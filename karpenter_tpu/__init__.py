@@ -1,0 +1,20 @@
+"""karpenter-tpu: a TPU-native Kubernetes node-provisioning framework.
+
+A ground-up rebuild of the capabilities of the Karpenter AWS provider
+(reference: ellistarn/karpenter-provider-aws) plus the scheduling core it
+plugs into (sigs.k8s.io/karpenter), re-architected TPU-first:
+
+- The control plane (reconcilers, providers, caches, cloud API emulation)
+  is host-side Python, mirroring the reference's Go reconciler structure
+  (reference: cmd/controller/main.go:30-84, pkg/operator/operator.go:96-212).
+- The decision plane -- the FFD bin-packing provisioning loop and the
+  consolidation candidate search, the two hot loops identified in
+  SURVEY.md section 3 -- is a batched JAX solver: pods x instance-type
+  fit/cost tensors evaluated on TPU, with constraint algebra lowered to
+  boolean masks and the sequential FFD loop reformulated as a
+  lax.scan over *pod equivalence classes* (not individual pods).
+- Scale-out: the solve shards over a jax.sharding.Mesh (pods axis = data
+  parallel, catalog axis = tensor parallel) with XLA collectives.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,38 @@
+from karpenter_tpu.apis import labels
+from karpenter_tpu.apis.objects import APIObject, ObjectMeta, StatusConditions, Condition, generate_name
+from karpenter_tpu.apis.nodepool import (
+    NodePool,
+    NodeClaimTemplate,
+    NodeClassRef,
+    Disruption,
+    Budget,
+    CONSOLIDATION_WHEN_EMPTY,
+    CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED,
+)
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.nodeclass import TPUNodeClass, SelectorTerm, ImageSelectorTerm
+from karpenter_tpu.apis.pod import Pod, Node, TopologySpreadConstraint, PodAffinityTerm
+
+__all__ = [
+    "labels",
+    "APIObject",
+    "ObjectMeta",
+    "StatusConditions",
+    "Condition",
+    "generate_name",
+    "NodePool",
+    "NodeClaimTemplate",
+    "NodeClassRef",
+    "Disruption",
+    "Budget",
+    "CONSOLIDATION_WHEN_EMPTY",
+    "CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED",
+    "NodeClaim",
+    "TPUNodeClass",
+    "SelectorTerm",
+    "ImageSelectorTerm",
+    "Pod",
+    "Node",
+    "TopologySpreadConstraint",
+    "PodAffinityTerm",
+]

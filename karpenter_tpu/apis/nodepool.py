@@ -1,0 +1,120 @@
+"""NodePool API type.
+
+Rebuilt from the core CRD shipped by the reference
+(pkg/apis/crds/karpenter.sh_nodepools.yaml): template (labels/annotations/
+requirements/taints/startup-taints/node-class-ref/expire-after), disruption
+policy (consolidation policy, consolidate-after, budgets), limits, weight.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.apis.objects import APIObject
+from karpenter_tpu.scheduling import Requirement, Requirements, Resources, Taint
+
+CONSOLIDATION_WHEN_EMPTY = "WhenEmpty"
+CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED = "WhenEmptyOrUnderutilized"
+
+
+@dataclass
+class Budget:
+    """Disruption budget: max share of nodes disruptable at once,
+    optionally gated to reasons and a cron schedule window."""
+
+    nodes: str = "10%"  # absolute int or percentage
+    reasons: Optional[List[str]] = None  # None = all reasons
+    schedule: Optional[str] = None
+    duration: Optional[float] = None
+
+    def allowed(self, total_nodes: int) -> int:
+        if self.nodes.endswith("%"):
+            pct = float(self.nodes[:-1]) / 100.0
+            return int(total_nodes * pct)
+        return int(self.nodes)
+
+
+@dataclass
+class Disruption:
+    consolidation_policy: str = CONSOLIDATION_WHEN_EMPTY_OR_UNDERUTILIZED
+    consolidate_after: float = 0.0  # seconds; 0 = immediately
+    budgets: List[Budget] = field(default_factory=lambda: [Budget()])
+
+
+@dataclass
+class NodeClassRef:
+    name: str = "default"
+    kind: str = "TPUNodeClass"
+    group: str = "karpenter.tpu"
+
+
+@dataclass
+class NodeClaimTemplate:
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    requirements: List[Requirement] = field(default_factory=list)
+    taints: List[Taint] = field(default_factory=list)
+    startup_taints: List[Taint] = field(default_factory=list)
+    node_class_ref: NodeClassRef = field(default_factory=NodeClassRef)
+    expire_after: Optional[float] = None  # seconds; None = never
+    termination_grace_period: Optional[float] = None
+
+
+class NodePool(APIObject):
+    KIND = "NodePool"
+
+    def __init__(
+        self,
+        name: str,
+        requirements: Sequence[Requirement] = (),
+        limits: Optional[Resources] = None,
+        weight: int = 0,
+        template: Optional[NodeClaimTemplate] = None,
+        disruption: Optional[Disruption] = None,
+    ):
+        super().__init__(name=name)
+        self.template = template or NodeClaimTemplate()
+        if requirements:
+            self.template.requirements = list(requirements)
+        self.limits = limits
+        self.weight = weight
+        self.disruption = disruption or Disruption()
+        # status
+        self.status_resources = Resources()  # aggregate of owned nodes
+
+    def requirements(self) -> Requirements:
+        """Template requirements + labels, as a single Requirements set
+        (the scheduler's starting constraint set for this pool)."""
+        reqs = Requirements(self.template.requirements)
+        reqs = reqs.union(Requirements.from_labels(self.template.labels))
+        from karpenter_tpu.apis import labels as wk
+
+        reqs.add(Requirement(wk.NODEPOOL_LABEL, "In", [self.name]))
+        return reqs
+
+    def static_hash(self) -> str:
+        """Drift hash over the static template fields (reference:
+        nodepool-hash annotation stamped by the core, mirrored by
+        pkg/controllers/nodeclass/hash for the nodeclass)."""
+        payload = {
+            "labels": self.template.labels,
+            "annotations": self.template.annotations,
+            "taints": [(t.key, t.value, t.effect) for t in self.template.taints],
+            "startup_taints": [(t.key, t.value, t.effect) for t in self.template.startup_taints],
+            "expire_after": self.template.expire_after,
+            "node_class_ref": (
+                self.template.node_class_ref.group,
+                self.template.node_class_ref.kind,
+                self.template.node_class_ref.name,
+            ),
+        }
+        return hashlib.blake2b(
+            json.dumps(payload, sort_keys=True).encode(), digest_size=8
+        ).hexdigest()
+
+    def within_limits(self, usage: Resources) -> bool:
+        if self.limits is None:
+            return True
+        return usage.fits(self.limits)

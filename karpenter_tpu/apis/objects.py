@@ -1,0 +1,132 @@
+"""Object machinery shared by all API types.
+
+The reference's types are k8s CRDs with metadata, spec, status, and
+status conditions managed by controller chains
+(e.g. pkg/controllers/nodeclass/controller.go:114-163). Without a kube
+apiserver in this environment, this module provides the equivalent object
+model: metadata (name/labels/annotations/finalizers/creation time/uid),
+status conditions with transition times, resource-version optimistic
+concurrency, and deep-copy -- the contract the in-memory API server in
+karpenter_tpu.kwok enforces.
+"""
+from __future__ import annotations
+
+import copy
+import itertools
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_seq = itertools.count(1)
+
+
+def now() -> float:
+    return time.time()
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = ""
+    uid: str = field(default_factory=lambda: str(uuid.uuid4()))
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    owner_references: List[str] = field(default_factory=list)  # uids
+    creation_timestamp: float = field(default_factory=now)
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+    generation: int = 1
+
+
+@dataclass
+class Condition:
+    type: str
+    status: str  # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = field(default_factory=now)
+    observed_generation: int = 0
+
+
+class StatusConditions:
+    """operatorpkg-style condition set: Set/Get/IsTrue + root readiness."""
+
+    READY = "Ready"
+
+    def __init__(self, root: str = READY):
+        self._conds: Dict[str, Condition] = {}
+        self._root = root
+
+    def set_true(self, ctype: str, reason: str = "", message: str = "") -> None:
+        self._set(ctype, "True", reason, message)
+
+    def set_false(self, ctype: str, reason: str = "", message: str = "") -> None:
+        self._set(ctype, "False", reason, message)
+
+    def set_unknown(self, ctype: str, reason: str = "AwaitingReconciliation", message: str = "") -> None:
+        self._set(ctype, "Unknown", reason, message)
+
+    def _set(self, ctype: str, status: str, reason: str, message: str) -> None:
+        prev = self._conds.get(ctype)
+        if prev is not None and prev.status == status:
+            prev.reason, prev.message = reason or prev.reason, message or prev.message
+            return
+        self._conds[ctype] = Condition(ctype, status, reason, message)
+
+    def get(self, ctype: str) -> Optional[Condition]:
+        return self._conds.get(ctype)
+
+    def is_true(self, ctype: str) -> bool:
+        c = self._conds.get(ctype)
+        return c is not None and c.status == "True"
+
+    def is_false(self, ctype: str) -> bool:
+        c = self._conds.get(ctype)
+        return c is not None and c.status == "False"
+
+    def all(self) -> List[Condition]:
+        return list(self._conds.values())
+
+    def compute_root(self, dependents: List[str]) -> None:
+        """Root condition = AND of dependents (operatorpkg semantics)."""
+        if any(self.is_false(t) for t in dependents):
+            bad = next(t for t in dependents if self.is_false(t))
+            self.set_false(self._root, reason="UnhealthyDependents", message=f"{bad} is False")
+        elif all(self.is_true(t) for t in dependents):
+            self.set_true(self._root)
+        else:
+            self.set_unknown(self._root)
+
+
+class APIObject:
+    """Base for all stored objects."""
+
+    KIND = "Object"
+
+    def __init__(self, name: str = "", **meta_kwargs):
+        self.metadata = ObjectMeta(name=name, **meta_kwargs)
+        self.status_conditions = StatusConditions()
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.uid
+
+    @property
+    def deleting(self) -> bool:
+        return self.metadata.deletion_timestamp is not None
+
+    def deep_copy(self):
+        return copy.deepcopy(self)
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.metadata.name!r})"
+
+
+def generate_name(prefix: str) -> str:
+    return f"{prefix}{uuid.uuid4().hex[:8]}"

@@ -1,0 +1,141 @@
+"""Pod and Node object model for the in-memory cluster.
+
+The reference consumes real corev1.Pod/Node through the core scheduler; this
+framework carries the subset of those objects the scheduling and disruption
+paths actually read: requests, node selector / required node affinity,
+tolerations, topology spread, (anti-)affinity, priority, deletion cost,
+ownership, and node binding.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Optional, Sequence
+
+from karpenter_tpu.apis.objects import APIObject
+from karpenter_tpu.scheduling import Requirement, Requirements, Resources, Taint, Toleration
+
+DO_NOT_DISRUPT_ANNOTATION = "karpenter.sh/do-not-disrupt"
+POD_DELETION_COST_ANNOTATION = "controller.kubernetes.io/pod-deletion-cost"
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str = "DoNotSchedule"  # or ScheduleAnyway
+    label_selector: Dict[str, str] = field(default_factory=dict)
+
+    def hard(self) -> bool:
+        return self.when_unsatisfiable == "DoNotSchedule"
+
+
+@dataclass
+class PodAffinityTerm:
+    label_selector: Dict[str, str] = field(default_factory=dict)
+    topology_key: str = "kubernetes.io/hostname"
+    anti: bool = False
+
+
+class Pod(APIObject):
+    KIND = "Pod"
+
+    def __init__(
+        self,
+        name: str,
+        namespace: str = "default",
+        requests: Optional[Resources] = None,
+        limits: Optional[Resources] = None,
+        node_selector: Optional[Mapping[str, str]] = None,
+        node_affinity_terms: Sequence[Sequence[Requirement]] = (),
+        tolerations: Sequence[Toleration] = (),
+        topology_spread: Sequence[TopologySpreadConstraint] = (),
+        affinity_terms: Sequence[PodAffinityTerm] = (),
+        priority: int = 0,
+        labels: Optional[Dict[str, str]] = None,
+        annotations: Optional[Dict[str, str]] = None,
+        owner_kind: str = "ReplicaSet",
+        scheduling_gates: Sequence[str] = (),
+    ):
+        super().__init__(name=name)
+        self.metadata.namespace = namespace
+        self.metadata.labels = dict(labels or {})
+        self.metadata.annotations = dict(annotations or {})
+        self.requests = requests or Resources()
+        self.limits = limits or Resources()
+        self.node_selector = dict(node_selector or {})
+        # required node affinity: OR over terms, each term a list of Requirements
+        self.node_affinity_terms = [list(t) for t in node_affinity_terms]
+        self.tolerations = list(tolerations)
+        self.topology_spread = list(topology_spread)
+        self.affinity_terms = list(affinity_terms)
+        self.priority = priority
+        self.owner_kind = owner_kind  # "" = bare pod (blocks consolidation)
+        self.scheduling_gates = list(scheduling_gates)
+
+        # status / spec binding
+        self.node_name: str = ""
+        self.phase: str = "Pending"
+
+    # -- scheduling views ---------------------------------------------------
+    def scheduling_requirements(self) -> List[Requirements]:
+        """The pod's hard node constraints as alternatives (OR of ANDs):
+        nodeSelector AND each nodeAffinity term. No affinity -> one term."""
+        base = Requirements.from_labels(self.node_selector)
+        if not self.node_affinity_terms:
+            return [base]
+        return [base.copy().add(*term) for term in self.node_affinity_terms]
+
+    @property
+    def bound(self) -> bool:
+        return bool(self.node_name)
+
+    @property
+    def pending(self) -> bool:
+        return self.phase == "Pending" and not self.node_name
+
+    def schedulable(self) -> bool:
+        return self.pending and not self.scheduling_gates and not self.deleting
+
+    def deletion_cost(self) -> float:
+        try:
+            return float(self.metadata.annotations.get(POD_DELETION_COST_ANNOTATION, "0"))
+        except ValueError:
+            return 0.0
+
+    def do_not_disrupt(self) -> bool:
+        return self.metadata.annotations.get(DO_NOT_DISRUPT_ANNOTATION) == "true"
+
+    def reschedulable(self) -> bool:
+        """Can this pod be evicted and rescheduled during disruption?
+        (reference: designs/consolidation.md 'Pods that Prevent Consolidation')"""
+        return bool(self.owner_kind) and not self.do_not_disrupt() and self.owner_kind != "Node"
+
+
+class Node(APIObject):
+    KIND = "Node"
+
+    def __init__(
+        self,
+        name: str,
+        labels: Optional[Dict[str, str]] = None,
+        capacity: Optional[Resources] = None,
+        allocatable: Optional[Resources] = None,
+        taints: Sequence[Taint] = (),
+        provider_id: str = "",
+    ):
+        super().__init__(name=name)
+        self.metadata.labels = dict(labels or {})
+        self.capacity = capacity or Resources()
+        self.allocatable = allocatable if allocatable is not None else self.capacity
+        self.taints: List[Taint] = list(taints)
+        self.provider_id = provider_id
+        self.ready: bool = False
+        self.unschedulable: bool = False  # cordon
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self.metadata.labels.get("topology.kubernetes.io/zone")
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self.metadata.labels.get("node.kubernetes.io/instance-type")

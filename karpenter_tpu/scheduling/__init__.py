@@ -1,0 +1,20 @@
+from karpenter_tpu.scheduling.resources import Resources, parse_quantity, format_quantity
+from karpenter_tpu.scheduling.requirements import (
+    Requirement,
+    Requirements,
+    Operator,
+)
+from karpenter_tpu.scheduling.taints import Taint, Toleration, tolerates, tolerates_all
+
+__all__ = [
+    "Resources",
+    "parse_quantity",
+    "format_quantity",
+    "Requirement",
+    "Requirements",
+    "Operator",
+    "Taint",
+    "Toleration",
+    "tolerates",
+    "tolerates_all",
+]

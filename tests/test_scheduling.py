@@ -1,0 +1,242 @@
+"""Unit tests for the scheduling vocabulary: quantities, resources,
+requirements algebra, taints. Modeled on the behavior the reference exercises
+through the core module (SURVEY.md section 2.3 'Scheduling requirements algebra')."""
+import pytest
+
+from karpenter_tpu.scheduling import (
+    Operator,
+    Requirement,
+    Requirements,
+    Resources,
+    Taint,
+    Toleration,
+    parse_quantity,
+    tolerates_all,
+)
+from karpenter_tpu.scheduling import resources as res
+
+
+class TestQuantity:
+    def test_cpu_forms(self):
+        assert parse_quantity("1", "cpu") == 1000.0
+        assert parse_quantity("250m", "cpu") == 250.0
+        assert parse_quantity("2.5", "cpu") == 2500.0
+        assert parse_quantity(1500, "cpu") == 1500.0  # numeric = base units (milli)
+
+    def test_memory_forms(self):
+        assert parse_quantity("1Ki", "memory") == 1024.0
+        assert parse_quantity("1Gi", "memory") == 2**30
+        assert parse_quantity("1G", "memory") == 1e9
+        assert parse_quantity("128974848", "memory") == 128974848.0
+        assert parse_quantity("1.5Gi", "memory") == 1.5 * 2**30
+
+    def test_milli_non_cpu(self):
+        assert parse_quantity("1500m", "memory") == 1.5
+
+    def test_bad(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc", "cpu")
+        with pytest.raises(ValueError):
+            parse_quantity("1Xx", "memory")
+
+
+class TestResources:
+    def test_arith_and_fit(self):
+        a = Resources({"cpu": "1", "memory": "1Gi"})
+        b = Resources({"cpu": "500m", "memory": "512Mi"})
+        s = a + b
+        assert s["cpu"] == 1500.0
+        assert s["memory"] == 1.5 * 2**30
+        assert b.fits(a)
+        assert not a.fits(b)
+        d = a - b
+        assert not d.any_negative()
+        assert (b - a).any_negative()
+
+    def test_vectorize(self):
+        r = Resources({"cpu": "2", "memory": "4Gi", "pods": 3})
+        v = r.to_vector()
+        assert v[res.AXIS_INDEX["cpu"]] == 2000.0
+        assert v[res.AXIS_INDEX["memory"]] == 4 * 2**30
+        assert v[res.AXIS_INDEX["pods"]] == 3.0
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(KeyError):
+            Resources({"example.com/widget": 1}).to_vector()
+
+
+class TestRequirement:
+    def test_in_matching(self):
+        r = Requirement("zone", Operator.IN, ["us-a", "us-b"])
+        assert r.matches("us-a")
+        assert not r.matches("us-c")
+        assert not r.matches(None)
+
+    def test_not_in_and_exists(self):
+        r = Requirement("zone", Operator.NOT_IN, ["us-a"])
+        assert not r.matches("us-a")
+        assert r.matches("us-b")
+        e = Requirement("zone", Operator.EXISTS)
+        assert e.matches("anything")
+        assert not e.matches(None)
+        d = Requirement("zone", Operator.DOES_NOT_EXIST)
+        assert d.matches(None)
+        assert not d.matches("us-a")
+
+    def test_gt_lt(self):
+        g = Requirement("cpu", Operator.GT, ["4"])
+        assert g.matches("8")
+        assert not g.matches("4")
+        l = Requirement("cpu", Operator.LT, ["16"])
+        assert l.matches("8")
+        assert not l.matches("16")
+        both = g.intersect(l)
+        assert both.matches("8")
+        assert not both.matches("2")
+        assert not both.matches("32")
+
+    def test_intersect_in_in(self):
+        a = Requirement("k", Operator.IN, ["1", "2", "3"])
+        b = Requirement("k", Operator.IN, ["2", "3", "4"])
+        assert a.intersect(b).values == {"2", "3"}
+        assert a.intersects(b)
+        c = Requirement("k", Operator.IN, ["9"])
+        assert not a.intersects(c)
+
+    def test_intersect_in_notin(self):
+        a = Requirement("k", Operator.IN, ["1", "2"])
+        b = Requirement("k", Operator.NOT_IN, ["2"])
+        assert a.intersect(b).values == {"1"}
+        assert a.intersects(b)
+
+    def test_intersect_notin_notin(self):
+        a = Requirement("k", Operator.NOT_IN, ["1"])
+        b = Requirement("k", Operator.NOT_IN, ["2"])
+        m = a.intersect(b)
+        assert m.complement and m.values == {"1", "2"}
+        assert a.intersects(b)
+
+    def test_gt_window_filters_in_set(self):
+        a = Requirement("cpu", Operator.IN, ["2", "8", "32"])
+        g = Requirement("cpu", Operator.GT, ["4"])
+        m = a.intersect(g)
+        assert m.values == {"8", "32"}
+
+
+class TestRequirements:
+    def test_add_tightens(self):
+        rs = Requirements([Requirement("zone", Operator.IN, ["a", "b", "c"])])
+        rs.add(Requirement("zone", Operator.NOT_IN, ["b"]))
+        assert rs.get("zone").values == {"a", "c"}
+
+    def test_compatible(self):
+        itype = Requirements(
+            [
+                Requirement("arch", Operator.IN, ["amd64"]),
+                Requirement("zone", Operator.IN, ["a", "b"]),
+            ]
+        )
+        pod = Requirements([Requirement("zone", Operator.IN, ["b", "c"])])
+        assert itype.compatible(pod)
+        pod2 = Requirements([Requirement("zone", Operator.IN, ["z"])])
+        assert not itype.compatible(pod2)
+        # arch key missing on pod side is fine (conjunction only over other's keys)
+        assert itype.compatible(Requirements())
+
+    def test_compatible_undefined_policy(self):
+        itype = Requirements([Requirement("arch", Operator.IN, ["amd64"])])
+        pod = Requirements([Requirement("custom/label", Operator.IN, ["x"])])
+        # default: missing key on self is permissive
+        assert itype.compatible(pod)
+        # restricted: only well-known keys may be undefined
+        assert not itype.compatible(pod, allow_undefined=set())
+        assert itype.compatible(pod, allow_undefined={"custom/label"})
+
+    def test_labels_projection(self):
+        rs = Requirements(
+            [
+                Requirement("a", Operator.IN, ["1"]),
+                Requirement("b", Operator.IN, ["1", "2"]),
+                Requirement("c", Operator.NOT_IN, ["1"]),
+            ]
+        )
+        assert rs.labels() == {"a": "1"}
+
+    def test_matches_labels(self):
+        rs = Requirements.from_labels({"a": "1"})
+        assert rs.matches_labels({"a": "1", "b": "2"})
+        assert not rs.matches_labels({"a": "2"})
+        assert not rs.matches_labels({})
+
+    def test_stable_hash(self):
+        r1 = Requirements([Requirement("a", Operator.IN, ["1", "2"])])
+        r2 = Requirements([Requirement("a", Operator.IN, ["2", "1"])])
+        r3 = Requirements([Requirement("a", Operator.IN, ["3"])])
+        assert r1.stable_hash() == r2.stable_hash()
+        assert r1.stable_hash() != r3.stable_hash()
+
+
+class TestTaints:
+    def test_basic(self):
+        t = Taint("dedicated", value="gpu")
+        assert not tolerates_all([], [t])
+        assert tolerates_all([Toleration(key="dedicated", value="gpu")], [t])
+        assert tolerates_all([Toleration(operator="Exists")], [t])
+        assert tolerates_all([Toleration(key="dedicated", operator="Exists")], [t])
+        assert not tolerates_all([Toleration(key="other", operator="Exists")], [t])
+
+    def test_prefer_no_schedule_soft(self):
+        t = Taint("x", effect="PreferNoSchedule")
+        assert tolerates_all([], [t])
+
+    def test_effect_scoping(self):
+        t = Taint("k", effect="NoExecute", value="v")
+        assert not tolerates_all([Toleration(key="k", value="v", effect="NoSchedule")], [t])
+        assert tolerates_all([Toleration(key="k", value="v", effect="NoExecute")], [t])
+
+
+class TestAPITypes:
+    def test_nodepool_requirements_include_pool_label(self):
+        from karpenter_tpu.apis import NodePool, labels as wk
+
+        np = NodePool("default", requirements=[Requirement(wk.ARCH_LABEL, Operator.IN, ["amd64"])])
+        reqs = np.requirements()
+        assert reqs.get(wk.NODEPOOL_LABEL).values == {"default"}
+        assert reqs.get(wk.ARCH_LABEL).values == {"amd64"}
+
+    def test_pod_scheduling_requirements(self):
+        from karpenter_tpu.apis import Pod
+
+        p = Pod(
+            "p1",
+            node_selector={"zone": "a"},
+            node_affinity_terms=[
+                [Requirement("arch", Operator.IN, ["arm64"])],
+                [Requirement("arch", Operator.IN, ["amd64"])],
+            ],
+        )
+        alts = p.scheduling_requirements()
+        assert len(alts) == 2
+        for alt in alts:
+            assert alt.get("zone").values == {"a"}
+
+    def test_nodeclass_hash_stability(self):
+        from karpenter_tpu.apis import TPUNodeClass
+
+        a, b = TPUNodeClass("a"), TPUNodeClass("b")
+        assert a.static_hash() == b.static_hash()
+        b.user_data = "#!/bin/bash"
+        assert a.static_hash() != b.static_hash()
+
+    def test_conditions_root(self):
+        from karpenter_tpu.apis import TPUNodeClass
+        from karpenter_tpu.apis.nodeclass import NODECLASS_CONDITIONS
+
+        nc = TPUNodeClass("default")
+        for c in NODECLASS_CONDITIONS:
+            nc.status_conditions.set_true(c)
+        nc.status_conditions.compute_root(NODECLASS_CONDITIONS)
+        assert nc.status_conditions.is_true("Ready")
+        nc.status_conditions.set_false(NODECLASS_CONDITIONS[0], "boom")
+        nc.status_conditions.compute_root(NODECLASS_CONDITIONS)
+        assert nc.status_conditions.is_false("Ready")
