@@ -1,0 +1,3 @@
+from karpenter_tpu.kwok.cloud import FakeCloud, RateLimiter
+
+__all__ = ["FakeCloud", "RateLimiter"]

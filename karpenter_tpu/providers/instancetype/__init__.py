@@ -1,0 +1,4 @@
+from karpenter_tpu.providers.instancetype.types import InstanceType, Offering, Resolver
+from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+
+__all__ = ["InstanceType", "Offering", "Resolver", "InstanceTypeProvider"]

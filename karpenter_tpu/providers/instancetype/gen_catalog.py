@@ -1,0 +1,372 @@
+"""Machine-catalog data pipeline.
+
+The reference ships generated static tables -- VPC/ENI limits
+(zz_generated.vpclimits.go, 14.5k LoC), network bandwidth
+(zz_generated.bandwidth.go), and fallback price tables
+(zz_generated.pricing_*.go) -- produced by hack/code/{vpc_limits_gen,
+bandwidth_gen,prices_gen}. This module is the equivalent pipeline: a
+deterministic generator that synthesizes a realistic ~700-entry machine
+catalog (shapes, ENI-style pod limits, bandwidth, zonal availability,
+on-demand and zonal spot prices) and can persist it to JSON
+(data/catalog.json) for inspection and for the fake-cloud emulator.
+
+Determinism: every "random" choice is a pure hash of the type/zone name, so
+catalog and prices are stable across processes (and across JAX traces).
+
+The taxonomy is EC2-shaped (categories c/m/r/x/t/i/d/g/p + an `acc`
+ML-accelerator family; generations 3-8; size ladder nano..metal) so that
+users of the reference find the vocabulary they expect, but every number
+here is synthesized from the models below, not copied.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Tuple
+
+from karpenter_tpu.cloud.types import InstanceTypeInfo, ZoneInfo
+
+REGION = "us-central-1"
+ZONES = [
+    ZoneInfo(f"{REGION}a", "uc1-az1"),
+    ZoneInfo(f"{REGION}b", "uc1-az2"),
+    ZoneInfo(f"{REGION}c", "uc1-az3"),
+    ZoneInfo(f"{REGION}d", "uc1-az4"),
+]
+ZONE_NAMES = tuple(z.name for z in ZONES)
+
+GIB = 1024  # MiB per GiB
+
+# size ladder: name -> vcpu multiplier relative to "large" (2 vCPU)
+SIZES: List[Tuple[str, int]] = [
+    ("medium", 1),
+    ("large", 2),
+    ("xlarge", 4),
+    ("2xlarge", 8),
+    ("4xlarge", 16),
+    ("8xlarge", 32),
+    ("12xlarge", 48),
+    ("16xlarge", 64),
+    ("24xlarge", 96),
+    ("32xlarge", 128),
+    ("48xlarge", 192),
+]
+SIZE_INDEX = {name: i for i, (name, _) in enumerate(SIZES)}
+
+# memory GiB per vCPU by category
+MEM_RATIO = {"c": 2, "m": 4, "r": 8, "x": 16, "t": 4, "i": 8, "d": 8, "g": 4, "p": 8, "acc": 4}
+
+# price model ($/hr): vcpu * cpu_rate + mem_gib * mem_rate, then multipliers
+CPU_RATE = 0.0255
+MEM_RATE = 0.0058
+ARCH_MULT = {"intel": 1.0, "amd": 0.90, "arm-native": 0.78}
+GEN_MULT = {3: 1.10, 4: 1.05, 5: 1.00, 6: 0.98, 7: 0.97, 8: 0.96}
+GPU_PRICE = {"t4g-like": 0.35, "a10-like": 0.60, "v100-like": 2.10, "a100-like": 4.10, "h100-like": 9.80}
+ACCEL_PRICE = {"ml-v4": 1.10, "ml-v5": 1.45}
+
+# family table: (family, category, generation, arch, cpu_mfr, flags, size slice)
+# flags: d = local nvme, n = network optimized, e = extra memory
+_FAM = []
+
+
+def _fam(family, cat, gen, arch, mfr, flags="", lo="large", hi="24xlarge"):
+    _FAM.append((family, cat, gen, arch, mfr, flags, lo, hi))
+
+
+# compute-optimized
+for gen, variants in [(4, ["i"]), (5, ["i", "a", "d", "n"]), (6, ["i", "a", "g", "gd", "gn", "id"]), (7, ["i", "a", "g", "gd"]), (8, ["g"])]:
+    for v in variants:
+        arm = v.startswith("g")  # graviton-style variants (incl. c6gn) are arm64
+        _fam(
+            f"c{gen}{'' if v == 'i' and gen < 6 else v}",
+            "c",
+            gen,
+            "arm64" if arm else "amd64",
+            "arm-native" if arm else ("amd" if "a" in v and not arm else "intel"),
+            ("d" if "d" in v else "") + ("n" if "n" in v else ""),
+            "large",
+            "48xlarge" if gen >= 7 else "24xlarge",
+        )
+# general purpose
+for gen, variants in [(4, [""]), (5, ["", "a", "d", "n", "ad"]), (6, ["i", "a", "g", "gd", "id", "idn"]), (7, ["i", "a", "g", "gd", "i-flex"]), (8, ["g"])]:
+    for v in variants:
+        arm = v.startswith("g")
+        _fam(
+            f"m{gen}{v}",
+            "m",
+            gen,
+            "arm64" if arm else "amd64",
+            "arm-native" if arm else ("amd" if v.startswith("a") else "intel"),
+            ("d" if "d" in v else "") + ("n" if "n" in v else ""),
+            "large",
+            "32xlarge" if gen >= 6 else "24xlarge",
+        )
+# memory optimized
+for gen, variants in [(4, [""]), (5, ["", "a", "d", "n", "b"]), (6, ["i", "a", "g", "gd", "id"]), (7, ["i", "a", "g", "iz"]), (8, ["g"])]:
+    for v in variants:
+        arm = v.startswith("g")
+        _fam(
+            f"r{gen}{v}",
+            "r",
+            gen,
+            "arm64" if arm else "amd64",
+            "arm-native" if arm else ("amd" if v.startswith("a") else "intel"),
+            ("d" if "d" in v else ""),
+            "large",
+            "48xlarge" if gen >= 7 else "24xlarge",
+        )
+# extra-high memory
+_fam("x1", "x", 4, "amd64", "intel", "e", "16xlarge", "32xlarge")
+_fam("x1e", "x", 4, "amd64", "intel", "e", "xlarge", "32xlarge")
+_fam("x2idn", "x", 6, "amd64", "intel", "de", "16xlarge", "32xlarge")
+_fam("x2iedn", "x", 6, "amd64", "intel", "de", "xlarge", "32xlarge")
+_fam("x2gd", "x", 6, "arm64", "arm-native", "de", "large", "16xlarge")
+# burstable
+_fam("t2", "t", 2, "amd64", "intel", "b", "medium", "2xlarge")
+_fam("t3", "t", 3, "amd64", "intel", "b", "medium", "2xlarge")
+_fam("t3a", "t", 3, "amd64", "amd", "b", "medium", "2xlarge")
+_fam("t4g", "t", 4, "arm64", "arm-native", "b", "medium", "2xlarge")
+# storage optimized
+_fam("i3", "i", 3, "amd64", "intel", "d", "large", "16xlarge")
+_fam("i3en", "i", 3, "amd64", "intel", "dn", "large", "24xlarge")
+_fam("i4i", "i", 6, "amd64", "intel", "d", "large", "32xlarge")
+_fam("i4g", "i", 6, "arm64", "arm-native", "d", "large", "16xlarge")
+_fam("d2", "d", 2, "amd64", "intel", "d", "xlarge", "8xlarge")
+_fam("d3", "d", 3, "amd64", "intel", "d", "xlarge", "8xlarge")
+# gpu
+_GPU_FAMS = {
+    "g4dn": ("t4g-like", 16, 1),   # gpu name, gpu mem GiB, base count
+    "g5": ("a10-like", 24, 1),
+    "g6": ("a10-like", 24, 1),
+    "p3": ("v100-like", 16, 1),
+    "p4d": ("a100-like", 40, 8),
+    "p5": ("h100-like", 80, 8),
+}
+_fam("g4dn", "g", 4, "amd64", "intel", "dg", "xlarge", "16xlarge")
+_fam("g5", "g", 5, "amd64", "amd", "dg", "xlarge", "48xlarge")
+_fam("g6", "g", 6, "amd64", "amd", "dg", "xlarge", "48xlarge")
+_fam("p3", "p", 3, "amd64", "intel", "g", "2xlarge", "16xlarge")
+_fam("p4d", "p", 4, "amd64", "intel", "gn", "24xlarge", "24xlarge")
+_fam("p5", "p", 5, "amd64", "amd", "gn", "48xlarge", "48xlarge")
+# ML accelerator (trainium/inferentia-like)
+_ACC_FAMS = {"acc1": ("ml-v4", 1), "acc2": ("ml-v5", 1)}
+_fam("acc1", "acc", 6, "amd64", "intel", "an", "xlarge", "24xlarge")
+_fam("acc2", "acc", 7, "amd64", "amd", "an", "xlarge", "48xlarge")
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _generate_instance_types_cached() -> tuple:
+    return tuple(_generate_instance_types_impl())
+
+
+def _h(s: str) -> float:
+    """Deterministic uniform [0,1) from a string."""
+    return int(hashlib.blake2b(s.encode(), digest_size=8).hexdigest(), 16) / 2**64
+
+
+def _eni_limits(vcpu: int) -> Tuple[int, int]:
+    """(interfaces, ipv4 per interface), an ENI-style tier table."""
+    if vcpu <= 2:
+        return 3, 10
+    if vcpu <= 4:
+        return 4, 15
+    if vcpu <= 8:
+        return 4, 15
+    if vcpu <= 16:
+        return 8, 30
+    if vcpu <= 48:
+        return 8, 30
+    return 15, 50
+
+
+def _network_gbps(vcpu: int, flags: str, category: str) -> float:
+    base = min(100.0, max(1.0, vcpu * 0.4))
+    if "n" in flags:
+        base = min(400.0, base * 4)
+    if category in ("p", "acc"):
+        base = max(base, 100.0)
+    return round(base, 2)
+
+
+def _zones_for(name: str, category: str, bare_metal: bool) -> Tuple[str, ...]:
+    """Most types in all zones; exotic shapes in fewer (deterministic)."""
+    if category in ("p", "x", "acc") or bare_metal:
+        k = 2 if _h(name + "|z") < 0.7 else 3
+    elif _h(name + "|z") < 0.08:
+        k = 3
+    else:
+        k = 4
+    start = int(_h(name + "|zs") * 4)
+    return tuple(ZONE_NAMES[(start + i) % 4] for i in range(k))
+
+
+def generate_instance_types() -> List[InstanceTypeInfo]:
+    """Memoized: the generation is deterministic, so one synthesis serves
+    every consumer (pricing tables, fake cloud, solver encoding)."""
+    return list(_generate_instance_types_cached())
+
+
+def _generate_instance_types_impl() -> List[InstanceTypeInfo]:
+    out: List[InstanceTypeInfo] = []
+    for family, cat, gen, arch, mfr, flags, lo, hi in _FAM:
+        lo_i, hi_i = SIZE_INDEX[lo], SIZE_INDEX[hi]
+        sizes = [s for s in SIZES[lo_i : hi_i + 1]]
+        # burstable families also get nano/micro/small below medium
+        if "b" in flags and cat == "t":
+            sizes = [("nano", 2), ("micro", 2), ("small", 2)] + [(n, m) for n, m in sizes]
+        for size_name, mult in sizes:
+            if cat == "t" and size_name in ("nano", "micro", "small"):
+                vcpu = 2  # burstable minis: 2 shared vCPUs, sub-GiB memory
+                mem_gib = {"nano": 0.5, "micro": 1, "small": 2}[size_name]
+            else:
+                vcpu = mult  # SIZES second element is the vCPU count
+                mem_gib = vcpu * MEM_RATIO[cat]
+            if "e" in flags:
+                mem_gib *= 2
+            name = f"{family}.{size_name}"
+            ifaces, ips = _eni_limits(vcpu)
+            nvme = int(vcpu * 58.25) if "d" in flags else 0
+            gpu_name = gpu_mfr = ""
+            gpu_count = gpu_mem = 0
+            if family in _GPU_FAMS:
+                gname, gmem, gbase = _GPU_FAMS[family]
+                gpu_name, gpu_mfr = gname, "gpu-corp"
+                gpu_count = max(1, min(8, gbase * max(1, vcpu // 48) if gbase > 1 else max(1, vcpu // 16)))
+                gpu_mem = gmem * GIB
+            acc_name = acc_mfr = ""
+            acc_count = 0
+            if family in _ACC_FAMS:
+                aname, abase = _ACC_FAMS[family]
+                acc_name, acc_mfr = aname, "accel-corp"
+                acc_count = max(1, min(16, abase * max(1, vcpu // 8)))
+            nic = 0
+            if "n" in flags and vcpu >= 32:
+                nic = 1 if vcpu < 96 else (4 if cat in ("p", "acc") else 2)
+            usage = ("on-demand",) if cat == "x" and gen <= 4 else ("on-demand", "spot")
+            out.append(
+                InstanceTypeInfo(
+                    name=name,
+                    category=cat,
+                    family=family,
+                    generation=gen,
+                    size=size_name,
+                    vcpu=vcpu,
+                    memory_mib=int(mem_gib * GIB),
+                    arch=arch,
+                    cpu_manufacturer=mfr,
+                    sustained_clock_mhz=3500 - gen * 50 + (400 if cat == "c" else 0),
+                    hypervisor="nitro" if gen >= 5 else "xen",
+                    bare_metal=False,
+                    burstable="b" in flags and cat == "t",
+                    network_gbps=_network_gbps(vcpu, flags, cat),
+                    ebs_gbps=round(min(80.0, max(2.0, vcpu * 0.6)), 2),
+                    max_network_interfaces=ifaces,
+                    ipv4_per_interface=ips,
+                    local_nvme_gib=nvme,
+                    gpu_name=gpu_name,
+                    gpu_manufacturer=gpu_mfr,
+                    gpu_count=gpu_count,
+                    gpu_memory_mib=gpu_mem,
+                    accelerator_name=acc_name,
+                    accelerator_manufacturer=acc_mfr,
+                    accelerator_count=acc_count,
+                    nic_count=nic,
+                    encryption_in_transit=gen >= 5,
+                    supported_usage_classes=usage,
+                    zones=_zones_for(name, cat, False),
+                )
+            )
+        # metal variant for modern non-burstable families
+        if gen >= 5 and cat not in ("t", "g", "p", "acc"):
+            vcpu = SIZES[hi_i][1]
+            mem_gib = vcpu * MEM_RATIO[cat] * (2 if "e" in flags else 1)
+            name = f"{family}.metal"
+            ifaces, ips = _eni_limits(vcpu)
+            out.append(
+                InstanceTypeInfo(
+                    name=name,
+                    category=cat,
+                    family=family,
+                    generation=gen,
+                    size="metal",
+                    vcpu=vcpu,
+                    memory_mib=int(mem_gib * GIB),
+                    arch=arch,
+                    cpu_manufacturer=mfr,
+                    hypervisor="",
+                    bare_metal=True,
+                    network_gbps=_network_gbps(vcpu, flags, cat),
+                    ebs_gbps=round(min(80.0, vcpu * 0.6), 2),
+                    max_network_interfaces=ifaces,
+                    ipv4_per_interface=ips,
+                    local_nvme_gib=int(vcpu * 58.25) if "d" in flags else 0,
+                    encryption_in_transit=True,
+                    zones=_zones_for(name, cat, True),
+                )
+            )
+    return out
+
+
+def on_demand_price(it: InstanceTypeInfo) -> float:
+    mem_gib = it.memory_mib / GIB
+    price = it.vcpu * CPU_RATE + mem_gib * MEM_RATE
+    price *= ARCH_MULT[it.cpu_manufacturer]
+    price *= GEN_MULT.get(it.generation, 1.08)
+    if it.burstable:
+        price *= 0.55
+    if it.local_nvme_gib:
+        price *= 1.08
+    if it.nic_count:
+        price *= 1.06
+    if it.bare_metal:
+        price *= 1.12
+    if it.gpu_count:
+        price += it.gpu_count * GPU_PRICE[it.gpu_name]
+    if it.accelerator_count:
+        price += it.accelerator_count * ACCEL_PRICE[it.accelerator_name]
+    return round(price, 4)
+
+
+def spot_price(it: InstanceTypeInfo, zone: str) -> float:
+    """Zonal spot price: 25-45% of on-demand, deterministic per (type, zone)."""
+    od = on_demand_price(it)
+    frac = 0.25 + 0.20 * _h(f"{it.name}|{zone}|spot")
+    return round(od * frac, 4)
+
+
+def generate_catalog() -> Dict:
+    """Full catalog document: types + prices + zones."""
+    types = generate_instance_types()
+    return {
+        "region": REGION,
+        "zones": [{"name": z.name, "id": z.zone_id, "type": z.zone_type} for z in ZONES],
+        "types": [
+            {
+                **{k: getattr(it, k) for k in InstanceTypeInfo.__dataclass_fields__},
+                "zones": list(it.zones),
+                "supported_usage_classes": list(it.supported_usage_classes),
+                "on_demand_price": on_demand_price(it),
+                "spot_prices": {z: spot_price(it, z) for z in it.zones if "spot" in it.supported_usage_classes},
+            }
+            for it in types
+        ],
+    }
+
+
+DATA_PATH = os.path.join(os.path.dirname(__file__), "data", "catalog.json")
+
+
+def main() -> None:
+    doc = generate_catalog()
+    os.makedirs(os.path.dirname(DATA_PATH), exist_ok=True)
+    with open(DATA_PATH, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    print(f"wrote {len(doc['types'])} instance types to {DATA_PATH}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.pricing.provider import PricingProvider
+
+__all__ = ["PricingProvider"]

@@ -1,0 +1,291 @@
+"""Catalog pipeline + instance-type provider + pricing + ICE cache tests.
+
+Modeled on the reference suites for pkg/providers/instancetype and
+pkg/providers/pricing (SURVEY.md section 4 tier 1)."""
+import pytest
+
+from karpenter_tpu.apis import TPUNodeClass, labels as wk
+from karpenter_tpu.apis.nodeclass import SubnetStatus, CapacityReservationStatus
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+from karpenter_tpu.kwok.cloud import FakeCloud
+from karpenter_tpu.providers.instancetype import gen_catalog
+from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder, RESERVED_PRICE_DIVISOR
+from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+from karpenter_tpu.providers.instancetype.types import Resolver
+from karpenter_tpu.providers.pricing import PricingProvider
+from karpenter_tpu.scheduling import Requirements, resources as res
+
+
+@pytest.fixture
+def clock():
+    return FakeClock(start=1000.0)
+
+
+@pytest.fixture
+def cloud(clock):
+    return FakeCloud(clock=clock)
+
+
+@pytest.fixture
+def provider(cloud, clock):
+    pricing = PricingProvider(cloud, cloud, gen_catalog.REGION)
+    ice = UnavailableOfferings(clock)
+    zone_ids = {z.name: z.zone_id for z in gen_catalog.ZONES}
+    builder = OfferingsBuilder(pricing, ice, zone_ids)
+    return InstanceTypeProvider(cloud, Resolver(gen_catalog.REGION), builder, ice, clock)
+
+
+@pytest.fixture
+def nodeclass(cloud):
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return nc
+
+
+class TestGenCatalog:
+    def test_scale_and_uniqueness(self):
+        types = gen_catalog.generate_instance_types()
+        assert 550 <= len(types) <= 850
+        names = [t.name for t in types]
+        assert len(set(names)) == len(names)
+
+    def test_determinism(self):
+        a = gen_catalog.generate_catalog()
+        b = gen_catalog.generate_catalog()
+        assert a == b
+
+    def test_price_model_sanity(self):
+        types = {t.name: t for t in gen_catalog.generate_instance_types()}
+        m5l = types["m5.large"]
+        assert 0.05 < gen_catalog.on_demand_price(m5l) < 0.20
+        # arm cheaper than intel at same shape
+        assert gen_catalog.on_demand_price(types["m7g.large"]) < gen_catalog.on_demand_price(types["m7i.large"])
+        # spot strictly below on-demand in every zone
+        for z in m5l.zones:
+            assert gen_catalog.spot_price(m5l, z) < gen_catalog.on_demand_price(m5l)
+        # gpu adder dominates
+        assert gen_catalog.on_demand_price(types["p5.48xlarge"]) > 50
+
+
+class TestResolver:
+    def test_capacity_and_overhead(self, provider, nodeclass):
+        items = {it.name: it for it in provider.list(nodeclass)}
+        m5l = items["m5.large"]
+        assert m5l.capacity[res.CPU] == 2000.0
+        # memory: 8GiB minus 7.5% VM overhead
+        assert abs(m5l.capacity[res.MEMORY] - 8 * 2**30 * 0.925) < 2**20
+        assert m5l.capacity[res.PODS] == 29
+        alloc = m5l.allocatable()
+        assert alloc[res.CPU] < 2000.0
+        assert alloc[res.MEMORY] < m5l.capacity[res.MEMORY]
+
+    def test_requirement_labels(self, provider, nodeclass):
+        items = {it.name: it for it in provider.list(nodeclass)}
+        g5 = items["g5.xlarge"]
+        labels = g5.requirements.labels()
+        assert labels[wk.LABEL_INSTANCE_FAMILY] == "g5"
+        assert labels[wk.LABEL_INSTANCE_CATEGORY] == "g"
+        assert labels[wk.ARCH_LABEL] == "amd64"
+        assert labels[wk.LABEL_INSTANCE_GPU_COUNT] == "1"
+        # zone requirement covers its offerings
+        zones = {o.zone for o in g5.offerings}
+        assert set(g5.requirements.get(wk.ZONE_LABEL).values) == zones
+
+    def test_kubelet_max_pods_override(self, provider, cloud):
+        nc = TPUNodeClass("custom")
+        nc.kubelet.max_pods = 10
+        nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+        items = {it.name: it for it in provider.list(nc)}
+        assert items["m5.large"].capacity[res.PODS] == 10
+
+    def test_pool_requirements_filter(self, provider, nodeclass):
+        items = provider.list(nodeclass)
+        reqs = Requirements.from_labels({wk.LABEL_INSTANCE_CATEGORY: "c", wk.ARCH_LABEL: "arm64"})
+        compat = [it for it in items if it.requirements.compatible(reqs)]
+        assert compat and all(it.info.category == "c" and it.info.arch == "arm64" for it in compat)
+
+
+class TestOfferings:
+    def test_spot_and_od(self, provider, nodeclass):
+        items = {it.name: it for it in provider.list(nodeclass)}
+        m5l = items["m5.large"]
+        captypes = {o.capacity_type for o in m5l.offerings}
+        assert captypes == {"spot", "on-demand"}
+        spot = [o for o in m5l.offerings if o.capacity_type == "spot"]
+        od = [o for o in m5l.offerings if o.capacity_type == "on-demand"]
+        assert min(o.price for o in spot) < min(o.price for o in od)
+        assert all(o.available for o in m5l.offerings)
+
+    def test_ice_marks_unavailable_and_rotates_cache(self, provider, nodeclass):
+        items = {it.name: it for it in provider.list(nodeclass)}
+        target = items["m5.large"].offerings[0]
+        provider.unavailable.mark_unavailable("m5.large", target.zone, target.capacity_type)
+        items2 = {it.name: it for it in provider.list(nodeclass)}
+        assert items2["m5.large"] is not items["m5.large"]  # cache key rotated
+        marked = [
+            o
+            for o in items2["m5.large"].offerings
+            if o.zone == target.zone and o.capacity_type == target.capacity_type
+        ]
+        assert marked and not marked[0].available
+
+    def test_reserved_injected_fresh_with_price_floor(self, provider, nodeclass):
+        nodeclass.status_capacity_reservations = [
+            CapacityReservationStatus(
+                id="cr-1", instance_type="m5.large", zone=nodeclass.status_subnets[0].zone, available_count=3
+            )
+        ]
+        items = {it.name: it for it in provider.list(nodeclass)}
+        reserved = [o for o in items["m5.large"].offerings if o.capacity_type == "reserved"]
+        assert len(reserved) == 1
+        assert reserved[0].reservation_capacity == 3
+        assert reserved[0].price < 1.0 / RESERVED_PRICE_DIVISOR * 100
+        # reserved sorts cheaper than every spot/od offering
+        others = [o.price for o in items["m5.large"].offerings if o.capacity_type != "reserved"]
+        assert reserved[0].price < min(others)
+
+    def test_subnet_zones_scope_offerings(self, provider, cloud):
+        nc = TPUNodeClass("scoped")
+        subnets = cloud.describe_subnets()
+        nc.status_subnets = [SubnetStatus(subnets[0].id, subnets[0].zone, subnets[0].zone_id)]
+        items = provider.list(nc)
+        for it in items:
+            assert all(o.zone == subnets[0].zone for o in it.offerings)
+
+
+class TestProviderCaching:
+    def test_list_is_cached(self, provider, nodeclass, cloud):
+        a = provider.list(nodeclass)
+        calls_before = cloud.calls.get("describe_instance_types", 0)
+        b = provider.list(nodeclass)
+        assert a is b
+        assert cloud.calls.get("describe_instance_types", 0) == calls_before
+
+    def test_pricing_seq_rotates(self, provider, nodeclass):
+        a = provider.list(nodeclass)
+        provider.offerings.pricing.seq_num += 1
+        b = provider.list(nodeclass)
+        assert a is not b
+
+    def test_ttl_expiry(self, provider, nodeclass, clock):
+        a = provider.list(nodeclass)
+        clock.step(6 * 60)
+        b = provider.list(nodeclass)
+        assert a is not b
+
+    def test_discovered_capacity_applied(self, provider, nodeclass):
+        from karpenter_tpu.apis.nodeclass import ImageStatus
+
+        nodeclass.status_images = [ImageStatus(id="img-std-amd64", name="standard", )]
+        true_mem = 7.6 * 2**30
+        provider.update_capacity_from_node("m5.large", "img-std-amd64", true_mem)
+        items = {it.name: it for it in provider.list(nodeclass)}
+        assert items["m5.large"].capacity[res.MEMORY] == true_mem
+
+
+class TestPricingProvider:
+    def test_static_fallback_without_apis(self):
+        p = PricingProvider(None, None, gen_catalog.REGION)
+        price, ok = p.on_demand_price("m5.large")
+        assert ok and price > 0
+        sp, ok = p.spot_price("m5.large", gen_catalog.ZONE_NAMES[0])
+        assert ok and 0 < sp < price
+
+    def test_unknown_type(self):
+        p = PricingProvider(None, None, gen_catalog.REGION)
+        _, ok = p.on_demand_price("nope.large")
+        assert not ok
+
+
+class TestFakeCloudFleet:
+    def _lt(self, cloud):
+        from karpenter_tpu.cloud.types import LaunchTemplateInfo
+
+        return cloud.create_launch_template(
+            LaunchTemplateInfo(id="", name="lt-test", image_id="img-std-amd64", security_group_ids=["sg-nodes"])
+        )
+
+    def test_lowest_price_wins(self, cloud):
+        from karpenter_tpu.cloud.types import FleetOverride, FleetRequest
+
+        self._lt(cloud)
+        subnets = {s.zone: s for s in cloud.describe_subnets()}
+        m5l = next(t for t in cloud.describe_instance_types() if t.name == "m5.large")
+        m7g = next(t for t in cloud.describe_instance_types() if t.name == "m7g.large")
+        overrides = [
+            FleetOverride("m5.large", subnets[m5l.zones[0]].id, m5l.zones[0]),
+            FleetOverride("m7g.large", subnets[m7g.zones[0]].id, m7g.zones[0]),
+        ]
+        result = cloud.create_fleet(FleetRequest("lt-test", "on-demand", overrides, target_capacity=1))
+        assert len(result.instances) == 1
+        assert result.instances[0].instance_type == "m7g.large"  # arm64 is cheaper
+
+    def test_ice_on_exhausted_pool(self, cloud):
+        from karpenter_tpu.cloud.types import FleetOverride, FleetRequest
+
+        self._lt(cloud)
+        m5l = next(t for t in cloud.describe_instance_types() if t.name == "m5.large")
+        zone = m5l.zones[0]
+        subnet = next(s for s in cloud.describe_subnets() if s.zone == zone)
+        cloud.set_capacity("m5.large", zone, "on-demand", 1)
+        req = FleetRequest("lt-test", "on-demand", [FleetOverride("m5.large", subnet.id, zone)], target_capacity=3)
+        result = cloud.create_fleet(req)
+        assert len(result.instances) == 1
+        assert any(e.code == "InsufficientInstanceCapacity" and e.instance_type == "m5.large" for e in result.errors)
+
+    def test_terminate_and_tag(self, cloud):
+        from karpenter_tpu.cloud.types import FleetOverride, FleetRequest
+
+        self._lt(cloud)
+        m5l = next(t for t in cloud.describe_instance_types() if t.name == "m5.large")
+        subnet = next(s for s in cloud.describe_subnets() if s.zone == m5l.zones[0])
+        result = cloud.create_fleet(
+            FleetRequest("lt-test", "on-demand", [FleetOverride("m5.large", subnet.id, m5l.zones[0])])
+        )
+        iid = result.instances[0].id
+        cloud.create_tags(iid, {"Name": "node-1"})
+        assert cloud.describe_instances([iid])[0].tags["Name"] == "node-1"
+        assert cloud.terminate_instances([iid]) == [iid]
+        assert cloud.describe_instances([iid])[0].state == "terminated"
+
+    def test_checkpoint_restore(self, cloud):
+        from karpenter_tpu.cloud.types import FleetOverride, FleetRequest
+
+        self._lt(cloud)
+        m5l = next(t for t in cloud.describe_instance_types() if t.name == "m5.large")
+        subnet = next(s for s in cloud.describe_subnets() if s.zone == m5l.zones[0])
+        cloud.create_fleet(FleetRequest("lt-test", "on-demand", [FleetOverride("m5.large", subnet.id, m5l.zones[0])]))
+        blob = cloud.checkpoint()
+        fresh = FakeCloud()
+        fresh.restore(blob)
+        assert len(fresh.describe_instances()) == 1
+        assert fresh.describe_launch_templates(["lt-test"])
+
+    def test_rate_limiting(self, clock):
+        cloud = FakeCloud(clock=clock, rate_limit=2.0)
+        from karpenter_tpu.kwok.cloud import RateLimitError
+
+        for _ in range(4):  # burst = 4
+            cloud.describe_instances()
+        with pytest.raises(RateLimitError):
+            cloud.describe_instances()
+        clock.step(1.0)
+        cloud.describe_instances()  # tokens refilled
+
+
+class TestICECache:
+    def test_three_subcaches_and_ttl(self, clock):
+        ice = UnavailableOfferings(clock, ttl=60.0)
+        ice.mark_unavailable("m5.large", "z1", "spot")
+        ice.mark_capacity_type_unavailable("reserved")
+        ice.mark_az_unavailable("z2", "on-demand")
+        assert ice.is_unavailable("m5.large", "z1", "spot")
+        assert ice.is_unavailable("anything", "zX", "reserved")
+        assert ice.is_unavailable("c5.large", "z2", "on-demand")
+        assert not ice.is_unavailable("m5.large", "z2", "spot")
+        seq = ice.seq_num
+        clock.step(61)
+        assert not ice.is_unavailable("m5.large", "z1", "spot")
+        ice.mark_unavailable("x", "y", "spot")
+        assert ice.seq_num > seq
