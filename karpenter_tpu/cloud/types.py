@@ -172,6 +172,7 @@ class CloudInstance:
     capacity_reservation_id: Optional[str] = None
     provider_id: str = ""
     nic_count: int = 0
+    security_group_ids: List[str] = field(default_factory=list)
 
     def __post_init__(self):
         if not self.provider_id:

@@ -1,0 +1,3 @@
+from karpenter_tpu.cloudprovider.cloudprovider import CloudProvider, RepairPolicy
+
+__all__ = ["CloudProvider", "RepairPolicy"]

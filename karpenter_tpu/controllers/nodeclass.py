@@ -1,0 +1,189 @@
+"""TPUNodeClass status reconciler.
+
+Rebuilds the reconciler chain of pkg/controllers/nodeclass/controller.go:
+97-163: Image -> CapacityReservation -> Subnet -> SecurityGroup ->
+InstanceProfile -> Validation -> Readiness, each resolving cloud state into
+status and setting its condition; the finalizer tears down owned instance
+profiles and launch templates (:165-201). The hash sub-controller stamps
+drift annotations (pkg/controllers/nodeclass/hash/controller.go).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_tpu.apis import TPUNodeClass
+from karpenter_tpu.apis.nodeclass import (
+    COND_CAPACITY_RESERVATIONS_READY,
+    COND_IMAGES_READY,
+    COND_INSTANCE_PROFILE_READY,
+    COND_READY,
+    COND_SECURITY_GROUPS_READY,
+    COND_SUBNETS_READY,
+    COND_VALIDATION_SUCCEEDED,
+    HASH_ANNOTATION,
+    HASH_VERSION,
+    HASH_VERSION_ANNOTATION,
+    CapacityReservationStatus,
+    ImageStatus,
+    NODECLASS_CONDITIONS,
+    SecurityGroupStatus,
+    SubnetStatus,
+)
+from karpenter_tpu.cloud.api import ComputeAPI, IdentityAPI
+from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.providers.image import ImageProvider
+from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
+from karpenter_tpu.providers.subnet import SubnetProvider
+
+TERMINATION_FINALIZER = "karpenter.tpu/termination"
+
+
+class NodeClassController:
+    def __init__(
+        self,
+        cluster: Cluster,
+        compute_api: ComputeAPI,
+        identity_api: IdentityAPI,
+        subnets: SubnetProvider,
+        security_groups: SecurityGroupProvider,
+        images: ImageProvider,
+        launch_templates=None,
+        clock=None,
+    ):
+        self.cluster = cluster
+        self.compute_api = compute_api
+        self.identity_api = identity_api
+        self.subnets = subnets
+        self.security_groups = security_groups
+        self.images = images
+        self.launch_templates = launch_templates
+        self.clock = clock
+
+    def reconcile_all(self) -> None:
+        for nc in self.cluster.list(TPUNodeClass):
+            self.reconcile(nc)
+
+    def reconcile(self, nc: TPUNodeClass) -> None:
+        if nc.deleting:
+            self._finalize(nc)
+            return
+        if TERMINATION_FINALIZER not in nc.metadata.finalizers:
+            nc.metadata.finalizers.append(TERMINATION_FINALIZER)
+        self._reconcile_hash(nc)
+        self._reconcile_images(nc)
+        self._reconcile_capacity_reservations(nc)
+        self._reconcile_subnets(nc)
+        self._reconcile_security_groups(nc)
+        self._reconcile_instance_profile(nc)
+        self._reconcile_validation(nc)
+        nc.status_conditions.compute_root(NODECLASS_CONDITIONS)
+        self.cluster.update(nc)
+
+    # -- chain stages -------------------------------------------------------
+    def _reconcile_hash(self, nc: TPUNodeClass) -> None:
+        nc.metadata.annotations[HASH_ANNOTATION] = nc.static_hash()
+        nc.metadata.annotations[HASH_VERSION_ANNOTATION] = HASH_VERSION
+
+    def _reconcile_images(self, nc: TPUNodeClass) -> None:
+        resolved = self.images.resolve(nc)
+        if not resolved:
+            nc.status_images = []
+            nc.status_conditions.set_false(COND_IMAGES_READY, "ImagesNotFound", "no images matched selector terms")
+            return
+        nc.status_images = [
+            ImageStatus(id=r.id, name=r.name, requirements=list(r.requirements)) for r in resolved
+        ]
+        nc.status_conditions.set_true(COND_IMAGES_READY)
+
+    def _reconcile_capacity_reservations(self, nc: TPUNodeClass) -> None:
+        if not nc.capacity_reservation_selector_terms:
+            nc.status_capacity_reservations = []
+            nc.status_conditions.set_true(COND_CAPACITY_RESERVATIONS_READY)
+            return
+        now = self.cluster.clock.now()
+        out: List[CapacityReservationStatus] = []
+        for cr in self.compute_api.describe_capacity_reservations():
+            if cr.end_time is not None and cr.end_time <= now:
+                continue
+            if not any(t.matches(id=cr.id, tags=cr.tags) for t in nc.capacity_reservation_selector_terms):
+                continue
+            out.append(
+                CapacityReservationStatus(
+                    id=cr.id,
+                    instance_type=cr.instance_type,
+                    zone=cr.zone,
+                    owner_id=cr.owner_id,
+                    reservation_type=cr.reservation_type,
+                    state=cr.state,
+                    end_time=cr.end_time,
+                    available_count=cr.available_count,
+                )
+            )
+        nc.status_capacity_reservations = out
+        nc.status_conditions.set_true(COND_CAPACITY_RESERVATIONS_READY)
+
+    def _reconcile_subnets(self, nc: TPUNodeClass) -> None:
+        subnets = self.subnets.list(nc)
+        if not subnets:
+            nc.status_subnets = []
+            nc.status_conditions.set_false(COND_SUBNETS_READY, "SubnetsNotFound", "no subnets matched selector terms")
+            return
+        nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in subnets]
+        nc.status_conditions.set_true(COND_SUBNETS_READY)
+
+    def _reconcile_security_groups(self, nc: TPUNodeClass) -> None:
+        groups = self.security_groups.list(nc)
+        if not groups:
+            nc.status_security_groups = []
+            nc.status_conditions.set_false(
+                COND_SECURITY_GROUPS_READY, "SecurityGroupsNotFound", "no security groups matched selector terms"
+            )
+            return
+        nc.status_security_groups = [SecurityGroupStatus(g.id, g.name) for g in groups]
+        nc.status_conditions.set_true(COND_SECURITY_GROUPS_READY)
+
+    def _reconcile_instance_profile(self, nc: TPUNodeClass) -> None:
+        if nc.instance_profile:
+            nc.status_instance_profile = nc.instance_profile
+            nc.status_conditions.set_true(COND_INSTANCE_PROFILE_READY)
+            return
+        name = f"karpenter-{nc.name}-profile"
+        prof = self.identity_api.get_instance_profile(name)
+        if prof is None:
+            self.identity_api.create_instance_profile(name, {"karpenter.tpu/nodeclass": nc.name})
+            self.identity_api.add_role(name, nc.role)
+        elif prof.get("roles") != [nc.role]:
+            self.identity_api.add_role(name, nc.role)
+        nc.status_instance_profile = name
+        nc.status_conditions.set_true(COND_INSTANCE_PROFILE_READY)
+
+    def _reconcile_validation(self, nc: TPUNodeClass) -> None:
+        """Authorization/launchability dry-run analogue (reference:
+        nodeclass/validation.go does cached dry-run auth checks)."""
+        problems = []
+        if nc.metadata_http_tokens not in ("required", "optional"):
+            problems.append(f"invalid metadata_http_tokens {nc.metadata_http_tokens!r}")
+        for b in nc.block_device_mappings:
+            if b.volume_size_gib <= 0:
+                problems.append(f"block device {b.device_name} has non-positive size")
+        if problems:
+            nc.status_conditions.set_false(COND_VALIDATION_SUCCEEDED, "ValidationFailed", "; ".join(problems))
+        else:
+            nc.status_conditions.set_true(COND_VALIDATION_SUCCEEDED)
+
+    # -- finalizer ----------------------------------------------------------
+    def _finalize(self, nc: TPUNodeClass) -> None:
+        from karpenter_tpu.apis import NodeClaim
+
+        blocking = [
+            c
+            for c in self.cluster.list(NodeClaim)
+            if c.node_class_ref.name == nc.name and not c.deleting
+        ]
+        if blocking:
+            return  # nodeclaims must drain first (reference blocks deletion)
+        if self.launch_templates is not None:
+            self.launch_templates.delete_all(nc)
+        if not nc.instance_profile:  # only delete profiles we created
+            self.identity_api.delete_instance_profile(f"karpenter-{nc.name}-profile")
+        self.cluster.remove_finalizer(nc, TERMINATION_FINALIZER)

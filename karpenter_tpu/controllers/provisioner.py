@@ -1,0 +1,203 @@
+"""Provisioning controller: pending pods -> NodeClaims -> launched capacity.
+
+Rebuilds the core provisioner reconcile (SURVEY.md section 3.1): snapshot
+pending pods and cluster capacity, run the scheduling simulation (oracle or
+TPU solver), create one NodeClaim per simulated node group, and call
+CloudProvider.Create. In-flight NodeClaims participate in the next
+simulation as virtual nodes so repeated ticks don't double-provision.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Node, labels as wk
+from karpenter_tpu.apis.nodeclass import HASH_ANNOTATION, HASH_VERSION, HASH_VERSION_ANNOTATION, TPUNodeClass
+from karpenter_tpu.apis.objects import generate_name
+from karpenter_tpu.cloudprovider import CloudProvider
+from karpenter_tpu.errors import CloudError
+from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.scheduling import resources as res
+from karpenter_tpu.solver.oracle import ExistingNode, NewNodeGroup, Scheduler, SchedulingResult
+
+MAX_TYPES_PER_CLAIM = 60  # mirror of the launch truncation for claim size
+
+TERMINATION_FINALIZER = "karpenter.sh/termination"
+
+
+class Provisioner:
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, solver=None):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.solver = solver  # optional TPU solver; None = oracle
+        self.last_result: Optional[SchedulingResult] = None
+
+    # -- snapshot -----------------------------------------------------------
+    def _existing_nodes(self) -> List[ExistingNode]:
+        out = []
+        for node in self.cluster.list(Node):
+            if node.deleting or node.unschedulable or not node.ready:
+                continue
+            out.append(
+                ExistingNode(
+                    name=node.metadata.name,
+                    labels=dict(node.metadata.labels),
+                    allocatable=node.allocatable,
+                    taints=list(node.taints),
+                    used=self.cluster.node_usage(node.metadata.name),
+                )
+            )
+        # launched-but-not-ready claims are virtual capacity
+        for claim in self.cluster.list(NodeClaim):
+            if claim.deleting or not claim.launched():
+                continue
+            node = self.cluster.node_for_nodeclaim(claim)
+            if node is not None and node.ready:
+                continue  # already counted above
+            labels = dict(claim.metadata.labels)
+            labels.update(claim.requirements.labels())
+            out.append(
+                ExistingNode(
+                    name=f"inflight/{claim.metadata.name}",
+                    labels=labels,
+                    allocatable=claim.allocatable,
+                    taints=list(claim.taints),  # startup taints excluded: they lift before pods land
+                    used=Resources(),
+                )
+            )
+        return out
+
+    def _pods_by_node(self) -> Dict[str, List]:
+        out: Dict[str, List] = {}
+        from karpenter_tpu.apis import Pod
+
+        for p in self.cluster.list(Pod):
+            if p.node_name:
+                out.setdefault(p.node_name, []).append(p)
+        return out
+
+    # -- reconcile ----------------------------------------------------------
+    def reconcile(self) -> SchedulingResult:
+        pods = self.cluster.pending_pods()
+        result = SchedulingResult()
+        if not pods:
+            self.last_result = result
+            return result
+        nodepools = [p for p in self.cluster.list(NodePool) if not p.deleting]
+        catalogs: Dict[str, List] = {}
+        zones = set()
+        for pool in nodepools:
+            try:
+                items = self.cloud_provider.get_instance_types(pool)
+            except CloudError:
+                items = []
+            catalogs[pool.name] = items
+            for it in items:
+                for o in it.available_offerings():
+                    zones.add(o.zone)
+        scheduler = Scheduler(
+            nodepools=nodepools,
+            instance_types=catalogs,
+            existing_nodes=self._existing_nodes(),
+            pods_by_node=self._pods_by_node(),
+            nodepool_usage={p.name: self.cluster.nodepool_usage(p.name) for p in nodepools},
+            zones=zones,
+        )
+        if self.solver is not None:
+            result = self.solver.schedule(scheduler, pods)
+        else:
+            result = scheduler.schedule(pods)
+        self._launch(result)
+        self.last_result = result
+        return result
+
+    # -- NodeClaim creation + launch ---------------------------------------
+    def _launch(self, result: SchedulingResult) -> None:
+        for group in result.new_groups:
+            claim = self._to_nodeclaim(group)
+            self.cluster.create(claim)
+            try:
+                self.cloud_provider.create(claim)
+                self.cluster.update(claim)
+            except CloudError as e:
+                # ICE already recorded by the instance provider; drop the
+                # claim so the next tick re-simulates around it
+                for pod in group.pods:
+                    result.unschedulable[pod.metadata.name] = str(e)
+                claim.metadata.finalizers = []
+                self.cluster.delete(NodeClaim, claim.metadata.name)
+
+    def _to_nodeclaim(self, group: NewNodeGroup) -> NodeClaim:
+        pool = group.nodepool
+        nodeclass = self.cluster.try_get(TPUNodeClass, pool.template.node_class_ref.name)
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        reqs = group.requirements.copy()
+        type_names = [it.name for it in sorted(group.instance_types, key=lambda i: i.cheapest_price())]
+        reqs.add(Requirement(wk.INSTANCE_TYPE_LABEL, Operator.IN, type_names[:MAX_TYPES_PER_CLAIM]))
+        claim = NodeClaim(
+            name=generate_name(f"{pool.name}-"),
+            requirements=list(reqs),
+            resources_requested=group.requested,
+            node_class_ref=pool.template.node_class_ref,
+            taints=list(pool.template.taints),
+            startup_taints=list(pool.template.startup_taints),
+            expire_after=pool.template.expire_after,
+        )
+        claim.metadata.labels = {
+            **pool.template.labels,
+            wk.NODEPOOL_LABEL: pool.name,
+            wk.LABEL_NODECLASS: pool.template.node_class_ref.name,
+        }
+        claim.metadata.annotations = {
+            **pool.template.annotations,
+            wk.NODEPOOL_HASH_ANNOTATION: pool.static_hash(),
+            wk.NODEPOOL_HASH_VERSION_ANNOTATION: HASH_VERSION,
+        }
+        if nodeclass is not None:
+            claim.metadata.annotations[HASH_ANNOTATION] = nodeclass.static_hash()
+            claim.metadata.annotations[HASH_VERSION_ANNOTATION] = HASH_VERSION
+        claim.metadata.finalizers.append(TERMINATION_FINALIZER)
+        claim.termination_grace_period = pool.template.termination_grace_period
+        return claim
+
+
+class PodBinder:
+    """kube-scheduler stand-in for the kwok cluster: binds pending pods onto
+    ready compatible nodes, first fit (the reference relies on the real
+    kube-scheduler for this; the kwok rig needs it in-process)."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def reconcile(self) -> int:
+        from karpenter_tpu.scheduling import tolerates_all
+
+        bound = 0
+        nodes = [n for n in self.cluster.list(Node) if n.ready and not n.unschedulable and not n.deleting]
+        for pod in self.cluster.pending_pods():
+            needed = pod.requests + Resources.from_base_units({res.PODS: 1})
+            for node in nodes:
+                if not tolerates_all(pod.tolerations, node.taints):
+                    continue
+                if not any(alt.matches_labels(node.metadata.labels) for alt in pod.scheduling_requirements()):
+                    continue
+                used = self.cluster.node_usage(node.metadata.name)
+                if not (used + needed).fits(node.allocatable):
+                    continue
+                if not self._anti_affinity_ok(pod, node):
+                    continue
+                self.cluster.bind_pod(pod, node)
+                bound += 1
+                break
+        return bound
+
+    def _anti_affinity_ok(self, pod, node) -> bool:
+        on_node = self.cluster.pods_on_node(node.metadata.name)
+        for term in pod.affinity_terms:
+            if not term.anti or term.topology_key != wk.HOSTNAME_LABEL:
+                continue
+            for other in on_node:
+                if all(other.metadata.labels.get(k) == v for k, v in term.label_selector.items()):
+                    return False
+        return True

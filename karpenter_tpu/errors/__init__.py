@@ -1,0 +1,27 @@
+from karpenter_tpu.errors.errors import (
+    CloudError,
+    InsufficientCapacityError,
+    NotFoundError,
+    AlreadyExistsError,
+    RateLimitedError,
+    LaunchTemplateNotFoundError,
+    NodeClassNotReadyError,
+    is_not_found,
+    is_rate_limited,
+    is_unfulfillable_capacity,
+    to_reason_message,
+)
+
+__all__ = [
+    "CloudError",
+    "InsufficientCapacityError",
+    "NotFoundError",
+    "AlreadyExistsError",
+    "RateLimitedError",
+    "LaunchTemplateNotFoundError",
+    "NodeClassNotReadyError",
+    "is_not_found",
+    "is_rate_limited",
+    "is_unfulfillable_capacity",
+    "to_reason_message",
+]

@@ -272,6 +272,7 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
                     tags=dict(request.tags),
                     capacity_reservation_id=o.capacity_reservation_id,
                     nic_count=lt.nic_count,
+                    security_group_ids=list(lt.security_group_ids),
                 )
                 with self._lock:
                     self._instances[iid] = inst

@@ -1,0 +1,186 @@
+"""In-memory cluster: the kube-apiserver stand-in.
+
+The reference's coordination bus is the Kubernetes API server (CRDs, watches,
+field indexers -- SURVEY.md section 2.4). This module provides the same
+contract for a standalone process: a thread-safe typed object store with
+resource-version optimistic concurrency, finalizer-aware deletion, event
+listeners (watch analogue), and the pod/node relational queries the
+scheduler and disruption controllers need (the role of the core's cluster
+state, state.NewCluster at cmd/controller/main.go:43).
+
+Everything is step-driven and clock-injected: no background goroutine
+analogues, so tests and the benchmark rig are deterministic.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Pod, Node, TPUNodeClass
+from karpenter_tpu.apis.objects import APIObject
+from karpenter_tpu.cache.ttl import Clock
+from karpenter_tpu.scheduling import Resources
+
+
+class Conflict(Exception):
+    """Optimistic-concurrency failure (stale resourceVersion)."""
+
+
+class NotFound(Exception):
+    pass
+
+
+class AlreadyExists(Exception):
+    pass
+
+
+EventHandler = Callable[[str, APIObject], None]  # (event_type, object)
+
+
+class Cluster:
+    KINDS: Tuple[Type[APIObject], ...] = (Pod, Node, NodeClaim, NodePool, TPUNodeClass)
+
+    def __init__(self, clock: Optional[Clock] = None):
+        self.clock = clock or Clock()
+        self._lock = threading.RLock()
+        self._store: Dict[str, Dict[str, APIObject]] = {k.KIND: {} for k in self.KINDS}
+        self._version = 0
+        self._handlers: List[EventHandler] = []
+
+    # -- watch --------------------------------------------------------------
+    def on_event(self, handler: EventHandler) -> None:
+        self._handlers.append(handler)
+
+    def _emit(self, event: str, obj: APIObject) -> None:
+        for h in self._handlers:
+            h(event, obj)
+
+    # -- CRUD ---------------------------------------------------------------
+    def create(self, obj: APIObject) -> APIObject:
+        with self._lock:
+            kind = type(obj).KIND
+            if obj.metadata.name in self._store[kind]:
+                raise AlreadyExists(f"{kind}/{obj.metadata.name}")
+            self._version += 1
+            obj.metadata.resource_version = self._version
+            if not obj.metadata.creation_timestamp:
+                obj.metadata.creation_timestamp = self.clock.now()
+            self._store[kind][obj.metadata.name] = obj
+        self._emit("ADDED", obj)
+        return obj
+
+    def get(self, kind: Type[APIObject], name: str) -> APIObject:
+        with self._lock:
+            obj = self._store[kind.KIND].get(name)
+            if obj is None:
+                raise NotFound(f"{kind.KIND}/{name}")
+            return obj
+
+    def try_get(self, kind: Type[APIObject], name: str) -> Optional[APIObject]:
+        with self._lock:
+            return self._store[kind.KIND].get(name)
+
+    def list(self, kind: Type[APIObject], predicate: Optional[Callable[[APIObject], bool]] = None) -> List[APIObject]:
+        with self._lock:
+            items = list(self._store[kind.KIND].values())
+        if predicate is not None:
+            items = [o for o in items if predicate(o)]
+        return items
+
+    def update(self, obj: APIObject, expect_version: Optional[int] = None) -> APIObject:
+        with self._lock:
+            kind = type(obj).KIND
+            current = self._store[kind].get(obj.metadata.name)
+            if current is None:
+                raise NotFound(f"{kind}/{obj.metadata.name}")
+            if expect_version is not None and current.metadata.resource_version != expect_version:
+                raise Conflict(f"{kind}/{obj.metadata.name}: version {expect_version} is stale")
+            self._version += 1
+            obj.metadata.resource_version = self._version
+            self._store[kind][obj.metadata.name] = obj
+        self._emit("MODIFIED", obj)
+        return obj
+
+    def delete(self, kind: Type[APIObject], name: str) -> Optional[APIObject]:
+        """Finalizer-aware: with finalizers set, marks deleting and returns
+        the object; actual removal happens once finalizers clear."""
+        with self._lock:
+            obj = self._store[kind.KIND].get(name)
+            if obj is None:
+                return None
+            if obj.metadata.finalizers:
+                if obj.metadata.deletion_timestamp is None:
+                    obj.metadata.deletion_timestamp = self.clock.now()
+                    self._version += 1
+                    obj.metadata.resource_version = self._version
+                result = obj
+            else:
+                del self._store[kind.KIND][name]
+                result = None
+        if result is not None:
+            self._emit("DELETING", obj)
+        else:
+            self._emit("DELETED", obj)
+        return result
+
+    def remove_finalizer(self, obj: APIObject, finalizer: str) -> None:
+        with self._lock:
+            if finalizer in obj.metadata.finalizers:
+                obj.metadata.finalizers.remove(finalizer)
+            if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
+                self._store[type(obj).KIND].pop(obj.metadata.name, None)
+                removed = True
+            else:
+                removed = False
+        if removed:
+            self._emit("DELETED", obj)
+
+    # -- relational queries (cluster-state role) ----------------------------
+    def pending_pods(self) -> List[Pod]:
+        return [p for p in self.list(Pod) if p.schedulable()]
+
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        return [p for p in self.list(Pod) if p.node_name == node_name]
+
+    def bind_pod(self, pod: Pod, node: Node) -> None:
+        pod.node_name = node.metadata.name
+        pod.phase = "Running"
+        self.update(pod)
+
+    def unbind_pods(self, node_name: str) -> List[Pod]:
+        """Node went away: owned pods return to Pending (controller
+        re-creation abstracted to an in-place reset)."""
+        out = []
+        for p in self.pods_on_node(node_name):
+            p.node_name = ""
+            p.phase = "Pending"
+            self.update(p)
+            out.append(p)
+        return out
+
+    def nodeclaim_for_node(self, node: Node) -> Optional[NodeClaim]:
+        for nc in self.list(NodeClaim):
+            if nc.provider_id and nc.provider_id == node.provider_id:
+                return nc
+        return None
+
+    def node_for_nodeclaim(self, claim: NodeClaim) -> Optional[Node]:
+        for n in self.list(Node):
+            if n.provider_id and n.provider_id == claim.provider_id:
+                return n
+        return None
+
+    def node_usage(self, node_name: str) -> Resources:
+        total = Resources()
+        for p in self.pods_on_node(node_name):
+            total = total + p.requests
+        return total
+
+    def nodepool_usage(self, nodepool_name: str) -> Resources:
+        from karpenter_tpu.apis import labels as wk
+
+        total = Resources()
+        for nc in self.list(NodeClaim):
+            if nc.metadata.labels.get(wk.NODEPOOL_LABEL) == nodepool_name and not nc.deleting:
+                total = total + nc.capacity
+        return total

@@ -1,0 +1,3 @@
+from karpenter_tpu.operator.operator import Operator, Options
+
+__all__ = ["Operator", "Options"]

@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.image.provider import ImageProvider, ResolvedImage
+
+__all__ = ["ImageProvider", "ResolvedImage"]

@@ -1,0 +1,72 @@
+"""Image (AMI-family analog) provider.
+
+Rebuilds the discovery half of pkg/providers/amifamily: images found via
+alias (param-store lookup, the SSM path), tags, ids, or names
+(amifamily/ami.go DescribeImageQueries), each carrying arch requirements so
+the launch path can match images to instance types
+(reference: Resolve groups instance types by image at resolver.go:126-188).
+Userdata bootstrapping lives in providers/launchtemplate/bootstrap.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclass import TPUNodeClass
+from karpenter_tpu.cache import SSM_CACHE_TTL, TTLCache
+from karpenter_tpu.cache.ttl import Clock
+from karpenter_tpu.cloud.api import ComputeAPI, ParamStoreAPI
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+
+
+@dataclass
+class ResolvedImage:
+    id: str
+    name: str
+    requirements: Requirements = field(default_factory=Requirements)
+    creation_time: float = 0.0
+
+
+class ImageProvider:
+    def __init__(self, compute_api: ComputeAPI, params: ParamStoreAPI, clock: Optional[Clock] = None):
+        self.compute_api = compute_api
+        self.params = params
+        self._param_cache = TTLCache(SSM_CACHE_TTL, clock)
+
+    def resolve(self, nodeclass: TPUNodeClass) -> List[ResolvedImage]:
+        images = {i.id: i for i in self.compute_api.describe_images()}
+        out: List[ResolvedImage] = []
+        seen = set()
+        for term in nodeclass.image_selector_terms:
+            matches = []
+            if term.alias:
+                family, _, version = term.alias.partition("@")
+                for arch in ("amd64", "arm64"):
+                    param = f"/images/{family.lower()}/{version or 'latest'}/{arch}"
+                    img_id = self._param_cache.get_or_compute(param, lambda p=param: self.params.get_parameter(p))
+                    if img_id and img_id in images:
+                        matches.append(images[img_id])
+            elif term.id:
+                if term.id in images:
+                    matches.append(images[term.id])
+            else:
+                for img in images.values():
+                    if term.matches(id=img.id, name=img.name, tags=img.tags):
+                        matches.append(img)
+            for img in matches:
+                if img.id in seen or img.deprecated:
+                    continue
+                seen.add(img.id)
+                out.append(
+                    ResolvedImage(
+                        id=img.id,
+                        name=img.name,
+                        requirements=Requirements([Requirement(wk.ARCH_LABEL, Operator.IN, [img.arch])]),
+                        creation_time=img.creation_time,
+                    )
+                )
+        # newest image first (creation time desc, name as tiebreak), matching
+        # the reference's deterministic ordering
+        out.sort(key=lambda r: (-r.creation_time, r.name))
+        return out

@@ -1,0 +1,4 @@
+from karpenter_tpu.providers.instance.provider import InstanceProvider
+from karpenter_tpu.providers.instance import filters
+
+__all__ = ["InstanceProvider", "filters"]

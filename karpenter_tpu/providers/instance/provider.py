@@ -1,0 +1,243 @@
+"""Instance provider: launch / read / delete cloud instances.
+
+Rebuilds pkg/providers/instance/instance.go:
+
+- Create (:117-151): filter chain -> truncate to 60 -> ensure launch
+  templates -> fleet call with overrides = available offerings x zonal
+  subnets (:392-439), priced priorities for capacity-optimized-prioritized
+- capacity-type decision reserved > spot > on-demand (:504-518)
+- fleet error parsing into the ICE cache (:441-484)
+- retry-once when the fleet call reports a stale launch template (:124-128)
+- List by cluster tags for GC resync (:174-204)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.apis import NodeClaim, labels as wk
+from karpenter_tpu.apis.nodeclass import TPUNodeClass
+from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+from karpenter_tpu.cloud.api import ComputeAPI
+from karpenter_tpu.cloud.types import CloudInstance, FleetOverride, FleetRequest
+from karpenter_tpu.errors import InsufficientCapacityError, NotFoundError, is_unfulfillable_capacity
+from karpenter_tpu.providers.instancetype.types import InstanceType
+from karpenter_tpu.providers.instance import filters
+from karpenter_tpu.providers.launchtemplate import LaunchTemplateProvider
+from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.scheduling import Requirements
+
+MAX_INSTANCE_TYPES = 60  # reference: instance.go:60
+
+CLUSTER_TAG = "karpenter.tpu/cluster"
+NODECLAIM_TAG = "karpenter.sh/nodeclaim"
+NODEPOOL_TAG = wk.NODEPOOL_LABEL
+
+
+class InstanceProvider:
+    def __init__(
+        self,
+        compute_api: ComputeAPI,
+        subnets: SubnetProvider,
+        launch_templates: LaunchTemplateProvider,
+        unavailable: UnavailableOfferings,
+        capacity_reservations=None,
+        cluster_name: str = "kwok-cluster",
+    ):
+        self.compute_api = compute_api
+        self.subnets = subnets
+        self.launch_templates = launch_templates
+        self.unavailable = unavailable
+        self.capacity_reservations = capacity_reservations
+        self.cluster_name = cluster_name
+
+    # -- create -------------------------------------------------------------
+    def create(
+        self,
+        nodeclass: TPUNodeClass,
+        claim: NodeClaim,
+        instance_types: Sequence[InstanceType],
+    ) -> CloudInstance:
+        reqs = claim.requirements
+        candidates = filters.apply_chain(instance_types, reqs, claim.resources_requested)
+        if not candidates:
+            raise InsufficientCapacityError("all requested instance types were unavailable")
+        capacity_type = self._capacity_type(candidates, reqs)
+        candidates = self._truncate(candidates, capacity_type)
+        return self._launch(nodeclass, claim, candidates, capacity_type)
+
+    def _capacity_type(self, items: Sequence[InstanceType], reqs: Requirements) -> str:
+        """reserved > spot > on-demand among permitted+available (:504-518),
+        with the spot-flexibility floor: a spot launch with fewer than 5
+        candidate types falls back to on-demand when permitted (:58)."""
+        req = reqs.get(wk.CAPACITY_TYPE_LABEL)
+        for ct in (wk.CAPACITY_TYPE_RESERVED, wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND):
+            if req is not None and not req.matches(ct):
+                continue
+            if not any(o.capacity_type == ct for it in items for o in it.available_offerings()):
+                continue
+            if ct == wk.CAPACITY_TYPE_SPOT and not filters.spot_viable(items, reqs):
+                od_permitted = req is None or req.matches(wk.CAPACITY_TYPE_ON_DEMAND)
+                od_available = any(
+                    o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
+                    for it in items
+                    for o in it.available_offerings()
+                )
+                if od_permitted and od_available:
+                    continue  # fall through to on-demand
+            return ct
+        return wk.CAPACITY_TYPE_ON_DEMAND
+
+    def _truncate(self, items: Sequence[InstanceType], capacity_type: str) -> List[InstanceType]:
+        """Cheapest-first truncation to 60 (reference sorts by price then
+        truncates, :242-270)."""
+
+        def price(it: InstanceType) -> float:
+            ps = [o.price for o in it.available_offerings() if o.capacity_type == capacity_type]
+            return min(ps) if ps else float("inf")
+
+        return sorted(items, key=price)[:MAX_INSTANCE_TYPES]
+
+    def _overrides(
+        self,
+        claim: NodeClaim,
+        items: Sequence[InstanceType],
+        capacity_type: str,
+        zonal_subnets: Dict[str, object],
+        image_id_for,
+    ) -> List[FleetOverride]:
+        """Cross product of available offerings x zonal subnets (:392-439),
+        priority = price (prioritized allocation strategies use it)."""
+        out: List[FleetOverride] = []
+        reqs = claim.requirements
+        for it in items:
+            for o in it.available_offerings():
+                if o.capacity_type != capacity_type:
+                    continue
+                if not reqs.compatible(o.requirements()):
+                    continue
+                subnet = zonal_subnets.get(o.zone)
+                if subnet is None:
+                    continue
+                out.append(
+                    FleetOverride(
+                        instance_type=it.name,
+                        subnet_id=subnet.id,
+                        zone=o.zone,
+                        priority=o.price,
+                        image_id=image_id_for(it),
+                        capacity_reservation_id=o.reservation_id,
+                    )
+                )
+        return out
+
+    def _launch(
+        self,
+        nodeclass: TPUNodeClass,
+        claim: NodeClaim,
+        items: Sequence[InstanceType],
+        capacity_type: str,
+        retried: bool = False,
+    ) -> CloudInstance:
+        reqs = claim.requirements
+        zone_req = reqs.get(wk.ZONE_LABEL)
+        zones = set(zone_req.values) if zone_req is not None and not zone_req.complement else None
+        zonal_subnets = self.subnets.zonal_subnets_for_launch(nodeclass, zones)
+        if not zonal_subnets:
+            raise InsufficientCapacityError("no subnet with free addresses in permitted zones")
+
+        reservation_id = None
+        if capacity_type == wk.CAPACITY_TYPE_RESERVED:
+            rids = [o.reservation_id for it in items for o in it.available_offerings() if o.reservation_id]
+            reservation_id = rids[0] if rids else None
+        labels = {**claim.metadata.labels, **claim.requirements.labels()}
+        groups = self.launch_templates.ensure_all(
+            nodeclass, list(items), labels, claim.taints, capacity_reservation_id=reservation_id
+        )
+        if not groups:
+            raise InsufficientCapacityError("no image matches any candidate instance type")
+
+        by_type: Dict[str, str] = {}
+        template_of: Dict[str, str] = {}
+        for g in groups:
+            for it in g.instance_types:
+                by_type[it.name] = g.image.id
+                template_of[it.name] = g.template_name
+
+        # types with no image group are unlaunchable: they must not produce
+        # overrides (an override without a template would crash below)
+        launchable = [it for it in items if it.name in template_of]
+        overrides = self._overrides(claim, launchable, capacity_type, zonal_subnets, lambda it: by_type[it.name])
+        if not overrides:
+            raise InsufficientCapacityError("no launchable offering x subnet combination")
+
+        # fleet per launch template group: pick the group of the cheapest override
+        overrides.sort(key=lambda o: o.priority)
+        lead_template = template_of[overrides[0].instance_type]
+        group_overrides = [o for o in overrides if template_of[o.instance_type] == lead_template]
+        request = FleetRequest(
+            launch_template_name=lead_template,
+            capacity_type=capacity_type,
+            overrides=group_overrides,
+            target_capacity=1,
+            tags={
+                CLUSTER_TAG: self.cluster_name,
+                NODECLAIM_TAG: claim.metadata.name,
+                NODEPOOL_TAG: claim.metadata.labels.get(wk.NODEPOOL_LABEL, ""),
+                wk.LABEL_NODECLASS: nodeclass.name,
+                "Name": f"{claim.metadata.labels.get(wk.NODEPOOL_LABEL, 'node')}-{claim.metadata.name}",
+            },
+        )
+        try:
+            result = self.compute_api.create_fleet(request)
+        except KeyError as e:
+            # stale launch-template cache: invalidate THIS launch's template
+            # names (incl. reservation-scoped ones) and retry once (:124-128)
+            if retried:
+                raise NotFoundError(str(e))
+            for g in groups:
+                self.launch_templates.invalidate(g.template_name)
+            return self._launch(nodeclass, claim, items, capacity_type, retried=True)
+        self._update_unavailable(result.errors, capacity_type)
+        if not result.instances:
+            raise InsufficientCapacityError(
+                "; ".join(e.message for e in result.errors) or "fleet returned no instances"
+            )
+        inst = result.instances[0]
+        self.subnets.mark_inflight(inst.subnet_id)
+        if inst.capacity_reservation_id and self.capacity_reservations is not None:
+            self.capacity_reservations.mark_launched(inst.capacity_reservation_id)
+        return inst
+
+    def _update_unavailable(self, fleet_errors, capacity_type: str) -> None:
+        for e in fleet_errors:
+            if is_unfulfillable_capacity(e.code) and e.instance_type and e.zone:
+                self.unavailable.mark_unavailable(
+                    e.instance_type, e.zone, e.capacity_type or capacity_type, reason=e.code
+                )
+
+    # -- read / delete ------------------------------------------------------
+    def get(self, instance_id: str) -> CloudInstance:
+        found = self.compute_api.describe_instances([instance_id])
+        if not found:
+            raise NotFoundError(f"instance {instance_id} not found")
+        return found[0]
+
+    def list(self) -> List[CloudInstance]:
+        """All instances owned by this cluster (GC resync tag filter)."""
+        return self.compute_api.describe_instances(tag_filter={CLUSTER_TAG: self.cluster_name})
+
+    def delete(self, instance_id: str) -> None:
+        inst = self.compute_api.describe_instances([instance_id])
+        if not inst:
+            raise NotFoundError(f"instance {instance_id} not found")
+        if inst[0].state in ("shutting-down", "terminated"):
+            return  # already going away (:206-224)
+        self.compute_api.terminate_instances([instance_id])
+        if inst[0].capacity_reservation_id and self.capacity_reservations is not None:
+            self.capacity_reservations.mark_terminated(inst[0].capacity_reservation_id)
+
+    def create_tags(self, instance_id: str, tags: Dict[str, str]) -> None:
+        try:
+            self.compute_api.create_tags(instance_id, tags)
+        except KeyError:
+            raise NotFoundError(f"instance {instance_id} not found")

@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.launchtemplate.provider import LaunchTemplateProvider, ResolvedTemplate
+
+__all__ = ["LaunchTemplateProvider", "ResolvedTemplate"]

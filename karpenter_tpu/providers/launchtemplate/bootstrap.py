@@ -1,0 +1,121 @@
+"""Node bootstrap userdata rendering.
+
+Rebuilds the per-image-family bootstrappers of
+pkg/providers/amifamily/bootstrap/ (eksbootstrap script, nodeadm YAML,
+bottlerocket TOML, windows powershell, MIME multipart merging
+bootstrap/mime/mime.go): each family renders the cluster join config plus
+kubelet flags, merging any user-supplied custom userdata.
+"""
+from __future__ import annotations
+
+import textwrap
+from typing import Dict, List, Optional
+
+from karpenter_tpu.apis.nodeclass import KubeletConfiguration, TPUNodeClass
+
+MIME_BOUNDARY = "BOUNDARY"
+
+
+def _kubelet_args(kubelet: KubeletConfiguration, max_pods: Optional[int]) -> List[str]:
+    args = []
+    if max_pods is not None:
+        args.append(f"--max-pods={max_pods}")
+    if kubelet.pods_per_core:
+        args.append(f"--pods-per-core={kubelet.pods_per_core}")
+    if kubelet.kube_reserved:
+        args.append("--kube-reserved=" + ",".join(f"{k}={v}" for k, v in sorted(kubelet.kube_reserved.items())))
+    if kubelet.system_reserved:
+        args.append("--system-reserved=" + ",".join(f"{k}={v}" for k, v in sorted(kubelet.system_reserved.items())))
+    if kubelet.eviction_hard:
+        args.append("--eviction-hard=" + ",".join(f"{k}<{v}" for k, v in sorted(kubelet.eviction_hard.items())))
+    if kubelet.cluster_dns:
+        args.append("--cluster-dns=" + ",".join(kubelet.cluster_dns))
+    return args
+
+
+def render_standard(
+    cluster_name: str,
+    endpoint: str,
+    ca_bundle: str,
+    nodeclass: TPUNodeClass,
+    labels: Dict[str, str],
+    taints: List,
+    max_pods: Optional[int],
+) -> str:
+    """Shell bootstrap (the eksbootstrap.sh analogue), MIME-merged with any
+    custom userdata (custom part first, like the reference's merge order)."""
+    label_str = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    taint_str = ",".join(f"{t.key}={t.value}:{t.effect}" for t in taints)
+    script = textwrap.dedent(
+        f"""\
+        #!/bin/bash
+        set -euo pipefail
+        /usr/local/bin/bootstrap-node \\
+          --cluster {cluster_name} \\
+          --endpoint {endpoint} \\
+          --ca-bundle {ca_bundle} \\
+          --node-labels '{label_str}' \\
+          --taints '{taint_str}' \\
+          {" ".join(_kubelet_args(nodeclass.kubelet, max_pods))}
+        """
+    )
+    parts = []
+    if nodeclass.user_data:
+        parts.append(nodeclass.user_data)
+    parts.append(script)
+    if len(parts) == 1:
+        return parts[0]
+    # RFC 2046: parts delimited by "--" + boundary, terminated by
+    # "--" + boundary + "--" (reference merges userdata the same way,
+    # bootstrap/mime/mime.go:121)
+    body = [f'MIME-Version: 1.0\nContent-Type: multipart/mixed; boundary="{MIME_BOUNDARY}"\n']
+    for p in parts:
+        body.append(f'--{MIME_BOUNDARY}\nContent-Type: text/x-shellscript; charset="us-ascii"\n\n{p}')
+    body.append(f"--{MIME_BOUNDARY}--")
+    return "\n".join(body)
+
+
+def render_declarative(
+    cluster_name: str,
+    endpoint: str,
+    ca_bundle: str,
+    nodeclass: TPUNodeClass,
+    labels: Dict[str, str],
+    taints: List,
+    max_pods: Optional[int],
+) -> str:
+    """Config-file bootstrap (the nodeadm-YAML / bottlerocket-TOML analogue):
+    structured config the node agent consumes, user config merged under it."""
+    lines = [
+        "node-config:",
+        f"  cluster: {cluster_name}",
+        f"  endpoint: {endpoint}",
+        f"  ca-bundle: {ca_bundle}",
+        "  labels:",
+    ]
+    for k, v in sorted(labels.items()):
+        lines.append(f"    {k}: {v!r}")
+    if taints:
+        lines.append("  taints:")
+        for t in taints:
+            lines.append(f"    - {t.key}={t.value}:{t.effect}")
+    if max_pods is not None:
+        lines.append(f"  max-pods: {max_pods}")
+    if nodeclass.user_data:
+        lines.append("  user-config: |")
+        for l in nodeclass.user_data.splitlines():
+            lines.append(f"    {l}")
+    return "\n".join(lines) + "\n"
+
+
+RENDERERS = {
+    "Standard": render_standard,
+    "Minimal": render_standard,
+    "Declarative": render_declarative,
+    "Custom": lambda cluster_name, endpoint, ca_bundle, nodeclass, labels, taints, max_pods: nodeclass.user_data,
+}
+
+
+def render(image_family: str, **kw) -> str:
+    renderer = RENDERERS.get(image_family, render_standard)
+    return renderer(**kw)

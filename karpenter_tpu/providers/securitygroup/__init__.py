@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.securitygroup.provider import SecurityGroupProvider
+
+__all__ = ["SecurityGroupProvider"]

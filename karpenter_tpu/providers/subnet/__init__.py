@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.subnet.provider import SubnetProvider
+
+__all__ = ["SubnetProvider"]

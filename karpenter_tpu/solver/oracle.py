@@ -1,0 +1,357 @@
+"""Pure-Python FFD scheduling oracle.
+
+The correctness reference for the TPU solver: a readable, sequential
+re-implementation of the core scheduler's provisioning simulation
+(First-Fit-Decreasing bin-packing per designs/bin-packing.md:17-43, the
+behavior the external sigs.k8s.io/karpenter module implements -- SURVEY.md
+section 2.3). Every TPU solve is differential-tested against this oracle on
+randomized instances.
+
+Semantics covered:
+- pods sorted by descending dominant resource (FFD)
+- existing capacity first, then open "in-flight" node groups, then new groups
+- a node group holds a *set* of still-feasible instance types that narrows
+  as pods accumulate (the core's NodeClaim simulation)
+- requirements algebra + taints/tolerations + nodepool weights and limits
+- hard topology spread over zone/hostname, hostname pod anti-affinity
+  (stateful constraints; the scan-with-carry part of the TPU formulation)
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from karpenter_tpu.apis import NodePool, Pod, labels as wk
+from karpenter_tpu.apis.pod import TopologySpreadConstraint
+from karpenter_tpu.providers.instancetype.types import InstanceType
+from karpenter_tpu.scheduling import Requirements, Resources, Taint, tolerates_all
+from karpenter_tpu.scheduling import resources as res
+
+# labels the scheduler may leave undefined on a not-yet-launched node
+_ALLOW_UNDEFINED = wk.WELL_KNOWN_LABELS
+
+
+@dataclass
+class ExistingNode:
+    """A live (or nominated in-flight) node the simulation can pack onto."""
+
+    name: str
+    labels: Dict[str, str]
+    allocatable: Resources
+    taints: List[Taint] = field(default_factory=list)
+    used: Resources = field(default_factory=Resources)
+
+    def remaining(self) -> Resources:
+        return self.allocatable - self.used
+
+
+@dataclass
+class NewNodeGroup:
+    """A simulated NodeClaim: pods packed together onto one future node."""
+
+    nodepool: NodePool
+    requirements: Requirements
+    instance_types: List[InstanceType]
+    taints: List[Taint]
+    pods: List[Pod] = field(default_factory=list)
+    requested: Resources = field(default_factory=lambda: Resources.from_base_units({res.PODS: 0}))
+
+    def add_requested(self, pod: Pod) -> Resources:
+        return self.requested + pod.requests + Resources.from_base_units({res.PODS: 1})
+
+
+@dataclass
+class SchedulingResult:
+    existing_assignments: Dict[str, str] = field(default_factory=dict)  # pod name -> node name
+    new_groups: List[NewNodeGroup] = field(default_factory=list)
+    unschedulable: Dict[str, str] = field(default_factory=dict)  # pod name -> reason
+
+    def node_count(self) -> int:
+        return len(self.new_groups)
+
+
+def _dominant_size(pod: Pod) -> Tuple[float, float]:
+    return (pod.requests.get(res.CPU), pod.requests.get(res.MEMORY))
+
+
+def _fits_type(it: InstanceType, requested: Resources) -> bool:
+    return requested.fits(it.allocatable())
+
+
+class _TopologyState:
+    """Domain counts for hard topology-spread constraints, keyed by the
+    spreading selector so different workloads spread independently."""
+
+    def __init__(self):
+        self._counts: Dict[tuple, Dict[str, int]] = {}
+
+    @staticmethod
+    def _key(tsc: TopologySpreadConstraint) -> tuple:
+        return (tsc.topology_key, tuple(sorted(tsc.label_selector.items())))
+
+    def seed_existing(self, pods_by_node: Dict[str, List[Pod]], node_labels: Dict[str, Dict[str, str]]):
+        for node, pods in pods_by_node.items():
+            for p in pods:
+                for tsc in p.topology_spread:
+                    if not tsc.hard():
+                        continue
+                    domain = node_labels.get(node, {}).get(tsc.topology_key)
+                    if domain:
+                        self.count(tsc)[domain] = self.count(tsc).get(domain, 0) + 1
+
+    def count(self, tsc: TopologySpreadConstraint) -> Dict[str, int]:
+        return self._counts.setdefault(self._key(tsc), {})
+
+    def allowed_domains(
+        self, tsc: TopologySpreadConstraint, candidates: Set[str], all_domains: Optional[Set[str]] = None
+    ) -> Set[str]:
+        """Candidate domains where adding one pod keeps skew <= max_skew.
+        The global minimum is over ALL eligible domains (k8s semantics --
+        empty domains count), not just the candidates reachable here."""
+        counts = self.count(tsc)
+        if not candidates:
+            return set()
+        domain_universe = all_domains if all_domains else candidates
+        global_min = min(counts.get(d, 0) for d in domain_universe)
+        return {d for d in candidates if counts.get(d, 0) + 1 - global_min <= tsc.max_skew}
+
+    def add(self, tsc: TopologySpreadConstraint, domain: str) -> None:
+        self.count(tsc)[domain] = self.count(tsc).get(domain, 0) + 1
+
+
+def _pod_matches_selector(pod: Pod, selector: Dict[str, str]) -> bool:
+    return all(pod.metadata.labels.get(k) == v for k, v in selector.items())
+
+
+class Scheduler:
+    """One simulation run over a fixed snapshot (pods, pools, capacity)."""
+
+    def __init__(
+        self,
+        nodepools: Sequence[NodePool],
+        instance_types: Dict[str, List[InstanceType]],  # nodepool name -> catalog
+        existing_nodes: Sequence[ExistingNode] = (),
+        pods_by_node: Optional[Dict[str, List[Pod]]] = None,
+        nodepool_usage: Optional[Dict[str, Resources]] = None,
+        zones: Optional[Set[str]] = None,
+    ):
+        self.nodepools = sorted(nodepools, key=lambda p: -p.weight)
+        self.instance_types = instance_types
+        self.existing = list(existing_nodes)
+        self.topology = _TopologyState()
+        pods_by_node = pods_by_node or {}
+        self.topology.seed_existing(pods_by_node, {n.name: n.labels for n in self.existing})
+        self.usage = dict(nodepool_usage or {})
+        self.zones = zones or set()
+        # anti-affinity occupancy: node/group id -> pod labels present
+        self._labels_on: Dict[str, List[Dict[str, str]]] = {}
+        for node, pods in pods_by_node.items():
+            self._labels_on[node] = [dict(p.metadata.labels) for p in pods]
+
+    # -- constraint checks --------------------------------------------------
+    def _anti_affinity_ok(self, pod: Pod, location: str) -> bool:
+        for term in pod.affinity_terms:
+            if not term.anti or term.topology_key != wk.HOSTNAME_LABEL:
+                continue
+            for labels in self._labels_on.get(location, []):
+                if all(labels.get(k) == v for k, v in term.label_selector.items()):
+                    return False
+        # symmetric check: existing pods' anti-affinity against this pod is
+        # approximated by the same-selector case (self anti-affinity), the
+        # overwhelmingly common pattern
+        return True
+
+    def _spread_ok_existing(self, pod: Pod, node: ExistingNode) -> bool:
+        for tsc in pod.topology_spread:
+            if not tsc.hard() or not _pod_matches_selector(pod, tsc.label_selector):
+                continue
+            domain = node.labels.get(tsc.topology_key)
+            if domain is None:
+                return False
+            candidates = self._domains_for(tsc)
+            if domain not in self.topology.allowed_domains(tsc, candidates, all_domains=candidates):
+                return False
+        return True
+
+    def _domains_for(self, tsc: TopologySpreadConstraint) -> Set[str]:
+        if tsc.topology_key == wk.ZONE_LABEL:
+            return set(self.zones)
+        if tsc.topology_key == wk.HOSTNAME_LABEL:
+            domains = {n.name for n in self.existing}
+            domains.update(self.topology.count(tsc).keys())
+            return domains
+        return set(self.topology.count(tsc).keys())
+
+    def _record_placement(self, pod: Pod, location: str, domain_labels: Dict[str, str]) -> None:
+        self._labels_on.setdefault(location, []).append(dict(pod.metadata.labels))
+        for tsc in pod.topology_spread:
+            if not tsc.hard() or not _pod_matches_selector(pod, tsc.label_selector):
+                continue
+            domain = domain_labels.get(tsc.topology_key)
+            if domain:
+                self.topology.add(tsc, domain)
+
+    # -- existing-node packing ---------------------------------------------
+    def _try_existing(self, pod: Pod, result: SchedulingResult) -> bool:
+        for node in self.existing:
+            if not tolerates_all(pod.tolerations, node.taints):
+                continue
+            compatible = any(alt.matches_labels(node.labels) for alt in pod.scheduling_requirements())
+            if not compatible:
+                continue
+            needed = pod.requests + Resources.from_base_units({res.PODS: 1})
+            if not needed.fits(node.remaining()):
+                continue
+            if not self._anti_affinity_ok(pod, node.name):
+                continue
+            if not self._spread_ok_existing(pod, node):
+                continue
+            node.used = node.used + needed
+            result.existing_assignments[pod.metadata.name] = node.name
+            self._record_placement(pod, node.name, node.labels)
+            return True
+        return False
+
+    # -- new-node packing ---------------------------------------------------
+    def _group_zone_domains(self, group_or_reqs) -> Set[str]:
+        reqs = group_or_reqs.requirements if isinstance(group_or_reqs, NewNodeGroup) else group_or_reqs
+        zreq = reqs.get(wk.ZONE_LABEL)
+        if zreq is None:
+            return set(self.zones)
+        if zreq.complement:
+            return {z for z in self.zones if zreq.matches(z)}
+        return set(zreq.values)
+
+    def _spread_narrow_group(self, pod: Pod, reqs: Requirements) -> Optional[Requirements]:
+        """Apply hard zone-spread by narrowing the group's zone requirement to
+        min-count eligible zones; returns None if no eligible zone. Hostname
+        spread over a new node is always a fresh domain (count 0): allowed iff
+        1 - global_min <= max_skew."""
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        out = reqs
+        for tsc in pod.topology_spread:
+            if not tsc.hard() or not _pod_matches_selector(pod, tsc.label_selector):
+                continue
+            if tsc.topology_key == wk.ZONE_LABEL:
+                candidates = self._group_zone_domains(out)
+                allowed = self.topology.allowed_domains(
+                    tsc, candidates & self._domains_for(tsc), all_domains=self._domains_for(tsc)
+                )
+                if not allowed:
+                    return None
+                # Pin ONE min-count zone (deterministic tie-break): leaving
+                # the zone open would let the launch path collapse every
+                # group into the cheapest zone, and the spread count could
+                # never be attributed to a domain.
+                counts = self.topology.count(tsc)
+                pinned = min(sorted(allowed), key=lambda z: counts.get(z, 0))
+                out = out.copy()
+                out.add(Requirement(wk.ZONE_LABEL, Operator.IN, [pinned]))
+            elif tsc.topology_key == wk.HOSTNAME_LABEL:
+                counts = self.topology.count(tsc)
+                domains = self._domains_for(tsc)
+                global_min = min((counts.get(d, 0) for d in domains), default=0)
+                if 1 - global_min > tsc.max_skew:
+                    return None
+        return out
+
+    def _try_group(self, pod: Pod, group: NewNodeGroup, pod_reqs: Requirements) -> bool:
+        if not tolerates_all(pod.tolerations, group.taints):
+            return False
+        if not group.requirements.compatible(pod_reqs, allow_undefined=None):
+            return False
+        if not self._anti_affinity_ok(pod, id(group)):
+            return False
+        merged = group.requirements.copy().add(*pod_reqs)
+        # zone topology spread narrows the merged requirements
+        narrowed = self._spread_narrow_group(pod, merged)
+        if narrowed is None:
+            return False
+        requested = group.add_requested(pod)
+        survivors = [
+            it
+            for it in group.instance_types
+            if it.requirements.compatible(narrowed) and _fits_type(it, requested)
+        ]
+        if not survivors:
+            return False
+        group.requirements = narrowed
+        group.instance_types = survivors
+        group.pods.append(pod)
+        group.requested = requested
+        self._record_placement(pod, id(group), narrowed.labels())
+        return True
+
+    def _open_group(self, pod: Pod, pod_reqs: Requirements, result: SchedulingResult) -> Optional[str]:
+        last_reason = "no nodepool matches pod requirements"
+        for pool in self.nodepools:
+            pool_reqs = pool.requirements()
+            if not pool_reqs.compatible(pod_reqs, allow_undefined=_ALLOW_UNDEFINED):
+                continue
+            taints = list(pool.template.taints)
+            if not tolerates_all(pod.tolerations, taints):
+                last_reason = f"pod does not tolerate nodepool {pool.name} taints"
+                continue
+            merged = pool_reqs.copy().add(*pod_reqs)
+            narrowed = self._spread_narrow_group(pod, merged)
+            if narrowed is None:
+                last_reason = "topology spread constraints unsatisfiable"
+                continue
+            requested = pod.requests + Resources.from_base_units({res.PODS: 1})
+            candidates = [
+                it
+                for it in self.instance_types.get(pool.name, [])
+                if it.requirements.compatible(narrowed) and _fits_type(it, requested)
+            ]
+            if not candidates:
+                last_reason = f"no instance type in nodepool {pool.name} fits pod"
+                continue
+            # nodepool resource limits: smallest candidate must stay in budget
+            if pool.limits is not None:
+                usage = self.usage.get(pool.name, Resources())
+                smallest = min(candidates, key=lambda it: it.capacity.get(res.CPU))
+                if not (usage + smallest.capacity).fits(pool.limits):
+                    last_reason = f"nodepool {pool.name} limits exceeded"
+                    continue
+                self.usage[pool.name] = usage + smallest.capacity
+            group = NewNodeGroup(
+                nodepool=pool,
+                requirements=narrowed,
+                instance_types=candidates,
+                taints=taints + list(pool.template.startup_taints),
+                pods=[pod],
+                requested=requested,
+            )
+            result.new_groups.append(group)
+            self._record_placement(pod, id(group), narrowed.labels())
+            return None
+        return last_reason
+
+    # -- entry point --------------------------------------------------------
+    def schedule(self, pods: Sequence[Pod]) -> SchedulingResult:
+        result = SchedulingResult()
+        ordered = sorted(pods, key=_dominant_size, reverse=True)
+        for pod in ordered:
+            if self._try_existing(pod, result):
+                continue
+            placed = False
+            for pod_reqs in pod.scheduling_requirements():
+                for group in result.new_groups:
+                    if self._try_group(pod, group, pod_reqs):
+                        placed = True
+                        break
+                if placed:
+                    break
+            if placed:
+                continue
+            reasons = []
+            for pod_reqs in pod.scheduling_requirements():
+                reason = self._open_group(pod, pod_reqs, result)
+                if reason is None:
+                    placed = True
+                    break
+                reasons.append(reason)
+            if not placed:
+                result.unschedulable[pod.metadata.name] = "; ".join(reasons) or "unschedulable"
+        return result

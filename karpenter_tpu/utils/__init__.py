@@ -1,0 +1,24 @@
+"""Small shared utilities (reference: pkg/utils/utils.go:1-123)."""
+from __future__ import annotations
+
+import re
+from typing import Dict, Mapping
+
+_PROVIDER_ID_RE = re.compile(r"^tpu:///(?P<zone>[^/]+)/(?P<id>[^/]+)$")
+
+
+def parse_instance_id(provider_id: str) -> str:
+    """providerID ("tpu:///zone/i-abc") -> instance id (reference:
+    ParseInstanceID regex over aws:///...)."""
+    m = _PROVIDER_ID_RE.match(provider_id)
+    if not m:
+        raise ValueError(f"unparseable provider id {provider_id!r}")
+    return m.group("id")
+
+
+def merge_tags(*tag_maps: Mapping[str, str]) -> Dict[str, str]:
+    """Later maps win (reference: GetTags merge order)."""
+    out: Dict[str, str] = {}
+    for m in tag_maps:
+        out.update(m)
+    return out

@@ -1,0 +1,230 @@
+"""End-to-end provisioning slice on the kwok rig:
+pending pods -> FFD simulation -> NodeClaim -> fake fleet launch -> node
+registration -> pod binding. Mirrors the reference's integration-test shape
+(pkg/cloudprovider/suite_test.go + test/suites/integration)."""
+import pytest
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Node, Pod, TPUNodeClass, labels as wk
+from karpenter_tpu.apis.pod import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.scheduling import Operator as Op, Requirement, Resources, Taint, Toleration
+from karpenter_tpu.scheduling import resources as res
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock(start=10_000.0)
+    op = Operator(clock=clock)
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    return op
+
+
+def make_pods(n, cpu="500m", memory="1Gi", prefix="pod", **kw):
+    return [
+        Pod(f"{prefix}-{i}", requests=Resources({"cpu": cpu, "memory": memory}), **kw)
+        for i in range(n)
+    ]
+
+
+class TestE2EProvisioning:
+    def test_single_pod_end_to_end(self, env):
+        pod = make_pods(1)[0]
+        env.cluster.create(pod)
+        ticks = env.settle()
+        assert not env.cluster.pending_pods(), "pod still pending"
+        claims = env.cluster.list(NodeClaim)
+        nodes = env.cluster.list(Node)
+        assert len(claims) == 1 and len(nodes) == 1
+        claim = claims[0]
+        assert claim.launched() and claim.registered() and claim.initialized()
+        assert claim.provider_id.startswith("tpu:///")
+        assert pod.node_name == nodes[0].metadata.name
+        # instance actually exists in the fake cloud with cluster tags
+        insts = env.cloud.describe_instances()
+        assert len(insts) == 1
+        assert insts[0].tags["karpenter.sh/nodeclaim"] == claim.metadata.name
+
+    def test_bin_packing_consolidates_small_pods(self, env):
+        for p in make_pods(20, cpu="100m", memory="128Mi"):
+            env.cluster.create(p)
+        env.settle()
+        assert not env.cluster.pending_pods()
+        # 20 tiny pods must share very few nodes, not 20
+        assert len(env.cluster.list(Node)) <= 2
+
+    def test_big_pods_fan_out(self, env):
+        for p in make_pods(4, cpu="3", memory="12Gi"):
+            env.cluster.create(p)
+        env.settle()
+        assert not env.cluster.pending_pods()
+        nodes = env.cluster.list(Node)
+        for node in nodes:
+            used = env.cluster.node_usage(node.metadata.name)
+            assert used.fits(node.allocatable)
+
+    def test_nodepool_requirements_respected(self, env):
+        pool = env.cluster.get(NodePool, "default")
+        pool.template.requirements = [
+            Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"]),
+            Requirement(wk.CAPACITY_TYPE_LABEL, Op.IN, ["on-demand"]),
+        ]
+        env.cluster.update(pool)
+        env.cluster.create(make_pods(1)[0])
+        env.settle()
+        node = env.cluster.list(Node)[0]
+        assert node.metadata.labels[wk.ARCH_LABEL] == "arm64"
+        assert node.metadata.labels[wk.CAPACITY_TYPE_LABEL] == "on-demand"
+
+    def test_pod_node_selector_zone(self, env):
+        zone = env.cloud.describe_zones()[1].name
+        env.cluster.create(Pod("zonal", requests=Resources({"cpu": "1"}), node_selector={wk.ZONE_LABEL: zone}))
+        env.settle()
+        node = env.cluster.list(Node)[0]
+        assert node.metadata.labels[wk.ZONE_LABEL] == zone
+
+    def test_taint_requires_toleration(self, env):
+        pool = env.cluster.get(NodePool, "default")
+        pool.template.taints = [Taint("dedicated", value="team-a")]
+        env.cluster.update(pool)
+        intolerant = make_pods(1, prefix="intolerant")[0]
+        tolerant = Pod("tolerant", requests=Resources({"cpu": "1"}),
+                       tolerations=[Toleration(key="dedicated", value="team-a")])
+        env.cluster.create(intolerant)
+        env.cluster.create(tolerant)
+        env.settle()
+        assert intolerant.pending  # cannot schedule anywhere
+        assert not tolerant.pending
+        assert env.provisioner.last_result is not None
+
+    def test_gpu_pod_gets_gpu_node(self, env):
+        gpu_pod = Pod("gpu", requests=Resources({"cpu": "2", "memory": "4Gi", res.GPU: 1}),
+                      tolerations=[Toleration(operator="Exists")])
+        env.cluster.create(gpu_pod)
+        env.settle()
+        assert not env.cluster.pending_pods()
+        gpu_node = env.cluster.get(Node, gpu_pod.node_name)
+        assert gpu_node.metadata.labels[wk.LABEL_INSTANCE_CATEGORY] in ("g", "p")
+
+    def test_plain_pod_avoids_exotic_provisioning(self, env):
+        # exotic avoidance is a *provisioning* decision: a plain pod must not
+        # cause a GPU/metal node to be created (binding to an existing
+        # untainted GPU node would still be legal kube behavior)
+        env.cluster.create(make_pods(1, prefix="plain")[0])
+        env.settle()
+        claims = env.cluster.list(NodeClaim)
+        assert len(claims) == 1
+        cat = claims[0].metadata.labels[wk.LABEL_INSTANCE_CATEGORY]
+        assert cat not in ("g", "p", "acc")
+        assert claims[0].metadata.labels[wk.LABEL_INSTANCE_SIZE] != "metal"
+
+    def test_ice_reroutes_capacity(self, env):
+        # Exhaust spot + od capacity for the cheapest types in one zone by
+        # zeroing every pool, then confirm launches land in another zone.
+        zones = [z.name for z in env.cloud.describe_zones()]
+        dead_zone = zones[0]
+        for t in env.cloud.describe_instance_types():
+            env.cloud.set_capacity(t.name, dead_zone, "spot", 0)
+            env.cloud.set_capacity(t.name, dead_zone, "on-demand", 0)
+        for p in make_pods(3):
+            env.cluster.create(p)
+        env.settle(max_ticks=30)
+        assert not env.cluster.pending_pods()
+        for node in env.cluster.list(Node):
+            assert node.metadata.labels[wk.ZONE_LABEL] != dead_zone
+
+    def test_inflight_claims_prevent_double_provisioning(self, env):
+        for p in make_pods(5, cpu="100m", memory="128Mi"):
+            env.cluster.create(p)
+        # two provisioner passes before any node registers
+        env.nodeclass_controller.reconcile_all()
+        env.provisioner.reconcile()
+        claims_after_first = len(env.cluster.list(NodeClaim))
+        env.provisioner.reconcile()
+        assert len(env.cluster.list(NodeClaim)) == claims_after_first
+
+    def test_nodepool_limits_cap_capacity(self, env):
+        pool = env.cluster.get(NodePool, "default")
+        pool.limits = Resources({"cpu": "2"})  # tiny: at most one small node
+        env.cluster.update(pool)
+        for p in make_pods(8, cpu="1500m", memory="1Gi"):
+            env.cluster.create(p)
+        env.settle()
+        claims = env.cluster.list(NodeClaim)
+        total_cpu = sum(c.capacity.get(res.CPU) for c in claims)
+        assert total_cpu <= 2000.0 or len(claims) <= 1
+        assert env.cluster.pending_pods()  # the rest stays pending
+
+
+class TestTopologyAndAffinity:
+    def test_zone_spread_hard(self, env):
+        tsc = TopologySpreadConstraint(max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"})
+        for i in range(6):
+            env.cluster.create(
+                Pod(
+                    f"web-{i}",
+                    requests=Resources({"cpu": "3"}),  # forces one pod per node
+                    labels={"app": "web"},
+                    topology_spread=[tsc],
+                )
+            )
+        env.settle()
+        assert not env.cluster.pending_pods()
+        zone_counts = {}
+        for i in range(6):
+            pod = env.cluster.get(Pod, f"web-{i}")
+            zone = env.cluster.get(Node, pod.node_name).metadata.labels[wk.ZONE_LABEL]
+            zone_counts[zone] = zone_counts.get(zone, 0) + 1
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+        assert len(zone_counts) >= 3
+
+    def test_hostname_anti_affinity(self, env):
+        term = PodAffinityTerm(label_selector={"app": "solo"}, topology_key=wk.HOSTNAME_LABEL, anti=True)
+        for i in range(3):
+            env.cluster.create(
+                Pod(f"solo-{i}", requests=Resources({"cpu": "100m"}), labels={"app": "solo"}, affinity_terms=[term])
+            )
+        env.settle()
+        assert not env.cluster.pending_pods()
+        node_names = {env.cluster.get(Pod, f"solo-{i}").node_name for i in range(3)}
+        assert len(node_names) == 3  # pairwise separation
+
+
+class TestNodeClassLifecycle:
+    def test_nodeclass_resolves_status(self, env):
+        env.tick()
+        nc = env.cluster.get(TPUNodeClass, "default")
+        assert nc.ready()
+        assert len(nc.status_subnets) == 4
+        assert nc.status_security_groups and nc.status_security_groups[0].id == "sg-nodes"
+        assert {i.id for i in nc.status_images} >= {"img-std-amd64", "img-std-arm64"}
+        assert nc.status_instance_profile
+        assert nc.metadata.annotations["karpenter.tpu/nodeclass-hash"] == nc.static_hash()
+
+    def test_unready_nodeclass_blocks_launch(self, env):
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.subnet_selector_terms = []  # nothing matches -> SubnetsReady False
+        env.cluster.update(nc)
+        env.cluster.create(make_pods(1)[0])
+        env.settle(max_ticks=3)
+        assert env.cluster.pending_pods()
+        assert not env.cluster.list(Node)
+
+    def test_bootstrap_userdata_rendered(self, env):
+        env.cluster.create(make_pods(1)[0])
+        env.settle()
+        lts = env.cloud.describe_launch_templates()
+        assert lts
+        ud = lts[0].user_data
+        assert "--cluster kwok-cluster" in ud
+        assert "--node-labels" in ud
+
+    def test_node_death_unbinds_pods(self, env):
+        env.cluster.create(make_pods(1)[0])
+        env.settle()
+        inst = env.cloud.describe_instances()[0]
+        env.cloud.kill_instance(inst.id)
+        env.lifecycle.step()
+        assert env.cluster.pending_pods()  # pod back to pending
+        assert not env.cluster.list(Node)
