@@ -1,0 +1,3 @@
+from karpenter_tpu.batcher.batcher import Batcher, BatchOptions
+
+__all__ = ["Batcher", "BatchOptions"]

@@ -1,0 +1,348 @@
+"""Disruption controller: expiration, drift, emptiness, consolidation.
+
+Rebuilds the single-deprovisioning-controller design the reference documents
+(designs/deprovisioning.md; consolidation mechanics in
+designs/consolidation.md -- HOT LOOP #3 in SURVEY.md section 3.2) around
+the same decision order and safety rails:
+
+- candidates: initialized, past consolidate-after, pods all evictable
+  (owned, no do-not-disrupt), nodepool disruption budgets respected
+- reasons, in priority order: Expired -> Drifted -> Empty -> Underutilized
+- consolidation evaluates candidates in ascending *disruption cost*
+  (pods x (1 + deletion-cost + priority/1e6), weighted by remaining
+  lifetime), then simulates rescheduling the candidate's pods against the
+  rest of the cluster:
+    deletion     -- pods fit on existing capacity
+    replacement  -- pods fit on existing capacity + ONE strictly cheaper
+                    new node (spot-to-spot guarded by the feature gate)
+- stabilization: no consolidation while pods are pending or capacity is
+  still materializing (the reference waits for cluster-state sync)
+
+Execution is delegated to the termination controller by deleting the
+NodeClaim (taint -> drain -> terminate), mirroring Delete at
+pkg/cloudprovider/cloudprovider.go:209-220.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.apis import (
+    CONSOLIDATION_WHEN_EMPTY,
+    NodeClaim,
+    NodePool,
+    Node,
+    Pod,
+    TPUNodeClass,
+    labels as wk,
+)
+from karpenter_tpu.apis.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED, COND_EMPTY
+from karpenter_tpu.cloudprovider import CloudProvider
+from karpenter_tpu.errors import CloudError
+from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.scheduling import resources as res
+from karpenter_tpu.solver.oracle import ExistingNode, Scheduler
+
+MIN_NODE_LIFETIME = 5 * 60.0  # consolidation waits for PVC binding etc.
+
+REASON_EXPIRED = "Expired"
+REASON_DRIFTED = "Drifted"
+REASON_EMPTY = "Empty"
+REASON_UNDERUTILIZED = "Underutilized"
+
+
+@dataclass
+class Candidate:
+    claim: NodeClaim
+    node: Node
+    nodepool: NodePool
+    pods: List[Pod]
+    price: float
+    disruption_cost: float
+
+
+class DisruptionController:
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, pricing, feature_gates: Optional[dict] = None):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.pricing = pricing
+        self.feature_gates = feature_gates or {}
+        self.last_decisions: List[Tuple[str, str]] = []  # (claim name, reason)
+
+    # -- helpers ------------------------------------------------------------
+    def _price_of(self, claim: NodeClaim) -> float:
+        it = claim.instance_type
+        if not it:
+            return float("inf")
+        if claim.capacity_type == wk.CAPACITY_TYPE_SPOT and claim.zone:
+            p, ok = self.pricing.spot_price(it, claim.zone)
+        else:
+            p, ok = self.pricing.on_demand_price(it)
+        return p if ok else float("inf")
+
+    def _disruption_cost(self, claim: NodeClaim, pods: Sequence[Pod]) -> float:
+        """designs/consolidation.md 'Selecting Nodes for Consolidation':
+        pod count + deletion-cost + priority, weighted by lifetime left."""
+        cost = 0.0
+        for p in pods:
+            cost += 1.0 + p.deletion_cost() + p.priority / 1e6
+        lifetime_factor = 1.0
+        if claim.expire_after:
+            age = self.cluster.clock.now() - claim.metadata.creation_timestamp
+            lifetime_factor = max(0.0, 1.0 - age / claim.expire_after)
+        return cost * lifetime_factor
+
+    def _candidates(self) -> List[Candidate]:
+        now = self.cluster.clock.now()
+        out = []
+        for claim in self.cluster.list(NodeClaim):
+            if claim.deleting or not claim.initialized():
+                continue
+            node = self.cluster.node_for_nodeclaim(claim)
+            if node is None or node.deleting or node.unschedulable:
+                continue
+            pool_name = claim.nodepool_name
+            pool = self.cluster.try_get(NodePool, pool_name) if pool_name else None
+            if pool is None:
+                continue
+            pods = self.cluster.pods_on_node(node.metadata.name)
+            out.append(
+                Candidate(
+                    claim=claim,
+                    node=node,
+                    nodepool=pool,
+                    pods=pods,
+                    price=self._price_of(claim),
+                    disruption_cost=self._disruption_cost(claim, pods),
+                )
+            )
+        return out
+
+    def _budget_allows(self, pool: NodePool, reason: str, disrupting: Dict[str, int], totals: Dict[str, int]) -> bool:
+        total = totals.get(pool.name, 0)
+        current = disrupting.get(pool.name, 0)
+        for budget in pool.disruption.budgets:
+            if budget.reasons is not None and reason not in budget.reasons:
+                continue
+            if current + 1 > budget.allowed(total):
+                return False
+        return True
+
+    def _all_pods_evictable(self, pods: Sequence[Pod]) -> bool:
+        return all(p.reschedulable() for p in pods)
+
+    # -- simulation ---------------------------------------------------------
+    def _other_nodes(self, excluded: Sequence[str]) -> List[ExistingNode]:
+        out = []
+        for node in self.cluster.list(Node):
+            if node.metadata.name in excluded or node.deleting or node.unschedulable or not node.ready:
+                continue
+            out.append(
+                ExistingNode(
+                    name=node.metadata.name,
+                    labels=dict(node.metadata.labels),
+                    allocatable=node.allocatable,
+                    taints=list(node.taints),
+                    used=self.cluster.node_usage(node.metadata.name),
+                )
+            )
+        return out
+
+    def _pods_by_node(self) -> Dict[str, List[Pod]]:
+        out: Dict[str, List[Pod]] = {}
+        for p in self.cluster.list(Pod):
+            if p.node_name:
+                out.setdefault(p.node_name, []).append(p)
+        return out
+
+    def _simulate(self, candidates: Sequence[Candidate], allow_new_node: bool):
+        """Can every pod on the candidate set reschedule elsewhere (plus at
+        most one new node when allow_new_node)? Returns (ok, new_groups)."""
+        excluded = [c.node.metadata.name for c in candidates]
+        pods = [p for c in candidates for p in c.pods if p.reschedulable()]
+        nodepools = [p for p in self.cluster.list(NodePool) if not p.deleting]
+        catalogs: Dict[str, list] = {}
+        zones: set = set()
+        if allow_new_node:
+            for pool in nodepools:
+                try:
+                    items = self.cloud_provider.get_instance_types(pool)
+                except CloudError:
+                    items = []
+                catalogs[pool.name] = items
+                for it in items:
+                    for o in it.available_offerings():
+                        zones.add(o.zone)
+        sched = Scheduler(
+            nodepools=nodepools if allow_new_node else [],
+            instance_types=catalogs,
+            existing_nodes=self._other_nodes(excluded),
+            pods_by_node={k: v for k, v in self._pods_by_node().items() if k not in excluded},
+            nodepool_usage={p.name: self.cluster.nodepool_usage(p.name) for p in nodepools},
+            zones=zones,
+        )
+        result = sched.schedule(pods)
+        if result.unschedulable:
+            return False, []
+        if not allow_new_node and result.new_groups:
+            return False, []
+        if allow_new_node and len(result.new_groups) > 1:
+            return False, []
+        return True, result.new_groups
+
+    # -- reconcile ----------------------------------------------------------
+    def reconcile(self, max_disruptions: int = 1) -> List[Tuple[str, str]]:
+        """One disruption pass; returns [(claim, reason)] acted on."""
+        self.last_decisions = []
+        disrupting: Dict[str, int] = {}
+        totals: Dict[str, int] = {}
+        for claim in self.cluster.list(NodeClaim):
+            if claim.nodepool_name:
+                totals[claim.nodepool_name] = totals.get(claim.nodepool_name, 0) + 1
+                if claim.deleting:
+                    disrupting[claim.nodepool_name] = disrupting.get(claim.nodepool_name, 0) + 1
+
+        candidates = self._candidates()
+        now = self.cluster.clock.now()
+
+        # 1) expiration (forceful; budget-exempt in the core's model for
+        #    expired-by-spec, but we respect budgets like modern karpenter)
+        for c in candidates:
+            if len(self.last_decisions) >= max_disruptions:
+                return self.last_decisions
+            if c.claim.expire_after is not None and now - c.claim.metadata.creation_timestamp >= c.claim.expire_after:
+                if self._budget_allows(c.nodepool, REASON_EXPIRED, disrupting, totals):
+                    self._disrupt(c, REASON_EXPIRED, disrupting)
+
+        # 2) drift (graceful: requires replacement simulation)
+        for c in candidates:
+            if len(self.last_decisions) >= max_disruptions:
+                return self.last_decisions
+            if c.claim.metadata.name in [n for n, _ in self.last_decisions]:
+                continue
+            drift = self._drift_reason(c)
+            if drift and self._all_pods_evictable(c.pods):
+                if not self._budget_allows(c.nodepool, REASON_DRIFTED, disrupting, totals):
+                    continue
+                c.claim.status_conditions.set_true(COND_DRIFTED, drift)
+                ok, groups = self._simulate([c], allow_new_node=True)
+                if ok:
+                    self._replace_then_disrupt(c, groups, REASON_DRIFTED, disrupting)
+
+        # 3) emptiness + 4) consolidation share the stabilization gate
+        if self.cluster.pending_pods():
+            return self.last_decisions
+        consolidatable = sorted(
+            (
+                c
+                for c in candidates
+                if c.claim.metadata.name not in [n for n, _ in self.last_decisions]
+                and now - c.claim.metadata.creation_timestamp
+                >= max(MIN_NODE_LIFETIME, c.nodepool.disruption.consolidate_after)
+            ),
+            key=lambda c: c.disruption_cost,
+        )
+        for c in consolidatable:
+            if len(self.last_decisions) >= max_disruptions:
+                return self.last_decisions
+            reschedulable = [p for p in c.pods if p.owner_kind != "Node"]
+            if not reschedulable:
+                c.claim.status_conditions.set_true(COND_EMPTY)
+                if self._budget_allows(c.nodepool, REASON_EMPTY, disrupting, totals):
+                    self._disrupt(c, REASON_EMPTY, disrupting)
+                continue
+            if c.nodepool.disruption.consolidation_policy == CONSOLIDATION_WHEN_EMPTY:
+                continue
+            if not self._all_pods_evictable(c.pods):
+                continue
+            if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, disrupting, totals):
+                continue
+            # deletion first, then single-node replacement
+            ok, _ = self._simulate([c], allow_new_node=False)
+            if ok:
+                c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+                self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
+                continue
+            ok, groups = self._simulate([c], allow_new_node=True)
+            if ok and groups and self._replacement_cheaper(c, groups):
+                c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+                self._replace_then_disrupt(c, groups, REASON_UNDERUTILIZED, disrupting)
+
+        # 5) multi-node consolidation: try deleting the k cheapest-to-disrupt
+        #    candidates together (pure deletion, no replacement)
+        if len(self.last_decisions) < max_disruptions and len(consolidatable) >= 2:
+            remaining = [
+                c
+                for c in consolidatable
+                if c.claim.metadata.name not in [n for n, _ in self.last_decisions]
+                and self._all_pods_evictable(c.pods)
+            ]
+            k = len(remaining)
+            while k >= 2:
+                subset = remaining[:k]
+                ok, _ = self._simulate(subset, allow_new_node=False)
+                if ok:
+                    # budgets re-checked per disruption as the count grows;
+                    # deleting a prefix of the simulated subset is safe
+                    # (fewer exclusions than simulated only adds capacity)
+                    for c in subset:
+                        if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, disrupting, totals):
+                            break
+                        self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
+                    break
+                k -= 1
+        return self.last_decisions
+
+    def _drift_reason(self, c: Candidate) -> Optional[str]:
+        # nodepool static drift via stamped hash
+        pool_hash = c.claim.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION)
+        if pool_hash is not None and pool_hash != c.nodepool.static_hash():
+            return "NodePoolDrifted"
+        try:
+            return self.cloud_provider.is_drifted(c.claim)
+        except CloudError:
+            return None
+
+    def _replacement_cheaper(self, c: Candidate, groups) -> bool:
+        """Replacement must be strictly cheaper; spot->spot consolidation is
+        feature-gated (reference gates SpotToSpotConsolidation)."""
+        if not groups:
+            return True
+        cheapest_new = min(min(it.cheapest_price() for it in g.instance_types) for g in groups)
+        if c.claim.capacity_type == wk.CAPACITY_TYPE_SPOT and not self.feature_gates.get("SpotToSpotConsolidation"):
+            # only consolidate spot into cheaper on-demand
+            od_prices = [
+                o.price
+                for g in groups
+                for it in g.instance_types
+                for o in it.available_offerings()
+                if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
+            ]
+            if not od_prices:
+                return False
+            cheapest_new = min(od_prices)
+        return cheapest_new < c.price
+
+    # -- execution ----------------------------------------------------------
+    def _disrupt(self, c: Candidate, reason: str, disrupting: Dict[str, int]) -> None:
+        self.cluster.delete(NodeClaim, c.claim.metadata.name)
+        disrupting[c.nodepool.name] = disrupting.get(c.nodepool.name, 0) + 1
+        self.last_decisions.append((c.claim.metadata.name, reason))
+
+    def _replace_then_disrupt(self, c: Candidate, groups, reason: str, disrupting: Dict[str, int]) -> None:
+        """Launch the replacement before draining (consolidation.md: delete
+        the expensive node only 'when [the replacement] is ready'). If the
+        replacement launch fails (e.g. ICE at fleet time), the old node is
+        KEPT -- disrupting without a live replacement is the capacity gap
+        this ordering exists to prevent."""
+        from karpenter_tpu.controllers.provisioner import Provisioner
+        from karpenter_tpu.solver.oracle import SchedulingResult
+
+        prov = Provisioner(self.cluster, self.cloud_provider)
+        result = SchedulingResult()
+        result.new_groups = list(groups)
+        prov._launch(result)
+        if result.unschedulable:
+            return  # replacement did not materialize; try again next tick
+        self._disrupt(c, reason, disrupting)

@@ -1,0 +1,76 @@
+"""NodeClaim termination: graceful drain -> instance delete -> finalizer.
+
+Rebuilds the core termination controller behavior the reference plugs into
+(CloudProvider.Delete at pkg/cloudprovider/cloudprovider.go:209-220; the
+disrupted taint + cordon-and-drain flow the interruption controller also
+uses, pkg/controllers/interruption/controller.go:233-248):
+
+deleting NodeClaim -> taint+cordon its node -> evict reschedulable pods
+(grace-period aware) -> when empty (or grace expired) terminate the cloud
+instance -> drop finalizer -> node object removed.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.apis import NodeClaim, Node
+from karpenter_tpu.cloudprovider import CloudProvider
+from karpenter_tpu.errors import NotFoundError
+from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.scheduling import Taint
+
+TERMINATION_FINALIZER = "karpenter.sh/termination"
+DISRUPTED_TAINT = Taint("karpenter.sh/disrupted", effect="NoSchedule")
+
+
+class TerminationController:
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self._drain_started: dict = {}
+
+    def reconcile_all(self) -> None:
+        for claim in self.cluster.list(NodeClaim):
+            if claim.deleting:
+                self.reconcile(claim)
+
+    def reconcile(self, claim: NodeClaim) -> None:
+        node = self.cluster.node_for_nodeclaim(claim)
+        now = self.cluster.clock.now()
+        if node is not None and not node.deleting:
+            # cordon + disrupted taint
+            if not node.unschedulable:
+                node.unschedulable = True
+                if all(t.key != DISRUPTED_TAINT.key for t in node.taints):
+                    node.taints.append(DISRUPTED_TAINT)
+                self.cluster.update(node)
+            started = self._drain_started.setdefault(claim.metadata.name, now)
+            pods = self.cluster.pods_on_node(node.metadata.name)
+            evictable = [p for p in pods if p.reschedulable()]
+            blocked = [p for p in pods if not p.reschedulable()]
+            for p in evictable:
+                p.node_name = ""
+                p.phase = "Pending"
+                self.cluster.update(p)
+            grace = claim.termination_grace_period
+            if blocked and (grace is None or now - started < grace):
+                return  # wait for do-not-disrupt pods until grace expires
+            # grace expired: non-reschedulable pods (static pods, bare pods)
+            # die with the node rather than being requeued -- requeueing
+            # would make the provisioner launch capacity for pods that are
+            # not controller-replaced
+            from karpenter_tpu.apis import Pod as PodKind
+
+            for p in blocked:
+                p.metadata.finalizers = []
+                self.cluster.delete(PodKind, p.metadata.name)
+        # node drained (or gone): delete the instance, then the objects
+        try:
+            self.cloud_provider.delete(claim)
+        except NotFoundError:
+            pass
+        if node is not None:
+            node.metadata.finalizers = []
+            self.cluster.delete(Node, node.metadata.name)
+        self.cluster.remove_finalizer(claim, TERMINATION_FINALIZER)
+        self._drain_started.pop(claim.metadata.name, None)

@@ -1,0 +1,3 @@
+from karpenter_tpu.parallel.mesh import make_mesh, sharded_solve, catalog_sharding
+
+__all__ = ["make_mesh", "sharded_solve", "catalog_sharding"]

@@ -1,0 +1,351 @@
+"""Dense tensor encoding: catalog and pod classes -> solver inputs.
+
+This is the bridge between the host-side constraint algebra and the TPU
+decision plane (SURVEY.md section 2.3: "this label-set constraint algebra is
+the boolean-mask layer of the future TPU solver").
+
+Encoding scheme
+===============
+- Resources are scaled to *small exact integers* (cpu -> millicores,
+  memory -> MiB, storage -> GiB, counts as-is) so every value is < 2^24 and
+  float32 arithmetic (incl. floor division) is exact -- the differential
+  guarantee vs the Python oracle depends on this.
+- Label constraints lower to **bitset masks over per-dimension
+  vocabularies**: the catalog contributes an int32 code per (type, dim);
+  a pod class contributes packed uint32 allowed-bitmasks per dim. On device,
+  compat[c, k] = AND_d bit(tcode[k, d]) in allowed[c, d]. Numeric
+  requirements (Gt/Lt over cpu, memory...) lower to interval tests against
+  numeric catalog columns.
+- Zones and capacity types are small fixed axes (Z, CT) with explicit
+  boolean masks, because they are offering properties (price/availability
+  vary per (type, zone, captype)), not type properties.
+
+Pods are grouped into equivalence classes by (requests, requirements,
+tolerations) -- 50k pods typically collapse to a few hundred classes, which
+turns the sequential FFD loop into a short scan with large per-step
+vectorized work (the shape TPUs want).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.apis import Pod, labels as wk
+from karpenter_tpu.providers.instancetype.types import InstanceType
+from karpenter_tpu.scheduling import Requirements, Taint, tolerates_all
+from karpenter_tpu.scheduling import resources as res
+
+# -- static solver shape parameters (XLA wants fixed shapes) -----------------
+R = res.NUM_RESOURCE_AXES          # resource axes
+Z_PAD = 8                          # zone slots
+CT = 3                             # capacity types: reserved, spot, on-demand
+CAPTYPE_INDEX = {wk.CAPACITY_TYPE_RESERVED: 0, wk.CAPACITY_TYPE_SPOT: 1, wk.CAPACITY_TYPE_ON_DEMAND: 2}
+
+# label dimensions lowered to bitset vocabularies, in fixed order
+LABEL_DIMS: Tuple[str, ...] = (
+    wk.INSTANCE_TYPE_LABEL,
+    wk.ARCH_LABEL,
+    wk.OS_LABEL,
+    wk.LABEL_INSTANCE_CATEGORY,
+    wk.LABEL_INSTANCE_FAMILY,
+    wk.LABEL_INSTANCE_GENERATION,
+    wk.LABEL_INSTANCE_SIZE,
+    wk.LABEL_INSTANCE_CPU_MANUFACTURER,
+    wk.LABEL_INSTANCE_HYPERVISOR,
+    wk.LABEL_INSTANCE_GPU_NAME,
+    wk.LABEL_INSTANCE_ACCELERATOR_NAME,
+    wk.LABEL_INSTANCE_LOCAL_NVME,
+    wk.LABEL_INSTANCE_ENCRYPTION_IN_TRANSIT,
+    wk.NODEPOOL_LABEL,
+    wk.REGION_LABEL,
+)
+D = len(LABEL_DIMS)
+
+# numeric dims for Gt/Lt windows
+NUMERIC_DIMS: Tuple[str, ...] = (
+    wk.LABEL_INSTANCE_CPU,
+    wk.LABEL_INSTANCE_MEMORY,
+    wk.LABEL_INSTANCE_GENERATION,
+    wk.LABEL_INSTANCE_NETWORK_BANDWIDTH,
+    wk.LABEL_INSTANCE_EBS_BANDWIDTH,
+    wk.LABEL_INSTANCE_GPU_COUNT,
+    wk.LABEL_INSTANCE_ACCELERATOR_COUNT,
+)
+ND = len(NUMERIC_DIMS)
+
+# unit scaling per resource axis: raw base units -> small exact ints
+_SCALE = np.ones((R,), dtype=np.float64)
+_SCALE[res.AXIS_INDEX[res.MEMORY]] = 1.0 / 2**20          # bytes -> MiB
+_SCALE[res.AXIS_INDEX[res.EPHEMERAL_STORAGE]] = 1.0 / 2**30  # bytes -> GiB
+
+
+def scale_vector(v: Sequence[float]) -> np.ndarray:
+    return np.asarray(v, dtype=np.float64) * _SCALE
+
+
+def _pad_pow2_words(n: int) -> int:
+    return (n + 31) // 32
+
+
+@dataclass
+class Vocab:
+    """Per-dimension value vocabulary; index 0 is reserved for 'absent'."""
+
+    values: List[str] = field(default_factory=lambda: ["<absent>"])
+    index: Dict[str, int] = field(default_factory=lambda: {"<absent>": 0})
+
+    def code(self, value: Optional[str]) -> int:
+        if value is None:
+            return 0
+        i = self.index.get(value)
+        if i is None:
+            i = len(self.values)
+            self.values.append(value)
+            self.index[value] = i
+        return i
+
+    def __len__(self):
+        return len(self.values)
+
+
+@dataclass
+class CatalogTensors:
+    """Device-ready encoding of one resolved instance-type catalog."""
+
+    names: List[str]                 # K_real entries
+    k_real: int
+    k_pad: int
+    cap: np.ndarray                  # [K, R] float32, scaled allocatable; 0 rows for padding
+    tcode: np.ndarray                # [K, D] int32 label codes
+    tnum: np.ndarray                 # [K, ND] float32 numeric label values
+    tnum_present: np.ndarray         # [K, ND] bool: label defined on the type
+    tzone: np.ndarray                # [K, Z] bool: has any offering in zone
+    tcap: np.ndarray                 # [K, CT] bool: has any offering of captype
+    price: np.ndarray                # [K, Z, CT] float32; +inf when no available offering
+    vocabs: List[Vocab]
+    zones: List[str]                 # zone axis order
+    words: List[int]                 # bitmask words per dim
+
+    def zone_index(self, zone: str) -> int:
+        return self.zones.index(zone)
+
+
+def encode_catalog(instance_types: Sequence[InstanceType], k_pad: Optional[int] = None) -> CatalogTensors:
+    k_real = len(instance_types)
+    if k_pad is None:
+        k_pad = max(128, ((k_real + 127) // 128) * 128)
+    vocabs = [Vocab() for _ in LABEL_DIMS]
+    zones: List[str] = []
+    zone_idx: Dict[str, int] = {}
+    for it in instance_types:
+        for o in it.offerings:
+            if o.zone not in zone_idx:
+                if len(zones) >= Z_PAD:
+                    raise ValueError(f"more than {Z_PAD} zones; raise Z_PAD")
+                zone_idx[o.zone] = len(zones)
+                zones.append(o.zone)
+
+    cap = np.zeros((k_pad, R), dtype=np.float32)
+    tcode = np.zeros((k_pad, D), dtype=np.int32)
+    tnum = np.zeros((k_pad, ND), dtype=np.float32)
+    tnum_present = np.zeros((k_pad, ND), dtype=bool)
+    tzone = np.zeros((k_pad, Z_PAD), dtype=bool)
+    tcap = np.zeros((k_pad, CT), dtype=bool)
+    price = np.full((k_pad, Z_PAD, CT), np.inf, dtype=np.float32)
+    names = []
+    for k, it in enumerate(instance_types):
+        names.append(it.name)
+        cap[k] = scale_vector(it.allocatable().to_vector())
+        labels = it.requirements.labels()
+        for d, dim in enumerate(LABEL_DIMS):
+            tcode[k, d] = vocabs[d].code(labels.get(dim))
+        for nd_i, dim in enumerate(NUMERIC_DIMS):
+            val = labels.get(dim)
+            try:
+                tnum[k, nd_i] = float(val) if val is not None else 0.0
+                tnum_present[k, nd_i] = val is not None
+            except ValueError:
+                tnum[k, nd_i] = 0.0
+                tnum_present[k, nd_i] = False
+        for o in it.offerings:
+            z = zone_idx[o.zone]
+            c = CAPTYPE_INDEX[o.capacity_type]
+            if o.available:
+                tzone[k, z] = True
+                tcap[k, c] = True
+                price[k, z, c] = min(price[k, z, c], o.price)
+    words = [_pad_pow2_words(len(v)) for v in vocabs]
+    return CatalogTensors(
+        names=names, k_real=k_real, k_pad=k_pad, cap=cap, tcode=tcode, tnum=tnum,
+        tnum_present=tnum_present, tzone=tzone, tcap=tcap, price=price, vocabs=vocabs,
+        zones=zones, words=words,
+    )
+
+
+@dataclass
+class PodClass:
+    """One equivalence class of identical-for-scheduling pods."""
+
+    pods: List[Pod]
+    requests: np.ndarray             # [R] scaled, includes pods=1
+    requirements: Requirements
+    key: tuple
+
+
+@dataclass
+class PodClassSet:
+    classes: List[PodClass]
+    c_real: int
+    c_pad: int
+    req: np.ndarray                  # [C, R] float32
+    count: np.ndarray                # [C] int32
+    allowed: List[np.ndarray]        # per dim: [C, W_d] uint32 bitmasks
+    num_lo: np.ndarray               # [C, ND] float32 exclusive lower bounds (-inf none)
+    num_hi: np.ndarray               # [C, ND] float32 exclusive upper bounds (+inf none)
+    azone: np.ndarray                # [C, Z] bool allowed zones
+    acap: np.ndarray                 # [C, CT] bool allowed captypes
+    schedulable: np.ndarray          # [C] bool (taints tolerated etc.)
+
+
+def _class_key(pod: Pod, reqs: Requirements) -> tuple:
+    return (
+        tuple(np.asarray(scale_vector(
+            (pod.requests + _one_pod()).to_vector()), dtype=np.float64)),
+        reqs.stable_hash(),
+        tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
+    )
+
+
+def _one_pod():
+    from karpenter_tpu.scheduling import Resources
+
+    return Resources.from_base_units({res.PODS: 1})
+
+
+def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] = None) -> List[PodClass]:
+    """Collapse pods into equivalence classes. Pods with multiple affinity
+    alternatives use their first term (the oracle handles full OR semantics;
+    multi-term pods are rare and can be routed to the oracle)."""
+    groups: Dict[tuple, PodClass] = {}
+    for pod in pods:
+        reqs = pod.scheduling_requirements()[0]
+        if extra_requirements is not None:
+            reqs = reqs.copy().add(*extra_requirements)
+        key = _class_key(pod, reqs)
+        pc = groups.get(key)
+        if pc is None:
+            requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
+            pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
+        pc.pods.append(pod)
+    # FFD order: dominant resource (cpu, then memory) descending -- must
+    # match the oracle's sort for differential equivalence
+    out = list(groups.values())
+    out.sort(key=lambda pc: (pc.requests[res.AXIS_INDEX[res.CPU]], pc.requests[res.AXIS_INDEX[res.MEMORY]]), reverse=True)
+    return out
+
+
+def _allowed_bits_for(reqs: Requirements, vocab: Vocab, dim: str, words: int) -> np.ndarray:
+    """Packed allowed-set bitmask for one dim. Unknown values in an In-set
+    are ignored (they can't match any type); absent requirement = all ones.
+
+    Semantics mirror Requirements.compatible on the *type* side: a type that
+    does not define the label (code 0, 'absent') is PERMISSIVELY compatible
+    with any requirement on that label (e.g. the karpenter.sh/nodepool
+    requirement never appears on catalog types) -- except DoesNotExist,
+    where absent is the only admissible state and defined values are not."""
+    r = reqs.get(dim)
+    out = np.zeros((words,), dtype=np.uint64)
+    if r is None:
+        out[:] = np.uint64(0xFFFFFFFF)
+        return out.astype(np.uint32)
+    if r.is_does_not_exist():
+        out[0] = np.uint64(1)  # only 'absent' allowed
+        return out.astype(np.uint32)
+    if r.complement:
+        out[:] = np.uint64(0xFFFFFFFF)
+        for v in r.values:
+            i = vocab.index.get(v)
+            if i is not None:
+                out[i // 32] &= ~np.uint64(1 << (i % 32))
+    else:
+        for v in r.values:
+            i = vocab.index.get(v)
+            if i is not None:
+                out[i // 32] |= np.uint64(1 << (i % 32))
+    out[0] |= np.uint64(1)  # absent label on the type side is permissive
+    return out.astype(np.uint32)
+
+
+def encode_classes(
+    classes: Sequence[PodClass],
+    catalog: CatalogTensors,
+    pool_taints: Sequence[Taint] = (),
+    c_pad: Optional[int] = None,
+) -> PodClassSet:
+    c_real = len(classes)
+    if c_pad is None:
+        c_pad = max(8, ((c_real + 7) // 8) * 8)
+    req = np.zeros((c_pad, R), dtype=np.float32)
+    count = np.zeros((c_pad,), dtype=np.int32)
+    allowed = [np.zeros((c_pad, w), dtype=np.uint32) for w in catalog.words]
+    num_lo = np.full((c_pad, ND), -np.inf, dtype=np.float32)
+    num_hi = np.full((c_pad, ND), np.inf, dtype=np.float32)
+    azone = np.zeros((c_pad, Z_PAD), dtype=bool)
+    acap = np.zeros((c_pad, CT), dtype=bool)
+    schedulable = np.zeros((c_pad,), dtype=bool)
+    for c, pc in enumerate(classes):
+        req[c] = pc.requests
+        count[c] = len(pc.pods)
+        reqs = pc.requirements
+        for d, dim in enumerate(LABEL_DIMS):
+            allowed[d][c] = _allowed_bits_for(reqs, catalog.vocabs[d], dim, catalog.words[d])
+        for nd_i, dim in enumerate(NUMERIC_DIMS):
+            r = reqs.get(dim)
+            if r is not None:
+                if r.greater_than is not None:
+                    num_lo[c, nd_i] = r.greater_than
+                if r.less_than is not None:
+                    num_hi[c, nd_i] = r.less_than
+                # In-sets over numeric dims are handled via the bitset path
+                # when the dim is also a LABEL_DIM; pure-numeric In is rare
+        zreq = reqs.get(wk.ZONE_LABEL)
+        for z, zone in enumerate(catalog.zones):
+            azone[c, z] = zreq is None or zreq.matches(zone)
+        creq = reqs.get(wk.CAPACITY_TYPE_LABEL)
+        for name, idx in CAPTYPE_INDEX.items():
+            acap[c, idx] = creq is None or creq.matches(name)
+        schedulable[c] = tolerates_all(pc.pods[0].tolerations, pool_taints)
+    return PodClassSet(
+        classes=list(classes), c_real=c_real, c_pad=c_pad, req=req, count=count,
+        allowed=allowed, num_lo=num_lo, num_hi=num_hi, azone=azone, acap=acap,
+        schedulable=schedulable,
+    )
+
+
+def compat_matrix(catalog: CatalogTensors, classes: PodClassSet) -> np.ndarray:
+    """[C, K] bool: class c may run on type k (labels + numeric windows).
+    Host/numpy reference implementation -- the jitted solver computes the
+    same thing on device (solver/ffd.py)."""
+    C, K = classes.c_pad, catalog.k_pad
+    ok = np.ones((C, K), dtype=bool)
+    for d in range(D):
+        codes = catalog.tcode[:, d]                       # [K]
+        words = classes.allowed[d][:, codes // 32]        # [C, K]
+        bits = (words >> (codes % 32).astype(np.uint32)) & 1
+        ok &= bits.astype(bool)
+    for nd_i in range(ND):
+        v = catalog.tnum[:, nd_i][None, :]
+        present = catalog.tnum_present[:, nd_i][None, :]
+        in_window = (v > classes.num_lo[:, nd_i][:, None]) & (v < classes.num_hi[:, nd_i][:, None])
+        # a type that does not define the numeric label is permissively
+        # compatible (matches Requirements.compatible for missing keys)
+        ok &= in_window | ~present
+    # offering-level compat: some permitted zone AND captype must exist
+    ok &= (classes.azone.astype(np.int8) @ catalog.tzone.T.astype(np.int8)) > 0
+    ok &= (classes.acap.astype(np.int8) @ catalog.tcap.T.astype(np.int8)) > 0
+    ok &= classes.schedulable[:, None]
+    ok[:, catalog.k_real:] = False
+    ok[classes.c_real:, :] = False
+    return ok

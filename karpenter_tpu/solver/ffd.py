@@ -1,0 +1,235 @@
+"""Batched First-Fit-Decreasing bin-packing as a jitted lax.scan.
+
+The TPU reformulation of the core scheduler's sequential FFD loop
+(designs/bin-packing.md:17-43 -- HOT LOOP #1 in SURVEY.md section 3.1):
+
+- pods are pre-collapsed into equivalence classes (solver/encode.py), so the
+  scan length is #distinct pod shapes (hundreds), not #pods (50k)
+- the scan carry is the set of open node groups: accumulated requests
+  [G, R], surviving instance-type mask [G, K], surviving zone / capacity-
+  type masks [G, Z] / [G, CT] -- the tensor form of the core's "NodeClaim
+  with narrowing requirements"
+- first-fit placement across groups is computed *exactly* with an exclusive
+  cumulative sum over per-group fit counts: identical pods spill from group
+  g to g+1 precisely as the sequential loop would
+- class/type compatibility (the requirements algebra) is evaluated on
+  device as packed-bitset gathers + numeric interval tests, fused by XLA
+  into the fit computation
+
+Everything is static-shaped; instances are padded into (C, G, K) buckets and
+compiled once per bucket. All resource values are small exact integers in
+float32 (encode.py scaling), so fit arithmetic is exact and differentially
+testable against the Python oracle.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.solver import encode
+from karpenter_tpu.solver.encode import CatalogTensors, PodClassSet
+
+_INF = jnp.float32(jnp.inf)
+
+
+class SolveInputs(NamedTuple):
+    # catalog
+    cap: jax.Array          # [K, R] f32
+    tcode: jax.Array        # [K, D] i32
+    tnum: jax.Array         # [K, ND] f32
+    tnum_present: jax.Array  # [K, ND] bool
+    tzone: jax.Array        # [K, Z] bool
+    tcap: jax.Array         # [K, CT] bool
+    # classes
+    req: jax.Array          # [C, R] f32
+    count: jax.Array        # [C] i32
+    allowed: jax.Array      # [C, TW] u32 (all dims concatenated)
+    num_lo: jax.Array       # [C, ND] f32
+    num_hi: jax.Array       # [C, ND] f32
+    azone: jax.Array        # [C, Z] bool
+    acap: jax.Array         # [C, CT] bool
+    schedulable: jax.Array  # [C] bool
+
+
+class SolveOutputs(NamedTuple):
+    take: jax.Array         # [C, G] i32: pods of class c placed on group g
+    unplaced: jax.Array     # [C] i32
+    n_open: jax.Array       # scalar i32
+    accum: jax.Array        # [G, R] f32
+    gmask: jax.Array        # [G, K] bool
+    gzone: jax.Array        # [G, Z] bool
+    gcap: jax.Array         # [G, CT] bool
+    compat: jax.Array       # [C, K] bool (diagnostic / reuse)
+
+
+def _device_compat(inp: SolveInputs, word_offsets: Tuple[int, ...], words: Tuple[int, ...]) -> jax.Array:
+    """[C, K] bool compatibility, computed on device. Mirrors
+    encode.compat_matrix; the Python version is the oracle for this one."""
+    C = inp.req.shape[0]
+    K = inp.cap.shape[0]
+    ok = jnp.ones((C, K), dtype=bool)
+    for d, (off, w) in enumerate(zip(word_offsets, words)):
+        codes = inp.tcode[:, d]                                   # [K]
+        word_idx = off + jnp.right_shift(codes, 5)                # [K]
+        bit_idx = jnp.bitwise_and(codes, 31).astype(jnp.uint32)   # [K]
+        gathered = inp.allowed[:, word_idx]                       # [C, K] u32
+        bits = jnp.bitwise_and(jnp.right_shift(gathered, bit_idx[None, :]), jnp.uint32(1))
+        ok = ok & bits.astype(bool)
+    v = inp.tnum[None, :, :]                                      # [1, K, ND]
+    in_window = (v > inp.num_lo[:, None, :]) & (v < inp.num_hi[:, None, :])
+    # absent numeric label on the type side is permissive (oracle semantics)
+    ok = ok & jnp.all(in_window | ~inp.tnum_present[None, :, :], axis=-1)
+    zj = jnp.einsum("cz,kz->ck", inp.azone.astype(jnp.float32), inp.tzone.astype(jnp.float32))
+    cj = jnp.einsum("ct,kt->ck", inp.acap.astype(jnp.float32), inp.tcap.astype(jnp.float32))
+    ok = ok & (zj > 0) & (cj > 0) & inp.schedulable[:, None]
+    return ok
+
+
+def _fit_counts(cap: jax.Array, accum: jax.Array, req: jax.Array) -> jax.Array:
+    """[G, K] how many pods of `req` fit in (cap[k] - accum[g]).
+    req axes that are zero are unconstrained. Exact in f32 (small ints)."""
+    headroom = cap[None, :, :] - accum[:, None, :]                # [G, K, R]
+    per_axis = jnp.where(
+        req[None, None, :] > 0,
+        jnp.floor(headroom / jnp.where(req > 0, req, 1.0)[None, None, :]),
+        _INF,
+    )
+    n = jnp.min(per_axis, axis=-1)                                # [G, K]
+    return jnp.maximum(n, 0.0)
+
+
+def ffd_solve_impl(inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...]) -> SolveOutputs:
+    """Unjitted body (jit via `ffd_solve`; exposed for graft-entry
+    compile checks and sharded wrappers)."""
+    return _ffd_body(inp, g_max, word_offsets, words)
+
+
+@functools.partial(jax.jit, static_argnames=("g_max", "word_offsets", "words"))
+def ffd_solve(inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...]) -> SolveOutputs:
+    return _ffd_body(inp, g_max, word_offsets, words)
+
+
+def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...]) -> SolveOutputs:
+    C, Rr = inp.req.shape
+    K = inp.cap.shape[0]
+    Z = inp.tzone.shape[1]
+    CTn = inp.tcap.shape[1]
+    compat = _device_compat(inp, word_offsets, words)             # [C, K]
+
+    slot = jnp.arange(g_max, dtype=jnp.int32)
+
+    def step(carry, xs):
+        accum, gmask, gzone, gcap, n_open = carry
+        req_c, count_c, compat_c, azone_c, acap_c = xs
+
+        # -- joint feasibility of class c on each open group ---------------
+        gz = gzone & azone_c[None, :]                             # [G, Z]
+        gc = gcap & acap_c[None, :]                               # [G, CT]
+        zj = jnp.einsum("gz,kz->gk", gz.astype(jnp.float32), inp.tzone.astype(jnp.float32)) > 0
+        cj = jnp.einsum("gt,kt->gk", gc.astype(jnp.float32), inp.tcap.astype(jnp.float32)) > 0
+        m = gmask & compat_c[None, :] & zj & cj                   # [G, K]
+
+        # -- how many fit on each open group -------------------------------
+        n_fit = _fit_counts(inp.cap, accum, req_c)                # [G, K]
+        n_grp = jnp.max(jnp.where(m, n_fit, 0.0), axis=-1)        # [G]
+        n_grp = jnp.where(slot < n_open, n_grp, 0.0).astype(jnp.int32)
+
+        # -- exact first-fit via exclusive cumsum --------------------------
+        cum_before = jnp.cumsum(n_grp) - n_grp
+        take = jnp.clip(count_c - cum_before, 0, n_grp)           # [G] i32
+        placed = jnp.sum(take)
+        leftover = count_c - placed
+
+        # -- open fresh identical groups for the remainder -----------------
+        fresh_zone = jnp.einsum("z,kz->k", azone_c.astype(jnp.float32), inp.tzone.astype(jnp.float32)) > 0
+        fresh_cap = jnp.einsum("t,kt->k", acap_c.astype(jnp.float32), inp.tcap.astype(jnp.float32)) > 0
+        fresh_mask = compat_c & fresh_zone & fresh_cap            # [K]
+        n_fresh = _fit_counts(inp.cap, jnp.zeros((1, Rr), inp.cap.dtype), req_c)[0]  # [K]
+        per_new = jnp.max(jnp.where(fresh_mask, n_fresh, 0.0)).astype(jnp.int32)
+        can_open = (leftover > 0) & (per_new > 0)
+        n_new = jnp.where(can_open, -(-leftover // jnp.maximum(per_new, 1)), 0)
+        n_new = jnp.minimum(n_new, g_max - n_open)                # slot budget
+        is_new = (slot >= n_open) & (slot < n_open + n_new)
+        ordinal = slot - n_open
+        take_new = jnp.where(
+            is_new, jnp.clip(leftover - ordinal * per_new, 0, per_new), 0
+        ).astype(jnp.int32)
+
+        take_all = take + take_new                                # [G]
+        still_unplaced = count_c - jnp.sum(take_all)
+
+        # -- update carry ---------------------------------------------------
+        accum2 = accum + take_all[:, None].astype(jnp.float32) * req_c[None, :]
+        fits_now = jnp.all(inp.cap[None, :, :] >= accum2[:, None, :], axis=-1)  # [G, K]
+        touched_existing = take > 0
+        gmask2 = jnp.where(touched_existing[:, None], m & fits_now, gmask)
+        gmask2 = jnp.where(is_new[:, None], fresh_mask[None, :] & fits_now, gmask2)
+        gzone2 = jnp.where(touched_existing[:, None], gz, gzone)
+        gzone2 = jnp.where(is_new[:, None], azone_c[None, :], gzone2)
+        gcap2 = jnp.where(touched_existing[:, None], gc, gcap)
+        gcap2 = jnp.where(is_new[:, None], acap_c[None, :], gcap2)
+        n_open2 = n_open + n_new
+
+        return (accum2, gmask2, gzone2, gcap2, n_open2), (take_all, still_unplaced)
+
+    init = (
+        jnp.zeros((g_max, Rr), jnp.float32),
+        jnp.zeros((g_max, K), bool),
+        jnp.zeros((g_max, Z), bool),
+        jnp.zeros((g_max, CTn), bool),
+        jnp.int32(0),
+    )
+    xs = (inp.req, inp.count, compat, inp.azone, inp.acap)
+    (accum, gmask, gzone, gcap, n_open), (take, unplaced) = jax.lax.scan(step, init, xs)
+    return SolveOutputs(
+        take=take, unplaced=unplaced, n_open=n_open, accum=accum,
+        gmask=gmask, gzone=gzone, gcap=gcap, compat=compat,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=())
+def select_offerings(price: jax.Array, gmask: jax.Array, gzone: jax.Array, gcap: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Cheapest (type, zone, captype) per group from the surviving masks.
+    price: [K, Z, CT]; returns (k, z, ct, price) each [G]."""
+    masked = jnp.where(
+        gmask[:, :, None, None] & gzone[:, None, :, None] & gcap[:, None, None, :],
+        price[None, :, :, :],
+        _INF,
+    )                                                             # [G, K, Z, CT]
+    G = masked.shape[0]
+    flat = masked.reshape(G, -1)
+    best = jnp.argmin(flat, axis=-1)
+    bp = jnp.min(flat, axis=-1)
+    K, Z, CT = price.shape
+    k = best // (Z * CT)
+    z = (best // CT) % Z
+    ct = best % CT
+    return k, z, ct, bp
+
+
+def make_inputs(catalog: CatalogTensors, classes: PodClassSet) -> Tuple[SolveInputs, Tuple[int, ...], Tuple[int, ...]]:
+    words = tuple(catalog.words)
+    offsets = tuple(int(x) for x in np.cumsum((0,) + words[:-1]))
+    allowed = np.concatenate(classes.allowed, axis=1)             # [C, TW]
+    inp = SolveInputs(
+        cap=jnp.asarray(catalog.cap),
+        tcode=jnp.asarray(catalog.tcode),
+        tnum=jnp.asarray(catalog.tnum),
+        tnum_present=jnp.asarray(catalog.tnum_present),
+        tzone=jnp.asarray(catalog.tzone),
+        tcap=jnp.asarray(catalog.tcap),
+        req=jnp.asarray(classes.req),
+        count=jnp.asarray(classes.count),
+        allowed=jnp.asarray(allowed),
+        num_lo=jnp.asarray(classes.num_lo),
+        num_hi=jnp.asarray(classes.num_hi),
+        azone=jnp.asarray(classes.azone),
+        acap=jnp.asarray(classes.acap),
+        schedulable=jnp.asarray(classes.schedulable),
+    )
+    return inp, offsets, words

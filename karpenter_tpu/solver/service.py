@@ -1,0 +1,177 @@
+"""TPU solver service: the decision-plane facade.
+
+Implements the same `schedule(scheduler, pods)` contract the Provisioner
+uses, backed by the batched JAX FFD (solver/ffd.py). Designed as the
+in-process version of the reference's out-of-process seam (SURVEY.md
+section 2.4 maps the cloud-RPC boundary to a gRPC solver service; the
+request/response here is already tensor-shaped for that move).
+
+Scope routing (v1): instances with stateful-constraint features the batch
+solver does not yet vectorize -- existing-node packing, topology spread,
+pod affinity, multi-term node affinity, multiple nodepools -- fall back to
+the Python oracle, which is authoritative. Everything else (the throughput
+path: many pods x one pool x full catalog) runs on the accelerator.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.apis import NodePool, Pod, labels as wk
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resources
+from karpenter_tpu.scheduling import resources as res
+from karpenter_tpu.solver import encode, ffd
+from karpenter_tpu.solver.encode import CatalogTensors
+from karpenter_tpu.solver.oracle import NewNodeGroup, Scheduler, SchedulingResult
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    """Round up to a power of two (compile-cache friendly)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class TPUSolver:
+    def __init__(self, g_max: int = 512, c_pad_min: int = 16):
+        self.g_max = g_max
+        self.c_pad_min = c_pad_min
+        self._cached_catalog_list = None   # strong ref: keeps the identity check sound
+        self._cached_tensors: Optional[CatalogTensors] = None
+        self._lock = threading.Lock()
+
+    # -- catalog staging ----------------------------------------------------
+    def catalog_tensors(self, instance_types: Sequence) -> CatalogTensors:
+        """Memoized by object identity. Holding a strong reference to the
+        keyed list makes the `is` check sound (a bare id() key could be
+        reused by a different list after GC)."""
+        with self._lock:
+            if self._cached_catalog_list is not instance_types:
+                self._cached_tensors = encode.encode_catalog(instance_types)
+                self._cached_catalog_list = instance_types
+            return self._cached_tensors
+
+    # -- routing ------------------------------------------------------------
+    @staticmethod
+    def supports(scheduler: Scheduler, pods: Sequence[Pod]) -> bool:
+        if scheduler.existing:
+            return False
+        if len(scheduler.nodepools) != 1:
+            return False
+        for p in pods:
+            if p.topology_spread or p.affinity_terms or len(p.node_affinity_terms) > 1:
+                return False
+        return True
+
+    # -- entry point (Provisioner contract) ---------------------------------
+    def schedule(self, scheduler: Scheduler, pods: Sequence[Pod]) -> SchedulingResult:
+        if not self.supports(scheduler, pods):
+            return scheduler.schedule(pods)
+        pool = scheduler.nodepools[0]
+        items = scheduler.instance_types.get(pool.name, [])
+        if not items:
+            result = SchedulingResult()
+            for p in pods:
+                result.unschedulable[p.metadata.name] = "no instance types for nodepool"
+            return result
+        return self.solve(pool, items, pods, nodepool_usage=scheduler.usage.get(pool.name))
+
+    # -- the batch solve ----------------------------------------------------
+    def solve(
+        self,
+        pool: NodePool,
+        instance_types: Sequence,
+        pods: Sequence[Pod],
+        nodepool_usage: Optional[Resources] = None,
+    ) -> SchedulingResult:
+        catalog = self.catalog_tensors(instance_types)
+        pool_reqs = pool.requirements()
+        classes = encode.group_pods(pods, extra_requirements=pool_reqs)
+        class_set = encode.encode_classes(
+            classes,
+            catalog,
+            pool_taints=list(pool.template.taints),
+            c_pad=_bucket(len(classes), self.c_pad_min),
+        )
+        inp, offsets, words = ffd.make_inputs(catalog, class_set)
+        out = ffd.ffd_solve(inp, g_max=self.g_max, word_offsets=offsets, words=words)
+        return self._decode(pool, instance_types, catalog, class_set, out, nodepool_usage)
+
+    def _decode(
+        self,
+        pool: NodePool,
+        instance_types: Sequence,
+        catalog: CatalogTensors,
+        class_set,
+        out: ffd.SolveOutputs,
+        nodepool_usage: Optional[Resources],
+    ) -> SchedulingResult:
+        result = SchedulingResult()
+        take = np.asarray(out.take)                    # [C, G]
+        unplaced = np.asarray(out.unplaced)            # [C]
+        n_open = int(out.n_open)
+        gmask = np.asarray(out.gmask)                  # [G, K]
+        gzone = np.asarray(out.gzone)
+        gcap = np.asarray(out.gcap)
+        by_name = {it.name: it for it in instance_types}
+        captype_names = [wk.CAPACITY_TYPE_RESERVED, wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND]
+
+        usage = nodepool_usage if nodepool_usage is not None else Resources()
+        limited = pool.limits is not None
+
+        for g in range(n_open):
+            classes_on_g = np.nonzero(take[:, g] > 0)[0]
+            if classes_on_g.size == 0:
+                continue
+            group_pods: List[Pod] = []
+            reqs = pool.requirements()
+            requested = Resources.from_base_units({res.PODS: 0})
+            for c in classes_on_g:
+                pc = class_set.classes[c]
+                n = int(take[c, g])
+                already = int(take[c, :g].sum())
+                group_pods.extend(pc.pods[already : already + n])
+                reqs.add(*pc.requirements)
+                for p in pc.pods[already : already + n]:
+                    requested = requested + p.requests + Resources.from_base_units({res.PODS: 1})
+            type_names = [catalog.names[k] for k in np.nonzero(gmask[g][: catalog.k_real])[0]]
+            group_types = [by_name[n] for n in type_names if n in by_name]
+            if not group_types:
+                for p in group_pods:
+                    result.unschedulable[p.metadata.name] = "no surviving instance type"
+                continue
+            zones = [catalog.zones[z] for z in np.nonzero(gzone[g][: len(catalog.zones)])[0]]
+            captypes = [captype_names[i] for i in np.nonzero(gcap[g])[0]]
+            if zones:
+                reqs.add(Requirement(wk.ZONE_LABEL, Operator.IN, zones))
+            if captypes:
+                reqs.add(Requirement(wk.CAPACITY_TYPE_LABEL, Operator.IN, captypes))
+            # nodepool limits (host-side guard, mirroring the oracle)
+            if limited:
+                smallest = min(group_types, key=lambda it: it.capacity.get(res.CPU))
+                if not (usage + smallest.capacity).fits(pool.limits):
+                    for p in group_pods:
+                        result.unschedulable[p.metadata.name] = f"nodepool {pool.name} limits exceeded"
+                    continue
+                usage = usage + smallest.capacity
+            result.new_groups.append(
+                NewNodeGroup(
+                    nodepool=pool,
+                    requirements=reqs,
+                    instance_types=sorted(group_types, key=lambda it: it.cheapest_price()),
+                    taints=list(pool.template.taints) + list(pool.template.startup_taints),
+                    pods=group_pods,
+                    requested=requested,
+                )
+            )
+        for c in range(class_set.c_real):
+            n_un = int(unplaced[c])
+            if n_un > 0:
+                pc = class_set.classes[c]
+                placed = int(take[c].sum())
+                for p in pc.pods[placed : placed + n_un]:
+                    result.unschedulable[p.metadata.name] = "no instance type fits pod requirements"
+        return result

@@ -1,0 +1,231 @@
+"""TPU solver tests: encoding correctness, device/host compat parity, and
+differential FFD equivalence against the Python oracle on randomized
+instances (the solver's correctness contract, SURVEY.md section 7 step 5)."""
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass, labels as wk
+from karpenter_tpu.apis.nodeclass import SubnetStatus
+from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+from karpenter_tpu.kwok.cloud import FakeCloud
+from karpenter_tpu.providers.instancetype import gen_catalog
+from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+from karpenter_tpu.providers.instancetype.types import Resolver
+from karpenter_tpu.providers.pricing import PricingProvider
+from karpenter_tpu.scheduling import Operator as Op, Requirement, Resources, Taint, Toleration
+from karpenter_tpu.scheduling import resources as res
+from karpenter_tpu.solver import encode, ffd
+from karpenter_tpu.solver.oracle import Scheduler
+from karpenter_tpu.solver.service import TPUSolver
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in gen_catalog.ZONES},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return prov.list(nc)
+
+
+@pytest.fixture(scope="module")
+def catalog(catalog_items):
+    return encode.encode_catalog(catalog_items)
+
+
+def make_pod(name, cpu, mem_gi, labels=None, node_selector=None, tolerations=()):
+    return Pod(
+        name,
+        requests=Resources({"cpu": cpu, "memory": f"{mem_gi}Gi"}),
+        labels=labels,
+        node_selector=node_selector,
+        tolerations=list(tolerations),
+    )
+
+
+class TestEncoding:
+    def test_catalog_shapes(self, catalog):
+        assert catalog.k_real >= 550
+        assert catalog.k_pad % 128 == 0
+        assert catalog.cap.shape == (catalog.k_pad, encode.R)
+        # padding rows are zero-capacity
+        assert catalog.cap[catalog.k_real :].sum() == 0
+        # memory scaled to MiB: all values small exact ints
+        assert catalog.cap.max() < 2**24
+
+    def test_prices_finite_only_for_offerings(self, catalog):
+        finite = np.isfinite(catalog.price)
+        assert finite.any()
+        assert not finite[catalog.k_real :].any()
+
+    def test_compat_host_matches_device(self, catalog, catalog_items):
+        pods = [
+            make_pod("a", "1", 2),
+            make_pod("b", "2", 4, node_selector={wk.ARCH_LABEL: "arm64"}),
+            make_pod("c", "1", 1, node_selector={wk.LABEL_INSTANCE_CATEGORY: "c"}),
+        ]
+        pool = NodePool("default")
+        classes = encode.group_pods(pods, extra_requirements=pool.requirements())
+        cs = encode.encode_classes(classes, catalog)
+        host = encode.compat_matrix(catalog, cs)
+        inp, offsets, words = ffd.make_inputs(catalog, cs)
+        out = ffd.ffd_solve(inp, g_max=16, word_offsets=offsets, words=words)
+        device = np.asarray(out.compat)
+        np.testing.assert_array_equal(host, device)
+
+    def test_compat_respects_requirements(self, catalog, catalog_items):
+        pods = [make_pod("arm", "1", 2, node_selector={wk.ARCH_LABEL: "arm64"})]
+        classes = encode.group_pods(pods)
+        cs = encode.encode_classes(classes, catalog)
+        compat = encode.compat_matrix(catalog, cs)
+        for k, it in enumerate(catalog_items):
+            expected = it.requirements.labels()[wk.ARCH_LABEL] == "arm64"
+            assert compat[0, k] == expected, it.name
+
+    def test_gt_requirement_numeric_window(self, catalog, catalog_items):
+        pod = Pod("big", requests=Resources({"cpu": "1"}))
+        pool = NodePool("p", requirements=[Requirement(wk.LABEL_INSTANCE_CPU, Op.GT, ["8"])])
+        classes = encode.group_pods([pod], extra_requirements=pool.requirements())
+        cs = encode.encode_classes(classes, catalog)
+        compat = encode.compat_matrix(catalog, cs)
+        for k, it in enumerate(catalog_items):
+            expected = it.info.vcpu > 8
+            assert compat[0, k] == expected, it.name
+
+
+def _oracle_and_solver(pool, items, pods):
+    sched_oracle = Scheduler(
+        nodepools=[pool],
+        instance_types={pool.name: items},
+        zones={o.zone for it in items for o in it.available_offerings()},
+    )
+    oracle_result = sched_oracle.schedule(list(pods))
+    solver = TPUSolver(g_max=256)
+    solver_result = solver.solve(pool, items, list(pods))
+    return oracle_result, solver_result
+
+
+def _signature(result):
+    """Order-insensitive packing signature: per-group sorted pod names."""
+    return sorted(tuple(sorted(p.metadata.name for p in g.pods)) for g in result.new_groups)
+
+
+class TestDifferentialFFD:
+    def test_uniform_small_pods(self, catalog_items):
+        pool = NodePool("default")
+        pods = [make_pod(f"p{i}", "250m", 1) for i in range(50)]
+        o, s = _oracle_and_solver(pool, catalog_items, pods)
+        assert not o.unschedulable and not s.unschedulable
+        assert len(o.new_groups) == len(s.new_groups)
+        assert _signature(o) == _signature(s)
+
+    def test_mixed_sizes(self, catalog_items):
+        pool = NodePool("default")
+        pods = (
+            [make_pod(f"s{i}", "100m", 0.25) for i in range(30)]
+            + [make_pod(f"m{i}", "2", 4) for i in range(10)]
+            + [make_pod(f"l{i}", "15", 60) for i in range(4)]
+        )
+        o, s = _oracle_and_solver(pool, catalog_items, pods)
+        assert len(o.new_groups) == len(s.new_groups)
+        assert _signature(o) == _signature(s)
+
+    def test_constrained_pool(self, catalog_items):
+        pool = NodePool(
+            "default",
+            requirements=[
+                Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"]),
+                Requirement(wk.LABEL_INSTANCE_CATEGORY, Op.IN, ["c", "m"]),
+                Requirement(wk.CAPACITY_TYPE_LABEL, Op.IN, ["on-demand"]),
+            ],
+        )
+        pods = [make_pod(f"p{i}", "1", 2) for i in range(20)]
+        o, s = _oracle_and_solver(pool, catalog_items, pods)
+        assert len(o.new_groups) == len(s.new_groups)
+        assert _signature(o) == _signature(s)
+        for g in s.new_groups:
+            for it in g.instance_types:
+                assert it.info.arch == "amd64" and it.info.category in ("c", "m")
+
+    def test_zone_pinned_pods(self, catalog_items):
+        pool = NodePool("default")
+        zones = sorted({o.zone for it in catalog_items for o in it.offerings})
+        pods = [
+            make_pod(f"p{i}", "500m", 1, node_selector={wk.ZONE_LABEL: zones[i % 2]})
+            for i in range(12)
+        ]
+        o, s = _oracle_and_solver(pool, catalog_items, pods)
+        assert len(o.new_groups) == len(s.new_groups)
+        assert _signature(o) == _signature(s)
+
+    def test_unschedulable_matches(self, catalog_items):
+        pool = NodePool("default")
+        pods = [make_pod("huge", "900", 4000), make_pod("ok", "1", 2)]
+        o, s = _oracle_and_solver(pool, catalog_items, pods)
+        assert set(o.unschedulable) == set(s.unschedulable) == {"huge"}
+
+    def test_taint_intolerant_unschedulable(self, catalog_items):
+        pool = NodePool("default")
+        pool.template.taints = [Taint("dedicated", value="x")]
+        tolerant = make_pod("tol", "1", 2, tolerations=[Toleration(key="dedicated", value="x")])
+        intolerant = make_pod("intol", "1", 2)
+        o, s = _oracle_and_solver(pool, catalog_items, [tolerant, intolerant])
+        assert set(o.unschedulable) == set(s.unschedulable) == {"intol"}
+        assert len(o.new_groups) == len(s.new_groups) == 1
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5, 6, 7])
+    def test_randomized(self, catalog_items, seed):
+        rng = np.random.default_rng(seed)
+        pool_req_choices = [
+            [],
+            [Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])],
+            [Requirement(wk.LABEL_INSTANCE_CATEGORY, Op.NOT_IN, ["g", "p", "acc", "x"])],
+            [Requirement(wk.CAPACITY_TYPE_LABEL, Op.IN, ["spot"])],
+        ]
+        pool = NodePool("default", requirements=pool_req_choices[seed % len(pool_req_choices)])
+        pods = []
+        n_shapes = int(rng.integers(2, 8))
+        zones = sorted({o.zone for it in catalog_items for o in it.offerings})
+        for shape in range(n_shapes):
+            cpu_m = int(rng.choice([100, 250, 500, 1000, 2000, 4000, 8000]))
+            mem_mi = int(rng.choice([128, 512, 1024, 4096, 16384]))
+            count = int(rng.integers(1, 20))
+            sel = None
+            if rng.random() < 0.3:
+                sel = {wk.ZONE_LABEL: str(rng.choice(zones))}
+            for i in range(count):
+                pods.append(
+                    Pod(
+                        f"r{shape}-{i}",
+                        requests=Resources({"cpu": cpu_m, "memory": float(mem_mi * 2**20)}),
+                        node_selector=sel,
+                    )
+                )
+        o, s = _oracle_and_solver(pool, catalog_items, pods)
+        assert set(o.unschedulable) == set(s.unschedulable), f"seed {seed}"
+        assert len(o.new_groups) == len(s.new_groups), f"seed {seed}"
+        assert _signature(o) == _signature(s), f"seed {seed}"
+
+
+class TestSolverInProvisioner:
+    def test_solver_backed_end_to_end(self):
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.operator import Operator
+
+        op = Operator(clock=FakeClock(1.0), solver=TPUSolver(g_max=128))
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        for i in range(12):
+            op.cluster.create(make_pod(f"p{i}", "500m", 1))
+        op.settle(max_ticks=20)
+        assert not op.cluster.pending_pods()
