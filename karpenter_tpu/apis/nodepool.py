@@ -31,8 +31,12 @@ class Budget:
 
     def allowed(self, total_nodes: int) -> int:
         if self.nodes.endswith("%"):
+            import math
+
             pct = float(self.nodes[:-1]) / 100.0
-            return int(total_nodes * pct)
+            # percentages scale up (k8s intstr semantics): "10%" of a
+            # 1-node pool permits 1 disruption, not 0
+            return math.ceil(total_nodes * pct)
         return int(self.nodes)
 
 

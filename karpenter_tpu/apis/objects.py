@@ -34,7 +34,9 @@ class ObjectMeta:
     annotations: Dict[str, str] = field(default_factory=dict)
     finalizers: List[str] = field(default_factory=list)
     owner_references: List[str] = field(default_factory=list)  # uids
-    creation_timestamp: float = field(default_factory=now)
+    # 0.0 = unset; the cluster store stamps its (injectable) clock at create
+    # time -- a wall-clock default here would poison FakeClock age math
+    creation_timestamp: float = 0.0
     deletion_timestamp: Optional[float] = None
     resource_version: int = 0
     generation: int = 1

@@ -52,7 +52,9 @@ class Batcher(Generic[T, U]):
         hasher: Optional[Callable[[T], Hashable]] = None,
         clock: Optional[Clock] = None,
         background: bool = False,
+        name: str = "",
     ):
+        self.name = name
         self.exec_batch = exec_batch
         self.options = options or BatchOptions()
         self.hasher = hasher or (lambda item: 0)
@@ -118,9 +120,13 @@ class Batcher(Generic[T, U]):
         return len(due)
 
     def _execute(self, bucket: _Bucket) -> None:
+        from karpenter_tpu import metrics
+
         self.batches_executed += 1
         self.items_executed += len(bucket.items)
         self.batch_sizes.append(len(bucket.items))
+        metrics.BATCH_SIZE.observe(len(bucket.items), api=self.name)
+        metrics.BATCH_WINDOW.observe(max(0.0, bucket.last_at - bucket.first_at), api=self.name)
         try:
             results = self.exec_batch(bucket.items)
             if len(results) != len(bucket.items):

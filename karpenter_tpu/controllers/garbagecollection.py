@@ -1,0 +1,52 @@
+"""Garbage collection: orphaned cloud instances and stale claims.
+
+Rebuilds pkg/controllers/nodeclaim/garbagecollection/controller.go:55-111:
+list cluster-owned cloud instances, subtract those with a live NodeClaim,
+and terminate the rest (instances whose claim was deleted out-of-band or
+whose creation never completed). A freshly-launched instance gets a grace
+window before it can be considered orphaned (its claim status may not have
+committed yet).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_tpu.apis import NodeClaim, Node
+from karpenter_tpu import metrics
+from karpenter_tpu.cloudprovider import CloudProvider
+from karpenter_tpu.errors import NotFoundError
+from karpenter_tpu.kwok.cluster import Cluster
+
+LAUNCH_GRACE = 60.0
+
+
+class GarbageCollectionController:
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+
+    def reconcile(self) -> List[str]:
+        """Returns terminated instance ids."""
+        now = self.cluster.clock.now()
+        claimed = {c.provider_id for c in self.cluster.list(NodeClaim) if c.provider_id}
+        nodes_by_provider = {n.provider_id: n for n in self.cluster.list(Node) if n.provider_id}
+        removed = []
+        for inst in self.cloud_provider.list_instances():
+            if inst.provider_id in claimed:
+                continue
+            if now - inst.launch_time < LAUNCH_GRACE:
+                continue
+            try:
+                # instance-level delete (there is no claim to route through
+                # CloudProvider.delete); the instance provider still does the
+                # reservation bookkeeping
+                self.cloud_provider.instances.delete(inst.id)
+                removed.append(inst.id)
+            except NotFoundError:
+                pass
+            node = nodes_by_provider.get(inst.provider_id)
+            if node is not None:
+                self.cluster.unbind_pods(node.metadata.name)
+                node.metadata.finalizers = []
+                self.cluster.delete(Node, node.metadata.name)
+        return removed

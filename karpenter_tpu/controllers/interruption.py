@@ -1,0 +1,133 @@
+"""Interruption controller: queue events -> node drain.
+
+Rebuilds pkg/controllers/interruption/controller.go:96-248 + parser.go +
+messages/: polls the interruption queue, parses the five message kinds
+(spot interruption, scheduled maintenance/health change, instance state
+change, rebalance recommendation, noop), marks spot capacity unavailable in
+the ICE cache so the scheduler routes around it
+(:219-225), deletes the affected NodeClaim (cordon-and-drain), and deletes
+the message. Parsing fans out over a worker pool in the reference (:119);
+here messages are processed in one synchronous sweep per reconcile with the
+same per-message isolation (a bad message never blocks the batch).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import List, Optional
+
+from karpenter_tpu.apis import NodeClaim, Node, labels as wk
+from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+from karpenter_tpu import metrics
+from karpenter_tpu.events import Recorder, WARNING
+from karpenter_tpu.cloud.api import QueueAPI
+from karpenter_tpu.kwok.cluster import Cluster
+
+KIND_SPOT_INTERRUPTION = "spot-interruption"
+KIND_SCHEDULED_CHANGE = "scheduled-change"
+KIND_STATE_CHANGE = "state-change"
+KIND_REBALANCE = "rebalance-recommendation"
+KIND_NOOP = "noop"
+
+# state-change states that warrant replacing the node
+_TERMINAL_STATES = {"stopping", "stopped", "shutting-down", "terminated"}
+
+
+@dataclass
+class ParsedMessage:
+    kind: str
+    instance_id: str = ""
+    zone: str = ""
+    state: str = ""
+
+
+def parse_message(body: str) -> ParsedMessage:
+    """Message taxonomy (reference: parser.go:1-93 + messages/*): unknown
+    shapes degrade to noop rather than erroring the batch."""
+    try:
+        doc = json.loads(body)
+    except (json.JSONDecodeError, TypeError):
+        return ParsedMessage(kind=KIND_NOOP)
+    kind = doc.get("kind", "")
+    instance_id = doc.get("instance_id", "")
+    if kind == KIND_SPOT_INTERRUPTION and instance_id:
+        return ParsedMessage(KIND_SPOT_INTERRUPTION, instance_id, doc.get("zone", ""))
+    if kind == KIND_SCHEDULED_CHANGE and instance_id:
+        return ParsedMessage(KIND_SCHEDULED_CHANGE, instance_id)
+    if kind == KIND_STATE_CHANGE and instance_id:
+        return ParsedMessage(KIND_STATE_CHANGE, instance_id, state=doc.get("state", ""))
+    if kind == KIND_REBALANCE and instance_id:
+        return ParsedMessage(KIND_REBALANCE, instance_id)
+    return ParsedMessage(kind=KIND_NOOP)
+
+
+class InterruptionController:
+    def __init__(
+        self,
+        cluster: Cluster,
+        queue: QueueAPI,
+        unavailable: UnavailableOfferings,
+        recorder: Optional[Recorder] = None,
+    ):
+        self.cluster = cluster
+        self.queue = queue
+        self.unavailable = unavailable
+        self.recorder = recorder or Recorder()
+
+    def reconcile(self, max_messages: int = 10) -> int:
+        """One poll sweep; returns messages handled. The reference requeues
+        immediately while messages remain (:114-136); callers loop."""
+        handled = 0
+        while True:
+            batch = self.queue.receive(max_messages)
+            if not batch:
+                return handled
+            for msg in batch:
+                parsed = parse_message(msg.body)
+                metrics.INTERRUPTION_RECEIVED.inc(kind=parsed.kind)
+                try:
+                    self._handle(parsed)
+                except Exception as e:  # noqa: BLE001 -- per-message isolation:
+                    # one bad message must not strand the rest of the batch
+                    self.recorder.publish(
+                        ParsedMessage(parsed.kind), "InterruptionHandlingFailed", str(e), type=WARNING
+                    )
+                finally:
+                    self.queue.delete(msg.receipt)
+                    metrics.INTERRUPTION_DELETED.inc()
+                handled += 1
+
+    # -- handling -----------------------------------------------------------
+    def _claim_for_instance(self, instance_id: str) -> Optional[NodeClaim]:
+        suffix = f"/{instance_id}"
+        for claim in self.cluster.list(NodeClaim):
+            if claim.provider_id.endswith(suffix):
+                return claim
+        return None
+
+    def _handle(self, parsed: ParsedMessage) -> None:
+        if parsed.kind == KIND_NOOP:
+            return
+        claim = self._claim_for_instance(parsed.instance_id)
+        if claim is None:
+            return
+        if parsed.kind == KIND_STATE_CHANGE and parsed.state not in _TERMINAL_STATES:
+            return
+        if parsed.kind == KIND_REBALANCE:
+            # advisory only: record, do not disrupt (reference treats
+            # rebalance recommendations as events unless configured)
+            self.recorder.publish(claim, "RebalanceRecommendation", "capacity may be reclaimed soon")
+            return
+        if parsed.kind == KIND_SPOT_INTERRUPTION:
+            # the pool is being reclaimed: negative-cache it so the
+            # scheduler stops offering this (type, zone, spot) pool (:219-225)
+            itype = claim.instance_type
+            zone = parsed.zone or claim.zone
+            if itype and zone:
+                self.unavailable.mark_unavailable(itype, zone, wk.CAPACITY_TYPE_SPOT, reason="SpotInterruption")
+        self.recorder.publish(claim, "Interrupted", f"{parsed.kind} for {parsed.instance_id}", type=WARNING)
+        if not claim.deleting:
+            self.cluster.delete(NodeClaim, claim.metadata.name)
+            metrics.NODECLAIMS_TERMINATED.inc(
+                nodepool=claim.nodepool_name or "", reason="interruption"
+            )

@@ -49,6 +49,7 @@ class NodeClassController:
         images: ImageProvider,
         launch_templates=None,
         clock=None,
+        capacity_reservations=None,
     ):
         self.cluster = cluster
         self.compute_api = compute_api
@@ -58,6 +59,7 @@ class NodeClassController:
         self.images = images
         self.launch_templates = launch_templates
         self.clock = clock
+        self.capacity_reservations = capacity_reservations
 
     def reconcile_all(self) -> None:
         for nc in self.cluster.list(TPUNodeClass):
@@ -102,7 +104,14 @@ class NodeClassController:
             return
         now = self.cluster.clock.now()
         out: List[CapacityReservationStatus] = []
-        for cr in self.compute_api.describe_capacity_reservations():
+        # read through the reservation provider when wired: its refresh
+        # clears the in-memory launch/terminate deltas in the same motion,
+        # so described counts and deltas never double-count
+        if self.capacity_reservations is not None:
+            reservations = self.capacity_reservations.list()
+        else:
+            reservations = self.compute_api.describe_capacity_reservations()
+        for cr in reservations:
             if cr.end_time is not None and cr.end_time <= now:
                 continue
             if not any(t.matches(id=cr.id, tags=cr.tags) for t in nc.capacity_reservation_selector_terms):

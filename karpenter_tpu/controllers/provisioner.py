@@ -10,7 +10,10 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import time
+
 from karpenter_tpu.apis import NodeClaim, NodePool, Node, labels as wk
+from karpenter_tpu import metrics
 from karpenter_tpu.apis.nodeclass import HASH_ANNOTATION, HASH_VERSION, HASH_VERSION_ANNOTATION, TPUNodeClass
 from karpenter_tpu.apis.objects import generate_name
 from karpenter_tpu.cloudprovider import CloudProvider
@@ -103,10 +106,13 @@ class Provisioner:
             nodepool_usage={p.name: self.cluster.nodepool_usage(p.name) for p in nodepools},
             zones=zones,
         )
+        t0 = time.perf_counter()
         if self.solver is not None:
             result = self.solver.schedule(scheduler, pods)
         else:
             result = scheduler.schedule(pods)
+        metrics.SCHEDULING_DURATION.observe(time.perf_counter() - t0)
+        metrics.IGNORED_PODS.set(len(result.unschedulable))
         self._launch(result)
         self.last_result = result
         return result
@@ -119,6 +125,7 @@ class Provisioner:
             try:
                 self.cloud_provider.create(claim)
                 self.cluster.update(claim)
+                metrics.NODECLAIMS_CREATED.inc(nodepool=group.nodepool.name)
             except CloudError as e:
                 # ICE already recorded by the instance provider; drop the
                 # claim so the next tick re-simulates around it

@@ -1,0 +1,61 @@
+"""Event recorder.
+
+The reference emits k8s Events through an events.Recorder for every notable
+lifecycle action (pkg/cloudprovider/events/events.go,
+pkg/controllers/interruption/events/events.go). This in-memory recorder
+keeps the same shape: typed events attached to objects, deduplicated within
+a window, queryable by tests.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from karpenter_tpu.cache.ttl import Clock
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    kind: str            # object kind
+    name: str            # object name
+    type: str            # Normal | Warning
+    reason: str
+    message: str
+    timestamp: float = 0.0
+    count: int = 1
+
+
+class Recorder:
+    def __init__(self, clock: Optional[Clock] = None, dedupe_window: float = 60.0):
+        self.clock = clock or Clock()
+        self.dedupe_window = dedupe_window
+        self._lock = threading.Lock()
+        self.events: List[Event] = []
+
+    def publish(self, obj, reason: str, message: str = "", type: str = NORMAL) -> None:
+        event_type = type
+        kind = getattr(obj, "KIND", "Object")
+        name = getattr(obj, "name", str(obj))
+        now = self.clock.now()
+        with self._lock:
+            for e in reversed(self.events[-50:]):
+                if (
+                    e.kind == kind and e.name == name and e.reason == reason
+                    and now - e.timestamp < self.dedupe_window
+                ):
+                    e.count += 1
+                    return
+            self.events.append(
+                Event(kind=kind, name=name, type=event_type, reason=reason, message=message, timestamp=now)
+            )
+
+    def for_object(self, obj) -> List[Event]:
+        name = getattr(obj, "name", str(obj))
+        return [e for e in self.events if e.name == name]
+
+    def with_reason(self, reason: str) -> List[Event]:
+        return [e for e in self.events if e.reason == reason]

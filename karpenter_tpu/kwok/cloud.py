@@ -216,6 +216,24 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
             self._capacity_pools[key] = remaining - 1
             return True
 
+    def _reservation_take(self, reservation_id: str) -> bool:
+        """Consume one reservation slot (the real cloud decrements reservation
+        availability as instances launch into it; Describe reflects it)."""
+        with self._lock:
+            for r in self._reservations:
+                if r.id == reservation_id:
+                    if r.available_count <= 0:
+                        return False
+                    r.available_count -= 1
+                    return True
+            return False
+
+    def _reservation_release(self, reservation_id: str) -> None:
+        with self._lock:
+            for r in self._reservations:
+                if r.id == reservation_id and r.available_count < r.total_count:
+                    r.available_count += 1
+
     def _score(self, instance_type: str, capacity_type: str, zone: str) -> float:
         """Lowest-price strategy (kwok/strategy/strategy.go:28-60)."""
         info = self._types_by_name.get(instance_type)
@@ -253,6 +271,18 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
                         FleetError(
                             code=ICE_CODE,
                             message=f"no {request.capacity_type} capacity for {o.instance_type} in {o.zone}",
+                            instance_type=o.instance_type,
+                            zone=o.zone,
+                            capacity_type=request.capacity_type,
+                        )
+                    )
+                    continue
+                if o.capacity_reservation_id and not self._reservation_take(o.capacity_reservation_id):
+                    exhausted.add(key)
+                    errors.append(
+                        FleetError(
+                            code="ReservationCapacityExceeded",
+                            message=f"reservation {o.capacity_reservation_id} exhausted",
                             instance_type=o.instance_type,
                             zone=o.zone,
                             capacity_type=request.capacity_type,
@@ -303,12 +333,17 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
     def terminate_instances(self, ids: Sequence[str]) -> List[str]:
         self._enter("terminate_instances")
         done = []
+        released = []
         with self._lock:
             for iid in ids:
                 inst = self._instances.get(iid)
                 if inst and inst.state not in ("terminated",):
                     inst.state = "terminated"
                     done.append(iid)
+                    if inst.capacity_reservation_id:
+                        released.append(inst.capacity_reservation_id)
+        for rid in released:
+            self._reservation_release(rid)
         return done
 
     def create_tags(self, resource_id: str, tags: Dict[str, str]) -> None:

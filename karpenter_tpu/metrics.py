@@ -1,0 +1,185 @@
+"""Metrics registry: Prometheus-shaped counters/gauges/histograms.
+
+The reference's observability is Prometheus-first (SURVEY.md section 5):
+SDK-call middleware, batcher window/size metrics, instance-type gauges,
+interruption counters, and the scheduler's
+karpenter_scheduler_scheduling_duration_seconds. This registry provides the
+same surface in-process with text exposition; no external client library.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class _Metric:
+    def __init__(self, name: str, help: str, label_names: Tuple[str, ...]):
+        self.name = name
+        self.help = help
+        self.label_names = label_names
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name, help, label_names=()):
+        super().__init__(name, help, tuple(label_names))
+        self._values: Dict[tuple, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(labels.get(l, "") for l in self.label_names)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        key = tuple(labels.get(l, "") for l in self.label_names)
+        return self._values.get(key, 0.0)
+
+    def collect(self):
+        for key, v in self._values.items():
+            yield key, v, "counter"
+
+
+class Gauge(_Metric):
+    def __init__(self, name, help, label_names=()):
+        super().__init__(name, help, tuple(label_names))
+        self._values: Dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(labels.get(l, "") for l in self.label_names)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def value(self, **labels) -> float:
+        key = tuple(labels.get(l, "") for l in self.label_names)
+        return self._values.get(key, 0.0)
+
+    def collect(self):
+        for key, v in self._values.items():
+            yield key, v, "gauge"
+
+
+class Histogram(_Metric):
+    def __init__(self, name, help, label_names=(), buckets: Iterable[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help, tuple(label_names))
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[tuple, List[int]] = {}
+        self._sums: Dict[tuple, float] = {}
+        self._totals: Dict[tuple, int] = {}
+        self._samples: Dict[tuple, List[float]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(labels.get(l, "") for l in self.label_names)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+            samples = self._samples.setdefault(key, [])
+            samples.append(value)
+            if len(samples) > 10_000:
+                del samples[: len(samples) // 2]
+
+    def percentile(self, q: float, **labels) -> float:
+        key = tuple(labels.get(l, "") for l in self.label_names)
+        samples = sorted(self._samples.get(key, []))
+        if not samples:
+            return math.nan
+        idx = min(len(samples) - 1, max(0, math.ceil(q / 100.0 * len(samples)) - 1))
+        return samples[idx]
+
+    def collect(self):
+        for key, total in self._totals.items():
+            yield key, total, "histogram"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help: str = "", labels=()) -> Counter:
+        return self._register(name, lambda: Counter(name, help, labels))
+
+    def gauge(self, name: str, help: str = "", labels=()) -> Gauge:
+        return self._register(name, lambda: Gauge(name, help, labels))
+
+    def histogram(self, name: str, help: str = "", labels=(), buckets=_DEFAULT_BUCKETS) -> Histogram:
+        return self._register(name, lambda: Histogram(name, help, labels, buckets))
+
+    def _register(self, name, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = factory()
+            return m
+
+    def expose(self) -> str:
+        """Prometheus text exposition."""
+        out = []
+        for name, m in sorted(self._metrics.items()):
+            out.append(f"# HELP {name} {m.help}")
+            if isinstance(m, Histogram):
+                out.append(f"# TYPE {name} histogram")
+                for key, total in m._totals.items():
+                    lbl = _labels_str(m.label_names, key)
+                    cum = 0
+                    for i, b in enumerate(m.buckets):
+                        cum = m._counts[key][i]
+                        le = _labels_str(m.label_names + ("le",), key + (repr(b),))
+                        out.append(f"{name}_bucket{le} {cum}")
+                    inf = _labels_str(m.label_names + ("le",), key + ("+Inf",))
+                    out.append(f"{name}_bucket{inf} {total}")
+                    out.append(f"{name}_sum{lbl} {m._sums[key]}")
+                    out.append(f"{name}_count{lbl} {total}")
+            else:
+                kind = "counter" if isinstance(m, Counter) else "gauge"
+                out.append(f"# TYPE {name} {kind}")
+                for key, v, _ in m.collect():
+                    out.append(f"{name}{_labels_str(m.label_names, key)} {v}")
+        return "\n".join(out) + "\n"
+
+
+def _labels_str(names, values) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+# process-global registry (controller-runtime registry analogue)
+REGISTRY = Registry()
+
+# well-known metrics (names mirror the reference's metric families)
+SCHEDULING_DURATION = REGISTRY.histogram(
+    "karpenter_scheduler_scheduling_duration_seconds",
+    "Duration of one scheduling simulation",
+)
+BATCH_SIZE = REGISTRY.histogram(
+    "karpenter_cloud_batcher_batch_size", "Items per coalesced cloud call", labels=("api",),
+    buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000),
+)
+BATCH_WINDOW = REGISTRY.histogram(
+    "karpenter_cloud_batcher_batch_time_seconds", "Batch window duration", labels=("api",),
+)
+INTERRUPTION_RECEIVED = REGISTRY.counter(
+    "karpenter_interruption_received_messages_total", "Interruption messages by kind", labels=("kind",),
+)
+INTERRUPTION_DELETED = REGISTRY.counter(
+    "karpenter_interruption_deleted_messages_total", "Interruption messages deleted",
+)
+NODECLAIMS_CREATED = REGISTRY.counter(
+    "karpenter_nodeclaims_created_total", "NodeClaims created", labels=("nodepool",),
+)
+NODECLAIMS_TERMINATED = REGISTRY.counter(
+    "karpenter_nodeclaims_terminated_total", "NodeClaims terminated", labels=("nodepool", "reason"),
+)
+INSTANCE_TYPE_COUNT = REGISTRY.gauge(
+    "karpenter_cloudprovider_instance_type_offering_available",
+    "Catalog size by nodeclass", labels=("nodeclass",),
+)
+IGNORED_PODS = REGISTRY.gauge("karpenter_ignored_pod_count", "Pods the scheduler cannot place")

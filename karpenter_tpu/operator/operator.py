@@ -13,11 +13,26 @@ from typing import Optional
 from karpenter_tpu.cache.ttl import Clock, FakeClock
 from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
 from karpenter_tpu.cloudprovider import CloudProvider
+from karpenter_tpu.controllers.disruption import DisruptionController
+from karpenter_tpu.controllers.garbagecollection import GarbageCollectionController
+from karpenter_tpu.controllers.interruption import InterruptionController
 from karpenter_tpu.controllers.nodeclass import NodeClassController
+from karpenter_tpu.controllers.providers import (
+    CapacityReservationExpirationController,
+    DiscoveredCapacityController,
+    ImageCacheInvalidationController,
+    InstanceTypeRefreshController,
+    PricingRefreshController,
+    VersionController,
+)
 from karpenter_tpu.controllers.provisioner import PodBinder, Provisioner
+from karpenter_tpu.controllers.tagging import TaggingController
+from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.events import Recorder
 from karpenter_tpu.kwok.cloud import FakeCloud
 from karpenter_tpu.kwok.cluster import Cluster
 from karpenter_tpu.kwok.lifecycle import NodeLifecycle
+from karpenter_tpu.providers.capacityreservation import CapacityReservationProvider
 from karpenter_tpu.providers.image import ImageProvider
 from karpenter_tpu.providers.instance import InstanceProvider
 from karpenter_tpu.providers.instancetype import gen_catalog
@@ -58,14 +73,19 @@ class Operator:
         self.cloud = cloud or FakeCloud(clock=self.clock)
         self.cluster = Cluster(clock=self.clock)
 
+        self.recorder = Recorder(self.clock)
+
         # providers, each with its dedicated caches (operator.go:126-186)
         self.unavailable = UnavailableOfferings(self.clock)
         self.pricing = PricingProvider(self.cloud, self.cloud, self.options.region)
         self.subnets = SubnetProvider(self.cloud, self.clock)
         self.security_groups = SecurityGroupProvider(self.cloud, self.clock)
         self.images = ImageProvider(self.cloud, self.cloud, self.clock)
+        self.capacity_reservations = CapacityReservationProvider(self.cloud, self.clock)
         zone_ids = {z.name: z.zone_id for z in self.cloud.describe_zones()}
-        self.offerings = OfferingsBuilder(self.pricing, self.unavailable, zone_ids)
+        self.offerings = OfferingsBuilder(
+            self.pricing, self.unavailable, zone_ids, self.capacity_reservations
+        )
         self.resolver = Resolver(self.options.region, self.options.vm_memory_overhead_percent)
         self.instance_types = InstanceTypeProvider(
             self.cloud, self.resolver, self.offerings, self.unavailable, self.clock
@@ -75,27 +95,57 @@ class Operator:
         )
         self.instances = InstanceProvider(
             self.cloud, self.subnets, self.launch_templates, self.unavailable,
+            capacity_reservations=self.capacity_reservations,
             cluster_name=self.options.cluster_name,
         )
         self.cloud_provider = CloudProvider(self.cluster, self.instance_types, self.instances)
 
-        # controllers
+        # controllers (the NewControllers bundle, controllers.go:65-110)
         self.nodeclass_controller = NodeClassController(
             self.cluster, self.cloud, self.cloud, self.subnets, self.security_groups,
             self.images, self.launch_templates, self.clock,
+            capacity_reservations=self.capacity_reservations,
         )
         self.provisioner = Provisioner(self.cluster, self.cloud_provider, solver=solver)
         self.binder = PodBinder(self.cluster)
         self.lifecycle = NodeLifecycle(self.cluster, self.cloud)
+        self.termination = TerminationController(self.cluster, self.cloud_provider)
+        self.disruption = DisruptionController(
+            self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates
+        )
+        self.interruption = InterruptionController(
+            self.cluster, self.cloud, self.unavailable, self.recorder
+        )
+        self.garbage_collection = GarbageCollectionController(self.cluster, self.cloud_provider)
+        self.tagging = TaggingController(self.cluster, self.cloud_provider)
+        self.instance_type_refresh = InstanceTypeRefreshController(self.instance_types, self.clock)
+        self.pricing_refresh = PricingRefreshController(self.pricing, self.clock)
+        self.discovered_capacity = DiscoveredCapacityController(self.cluster, self.instance_types)
+        self.version_controller = VersionController(self.cloud, self.clock)
+        self.image_invalidation = ImageCacheInvalidationController(self.images, self.cloud)
+        self.reservation_expiration = CapacityReservationExpirationController(
+            self.cluster, self.capacity_reservations
+        )
 
     # -- convenience loop for tests/rig -------------------------------------
     def tick(self) -> None:
-        """One controller-manager sweep: status -> provision -> lifecycle ->
-        bind. Step the clock between ticks to advance node registration."""
+        """One controller-manager sweep. Order mirrors the reconcile flow:
+        status resolution -> events -> provisioning -> node lifecycle ->
+        binding -> post-launch bookkeeping -> drain/teardown -> GC."""
         self.nodeclass_controller.reconcile_all()
+        self.instance_type_refresh.reconcile()
+        self.pricing_refresh.reconcile()
+        self.version_controller.reconcile()
+        self.reservation_expiration.reconcile_all()
+        self.interruption.reconcile()
         self.provisioner.reconcile()
         self.lifecycle.step()
         self.binder.reconcile()
+        self.tagging.reconcile_all()
+        self.discovered_capacity.reconcile_all()
+        self.disruption.reconcile()
+        self.termination.reconcile_all()
+        self.garbage_collection.reconcile()
 
     def settle(self, max_ticks: int = 20, step_seconds: float = 3.0) -> int:
         """Tick until no pending pods or budget exhausted; returns ticks."""

@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.capacityreservation.provider import CapacityReservationProvider
+
+__all__ = ["CapacityReservationProvider"]

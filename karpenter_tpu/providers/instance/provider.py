@@ -197,7 +197,7 @@ class InstanceProvider:
             for g in groups:
                 self.launch_templates.invalidate(g.template_name)
             return self._launch(nodeclass, claim, items, capacity_type, retried=True)
-        self._update_unavailable(result.errors, capacity_type)
+        self._update_unavailable(result.errors, capacity_type, reservation_id)
         if not result.instances:
             raise InsufficientCapacityError(
                 "; ".join(e.message for e in result.errors) or "fleet returned no instances"
@@ -208,12 +208,14 @@ class InstanceProvider:
             self.capacity_reservations.mark_launched(inst.capacity_reservation_id)
         return inst
 
-    def _update_unavailable(self, fleet_errors, capacity_type: str) -> None:
+    def _update_unavailable(self, fleet_errors, capacity_type: str, reservation_id=None) -> None:
         for e in fleet_errors:
             if is_unfulfillable_capacity(e.code) and e.instance_type and e.zone:
                 self.unavailable.mark_unavailable(
                     e.instance_type, e.zone, e.capacity_type or capacity_type, reason=e.code
                 )
+            if e.code == "ReservationCapacityExceeded" and reservation_id and self.capacity_reservations is not None:
+                self.capacity_reservations.mark_unavailable(reservation_id)
 
     # -- read / delete ------------------------------------------------------
     def get(self, instance_id: str) -> CloudInstance:

@@ -99,6 +99,7 @@ class InstanceTypeProvider:
             self.offerings_seq,
             self.unavailable.seq_num,
             self.offerings.pricing.seq_num,
+            getattr(self.offerings.capacity_reservations, "seq_num", 0),
             self._discovered_seq,
             kubelet_key,
         )
@@ -139,6 +140,11 @@ class InstanceTypeProvider:
                     )
                     break
         self._cache.set(key, items)
+        from karpenter_tpu import metrics
+
+        metrics.INSTANCE_TYPE_COUNT.set(
+            sum(1 for it in items if it.available_offerings()), nodeclass=nodeclass.name
+        )
         return items
 
 

@@ -1,0 +1,220 @@
+"""Resilience subsystems: interruption queue pipeline, garbage collection,
+tagging, capacity-reservation bookkeeping, refresh controllers, metrics and
+events (reference behaviors from SURVEY.md sections 2.2, 2.5, 5)."""
+import json
+
+import pytest
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Node, Pod, TPUNodeClass, labels as wk
+from karpenter_tpu.apis.nodeclass import SelectorTerm
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.cloud.types import CapacityReservationInfo
+from karpenter_tpu.controllers.interruption import parse_message
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.utils import parse_instance_id
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock(100_000.0)
+    op = Operator(clock=clock)
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    return op
+
+
+def provision(env, n=1, cpu="500m"):
+    pods = [Pod(f"p{i}", requests=Resources({"cpu": cpu, "memory": "1Gi"})) for i in range(n)]
+    for p in pods:
+        env.cluster.create(p)
+    env.settle(max_ticks=30)
+    assert not env.cluster.pending_pods()
+    return pods
+
+
+class TestMessageParsing:
+    def test_five_kinds(self):
+        assert parse_message(json.dumps({"kind": "spot-interruption", "instance_id": "i-1", "zone": "z"})).kind == "spot-interruption"
+        assert parse_message(json.dumps({"kind": "scheduled-change", "instance_id": "i-1"})).kind == "scheduled-change"
+        p = parse_message(json.dumps({"kind": "state-change", "instance_id": "i-1", "state": "stopping"}))
+        assert p.kind == "state-change" and p.state == "stopping"
+        assert parse_message(json.dumps({"kind": "rebalance-recommendation", "instance_id": "i-1"})).kind == "rebalance-recommendation"
+        assert parse_message("not json").kind == "noop"
+        assert parse_message(json.dumps({"kind": "mystery"})).kind == "noop"
+        assert parse_message(json.dumps({"kind": "spot-interruption"})).kind == "noop"  # no instance
+
+
+class TestInterruption:
+    def test_spot_interruption_drains_and_ices(self, env):
+        provision(env)
+        claim = env.cluster.list(NodeClaim)[0]
+        iid = parse_instance_id(claim.provider_id)
+        itype, zone = claim.instance_type, claim.zone
+        env.cloud.send(json.dumps({"kind": "spot-interruption", "instance_id": iid, "zone": zone}))
+        handled = env.interruption.reconcile()
+        assert handled == 1
+        assert env.cluster.get(NodeClaim, claim.metadata.name).deleting
+        assert env.unavailable.is_unavailable(itype, zone, "spot")
+        # drain completes; pod rescheduled on replacement capacity that
+        # avoids the ICE'd offering
+        env.settle(max_ticks=30)
+        assert not env.cluster.pending_pods()
+        live = [c for c in env.cluster.list(NodeClaim) if not c.deleting]
+        assert live and live[0].metadata.name != claim.metadata.name
+
+    def test_state_change_terminal_only(self, env):
+        provision(env)
+        claim = env.cluster.list(NodeClaim)[0]
+        iid = parse_instance_id(claim.provider_id)
+        env.cloud.send(json.dumps({"kind": "state-change", "instance_id": iid, "state": "pending"}))
+        env.interruption.reconcile()
+        assert not env.cluster.get(NodeClaim, claim.metadata.name).deleting
+        env.cloud.send(json.dumps({"kind": "state-change", "instance_id": iid, "state": "stopping"}))
+        env.interruption.reconcile()
+        assert env.cluster.get(NodeClaim, claim.metadata.name).deleting
+
+    def test_rebalance_is_advisory(self, env):
+        provision(env)
+        claim = env.cluster.list(NodeClaim)[0]
+        iid = parse_instance_id(claim.provider_id)
+        env.cloud.send(json.dumps({"kind": "rebalance-recommendation", "instance_id": iid}))
+        env.interruption.reconcile()
+        assert not env.cluster.get(NodeClaim, claim.metadata.name).deleting
+        assert env.recorder.with_reason("RebalanceRecommendation")
+
+    def test_unknown_instance_ignored(self, env):
+        env.cloud.send(json.dumps({"kind": "spot-interruption", "instance_id": "i-nope", "zone": "z"}))
+        assert env.interruption.reconcile() == 1  # handled (deleted), no crash
+
+    def test_queue_drained_in_batches(self, env):
+        for i in range(25):
+            env.cloud.send(json.dumps({"kind": "mystery", "n": i}))
+        assert env.interruption.reconcile(max_messages=10) == 25
+
+
+class TestGarbageCollection:
+    def test_orphan_instance_terminated(self, env):
+        provision(env)
+        claim = env.cluster.list(NodeClaim)[0]
+        # claim vanishes out-of-band (no finalizer processing)
+        env.cluster._store[NodeClaim.KIND].pop(claim.metadata.name)
+        env.clock.step(120)  # past launch grace
+        removed = env.garbage_collection.reconcile()
+        assert removed == [parse_instance_id(claim.provider_id)]
+        insts = env.cloud.describe_instances()
+        assert all(i.state == "terminated" for i in insts)
+
+    def test_fresh_instance_spared(self, env):
+        provision(env)
+        claim = env.cluster.list(NodeClaim)[0]
+        env.cluster._store[NodeClaim.KIND].pop(claim.metadata.name)
+        # within grace: not collected
+        assert env.garbage_collection.reconcile() == []
+
+
+class TestTagging:
+    def test_name_tag_applied_once(self, env):
+        provision(env)
+        claim = env.cluster.list(NodeClaim)[0]
+        iid = parse_instance_id(claim.provider_id)
+        inst = env.cloud.describe_instances([iid])[0]
+        assert inst.tags.get("Name") == claim.node_name
+        calls_before = env.cloud.calls.get("create_tags", 0)
+        env.tagging.reconcile_all()
+        assert env.cloud.calls.get("create_tags", 0) == calls_before  # idempotent
+
+
+class TestCapacityReservations:
+    def _reserve(self, env, count=2):
+        items = env.cloud.describe_instance_types()
+        m5l = next(t for t in items if t.name == "m5.large")
+        cr = CapacityReservationInfo(
+            id="cr-test", instance_type="m5.large", zone=m5l.zones[0],
+            total_count=count, available_count=count,
+            tags={"team": "ml"},
+        )
+        env.cloud.add_capacity_reservation(cr)
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.capacity_reservation_selector_terms = [SelectorTerm(tags={"team": "ml"})]
+        env.cluster.update(nc)
+        return cr
+
+    def test_reserved_preferred_then_bookkept(self, env):
+        self._reserve(env, count=2)
+        provision(env, n=1)
+        claim = env.cluster.list(NodeClaim)[0]
+        assert claim.capacity_type == "reserved"
+        assert claim.metadata.labels[wk.LABEL_CAPACITY_RESERVATION_ID] == "cr-test"
+        # bookkeeping consumed one slot
+        assert env.capacity_reservations.available_count("cr-test", 2) == 1
+
+    def test_exhausted_reservation_falls_back(self, env):
+        self._reserve(env, count=1)
+        provision(env, n=1, cpu="1500m")  # fills the reserved m5.large
+        # second pod arrives; reservation exhausted -> spot/od launch
+        env.cluster.create(Pod("extra", requests=Resources({"cpu": "1500m", "memory": "1Gi"})))
+        env.settle(max_ticks=30)
+        claims = sorted(env.cluster.list(NodeClaim), key=lambda c: c.metadata.creation_timestamp)
+        assert claims[0].capacity_type == "reserved"
+        assert claims[-1].capacity_type in ("spot", "on-demand")
+
+    def test_expiration_flips_capacity_type(self, env):
+        from karpenter_tpu.apis import CONSOLIDATION_WHEN_EMPTY
+
+        # isolate the in-place flip: without this, consolidation correctly
+        # replaces the newly-on-demand node with cheaper spot in the same tick
+        pool = env.cluster.get(NodePool, "default")
+        pool.disruption.consolidation_policy = CONSOLIDATION_WHEN_EMPTY
+        env.cluster.update(pool)
+        cr = self._reserve(env, count=2)
+        cr.end_time = env.clock.now() + 1000
+        provision(env, n=1)
+        claim = env.cluster.list(NodeClaim)[0]
+        assert claim.capacity_type == "reserved"
+        env.clock.step(2000)
+        env.tick()
+        claim = env.cluster.list(NodeClaim)[0]
+        assert claim.capacity_type == "on-demand"
+        assert wk.LABEL_CAPACITY_RESERVATION_ID not in claim.metadata.labels
+
+
+class TestRefreshControllers:
+    def test_refresh_cadence(self, env):
+        env.tick()
+        calls = env.cloud.calls.get("describe_instance_types", 0)
+        env.tick()  # within 12h window: no refresh
+        assert env.cloud.calls.get("describe_instance_types", 0) == calls
+        env.clock.step(13 * 3600)
+        env.tick()
+        assert env.cloud.calls.get("describe_instance_types", 0) > calls
+
+    def test_discovered_capacity_feedback(self, env):
+        provision(env)
+        node = env.cluster.list(Node)[0]
+        assert env.instance_types._discovered_memory  # learned from the node
+
+
+class TestObservability:
+    def test_metrics_exposition(self, env):
+        from karpenter_tpu import metrics
+
+        provision(env)
+        env.cloud.send(json.dumps({"kind": "mystery"}))
+        env.interruption.reconcile()
+        text = metrics.REGISTRY.expose()
+        assert "karpenter_interruption_received_messages_total" in text
+        assert "# TYPE" in text
+
+    def test_event_dedupe(self, env):
+        from karpenter_tpu.events import Recorder
+
+        r = Recorder(env.clock, dedupe_window=60)
+        claim = NodeClaim("x")
+        r.publish(claim, "Waiting", "still waiting")
+        r.publish(claim, "Waiting", "still waiting")
+        assert len(r.with_reason("Waiting")) == 1
+        assert r.with_reason("Waiting")[0].count == 2
+        env.clock.step(61)
+        r.publish(claim, "Waiting", "still waiting")
+        assert len(r.with_reason("Waiting")) == 2
