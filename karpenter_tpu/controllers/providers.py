@@ -112,12 +112,7 @@ class ImageCacheInvalidationController:
 
     def reconcile(self) -> int:
         live = {i.id for i in self.compute_api.describe_images()}
-        stale = 0
-        for key, img_id in list(self.images._param_cache.items()):
-            if img_id is not None and img_id not in live:
-                self.images._param_cache.delete(key)
-                stale += 1
-        return stale
+        return self.images.invalidate_missing(live)
 
 
 class CapacityReservationExpirationController:

@@ -34,6 +34,18 @@ class ImageProvider:
         self.params = params
         self._param_cache = TTLCache(SSM_CACHE_TTL, clock)
 
+    def invalidate_missing(self, live_ids) -> int:
+        """Drop cached alias resolutions whose image id is no longer in the
+        live set (mirrors the SSM-invalidation controller's contract in the
+        reference, pkg/controllers/providers/ssm/invalidation); returns the
+        number of entries dropped."""
+        stale = 0
+        for key, img_id in list(self._param_cache.items()):
+            if img_id is not None and img_id not in live_ids:
+                self._param_cache.delete(key)
+                stale += 1
+        return stale
+
     def resolve(self, nodeclass: TPUNodeClass) -> List[ResolvedImage]:
         images = {i.id: i for i in self.compute_api.describe_images()}
         out: List[ResolvedImage] = []

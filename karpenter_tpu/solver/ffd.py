@@ -15,11 +15,24 @@ The TPU reformulation of the core scheduler's sequential FFD loop
 - class/type compatibility (the requirements algebra) is evaluated on
   device as packed-bitset gathers + numeric interval tests, fused by XLA
   into the fit computation
+- zone and capacity-type sets are packed into a single uint32 lane per
+  group/type/class (zones in bits 0..7, capacity types in bits 8..10), so
+  the per-step offering joins are two bitwise ANDs + compares instead of
+  bool einsums -- the scan body stays VPU-only with no dtype conversions
 
 Everything is static-shaped; instances are padded into (C, G, K) buckets and
 compiled once per bucket. All resource values are small exact integers in
 float32 (encode.py scaling), so fit arithmetic is exact and differentially
 testable against the Python oracle.
+
+For the tunneled-accelerator deployment (solver service on a TPU VM, ~tens
+of ms RTT), `ffd_solve_packed` additionally compacts the full decision --
+sparse (class, group) placements, leftovers, and per-group cheapest
+offering -- into a handful of small arrays materialized with ONE
+device->host round trip; the catalog tensors are staged on device once via
+`stage_catalog` and only the per-tick class tensors travel (SURVEY.md
+section 7 hard part #6: persistent streams, pre-staged catalog tensors,
+delta updates only).
 """
 from __future__ import annotations
 
@@ -114,6 +127,44 @@ def ffd_solve(inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], wo
     return _ffd_body(inp, g_max, word_offsets, words)
 
 
+_CT_SHIFT = 8  # captype bits live above the zone bits in the packed u32
+
+
+def _pack_zc(zmask: jax.Array, cmask: jax.Array) -> jax.Array:
+    """[..., Z] bool x [..., CT] bool -> [...] u32 (zones bits 0..Z-1,
+    captypes bits _CT_SHIFT.._CT_SHIFT+CT-1)."""
+    Z = zmask.shape[-1]
+    CTn = cmask.shape[-1]
+    if Z > _CT_SHIFT:
+        raise ValueError(
+            f"zone lanes ({Z}) overflow into the captype bits; raise _CT_SHIFT "
+            f"alongside encode.Z_PAD (captype bits start at {_CT_SHIFT})"
+        )
+    if _CT_SHIFT + CTn > 32:
+        raise ValueError(f"zone+captype lanes exceed 32 bits ({_CT_SHIFT}+{CTn})")
+    zbits = jnp.sum(
+        zmask.astype(jnp.uint32) << jnp.arange(Z, dtype=jnp.uint32), axis=-1
+    )
+    cbits = jnp.sum(
+        cmask.astype(jnp.uint32) << jnp.arange(_CT_SHIFT, _CT_SHIFT + CTn, dtype=jnp.uint32),
+        axis=-1,
+    )
+    return zbits | cbits
+
+
+def _unpack_zc(packed: jax.Array, Z: int, CTn: int) -> Tuple[jax.Array, jax.Array]:
+    zmask = ((packed[..., None] >> jnp.arange(Z, dtype=jnp.uint32)) & 1) != 0
+    cmask = ((packed[..., None] >> jnp.arange(_CT_SHIFT, _CT_SHIFT + CTn, dtype=jnp.uint32)) & 1) != 0
+    return zmask, cmask
+
+
+def _joint_ok(x: jax.Array) -> jax.Array:
+    """Packed-intersection test: both the zone AND the captype sub-bitsets
+    must intersect (non-empty offering join)."""
+    zone_bits = jnp.uint32((1 << _CT_SHIFT) - 1)
+    return ((x & zone_bits) != 0) & ((x >> _CT_SHIFT) != 0)
+
+
 def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...]) -> SolveOutputs:
     C, Rr = inp.req.shape
     K = inp.cap.shape[0]
@@ -121,18 +172,39 @@ def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words
     CTn = inp.tcap.shape[1]
     compat = _device_compat(inp, word_offsets, words)             # [C, K]
 
+    tzc = _pack_zc(inp.tzone, inp.tcap)                           # [K] u32
+    azc = _pack_zc(inp.azone, inp.acap)                           # [C] u32
+
+    # fresh-group fit per (class, type): independent of the carry, so it is
+    # hoisted out of the scan entirely (one [C, K, R] pass instead of C
+    # [K, R] passes inside the sequential loop)
+    req_safe = jnp.where(inp.req > 0, inp.req, 1.0)               # [C, R]
+    n_fresh_all = jnp.maximum(
+        jnp.min(
+            jnp.where(
+                inp.req[:, None, :] > 0,
+                jnp.floor(inp.cap[None, :, :] / req_safe[:, None, :]),
+                _INF,
+            ),
+            axis=-1,
+        ),
+        0.0,
+    )                                                             # [C, K]
+    fresh_join = _joint_ok(azc[:, None] & tzc[None, :])           # [C, K]
+    fresh_mask_all = compat & fresh_join                          # [C, K]
+    per_new_all = jnp.max(
+        jnp.where(fresh_mask_all, n_fresh_all, 0.0), axis=-1
+    ).astype(jnp.int32)                                           # [C]
+
     slot = jnp.arange(g_max, dtype=jnp.int32)
 
     def step(carry, xs):
-        accum, gmask, gzone, gcap, n_open = carry
-        req_c, count_c, compat_c, azone_c, acap_c = xs
+        accum, gmask, gzc, n_open = carry
+        req_c, count_c, compat_c, azc_c, fresh_mask, per_new = xs
 
         # -- joint feasibility of class c on each open group ---------------
-        gz = gzone & azone_c[None, :]                             # [G, Z]
-        gc = gcap & acap_c[None, :]                               # [G, CT]
-        zj = jnp.einsum("gz,kz->gk", gz.astype(jnp.float32), inp.tzone.astype(jnp.float32)) > 0
-        cj = jnp.einsum("gt,kt->gk", gc.astype(jnp.float32), inp.tcap.astype(jnp.float32)) > 0
-        m = gmask & compat_c[None, :] & zj & cj                   # [G, K]
+        gzc_new = gzc & azc_c                                     # [G] u32
+        m = gmask & compat_c[None, :] & _joint_ok(gzc_new[:, None] & tzc[None, :])
 
         # -- how many fit on each open group -------------------------------
         n_fit = _fit_counts(inp.cap, accum, req_c)                # [G, K]
@@ -146,11 +218,6 @@ def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words
         leftover = count_c - placed
 
         # -- open fresh identical groups for the remainder -----------------
-        fresh_zone = jnp.einsum("z,kz->k", azone_c.astype(jnp.float32), inp.tzone.astype(jnp.float32)) > 0
-        fresh_cap = jnp.einsum("t,kt->k", acap_c.astype(jnp.float32), inp.tcap.astype(jnp.float32)) > 0
-        fresh_mask = compat_c & fresh_zone & fresh_cap            # [K]
-        n_fresh = _fit_counts(inp.cap, jnp.zeros((1, Rr), inp.cap.dtype), req_c)[0]  # [K]
-        per_new = jnp.max(jnp.where(fresh_mask, n_fresh, 0.0)).astype(jnp.int32)
         can_open = (leftover > 0) & (per_new > 0)
         n_new = jnp.where(can_open, -(-leftover // jnp.maximum(per_new, 1)), 0)
         n_new = jnp.minimum(n_new, g_max - n_open)                # slot budget
@@ -169,23 +236,21 @@ def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words
         touched_existing = take > 0
         gmask2 = jnp.where(touched_existing[:, None], m & fits_now, gmask)
         gmask2 = jnp.where(is_new[:, None], fresh_mask[None, :] & fits_now, gmask2)
-        gzone2 = jnp.where(touched_existing[:, None], gz, gzone)
-        gzone2 = jnp.where(is_new[:, None], azone_c[None, :], gzone2)
-        gcap2 = jnp.where(touched_existing[:, None], gc, gcap)
-        gcap2 = jnp.where(is_new[:, None], acap_c[None, :], gcap2)
+        gzc2 = jnp.where(touched_existing, gzc_new, gzc)
+        gzc2 = jnp.where(is_new, azc_c, gzc2)
         n_open2 = n_open + n_new
 
-        return (accum2, gmask2, gzone2, gcap2, n_open2), (take_all, still_unplaced)
+        return (accum2, gmask2, gzc2, n_open2), (take_all, still_unplaced)
 
     init = (
         jnp.zeros((g_max, Rr), jnp.float32),
         jnp.zeros((g_max, K), bool),
-        jnp.zeros((g_max, Z), bool),
-        jnp.zeros((g_max, CTn), bool),
+        jnp.zeros((g_max,), jnp.uint32),
         jnp.int32(0),
     )
-    xs = (inp.req, inp.count, compat, inp.azone, inp.acap)
-    (accum, gmask, gzone, gcap, n_open), (take, unplaced) = jax.lax.scan(step, init, xs)
+    xs = (inp.req, inp.count, compat, azc, fresh_mask_all, per_new_all)
+    (accum, gmask, gzc, n_open), (take, unplaced) = jax.lax.scan(step, init, xs)
+    gzone, gcap = _unpack_zc(gzc, Z, CTn)
     return SolveOutputs(
         take=take, unplaced=unplaced, n_open=n_open, accum=accum,
         gmask=gmask, gzone=gzone, gcap=gcap, compat=compat,
@@ -210,6 +275,96 @@ def select_offerings(price: jax.Array, gmask: jax.Array, gzone: jax.Array, gcap:
     z = (best // CT) % Z
     ct = best % CT
     return k, z, ct, bp
+
+
+class PackedDecision(NamedTuple):
+    """The full scheduling decision compacted for a single high-latency
+    device->host fetch (~25 KB instead of the dense [C, G] take matrix).
+
+    `idx`/`val` are a sparse encoding of take: flat indices into
+    take.ravel() (row-major [C, G]) and the pod counts placed there; padding
+    entries have idx == -1. `nnz` is the true nonzero count -- if it exceeds
+    idx.shape[0] the caller must refetch densely (never observed at bench
+    scale; FFD placements are near-diagonal so nnz ~ C + n_open)."""
+
+    idx: jax.Array          # [NNZ] i32
+    val: jax.Array          # [NNZ] i32
+    nnz: jax.Array          # scalar i32
+    unplaced: jax.Array     # [C] i32
+    n_open: jax.Array       # scalar i32
+    sel_type: jax.Array     # [G] i32
+    sel_zone: jax.Array     # [G] i32
+    sel_cap: jax.Array      # [G] i32
+    sel_price: jax.Array    # [G] f32
+
+
+@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words"))
+def ffd_solve_packed(
+    inp: SolveInputs,
+    price: jax.Array,
+    *,
+    g_max: int,
+    nnz_max: int,
+    word_offsets: Tuple[int, ...],
+    words: Tuple[int, ...],
+) -> PackedDecision:
+    out = _ffd_body(inp, g_max, word_offsets, words)
+    k, z, ct, bp = select_offerings(price, out.gmask, out.gzone, out.gcap)
+    flat = out.take.ravel()
+    nnz_true = jnp.sum(flat != 0).astype(jnp.int32)
+    (idx,) = jnp.nonzero(flat, size=nnz_max, fill_value=0)
+    valid = jnp.arange(nnz_max) < nnz_true
+    val = jnp.where(valid, flat[idx], 0).astype(jnp.int32)
+    idx = jnp.where(valid, idx, -1).astype(jnp.int32)
+    return PackedDecision(
+        idx=idx, val=val, nnz=nnz_true, unplaced=out.unplaced,
+        n_open=out.n_open, sel_type=k.astype(jnp.int32),
+        sel_zone=z.astype(jnp.int32), sel_cap=ct.astype(jnp.int32),
+        sel_price=bp,
+    )
+
+
+class StagedCatalog(NamedTuple):
+    """Catalog tensors resident on device (uploaded once per catalog
+    seqnum), plus the static bitset geometry. Per-solve traffic is then
+    only the ~100 KB of pod-class tensors."""
+
+    cap: jax.Array
+    tcode: jax.Array
+    tnum: jax.Array
+    tnum_present: jax.Array
+    tzone: jax.Array
+    tcap: jax.Array
+    price: jax.Array
+
+
+def stage_catalog(catalog: CatalogTensors, device=None) -> Tuple[StagedCatalog, Tuple[int, ...], Tuple[int, ...]]:
+    put = functools.partial(jax.device_put, device=device)
+    words = tuple(catalog.words)
+    offsets = tuple(int(x) for x in np.cumsum((0,) + words[:-1]))
+    staged = StagedCatalog(
+        cap=put(catalog.cap),
+        tcode=put(catalog.tcode),
+        tnum=put(catalog.tnum),
+        tnum_present=put(catalog.tnum_present),
+        tzone=put(catalog.tzone),
+        tcap=put(catalog.tcap),
+        price=put(catalog.price),
+    )
+    return staged, offsets, words
+
+
+def make_inputs_staged(staged: StagedCatalog, classes: PodClassSet) -> SolveInputs:
+    """SolveInputs over a pre-staged device catalog; class-side leaves stay
+    host numpy so the jit dispatch streams them asynchronously."""
+    allowed = np.concatenate(classes.allowed, axis=1)
+    return SolveInputs(
+        cap=staged.cap, tcode=staged.tcode, tnum=staged.tnum,
+        tnum_present=staged.tnum_present, tzone=staged.tzone, tcap=staged.tcap,
+        req=classes.req, count=classes.count, allowed=allowed,
+        num_lo=classes.num_lo, num_hi=classes.num_hi, azone=classes.azone,
+        acap=classes.acap, schedulable=classes.schedulable,
+    )
 
 
 def make_inputs(catalog: CatalogTensors, classes: PodClassSet) -> Tuple[SolveInputs, Tuple[int, ...], Tuple[int, ...]]:

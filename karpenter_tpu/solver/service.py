@@ -17,6 +17,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
 
 from karpenter_tpu.apis import NodePool, Pod, labels as wk
@@ -41,18 +42,29 @@ class TPUSolver:
         self.c_pad_min = c_pad_min
         self._cached_catalog_list = None   # strong ref: keeps the identity check sound
         self._cached_tensors: Optional[CatalogTensors] = None
+        self._cached_staged = None         # (StagedCatalog, offsets, words)
         self._lock = threading.Lock()
 
     # -- catalog staging ----------------------------------------------------
-    def catalog_tensors(self, instance_types: Sequence) -> CatalogTensors:
-        """Memoized by object identity. Holding a strong reference to the
+    def _catalog(self, instance_types: Sequence):
+        """(tensors, staged, offsets, words), memoized by object identity
+        and returned from ONE lock acquisition so concurrent solves for
+        different catalogs can never pair one catalog's encoding with
+        another's staged device tensors. Holding a strong reference to the
         keyed list makes the `is` check sound (a bare id() key could be
-        reused by a different list after GC)."""
+        reused by a different list after GC). Staging uploads the catalog
+        to device once -- per-tick solves then only move the pod-class
+        tensors (SURVEY.md section 7 hard part #6)."""
         with self._lock:
             if self._cached_catalog_list is not instance_types:
                 self._cached_tensors = encode.encode_catalog(instance_types)
+                self._cached_staged = ffd.stage_catalog(self._cached_tensors)
                 self._cached_catalog_list = instance_types
-            return self._cached_tensors
+            staged, offsets, words = self._cached_staged
+            return self._cached_tensors, staged, offsets, words
+
+    def catalog_tensors(self, instance_types: Sequence) -> CatalogTensors:
+        return self._catalog(instance_types)[0]
 
     # -- routing ------------------------------------------------------------
     @staticmethod
@@ -87,7 +99,7 @@ class TPUSolver:
         pods: Sequence[Pod],
         nodepool_usage: Optional[Resources] = None,
     ) -> SchedulingResult:
-        catalog = self.catalog_tensors(instance_types)
+        catalog, staged, offsets, words = self._catalog(instance_types)
         pool_reqs = pool.requirements()
         classes = encode.group_pods(pods, extra_requirements=pool_reqs)
         class_set = encode.encode_classes(
@@ -96,8 +108,10 @@ class TPUSolver:
             pool_taints=list(pool.template.taints),
             c_pad=_bucket(len(classes), self.c_pad_min),
         )
-        inp, offsets, words = ffd.make_inputs(catalog, class_set)
+        inp = ffd.make_inputs_staged(staged, class_set)
         out = ffd.ffd_solve(inp, g_max=self.g_max, word_offsets=offsets, words=words)
+        # one batched device->host fetch (transfers overlap; a single RTT)
+        out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
         return self._decode(pool, instance_types, catalog, class_set, out, nodepool_usage)
 
     def _decode(

@@ -3,10 +3,19 @@
 Multi-chip TPU hardware is not available in CI; sharding correctness is
 validated on a host-platform mesh (the driver separately dry-run-compiles
 the multi-chip path via __graft_entry__.dryrun_multichip).
+
+The environment may pin JAX_PLATFORMS to a remote-accelerator plugin via a
+sitecustomize hook, so setting the env var is not enough -- the jax config
+override below wins regardless of import order (as long as no test module
+created device arrays at import time, which none do).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
