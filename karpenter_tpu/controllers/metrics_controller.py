@@ -1,0 +1,54 @@
+"""NodeClaim metrics controller.
+
+Rebuilds pkg/controllers/metrics/controller.go:33-106: export per-NodeClaim
+cloud dimensions (instance type, zone, capacity type, nodepool, reservation)
+as an info gauge, pruning series for claims that no longer exist so the
+registry never leaks cardinality across claim churn.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from karpenter_tpu.apis import NodeClaim, labels as wk
+from karpenter_tpu import metrics
+from karpenter_tpu.kwok.cluster import Cluster
+
+INSTANCE_INFO = metrics.REGISTRY.gauge(
+    "karpenter_cloudprovider_instance_info",
+    "Per-nodeclaim cloud instance dimensions (value is always 1).",
+    labels=("nodeclaim", "instance_type", "zone", "capacity_type", "nodepool", "reservation_id"),
+)
+
+
+class MetricsController:
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self._series: Dict[str, Tuple] = {}  # claim name -> label values
+
+    def _labels_of(self, claim: NodeClaim) -> Dict[str, str]:
+        l = claim.metadata.labels
+        return {
+            "nodeclaim": claim.metadata.name,
+            "instance_type": l.get(wk.INSTANCE_TYPE_LABEL, ""),
+            "zone": l.get(wk.ZONE_LABEL, ""),
+            "capacity_type": l.get(wk.CAPACITY_TYPE_LABEL, ""),
+            "nodepool": l.get(wk.NODEPOOL_LABEL, ""),
+            "reservation_id": l.get(wk.LABEL_CAPACITY_RESERVATION_ID, ""),
+        }
+
+    def reconcile_all(self) -> int:
+        live = {}
+        for claim in self.cluster.list(NodeClaim):
+            if not claim.launched():
+                continue
+            labels = self._labels_of(claim)
+            live[claim.metadata.name] = tuple(labels.values())
+            INSTANCE_INFO.set(1.0, **labels)
+        # prune series for claims that disappeared or changed dimensions --
+        # remove, never zero, so claim churn cannot grow cardinality
+        label_names = ("nodeclaim", "instance_type", "zone", "capacity_type", "nodepool", "reservation_id")
+        for name, values in list(self._series.items()):
+            if live.get(name) != values:
+                INSTANCE_INFO.remove(**dict(zip(label_names, values)))
+        self._series = live
+        return len(live)

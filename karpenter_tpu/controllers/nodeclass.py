@@ -50,7 +50,11 @@ class NodeClassController:
         launch_templates=None,
         clock=None,
         capacity_reservations=None,
+        instance_profiles=None,
+        cluster_name: str = "",
     ):
+        from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
+
         self.cluster = cluster
         self.compute_api = compute_api
         self.identity_api = identity_api
@@ -60,6 +64,17 @@ class NodeClassController:
         self.launch_templates = launch_templates
         self.clock = clock
         self.capacity_reservations = capacity_reservations
+        if instance_profiles is None:
+            # managed-profile names embed the cluster name so two clusters
+            # can never collide on (and finalize-delete) each other's
+            # profiles -- a default provider without one would be a trap
+            if not cluster_name:
+                raise ValueError(
+                    "NodeClassController needs either an InstanceProfileProvider "
+                    "or a cluster_name to build one"
+                )
+            instance_profiles = InstanceProfileProvider(identity_api, cluster_name)
+        self.instance_profiles = instance_profiles
 
     def reconcile_all(self) -> None:
         for nc in self.cluster.list(TPUNodeClass):
@@ -153,17 +168,11 @@ class NodeClassController:
 
     def _reconcile_instance_profile(self, nc: TPUNodeClass) -> None:
         if nc.instance_profile:
+            # user-supplied profile: reference it, never manage it
             nc.status_instance_profile = nc.instance_profile
             nc.status_conditions.set_true(COND_INSTANCE_PROFILE_READY)
             return
-        name = f"karpenter-{nc.name}-profile"
-        prof = self.identity_api.get_instance_profile(name)
-        if prof is None:
-            self.identity_api.create_instance_profile(name, {"karpenter.tpu/nodeclass": nc.name})
-            self.identity_api.add_role(name, nc.role)
-        elif prof.get("roles") != [nc.role]:
-            self.identity_api.add_role(name, nc.role)
-        nc.status_instance_profile = name
+        nc.status_instance_profile = self.instance_profiles.ensure(nc.name, nc.role)
         nc.status_conditions.set_true(COND_INSTANCE_PROFILE_READY)
 
     def _reconcile_validation(self, nc: TPUNodeClass) -> None:
@@ -194,5 +203,5 @@ class NodeClassController:
         if self.launch_templates is not None:
             self.launch_templates.delete_all(nc)
         if not nc.instance_profile:  # only delete profiles we created
-            self.identity_api.delete_instance_profile(f"karpenter-{nc.name}-profile")
+            self.instance_profiles.delete(nc.name)
         self.cluster.remove_finalizer(nc, TERMINATION_FINALIZER)

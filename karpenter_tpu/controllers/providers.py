@@ -90,15 +90,22 @@ class DiscoveredCapacityController:
 
 
 class VersionController(_Periodic):
-    def __init__(self, cluster_api, clock: Clock, interval: float = 5 * 60.0):
+    """Periodic cluster-version refresh through the version provider
+    (reference: providers/version/controller.go drives version.Provider)."""
+
+    def __init__(self, version_provider, clock: Clock, interval: float = 5 * 60.0):
         super().__init__(clock, interval)
-        self.cluster_api = cluster_api
-        self.version: str = ""
+        self.version_provider = version_provider
+
+    @property
+    def version(self) -> str:
+        return self.version_provider.get()
 
     def reconcile(self) -> bool:
         if not self.due():
             return False
-        self.version = self.cluster_api.cluster_version()
+        self.version_provider.invalidate()
+        self.version_provider.get()
         return True
 
 
@@ -115,9 +122,9 @@ class ImageCacheInvalidationController:
         return self.images.invalidate_missing(live)
 
 
-class CapacityReservationExpirationController:
+class CapacityTypeController:
     """Flips claims on expired/vanished reservations to on-demand accounting
-    (the capacitytype + expiration controllers' job in the reference).
+    (reference: capacityreservation/capacitytype/controller.go:1-157).
     Expiry is judged directly against the cloud's reservation list -- by the
     time this runs, the nodeclass controller may already have scrubbed the
     lapsed entry from status, so status cannot be the source of truth."""
@@ -152,3 +159,44 @@ class CapacityReservationExpirationController:
                 self.cluster.update(claim)
                 flipped += 1
         return flipped
+
+
+# expiration lead: start draining capacity-block claims this long before the
+# reservation's hard end so pods reschedule while capacity still exists
+# (reference: capacityreservation/expiration/controller.go)
+CAPACITY_BLOCK_EXPIRATION_LEAD = 10 * 60.0
+
+
+class CapacityReservationExpirationController:
+    """Initiates graceful NodeClaim deletion for capacity-BLOCK claims whose
+    reservation is about to end (reference:
+    capacityreservation/expiration/controller.go:1-135). Capacity blocks
+    hard-reclaim their instances at end time, so waiting for the
+    capacitytype flip (which handles default ODCRs) would strand pods; this
+    controller drains ahead of the cliff instead."""
+
+    def __init__(self, cluster: Cluster, reservations, lead: float = CAPACITY_BLOCK_EXPIRATION_LEAD):
+        self.cluster = cluster
+        self.reservations = reservations
+        self.lead = lead
+
+    def reconcile_all(self) -> int:
+        now = self.cluster.clock.now()
+        expiring_blocks = {
+            cr.id: cr.end_time
+            for cr in self.reservations.list()
+            if cr.reservation_type == "capacity-block" and cr.end_time is not None
+        }
+        if not expiring_blocks:
+            return 0
+        expired = 0
+        for claim in self.cluster.list(NodeClaim):
+            if claim.deleting:
+                continue
+            rid = claim.metadata.labels.get(wk.LABEL_CAPACITY_RESERVATION_ID)
+            end = expiring_blocks.get(rid)
+            if end is not None and now >= end - self.lead:
+                # cordon-and-drain via the termination flow
+                self.cluster.delete(NodeClaim, claim.metadata.name)
+                expired += 1
+        return expired

@@ -118,21 +118,53 @@ class Provisioner:
         return result
 
     # -- NodeClaim creation + launch ---------------------------------------
+    # worker parallelism for cloud launches, mirroring the reference's
+    # MaxConcurrentReconciles: 10 (SURVEY.md section 2.4 row 1). Running
+    # launches concurrently is also what makes the fleet batching window
+    # effective: identical requests land in the same bucket before the
+    # first waiter's flush fires (pkg/batcher/createfleet.go:36-46)
+    MAX_CONCURRENT_LAUNCHES = 10
+
     def _launch(self, result: SchedulingResult) -> None:
-        for group in result.new_groups:
+        groups = result.new_groups
+        if not groups:
+            return
+        claims = []
+        for group in groups:
             claim = self._to_nodeclaim(group)
             self.cluster.create(claim)
-            try:
-                self.cloud_provider.create(claim)
+            claims.append(claim)
+
+        def launch_one(claim):
+            # cloud call only -- cluster mutations stay on the caller thread
+            self.cloud_provider.create(claim)
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        if len(claims) == 1:
+            outcomes = [self._try_launch(launch_one, claims[0])]
+        else:
+            with ThreadPoolExecutor(max_workers=self.MAX_CONCURRENT_LAUNCHES) as pool:
+                outcomes = list(pool.map(lambda c: self._try_launch(launch_one, c), claims))
+        for group, claim, err in zip(groups, claims, outcomes):
+            if err is None:
                 self.cluster.update(claim)
                 metrics.NODECLAIMS_CREATED.inc(nodepool=group.nodepool.name)
-            except CloudError as e:
+            else:
                 # ICE already recorded by the instance provider; drop the
                 # claim so the next tick re-simulates around it
                 for pod in group.pods:
-                    result.unschedulable[pod.metadata.name] = str(e)
+                    result.unschedulable[pod.metadata.name] = str(err)
                 claim.metadata.finalizers = []
                 self.cluster.delete(NodeClaim, claim.metadata.name)
+
+    @staticmethod
+    def _try_launch(fn, claim):
+        try:
+            fn(claim)
+            return None
+        except CloudError as e:
+            return e
 
     def _to_nodeclaim(self, group: NewNodeGroup) -> NodeClaim:
         pool = group.nodepool

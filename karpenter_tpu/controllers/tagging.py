@@ -11,6 +11,7 @@ from karpenter_tpu.apis import NodeClaim
 from karpenter_tpu.cloudprovider import CloudProvider
 from karpenter_tpu.errors import NotFoundError
 from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.providers.instance.provider import NODECLAIM_TAG
 from karpenter_tpu.utils import parse_instance_id
 
 ANNOTATION_TAGGED = "karpenter.tpu/tagged"
@@ -35,7 +36,7 @@ class TaggingController:
                     parse_instance_id(claim.provider_id),
                     {
                         "Name": claim.node_name,
-                        "karpenter.tpu/nodeclaim": claim.metadata.name,
+                        NODECLAIM_TAG: claim.metadata.name,
                     },
                 )
             except NotFoundError:

@@ -56,6 +56,15 @@ class Gauge(_Metric):
         key = tuple(labels.get(l, "") for l in self.label_names)
         return self._values.get(key, 0.0)
 
+    def remove(self, **labels) -> None:
+        """Delete a label series entirely (DeletePartialMatch in the
+        reference's prometheus usage) -- churn-heavy controllers must
+        remove series for gone objects, not zero them, or cardinality
+        grows without bound."""
+        key = tuple(labels.get(l, "") for l in self.label_names)
+        with self._lock:
+            self._values.pop(key, None)
+
     def collect(self):
         for key, v in self._values.items():
             yield key, v, "gauge"

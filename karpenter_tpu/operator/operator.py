@@ -17,8 +17,12 @@ from karpenter_tpu.controllers.disruption import DisruptionController
 from karpenter_tpu.controllers.garbagecollection import GarbageCollectionController
 from karpenter_tpu.controllers.interruption import InterruptionController
 from karpenter_tpu.controllers.nodeclass import NodeClassController
+from karpenter_tpu.batcher.batcher import BatchOptions
+from karpenter_tpu.batcher.cloud import CloudBatchers
+from karpenter_tpu.controllers.metrics_controller import MetricsController
 from karpenter_tpu.controllers.providers import (
     CapacityReservationExpirationController,
+    CapacityTypeController,
     DiscoveredCapacityController,
     ImageCacheInvalidationController,
     InstanceTypeRefreshController,
@@ -34,6 +38,10 @@ from karpenter_tpu.kwok.cluster import Cluster
 from karpenter_tpu.kwok.lifecycle import NodeLifecycle
 from karpenter_tpu.providers.capacityreservation import CapacityReservationProvider
 from karpenter_tpu.providers.image import ImageProvider
+from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
+from karpenter_tpu.providers.params import ParamStoreProvider
+from karpenter_tpu.providers.queue import QueueProvider
+from karpenter_tpu.providers.version import VersionProvider
 from karpenter_tpu.providers.instance import InstanceProvider
 from karpenter_tpu.providers.instancetype import gen_catalog
 from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
@@ -80,8 +88,14 @@ class Operator:
         self.pricing = PricingProvider(self.cloud, self.cloud, self.options.region)
         self.subnets = SubnetProvider(self.cloud, self.clock)
         self.security_groups = SecurityGroupProvider(self.cloud, self.clock)
-        self.images = ImageProvider(self.cloud, self.cloud, self.clock)
+        self.params = ParamStoreProvider(self.cloud, self.clock)
+        self.images = ImageProvider(self.cloud, self.params, self.clock)
         self.capacity_reservations = CapacityReservationProvider(self.cloud, self.clock)
+        self.instance_profiles = InstanceProfileProvider(
+            self.cloud, self.options.cluster_name, self.options.region
+        )
+        self.queue = QueueProvider(self.cloud)
+        self.version = VersionProvider(self.cloud, self.clock)
         zone_ids = {z.name: z.zone_id for z in self.cloud.describe_zones()}
         self.offerings = OfferingsBuilder(
             self.pricing, self.unavailable, zone_ids, self.capacity_reservations
@@ -93,10 +107,19 @@ class Operator:
         self.launch_templates = LaunchTemplateProvider(
             self.cloud, self.cloud, self.images, self.security_groups, self.options.cluster_name
         )
+        self.batchers = CloudBatchers(
+            self.cloud,
+            options=BatchOptions(
+                idle_seconds=self.options.batch_idle_duration,
+                max_seconds=self.options.batch_max_duration,
+            ),
+            clock=self.clock,
+        )
         self.instances = InstanceProvider(
             self.cloud, self.subnets, self.launch_templates, self.unavailable,
             capacity_reservations=self.capacity_reservations,
             cluster_name=self.options.cluster_name,
+            batchers=self.batchers,
         )
         self.cloud_provider = CloudProvider(self.cluster, self.instance_types, self.instances)
 
@@ -105,6 +128,7 @@ class Operator:
             self.cluster, self.cloud, self.cloud, self.subnets, self.security_groups,
             self.images, self.launch_templates, self.clock,
             capacity_reservations=self.capacity_reservations,
+            instance_profiles=self.instance_profiles,
         )
         self.provisioner = Provisioner(self.cluster, self.cloud_provider, solver=solver)
         self.binder = PodBinder(self.cluster)
@@ -114,18 +138,22 @@ class Operator:
             self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates
         )
         self.interruption = InterruptionController(
-            self.cluster, self.cloud, self.unavailable, self.recorder
+            self.cluster, self.queue, self.unavailable, self.recorder
         )
         self.garbage_collection = GarbageCollectionController(self.cluster, self.cloud_provider)
         self.tagging = TaggingController(self.cluster, self.cloud_provider)
         self.instance_type_refresh = InstanceTypeRefreshController(self.instance_types, self.clock)
         self.pricing_refresh = PricingRefreshController(self.pricing, self.clock)
         self.discovered_capacity = DiscoveredCapacityController(self.cluster, self.instance_types)
-        self.version_controller = VersionController(self.cloud, self.clock)
+        self.version_controller = VersionController(self.version, self.clock)
         self.image_invalidation = ImageCacheInvalidationController(self.images, self.cloud)
+        self.capacity_type_controller = CapacityTypeController(
+            self.cluster, self.capacity_reservations
+        )
         self.reservation_expiration = CapacityReservationExpirationController(
             self.cluster, self.capacity_reservations
         )
+        self.metrics_controller = MetricsController(self.cluster)
 
     # -- convenience loop for tests/rig -------------------------------------
     def tick(self) -> None:
@@ -136,6 +164,7 @@ class Operator:
         self.instance_type_refresh.reconcile()
         self.pricing_refresh.reconcile()
         self.version_controller.reconcile()
+        self.capacity_type_controller.reconcile_all()
         self.reservation_expiration.reconcile_all()
         self.interruption.reconcile()
         self.provisioner.reconcile()
@@ -146,6 +175,7 @@ class Operator:
         self.disruption.reconcile()
         self.termination.reconcile_all()
         self.garbage_collection.reconcile()
+        self.metrics_controller.reconcile_all()
 
     def settle(self, max_ticks: int = 20, step_seconds: float = 3.0) -> int:
         """Tick until no pending pods or budget exhausted; returns ticks."""

@@ -14,7 +14,6 @@ from typing import List, Optional
 
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.nodeclass import TPUNodeClass
-from karpenter_tpu.cache import SSM_CACHE_TTL, TTLCache
 from karpenter_tpu.cache.ttl import Clock
 from karpenter_tpu.cloud.api import ComputeAPI, ParamStoreAPI
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements
@@ -30,21 +29,23 @@ class ResolvedImage:
 
 class ImageProvider:
     def __init__(self, compute_api: ComputeAPI, params: ParamStoreAPI, clock: Optional[Clock] = None):
+        from karpenter_tpu.providers.params import ParamStoreProvider
+
         self.compute_api = compute_api
-        self.params = params
-        self._param_cache = TTLCache(SSM_CACHE_TTL, clock)
+        # alias resolution goes through the param-store provider (the ssm
+        # provider seam in the reference); accept either a raw ParamStoreAPI
+        # (wrapped here) or a pre-built provider
+        if isinstance(params, ParamStoreProvider):
+            self.params = params
+        else:
+            self.params = ParamStoreProvider(params, clock)
 
     def invalidate_missing(self, live_ids) -> int:
         """Drop cached alias resolutions whose image id is no longer in the
         live set (mirrors the SSM-invalidation controller's contract in the
         reference, pkg/controllers/providers/ssm/invalidation); returns the
         number of entries dropped."""
-        stale = 0
-        for key, img_id in list(self._param_cache.items()):
-            if img_id is not None and img_id not in live_ids:
-                self._param_cache.delete(key)
-                stale += 1
-        return stale
+        return self.params.invalidate_missing(live_ids)
 
     def resolve(self, nodeclass: TPUNodeClass) -> List[ResolvedImage]:
         images = {i.id: i for i in self.compute_api.describe_images()}
@@ -56,7 +57,7 @@ class ImageProvider:
                 family, _, version = term.alias.partition("@")
                 for arch in ("amd64", "arm64"):
                     param = f"/images/{family.lower()}/{version or 'latest'}/{arch}"
-                    img_id = self._param_cache.get_or_compute(param, lambda p=param: self.params.get_parameter(p))
+                    img_id = self.params.get(param)
                     if img_id and img_id in images:
                         matches.append(images[img_id])
             elif term.id:

@@ -42,6 +42,7 @@ class InstanceProvider:
         unavailable: UnavailableOfferings,
         capacity_reservations=None,
         cluster_name: str = "kwok-cluster",
+        batchers=None,
     ):
         self.compute_api = compute_api
         self.subnets = subnets
@@ -49,6 +50,26 @@ class InstanceProvider:
         self.unavailable = unavailable
         self.capacity_reservations = capacity_reservations
         self.cluster_name = cluster_name
+        # optional CloudBatchers (batcher/cloud.py): the reference always
+        # routes fleet/describe/terminate through the window batcher
+        # (instance.go uses ec2Batcher unconditionally); tests may pass None
+        # to talk to the API directly
+        self.batchers = batchers
+
+    def _create_fleet(self, request: FleetRequest):
+        if self.batchers is not None:
+            return self.batchers.create_fleet.call(request)
+        return self.compute_api.create_fleet(request)
+
+    def _describe(self, ids: Sequence[str]):
+        if self.batchers is not None:
+            return self.batchers.describe_instances.call(ids)
+        return self.compute_api.describe_instances(ids)
+
+    def _terminate(self, ids: Sequence[str]):
+        if self.batchers is not None:
+            return self.batchers.terminate_instances.call(ids)
+        return self.compute_api.terminate_instances(ids)
 
     # -- create -------------------------------------------------------------
     def create(
@@ -179,16 +200,19 @@ class InstanceProvider:
             capacity_type=capacity_type,
             overrides=group_overrides,
             target_capacity=1,
+            # ownership tags only -- per-claim tags (nodeclaim name, Name)
+            # are stamped post-registration by the tagging controller, which
+            # keeps identical launches byte-identical so the fleet batcher
+            # can merge them (reference: tagging/controller.go + the
+            # whole-input DefaultHasher in batcher.go:117-124)
             tags={
                 CLUSTER_TAG: self.cluster_name,
-                NODECLAIM_TAG: claim.metadata.name,
                 NODEPOOL_TAG: claim.metadata.labels.get(wk.NODEPOOL_LABEL, ""),
                 wk.LABEL_NODECLASS: nodeclass.name,
-                "Name": f"{claim.metadata.labels.get(wk.NODEPOOL_LABEL, 'node')}-{claim.metadata.name}",
             },
         )
         try:
-            result = self.compute_api.create_fleet(request)
+            result = self._create_fleet(request)
         except KeyError as e:
             # stale launch-template cache: invalidate THIS launch's template
             # names (incl. reservation-scoped ones) and retry once (:124-128)
@@ -219,7 +243,7 @@ class InstanceProvider:
 
     # -- read / delete ------------------------------------------------------
     def get(self, instance_id: str) -> CloudInstance:
-        found = self.compute_api.describe_instances([instance_id])
+        found = self._describe([instance_id])
         if not found:
             raise NotFoundError(f"instance {instance_id} not found")
         return found[0]
@@ -229,12 +253,12 @@ class InstanceProvider:
         return self.compute_api.describe_instances(tag_filter={CLUSTER_TAG: self.cluster_name})
 
     def delete(self, instance_id: str) -> None:
-        inst = self.compute_api.describe_instances([instance_id])
+        inst = self._describe([instance_id])
         if not inst:
             raise NotFoundError(f"instance {instance_id} not found")
         if inst[0].state in ("shutting-down", "terminated"):
             return  # already going away (:206-224)
-        self.compute_api.terminate_instances([instance_id])
+        self._terminate([instance_id])
         if inst[0].capacity_reservation_id and self.capacity_reservations is not None:
             self.capacity_reservations.mark_terminated(inst[0].capacity_reservation_id)
 

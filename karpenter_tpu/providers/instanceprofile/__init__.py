@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.instanceprofile.provider import InstanceProfileProvider
+
+__all__ = ["InstanceProfileProvider"]

@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.params.provider import ParamStoreProvider
+
+__all__ = ["ParamStoreProvider"]

@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.queue.provider import QueueProvider
+
+__all__ = ["QueueProvider"]

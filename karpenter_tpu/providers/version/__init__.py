@@ -1,0 +1,3 @@
+from karpenter_tpu.providers.version.provider import VersionProvider
+
+__all__ = ["VersionProvider"]

@@ -1,0 +1,255 @@
+"""Tests for the auxiliary provider layer: instance profiles, queue,
+param store, version, cloud batchers, capacity-block expiration, and the
+nodeclaim metrics controller (SURVEY.md sections 2.1/2.2/2.5 parity)."""
+import pytest
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Pod, TPUNodeClass, labels as wk
+from karpenter_tpu.batcher.batcher import BatchOptions
+from karpenter_tpu.batcher.cloud import CloudBatchers
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.cloud.types import CapacityReservationInfo, FleetOverride, FleetRequest
+from karpenter_tpu.kwok.cloud import FakeCloud
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
+from karpenter_tpu.providers.params import ParamStoreProvider
+from karpenter_tpu.providers.queue import QueueProvider
+from karpenter_tpu.providers.version import VersionProvider
+from karpenter_tpu.scheduling import Resources
+
+
+@pytest.fixture
+def clock():
+    return FakeClock(start=10_000.0)
+
+
+@pytest.fixture
+def cloud(clock):
+    return FakeCloud(clock=clock)
+
+
+class TestInstanceProfileProvider:
+    def test_ensure_creates_and_converges(self, cloud):
+        p = InstanceProfileProvider(cloud, "test-cluster", "region-1")
+        name = p.ensure("default", "node-role")
+        prof = cloud.get_instance_profile(name)
+        assert prof is not None and prof["roles"] == ["node-role"]
+        # deterministic name, stable across calls
+        assert p.ensure("default", "node-role") == name
+        # role drift converges
+        name2 = p.ensure("default", "other-role")
+        assert name2 == name
+        assert cloud.get_instance_profile(name)["roles"] == ["other-role"]
+
+    def test_delete_removes_managed_profile(self, cloud):
+        p = InstanceProfileProvider(cloud, "test-cluster")
+        name = p.ensure("default", "r")
+        p.delete("default")
+        assert cloud.get_instance_profile(name) is None
+        p.delete("default")  # idempotent
+
+    def test_names_disambiguate_clusters(self, cloud):
+        a = InstanceProfileProvider(cloud, "cluster-a")
+        b = InstanceProfileProvider(cloud, "cluster-b")
+        assert a.profile_name("default") != b.profile_name("default")
+
+
+class TestQueueProvider:
+    def test_receive_and_delete(self, cloud):
+        q = QueueProvider(cloud)
+        assert q.url()
+        q.send('{"kind": "noop"}')
+        msgs = q.receive()
+        assert len(msgs) == 1
+        q.delete(msgs[0].receipt)
+        assert q.receive() == []
+
+    def test_url_passthrough(self, cloud):
+        q = QueueProvider(cloud)
+        assert q.url() == cloud.queue_url()
+
+
+class TestParamStoreProvider:
+    def test_cached_get(self, cloud, clock):
+        p = ParamStoreProvider(cloud, clock)
+        key = "/images/standard/latest/amd64"
+        v1 = p.get(key)
+        assert v1 is not None
+        # upstream change invisible until TTL expiry or invalidation
+        assert p.get(key) == v1
+
+    def test_negative_caching(self, cloud, clock):
+        p = ParamStoreProvider(cloud, clock)
+        assert p.get("/images/nope/latest/amd64") is None
+        assert any(k == "/images/nope/latest/amd64" for k, _ in p.items())
+
+    def test_invalidate_missing(self, cloud, clock):
+        p = ParamStoreProvider(cloud, clock)
+        key = "/images/standard/latest/amd64"
+        val = p.get(key)
+        assert p.invalidate_missing({val}) == 0
+        assert p.invalidate_missing(set()) == 1
+        assert not any(k == key for k, _ in p.items())
+
+
+class TestVersionProvider:
+    def test_discovers_and_caches(self, cloud, clock):
+        v = VersionProvider(cloud, clock)
+        ver = v.get()
+        assert ver and "." in ver
+        assert v.supported()
+
+    def test_validation_window(self, clock):
+        class OldCluster:
+            def cluster_endpoint(self):
+                return "https://x"
+
+            def cluster_version(self):
+                return "1.12"
+
+            def cluster_ca_bundle(self):
+                return ""
+
+        v = VersionProvider(OldCluster(), clock)
+        assert v.get() == "1.12"
+        assert not v.supported()
+        assert "below minimum" in v.validation_message
+
+
+class TestCloudBatchers:
+    def _lt(self, cloud):
+        from karpenter_tpu.cloud.types import LaunchTemplateInfo
+
+        cloud.create_launch_template(LaunchTemplateInfo(id="", name="lt-b", image_id="img-std-amd64", security_group_ids=["sg-nodes"]))
+
+    def test_identical_fleet_requests_merge(self, cloud, clock):
+        self._lt(cloud)
+        b = CloudBatchers(cloud, options=BatchOptions(), clock=clock)
+        t = next(t for t in cloud.describe_instance_types() if t.name == "m5.large")
+        subnet = next(s for s in cloud.describe_subnets() if s.zone == t.zones[0])
+        req = lambda: FleetRequest(
+            "lt-b", "on-demand", [FleetOverride("m5.large", subnet.id, t.zones[0])], target_capacity=1
+        )
+        import threading
+
+        results = []
+        # two concurrent identical requests coalesce into one fleet call
+        threads = [threading.Thread(target=lambda: results.append(b.create_fleet.call(req()))) for _ in range(2)]
+        calls_before = b.create_fleet.batcher.batches_executed
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len(results) == 2
+        ids = {r.instances[0].id for r in results if r.instances}
+        assert len(ids) == 2  # each caller got its own instance
+        assert b.create_fleet.batcher.batches_executed >= calls_before + 1
+
+    def test_describe_batch_fans_results_back(self, cloud, clock):
+        self._lt(cloud)
+        b = CloudBatchers(cloud, clock=clock)
+        t = next(t for t in cloud.describe_instance_types() if t.name == "m5.large")
+        subnet = next(s for s in cloud.describe_subnets() if s.zone == t.zones[0])
+        r = cloud.create_fleet(
+            FleetRequest("lt-b", "on-demand", [FleetOverride("m5.large", subnet.id, t.zones[0])])
+        )
+        iid = r.instances[0].id
+        got = b.describe_instances.call([iid])
+        assert [i.id for i in got] == [iid]
+        assert b.describe_instances.call(["i-missing"]) == []
+
+    def test_terminate_batch(self, cloud, clock):
+        self._lt(cloud)
+        b = CloudBatchers(cloud, clock=clock)
+        t = next(t for t in cloud.describe_instance_types() if t.name == "m5.large")
+        subnet = next(s for s in cloud.describe_subnets() if s.zone == t.zones[0])
+        r = cloud.create_fleet(
+            FleetRequest("lt-b", "on-demand", [FleetOverride("m5.large", subnet.id, t.zones[0])])
+        )
+        iid = r.instances[0].id
+        assert b.terminate_instances.call([iid]) == [iid]
+        assert cloud.describe_instances([iid])[0].state == "terminated"
+
+
+class TestCapacityBlockExpiration:
+    def test_expiring_block_drains_claims_ahead_of_cliff(self, clock):
+        op = Operator(clock=clock)
+        end = clock.now() + 3600.0
+        op.cloud.add_capacity_reservation(
+            CapacityReservationInfo(
+                id="cb-1", instance_type="m5.large", zone="zone-a",
+                total_count=2, available_count=2,
+                reservation_type="capacity-block", end_time=end,
+            )
+        )
+        claim = NodeClaim("blocked")
+        claim.metadata.labels[wk.LABEL_CAPACITY_RESERVATION_ID] = "cb-1"
+        op.cluster.create(claim)
+        # far from the cliff: nothing happens
+        assert op.reservation_expiration.reconcile_all() == 0
+        # inside the 10-minute lead: drain begins
+        clock.set(end - 300.0)
+        assert op.reservation_expiration.reconcile_all() == 1
+        refreshed = op.cluster.try_get(NodeClaim, "blocked")
+        assert refreshed is None or refreshed.deleting
+
+    def test_default_odcr_not_expired(self, clock):
+        op = Operator(clock=clock)
+        end = clock.now() + 3600.0
+        op.cloud.add_capacity_reservation(
+            CapacityReservationInfo(
+                id="odcr-1", instance_type="m5.large", zone="zone-a",
+                total_count=2, available_count=2,
+                reservation_type="default", end_time=end,
+            )
+        )
+        claim = NodeClaim("reserved")
+        claim.metadata.labels[wk.LABEL_CAPACITY_RESERVATION_ID] = "odcr-1"
+        op.cluster.create(claim)
+        clock.set(end - 60.0)
+        # default ODCRs flip to on-demand at expiry (capacitytype controller),
+        # they are not drained ahead of time
+        assert op.reservation_expiration.reconcile_all() == 0
+
+
+class TestMetricsController:
+    def test_emits_and_prunes_series(self, clock):
+        from karpenter_tpu.controllers.metrics_controller import INSTANCE_INFO
+
+        op = Operator(clock=clock)
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(Pod("p-1", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        op.settle()
+        claims = op.cluster.list(NodeClaim)
+        assert claims
+        n = op.metrics_controller.reconcile_all()
+        assert n == len(claims)
+        c = claims[0]
+        assert (
+            INSTANCE_INFO.value(
+                nodeclaim=c.metadata.name,
+                instance_type=c.metadata.labels.get(wk.INSTANCE_TYPE_LABEL, ""),
+                zone=c.metadata.labels.get(wk.ZONE_LABEL, ""),
+                capacity_type=c.metadata.labels.get(wk.CAPACITY_TYPE_LABEL, ""),
+                nodepool=c.metadata.labels.get(wk.NODEPOOL_LABEL, ""),
+                reservation_id="",
+            )
+            == 1.0
+        )
+
+
+class TestE2EStillTagsClaims:
+    def test_per_claim_tags_applied_post_registration(self, clock):
+        """Per-claim tags moved out of the fleet request (so the batcher can
+        merge identical launches); the tagging controller must still stamp
+        them by the time provisioning settles."""
+        op = Operator(clock=clock)
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(Pod("p-1", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        op.settle()
+        insts = op.cloud.describe_instances()
+        assert len(insts) == 1
+        claim = op.cluster.list(NodeClaim)[0]
+        assert insts[0].tags["karpenter.sh/nodeclaim"] == claim.metadata.name
+        assert insts[0].tags["Name"] == claim.node_name
