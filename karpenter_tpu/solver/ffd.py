@@ -200,7 +200,7 @@ def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words
 
     def step(carry, xs):
         accum, gmask, gzc, n_open = carry
-        req_c, count_c, compat_c, azc_c, fresh_mask, per_new = xs
+        req_c, count_c, compat_c, azc_c, fresh_mask, n_fresh_row, per_new = xs
 
         # -- joint feasibility of class c on each open group ---------------
         gzc_new = gzc & azc_c                                     # [G] u32
@@ -231,11 +231,23 @@ def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words
         still_unplaced = count_c - jnp.sum(take_all)
 
         # -- update carry ---------------------------------------------------
+        # The gmask invariant -- cap[k] >= accum[g] on every axis for every
+        # surviving (g, k) -- lets post-placement feasibility be read off the
+        # fit counts already in hand: axes with req == 0 are untouched (the
+        # invariant carries over), and axes with req > 0 still fit iff the
+        # pods taken do not exceed the per-type fit count. This replaces a
+        # second [G, K, R] pass (cap >= accum') with [G, K] compares.
         accum2 = accum + take_all[:, None].astype(jnp.float32) * req_c[None, :]
-        fits_now = jnp.all(inp.cap[None, :, :] >= accum2[:, None, :], axis=-1)  # [G, K]
+        takef = take_all.astype(jnp.float32)
         touched_existing = take > 0
-        gmask2 = jnp.where(touched_existing[:, None], m & fits_now, gmask)
-        gmask2 = jnp.where(is_new[:, None], fresh_mask[None, :] & fits_now, gmask2)
+        gmask2 = jnp.where(
+            touched_existing[:, None], m & (takef[:, None] <= n_fit), gmask
+        )
+        gmask2 = jnp.where(
+            is_new[:, None],
+            fresh_mask[None, :] & (takef[:, None] <= n_fresh_row[None, :]),
+            gmask2,
+        )
         gzc2 = jnp.where(touched_existing, gzc_new, gzc)
         gzc2 = jnp.where(is_new, azc_c, gzc2)
         n_open2 = n_open + n_new
@@ -248,7 +260,7 @@ def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words
         jnp.zeros((g_max,), jnp.uint32),
         jnp.int32(0),
     )
-    xs = (inp.req, inp.count, compat, azc, fresh_mask_all, per_new_all)
+    xs = (inp.req, inp.count, compat, azc, fresh_mask_all, n_fresh_all, per_new_all)
     (accum, gmask, gzc, n_open), (take, unplaced) = jax.lax.scan(step, init, xs)
     gzone, gcap = _unpack_zc(gzc, Z, CTn)
     return SolveOutputs(
