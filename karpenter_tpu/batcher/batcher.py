@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 from concurrent.futures import Future
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Hashable, List, Optional, Sequence, Tuple, TypeVar
 
@@ -66,6 +67,8 @@ class Batcher(Generic[T, U]):
         self.batch_sizes: List[int] = []  # metrics (pkg/batcher/metrics.go)
         self._background = background
         self._stop = threading.Event()
+        self._window_expected = 0
+        self._window_arrived = 0
         if background:
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
@@ -75,6 +78,7 @@ class Batcher(Generic[T, U]):
         fut: Future = Future()
         now = self.clock.now()
         ready = None
+        flush_all = False
         with self._lock:
             key = self.hasher(item)
             bucket = self._buckets.get(key)
@@ -83,18 +87,63 @@ class Batcher(Generic[T, U]):
             bucket.items.append(item)
             bucket.futures.append(fut)
             bucket.last_at = now
+            if self._window_expected > 0:
+                self._window_arrived += 1
+                if self._window_arrived >= self._window_expected:
+                    self._window_expected = 0
+                    self._window_arrived = 0
+                    flush_all = True
             if len(bucket.items) >= self.options.max_items:
                 ready = self._buckets.pop(key)
         if ready is not None:
             self._execute(ready)
+        if flush_all:
+            self.flush(force=True)
         return fut
+
+    @contextmanager
+    def window(self, expected: int):
+        """Rendezvous batching for foreground callers: treat the next
+        `expected` add()s as one batching window -- the last arrival
+        flushes, so concurrent identical requests merge deterministically
+        instead of racing each caller's own force-flush. This is the 35 ms
+        idle window collapsed to an exact count, usable because the caller
+        (the provisioner's launch fan-out) knows its own parallelism; a
+        straggler that never arrives is covered by the idle timeout in
+        call(). Overlapping windows compose additively (the rendezvous
+        fires when the combined expectation is met); exit subtracts only
+        this window's share so a concurrent window is not clobbered."""
+        with self._lock:
+            self._window_expected += expected
+        try:
+            yield
+        finally:
+            flush_now = False
+            with self._lock:
+                self._window_expected = max(0, self._window_expected - expected)
+                if self._window_expected == 0 or self._window_arrived >= self._window_expected:
+                    self._window_expected = 0
+                    self._window_arrived = 0
+                    flush_now = True
+            if flush_now:
+                self.flush(force=True)
 
     def call(self, item: T) -> U:
         """Submit and block (synchronous callers); in step-driven mode the
         caller must flush from another thread or use add()+flush()."""
         fut = self.add(item)
-        if not self._background:
-            self.flush(force=True)
+        if self._background:
+            return fut.result()
+        while not fut.done():
+            with self._lock:
+                windowed = self._window_expected > 0
+            if not windowed:
+                self.flush(force=True)
+                break
+            try:
+                return fut.result(timeout=self.options.idle_seconds)
+            except TimeoutError:
+                self.flush(force=True)
         return fut.result()
 
     # -- window management --------------------------------------------------

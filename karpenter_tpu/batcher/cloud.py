@@ -40,6 +40,18 @@ class CloudBatchers:
             b.batcher.stop()
 
 
+def _union_ids(id_groups: Sequence[Tuple[str, ...]]) -> List[str]:
+    """Order-preserving union of the waiters' id groups."""
+    union: List[str] = []
+    seen = set()
+    for ids in id_groups:
+        for i in ids:
+            if i not in seen:
+                seen.add(i)
+                union.append(i)
+    return union
+
+
 def _fleet_key(req: FleetRequest) -> Tuple:
     return (
         req.launch_template_name,
@@ -104,14 +116,7 @@ class DescribeInstancesBatcher:
         return self.batcher.call(tuple(ids))
 
     def _exec(self, id_groups: Sequence[Tuple[str, ...]]) -> List[list]:
-        union: List[str] = []
-        seen = set()
-        for ids in id_groups:
-            for i in ids:
-                if i not in seen:
-                    seen.add(i)
-                    union.append(i)
-        found = self.compute_api.describe_instances(union)
+        found = self.compute_api.describe_instances(_union_ids(id_groups))
         by_id: Dict[str, object] = {inst.id: inst for inst in found}
         return [[by_id[i] for i in ids if i in by_id] for ids in id_groups]
 
@@ -129,12 +134,5 @@ class TerminateInstancesBatcher:
         return self.batcher.call(tuple(ids))
 
     def _exec(self, id_groups: Sequence[Tuple[str, ...]]) -> List[list]:
-        union: List[str] = []
-        seen = set()
-        for ids in id_groups:
-            for i in ids:
-                if i not in seen:
-                    seen.add(i)
-                    union.append(i)
-        terminated = set(self.compute_api.terminate_instances(union))
+        terminated = set(self.compute_api.terminate_instances(_union_ids(id_groups)))
         return [[i for i in ids if i in terminated] for ids in id_groups]

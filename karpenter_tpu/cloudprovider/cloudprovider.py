@@ -59,6 +59,9 @@ class CloudProvider:
         self.instance_types = instance_types
         self.instances = instances
 
+    def launch_window(self, expected: int):
+        return self.instances.launch_window(expected)
+
     def name(self) -> str:
         return self.NAME
 

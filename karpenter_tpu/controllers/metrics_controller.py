@@ -42,11 +42,11 @@ class MetricsController:
             if not claim.launched():
                 continue
             labels = self._labels_of(claim)
-            live[claim.metadata.name] = tuple(labels.values())
+            live[claim.metadata.name] = tuple(labels[n] for n in INSTANCE_INFO.label_names)
             INSTANCE_INFO.set(1.0, **labels)
         # prune series for claims that disappeared or changed dimensions --
         # remove, never zero, so claim churn cannot grow cardinality
-        label_names = ("nodeclaim", "instance_type", "zone", "capacity_type", "nodepool", "reservation_id")
+        label_names = INSTANCE_INFO.label_names
         for name, values in list(self._series.items()):
             if live.get(name) != values:
                 INSTANCE_INFO.remove(**dict(zip(label_names, values)))

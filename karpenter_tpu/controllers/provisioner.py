@@ -8,6 +8,7 @@ simulation as virtual nodes so repeated ticks don't double-provision.
 """
 from __future__ import annotations
 
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 import time
@@ -139,13 +140,18 @@ class Provisioner:
             # cloud call only -- cluster mutations stay on the caller thread
             self.cloud_provider.create(claim)
 
-        from concurrent.futures import ThreadPoolExecutor
-
         if len(claims) == 1:
             outcomes = [self._try_launch(launch_one, claims[0])]
         else:
-            with ThreadPoolExecutor(max_workers=self.MAX_CONCURRENT_LAUNCHES) as pool:
-                outcomes = list(pool.map(lambda c: self._try_launch(launch_one, c), claims))
+            # the launch fan-out announces its size to the fleet batcher so
+            # identical requests rendezvous into one merged fleet call; the
+            # expectation is capped at the worker-pool size -- only that many
+            # calls can be in flight at once, and an expectation the pool
+            # cannot satisfy would stall every wave on the idle timeout
+            expected = min(len(claims), self.MAX_CONCURRENT_LAUNCHES)
+            with self.cloud_provider.launch_window(expected):
+                with ThreadPoolExecutor(max_workers=self.MAX_CONCURRENT_LAUNCHES) as pool:
+                    outcomes = list(pool.map(lambda c: self._try_launch(launch_one, c), claims))
         for group, claim, err in zip(groups, claims, outcomes):
             if err is None:
                 self.cluster.update(claim)

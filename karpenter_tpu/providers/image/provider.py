@@ -39,13 +39,16 @@ class ImageProvider:
             self.params = params
         else:
             self.params = ParamStoreProvider(params, clock)
+        self._alias_params = set()  # param keys this provider resolved
 
     def invalidate_missing(self, live_ids) -> int:
         """Drop cached alias resolutions whose image id is no longer in the
         live set (mirrors the SSM-invalidation controller's contract in the
         reference, pkg/controllers/providers/ssm/invalidation); returns the
-        number of entries dropped."""
-        return self.params.invalidate_missing(live_ids)
+        number of entries dropped. Scoped to the alias params this provider
+        resolved -- the param store is shared, and other consumers' values
+        are not image ids."""
+        return self.params.invalidate_missing(live_ids, keys=self._alias_params)
 
     def resolve(self, nodeclass: TPUNodeClass) -> List[ResolvedImage]:
         images = {i.id: i for i in self.compute_api.describe_images()}
@@ -57,6 +60,7 @@ class ImageProvider:
                 family, _, version = term.alias.partition("@")
                 for arch in ("amd64", "arm64"):
                     param = f"/images/{family.lower()}/{version or 'latest'}/{arch}"
+                    self._alias_params.add(param)
                     img_id = self.params.get(param)
                     if img_id and img_id in images:
                         matches.append(images[img_id])

@@ -61,6 +61,15 @@ class InstanceProvider:
             return self.batchers.create_fleet.call(request)
         return self.compute_api.create_fleet(request)
 
+    def launch_window(self, expected: int):
+        """Batching-window rendezvous for a fan-out of `expected` concurrent
+        create() calls (no-op without batchers)."""
+        from contextlib import nullcontext
+
+        if self.batchers is None:
+            return nullcontext()
+        return self.batchers.create_fleet.batcher.window(expected)
+
     def _describe(self, ids: Sequence[str]):
         if self.batchers is not None:
             return self.batchers.describe_instances.call(ids)

@@ -36,11 +36,16 @@ class ParamStoreProvider:
     def flush(self) -> None:
         self._cache.flush()
 
-    def invalidate_missing(self, live_values) -> int:
+    def invalidate_missing(self, live_values, keys=None) -> int:
         """Drop entries whose cached value is not in the live set; returns
-        the number dropped (the ssm-invalidation controller's contract)."""
+        the number dropped (the ssm-invalidation controller's contract).
+        `keys` scopes the sweep: the param store is shared by consumers
+        whose values are not image ids, and an unscoped sweep would evict
+        their entries on every reconcile."""
         stale = 0
         for key, value in list(self._cache.items()):
+            if keys is not None and key not in keys:
+                continue
             if value is not None and value not in live_values:
                 self._cache.delete(key)
                 stale += 1

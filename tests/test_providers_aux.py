@@ -144,6 +144,53 @@ class TestCloudBatchers:
         assert len(ids) == 2  # each caller got its own instance
         assert b.create_fleet.batcher.batches_executed >= calls_before + 1
 
+    def test_window_rendezvous_merges_exactly_one_batch(self, cloud, clock):
+        """With the launch fan-out announcing its size, N identical
+        concurrent requests merge into exactly ONE fleet call (the batching
+        window of createfleet.go made deterministic)."""
+        import threading
+
+        self._lt(cloud)
+        b = CloudBatchers(cloud, options=BatchOptions(), clock=clock)
+        t = next(t for t in cloud.describe_instance_types() if t.name == "m5.large")
+        subnet = next(s for s in cloud.describe_subnets() if s.zone == t.zones[0])
+        req = lambda: FleetRequest(
+            "lt-b", "on-demand", [FleetOverride("m5.large", subnet.id, t.zones[0])], target_capacity=1
+        )
+        n = 6
+        results = []
+        lock = threading.Lock()
+
+        def call_one():
+            r = b.create_fleet.call(req())
+            with lock:
+                results.append(r)
+
+        before = b.create_fleet.batcher.batches_executed
+        with b.create_fleet.batcher.window(n):
+            threads = [threading.Thread(target=call_one) for _ in range(n)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        assert len(results) == n
+        assert b.create_fleet.batcher.batches_executed == before + 1
+        ids = {r.instances[0].id for r in results if r.instances}
+        assert len(ids) == n  # one distinct instance dealt to each waiter
+
+    def test_window_straggler_does_not_deadlock(self, cloud, clock):
+        """A window expecting more arrivals than occur still completes: the
+        idle timeout flushes what arrived."""
+        self._lt(cloud)
+        b = CloudBatchers(cloud, options=BatchOptions(idle_seconds=0.01), clock=clock)
+        t = next(t for t in cloud.describe_instance_types() if t.name == "m5.large")
+        subnet = next(s for s in cloud.describe_subnets() if s.zone == t.zones[0])
+        with b.create_fleet.batcher.window(3):  # only 1 arrives
+            r = b.create_fleet.call(
+                FleetRequest("lt-b", "on-demand", [FleetOverride("m5.large", subnet.id, t.zones[0])], target_capacity=1)
+            )
+        assert len(r.instances) == 1
+
     def test_describe_batch_fans_results_back(self, cloud, clock):
         self._lt(cloud)
         b = CloudBatchers(cloud, clock=clock)
