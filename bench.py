@@ -163,8 +163,25 @@ def main() -> None:
     n_open = int(dec.n_open)
     placed = int(dec.val.sum())
     assert placed + int(dec.unplaced.sum()) == int(workloads[0].count.sum()), "pod conservation violated"
-    for _ in range(WARMUP - 1):
+    # adaptive warmup: the chip sits behind a network tunnel whose first
+    # seconds after idle can be pathologically slow (seconds per solve);
+    # warm until solve time stabilizes near its observed floor so the
+    # measurement reflects steady state, not transport cold-start
+    best = float("inf")
+    stable = 0
+    for _ in range(60):
+        t0 = time.perf_counter()
         solve(workloads[0])
+        dt = time.perf_counter() - t0
+        if dt < best * 0.9:
+            stable = 0  # still improving markedly: not yet at steady state
+        elif dt <= best * 1.3:
+            stable += 1
+            if stable >= WARMUP:
+                break
+        else:
+            stable = 0
+        best = min(best, dt)
 
     times = []
     for i in range(ITERS):

@@ -1,0 +1,93 @@
+"""Binary entry point: compose the operator and run the controller loop.
+
+The analogue of cmd/controller/main.go:30-84 (operator construction, flag
+parsing, controller registration, manager start) combined with kwok/main.go
+(the in-memory cloud stands in for a real account, so the full stack --
+providers, batchers, CloudProvider, all reconcilers, the TPU decision
+plane -- runs self-contained). Flags mirror pkg/operator/options/options.go.
+
+    python -m karpenter_tpu --help
+    python -m karpenter_tpu --max-ticks 50 --tick-interval 0.1
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+
+def build_operator(args):
+    from karpenter_tpu.operator import Operator, Options
+
+    options = Options(
+        cluster_name=args.cluster_name,
+        interruption_queue=args.interruption_queue,
+        vm_memory_overhead_percent=args.vm_memory_overhead_percent,
+        reserved_nics=args.reserved_nics,
+        isolated_network=args.isolated_network,
+    )
+    solver = None
+    evaluator = None
+    if args.tpu_solver:
+        from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
+        from karpenter_tpu.solver.service import TPUSolver
+
+        solver = TPUSolver()
+        evaluator = ConsolidationEvaluator()
+    return Operator(options=options, solver=solver, consolidation_evaluator=evaluator)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="karpenter-tpu", description="TPU-native node provisioning controller (kwok rig)"
+    )
+    parser.add_argument("--cluster-name", default="kwok-cluster")
+    parser.add_argument("--interruption-queue", default="interruption-queue")
+    parser.add_argument("--vm-memory-overhead-percent", type=float, default=0.075)
+    parser.add_argument("--reserved-nics", type=int, default=0)
+    parser.add_argument("--isolated-network", action="store_true")
+    parser.add_argument(
+        "--tpu-solver", action=argparse.BooleanOptionalAction, default=True,
+        help="route scheduling + consolidation decisions through the accelerator",
+    )
+    parser.add_argument("--tick-interval", type=float, default=1.0, help="seconds between sweeps")
+    parser.add_argument("--max-ticks", type=int, default=0, help="stop after N sweeps (0 = run forever)")
+    parser.add_argument("--metrics-dump", action="store_true", help="print Prometheus metrics on exit")
+    args = parser.parse_args(argv)
+
+    op = build_operator(args)
+    # a default NodeClass + NodePool so the rig provisions out of the box
+    from karpenter_tpu.apis import NodePool, TPUNodeClass
+
+    if not op.cluster.list(TPUNodeClass):
+        op.cluster.create(TPUNodeClass("default"))
+    if not op.cluster.list(NodePool):
+        op.cluster.create(NodePool("default"))
+
+    stop = {"flag": False}
+
+    def on_signal(signum, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+
+    ticks = 0
+    while not stop["flag"]:
+        op.tick()
+        ticks += 1
+        if args.max_ticks and ticks >= args.max_ticks:
+            break
+        time.sleep(args.tick_interval)
+
+    if args.metrics_dump:
+        from karpenter_tpu import metrics
+
+        print(metrics.REGISTRY.expose())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -121,10 +121,13 @@ class Batcher(Generic[T, U]):
             flush_now = False
             with self._lock:
                 self._window_expected = max(0, self._window_expected - expected)
-                if self._window_expected == 0 or self._window_arrived >= self._window_expected:
-                    self._window_expected = 0
+                if self._window_expected == 0:
                     self._window_arrived = 0
                     flush_now = True
+                else:
+                    # a concurrent window remains open: surrender only this
+                    # window's arrival credit, never the shared rendezvous
+                    self._window_arrived = min(self._window_arrived, self._window_expected)
             if flush_now:
                 self.flush(force=True)
 

@@ -63,11 +63,22 @@ class Candidate:
 
 
 class DisruptionController:
-    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, pricing, feature_gates: Optional[dict] = None):
+    def __init__(
+        self,
+        cluster: Cluster,
+        cloud_provider: CloudProvider,
+        pricing,
+        feature_gates: Optional[dict] = None,
+        evaluator=None,
+    ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.pricing = pricing
         self.feature_gates = feature_gates or {}
+        # batched device evaluator (solver/consolidate.py): all candidate
+        # sets are judged in one dispatch; candidates with stateful
+        # constraints fall back to the per-candidate oracle simulation
+        self.evaluator = evaluator
         self.last_decisions: List[Tuple[str, str]] = []  # (claim name, reason)
 
     # -- helpers ------------------------------------------------------------
@@ -243,6 +254,7 @@ class DisruptionController:
             ),
             key=lambda c: c.disruption_cost,
         )
+        verdicts = self._device_verdicts(consolidatable)
         for c in consolidatable:
             if len(self.last_decisions) >= max_disruptions:
                 return self.last_decisions
@@ -258,7 +270,23 @@ class DisruptionController:
                 continue
             if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, disrupting, totals):
                 continue
-            # deletion first, then single-node replacement
+            v = verdicts.get(c.claim.metadata.name)
+            if v is not None:
+                # device verdict: deletion decisions are oracle-equivalent
+                # (differential tests); replacement is a pre-filter -- the
+                # oracle re-derives the actual group before acting
+                if v.can_delete:
+                    c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+                    self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
+                    continue
+                if not self._device_replacement_cheaper(c, v):
+                    continue
+                ok, groups = self._simulate([c], allow_new_node=True)
+                if ok and groups and self._replacement_cheaper(c, groups):
+                    c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+                    self._replace_then_disrupt(c, groups, REASON_UNDERUTILIZED, disrupting)
+                continue
+            # oracle path: deletion first, then single-node replacement
             ok, _ = self._simulate([c], allow_new_node=False)
             if ok:
                 c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
@@ -278,21 +306,94 @@ class DisruptionController:
                 if c.claim.metadata.name not in [n for n, _ in self.last_decisions]
                 and self._all_pods_evictable(c.pods)
             ]
-            k = len(remaining)
-            while k >= 2:
-                subset = remaining[:k]
-                ok, _ = self._simulate(subset, allow_new_node=False)
-                if ok:
-                    # budgets re-checked per disruption as the count grows;
-                    # deleting a prefix of the simulated subset is safe
-                    # (fewer exclusions than simulated only adds capacity)
-                    for c in subset:
-                        if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, disrupting, totals):
-                            break
-                        self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
-                    break
-                k -= 1
+            subset = self._largest_deletable_prefix(remaining)
+            if subset:
+                # budgets re-checked per disruption as the count grows;
+                # deleting a prefix of the simulated subset is safe
+                # (fewer exclusions than simulated only adds capacity)
+                for c in subset:
+                    if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, disrupting, totals):
+                        break
+                    self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
         return self.last_decisions
+
+    def _largest_deletable_prefix(self, remaining: List[Candidate]) -> List[Candidate]:
+        """Largest k such that candidates[0:k] can all be deleted with their
+        pods repacked on surviving capacity. When every candidate is
+        device-eligible, all prefixes are judged in ONE batched dispatch
+        (solver/consolidate.py) instead of up to k-1 full simulations."""
+        if len(remaining) < 2:
+            return []
+        if self.evaluator is not None:
+            from karpenter_tpu.solver.consolidate import device_eligible
+
+            resched = {
+                c.claim.metadata.name: [p for p in c.pods if p.reschedulable()]
+                for c in remaining
+            }
+            if all(device_eligible(resched[c.claim.metadata.name]) for c in remaining):
+                sets = []
+                for k in range(2, len(remaining) + 1):
+                    prefix = remaining[:k]
+                    sets.append(
+                        (
+                            [p for c in prefix for p in resched[c.claim.metadata.name]],
+                            [c.node.metadata.name for c in prefix],
+                        )
+                    )
+                verdicts = self.evaluator.evaluate(self._other_nodes([]), sets)
+                for i in range(len(verdicts) - 1, -1, -1):  # largest k first
+                    if verdicts[i].can_delete:
+                        return remaining[: i + 2]
+                return []
+        k = len(remaining)
+        while k >= 2:
+            subset = remaining[:k]
+            ok, _ = self._simulate(subset, allow_new_node=False)
+            if ok:
+                return subset
+            k -= 1
+        return []
+
+    def _device_verdicts(self, consolidatable: Sequence[Candidate]) -> Dict[str, object]:
+        """One batched device evaluation of every eligible single-node
+        candidate; ineligible candidates (stateful constraints) are absent
+        from the result and take the oracle path."""
+        if self.evaluator is None or not consolidatable:
+            return {}
+        from karpenter_tpu.solver.consolidate import device_eligible
+
+        eligible: List[Candidate] = []
+        sets = []
+        for c in consolidatable:
+            resched = [p for p in c.pods if p.reschedulable()]
+            if not resched or not device_eligible(resched):
+                continue
+            eligible.append(c)
+            sets.append((resched, [c.node.metadata.name]))
+        if not eligible:
+            return {}
+        nodepools = [p for p in self.cluster.list(NodePool) if not p.deleting]
+        catalogs: Dict[str, list] = {}
+        for pool in nodepools:
+            try:
+                catalogs[pool.name] = self.cloud_provider.get_instance_types(pool)
+            except CloudError:
+                catalogs[pool.name] = []
+        verdicts = self.evaluator.evaluate(
+            self._other_nodes([]), sets, pools=nodepools, catalogs=catalogs
+        )
+        return {c.claim.metadata.name: v for c, v in zip(eligible, verdicts)}
+
+    def _device_replacement_cheaper(self, c: Candidate, v) -> bool:
+        """Price gate over the device verdict, mirroring
+        _replacement_cheaper's spot-to-spot feature gating."""
+        price = v.replace_price
+        if c.claim.capacity_type == wk.CAPACITY_TYPE_SPOT and not self.feature_gates.get(
+            "SpotToSpotConsolidation"
+        ):
+            price = v.replace_od_price
+        return price < c.price
 
     def _drift_reason(self, c: Candidate) -> Optional[str]:
         # nodepool static drift via stamped hash

@@ -75,6 +75,7 @@ class Operator:
         clock: Optional[Clock] = None,
         options: Optional[Options] = None,
         solver=None,
+        consolidation_evaluator=None,
     ):
         self.clock = clock or Clock()
         self.options = options or Options()
@@ -135,7 +136,8 @@ class Operator:
         self.lifecycle = NodeLifecycle(self.cluster, self.cloud)
         self.termination = TerminationController(self.cluster, self.cloud_provider)
         self.disruption = DisruptionController(
-            self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates
+            self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates,
+            evaluator=consolidation_evaluator,
         )
         self.interruption = InterruptionController(
             self.cluster, self.queue, self.unavailable, self.recorder
