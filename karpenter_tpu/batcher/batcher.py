@@ -125,9 +125,12 @@ class Batcher(Generic[T, U]):
                     self._window_arrived = 0
                     flush_now = True
                 else:
-                    # a concurrent window remains open: surrender only this
-                    # window's arrival credit, never the shared rendezvous
-                    self._window_arrived = min(self._window_arrived, self._window_expected)
+                    # a concurrent window remains open: surrender this
+                    # window's arrival credit so its items cannot fire the
+                    # survivor's rendezvous early (splitting its batch).
+                    # Under-counting only delays the flush, and the idle
+                    # timeout in call() caps that delay.
+                    self._window_arrived = max(0, self._window_arrived - expected)
             if flush_now:
                 self.flush(force=True)
 

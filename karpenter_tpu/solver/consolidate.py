@@ -48,12 +48,7 @@ from karpenter_tpu.solver.oracle import ExistingNode
 
 _INF = jnp.float32(jnp.inf)
 
-
-def _bucket(n: int, lo: int = 8) -> int:
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+_bucket = encode.bucket
 
 
 # -- device kernels ----------------------------------------------------------
@@ -65,11 +60,12 @@ def _repack(
     req: jax.Array,         # [C, R] f32 per-pod request (includes pods=1)
     member: jax.Array,      # [S, C] i32 pods of class c in candidate set s
     excl: jax.Array,        # [S, N] bool node n is being deleted by set s
-) -> jax.Array:
-    """[S, C] i32: pods of class c in set s that did NOT fit on the
-    surviving nodes (first-fit decreasing, node order = oracle order)."""
+) -> Tuple[jax.Array, jax.Array]:
+    """([S, C] i32 leftovers, [S, C, N] i32 per-node placements): pods of
+    class c in set s packed first-fit-decreasing onto the surviving nodes
+    (node order = oracle order); leftover did not fit anywhere."""
 
-    def one_set(member_s: jax.Array, excl_s: jax.Array) -> jax.Array:
+    def one_set(member_s: jax.Array, excl_s: jax.Array):
         hr0 = jnp.where(excl_s[:, None], 0.0, headroom0)          # [N, R]
 
         def step(hr, xs):
@@ -83,10 +79,10 @@ def _repack(
             cum_before = jnp.cumsum(fit) - fit
             take = jnp.clip(count_c - cum_before, 0, fit)         # [N]
             hr2 = hr - take[:, None].astype(jnp.float32) * req_c[None, :]
-            return hr2, count_c - jnp.sum(take)
+            return hr2, (count_c - jnp.sum(take), take)
 
-        _, leftover = jax.lax.scan(step, hr0, (req, feas, member_s))
-        return leftover                                           # [C]
+        _, (leftover, takes) = jax.lax.scan(step, hr0, (req, feas, member_s))
+        return leftover, takes                                    # [C], [C, N]
 
     return jax.vmap(one_set)(member, excl)
 
@@ -229,7 +225,8 @@ class ConsolidationEvaluator:
                 if ni is not None:
                     excl[si, ni] = True
 
-        leftover = np.asarray(_repack(headroom, feas, req, member, excl))
+        leftover, _ = _repack(headroom, feas, req, member, excl)
+        leftover = np.asarray(leftover)
         left_total = leftover.sum(axis=1)
 
         verdicts = [

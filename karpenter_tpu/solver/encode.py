@@ -89,6 +89,14 @@ def _pad_pow2_words(n: int) -> int:
     return (n + 31) // 32
 
 
+def bucket(n: int, lo: int = 8) -> int:
+    """Round up to a power of two (compile-cache-friendly static shapes)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
 @dataclass
 class Vocab:
     """Per-dimension value vocabulary; index 0 is reserved for 'absent'."""

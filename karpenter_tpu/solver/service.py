@@ -28,12 +28,7 @@ from karpenter_tpu.solver.encode import CatalogTensors
 from karpenter_tpu.solver.oracle import NewNodeGroup, Scheduler, SchedulingResult
 
 
-def _bucket(n: int, lo: int = 8) -> int:
-    """Round up to a power of two (compile-cache friendly)."""
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+_bucket = encode.bucket
 
 
 class TPUSolver:
@@ -69,8 +64,6 @@ class TPUSolver:
     # -- routing ------------------------------------------------------------
     @staticmethod
     def supports(scheduler: Scheduler, pods: Sequence[Pod]) -> bool:
-        if scheduler.existing:
-            return False
         if len(scheduler.nodepools) != 1:
             return False
         for p in pods:
@@ -84,12 +77,16 @@ class TPUSolver:
             return scheduler.schedule(pods)
         pool = scheduler.nodepools[0]
         items = scheduler.instance_types.get(pool.name, [])
-        if not items:
+        if not items and not scheduler.existing:
             result = SchedulingResult()
             for p in pods:
                 result.unschedulable[p.metadata.name] = "no instance types for nodepool"
             return result
-        return self.solve(pool, items, pods, nodepool_usage=scheduler.usage.get(pool.name))
+        return self.solve(
+            pool, items, pods,
+            nodepool_usage=scheduler.usage.get(pool.name),
+            existing_nodes=scheduler.existing,
+        )
 
     # -- the batch solve ----------------------------------------------------
     def solve(
@@ -98,21 +95,81 @@ class TPUSolver:
         instance_types: Sequence,
         pods: Sequence[Pod],
         nodepool_usage: Optional[Resources] = None,
+        existing_nodes: Sequence = (),
     ) -> SchedulingResult:
-        catalog, staged, offsets, words = self._catalog(instance_types)
         pool_reqs = pool.requirements()
         classes = encode.group_pods(pods, extra_requirements=pool_reqs)
+        result = SchedulingResult()
+
+        # phase 1 (device): pack onto existing capacity first, exactly as the
+        # oracle tries existing nodes before opening groups -- the same
+        # repack kernel the consolidation evaluator uses (consolidate.py)
+        placed_existing = np.zeros((len(classes),), dtype=np.int64)
+        if existing_nodes:
+            placed_existing = self._pack_existing(classes, existing_nodes, result)
+
+        remaining = int(sum(len(pc.pods) for pc in classes) - placed_existing.sum())
+        if remaining == 0:
+            return result
+        if not instance_types:
+            for c, pc in enumerate(classes):
+                for p in pc.pods[int(placed_existing[c]):]:
+                    result.unschedulable[p.metadata.name] = "no instance types for nodepool"
+            return result
+
+        # phase 2 (device): batched FFD over the leftovers
+        catalog, staged, offsets, words = self._catalog(instance_types)
         class_set = encode.encode_classes(
             classes,
             catalog,
             pool_taints=list(pool.template.taints),
             c_pad=_bucket(len(classes), self.c_pad_min),
         )
+        counts = class_set.count.copy()
+        counts[: len(classes)] -= placed_existing.astype(counts.dtype)
+        class_set.count = counts
         inp = ffd.make_inputs_staged(staged, class_set)
         out = ffd.ffd_solve(inp, g_max=self.g_max, word_offsets=offsets, words=words)
         # one batched device->host fetch (transfers overlap; a single RTT)
         out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
-        return self._decode(pool, instance_types, catalog, class_set, out, nodepool_usage)
+        return self._decode(
+            pool, instance_types, catalog, class_set, out, nodepool_usage,
+            result=result, class_offset=placed_existing,
+        )
+
+    def _pack_existing(self, classes, existing_nodes, result: SchedulingResult) -> np.ndarray:
+        """First-fit pods onto live/in-flight nodes on device; fills
+        result.existing_assignments and returns per-class placed counts."""
+        from karpenter_tpu.solver import consolidate
+
+        C = _bucket(len(classes), self.c_pad_min)
+        N = _bucket(len(existing_nodes), 16)
+        req = np.zeros((C, encode.R), dtype=np.float32)
+        member = np.zeros((1, C), dtype=np.int32)
+        for i, pc in enumerate(classes):
+            req[i] = pc.requests
+            member[0, i] = len(pc.pods)
+        feas = np.zeros((C, N), dtype=bool)
+        feas[: len(classes), : len(existing_nodes)] = consolidate._node_feasibility(
+            classes, existing_nodes
+        )
+        headroom = np.zeros((N, encode.R), dtype=np.float32)
+        for ni, node in enumerate(existing_nodes):
+            headroom[ni] = encode.scale_vector(node.remaining().to_vector())
+        _, takes = consolidate._repack(
+            headroom, feas, req, member, np.zeros((1, N), dtype=bool)
+        )
+        takes = np.asarray(takes[0])                       # [C, N]
+        placed = np.zeros((len(classes),), dtype=np.int64)
+        for c, pc in enumerate(classes):
+            cursor = 0
+            for ni, node in enumerate(existing_nodes):
+                n = int(takes[c, ni])
+                for p in pc.pods[cursor : cursor + n]:
+                    result.existing_assignments[p.metadata.name] = node.name
+                cursor += n
+            placed[c] = cursor
+        return placed
 
     def _decode(
         self,
@@ -122,8 +179,13 @@ class TPUSolver:
         class_set,
         out: ffd.SolveOutputs,
         nodepool_usage: Optional[Resources],
+        result: Optional[SchedulingResult] = None,
+        class_offset: Optional[np.ndarray] = None,
     ) -> SchedulingResult:
-        result = SchedulingResult()
+        if result is None:
+            result = SchedulingResult()
+        if class_offset is None:
+            class_offset = np.zeros((class_set.c_real,), dtype=np.int64)
         take = np.asarray(out.take)                    # [C, G]
         unplaced = np.asarray(out.unplaced)            # [C]
         n_open = int(out.n_open)
@@ -146,10 +208,11 @@ class TPUSolver:
             for c in classes_on_g:
                 pc = class_set.classes[c]
                 n = int(take[c, g])
-                already = int(take[c, :g].sum())
-                group_pods.extend(pc.pods[already : already + n])
+                # pods before `off` went to existing nodes in phase 1
+                off = int(class_offset[c]) + int(take[c, :g].sum())
+                group_pods.extend(pc.pods[off : off + n])
                 reqs.add(*pc.requirements)
-                for p in pc.pods[already : already + n]:
+                for p in pc.pods[off : off + n]:
                     requested = requested + p.requests + Resources.from_base_units({res.PODS: 1})
             type_names = [catalog.names[k] for k in np.nonzero(gmask[g][: catalog.k_real])[0]]
             group_types = [by_name[n] for n in type_names if n in by_name]
@@ -185,7 +248,7 @@ class TPUSolver:
             n_un = int(unplaced[c])
             if n_un > 0:
                 pc = class_set.classes[c]
-                placed = int(take[c].sum())
+                placed = int(class_offset[c]) + int(take[c].sum())
                 for p in pc.pods[placed : placed + n_un]:
                     result.unschedulable[p.metadata.name] = "no instance type fits pod requirements"
         return result

@@ -217,6 +217,87 @@ class TestDifferentialFFD:
         assert _signature(o) == _signature(s), f"seed {seed}"
 
 
+class TestExistingNodePrepack:
+    """The device existing-node pre-pass must match the oracle's
+    existing-first placement (oracle._try_existing before any new group)."""
+
+    def _existing(self, name, cpu_m, mem_mib, used_cpu_m=0):
+        from karpenter_tpu.solver.oracle import ExistingNode
+
+        return ExistingNode(
+            name=name,
+            labels={wk.HOSTNAME_LABEL: name, wk.ZONE_LABEL: "us-central-1a"},
+            allocatable=Resources.from_base_units(
+                {res.CPU: cpu_m, res.MEMORY: mem_mib * 2**20, res.PODS: 110}
+            ),
+            used=Resources.from_base_units({res.CPU: used_cpu_m}),
+        )
+
+    def _both(self, pool, items, pods, nodes):
+        def fresh(ns):
+            from karpenter_tpu.solver.oracle import ExistingNode
+
+            return [
+                ExistingNode(name=n.name, labels=dict(n.labels), allocatable=n.allocatable,
+                             taints=list(n.taints), used=n.used)
+                for n in ns
+            ]
+
+        oracle = Scheduler(
+            nodepools=[pool], instance_types={pool.name: items},
+            existing_nodes=fresh(nodes),
+            zones={o.zone for it in items for o in it.available_offerings()},
+        ).schedule(list(pods))
+        solver = TPUSolver(g_max=256)
+        device = solver.solve(pool, items, list(pods), existing_nodes=fresh(nodes))
+        return oracle, device
+
+    def test_pods_prefer_existing_capacity(self, catalog_items):
+        pool = NodePool("default")
+        nodes = [self._existing("n0", 4000, 8192), self._existing("n1", 4000, 8192)]
+        pods = [make_pod(f"p{i}", "1", 1) for i in range(6)]
+        oracle, device = self._both(pool, catalog_items, pods, nodes)
+        # 6 cpu fits on 8 cpu of existing capacity: no new nodes either way
+        assert not oracle.new_groups and not device.new_groups
+        assert not oracle.unschedulable and not device.unschedulable
+        assert oracle.existing_assignments == device.existing_assignments
+
+    def test_overflow_opens_groups_for_the_remainder(self, catalog_items):
+        pool = NodePool("default")
+        nodes = [self._existing("n0", 2000, 4096)]
+        pods = [make_pod(f"p{i}", "1", 1) for i in range(5)]
+        oracle, device = self._both(pool, catalog_items, pods, nodes)
+        assert oracle.existing_assignments == device.existing_assignments
+        assert len(oracle.new_groups) == len(device.new_groups)
+        assert _signature(oracle) == _signature(device)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_with_existing(self, catalog_items, seed):
+        rng = np.random.default_rng(1000 + seed)
+        pool = NodePool("default")
+        nodes = [
+            self._existing(
+                f"n{i}",
+                int(rng.choice([2000, 4000, 8000])),
+                int(rng.choice([4096, 8192, 16384])),
+                used_cpu_m=int(rng.integers(0, 1500)),
+            )
+            for i in range(int(rng.integers(1, 5)))
+        ]
+        pods = []
+        for shape in range(int(rng.integers(1, 5))):
+            cpu_m = int(rng.choice([250, 500, 1000, 2000]))
+            mem_mi = int(rng.choice([256, 1024, 4096]))
+            for i in range(int(rng.integers(1, 15))):
+                pods.append(
+                    Pod(f"s{shape}-{i}", requests=Resources({"cpu": cpu_m, "memory": float(mem_mi * 2**20)}))
+                )
+        oracle, device = self._both(pool, catalog_items, pods, nodes)
+        assert oracle.existing_assignments == device.existing_assignments, f"seed {seed}"
+        assert set(oracle.unschedulable) == set(device.unschedulable), f"seed {seed}"
+        assert _signature(oracle) == _signature(device), f"seed {seed}"
+
+
 class TestSolverInProvisioner:
     def test_solver_backed_end_to_end(self):
         from karpenter_tpu.cache.ttl import FakeClock
