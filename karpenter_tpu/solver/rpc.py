@@ -1,0 +1,309 @@
+"""Solver service boundary: the decision plane as a network sidecar.
+
+SURVEY.md section 2.4/5 maps the reference's cloud-RPC seam (aws-sdk over
+HTTPS with batching) to an RPC boundary between the host-side reconcilers
+and the solver process on the TPU VM. This module implements that boundary
+as a dependency-free length-prefixed binary protocol over TCP (the image
+ships no grpc; the frame layout below is trivially portable to gRPC
+streaming messages later):
+
+    frame := u32 header_len | header_json | payload_bytes
+    header := {"op"|"ok": ..., meta..., "tensors": [{name, dtype, shape}]}
+    payload := the tensors' raw little-endian buffers, concatenated
+
+Design constraints carried over from the in-process solver (SURVEY.md
+section 7 hard part #6 -- the 100 ms budget leaves no room for re-shipping
+state): the catalog tensors are staged on the server ONCE per catalog
+seqnum (`stage` op); each `solve` ships only the pod-class tensors
+(~100 KB at 50k-pod scale) and returns the solve outputs; connections are
+persistent (one socket, many solves).
+
+Server-side compute = the same jitted kernels the in-process path uses
+(solver/ffd.py), so differential guarantees carry over unchanged.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.solver import encode, ffd
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 256 * 1024 * 1024
+
+
+# -- framing -----------------------------------------------------------------
+
+def _send_frame(sock: socket.socket, header: dict, tensors: Sequence[Tuple[str, np.ndarray]] = ()) -> None:
+    header = dict(header)
+    header["tensors"] = [
+        {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)} for name, a in tensors
+    ]
+    hb = json.dumps(header).encode()
+    parts = [_LEN.pack(len(hb)), hb]
+    for _, a in tensors:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    sock.sendall(b"".join(parts))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket) -> Tuple[dict, Dict[str, np.ndarray]]:
+    (hlen,) = _LEN.unpack(_recv_exact(sock, 4))
+    if hlen > MAX_FRAME:
+        raise ConnectionError(f"oversized header ({hlen} bytes)")
+    header = json.loads(_recv_exact(sock, hlen))
+    tensors: Dict[str, np.ndarray] = {}
+    total = 0
+    for spec in header.get("tensors", ()):
+        dtype = np.dtype(spec["dtype"])
+        shape = [int(s) for s in spec["shape"]]
+        if any(s < 0 for s in shape):
+            raise ConnectionError(f"negative dimension in {spec}")
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        nbytes = count * dtype.itemsize
+        total += nbytes
+        # bound the payload BEFORE allocating: a hostile header must not be
+        # able to make the sidecar allocate unbounded buffers
+        if nbytes > MAX_FRAME or total > MAX_FRAME:
+            raise ConnectionError(f"oversized tensor payload ({total} bytes)")
+        raw = _recv_exact(sock, nbytes)
+        tensors[spec["name"]] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+    return header, tensors
+
+
+# -- server ------------------------------------------------------------------
+
+class _StagedEntry:
+    def __init__(self, staged, offsets, words):
+        self.staged = staged
+        self.offsets = offsets
+        self.words = words
+
+
+class SolverServer:
+    """Serves stage/solve/ping over persistent TCP connections. One staged
+    catalog per seqnum (bounded LRU of 4: catalogs change 12-hourly)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._staged: Dict[str, _StagedEntry] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        header, tensors = _recv_frame(self.request)
+                        outer._dispatch(self.request, header, tensors)
+                except (ConnectionError, OSError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SolverServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- ops ----------------------------------------------------------------
+    def _dispatch(self, sock, header: dict, tensors: Dict[str, np.ndarray]) -> None:
+        op = header.get("op")
+        try:
+            if op == "ping":
+                _send_frame(sock, {"ok": True})
+            elif op == "stage":
+                self._op_stage(sock, header, tensors)
+            elif op == "solve":
+                self._op_solve(sock, header, tensors)
+            else:
+                _send_frame(sock, {"ok": False, "error": f"unknown op {op!r}"})
+        except Exception as e:  # noqa: BLE001 -- errors cross the wire
+            _send_frame(sock, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+    def _op_stage(self, sock, header: dict, t: Dict[str, np.ndarray]) -> None:
+        seqnum = str(header["seqnum"])
+        words = tuple(int(w) for w in header["words"])
+        catalog = encode.CatalogTensors(
+            names=list(header["names"]), k_real=int(header["k_real"]),
+            k_pad=int(t["cap"].shape[0]), cap=t["cap"], tcode=t["tcode"],
+            tnum=t["tnum"], tnum_present=t["tnum_present"], tzone=t["tzone"],
+            tcap=t["tcap"], price=t["price"], vocabs=[], zones=list(header["zones"]),
+            words=list(words),
+        )
+        staged, offsets, words = ffd.stage_catalog(catalog)
+        with self._lock:
+            if len(self._staged) >= 4:
+                self._staged.pop(next(iter(self._staged)))
+            self._staged[seqnum] = _StagedEntry(staged, offsets, words)
+        _send_frame(sock, {"ok": True, "seqnum": seqnum})
+
+    def _op_solve(self, sock, header: dict, t: Dict[str, np.ndarray]) -> None:
+        import jax
+
+        seqnum = str(header["seqnum"])
+        with self._lock:
+            entry = self._staged.get(seqnum)
+            if entry is not None:
+                # LRU touch: re-insert so eviction pops the least recently
+                # USED catalog, not the oldest staged
+                self._staged.pop(seqnum)
+                self._staged[seqnum] = entry
+        if entry is None:
+            # the client re-stages on this error (cache-miss contract)
+            _send_frame(sock, {"ok": False, "error": "unknown-seqnum"})
+            return
+        inp = ffd.SolveInputs(
+            cap=entry.staged.cap, tcode=entry.staged.tcode, tnum=entry.staged.tnum,
+            tnum_present=entry.staged.tnum_present, tzone=entry.staged.tzone,
+            tcap=entry.staged.tcap, req=t["req"], count=t["count"],
+            allowed=t["allowed"], num_lo=t["num_lo"], num_hi=t["num_hi"],
+            azone=t["azone"], acap=t["acap"], schedulable=t["schedulable"],
+        )
+        out = ffd.ffd_solve(
+            inp, g_max=int(header["g_max"]),
+            word_offsets=entry.offsets, words=entry.words,
+        )
+        arrays = jax.device_get(tuple(out))
+        names = ffd.SolveOutputs._fields
+        _send_frame(
+            sock, {"ok": True},
+            [(n, np.asarray(a)) for n, a in zip(names, arrays)],
+        )
+
+
+# -- client ------------------------------------------------------------------
+
+class SolverClient:
+    """Drop-in backend for TPUSolver-shaped solves over the wire. Maintains
+    one persistent connection; `solve_classes` mirrors the tensor half of
+    TPUSolver.solve (the caller does host-side encode/decode)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.addr = (host, port)
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._staged_seqnums: set = set()
+        # one reentrant lock serializes the socket AND the staging set: the
+        # protocol is strictly request/response on one connection, so a
+        # whole roundtrip (and the stage-then-solve sequence inside
+        # solve_classes) must be atomic across threads
+        self._lock = threading.RLock()
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.addr, timeout=self.timeout)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._staged_seqnums.clear()
+        return self._sock
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None
+
+    def _roundtrip(self, header, tensors=()):
+        with self._lock:
+            sock = self._conn()
+            try:
+                _send_frame(sock, header, tensors)
+                return _recv_frame(sock)
+            except (ConnectionError, OSError):
+                self.close()  # one reconnect attempt per call
+                sock = self._conn()
+                _send_frame(sock, header, tensors)
+                return _recv_frame(sock)
+
+    def ping(self) -> bool:
+        header, _ = self._roundtrip({"op": "ping"})
+        return bool(header.get("ok"))
+
+    def stage_catalog(self, seqnum: str, catalog: encode.CatalogTensors) -> None:
+        header = {
+            "op": "stage", "seqnum": seqnum, "names": catalog.names,
+            "k_real": catalog.k_real, "zones": catalog.zones, "words": catalog.words,
+        }
+        tensors = [
+            ("cap", catalog.cap), ("tcode", catalog.tcode), ("tnum", catalog.tnum),
+            ("tnum_present", catalog.tnum_present), ("tzone", catalog.tzone),
+            ("tcap", catalog.tcap), ("price", catalog.price),
+        ]
+        resp, _ = self._roundtrip(header, tensors)
+        if not resp.get("ok"):
+            raise RuntimeError(f"stage failed: {resp.get('error')}")
+        with self._lock:
+            self._staged_seqnums.add(seqnum)
+
+    def solve_classes(
+        self, seqnum: str, catalog: encode.CatalogTensors, class_set: encode.PodClassSet,
+        g_max: int = 512,
+    ) -> ffd.SolveOutputs:
+        with self._lock:  # atomic stage-then-solve (reentrant)
+            if seqnum not in self._staged_seqnums:
+                self.stage_catalog(seqnum, catalog)
+            header = {"op": "solve", "seqnum": seqnum, "g_max": g_max}
+            tensors = [
+                ("req", class_set.req), ("count", class_set.count),
+                ("allowed", np.concatenate(class_set.allowed, axis=1)),
+                ("num_lo", class_set.num_lo), ("num_hi", class_set.num_hi),
+                ("azone", class_set.azone), ("acap", class_set.acap),
+                ("schedulable", class_set.schedulable),
+            ]
+            resp, out = self._roundtrip(header, tensors)
+            if not resp.get("ok"):
+                if resp.get("error") == "unknown-seqnum":
+                    # server restarted / evicted: re-stage once and retry
+                    self.stage_catalog(seqnum, catalog)
+                    resp, out = self._roundtrip(header, tensors)
+                if not resp.get("ok"):
+                    raise RuntimeError(f"solve failed: {resp.get('error')}")
+            return ffd.SolveOutputs(**{n: out[n] for n in ffd.SolveOutputs._fields})
+
+
+def serve_main(argv=None) -> int:
+    """`python -m karpenter_tpu.solver.rpc --port 7077` -- run the solver
+    sidecar (the process that lives on the TPU VM)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=7077)
+    args = parser.parse_args(argv)
+    server = SolverServer(args.host, args.port).start()
+    print(f"solver service listening on {server.address[0]}:{server.address[1]}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(serve_main())

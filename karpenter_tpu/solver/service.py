@@ -32,12 +32,26 @@ _bucket = encode.bucket
 
 
 class TPUSolver:
-    def __init__(self, g_max: int = 512, c_pad_min: int = 16):
+    def __init__(self, g_max: int = 512, c_pad_min: int = 16, client=None):
         self.g_max = g_max
         self.c_pad_min = c_pad_min
+        # optional solver/rpc.SolverClient: tensor solves go over the wire
+        # to the sidecar on the TPU VM instead of the in-process backend
+        # (the SURVEY.md section 2.4 deployment seam); encode/decode and the
+        # existing-node pre-pass stay host-side either way
+        self.client = client
         self._cached_catalog_list = None   # strong ref: keeps the identity check sound
         self._cached_tensors: Optional[CatalogTensors] = None
         self._cached_staged = None         # (StagedCatalog, offsets, words)
+        # wire seqnum for remote staging: id() is unsound across catalog
+        # lifetimes (CPython reuses freed ids), and two controller processes
+        # must never collide on the shared sidecar -- so a per-solver random
+        # prefix plus a monotonic counter bumped on every re-encode
+        import uuid
+
+        self._seq_prefix = uuid.uuid4().hex[:12]
+        self._seq_counter = 0
+        self._cached_seqnum = ""
         self._lock = threading.Lock()
 
     # -- catalog staging ----------------------------------------------------
@@ -53,10 +67,15 @@ class TPUSolver:
         with self._lock:
             if self._cached_catalog_list is not instance_types:
                 self._cached_tensors = encode.encode_catalog(instance_types)
-                self._cached_staged = ffd.stage_catalog(self._cached_tensors)
+                # remote mode: the sidecar stages on ITS device; no local copy
+                self._cached_staged = (
+                    ffd.stage_catalog(self._cached_tensors) if self.client is None else (None, None, None)
+                )
                 self._cached_catalog_list = instance_types
+                self._seq_counter += 1
+                self._cached_seqnum = f"{self._seq_prefix}-{self._seq_counter}"
             staged, offsets, words = self._cached_staged
-            return self._cached_tensors, staged, offsets, words
+            return self._cached_tensors, staged, offsets, words, self._cached_seqnum
 
     def catalog_tensors(self, instance_types: Sequence) -> CatalogTensors:
         return self._catalog(instance_types)[0]
@@ -118,7 +137,7 @@ class TPUSolver:
             return result
 
         # phase 2 (device): batched FFD over the leftovers
-        catalog, staged, offsets, words = self._catalog(instance_types)
+        catalog, staged, offsets, words, seqnum = self._catalog(instance_types)
         class_set = encode.encode_classes(
             classes,
             catalog,
@@ -128,10 +147,13 @@ class TPUSolver:
         counts = class_set.count.copy()
         counts[: len(classes)] -= placed_existing.astype(counts.dtype)
         class_set.count = counts
-        inp = ffd.make_inputs_staged(staged, class_set)
-        out = ffd.ffd_solve(inp, g_max=self.g_max, word_offsets=offsets, words=words)
-        # one batched device->host fetch (transfers overlap; a single RTT)
-        out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
+        if self.client is not None:
+            out = self.client.solve_classes(seqnum, catalog, class_set, g_max=self.g_max)
+        else:
+            inp = ffd.make_inputs_staged(staged, class_set)
+            out = ffd.ffd_solve(inp, g_max=self.g_max, word_offsets=offsets, words=words)
+            # one batched device->host fetch (transfers overlap; a single RTT)
+            out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
         return self._decode(
             pool, instance_types, catalog, class_set, out, nodepool_usage,
             result=result, class_offset=placed_existing,

@@ -1,0 +1,158 @@
+"""Solver RPC boundary tests: framing, staging contract, differential
+equivalence remote-vs-in-process, and the full provisioner loop running
+against the sidecar (SURVEY.md section 2.4's deployment seam)."""
+import json
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver import encode
+from karpenter_tpu.solver.rpc import SolverClient, SolverServer
+from karpenter_tpu.solver.service import TPUSolver
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = SolverServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = SolverClient(server.address[0], server.address[1])
+    yield c
+    c.close()
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in cloud.describe_zones()},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return prov.list(nc)
+
+
+def make_pods(n, cpu="500m", mem="1Gi"):
+    return [Pod(f"p{i}", requests=Resources({"cpu": cpu, "memory": mem})) for i in range(n)]
+
+
+class TestProtocol:
+    def test_ping(self, client):
+        assert client.ping() is True
+
+    def test_unknown_op_is_an_error_frame(self, server):
+        import socket
+
+        from karpenter_tpu.solver.rpc import _recv_frame, _send_frame
+
+        sock = socket.create_connection(server.address)
+        _send_frame(sock, {"op": "nonsense"})
+        header, _ = _recv_frame(sock)
+        assert header["ok"] is False and "unknown op" in header["error"]
+        sock.close()
+
+    def test_solve_unknown_seqnum_restages(self, server, client, catalog_items):
+        """The client transparently re-stages when the server does not know
+        the seqnum (sidecar restart / eviction contract)."""
+        pool = NodePool("default")
+        solver = TPUSolver(g_max=64, client=client)
+        result = solver.solve(pool, catalog_items, make_pods(5))
+        assert not result.unschedulable
+        # simulate a sidecar restart: the server forgets every staged
+        # catalog, but the client still believes its seqnum is staged
+        with server._lock:
+            server._staged.clear()
+        result = solver.solve(pool, catalog_items, make_pods(6))
+        assert not result.unschedulable  # re-staged + retried transparently
+        with server._lock:
+            assert len(server._staged) == 1  # catalog re-staged server-side
+
+    def test_unknown_seqnum_without_restage_is_an_error(self, server):
+        import socket
+
+        from karpenter_tpu.solver.rpc import _recv_frame, _send_frame
+
+        sock = socket.create_connection(server.address)
+        _send_frame(sock, {"op": "solve", "seqnum": "never-staged", "g_max": 8})
+        header, _ = _recv_frame(sock)
+        assert header["ok"] is False and header["error"] == "unknown-seqnum"
+        sock.close()
+
+    def test_oversized_tensor_header_rejected(self, server):
+        """A hostile header declaring a huge tensor must not make the server
+        allocate; the connection is dropped instead."""
+        import socket
+        import struct
+
+        from karpenter_tpu.solver.rpc import _recv_frame
+
+        sock = socket.create_connection(server.address)
+        header = {
+            "op": "solve", "seqnum": "x", "g_max": 8,
+            "tensors": [{"name": "req", "dtype": "float32", "shape": [1, 2**33]}],
+        }
+        hb = json.dumps(header).encode()
+        sock.sendall(struct.pack("<I", len(hb)) + hb)
+        # server closes the connection without reading 32 GB
+        sock.settimeout(10.0)
+        with pytest.raises((ConnectionError, OSError)):
+            _recv_frame(sock)
+        sock.close()
+
+
+class TestRemoteDifferential:
+    def test_remote_matches_in_process(self, client, catalog_items):
+        pool = NodePool("default")
+        pods = make_pods(40, cpu="1", mem="2Gi")
+        local = TPUSolver(g_max=128).solve(pool, catalog_items, list(pods))
+        remote = TPUSolver(g_max=128, client=client).solve(pool, catalog_items, list(pods))
+        assert set(local.unschedulable) == set(remote.unschedulable)
+        sig = lambda r: sorted(
+            tuple(sorted(p.metadata.name for p in g.pods)) for g in r.new_groups
+        )
+        assert sig(local) == sig(remote)
+
+    def test_staging_is_reused_across_solves(self, client, catalog_items):
+        solver = TPUSolver(g_max=64, client=client)
+        pool = NodePool("default")
+        solver.solve(pool, catalog_items, make_pods(3))
+        staged_after_first = set(client._staged_seqnums)
+        solver.solve(pool, catalog_items, make_pods(4))
+        assert client._staged_seqnums == staged_after_first  # no re-stage
+
+
+class TestProvisionerOverRPC:
+    def test_end_to_end_with_sidecar(self, server):
+        client = SolverClient(server.address[0], server.address[1])
+        op = Operator(clock=FakeClock(1.0), solver=TPUSolver(g_max=128, client=client))
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        for i in range(25):
+            op.cluster.create(Pod(f"w{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        op.settle(max_ticks=30)
+        assert not op.cluster.pending_pods()
+        client.close()

@@ -1,0 +1,153 @@
+"""Scale suite on the kwok rig -- the reference's test/suites/scale shapes
+(provisioning_test.go: node-dense and pod-dense provisioning;
+deprovisioning_test.go: consolidation sweep) plus the interruption-queue
+benchmark tiers (interruption_benchmark_test.go: drain N queued messages),
+scaled to CI-friendly sizes. bench.py owns the full 50k-pod measurement."""
+import json
+import time
+
+import pytest
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Node, Pod, TPUNodeClass, labels as wk
+from karpenter_tpu.apis.pod import PodAffinityTerm
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.controllers.disruption import MIN_NODE_LIFETIME
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
+from karpenter_tpu.solver.service import TPUSolver
+
+
+def fresh_env(solver=True, evaluator=True):
+    op = Operator(
+        clock=FakeClock(100_000.0),
+        solver=TPUSolver(g_max=512) if solver else None,
+        consolidation_evaluator=ConsolidationEvaluator() if evaluator else None,
+    )
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    return op
+
+
+class TestPodDenseProvisioning:
+    def test_two_thousand_pods_one_tick_burst(self):
+        """Pod-dense: a 2k-pod burst lands through the batch solver and is
+        fully bound; the scheduling decision itself is one device solve."""
+        op = fresh_env()
+        sizes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi")]
+        for i in range(2000):
+            cpu, mem = sizes[i % len(sizes)]
+            op.cluster.create(Pod(f"w{i}", requests=Resources({"cpu": cpu, "memory": mem})))
+        t0 = time.perf_counter()
+        op.settle(max_ticks=40)
+        elapsed = time.perf_counter() - t0
+        assert not op.cluster.pending_pods()
+        bound = sum(1 for p in op.cluster.list(Pod) if p.node_name)
+        assert bound == 2000
+        nodes = op.cluster.list(Node)
+        # packing sanity: thousands of pods collapse to few dense nodes
+        assert 0 < len(nodes) < 60, f"{len(nodes)} nodes for 2000 pods"
+        assert elapsed < 120, f"pod-dense settle took {elapsed:.1f}s"
+
+    def test_follow_up_burst_packs_existing(self):
+        """Steady-state shape: a second burst must reuse live capacity via
+        the device existing-node pre-pass without growing the fleet when
+        headroom suffices."""
+        op = fresh_env()
+        for i in range(400):
+            op.cluster.create(Pod(f"a{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        op.settle(max_ticks=40)
+        n_before = len(op.cluster.list(Node))
+        for i in range(40):
+            op.cluster.create(Pod(f"b{i}", requests=Resources({"cpu": "100m", "memory": "64Mi"})))
+        op.settle(max_ticks=40)
+        assert not op.cluster.pending_pods()
+        assert len(op.cluster.list(Node)) == n_before
+
+
+class TestNodeDenseProvisioning:
+    def test_one_pod_per_node_via_anti_affinity(self):
+        """Node-dense: hostname anti-affinity forces one pod per node (the
+        reference's 500-node shape, scaled); stateful constraints route
+        through the oracle."""
+        op = fresh_env()
+        n = 60
+        for i in range(n):
+            op.cluster.create(
+                Pod(
+                    f"spread-{i}",
+                    requests=Resources({"cpu": "500m", "memory": "512Mi"}),
+                    labels={"app": "dense"},
+                    affinity_terms=[
+                        PodAffinityTerm(
+                            label_selector={"app": "dense"},
+                            topology_key=wk.HOSTNAME_LABEL,
+                            anti=True,
+                        )
+                    ],
+                )
+            )
+        t0 = time.perf_counter()
+        op.settle(max_ticks=80)
+        elapsed = time.perf_counter() - t0
+        assert not op.cluster.pending_pods()
+        nodes = op.cluster.list(Node)
+        assert len(nodes) == n, f"expected {n} nodes, got {len(nodes)}"
+        assert elapsed < 120, f"node-dense settle took {elapsed:.1f}s"
+
+
+class TestDeprovisioningScale:
+    def test_consolidation_sweep_shrinks_fleet(self):
+        """The deprovisioning shape: many underutilized nodes consolidate
+        down over repeated disruption passes (reference observes ~1 node /
+        2 min; the kwok rig has no such pacing floor)."""
+        op = fresh_env()
+        n_nodes = 8
+        for i in range(n_nodes):
+            op.cluster.create(Pod(f"big{i}", requests=Resources({"cpu": "3", "memory": "4Gi"})))
+            op.settle(max_ticks=30)
+            op.cluster.create(Pod(f"small{i}", requests=Resources({"cpu": "200m", "memory": "128Mi"})))
+            op.settle(max_ticks=30)
+        assert not op.cluster.pending_pods()
+        assert len(op.cluster.list(NodeClaim)) == n_nodes
+        for i in range(n_nodes):
+            big = op.cluster.get(Pod, f"big{i}")
+            big.metadata.finalizers = []
+            op.cluster.delete(Pod, f"big{i}")
+        op.clock.step(MIN_NODE_LIFETIME + 60)
+        # disruption passes with drain cycles between, until steady state
+        for _ in range(2 * n_nodes):
+            decisions = op.disruption.reconcile(max_disruptions=5)
+            for _ in range(8):
+                op.termination.reconcile_all()
+                op.tick()
+                op.clock.step(3.0)
+            op.clock.step(MIN_NODE_LIFETIME + 60)
+            if not decisions:
+                break
+        live = [c for c in op.cluster.list(NodeClaim) if not c.deleting]
+        assert len(live) < n_nodes, "consolidation should shrink the fleet"
+        assert not op.cluster.pending_pods()
+        bound = sum(1 for p in op.cluster.list(Pod) if p.node_name)
+        assert bound == n_nodes  # every small pod still running somewhere
+
+
+class TestInterruptionThroughput:
+    @pytest.mark.parametrize("n_messages", [1000, 5000])
+    def test_drain_tiers(self, n_messages):
+        """interruption_benchmark_test.go tiers against the fake queue: the
+        controller must drain N messages to completion."""
+        op = fresh_env(solver=False, evaluator=False)
+        for i in range(n_messages):
+            op.cloud.send(json.dumps({"kind": "state-change", "instance_id": f"i-none-{i}", "state": "stopping"}))
+        t0 = time.perf_counter()
+        handled = 0
+        while True:
+            got = op.interruption.reconcile(max_messages=10)
+            if got == 0:
+                break
+            handled += got
+        elapsed = time.perf_counter() - t0
+        assert handled == n_messages
+        rate = handled / max(elapsed, 1e-9)
+        assert rate > 500, f"drained at {rate:.0f} msg/s"
