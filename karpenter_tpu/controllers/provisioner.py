@@ -222,6 +222,9 @@ class PodBinder:
         nodes = [n for n in self.cluster.list(Node) if n.ready and not n.unschedulable and not n.deleting]
         for pod in self.cluster.pending_pods():
             needed = pod.requests + Resources.from_base_units({res.PODS: 1})
+            # per-domain spread counts are node-independent: compute once
+            # per (pod, constraint), check each candidate node against them
+            spread_counts = self._spread_counts(pod, nodes)
             for node in nodes:
                 if not tolerates_all(pod.tolerations, node.taints):
                     continue
@@ -232,10 +235,60 @@ class PodBinder:
                     continue
                 if not self._anti_affinity_ok(pod, node):
                     continue
+                if not self._spread_ok(node, spread_counts):
+                    continue
                 self.cluster.bind_pod(pod, node)
                 bound += 1
                 break
         return bound
+
+    def _spread_counts(self, pod, nodes):
+        """[(tsc, per-domain count dict)] for the pod's hard, self-matching
+        spread constraints (kube-scheduler's skew bookkeeping; domain
+        universe = the ready nodes' domains)."""
+        from karpenter_tpu.apis import Pod
+
+        hard = [
+            t
+            for t in pod.topology_spread
+            if t.hard()
+            and all(pod.metadata.labels.get(k) == v for k, v in t.label_selector.items())
+        ]
+        if not hard:
+            return []
+        node_domain = {}
+        out = []
+        for tsc in hard:
+            counts: dict = {}
+            for n in nodes:
+                d = n.metadata.labels.get(tsc.topology_key)
+                if d is not None:
+                    counts.setdefault(d, 0)
+            for other in self.cluster.list(Pod):
+                if not other.node_name or other.metadata.name == pod.metadata.name:
+                    continue
+                if not all(other.metadata.labels.get(k) == v for k, v in tsc.label_selector.items()):
+                    continue
+                onode = self.cluster.try_get(Node, other.node_name)
+                if onode is None:
+                    continue
+                d = onode.metadata.labels.get(tsc.topology_key)
+                if d is not None:
+                    counts[d] = counts.get(d, 0) + 1
+            out.append((tsc, counts))
+        return out
+
+    @staticmethod
+    def _spread_ok(node, spread_counts) -> bool:
+        """Adding the pod to this node's domain must keep skew <= max_skew."""
+        for tsc, counts in spread_counts:
+            domain = node.metadata.labels.get(tsc.topology_key)
+            if domain is None:
+                return False
+            global_min = min(counts.values(), default=0)
+            if counts.get(domain, 0) + 1 - global_min > tsc.max_skew:
+                return False
+        return True
 
     def _anti_affinity_ok(self, pod, node) -> bool:
         on_node = self.cluster.pods_on_node(node.metadata.name)

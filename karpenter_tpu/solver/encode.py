@@ -217,12 +217,45 @@ class PodClassSet:
     schedulable: np.ndarray          # [C] bool (taints tolerated etc.)
 
 
+def _spread_sig(pod: Pod) -> tuple:
+    """Hard spread constraints are part of scheduling identity: pods that
+    spread differently (or match their own selector differently) must not
+    collapse into one class (solver/spread.py distributes per class)."""
+    return tuple(
+        (
+            t.topology_key,
+            t.max_skew,
+            tuple(sorted(t.label_selector.items())),
+            all(pod.metadata.labels.get(k) == v for k, v in t.label_selector.items()),
+        )
+        for t in pod.topology_spread
+        if t.hard()
+    )
+
+
+def pod_sort_key(pod: Pod) -> tuple:
+    """The canonical scheduling order: dominant resource descending, then a
+    pool-independent class signature as the tie-break. BOTH the oracle's
+    per-pod loop and group_pods' class order sort by this key, so pods of
+    equal size but different classes are processed in the same relative
+    order on both paths -- shared spread counts then evolve identically."""
+    reqs = pod.scheduling_requirements()[0]
+    return (
+        -pod.requests.get(res.CPU),
+        -pod.requests.get(res.MEMORY),
+        reqs.stable_hash(),
+        tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
+        _spread_sig(pod),
+    )
+
+
 def _class_key(pod: Pod, reqs: Requirements) -> tuple:
     return (
         tuple(np.asarray(scale_vector(
             (pod.requests + _one_pod()).to_vector()), dtype=np.float64)),
         reqs.stable_hash(),
         tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
+        _spread_sig(pod),
     )
 
 
@@ -247,10 +280,11 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
             requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
             pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
         pc.pods.append(pod)
-    # FFD order: dominant resource (cpu, then memory) descending -- must
-    # match the oracle's sort for differential equivalence
+    # FFD order: dominant resource descending with the canonical tie-break
+    # (pod_sort_key) -- must match the oracle's sort for differential
+    # equivalence, including between equal-sized classes
     out = list(groups.values())
-    out.sort(key=lambda pc: (pc.requests[res.AXIS_INDEX[res.CPU]], pc.requests[res.AXIS_INDEX[res.MEMORY]]), reverse=True)
+    out.sort(key=lambda pc: pod_sort_key(pc.pods[0]))
     return out
 
 

@@ -143,6 +143,7 @@ class Scheduler:
         self.topology.seed_existing(pods_by_node, {n.name: n.labels for n in self.existing})
         self.usage = dict(nodepool_usage or {})
         self.zones = zones or set()
+        self._feasible_zone_cache: Dict[tuple, Set[str]] = {}
         # anti-affinity occupancy: node/group id -> pod labels present
         self._labels_on: Dict[str, List[Dict[str, str]]] = {}
         for node, pods in pods_by_node.items():
@@ -222,11 +223,59 @@ class Scheduler:
             return {z for z in self.zones if zreq.matches(z)}
         return set(zreq.values)
 
-    def _spread_narrow_group(self, pod: Pod, reqs: Requirements) -> Optional[Requirements]:
-        """Apply hard zone-spread by narrowing the group's zone requirement to
-        min-count eligible zones; returns None if no eligible zone. Hostname
-        spread over a new node is always a fresh domain (count 0): allowed iff
-        1 - global_min <= max_skew."""
+    def _feasible_spread_zones(self, pool: Optional[NodePool], base: Requirements, requested: Resources) -> Set[str]:
+        """Zones where some instance type of `pool` is compatible with the
+        pod+pool requirements pinned to that zone, fits one pod, and has an
+        available offering there. These are the spread DOMAINS for the pod:
+        a zone with no schedulable capacity neither receives pods nor drags
+        the global minimum down (kube-scheduler's eligible-domain rule; the
+        batch solver computes the same set from catalog tensors)."""
+        from karpenter_tpu.scheduling import Operator as Op, Requirement
+
+        if pool is None:
+            return set(self.zones)
+        key = (pool.name, base.stable_hash(), tuple(requested.to_vector()))
+        hit = self._feasible_zone_cache.get(key)
+        if hit is not None:
+            return hit
+        items = self.instance_types.get(pool.name, [])
+        out: Set[str] = set()
+        for z in self.zones:
+            reqz = base.copy().add(Requirement(wk.ZONE_LABEL, Op.IN, [z]))
+            for it in items:
+                if (
+                    it.requirements.compatible(reqz)
+                    and _fits_type(it, requested)
+                    and any(o.available and o.zone == z for o in it.offerings)
+                ):
+                    out.add(z)
+                    break
+        self._feasible_zone_cache[key] = out
+        return out
+
+    def _spread_narrow_group(
+        self,
+        pod: Pod,
+        reqs: Requirements,
+        base_fn=None,
+        pool: Optional[NodePool] = None,
+    ) -> Optional[Requirements]:
+        """Apply hard zone-spread by pinning the pod's globally-chosen zone;
+        returns None when the pod cannot go where spreading demands.
+
+        Spec: GREEDY MIN-COUNT spreading over FEASIBLE domains -- every
+        spread pod goes to the lexicographically-first minimum-count zone
+        among candidates that are skew-eligible AND have schedulable
+        capacity (so an exhausted zone steers spreading instead of
+        livelocking it); `base_fn` supplies the pod+pool requirements,
+        independent of any particular group, built lazily since most pods
+        carry no spread constraints. A group is joinable only if its zones
+        include the chosen zone. This is a deterministic, stricter
+        refinement of the k8s max-skew contract and exactly what the batch
+        solver's water-fill computes (solver/spread.py), keeping the two
+        paths differentially equal. Hostname spread over a new node is
+        always a fresh domain (count 0): allowed iff 1 - global_min <=
+        max_skew."""
         from karpenter_tpu.scheduling import Operator, Requirement
 
         out = reqs
@@ -234,20 +283,21 @@ class Scheduler:
             if not tsc.hard() or not _pod_matches_selector(pod, tsc.label_selector):
                 continue
             if tsc.topology_key == wk.ZONE_LABEL:
-                candidates = self._group_zone_domains(out)
+                base = base_fn() if base_fn is not None else out
+                requested = pod.requests + Resources.from_base_units({res.PODS: 1})
+                domains = self._feasible_spread_zones(pool, base, requested)
+                candidates = self._group_zone_domains(base) & domains
                 allowed = self.topology.allowed_domains(
-                    tsc, candidates & self._domains_for(tsc), all_domains=self._domains_for(tsc)
+                    tsc, candidates, all_domains=domains
                 )
                 if not allowed:
                     return None
-                # Pin ONE min-count zone (deterministic tie-break): leaving
-                # the zone open would let the launch path collapse every
-                # group into the cheapest zone, and the spread count could
-                # never be attributed to a domain.
                 counts = self.topology.count(tsc)
-                pinned = min(sorted(allowed), key=lambda z: counts.get(z, 0))
+                want = min(sorted(allowed), key=lambda z: counts.get(z, 0))
+                if want not in self._group_zone_domains(out):
+                    return None  # this group cannot host the chosen zone
                 out = out.copy()
-                out.add(Requirement(wk.ZONE_LABEL, Operator.IN, [pinned]))
+                out.add(Requirement(wk.ZONE_LABEL, Operator.IN, [want]))
             elif tsc.topology_key == wk.HOSTNAME_LABEL:
                 counts = self.topology.count(tsc)
                 domains = self._domains_for(tsc)
@@ -264,8 +314,14 @@ class Scheduler:
         if not self._anti_affinity_ok(pod, id(group)):
             return False
         merged = group.requirements.copy().add(*pod_reqs)
-        # zone topology spread narrows the merged requirements
-        narrowed = self._spread_narrow_group(pod, merged)
+        # zone topology spread narrows the merged requirements; the chosen
+        # zone is computed pool-wide (pod+pool), not from this group's
+        # already-narrowed zones, so joining can never dodge the spread
+        narrowed = self._spread_narrow_group(
+            pod, merged,
+            base_fn=lambda: group.nodepool.requirements().copy().add(*pod_reqs),
+            pool=group.nodepool,
+        )
         if narrowed is None:
             return False
         requested = group.add_requested(pod)
@@ -294,7 +350,7 @@ class Scheduler:
                 last_reason = f"pod does not tolerate nodepool {pool.name} taints"
                 continue
             merged = pool_reqs.copy().add(*pod_reqs)
-            narrowed = self._spread_narrow_group(pod, merged)
+            narrowed = self._spread_narrow_group(pod, merged, pool=pool)
             if narrowed is None:
                 last_reason = "topology spread constraints unsatisfiable"
                 continue
@@ -331,7 +387,11 @@ class Scheduler:
     # -- entry point --------------------------------------------------------
     def schedule(self, pods: Sequence[Pod]) -> SchedulingResult:
         result = SchedulingResult()
-        ordered = sorted(pods, key=_dominant_size, reverse=True)
+        # canonical order shared with the batch solver (encode.pod_sort_key):
+        # dominant size descending, pool-independent class-signature tie-break
+        from karpenter_tpu.solver.encode import pod_sort_key
+
+        ordered = sorted(pods, key=pod_sort_key)
         for pod in ordered:
             if self._try_existing(pod, result):
                 continue

@@ -83,10 +83,21 @@ class TPUSolver:
     # -- routing ------------------------------------------------------------
     @staticmethod
     def supports(scheduler: Scheduler, pods: Sequence[Pod]) -> bool:
+        from karpenter_tpu.solver import spread
+
         if len(scheduler.nodepools) != 1:
             return False
+        any_spread = False
         for p in pods:
-            if p.topology_spread or p.affinity_terms or len(p.node_affinity_terms) > 1:
+            if p.affinity_terms or len(p.node_affinity_terms) > 1:
+                return False
+            if any(t.hard() for t in p.topology_spread):
+                any_spread = True
+        if any_spread:
+            # zone spread is handled by the host carry pass (spread.py);
+            # it models fresh-cluster counts only, so live nodes route to
+            # the oracle (their pods seed counts the pass does not track)
+            if scheduler.existing or not spread.spread_eligible(pods):
                 return False
         return True
 
@@ -105,6 +116,7 @@ class TPUSolver:
             pool, items, pods,
             nodepool_usage=scheduler.usage.get(pool.name),
             existing_nodes=scheduler.existing,
+            zones=sorted(scheduler.zones),
         )
 
     # -- the batch solve ----------------------------------------------------
@@ -115,10 +127,44 @@ class TPUSolver:
         pods: Sequence[Pod],
         nodepool_usage: Optional[Resources] = None,
         existing_nodes: Sequence = (),
+        zones: Sequence[str] = (),
     ) -> SchedulingResult:
+        from karpenter_tpu.solver import spread as spread_mod
+
+        if not spread_mod.spread_eligible(pods):
+            raise ValueError(
+                "TPUSolver.solve: pods carry out-of-scope spread constraints "
+                "(hostname or multiple hard constraints); call schedule() so "
+                "routing can fall back to the oracle"
+            )
         pool_reqs = pool.requirements()
         classes = encode.group_pods(pods, extra_requirements=pool_reqs)
         result = SchedulingResult()
+
+        # phase 0 (host): zone topology spread -- the carry pass splits
+        # spread classes into zone-pinned sub-classes with the oracle's
+        # exact pod distribution (solver/spread.py). Runs before the
+        # existing-node phase so class indices stay aligned; the routing in
+        # supports() guarantees spread pods never coexist with existing
+        # nodes on this path (live pods would seed counts this pass does
+        # not track).
+        if instance_types and any(spread_mod.hard_zone_tsc(pc.pods[0]) for pc in classes):
+            catalog0 = self._catalog(instance_types)[0]
+            pre_set = encode.encode_classes(
+                classes, catalog0, pool_taints=list(pool.template.taints),
+                c_pad=_bucket(len(classes), self.c_pad_min),
+            )
+            compat = encode.compat_matrix(catalog0, pre_set)[: len(classes)]
+            fits_one = np.all(
+                catalog0.cap[None, :, :] >= pre_set.req[: len(classes), None, :], axis=-1
+            )
+            split = spread_mod.split_zone_spread(
+                classes, catalog0, list(zones) or list(catalog0.zones), compat, fits_one
+            )
+            classes = split.classes
+            result.unschedulable.update(split.unschedulable)
+            if not classes:
+                return result
 
         # phase 1 (device): pack onto existing capacity first, exactly as the
         # oracle tries existing nodes before opening groups -- the same

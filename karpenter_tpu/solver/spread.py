@@ -1,0 +1,191 @@
+"""Zone topology-spread as a host-side carry pass over pod classes.
+
+SURVEY.md hard part #1: hard topology spread is stateful across placement
+decisions (per-zone pod counts evolve as pods place), which fights
+vectorization. The resolution: the state evolves *per class*, not per pod --
+identical pods distribute over zones by sequential min-count placement,
+whose closed form is water-filling. So a cheap sequential pass over the few
+hundred classes (this module) splits each spread-constrained class into
+zone-pinned sub-classes carrying the exact per-zone pod counts the oracle's
+per-pod loop would produce, and the batched FFD solve (solver/ffd.py) then
+runs unchanged on the pinned sub-classes.
+
+Semantics mirrored from solver/oracle.py (greedy min-count spreading over
+feasible domains):
+- counts are keyed by the spread selector (different workloads spread
+  independently) and shared across classes in the canonical scan order
+- spread domains = zones with schedulable capacity for the class (some
+  compatible type fits one pod and has an available offering there), so an
+  exhausted zone steers spreading instead of blocking it
+- each pod pins the lexicographically-first minimum-count zone among
+  candidates where count+1-global_min <= max_skew (global min over the
+  feasible domains, empty ones included)
+- pods that do not match their own constraint's selector are unconstrained
+
+Scope (routing in solver/service.py): single hard zone-spread constraint
+per pod, no existing nodes; hostname spread and multi-constraint pods take
+the oracle path. Soft (ScheduleAnyway) constraints are ignored exactly as
+the oracle ignores them.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.apis import Pod, labels as wk
+from karpenter_tpu.apis.pod import TopologySpreadConstraint
+from karpenter_tpu.scheduling import Operator, Requirement
+from karpenter_tpu.solver import encode
+from karpenter_tpu.solver.encode import CatalogTensors, PodClass
+
+
+def hard_zone_tsc(pod: Pod) -> Optional[TopologySpreadConstraint]:
+    """The pod's single effective hard zone-spread constraint, or None.
+    A constraint whose selector the pod itself does not match never
+    constrains that pod's placement (oracle._spread_narrow_group gates on
+    _pod_matches_selector)."""
+    hard = [t for t in pod.topology_spread if t.hard()]
+    if not hard:
+        return None
+    t = hard[0]
+    if len(hard) > 1 or t.topology_key != wk.ZONE_LABEL:
+        raise ValueError("route to oracle: multi-constraint or non-zone spread")
+    if not all(pod.metadata.labels.get(k) == v for k, v in t.label_selector.items()):
+        return None
+    return t
+
+
+def spread_eligible(pods: Sequence[Pod]) -> bool:
+    """True when every pod's spread constraints are in this module's scope."""
+    for p in pods:
+        hard = [t for t in p.topology_spread if t.hard()]
+        if not hard:
+            continue
+        if len(hard) > 1 or hard[0].topology_key != wk.ZONE_LABEL:
+            return False
+    return True
+
+
+def _selector_key(t: TopologySpreadConstraint) -> tuple:
+    return tuple(sorted(t.label_selector.items()))
+
+
+@dataclass
+class SpreadState:
+    """Per-selector zone counts (the oracle's _TopologyState for the zone
+    key), carried across classes in scan order."""
+
+    zones: List[str]
+    counts: Dict[tuple, np.ndarray] = field(default_factory=dict)
+
+    def of(self, key: tuple) -> np.ndarray:
+        c = self.counts.get(key)
+        if c is None:
+            c = self.counts[key] = np.zeros(len(self.zones), dtype=np.int64)
+        return c
+
+
+def _water_fill(counts: np.ndarray, order: np.ndarray, n: int) -> np.ndarray:
+    """Place n pods by repeated min-count (ties -> earliest in `order`)
+    among exactly the zones listed in `order`; returns per-zone additions.
+    Closed form of the oracle's sequential pinning when every candidate
+    zone is feasible."""
+    take = np.zeros_like(counts)
+    if n <= 0 or order.size == 0:
+        return take
+    c = counts[order].astype(np.int64)
+    # fill lowest levels first: after placement, counts differ by <= 1
+    # among candidates at the waterline
+    lo = int(c.min())
+    # final level L: pods needed to reach level x is sum(max(0, x - c))
+    hi = lo + n + 1
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if int(np.maximum(0, mid - c).sum()) <= n:
+            lo = mid
+        else:
+            hi = mid
+    level = lo
+    add = np.maximum(0, level - c)
+    rem = n - int(add.sum())
+    # remainder goes one each to the earliest zones (by `order`) at <= level
+    at_line = np.nonzero(c + add <= level)[0]
+    add[at_line[:rem]] += 1
+    take[order] = add
+    return take
+
+
+class SplitResult:
+    def __init__(self):
+        self.classes: List[PodClass] = []
+        self.unschedulable: Dict[str, str] = {}
+
+
+def split_zone_spread(
+    classes: Sequence[PodClass],
+    catalog: CatalogTensors,
+    class_set_zones: Sequence[str],
+    compat: np.ndarray,           # [C, K] host compat (encode.compat_matrix)
+    fits_one: np.ndarray,         # [C, K] one pod of class c fits type k
+) -> SplitResult:
+    """The carry pass: returns classes with every spread class replaced by
+    zone-pinned sub-classes (FFD order preserved; sub-classes adjacent)."""
+    zones = sorted(class_set_zones)
+    state = SpreadState(zones)
+    zone_to_idx = {z: i for i, z in enumerate(zones)}
+    # catalog zone axis may be ordered differently
+    cat_zone_idx = {z: i for i, z in enumerate(catalog.zones)}
+    out = SplitResult()
+    for ci, pc in enumerate(classes):
+        t = hard_zone_tsc(pc.pods[0])
+        if t is None:
+            out.classes.append(pc)
+            continue
+        key = _selector_key(t)
+        counts = state.of(key)
+        # spread domains = zones the class can actually use: its own zone
+        # requirement AND schedulable capacity (a compatible type that fits
+        # one pod and has an available offering there). Exhausted zones
+        # steer spreading instead of blocking it, and a pinned pod spreads
+        # only over its reachable zones -- the oracle derives the same set
+        # from the pod+pool requirements (_feasible_spread_zones). Since
+        # every pod pins a minimum-count domain, the skew bound is always
+        # satisfied: max_skew shapes nothing beyond domain choice, and the
+        # closed-form water-fill covers every case.
+        zreq = pc.requirements.get(wk.ZONE_LABEL)
+        domains = [
+            z
+            for z in zones
+            if (zreq is None or zreq.matches(z))
+            and cat_zone_idx.get(z) is not None
+            and bool(np.any(compat[ci] & fits_one[ci] & catalog.tzone[:, cat_zone_idx[z]]))
+        ]
+        n = len(pc.pods)
+        order = np.array([zone_to_idx[z] for z in domains], dtype=np.int64)
+        take = _water_fill(counts, order, n)
+        failed_from = None if domains else "topology spread constraints unsatisfiable"
+        counts += take
+        cursor = 0
+        for zi in np.nonzero(take)[0]:
+            z = zones[zi]
+            k = int(take[zi])
+            sub_reqs = pc.requirements.copy()
+            sub_reqs.add(Requirement(wk.ZONE_LABEL, Operator.IN, [z]))
+            out.classes.append(
+                PodClass(
+                    pods=pc.pods[cursor : cursor + k],
+                    requests=pc.requests,
+                    requirements=sub_reqs,
+                    key=pc.key + (z,),
+                )
+            )
+            cursor += k
+        for p in pc.pods[cursor:]:
+            out.unschedulable[p.metadata.name] = (
+                failed_from or "topology spread constraints unsatisfiable"
+            )
+    return out
+
+

@@ -1,0 +1,274 @@
+"""Zone topology-spread differential tests: the host carry pass + batched
+FFD (solver/spread.py + service.py) against the oracle's per-pod loop."""
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass, labels as wk
+from karpenter_tpu.apis.pod import TopologySpreadConstraint
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver.oracle import Scheduler
+from karpenter_tpu.solver.service import TPUSolver
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in cloud.describe_zones()},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return prov.list(nc)
+
+
+def spread_pod(name, cpu, mem, max_skew=1, labels=None, node_selector=None, app="web"):
+    labels = dict(labels or {})
+    labels.setdefault("app", app)
+    return Pod(
+        name,
+        requests=Resources({"cpu": cpu, "memory": mem}),
+        labels=labels,
+        node_selector=node_selector,
+        topology_spread=[
+            TopologySpreadConstraint(
+                max_skew=max_skew,
+                topology_key=wk.ZONE_LABEL,
+                label_selector={"app": app},
+            )
+        ],
+    )
+
+
+def run_both(items, pods, pool=None):
+    pool = pool or NodePool("default")
+    zones = {o.zone for it in items for o in it.available_offerings()}
+    oracle = Scheduler(
+        nodepools=[pool], instance_types={pool.name: items}, zones=zones
+    ).schedule(list(pods))
+    device = TPUSolver(g_max=256).solve(pool, items, list(pods), zones=sorted(zones))
+    return oracle, device
+
+
+def group_zone(g):
+    r = g.requirements.get(wk.ZONE_LABEL)
+    assert r is not None and len(r.values) >= 1
+    return tuple(sorted(r.values))
+
+
+def zone_distribution(result):
+    """multiset of (zone(s), pods-in-group) over new groups."""
+    return sorted((group_zone(g), len(g.pods)) for g in result.new_groups)
+
+
+class TestSpreadDifferential:
+    def test_even_spread_over_zones(self, catalog_items):
+        pods = [spread_pod(f"p{i}", "500m", "1Gi") for i in range(12)]
+        oracle, device = run_both(catalog_items, pods)
+        assert not oracle.unschedulable and not device.unschedulable
+        assert zone_distribution(oracle) == zone_distribution(device)
+        # 4 zones, 12 pods, skew 1 -> 3 per zone
+        sizes = sorted(n for _, n in zone_distribution(device))
+        assert sizes == [3, 3, 3, 3]
+
+    def test_remainder_distribution_matches(self, catalog_items):
+        pods = [spread_pod(f"p{i}", "500m", "1Gi") for i in range(10)]
+        oracle, device = run_both(catalog_items, pods)
+        assert zone_distribution(oracle) == zone_distribution(device)
+        sizes = sorted(n for _, n in zone_distribution(device))
+        assert sizes == [2, 2, 3, 3]
+
+    def test_zone_pinned_and_spread(self, catalog_items):
+        """Pods pinned to one zone while spreading: their domain universe is
+        the reachable zone alone (k8s computes skew over nodeAffinity-
+        eligible domains), so all place there, identically on both paths."""
+        pods = [
+            Pod(
+                f"q{i}",
+                requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                labels={"app": "pinned"},
+                node_selector={wk.ZONE_LABEL: "us-central-1a"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "pinned"}
+                    )
+                ],
+            )
+            for i in range(6)
+        ]
+        oracle, device = run_both(catalog_items, pods)
+        assert not oracle.unschedulable and not device.unschedulable
+        assert zone_distribution(oracle) == zone_distribution(device)
+        zones_used = {z for zs, _ in zone_distribution(device) for z in zs}
+        assert zones_used == {"us-central-1a"}
+
+    def test_non_matching_selector_unconstrained(self, catalog_items):
+        """A constraint whose selector the pod does not match never pins."""
+        pods = [
+            Pod(
+                f"p{i}",
+                requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                labels={"app": "other"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"}
+                    )
+                ],
+            )
+            for i in range(8)
+        ]
+        oracle, device = run_both(catalog_items, pods)
+        assert not device.unschedulable
+        assert len(oracle.new_groups) == len(device.new_groups)
+
+    def test_independent_workloads_spread_independently(self, catalog_items):
+        pods = [spread_pod(f"a{i}", "500m", "1Gi", app="alpha") for i in range(4)]
+        pods += [spread_pod(f"b{i}", "250m", "512Mi", app="beta") for i in range(4)]
+        oracle, device = run_both(catalog_items, pods)
+        assert zone_distribution(oracle) == zone_distribution(device)
+
+    def test_soft_spread_ignored(self, catalog_items):
+        pods = [
+            Pod(
+                f"p{i}",
+                requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=wk.ZONE_LABEL,
+                        label_selector={"app": "web"}, when_unsatisfiable="ScheduleAnyway",
+                    )
+                ],
+            )
+            for i in range(6)
+        ]
+        oracle, device = run_both(catalog_items, pods)
+        assert not device.unschedulable
+        assert len(oracle.new_groups) == len(device.new_groups)
+
+    def test_mixed_spread_and_plain_pods(self, catalog_items):
+        pods = [spread_pod(f"s{i}", "1", "2Gi") for i in range(8)]
+        pods += [
+            Pod(f"n{i}", requests=Resources({"cpu": "250m", "memory": "512Mi"}))
+            for i in range(20)
+        ]
+        oracle, device = run_both(catalog_items, pods)
+        assert set(oracle.unschedulable) == set(device.unschedulable)
+        assert len(oracle.new_groups) == len(device.new_groups)
+        # the spread groups agree exactly
+        o_spread = sorted((group_zone(g), len(g.pods)) for g in oracle.new_groups if any(p.metadata.name.startswith("s") for p in g.pods))
+        d_spread = sorted((group_zone(g), len(g.pods)) for g in device.new_groups if any(p.metadata.name.startswith("s") for p in g.pods))
+        assert o_spread == d_spread
+
+    def test_exhausted_zone_steers_spreading(self, catalog_items):
+        """A zone with no available capacity (e.g. fully ICE'd) is not a
+        spread domain: pods spread over the remaining zones instead of
+        livelocking on the unreachable minimum-count zone."""
+        import copy
+
+        items = []
+        for it in catalog_items:
+            clone = copy.copy(it)
+            clone.offerings = [copy.copy(o) for o in it.offerings]
+            for o in clone.offerings:
+                if o.zone == "us-central-1a":
+                    o.available = False
+            items.append(clone)
+        pods = [spread_pod(f"p{i}", "500m", "1Gi") for i in range(9)]
+        oracle, device = run_both(items, pods)
+        assert not oracle.unschedulable and not device.unschedulable
+        assert zone_distribution(oracle) == zone_distribution(device)
+        zones_used = {z for zs, _ in zone_distribution(device) for z in zs}
+        assert "us-central-1a" not in zones_used
+        sizes = sorted(n for _, n in zone_distribution(device))
+        assert sizes == [3, 3, 3]
+
+    def test_equal_sized_interleaved_classes(self, catalog_items):
+        """Two classes with identical requests sharing a spread selector:
+        the canonical sort keeps shared counts evolving identically on both
+        paths regardless of pod creation interleaving."""
+        pods = []
+        for i in range(4):
+            pods.append(spread_pod(f"x{i}", "500m", "1Gi", app="web"))
+            pods.append(
+                Pod(
+                    f"y{i}",
+                    requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                    labels={"app": "web"},
+                    node_selector={wk.ZONE_LABEL: "us-central-1b"},
+                    topology_spread=[
+                        TopologySpreadConstraint(
+                            max_skew=1, topology_key=wk.ZONE_LABEL,
+                            label_selector={"app": "web"},
+                        )
+                    ],
+                )
+            )
+        oracle, device = run_both(catalog_items, pods)
+        assert set(oracle.unschedulable) == set(device.unschedulable)
+        assert zone_distribution(oracle) == zone_distribution(device)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_spread(self, catalog_items, seed):
+        rng = np.random.default_rng(5000 + seed)
+        pods = []
+        for w in range(int(rng.integers(1, 4))):
+            app = f"w{w}"
+            skew = int(rng.choice([1, 2]))
+            cpu_m = int(rng.choice([250, 500, 1000, 2000]))
+            mem_mi = int(rng.choice([512, 1024, 4096]))
+            for i in range(int(rng.integers(2, 18))):
+                pods.append(
+                    Pod(
+                        f"{app}-{i}",
+                        requests=Resources({"cpu": cpu_m, "memory": float(mem_mi * 2**20)}),
+                        labels={"app": app},
+                        topology_spread=[
+                            TopologySpreadConstraint(
+                                max_skew=skew, topology_key=wk.ZONE_LABEL,
+                                label_selector={"app": app},
+                            )
+                        ],
+                    )
+                )
+        if rng.random() < 0.5:
+            for i in range(int(rng.integers(1, 15))):
+                pods.append(Pod(f"plain-{i}", requests=Resources({"cpu": "250m", "memory": "256Mi"})))
+        oracle, device = run_both(catalog_items, pods)
+        assert set(oracle.unschedulable) == set(device.unschedulable), f"seed {seed}"
+        assert zone_distribution(oracle) == zone_distribution(device), f"seed {seed}"
+
+
+class TestSpreadEndToEnd:
+    def test_spread_burst_on_kwok_rig(self):
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.apis import Node
+
+        op = Operator(clock=FakeClock(1.0), solver=TPUSolver(g_max=128))
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        for i in range(8):
+            op.cluster.create(spread_pod(f"p{i}", "2", "4Gi"))
+        op.settle(max_ticks=30)
+        assert not op.cluster.pending_pods()
+        node_zones = sorted(
+            n.metadata.labels.get(wk.ZONE_LABEL) for n in op.cluster.list(Node)
+        )
+        # pods spread across all 4 zones
+        assert len(set(node_zones)) == 4
