@@ -205,6 +205,17 @@ class DisruptionController:
     # -- reconcile ----------------------------------------------------------
     def reconcile(self, max_disruptions: int = 1) -> List[Tuple[str, str]]:
         """One disruption pass; returns [(claim, reason)] acted on."""
+        import time as _time
+
+        from karpenter_tpu import metrics
+
+        t0 = _time.perf_counter()
+        try:
+            return self._reconcile(max_disruptions)
+        finally:
+            metrics.DISRUPTION_EVAL_DURATION.observe(_time.perf_counter() - t0)
+
+    def _reconcile(self, max_disruptions: int) -> List[Tuple[str, str]]:
         self.last_decisions = []
         disrupting: Dict[str, int] = {}
         totals: Dict[str, int] = {}
@@ -427,9 +438,12 @@ class DisruptionController:
 
     # -- execution ----------------------------------------------------------
     def _disrupt(self, c: Candidate, reason: str, disrupting: Dict[str, int]) -> None:
+        from karpenter_tpu import metrics
+
         self.cluster.delete(NodeClaim, c.claim.metadata.name)
         disrupting[c.nodepool.name] = disrupting.get(c.nodepool.name, 0) + 1
         self.last_decisions.append((c.claim.metadata.name, reason))
+        metrics.DISRUPTION_DECISIONS.inc(reason=reason)
 
     def _replace_then_disrupt(self, c: Candidate, groups, reason: str, disrupting: Dict[str, int]) -> None:
         """Launch the replacement before draining (consolidation.md: delete

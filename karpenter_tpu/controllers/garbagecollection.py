@@ -42,6 +42,9 @@ class GarbageCollectionController:
                 # reservation bookkeeping
                 self.cloud_provider.instances.delete(inst.id)
                 removed.append(inst.id)
+                from karpenter_tpu import metrics
+
+                metrics.GARBAGE_COLLECTED.inc()
             except NotFoundError:
                 pass
             node = nodes_by_provider.get(inst.provider_id)

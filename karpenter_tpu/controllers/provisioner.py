@@ -220,11 +220,18 @@ class PodBinder:
 
         bound = 0
         nodes = [n for n in self.cluster.list(Node) if n.ready and not n.unschedulable and not n.deleting]
+        # per-(topology key, selector) domain counts, built on first use per
+        # reconcile (one cluster scan per distinct constraint) and updated
+        # incrementally on each bind -- kube-scheduler's skew bookkeeping
+        counts_cache: Dict[tuple, Dict[str, int]] = {}
+        node_by_name = {n.metadata.name: n for n in nodes}
         for pod in self.cluster.pending_pods():
             needed = pod.requests + Resources.from_base_units({res.PODS: 1})
-            # per-domain spread counts are node-independent: compute once
-            # per (pod, constraint), check each candidate node against them
-            spread_counts = self._spread_counts(pod, nodes)
+            tscs = self._matching_spread(pod)
+            spread_counts = [
+                (tsc, self._counts_for(tsc, nodes, node_by_name, counts_cache))
+                for tsc in tscs
+            ]
             for node in nodes:
                 if not tolerates_all(pod.tolerations, node.taints):
                     continue
@@ -238,45 +245,52 @@ class PodBinder:
                 if not self._spread_ok(node, spread_counts):
                     continue
                 self.cluster.bind_pod(pod, node)
+                for tsc, counts in spread_counts:
+                    d = node.metadata.labels.get(tsc.topology_key)
+                    if d is not None:
+                        counts[d] = counts.get(d, 0) + 1
                 bound += 1
                 break
+        if bound:
+            metrics.PODS_BOUND.inc(bound)
+        metrics.NODES_READY.set(float(len(nodes)))
         return bound
 
-    def _spread_counts(self, pod, nodes):
-        """[(tsc, per-domain count dict)] for the pod's hard, self-matching
-        spread constraints (kube-scheduler's skew bookkeeping; domain
-        universe = the ready nodes' domains)."""
-        from karpenter_tpu.apis import Pod
-
-        hard = [
+    @staticmethod
+    def _matching_spread(pod):
+        return [
             t
             for t in pod.topology_spread
             if t.hard()
             and all(pod.metadata.labels.get(k) == v for k, v in t.label_selector.items())
         ]
-        if not hard:
-            return []
-        node_domain = {}
-        out = []
-        for tsc in hard:
-            counts: dict = {}
-            for n in nodes:
-                d = n.metadata.labels.get(tsc.topology_key)
-                if d is not None:
-                    counts.setdefault(d, 0)
-            for other in self.cluster.list(Pod):
-                if not other.node_name or other.metadata.name == pod.metadata.name:
-                    continue
-                if not all(other.metadata.labels.get(k) == v for k, v in tsc.label_selector.items()):
-                    continue
-                onode = self.cluster.try_get(Node, other.node_name)
-                if onode is None:
-                    continue
-                d = onode.metadata.labels.get(tsc.topology_key)
-                if d is not None:
-                    counts[d] = counts.get(d, 0) + 1
-            out.append((tsc, counts))
-        return out
+
+    def _counts_for(self, tsc, nodes, node_by_name, cache):
+        """Per-domain pod counts for one constraint, cached per reconcile
+        (domain universe = the ready nodes' domains)."""
+        from karpenter_tpu.apis import Pod
+
+        key = (tsc.topology_key, tuple(sorted(tsc.label_selector.items())))
+        counts = cache.get(key)
+        if counts is not None:
+            return counts
+        counts = cache[key] = {}
+        for n in nodes:
+            d = n.metadata.labels.get(tsc.topology_key)
+            if d is not None:
+                counts.setdefault(d, 0)
+        for other in self.cluster.list(Pod):
+            if not other.node_name:
+                continue
+            if not all(other.metadata.labels.get(k) == v for k, v in tsc.label_selector.items()):
+                continue
+            onode = node_by_name.get(other.node_name) or self.cluster.try_get(Node, other.node_name)
+            if onode is None:
+                continue
+            d = onode.metadata.labels.get(tsc.topology_key)
+            if d is not None:
+                counts[d] = counts.get(d, 0) + 1
+        return counts
 
     @staticmethod
     def _spread_ok(node, spread_counts) -> bool:

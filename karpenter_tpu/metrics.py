@@ -192,3 +192,21 @@ INSTANCE_TYPE_COUNT = REGISTRY.gauge(
     "Catalog size by nodeclass", labels=("nodeclass",),
 )
 IGNORED_PODS = REGISTRY.gauge("karpenter_ignored_pod_count", "Pods the scheduler cannot place")
+DISRUPTION_DECISIONS = REGISTRY.counter(
+    "karpenter_voluntary_disruption_decisions_total",
+    "Disruption decisions by reason", labels=("reason",),
+)
+DISRUPTION_EVAL_DURATION = REGISTRY.histogram(
+    "karpenter_voluntary_disruption_decision_evaluation_duration_seconds",
+    "Duration of one disruption evaluation pass",
+)
+GARBAGE_COLLECTED = REGISTRY.counter(
+    "karpenter_garbage_collected_instances_total",
+    "Orphaned cloud instances terminated by garbage collection",
+)
+PODS_BOUND = REGISTRY.counter(
+    "karpenter_pods_bound_total", "Pods bound to nodes by the kwok binder",
+)
+NODES_READY = REGISTRY.gauge(
+    "karpenter_nodes_ready_count", "Ready nodes in the cluster",
+)

@@ -164,7 +164,10 @@ class ConsolidationEvaluator:
     pool whose catalog admits a feasible replacement wins (the oracle's
     pool-iteration order in _open_group)."""
 
-    def __init__(self):
+    def __init__(self, mesh=None):
+        # optional jax.sharding.Mesh: candidate sets are data-parallel
+        # across devices (parallel/mesh.sharded_repack); None = single chip
+        self.mesh = mesh
         # keyed by object identity; holds the items list so the id stays valid
         self._catalog_cache: Dict[int, Tuple[list, CatalogTensors]] = {}
 
@@ -201,6 +204,9 @@ class ConsolidationEvaluator:
         C = _bucket(len(classes))
         N = _bucket(max(1, len(nodes)), lo=16)
         S = _bucket(len(sets))
+        if self.mesh is not None and S % self.mesh.size:
+            # the sharded set axis must divide evenly across devices
+            S = ((S + self.mesh.size - 1) // self.mesh.size) * self.mesh.size
         R = encode.R
 
         req = np.zeros((C, R), dtype=np.float32)
@@ -225,7 +231,12 @@ class ConsolidationEvaluator:
                 if ni is not None:
                     excl[si, ni] = True
 
-        leftover, _ = _repack(headroom, feas, req, member, excl)
+        if self.mesh is not None:
+            from karpenter_tpu.parallel.mesh import sharded_repack
+
+            leftover, _ = sharded_repack(self.mesh, headroom, feas, req, member, excl)
+        else:
+            leftover, _ = _repack(headroom, feas, req, member, excl)
         leftover = np.asarray(leftover)
         left_total = leftover.sum(axis=1)
 

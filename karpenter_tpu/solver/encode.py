@@ -243,6 +243,9 @@ def pod_sort_key(pod: Pod) -> tuple:
     return (
         -pod.requests.get(res.CPU),
         -pod.requests.get(res.MEMORY),
+        # full request vector: classes may differ only in another axis
+        # (gpu, storage); the tie-break must still order them identically
+        tuple(-v for v in scale_vector((pod.requests + _one_pod()).to_vector())),
         reqs.stable_hash(),
         tuple(sorted((t.key, t.operator, t.value, t.effect) for t in pod.tolerations)),
         _spread_sig(pod),

@@ -1,0 +1,118 @@
+"""Mesh-sharding tests on the virtual 8-device CPU mesh: the sharded
+lowerings must produce bit-identical results to the single-device kernels
+(GSPMD only changes placement, never semantics)."""
+import numpy as np
+import pytest
+
+import jax
+
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass
+from karpenter_tpu.parallel.mesh import make_mesh, sharded_repack, sharded_solve
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.scheduling import resources as res
+from karpenter_tpu.solver import consolidate, encode, ffd
+from karpenter_tpu.solver.oracle import ExistingNode
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh (tests/conftest.py)")
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in cloud.describe_zones()},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return prov.list(nc)
+
+
+class TestShardedFFD:
+    def test_sharded_solve_matches_single_device(self, mesh, catalog_items):
+        catalog = encode.encode_catalog(catalog_items, k_pad=640)
+        pool = NodePool("default")
+        pods = [
+            Pod(f"p{i}", requests=Resources({"cpu": "1", "memory": "2Gi"}))
+            for i in range(30)
+        ] + [
+            Pod(f"q{i}", requests=Resources({"cpu": "250m", "memory": "512Mi"}))
+            for i in range(50)
+        ]
+        classes = encode.group_pods(pods, extra_requirements=pool.requirements())
+        cs = encode.encode_classes(classes, catalog)
+        inp, offsets, words = ffd.make_inputs(catalog, cs)
+        single = ffd.ffd_solve(inp, g_max=32, word_offsets=offsets, words=words)
+        sharded = sharded_solve(mesh, inp, g_max=32, word_offsets=offsets, words=words)
+        np.testing.assert_array_equal(np.asarray(single.take), np.asarray(sharded.take))
+        np.testing.assert_array_equal(np.asarray(single.unplaced), np.asarray(sharded.unplaced))
+        assert int(single.n_open) == int(sharded.n_open)
+        np.testing.assert_array_equal(np.asarray(single.gmask), np.asarray(sharded.gmask))
+
+
+class TestShardedRepack:
+    def test_sharded_repack_matches_single_device(self, mesh):
+        rng = np.random.default_rng(3)
+        N, C, S, R = 16, 8, 16, encode.R
+        headroom = np.zeros((N, R), dtype=np.float32)
+        headroom[:, res.AXIS_INDEX[res.CPU]] = rng.choice([2000, 4000, 8000], N)
+        headroom[:, res.AXIS_INDEX[res.MEMORY]] = rng.choice([4096, 8192], N)
+        headroom[:, res.AXIS_INDEX[res.PODS]] = 110
+        req = np.zeros((C, R), dtype=np.float32)
+        req[:, res.AXIS_INDEX[res.CPU]] = rng.choice([250, 500, 1000], C)
+        req[:, res.AXIS_INDEX[res.MEMORY]] = rng.choice([256, 1024], C)
+        req[:, res.AXIS_INDEX[res.PODS]] = 1
+        feas = rng.random((C, N)) < 0.8
+        member = rng.integers(0, 6, size=(S, C)).astype(np.int32)
+        excl = rng.random((S, N)) < 0.2
+        l1, t1 = consolidate._repack(headroom, feas, req, member, excl)
+        l2, t2 = sharded_repack(mesh, headroom, feas, req, member, excl)
+        np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
+        np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+
+    def test_evaluator_with_mesh_matches_without(self, mesh):
+        nodes = [
+            ExistingNode(
+                name=f"n{i}",
+                labels={},
+                allocatable=Resources.from_base_units(
+                    {res.CPU: 4000, res.MEMORY: 8 * 2**30, res.PODS: 110}
+                ),
+            )
+            for i in range(5)
+        ]
+        sets = [
+            (
+                [
+                    Pod(f"s{s}-{i}", requests=Resources({"cpu": "1", "memory": "1Gi"}))
+                    for i in range(2 + s)
+                ],
+                [f"n{s % 5}"],
+            )
+            for s in range(10)
+        ]
+        plain = consolidate.ConsolidationEvaluator().evaluate(nodes, sets)
+        meshy = consolidate.ConsolidationEvaluator(mesh=mesh).evaluate(nodes, sets)
+        assert [(v.can_delete, v.leftover) for v in plain] == [
+            (v.can_delete, v.leftover) for v in meshy
+        ]
