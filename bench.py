@@ -128,9 +128,17 @@ def synth_workload(rng: np.random.Generator, catalog, n_pods: int):
 
 def main() -> None:
     profile = "--profile" in sys.argv
+    use_pallas = "--pallas" in sys.argv  # measure the fused pallas step kernel
     import jax
 
     from karpenter_tpu.solver import encode, ffd
+
+    if use_pallas and jax.default_backend() != "tpu":
+        print(
+            "# --pallas off-TPU runs the INTERPRETER (orders of magnitude "
+            "slower than either real lowering); timings below are not the "
+            "kernel's", file=sys.stderr,
+        )
 
     t0 = time.perf_counter()
     items = build_catalog_items()
@@ -148,7 +156,7 @@ def main() -> None:
         inp = ffd.make_inputs_staged(staged, cs)
         out = ffd.ffd_solve_packed(
             inp, staged.price, g_max=G_MAX, nnz_max=NNZ_MAX,
-            word_offsets=offsets, words=words,
+            word_offsets=offsets, words=words, use_pallas=use_pallas,
         )
         # materialize the full decision -- sparse placements, leftovers,
         # and per-group offering selection -- in one device->host fetch
@@ -201,7 +209,7 @@ def main() -> None:
         for _ in range(n_amort):
             out = ffd.ffd_solve_packed(
                 inp, staged.price, g_max=G_MAX, nnz_max=NNZ_MAX,
-                word_offsets=offsets, words=words,
+                word_offsets=offsets, words=words, use_pallas=use_pallas,
             )
         jax.block_until_ready(out)
         t_amort = (time.perf_counter() - t0) * 1e3

@@ -122,9 +122,12 @@ def ffd_solve_impl(inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...
     return _ffd_body(inp, g_max, word_offsets, words)
 
 
-@functools.partial(jax.jit, static_argnames=("g_max", "word_offsets", "words"))
-def ffd_solve(inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...]) -> SolveOutputs:
-    return _ffd_body(inp, g_max, word_offsets, words)
+@functools.partial(jax.jit, static_argnames=("g_max", "word_offsets", "words", "use_pallas"))
+def ffd_solve(
+    inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
+    use_pallas: bool = False,
+) -> SolveOutputs:
+    return _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas)
 
 
 _CT_SHIFT = 8  # captype bits live above the zone bits in the packed u32
@@ -165,12 +168,20 @@ def _joint_ok(x: jax.Array) -> jax.Array:
     return ((x & zone_bits) != 0) & ((x >> _CT_SHIFT) != 0)
 
 
-def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...]) -> SolveOutputs:
+def _ffd_body(
+    inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
+    use_pallas: bool = False,
+) -> SolveOutputs:
     C, Rr = inp.req.shape
     K = inp.cap.shape[0]
     Z = inp.tzone.shape[1]
     CTn = inp.tcap.shape[1]
     compat = _device_compat(inp, word_offsets, words)             # [C, K]
+    if use_pallas:
+        from karpenter_tpu.solver import kernels
+
+        cap_t = inp.cap.T                                         # [R, K]
+        pallas_interpret = kernels.default_interpret()
 
     tzc = _pack_zc(inp.tzone, inp.tcap)                           # [K] u32
     azc = _pack_zc(inp.azone, inp.acap)                           # [C] u32
@@ -207,8 +218,14 @@ def _ffd_body(inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words
         m = gmask & compat_c[None, :] & _joint_ok(gzc_new[:, None] & tzc[None, :])
 
         # -- how many fit on each open group -------------------------------
-        n_fit = _fit_counts(inp.cap, accum, req_c)                # [G, K]
-        n_grp = jnp.max(jnp.where(m, n_fit, 0.0), axis=-1)        # [G]
+        if use_pallas:
+            n_fit, n_grp = kernels.fit_max_groups(
+                cap_t, accum, req_c, m.astype(jnp.float32),
+                interpret=pallas_interpret,
+            )                                                     # [G, K], [G]
+        else:
+            n_fit = _fit_counts(inp.cap, accum, req_c)            # [G, K]
+            n_grp = jnp.max(jnp.where(m, n_fit, 0.0), axis=-1)    # [G]
         n_grp = jnp.where(slot < n_open, n_grp, 0.0).astype(jnp.int32)
 
         # -- exact first-fit via exclusive cumsum --------------------------
@@ -310,7 +327,7 @@ class PackedDecision(NamedTuple):
     sel_price: jax.Array    # [G] f32
 
 
-@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words"))
+@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "use_pallas"))
 def ffd_solve_packed(
     inp: SolveInputs,
     price: jax.Array,
@@ -319,8 +336,9 @@ def ffd_solve_packed(
     nnz_max: int,
     word_offsets: Tuple[int, ...],
     words: Tuple[int, ...],
+    use_pallas: bool = False,
 ) -> PackedDecision:
-    out = _ffd_body(inp, g_max, word_offsets, words)
+    out = _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas)
     k, z, ct, bp = select_offerings(price, out.gmask, out.gzone, out.gcap)
     flat = out.take.ravel()
     nnz_true = jnp.sum(flat != 0).astype(jnp.int32)

@@ -1,0 +1,98 @@
+"""Pallas kernels for the solver's hot ops.
+
+The FFD scan step's dominant compute is the per-(group, type) fit count:
+min over resource axes of floor(headroom / request), masked by group-type
+feasibility, max-reduced over types (solver/ffd.py). XLA fuses this well
+already; this kernel exists to claim back the remainder -- one VMEM-resident
+pass producing the per-group counts directly, with the R axis unrolled
+(R = 8) so the whole step is TG x TK vector work with no HBM intermediates.
+
+Layout: the type axis K rides the 128-wide lane dimension ([R, K] / [G, K]
+operands); G tiles the sublane axis. Everything for one step fits VMEM at
+bench shapes (G=512, K=640: ~1.6 MB), so the grid tiles G only.
+
+Usage is gated (ffd.ffd_solve(..., use_pallas=True)): off the TPU backend
+the kernel runs in interpreter mode (tests exercise it differentially);
+the benchmark decides whether the lowering actually beats XLA's fusion on
+hardware before it becomes a default.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_TG = 256  # group-axis tile (sublane multiples of 8)
+
+
+def _fit_max_kernel(cap_ref, accum_ref, req_ref, m_ref, fit_ref, max_ref):
+    """One G-tile: fit[g,k] = min_r floor((cap[r,k] - accum[g,r]) / req[r])
+    (req == 0 axes unconstrained, clamped at 0), and
+    max[g] = max_k (m[g,k] ? fit[g,k] : 0)."""
+    G, K = m_ref.shape
+    R = cap_ref.shape[0]
+    fit = jnp.full((G, K), jnp.inf, dtype=jnp.float32)
+    for r in range(R):  # static unroll: R is 8
+        cap_r = cap_ref[r : r + 1, :]                  # [1, K]
+        acc_r = accum_ref[:, r : r + 1]                # [G, 1]
+        req_r = req_ref[0, r]
+        head = cap_r - acc_r                           # [G, K]
+        per_axis = jnp.where(
+            req_r > 0.0,
+            jnp.floor(head / jnp.where(req_r > 0.0, req_r, 1.0)),
+            jnp.inf,
+        )
+        fit = jnp.minimum(fit, per_axis)
+    fit = jnp.maximum(fit, 0.0)
+    fit_ref[:] = fit
+    max_ref[:] = jnp.max(jnp.where(m_ref[:] > 0, fit, 0.0), axis=1, keepdims=True)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def fit_max_groups(
+    cap_t: jax.Array,    # [R, K] f32 (catalog allocatable, transposed)
+    accum: jax.Array,    # [G, R] f32 (scan carry)
+    req_c: jax.Array,    # [R] f32 (current class request)
+    m: jax.Array,        # [G, K] f32 0/1 (joint feasibility mask)
+    *,
+    interpret: bool = False,
+):
+    """([G, K] f32 fit counts, [G] f32 per-group masked max)."""
+    G, K = m.shape
+    R = cap_t.shape[0]
+    # largest divisor of G that is <= _TG and sublane-aligned, so VMEM
+    # blocks stay bounded for any g_max instead of spanning the whole G
+    tg = G
+    for cand in range((min(_TG, G) // 8) * 8, 7, -8):
+        if G % cand == 0:
+            tg = cand
+            break
+    fit, mx = pl.pallas_call(
+        _fit_max_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((G, K), jnp.float32),
+            jax.ShapeDtypeStruct((G, 1), jnp.float32),
+        ),
+        grid=(G // tg,),
+        in_specs=[
+            pl.BlockSpec((R, K), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tg, R), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, R), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tg, K), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((tg, K), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tg, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(cap_t, accum, req_c.reshape(1, -1), m)
+    return fit, mx[:, 0]
+
+
+def default_interpret() -> bool:
+    """Pallas TPU lowering needs the TPU backend; everywhere else (the CPU
+    test mesh) the interpreter provides the same semantics."""
+    return jax.default_backend() != "tpu"

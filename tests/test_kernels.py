@@ -1,0 +1,97 @@
+"""Pallas kernel tests (interpreter mode on the CPU mesh): the fused
+fit-count/max kernel must be bit-identical to the XLA formulation, both at
+the kernel level and through a full FFD solve."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver import encode, ffd
+from karpenter_tpu.solver.kernels import fit_max_groups
+
+
+class TestFitMaxKernel:
+    def test_matches_xla_formulation(self):
+        rng = np.random.default_rng(11)
+        G, K, R = 32, 128, encode.R
+        cap = (rng.integers(1, 64, size=(K, R)) * 64).astype(np.float32)
+        accum = (rng.integers(0, 32, size=(G, R)) * 64).astype(np.float32)
+        req = np.zeros((R,), dtype=np.float32)
+        req[0] = 250.0
+        req[1] = 512.0
+        req[3] = 1.0
+        m = (rng.random((G, K)) < 0.7).astype(np.float32)
+        fit_p, max_p = fit_max_groups(
+            jnp.asarray(cap.T), jnp.asarray(accum), jnp.asarray(req), jnp.asarray(m),
+            interpret=True,
+        )
+        fit_x = np.asarray(ffd._fit_counts(jnp.asarray(cap), jnp.asarray(accum), jnp.asarray(req)))
+        max_x = np.max(np.where(m > 0, fit_x, 0.0), axis=-1)
+        np.testing.assert_array_equal(np.asarray(fit_p), fit_x)
+        np.testing.assert_array_equal(np.asarray(max_p), max_x)
+
+    def test_zero_request_unconstrained(self):
+        G, K, R = 8, 128, encode.R
+        cap = np.full((K, R), 100.0, dtype=np.float32)
+        accum = np.zeros((G, R), dtype=np.float32)
+        req = np.zeros((R,), dtype=np.float32)  # nothing requested
+        m = np.ones((G, K), dtype=np.float32)
+        fit_p, max_p = fit_max_groups(
+            jnp.asarray(cap.T), jnp.asarray(accum), jnp.asarray(req), jnp.asarray(m),
+            interpret=True,
+        )
+        assert np.all(np.isinf(np.asarray(fit_p)))
+        assert np.all(np.isinf(np.asarray(max_p)))
+
+
+class TestPallasSolveDifferential:
+    @pytest.fixture(scope="class")
+    def catalog_items(self):
+        from karpenter_tpu.apis.nodeclass import SubnetStatus
+        from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+        from karpenter_tpu.kwok.cloud import FakeCloud
+        from karpenter_tpu.providers.instancetype import gen_catalog
+        from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+        from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+        from karpenter_tpu.providers.instancetype.types import Resolver
+        from karpenter_tpu.providers.pricing import PricingProvider
+
+        cloud = FakeCloud()
+        prov = InstanceTypeProvider(
+            cloud,
+            Resolver(gen_catalog.REGION),
+            OfferingsBuilder(
+                PricingProvider(cloud, cloud, gen_catalog.REGION),
+                UnavailableOfferings(),
+                {z.name: z.zone_id for z in cloud.describe_zones()},
+            ),
+            UnavailableOfferings(),
+        )
+        nc = TPUNodeClass("default")
+        nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+        return prov.list(nc)
+
+    def test_full_solve_matches(self, catalog_items):
+        catalog = encode.encode_catalog(catalog_items)
+        pool = NodePool("default")
+        pods = [
+            Pod(f"p{i}", requests=Resources({"cpu": "1", "memory": "2Gi"}))
+            for i in range(40)
+        ] + [
+            Pod(f"q{i}", requests=Resources({"cpu": "250m", "memory": "512Mi"}))
+            for i in range(60)
+        ]
+        classes = encode.group_pods(pods, extra_requirements=pool.requirements())
+        cs = encode.encode_classes(classes, catalog)
+        inp, offsets, words = ffd.make_inputs(catalog, cs)
+        plain = ffd.ffd_solve(inp, g_max=32, word_offsets=offsets, words=words)
+        pallas = ffd.ffd_solve(
+            inp, g_max=32, word_offsets=offsets, words=words, use_pallas=True
+        )
+        np.testing.assert_array_equal(np.asarray(plain.take), np.asarray(pallas.take))
+        np.testing.assert_array_equal(np.asarray(plain.unplaced), np.asarray(pallas.unplaced))
+        np.testing.assert_array_equal(np.asarray(plain.gmask), np.asarray(pallas.gmask))
+        assert int(plain.n_open) == int(pallas.n_open)
