@@ -1,0 +1,35 @@
+# Developer workflow (the reference Makefile's test/deflake/benchmark/e2e
+# targets, adapted: pytest on the virtual 8-device CPU mesh; bench on the
+# real accelerator).
+
+PY ?= python
+PYTEST ?= $(PY) -m pytest
+
+.PHONY: test deflake benchmark e2e run docs-check docs verify-entry
+
+test:  ## unit + component + differential suites
+	$(PYTEST) tests/ -q
+
+deflake:  ## randomized order, repeated until it fails (race hunting)
+	@for i in 1 2 3 4 5; do \
+		echo "deflake round $$i"; \
+		$(PYTEST) tests/ -q -p no:cacheprovider -o addopts= --maxfail=1 || exit 1; \
+	done
+
+benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
+	$(PY) bench.py --profile
+
+e2e:  ## scale + end-to-end suites only
+	$(PYTEST) tests/test_scale.py tests/test_e2e_provisioning.py -q
+
+run:  ## controller loop over the kwok rig
+	$(PY) -m karpenter_tpu --max-ticks 50 --tick-interval 0.2 --metrics-dump
+
+docs:  ## regenerate generated docs
+	$(PY) hack/metrics_gen.py
+
+docs-check:  ## fail if generated docs are stale
+	$(PY) hack/metrics_gen.py --check
+
+verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun)
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -36,7 +36,10 @@ def build_operator(args):
 
         solver = TPUSolver()
         evaluator = ConsolidationEvaluator()
-    return Operator(options=options, solver=solver, consolidation_evaluator=evaluator)
+    return Operator(
+        options=options, solver=solver, consolidation_evaluator=evaluator,
+        identity=getattr(args, "identity", ""),
+    )
 
 
 def main(argv=None) -> int:
@@ -44,6 +47,10 @@ def main(argv=None) -> int:
         prog="karpenter-tpu", description="TPU-native node provisioning controller (kwok rig)"
     )
     parser.add_argument("--cluster-name", default="kwok-cluster")
+    parser.add_argument(
+        "--identity", default="",
+        help="replica identity for leader election (empty = single replica, no election)",
+    )
     parser.add_argument("--interruption-queue", default="interruption-queue")
     parser.add_argument("--vm-memory-overhead-percent", type=float, default=0.075)
     parser.add_argument("--reserved-nics", type=int, default=0)

@@ -111,6 +111,7 @@ class APIObject:
         self.metadata = ObjectMeta(name=name, **meta_kwargs)
         self.status_conditions = StatusConditions()
 
+
     @property
     def name(self) -> str:
         return self.metadata.name
@@ -128,6 +129,18 @@ class APIObject:
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.metadata.name!r})"
+
+
+class Lease(APIObject):
+    """Coordination lease for leader election (the coordination.k8s.io
+    Lease analogue; see operator/election.py for the elector)."""
+
+    KIND = "Lease"
+
+    def __init__(self, name: str = "", holder: str = "", renew_deadline: float = 0.0):
+        super().__init__(name)
+        self.holder = holder
+        self.renew_deadline = renew_deadline
 
 
 def generate_name(prefix: str) -> str:

@@ -1,0 +1,63 @@
+"""Lease-based leader election.
+
+The reference runs a single active replica behind controller-runtime leader
+election, gating cache hydration on `op.Elected()` (SURVEY.md section 2.4;
+launchtemplate.go:120-128, kwok/main.go:53-66). The same contract here: a
+Lease object in the cluster store names the holder with a renew deadline;
+the elector acquires when the lease is free or expired, renews while
+holding, and the operator runs its controller sweep (and one-time cache
+hydration) only while elected.
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+from karpenter_tpu.apis.objects import Lease
+
+LEASE_NAME = "karpenter-tpu-leader"
+LEASE_DURATION = 15.0
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        cluster,
+        identity: str,
+        lease_name: str = LEASE_NAME,
+        lease_duration: float = LEASE_DURATION,
+    ):
+        self.cluster = cluster
+        self.identity = identity
+        self.lease_name = lease_name
+        self.lease_duration = lease_duration
+        self._was_elected = False
+        self.on_elected: List[Callable[[], None]] = []  # hydration hooks
+
+    @property
+    def elected(self) -> bool:
+        lease = self.cluster.try_get(Lease, self.lease_name)
+        return bool(
+            lease
+            and lease.holder == self.identity
+            and lease.renew_deadline > self.cluster.clock.now()
+        )
+
+    def tick(self) -> bool:
+        """Acquire or renew; fires on_elected hooks on each transition into
+        leadership (the reference re-hydrates caches on every election win,
+        not only the first). Returns whether this replica currently leads."""
+        now = self.cluster.clock.now()
+        lease = self.cluster.try_get(Lease, self.lease_name)
+        if lease is None:
+            lease = Lease(self.lease_name, self.identity, now + self.lease_duration)
+            self.cluster.create(lease)
+        elif lease.holder == self.identity or lease.renew_deadline <= now:
+            lease.holder = self.identity
+            lease.renew_deadline = now + self.lease_duration
+            self.cluster.update(lease)
+        holding = self.elected
+        if holding and not self._was_elected:
+            for hook in self.on_elected:
+                hook()
+        self._was_elected = holding
+        return holding

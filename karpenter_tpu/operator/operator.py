@@ -76,6 +76,7 @@ class Operator:
         options: Optional[Options] = None,
         solver=None,
         consolidation_evaluator=None,
+        identity: str = "",
     ):
         self.clock = clock or Clock()
         self.options = options or Options()
@@ -157,11 +158,22 @@ class Operator:
         )
         self.metrics_controller = MetricsController(self.cluster)
 
+        # leader election: a single active replica runs the sweep; cache
+        # hydration fires on each election win (reference: controller-runtime
+        # election + hydration gated on op.Elected())
+        from karpenter_tpu.operator.election import LeaderElector
+
+        self.elector = LeaderElector(self.cluster, identity) if identity else None
+        if self.elector is not None:
+            self.elector.on_elected.append(self.launch_templates.hydrate)
+
     # -- convenience loop for tests/rig -------------------------------------
     def tick(self) -> None:
         """One controller-manager sweep. Order mirrors the reconcile flow:
         status resolution -> events -> provisioning -> node lifecycle ->
         binding -> post-launch bookkeeping -> drain/teardown -> GC."""
+        if self.elector is not None and not self.elector.tick():
+            return  # standby replica: watch-only until the lease is won
         self.nodeclass_controller.reconcile_all()
         self.instance_type_refresh.reconcile()
         self.pricing_refresh.reconcile()

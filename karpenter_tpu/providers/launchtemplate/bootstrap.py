@@ -108,10 +108,74 @@ def render_declarative(
     return "\n".join(lines) + "\n"
 
 
+def render_toml(
+    cluster_name: str,
+    endpoint: str,
+    ca_bundle: str,
+    nodeclass: TPUNodeClass,
+    labels: Dict[str, str],
+    taints: List,
+    max_pods: Optional[int],
+) -> str:
+    """Immutable-OS TOML bootstrap (the Bottlerocket analogue): settings
+    tree only, no scripts; user TOML is prepended so the generated settings
+    win on key conflict (reference merges bottlerocket config the same
+    way)."""
+    lines = []
+    if nodeclass.user_data:
+        lines.append(nodeclass.user_data.rstrip())
+        lines.append("")
+    lines += [
+        "[settings.kubernetes]",
+        f'cluster-name = "{cluster_name}"',
+        f'api-server = "{endpoint}"',
+        f'cluster-certificate = "{ca_bundle}"',
+    ]
+    if max_pods is not None:
+        lines.append(f"max-pods = {max_pods}")
+    if labels:
+        lines.append("[settings.kubernetes.node-labels]")
+        for k, v in sorted(labels.items()):
+            lines.append(f'"{k}" = "{v}"')
+    if taints:
+        lines.append("[settings.kubernetes.node-taints]")
+        for t in taints:
+            lines.append(f'"{t.key}" = ["{t.value}:{t.effect}"]')
+    return "\n".join(lines) + "\n"
+
+
+def render_powershell(
+    cluster_name: str,
+    endpoint: str,
+    ca_bundle: str,
+    nodeclass: TPUNodeClass,
+    labels: Dict[str, str],
+    taints: List,
+    max_pods: Optional[int],
+) -> str:
+    """Windows powershell bootstrap analogue: custom userdata runs first
+    inside the same <powershell> block (the reference appends its bootstrap
+    call after user content)."""
+    label_str = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    taint_str = ",".join(f"{t.key}={t.value}:{t.effect}" for t in taints)
+    kubelet_args = " ".join(_kubelet_args(nodeclass.kubelet, max_pods))
+    body = []
+    if nodeclass.user_data:
+        body.append(nodeclass.user_data.rstrip())
+    body.append(
+        f"& Bootstrap-Node -Cluster '{cluster_name}' -Endpoint '{endpoint}' "
+        f"-CaBundle '{ca_bundle}' -NodeLabels '{label_str}' -Taints '{taint_str}' "
+        f"-KubeletExtraArgs '{kubelet_args}'"
+    )
+    return "<powershell>\n" + "\n".join(body) + "\n</powershell>"
+
+
 RENDERERS = {
     "Standard": render_standard,
     "Minimal": render_standard,
     "Declarative": render_declarative,
+    "Immutable": render_toml,
+    "Windows": render_powershell,
     "Custom": lambda cluster_name, endpoint, ca_bundle, nodeclass, labels, taints, max_pods: nodeclass.user_data,
 }
 

@@ -1,0 +1,97 @@
+"""Leader election + bootstrap family tests (operator/election.py,
+providers/launchtemplate/bootstrap.py)."""
+import pytest
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Pod, TPUNodeClass
+from karpenter_tpu.apis.nodeclass import KubeletConfiguration
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.operator.election import LEASE_DURATION, LeaderElector
+from karpenter_tpu.providers.launchtemplate import bootstrap
+from karpenter_tpu.scheduling import Resources, Taint
+
+
+class TestLeaderElection:
+    def test_single_replica_acquires_and_runs(self):
+        op = Operator(clock=FakeClock(1000.0), identity="replica-a")
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "200m"})))
+        op.settle(max_ticks=30)
+        assert not op.cluster.pending_pods()
+        assert op.elector.elected
+
+    def test_standby_does_nothing_until_lease_expires(self):
+        clock = FakeClock(1000.0)
+        leader = Operator(clock=clock, identity="replica-a")
+        standby = Operator(cloud=leader.cloud, clock=clock, identity="replica-b")
+        standby.cluster = leader.cluster  # same API server
+        standby.elector.cluster = leader.cluster
+        leader.elector.tick()
+        assert leader.elector.elected
+        assert standby.elector.tick() is False
+        # leader stops renewing; lease expires; standby takes over
+        clock.step(LEASE_DURATION + 1)
+        assert standby.elector.tick() is True
+        assert not leader.elector.elected
+
+    def test_hydration_fires_on_election_win(self):
+        op = Operator(clock=FakeClock(1000.0), identity="replica-a")
+        fired = []
+        op.elector.on_elected.append(lambda: fired.append(1))
+        op.elector.tick()
+        op.elector.tick()
+        assert fired == [1]  # once per win, not per renew
+
+    def test_no_identity_runs_unelected(self):
+        op = Operator(clock=FakeClock(1000.0))
+        assert op.elector is None
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.tick()  # must not raise
+
+
+class TestBootstrapFamilies:
+    def _kw(self, user_data=None):
+        nc = TPUNodeClass("default")
+        nc.user_data = user_data
+        return dict(
+            cluster_name="c1",
+            endpoint="https://api.c1",
+            ca_bundle="Q0E=",
+            nodeclass=nc,
+            labels={"team": "ml"},
+            taints=[Taint("dedicated", value="ml", effect="NoSchedule")],
+            max_pods=58,
+        )
+
+    def test_standard_script(self):
+        out = bootstrap.render("Standard", **self._kw())
+        assert "#!/bin/bash" in out and "--max-pods=58" in out and "team=ml" in out
+
+    def test_standard_merges_custom_userdata_as_mime(self):
+        out = bootstrap.render("Standard", **self._kw(user_data="#!/bin/bash\necho hi"))
+        assert "multipart/mixed" in out
+        assert out.index("echo hi") < out.index("bootstrap-node")
+        assert out.rstrip().endswith(f"--{bootstrap.MIME_BOUNDARY}--")
+
+    def test_declarative_yaml(self):
+        out = bootstrap.render("Declarative", **self._kw(user_data="extra: true"))
+        assert "node-config:" in out and "max-pods: 58" in out and "extra: true" in out
+
+    def test_immutable_toml(self):
+        out = bootstrap.render("Immutable", **self._kw(user_data='[settings.host]\nfoo = "bar"'))
+        assert "[settings.kubernetes]" in out
+        assert 'cluster-name = "c1"' in out
+        assert '"dedicated" = ["ml:NoSchedule"]' in out
+        # user TOML first so generated settings win on conflict
+        assert out.index("[settings.host]") < out.index("[settings.kubernetes]")
+
+    def test_windows_powershell(self):
+        out = bootstrap.render("Windows", **self._kw(user_data="Write-Host preflight"))
+        assert out.startswith("<powershell>") and out.endswith("</powershell>")
+        assert out.index("preflight") < out.index("Bootstrap-Node")
+
+    def test_custom_passthrough(self):
+        out = bootstrap.render("Custom", **self._kw(user_data="raw bytes"))
+        assert out == "raw bytes"
