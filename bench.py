@@ -1,41 +1,85 @@
 """Scale benchmark: the BASELINE.json north-star measurement.
 
-Measures end-to-end scheduling-decision latency for 50k pending pods against
-the full instance-type catalog on one accelerator chip: pod classes encoded
-(host), constraint masks + batched FFD solve (device), full decision
-materialized (host) as one compact fetch. Reported as p99 over repeated
-solves with varied workloads.
+Measures END-TO-END scheduling-decision latency for 50k pending pods against
+the full instance-type catalog: real Pod objects in, NewNodeGroup decisions
+out. The measured path is exactly the Provisioner's
+(controllers/provisioner.py -> solver/service.TPUSolver.solve):
 
-Note on transport: under the test harness the chip is reached through a
-network tunnel with ~70 ms round-trip latency, which bounds e2e below by
-one RTT (the solve is one async dispatch + one blocking fetch). The device
-compute itself is ~9 ms/solve (see --profile's amortized number); deployed
-on the TPU VM (the SURVEY.md section 7 architecture) the RTT term vanishes.
+    host   group_pods          pod objects -> equivalence classes (memoized
+                               per-pod signatures; the grouping cache)
+    host   encode_classes      classes -> dense tensors
+    device batched FFD         masks + packed-bitset compat + scan
+    host   _decode             placements -> NewNodeGroups w/ offerings
 
 Target (BASELINE.md): < 100 ms p99 @ 50k pods x ~700 types.
 The reference has no published number for this path -- its in-process Go FFD
 is the implicit baseline and the 100 ms target is the contract; vs_baseline
 reports target/measured (>1 means beating the target).
 
+Robustness contract (VERDICT round 1, item 1): this script NEVER exits
+non-zero and ALWAYS prints exactly one JSON line on stdout. The accelerator
+backend is probed in a subprocess with a timeout first (the chip sits behind
+a network tunnel that can hang or refuse; round 1 lost its number to exactly
+that), with retries; if the probe fails the measurement degrades to the host
+CPU backend and says so in the JSON ("platform": "cpu", "degraded": true).
+
 Usage: python bench.py            (one JSON line on stdout)
        python bench.py --profile  (extra breakdown on stderr)
+       python bench.py --pallas   (use the fused pallas step kernel)
+       python bench.py --cpu      (skip the probe, force host CPU)
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
+import traceback
 
 import numpy as np
 
-
 N_PODS = 50_000
-N_CLASS_SHAPES = 192
-C_PAD = 192
-G_MAX = 512
-NNZ_MAX = 4096
+N_SPEC_TEMPLATES = 160
 ITERS = 100
 WARMUP = 5
+G_MAX = 512
+TARGET_MS = 100.0
+
+_PROBE_CODE = (
+    "import jax, sys\n"
+    "d = jax.devices()\n"
+    "import jax.numpy as jnp\n"
+    "x = jnp.arange(8.0)\n"
+    "assert float((x * 2).sum()) == 56.0\n"
+    "print('BACKEND=' + jax.default_backend())\n"
+)
+
+
+def probe_backend(timeout_s: int = 120, attempts: int = 2):
+    """Initialize the environment's default JAX backend in a SUBPROCESS so a
+    hung device tunnel cannot hang the benchmark. Returns (backend, error):
+    backend is the platform name on success, None on failure."""
+    err = None
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+            for line in r.stdout.splitlines():
+                if line.startswith("BACKEND="):
+                    return line.split("=", 1)[1], None
+            err = (r.stderr or r.stdout)[-500:]
+        except subprocess.TimeoutExpired:
+            err = f"backend probe timed out after {timeout_s}s (attempt {i + 1})"
+        except Exception as e:  # noqa: BLE001 - diagnostic path must not raise
+            err = repr(e)
+        if i < attempts - 1:
+            time.sleep(3 * (i + 1))
+    return None, err
 
 
 def build_catalog_items():
@@ -62,127 +106,122 @@ def build_catalog_items():
     )
     nc = TPUNodeClass("default")
     nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
-    return prov.list(nc)
+    return prov.list(nc), cloud
 
 
-def synth_workload(rng: np.random.Generator, catalog, n_pods: int):
-    """A 50k-pod pending set, pre-grouped into classes (the controller's
-    batching window produces exactly this shape). Mix modeled on scale-test
-    workloads: mostly small web pods, some medium services, a few large."""
-    from karpenter_tpu.solver import encode
-    from karpenter_tpu.apis import labels as wk
-    from karpenter_tpu.scheduling import Requirements
+def synth_pods(rng: np.random.Generator, zones, n_pods: int, salt: int):
+    """A 50k-pod pending set of REAL Pod objects (VERDICT round 1, item 2:
+    host-side encoding must be inside the measurement). Spec mix modeled on
+    the reference's scale-test workloads (test/suites/scale): many replicas
+    over ~160 distinct deployment specs -- mostly small web pods, some
+    medium services, a few large; ~20% zone-pinned, ~15% on-demand-only,
+    some arch/category constrained, some tolerating dedicated taints."""
+    from karpenter_tpu.apis import Pod, labels as wk
+    from karpenter_tpu.scheduling import Resources, Toleration
+    from karpenter_tpu.scheduling import resources as res
 
-    C = N_CLASS_SHAPES
     cpu_choices = np.array([100, 100, 250, 250, 500, 500, 1000, 2000, 4000, 8000])
     mem_choices = np.array([128, 256, 512, 512, 1024, 2048, 4096, 8192, 16384, 32768])
-    idx = rng.integers(0, len(cpu_choices), size=C)
-    weights = rng.dirichlet(np.ones(C) * 0.5)
+
+    T = N_SPEC_TEMPLATES
+    sizes = rng.integers(0, len(cpu_choices), size=T)
+    weights = rng.dirichlet(np.ones(T) * 0.5)
     counts = np.maximum(1, (weights * n_pods).astype(np.int64))
     counts[0] += n_pods - counts.sum()
 
-    req = np.zeros((C, encode.R), dtype=np.float32)
-    import karpenter_tpu.scheduling.resources as res
+    templates = []
+    for t in range(T):
+        selector = {}
+        u = rng.random()
+        if u < 0.20:
+            selector[wk.ZONE_LABEL] = str(zones[int(rng.integers(0, len(zones)))])
+        elif u < 0.35:
+            selector[wk.CAPACITY_TYPE_LABEL] = wk.CAPACITY_TYPE_ON_DEMAND
+        elif u < 0.42:
+            selector[wk.ARCH_LABEL] = "arm64" if rng.random() < 0.5 else "amd64"
+        tolerations = []
+        if rng.random() < 0.1:
+            tolerations.append(Toleration(key="dedicated", operator="Exists"))
+        requests = Resources.from_base_units(
+            {
+                res.CPU: float(cpu_choices[sizes[t]]),
+                res.MEMORY: float(mem_choices[sizes[t]]) * 2**20,
+            }
+        )
+        templates.append((requests, selector, tolerations))
 
-    req[:, res.AXIS_INDEX[res.CPU]] = cpu_choices[idx]
-    req[:, res.AXIS_INDEX[res.MEMORY]] = mem_choices[idx]  # MiB (already scaled units)
-    req[:, res.AXIS_INDEX[res.PODS]] = 1.0
-
-    # sort FFD-style: dominant resource desc
-    order = np.lexsort((-req[:, res.AXIS_INDEX[res.MEMORY]], -req[:, res.AXIS_INDEX[res.CPU]]))
-    req = req[order]
-    counts = counts[order]
-
-    c_pad = C_PAD
-    empty = Requirements()
-    allowed = [np.zeros((c_pad, w), dtype=np.uint32) for w in catalog.words]
-    for d in range(encode.D):
-        allowed[d][:] = 0xFFFFFFFF
-    num_lo = np.full((c_pad, encode.ND), -np.inf, dtype=np.float32)
-    num_hi = np.full((c_pad, encode.ND), np.inf, dtype=np.float32)
-    azone = np.zeros((c_pad, encode.Z_PAD), dtype=bool)
-    azone[:, : len(catalog.zones)] = True
-    acap = np.zeros((c_pad, encode.CT), dtype=bool)
-    acap[:] = True
-    # a third of classes are zone-pinned / captype-constrained (constraint
-    # masks exercise the requirement path)
-    zone_pin = rng.random(c_pad) < 0.2
-    azone[zone_pin] = False
-    azone[zone_pin, rng.integers(0, len(catalog.zones), size=int(zone_pin.sum()))] = True
-    od_only = rng.random(c_pad) < 0.15
-    acap[od_only, 1] = False  # no spot
-
-    reqp = np.zeros((c_pad, encode.R), dtype=np.float32)
-    reqp[:C] = req
-    countp = np.zeros((c_pad,), dtype=np.int32)
-    countp[:C] = counts
-    sched = np.zeros((c_pad,), dtype=bool)
-    sched[:C] = True
-
-    cs = encode.PodClassSet(
-        classes=[], c_real=C, c_pad=c_pad, req=reqp, count=countp, allowed=allowed,
-        num_lo=num_lo, num_hi=num_hi, azone=azone, acap=acap, schedulable=sched,
-    )
-    return cs
+    pods = []
+    i = 0
+    for t in range(T):
+        requests, selector, tolerations = templates[t]
+        for _ in range(int(counts[t])):
+            pods.append(
+                Pod(
+                    f"bench-{salt}-{i}",
+                    requests=requests,
+                    node_selector=selector,
+                    tolerations=tolerations,
+                    labels={"app": f"app-{salt}-{t}"},
+                )
+            )
+            i += 1
+    return pods
 
 
-def main() -> None:
-    profile = "--profile" in sys.argv
-    use_pallas = "--pallas" in sys.argv  # measure the fused pallas step kernel
+def run(profile: bool, use_pallas: bool):
     import jax
 
-    from karpenter_tpu.solver import encode, ffd
+    from karpenter_tpu.apis import NodePool
+    from karpenter_tpu.solver import encode
+    from karpenter_tpu.solver.service import TPUSolver
 
-    if use_pallas and jax.default_backend() != "tpu":
+    backend = jax.default_backend()
+    if use_pallas and backend != "tpu":
         print(
             "# --pallas off-TPU runs the INTERPRETER (orders of magnitude "
             "slower than either real lowering); timings below are not the "
-            "kernel's", file=sys.stderr,
+            "kernel's",
+            file=sys.stderr,
         )
 
     t0 = time.perf_counter()
-    items = build_catalog_items()
-    catalog = encode.encode_catalog(items)
-    # catalog tensors are staged on device ONCE (they change on the 12h
-    # refresh cadence, not per scheduling tick -- SURVEY.md section 7 hard
-    # part #6); per-solve traffic is the pod-class tensors only
-    staged, offsets, words = ffd.stage_catalog(catalog)
+    items, cloud = build_catalog_items()
+    zones = [z.name for z in cloud.describe_zones()]
     t_catalog = time.perf_counter() - t0
 
+    pool = NodePool("default")
+    solver = TPUSolver(g_max=G_MAX, use_pallas=use_pallas)
+
     rng = np.random.default_rng(42)
-    workloads = [synth_workload(rng, catalog, N_PODS) for _ in range(8)]
-
-    def solve(cs):
-        inp = ffd.make_inputs_staged(staged, cs)
-        out = ffd.ffd_solve_packed(
-            inp, staged.price, g_max=G_MAX, nnz_max=NNZ_MAX,
-            word_offsets=offsets, words=words, use_pallas=use_pallas,
-        )
-        # materialize the full decision -- sparse placements, leftovers,
-        # and per-group offering selection -- in one device->host fetch
-        dec = jax.device_get(out)
-        assert int(dec.nnz) <= NNZ_MAX, "sparse take overflow; refetch dense"
-        return dec
-
-    # warmup / compile
     t0 = time.perf_counter()
-    dec = solve(workloads[0])
+    workloads = [synth_pods(rng, zones, N_PODS, salt) for salt in range(8)]
+    t_pods = time.perf_counter() - t0
+
+    def solve(pods):
+        return solver.solve(pool, items, pods)
+
+    # first solves: compile + device staging + grouping-cache cold start.
+    # Every workload is solved once so each distinct class-count bucket is
+    # compiled before measurement begins.
+    t0 = time.perf_counter()
+    result = solve(workloads[0])
     t_compile = time.perf_counter() - t0
-    n_open = int(dec.n_open)
-    placed = int(dec.val.sum())
-    assert placed + int(dec.unplaced.sum()) == int(workloads[0].count.sum()), "pod conservation violated"
-    # adaptive warmup: the chip sits behind a network tunnel whose first
-    # seconds after idle can be pathologically slow (seconds per solve);
-    # warm until solve time stabilizes near its observed floor so the
-    # measurement reflects steady state, not transport cold-start
+    n_groups = len(result.new_groups)
+    placed = sum(len(g.pods) for g in result.new_groups)
+    assert placed + len(result.unschedulable) == N_PODS, "pod conservation violated"
+    for w in workloads[1:]:
+        solve(w)
+
+    # adaptive warmup: a tunneled chip's first seconds after idle can be
+    # pathologically slow; warm until solve time stabilizes near its floor
     best = float("inf")
     stable = 0
-    for _ in range(60):
+    for _ in range(40):
         t0 = time.perf_counter()
         solve(workloads[0])
         dt = time.perf_counter() - t0
         if dt < best * 0.9:
-            stable = 0  # still improving markedly: not yet at steady state
+            stable = 0
         elif dt <= best * 1.3:
             stable += 1
             if stable >= WARMUP:
@@ -193,43 +232,93 @@ def main() -> None:
 
     times = []
     for i in range(ITERS):
-        cs = workloads[i % len(workloads)]
+        pods = workloads[i % len(workloads)]
         t0 = time.perf_counter()
-        solve(cs)
+        solve(pods)
         times.append((time.perf_counter() - t0) * 1000.0)
     times = np.array(times)
     p50, p99 = float(np.percentile(times, 50)), float(np.percentile(times, 99))
 
+    # total fleet price of the decision (secondary objective; the packing
+    # objective is price-aware -- see solver/ffd.py)
+    # instance_types arrive sorted by cheapest price (service._decode)
+    fleet_price = sum(g.instance_types[0].cheapest_price() for g in result.new_groups)
+
     if profile:
-        # amortized device-compute time: N dependent dispatches, one block
-        # (subtracts the transport RTT that dominates single-solve e2e)
-        inp = ffd.make_inputs_staged(staged, workloads[0])
-        n_amort = 20
+        pods = workloads[0]
         t0 = time.perf_counter()
-        for _ in range(n_amort):
-            out = ffd.ffd_solve_packed(
-                inp, staged.price, g_max=G_MAX, nnz_max=NNZ_MAX,
-                word_offsets=offsets, words=words, use_pallas=use_pallas,
-            )
-        jax.block_until_ready(out)
-        t_amort = (time.perf_counter() - t0) * 1e3
+        classes = encode.group_pods(pods, extra_requirements=pool.requirements())
+        t_group = (time.perf_counter() - t0) * 1e3
+        catalog = solver.catalog_tensors(items)
+        t0 = time.perf_counter()
+        encode.encode_classes(classes, catalog, c_pad=encode.bucket(len(classes), 16))
+        t_encode = (time.perf_counter() - t0) * 1e3
         print(
-            f"# catalog build {t_catalog*1e3:.0f}ms; first solve (compile) {t_compile:.1f}s; "
+            f"# backend {backend}; catalog build {t_catalog * 1e3:.0f}ms; "
+            f"pod synth {t_pods:.1f}s; first solve (compile) {t_compile:.1f}s; "
             f"p50 {p50:.1f}ms p99 {p99:.1f}ms min {times.min():.1f}ms max {times.max():.1f}ms; "
-            f"device-only ~{t_amort/n_amort:.1f}ms/solve; "
-            f"nodes opened {n_open}; pods placed {placed}/{N_PODS}; backend {jax.default_backend()}",
+            f"host group {t_group:.1f}ms encode {t_encode:.1f}ms ({len(classes)} classes); "
+            f"groups opened {n_groups}; pods placed {placed}/{N_PODS}; "
+            f"fleet price ${fleet_price:.2f}/h",
             file=sys.stderr,
         )
-    print(
-        json.dumps(
-            {
-                "metric": f"p99_scheduling_decision_latency_{N_PODS//1000}k_pods_{catalog.k_real}_types",
-                "value": round(p99, 2),
-                "unit": "ms",
-                "vs_baseline": round(100.0 / p99, 3) if p99 > 0 else 0.0,
-            }
+
+    k_real = solver.catalog_tensors(items).k_real
+    return {
+        "metric": f"p99_scheduling_decision_latency_{N_PODS // 1000}k_pods_{k_real}_types",
+        "value": round(p99, 2),
+        "unit": "ms",
+        "vs_baseline": round(TARGET_MS / p99, 3) if p99 > 0 else 0.0,
+        "p50_ms": round(p50, 2),
+        "platform": backend,
+        "groups_opened": n_groups,
+        "pods_placed": placed,
+        "fleet_price_per_hour": round(fleet_price, 2),
+    }
+
+
+def main() -> None:
+    profile = "--profile" in sys.argv
+    use_pallas = "--pallas" in sys.argv
+    force_cpu = "--cpu" in sys.argv
+
+    degraded = False
+    probe_err = None
+    if force_cpu:
+        backend, probe_err = None, "forced by --cpu"
+    else:
+        backend, probe_err = probe_backend()
+    if backend is None:
+        degraded = not force_cpu
+        if probe_err and not force_cpu:
+            print(f"# backend probe failed, falling back to cpu: {probe_err}", file=sys.stderr)
+        import jax
+
+        # the environment may pin JAX_PLATFORMS to a remote-accelerator
+        # plugin via sitecustomize; the config override wins regardless
+        jax.config.update("jax_platforms", "cpu")
+
+    try:
+        out = run(profile, use_pallas)
+        if degraded:
+            out["degraded"] = True
+            out["probe_error"] = (probe_err or "")[:300]
+        print(json.dumps(out))
+    except Exception as e:  # noqa: BLE001 - the JSON line must always appear
+        traceback.print_exc()
+        print(
+            json.dumps(
+                {
+                    "metric": f"p99_scheduling_decision_latency_{N_PODS // 1000}k_pods",
+                    "value": 0.0,
+                    "unit": "ms",
+                    "vs_baseline": 0.0,
+                    "error": f"{type(e).__name__}: {e}"[:300],
+                    "degraded": True,
+                }
+            )
         )
-    )
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

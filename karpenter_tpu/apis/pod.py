@@ -75,6 +75,48 @@ class Pod(APIObject):
         # status / spec binding
         self.node_name: str = ""
         self.phase: str = "Pending"
+        # memoized grouping signature (solver/encode.group_pods); pod specs
+        # are immutable post-creation in k8s, so computing once is sound
+        self._group_sig: Optional[tuple] = None
+
+    def grouping_signature(self) -> tuple:
+        """A cheap structural signature over every spec field that affects
+        scheduling identity. Pods with equal signatures are interchangeable
+        for the batch solver; the expensive canonical key (Requirements
+        construction + stable hash) is computed once per distinct signature,
+        not per pod -- this is the hot-path grouping cache the 50k-pod
+        scheduling budget depends on (reference hot loop #1:
+        designs/bin-packing.md:17-43 pre-groups pods the same way)."""
+        sig = self._group_sig
+        if sig is None:
+            labels = self.metadata.labels
+            sig = self._group_sig = (
+                tuple(sorted(self.requests.items())),
+                tuple(sorted(self.node_selector.items())) if self.node_selector else (),
+                tuple(
+                    tuple(
+                        (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than, r.min_values)
+                        for r in term
+                    )
+                    for term in self.node_affinity_terms
+                ),
+                tuple((t.key, t.operator, t.value, t.effect) for t in self.tolerations),
+                tuple(
+                    (
+                        t.topology_key,
+                        t.max_skew,
+                        t.when_unsatisfiable,
+                        tuple(sorted(t.label_selector.items())),
+                        all(labels.get(k) == v for k, v in t.label_selector.items()),
+                    )
+                    for t in self.topology_spread
+                ),
+                tuple(
+                    (tuple(sorted(t.label_selector.items())), t.topology_key, t.anti)
+                    for t in self.affinity_terms
+                ),
+            )
+        return sig
 
     # -- scheduling views ---------------------------------------------------
     def scheduling_requirements(self) -> List[Requirements]:

@@ -271,17 +271,32 @@ def _one_pod():
 def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] = None) -> List[PodClass]:
     """Collapse pods into equivalence classes. Pods with multiple affinity
     alternatives use their first term (the oracle handles full OR semantics;
-    multi-term pods are rare and can be routed to the oracle)."""
+    multi-term pods are rare and can be routed to the oracle).
+
+    Two-level grouping keeps the 50k-pod hot path inside the latency budget:
+    pods key by their memoized cheap structural signature
+    (Pod.grouping_signature -- raw spec tuples, no numpy / hashing), and
+    ONE canonical key (Requirements construction + stable hash + scaled
+    request vector) is computed per distinct signature. Signatures whose
+    canonical keys coincide (e.g. the same constraint written as
+    nodeSelector vs nodeAffinity) share a class. The single ordered pass
+    preserves input order within each class -- required for exact
+    differential equivalence with the oracle's stable per-pod sort."""
+    sig_to_class: Dict[tuple, PodClass] = {}
     groups: Dict[tuple, PodClass] = {}
     for pod in pods:
-        reqs = pod.scheduling_requirements()[0]
-        if extra_requirements is not None:
-            reqs = reqs.copy().add(*extra_requirements)
-        key = _class_key(pod, reqs)
-        pc = groups.get(key)
+        sig = pod.grouping_signature()
+        pc = sig_to_class.get(sig)
         if pc is None:
-            requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
-            pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
+            reqs = pod.scheduling_requirements()[0]
+            if extra_requirements is not None:
+                reqs = reqs.copy().add(*extra_requirements)
+            key = _class_key(pod, reqs)
+            pc = groups.get(key)
+            if pc is None:
+                requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
+                pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
+            sig_to_class[sig] = pc
         pc.pods.append(pod)
     # FFD order: dominant resource descending with the canonical tie-break
     # (pod_sort_key) -- must match the oracle's sort for differential

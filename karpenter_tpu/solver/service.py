@@ -32,9 +32,17 @@ _bucket = encode.bucket
 
 
 class TPUSolver:
-    def __init__(self, g_max: int = 512, c_pad_min: int = 16, client=None):
+    def __init__(self, g_max: int = 512, c_pad_min: int = 16, client=None, use_pallas: bool = False):
         self.g_max = g_max
         self.c_pad_min = c_pad_min
+        # route the FFD step through the fused pallas kernel (TPU only;
+        # interpreted elsewhere -- bench.py decides based on hardware)
+        if client is not None and use_pallas:
+            raise ValueError(
+                "use_pallas is not forwarded over the RPC sidecar; run the "
+                "solver in-process for the pallas path"
+            )
+        self.use_pallas = use_pallas
         # optional solver/rpc.SolverClient: tensor solves go over the wire
         # to the sidecar on the TPU VM instead of the in-process backend
         # (the SURVEY.md section 2.4 deployment seam); encode/decode and the
@@ -197,7 +205,10 @@ class TPUSolver:
             out = self.client.solve_classes(seqnum, catalog, class_set, g_max=self.g_max)
         else:
             inp = ffd.make_inputs_staged(staged, class_set)
-            out = ffd.ffd_solve(inp, g_max=self.g_max, word_offsets=offsets, words=words)
+            out = ffd.ffd_solve(
+                inp, g_max=self.g_max, word_offsets=offsets, words=words,
+                use_pallas=self.use_pallas,
+            )
             # one batched device->host fetch (transfers overlap; a single RTT)
             out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
         return self._decode(
@@ -260,7 +271,14 @@ class TPUSolver:
         gmask = np.asarray(out.gmask)                  # [G, K]
         gzone = np.asarray(out.gzone)
         gcap = np.asarray(out.gcap)
+        # cumulative placements per class: offset math in O(1) per (c, g)
+        take_cum = np.concatenate(
+            [np.zeros((take.shape[0], 1), dtype=take.dtype), np.cumsum(take, axis=1)], axis=1
+        )
         by_name = {it.name: it for it in instance_types}
+        # price memo: cheapest_price scans offerings; decode sorts candidate
+        # types per group, so resolve each type's price exactly once
+        price_of = {it.name: it.cheapest_price() for it in instance_types}
         captype_names = [wk.CAPACITY_TYPE_RESERVED, wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND]
 
         usage = nodepool_usage if nodepool_usage is not None else Resources()
@@ -277,11 +295,16 @@ class TPUSolver:
                 pc = class_set.classes[c]
                 n = int(take[c, g])
                 # pods before `off` went to existing nodes in phase 1
-                off = int(class_offset[c]) + int(take[c, :g].sum())
+                off = int(class_offset[c]) + int(take_cum[c, g])
                 group_pods.extend(pc.pods[off : off + n])
                 reqs.add(*pc.requirements)
-                for p in pc.pods[off : off + n]:
-                    requested = requested + p.requests + Resources.from_base_units({res.PODS: 1})
+                # all pods in a class have identical requests (the canonical
+                # class key includes the scaled request vector), so the
+                # group total is one vector multiply per class, not one
+                # Resources add per pod -- decode is on the hot path
+                requested = requested + (
+                    pc.pods[0].requests + Resources.from_base_units({res.PODS: 1})
+                ) * n
             type_names = [catalog.names[k] for k in np.nonzero(gmask[g][: catalog.k_real])[0]]
             group_types = [by_name[n] for n in type_names if n in by_name]
             if not group_types:
@@ -306,7 +329,7 @@ class TPUSolver:
                 NewNodeGroup(
                     nodepool=pool,
                     requirements=reqs,
-                    instance_types=sorted(group_types, key=lambda it: it.cheapest_price()),
+                    instance_types=sorted(group_types, key=lambda it: price_of[it.name]),
                     taints=list(pool.template.taints) + list(pool.template.startup_taints),
                     pods=group_pods,
                     requested=requested,
