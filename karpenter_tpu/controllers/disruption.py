@@ -80,6 +80,15 @@ class DisruptionController:
         # constraints fall back to the per-candidate oracle simulation
         self.evaluator = evaluator
         self.last_decisions: List[Tuple[str, str]] = []  # (claim name, reason)
+        # nodes disrupted in the CURRENT pass: their NodeClaims are deleting
+        # but the Node objects are not yet marked (termination runs later),
+        # so simulations must exclude them explicitly or later candidates
+        # would repack onto capacity that is already going away
+        self._pass_disrupted: List[str] = []
+        # per-pass pool/catalog snapshot (None outside a pass: helpers
+        # called directly, e.g. from tests, fetch fresh)
+        self._pass_pools: Optional[List[NodePool]] = None
+        self._pass_catalogs: Optional[Dict[str, list]] = None
 
     # -- helpers ------------------------------------------------------------
     def _price_of(self, claim: NodeClaim) -> float:
@@ -167,21 +176,32 @@ class DisruptionController:
                 out.setdefault(p.node_name, []).append(p)
         return out
 
+    def _in_flight_pods(self) -> List[Pod]:
+        """Reschedulable pods still bound to nodes disrupted earlier in this
+        pass. They have not rebound yet, so later simulations must place
+        them ALONGSIDE the candidate's pods -- otherwise two candidates
+        would each claim the same surviving headroom (ADVICE round 1)."""
+        by_node = self._pods_by_node()
+        return [
+            p
+            for n in self._pass_disrupted
+            for p in by_node.get(n, [])
+            if p.reschedulable()
+        ]
+
     def _simulate(self, candidates: Sequence[Candidate], allow_new_node: bool):
         """Can every pod on the candidate set reschedule elsewhere (plus at
         most one new node when allow_new_node)? Returns (ok, new_groups)."""
-        excluded = [c.node.metadata.name for c in candidates]
-        pods = [p for c in candidates for p in c.pods if p.reschedulable()]
-        nodepools = [p for p in self.cluster.list(NodePool) if not p.deleting]
+        excluded = [c.node.metadata.name for c in candidates] + list(self._pass_disrupted)
+        pods = self._in_flight_pods() + [
+            p for c in candidates for p in c.pods if p.reschedulable()
+        ]
+        nodepools, pass_catalogs = self._pool_context()
         catalogs: Dict[str, list] = {}
         zones: set = set()
         if allow_new_node:
-            for pool in nodepools:
-                try:
-                    items = self.cloud_provider.get_instance_types(pool)
-                except CloudError:
-                    items = []
-                catalogs[pool.name] = items
+            catalogs = pass_catalogs
+            for items in catalogs.values():
                 for it in items:
                     for o in it.available_offerings():
                         zones.add(o.zone)
@@ -213,10 +233,29 @@ class DisruptionController:
         try:
             return self._reconcile(max_disruptions)
         finally:
+            self._pass_pools, self._pass_catalogs = None, None
             metrics.DISRUPTION_EVAL_DURATION.observe(_time.perf_counter() - t0)
+
+    def _pool_context(self) -> Tuple[List[NodePool], Dict[str, list]]:
+        """(live pools, their catalogs). Inside a pass this is the snapshot
+        taken at pass start -- catalogs change on the 12h refresh cadence,
+        not mid-pass, so verdict re-judges must not re-fetch them."""
+        if self._pass_pools is not None and self._pass_catalogs is not None:
+            return self._pass_pools, self._pass_catalogs
+        pools = [p for p in self.cluster.list(NodePool) if not p.deleting]
+        catalogs: Dict[str, list] = {}
+        for pool in pools:
+            try:
+                catalogs[pool.name] = self.cloud_provider.get_instance_types(pool)
+            except CloudError:
+                catalogs[pool.name] = []
+        return pools, catalogs
 
     def _reconcile(self, max_disruptions: int) -> List[Tuple[str, str]]:
         self.last_decisions = []
+        self._pass_disrupted = []
+        self._pass_pools, self._pass_catalogs = None, None
+        self._pass_pools, self._pass_catalogs = self._pool_context()
         disrupting: Dict[str, int] = {}
         totals: Dict[str, int] = {}
         for claim in self.cluster.list(NodeClaim):
@@ -266,9 +305,17 @@ class DisruptionController:
             key=lambda c: c.disruption_cost,
         )
         verdicts = self._device_verdicts(consolidatable)
-        for c in consolidatable:
+        decided = len(self.last_decisions)
+        for i, c in enumerate(consolidatable):
             if len(self.last_decisions) >= max_disruptions:
                 return self.last_decisions
+            if len(self.last_decisions) != decided:
+                # a disruption earlier in this pass consumed surviving
+                # headroom; stale verdicts would double-book it (ADVICE
+                # round 1) -- re-judge the remaining candidates in one
+                # fresh batched dispatch
+                decided = len(self.last_decisions)
+                verdicts = self._device_verdicts(consolidatable[i:])
             reschedulable = [p for p in c.pods if p.owner_kind != "Node"]
             if not reschedulable:
                 c.claim.status_conditions.set_true(COND_EMPTY)
@@ -342,17 +389,23 @@ class DisruptionController:
                 c.claim.metadata.name: [p for p in c.pods if p.reschedulable()]
                 for c in remaining
             }
-            if all(device_eligible(resched[c.claim.metadata.name]) for c in remaining):
+            in_flight = self._in_flight_pods()
+            if all(
+                device_eligible(resched[c.claim.metadata.name]) for c in remaining
+            ) and device_eligible(in_flight):
                 sets = []
                 for k in range(2, len(remaining) + 1):
                     prefix = remaining[:k]
                     sets.append(
                         (
-                            [p for c in prefix for p in resched[c.claim.metadata.name]],
+                            in_flight
+                            + [p for c in prefix for p in resched[c.claim.metadata.name]],
                             [c.node.metadata.name for c in prefix],
                         )
                     )
-                verdicts = self.evaluator.evaluate(self._other_nodes([]), sets)
+                verdicts = self.evaluator.evaluate(
+                    self._other_nodes(list(self._pass_disrupted)), sets
+                )
                 for i in range(len(verdicts) - 1, -1, -1):  # largest k first
                     if verdicts[i].can_delete:
                         return remaining[: i + 2]
@@ -374,6 +427,11 @@ class DisruptionController:
             return {}
         from karpenter_tpu.solver.consolidate import device_eligible
 
+        in_flight = self._in_flight_pods()
+        if in_flight and not device_eligible(in_flight):
+            # in-flight pods carry stateful constraints the evaluator does
+            # not model; every remaining candidate takes the oracle path
+            return {}
         eligible: List[Candidate] = []
         sets = []
         for c in consolidatable:
@@ -381,18 +439,15 @@ class DisruptionController:
             if not resched or not device_eligible(resched):
                 continue
             eligible.append(c)
-            sets.append((resched, [c.node.metadata.name]))
+            # in-flight pods repack jointly with the candidate's: the
+            # verdict only says can_delete when BOTH fit the survivors
+            sets.append((in_flight + resched, [c.node.metadata.name]))
         if not eligible:
             return {}
-        nodepools = [p for p in self.cluster.list(NodePool) if not p.deleting]
-        catalogs: Dict[str, list] = {}
-        for pool in nodepools:
-            try:
-                catalogs[pool.name] = self.cloud_provider.get_instance_types(pool)
-            except CloudError:
-                catalogs[pool.name] = []
+        pools, catalogs = self._pool_context()
         verdicts = self.evaluator.evaluate(
-            self._other_nodes([]), sets, pools=nodepools, catalogs=catalogs
+            self._other_nodes(list(self._pass_disrupted)), sets,
+            pools=pools, catalogs=catalogs,
         )
         return {c.claim.metadata.name: v for c, v in zip(eligible, verdicts)}
 
@@ -441,6 +496,7 @@ class DisruptionController:
         from karpenter_tpu import metrics
 
         self.cluster.delete(NodeClaim, c.claim.metadata.name)
+        self._pass_disrupted.append(c.node.metadata.name)
         disrupting[c.nodepool.name] = disrupting.get(c.nodepool.name, 0) + 1
         self.last_decisions.append((c.claim.metadata.name, reason))
         metrics.DISRUPTION_DECISIONS.inc(reason=reason)

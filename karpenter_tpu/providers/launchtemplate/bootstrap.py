@@ -108,6 +108,83 @@ def render_declarative(
     return "\n".join(lines) + "\n"
 
 
+_TOML_ESCAPES = {
+    "\\": "\\\\", '"': '\\"', "\n": "\\n", "\t": "\\t", "\r": "\\r",
+    "\b": "\\b", "\f": "\\f",
+}
+
+
+def _toml_scalar(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, list):
+        return "[" + ", ".join(_toml_scalar(x) for x in v) + "]"
+    s = str(v)
+    out = []
+    for ch in s:
+        esc = _TOML_ESCAPES.get(ch)
+        if esc is not None:
+            out.append(esc)
+        elif ord(ch) < 0x20:
+            out.append(f"\\u{ord(ch):04X}")
+        else:
+            out.append(ch)
+    return '"' + "".join(out) + '"'
+
+
+def _toml_key(k: str) -> str:
+    return k if k.replace("-", "").replace("_", "").isalnum() else f'"{k}"'
+
+
+def _toml_dump(
+    tree: Dict, prefix: str = "", lines: Optional[List[str]] = None,
+    _array_elem: bool = False,
+) -> str:
+    """Serialize a nested dict as TOML: scalars of each table first, then
+    sub-tables depth-first with dotted [a.b] headers, then arrays of tables
+    as [[a.b]] blocks (sub-tables inside an element use dotted headers,
+    which TOML attaches to the most recent [[a.b]]). Keys are quoted when
+    needed (label names contain dots/slashes). Round-trips everything
+    tomllib can parse."""
+    if lines is None:
+        lines = []
+
+    def is_aot(v):  # array of tables
+        return isinstance(v, list) and v and all(isinstance(x, dict) for x in v)
+
+    scalars = {k: v for k, v in tree.items() if not isinstance(v, dict) and not is_aot(v)}
+    subs = {k: v for k, v in tree.items() if isinstance(v, dict)}
+    aots = {k: v for k, v in tree.items() if is_aot(v)}
+    if prefix:
+        if _array_elem:
+            lines.append(f"[[{prefix}]]")
+        elif scalars or not (subs or aots):
+            lines.append(f"[{prefix}]")
+    for k, v in scalars.items():
+        lines.append(f"{_toml_key(k)} = {_toml_scalar(v)}")
+    for k, v in subs.items():
+        _toml_dump(v, f"{prefix}.{_toml_key(k)}" if prefix else _toml_key(k), lines)
+    for k, elems in aots.items():
+        header = f"{prefix}.{_toml_key(k)}" if prefix else _toml_key(k)
+        for elem in elems:
+            _toml_dump(elem, header, lines, _array_elem=True)
+    return "\n".join(lines) + "\n"
+
+
+def _deep_merge(base: Dict, override: Dict) -> Dict:
+    """Merge `override` onto `base`, recursing into shared sub-tables;
+    override's leaves win on conflict."""
+    out = dict(base)
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
 def render_toml(
     cluster_name: str,
     endpoint: str,
@@ -118,30 +195,38 @@ def render_toml(
     max_pods: Optional[int],
 ) -> str:
     """Immutable-OS TOML bootstrap (the Bottlerocket analogue): settings
-    tree only, no scripts; user TOML is prepended so the generated settings
-    win on key conflict (reference merges bottlerocket config the same
-    way)."""
-    lines = []
+    tree only, no scripts. User TOML is parsed and merged STRUCTURALLY with
+    the generated settings tree -- generated values win on key conflict
+    (the reference merges Bottlerocket userdata the same way,
+    pkg/providers/amifamily/bootstrap/bottlerocket.go; a textual prepend
+    would make duplicate tables a TOML parse error, ADVICE round 1)."""
+    import tomllib
+
+    user_tree: Dict = {}
     if nodeclass.user_data:
-        lines.append(nodeclass.user_data.rstrip())
-        lines.append("")
-    lines += [
-        "[settings.kubernetes]",
-        f'cluster-name = "{cluster_name}"',
-        f'api-server = "{endpoint}"',
-        f'cluster-certificate = "{ca_bundle}"',
-    ]
+        try:
+            user_tree = tomllib.loads(nodeclass.user_data)
+        except tomllib.TOMLDecodeError as e:
+            raise ValueError(f"nodeclass user_data is not valid TOML: {e}") from e
+
+    kube: Dict = {
+        "cluster-name": cluster_name,
+        "api-server": endpoint,
+        "cluster-certificate": ca_bundle,
+    }
     if max_pods is not None:
-        lines.append(f"max-pods = {max_pods}")
+        kube["max-pods"] = max_pods
     if labels:
-        lines.append("[settings.kubernetes.node-labels]")
-        for k, v in sorted(labels.items()):
-            lines.append(f'"{k}" = "{v}"')
+        kube["node-labels"] = {k: v for k, v in sorted(labels.items())}
     if taints:
-        lines.append("[settings.kubernetes.node-taints]")
+        # aggregate by key: multiple taints may share a key with different
+        # effects (legal in k8s); a dict comprehension would drop all but one
+        node_taints: Dict[str, List[str]] = {}
         for t in taints:
-            lines.append(f'"{t.key}" = ["{t.value}:{t.effect}"]')
-    return "\n".join(lines) + "\n"
+            node_taints.setdefault(t.key, []).append(f"{t.value}:{t.effect}")
+        kube["node-taints"] = node_taints
+    generated = {"settings": {"kubernetes": kube}}
+    return _toml_dump(_deep_merge(user_tree, generated))
 
 
 def render_powershell(

@@ -18,6 +18,7 @@ from typing import Dict, List, Optional, Sequence
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.nodeclass import TPUNodeClass
 from karpenter_tpu.cloud.api import ClusterAPI, ComputeAPI
+from karpenter_tpu.errors import CloudError
 from karpenter_tpu.cloud.types import LaunchTemplateInfo
 from karpenter_tpu.providers.image.provider import ImageProvider, ResolvedImage
 from karpenter_tpu.providers.launchtemplate import bootstrap
@@ -153,16 +154,26 @@ class LaunchTemplateProvider:
         if existing:
             self._known[name] = existing[0]
             return
-        user_data = bootstrap.render(
-            nodeclass.image_family,
-            cluster_name=self.cluster_name,
-            endpoint=self.cluster_api.cluster_endpoint(),
-            ca_bundle=self.cluster_api.cluster_ca_bundle(),
-            nodeclass=nodeclass,
-            labels=labels,
-            taints=list(taints),
-            max_pods=group.max_pods,
-        )
+        try:
+            user_data = bootstrap.render(
+                nodeclass.image_family,
+                cluster_name=self.cluster_name,
+                endpoint=self.cluster_api.cluster_endpoint(),
+                ca_bundle=self.cluster_api.cluster_ca_bundle(),
+                nodeclass=nodeclass,
+                labels=labels,
+                taints=list(taints),
+                max_pods=group.max_pods,
+            )
+        except ValueError as e:
+            # invalid user_data on ONE nodeclass must fail that launch, not
+            # crash the whole provisioning tick (the provisioner catches
+            # CloudError per launch; the reference surfaces the same class
+            # of failure through nodeclass status validation)
+            raise CloudError(
+                f"nodeclass {nodeclass.name}: bootstrap rendering failed: {e}",
+                code="InvalidUserData",
+            ) from e
         lt = LaunchTemplateInfo(
             id="",
             name=name,

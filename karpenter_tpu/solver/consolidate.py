@@ -263,7 +263,12 @@ class ConsolidationEvaluator:
             catalog = self._catalog_tensors(items)
             cs = encode.encode_classes(
                 _with_pool_requirements(classes, pool), catalog,
-                pool_taints=list(pool.template.taints) + list(pool.template.startup_taints),
+                # template.taints ONLY: startup taints lift before pods land
+                # (provisioner.py:68), and the oracle's _open_group gates on
+                # exactly this set -- including startup taints here would
+                # wrongly report inf replacement price for pods that do not
+                # tolerate them (ADVICE round 1, medium)
+                pool_taints=list(pool.template.taints),
                 c_pad=C,
             )
             compat = encode.compat_matrix(catalog, cs)

@@ -375,7 +375,12 @@ class Scheduler:
                 nodepool=pool,
                 requirements=narrowed,
                 instance_types=candidates,
-                taints=taints + list(pool.template.startup_taints),
+                # scheduling-relevant taints only: startup taints lift
+                # before pods land, so they must not block later pods from
+                # JOINING this group either (_try_group gates on these; the
+                # provisioner re-derives startup taints from the pool when
+                # building the NodeClaim)
+                taints=taints,
                 pods=[pod],
                 requested=requested,
             )

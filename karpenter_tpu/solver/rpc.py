@@ -291,7 +291,16 @@ def serve_main(argv=None) -> int:
     import argparse
 
     parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
-    parser.add_argument("--host", default="0.0.0.0")
+    # TRUST BOUNDARY: the sidecar speaks an unauthenticated length-prefixed
+    # protocol and will stage multi-MB catalogs / run solves for any peer
+    # that can connect. Default to loopback; binding a routable address is
+    # an explicit operator decision (front it with mTLS or network policy,
+    # the way the reference trusts only the in-cluster apiserver bus).
+    parser.add_argument(
+        "--host",
+        default="127.0.0.1",
+        help="bind address (default loopback; see trust-boundary note)",
+    )
     parser.add_argument("--port", type=int, default=7077)
     args = parser.parse_args(argv)
     server = SolverServer(args.host, args.port).start()

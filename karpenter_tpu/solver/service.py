@@ -145,6 +145,20 @@ class TPUSolver:
                 "(hostname or multiple hard constraints); call schedule() so "
                 "routing can fall back to the oracle"
             )
+        if existing_nodes and any(
+            spread_mod.hard_zone_tsc(p) for p in pods
+        ):
+            # the carry pass models fresh-cluster counts only: pods already
+            # bound to existing nodes seed per-zone counts it cannot see, and
+            # _pack_existing checks pod-level requirements only (a zone-
+            # pinned sub-class could land on a wrong-zone node). schedule()'s
+            # routing sends this combination to the oracle; direct solve()
+            # calls must not bypass that invariant (ADVICE round 1).
+            raise ValueError(
+                "TPUSolver.solve: hard zone-spread pods cannot be combined "
+                "with existing_nodes; call schedule() so routing can fall "
+                "back to the oracle"
+            )
         pool_reqs = pool.requirements()
         classes = encode.group_pods(pods, extra_requirements=pool_reqs)
         result = SchedulingResult()
@@ -330,7 +344,7 @@ class TPUSolver:
                     nodepool=pool,
                     requirements=reqs,
                     instance_types=sorted(group_types, key=lambda it: price_of[it.name]),
-                    taints=list(pool.template.taints) + list(pool.template.startup_taints),
+                    taints=list(pool.template.taints),
                     pods=group_pods,
                     requested=requested,
                 )

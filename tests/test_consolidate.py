@@ -272,3 +272,55 @@ class TestControllerEquivalence:
         assert d_plain, "scenario should consolidate"
         assert [r for _, r in d_device] == [r for _, r in d_plain]
         assert len(d_device) == len(d_plain)
+
+
+class TestReplacementStartupTaints:
+    def test_startup_taints_do_not_block_replacement(self):
+        """Startup taints lift before pods land (provisioner), so the
+        device replacement search must gate on template.taints only --
+        matching oracle._open_group (ADVICE round 1, medium)."""
+        from karpenter_tpu.scheduling import Taint
+
+        clock = FakeClock(100_000.0)
+        op = Operator(clock=clock)
+        op.cluster.create(TPUNodeClass("default"))
+        pool = NodePool("default")
+        pool.template.startup_taints = [
+            Taint("node.cilium.io/agent-not-ready", value="true", effect="NoSchedule")
+        ]
+        op.cluster.create(pool)
+        op.nodeclass_controller.reconcile_all()
+        catalog = op.cloud_provider.get_instance_types(pool)
+        pods = mk_pods(3, 1000, 2048)  # tolerate nothing
+        ev = ConsolidationEvaluator()
+        v = ev.evaluate([], [(pods, [])], pools=[pool], catalogs={"default": catalog})[0]
+        assert np.isfinite(v.replace_price), (
+            "startup taints wrongly blocked the replacement verdict"
+        )
+        # oracle agreement: the same pods schedule onto a new group
+        sched = Scheduler(
+            nodepools=[pool], instance_types={"default": catalog},
+            zones={o.zone for it in catalog for o in it.available_offerings()},
+        )
+        result = sched.schedule(pods)
+        assert not result.unschedulable and len(result.new_groups) == 1
+        oracle_price = min(
+            it.cheapest_price() for it in result.new_groups[0].instance_types
+        )
+        assert v.replace_price == pytest.approx(oracle_price)
+
+    def test_hard_template_taints_still_block(self):
+        from karpenter_tpu.scheduling import Taint
+
+        clock = FakeClock(100_000.0)
+        op = Operator(clock=clock)
+        op.cluster.create(TPUNodeClass("default"))
+        pool = NodePool("default")
+        pool.template.taints = [Taint("dedicated", value="gpu", effect="NoSchedule")]
+        op.cluster.create(pool)
+        op.nodeclass_controller.reconcile_all()
+        catalog = op.cloud_provider.get_instance_types(pool)
+        pods = mk_pods(3, 1000, 2048)  # tolerate nothing
+        ev = ConsolidationEvaluator()
+        v = ev.evaluate([], [(pods, [])], pools=[pool], catalogs={"default": catalog})[0]
+        assert not np.isfinite(v.replace_price)

@@ -243,3 +243,95 @@ class TestBatcher:
         for f in futs:
             with pytest.raises(RuntimeError):
                 f.result()
+
+
+class TestPassStaleness:
+    """Verdicts computed before a disruption must not be trusted after it:
+    two candidates whose pods each fit the lone survivor ALONE, but not
+    together, may only yield ONE disruption per pass (ADVICE round 1)."""
+
+    @staticmethod
+    def _mk_bound_node(env, name, cpu_m, mem_mib, pod_specs, itype="t4g.medium"):
+        from karpenter_tpu.apis.nodeclaim import (
+            COND_INITIALIZED,
+            COND_LAUNCHED,
+            COND_REGISTERED,
+        )
+        from karpenter_tpu.scheduling import resources as res
+
+        claim = NodeClaim(name)
+        claim.metadata.labels[wk.NODEPOOL_LABEL] = "default"
+        claim.metadata.labels[wk.INSTANCE_TYPE_LABEL] = itype
+        claim.metadata.labels[wk.CAPACITY_TYPE_LABEL] = wk.CAPACITY_TYPE_ON_DEMAND
+        claim.metadata.labels[wk.ZONE_LABEL] = "us-central-1a"
+        claim.provider_id = f"tpu:///test/{name}"
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+            claim.status_conditions.set_true(cond)
+        env.cluster.create(claim)
+        claim.metadata.creation_timestamp = env.clock.now() - (MIN_NODE_LIFETIME + 600)
+        alloc = Resources.from_base_units(
+            {res.CPU: cpu_m, res.MEMORY: mem_mib * 2**20, res.PODS: 110}
+        )
+        node = Node(
+            name,
+            labels={
+                "kubernetes.io/hostname": name,
+                wk.ZONE_LABEL: "us-central-1a",
+                wk.NODEPOOL_LABEL: "default",
+            },
+            capacity=alloc,
+            allocatable=alloc,
+        )
+        node.provider_id = claim.provider_id
+        node.ready = True
+        env.cluster.create(node)
+        for pname, pcpu, annotations in pod_specs:
+            p = Pod(
+                pname,
+                requests=Resources.from_base_units({res.CPU: pcpu, res.MEMORY: 256 * 2**20}),
+                annotations=annotations,
+            )
+            env.cluster.create(p)
+            p.node_name = name
+            p.phase = "Running"
+        return claim
+
+    @pytest.mark.parametrize("use_evaluator", [False, True])
+    def test_second_candidate_rejudged_after_first_disruption(self, use_evaluator):
+        from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
+
+        clock = FakeClock(100_000.0)
+        op = Operator(
+            clock=clock,
+            consolidation_evaluator=ConsolidationEvaluator() if use_evaluator else None,
+        )
+        op.cluster.create(TPUNodeClass("default"))
+        pool = NodePool("default")
+        # a permissive budget: the 1-per-pass cap must come from re-judging
+        # stale verdicts, not from the default 10% budget masking the bug
+        pool.disruption.budgets = [Budget(nodes="100%")]
+        op.cluster.create(pool)
+        ctl = DisruptionController(
+            op.cluster,
+            op.cloud_provider,
+            op.pricing,
+            op.options.feature_gates,
+            evaluator=ConsolidationEvaluator() if use_evaluator else None,
+        )
+        # two 4-cpu candidates each holding a 3-cpu pod; survivor has
+        # 3.5 cpu free -- room for ONE candidate's pod, not both
+        self._mk_bound_node(op, "cand-a", 4000, 8192, [("pa", 3000, None)])
+        self._mk_bound_node(op, "cand-b", 4000, 8192, [("pb", 3000, None)])
+        self._mk_bound_node(
+            op,
+            "survivor",
+            4000,
+            8192,
+            [("ps", 500, {"karpenter.sh/do-not-disrupt": "true"})],
+        )
+        decisions = ctl.reconcile(max_disruptions=5)
+        names = sorted(n for n, _ in decisions)
+        assert len(decisions) == 1, (
+            f"stale verdicts double-booked the survivor: {decisions}"
+        )
+        assert names[0] in ("cand-a", "cand-b")

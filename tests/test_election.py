@@ -80,12 +80,35 @@ class TestBootstrapFamilies:
         assert "node-config:" in out and "max-pods: 58" in out and "extra: true" in out
 
     def test_immutable_toml(self):
+        import tomllib
+
         out = bootstrap.render("Immutable", **self._kw(user_data='[settings.host]\nfoo = "bar"'))
-        assert "[settings.kubernetes]" in out
-        assert 'cluster-name = "c1"' in out
-        assert '"dedicated" = ["ml:NoSchedule"]' in out
-        # user TOML first so generated settings win on conflict
-        assert out.index("[settings.host]") < out.index("[settings.kubernetes]")
+        tree = tomllib.loads(out)  # the merged document must parse
+        kube = tree["settings"]["kubernetes"]
+        assert kube["cluster-name"] == "c1"
+        assert kube["node-taints"]["dedicated"] == ["ml:NoSchedule"]
+        assert kube["node-labels"]["team"] == "ml"
+        # user settings outside the generated tree survive the merge
+        assert tree["settings"]["host"]["foo"] == "bar"
+
+    def test_immutable_toml_conflicting_user_keys_lose(self):
+        import tomllib
+
+        # a textual prepend would emit [settings.kubernetes] twice -- a TOML
+        # parse error; the structural merge must instead override the user's
+        # conflicting leaf while keeping their non-conflicting ones
+        user = '[settings.kubernetes]\ncluster-name = "evil"\ncustom = 1\n'
+        out = bootstrap.render("Immutable", **self._kw(user_data=user))
+        tree = tomllib.loads(out)
+        kube = tree["settings"]["kubernetes"]
+        assert kube["cluster-name"] == "c1"  # generated wins
+        assert kube["custom"] == 1           # user's extra key survives
+
+    def test_immutable_toml_invalid_user_data_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="not valid TOML"):
+            bootstrap.render("Immutable", **self._kw(user_data="[broken"))
 
     def test_windows_powershell(self):
         out = bootstrap.render("Windows", **self._kw(user_data="Write-Host preflight"))
@@ -95,3 +118,33 @@ class TestBootstrapFamilies:
     def test_custom_passthrough(self):
         out = bootstrap.render("Custom", **self._kw(user_data="raw bytes"))
         assert out == "raw bytes"
+
+    def test_immutable_toml_round_trips_arrays_of_tables(self):
+        import tomllib
+
+        user = (
+            '[settings]\nmotd = "line1\\nline2"\n'
+            '[[settings.host-containers]]\nname = "admin"\nenabled = true\n'
+            '[[settings.host-containers]]\nname = "control"\nenabled = false\n'
+            '[settings.host-containers.extra]\nnested = "yes"\n'
+        )
+        out = bootstrap.render("Immutable", **self._kw(user_data=user))
+        tree = tomllib.loads(out)  # serialized output must parse
+        hcs = tree["settings"]["host-containers"]
+        assert [h["name"] for h in hcs] == ["admin", "control"]
+        assert hcs[1]["extra"]["nested"] == "yes"
+        assert tree["settings"]["motd"] == "line1\nline2"
+
+    def test_immutable_toml_duplicate_taint_keys_aggregate(self):
+        import tomllib
+
+        from karpenter_tpu.scheduling import Taint
+
+        kw = self._kw()
+        kw["taints"] = [
+            Taint("dedicated", value="ml", effect="NoSchedule"),
+            Taint("dedicated", value="ml", effect="NoExecute"),
+        ]
+        out = bootstrap.render("Immutable", **kw)
+        taints = tomllib.loads(out)["settings"]["kubernetes"]["node-taints"]
+        assert sorted(taints["dedicated"]) == ["ml:NoExecute", "ml:NoSchedule"]
