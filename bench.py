@@ -5,16 +5,30 @@ the full instance-type catalog: real Pod objects in, NewNodeGroup decisions
 out. The measured path is exactly the Provisioner's
 (controllers/provisioner.py -> solver/service.TPUSolver.solve):
 
-    host   group_pods          pod objects -> equivalence classes (memoized
+    host   group_pods          pod objects -> equivalence classes (interned
                                per-pod signatures; the grouping cache)
     host   encode_classes      classes -> dense tensors
     device batched FFD         masks + packed-bitset compat + scan
     host   _decode             placements -> NewNodeGroups w/ offerings
 
+The HEADLINE metric is COLD-PODS (VERDICT round 2, weak #2): every measured
+iteration sees fresh Pod objects whose grouping signatures have never been
+computed, the shape of a steady-state tick where pending pods arrive from
+watch events. Pods of one workload template share one spec object, as
+ReplicaSet replicas do. Warm-iteration latency (the same pending set
+re-solved, e.g. an unsatisfiable remainder re-examined every tick) is
+reported as a secondary field.
+
 Target (BASELINE.md): < 100 ms p99 @ 50k pods x ~700 types.
 The reference has no published number for this path -- its in-process Go FFD
 is the implicit baseline and the 100 ms target is the contract; vs_baseline
 reports target/measured (>1 means beating the target).
+
+The packing objective is price-aware (BASELINE.json configs 3-4,
+solver/ffd.py objective == "price"): groups open on the min total-class-cost
+type inside a density envelope. A max-fit ("fit" objective) solve of the
+same workload is run once for the A/B fleet-price comparison
+(fleet_price_fit_mode in the JSON).
 
 Robustness contract (VERDICT round 1, item 1): this script NEVER exits
 non-zero and ALWAYS prints exactly one JSON line on stdout. The accelerator
@@ -41,9 +55,10 @@ import numpy as np
 
 N_PODS = 50_000
 N_SPEC_TEMPLATES = 160
-ITERS = 100
+ITERS = 60          # warm iterations
+COLD_ITERS = 25     # cold iterations (fresh Pod objects each; the headline)
 WARMUP = 5
-G_MAX = 512
+G_MAX = 1024        # price objective opens ~1.6x max-fit's group count
 TARGET_MS = 100.0
 
 _PROBE_CODE = (
@@ -168,11 +183,60 @@ def synth_pods(rng: np.random.Generator, zones, n_pods: int, salt: int):
     return pods
 
 
+def _stage_breakdown(solver, pool, items, pods):
+    """One staged decomposition of the solve path (numbers in ms). The
+    stages here are run serially with a device sync between solve and
+    fetch, so their sum slightly exceeds the pipelined production path."""
+    import jax
+
+    from karpenter_tpu.solver import encode, ffd
+
+    t = {}
+    t0 = time.perf_counter()
+    classes = encode.group_pods(pods, extra_requirements=pool.requirements())
+    t["group"] = time.perf_counter() - t0
+    catalog, staged, offsets, words, _ = solver._catalog(items)
+    t0 = time.perf_counter()
+    cs = encode.encode_classes(
+        classes, catalog, c_pad=encode.bucket(len(classes), solver.c_pad_min)
+    )
+    t["encode"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    inp = ffd.make_inputs_staged(staged, cs)
+    dec = ffd.ffd_solve_compact(
+        inp, g_max=solver.g_max, nnz_max=cs.c_pad + 4 * solver.g_max,
+        word_offsets=offsets, words=words, use_pallas=solver.use_pallas,
+        objective=solver.objective,
+    )
+    jax.block_until_ready(dec)
+    t["device_solve"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    dec = ffd.CompactDecision(*jax.device_get(tuple(dec)))
+    t["fetch"] = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    dense = ffd.expand_compact(
+        dec, cs.c_pad, solver.g_max, catalog.k_pad, encode.Z_PAD, encode.CT
+    )
+    if dense is None:
+        # sparse-budget overflow: mirror the production dense refetch
+        out = ffd.ffd_solve(
+            inp, g_max=solver.g_max, word_offsets=offsets, words=words,
+            use_pallas=solver.use_pallas, objective=solver.objective,
+        )
+        out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
+        dense = (
+            np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
+            np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
+        )
+    solver._decode(pool, items, catalog, cs, dense, None)
+    t["decode"] = time.perf_counter() - t0
+    return {k: round(v * 1e3, 2) for k, v in t.items()}, len(classes)
+
+
 def run(profile: bool, use_pallas: bool):
     import jax
 
     from karpenter_tpu.apis import NodePool
-    from karpenter_tpu.solver import encode
     from karpenter_tpu.solver.service import TPUSolver
 
     backend = jax.default_backend()
@@ -230,36 +294,52 @@ def run(profile: bool, use_pallas: bool):
             stable = 0
         best = min(best, dt)
 
-    times = []
+    # warm pass: the 8 fixed workloads cycle, so grouping caches are hot
+    warm = []
     for i in range(ITERS):
         pods = workloads[i % len(workloads)]
         t0 = time.perf_counter()
         solve(pods)
-        times.append((time.perf_counter() - t0) * 1000.0)
-    times = np.array(times)
-    p50, p99 = float(np.percentile(times, 50)), float(np.percentile(times, 99))
+        warm.append((time.perf_counter() - t0) * 1000.0)
+    warm = np.array(warm)
 
-    # total fleet price of the decision (secondary objective; the packing
-    # objective is price-aware -- see solver/ffd.py)
-    # instance_types arrive sorted by cheapest price (service._decode)
+    # cold pass (the HEADLINE): fresh Pod objects per iteration -- no pod
+    # signature has ever been seen. Workload generation stays outside the
+    # timer (pods arrive from watch events; creating them is not part of
+    # the scheduling decision).
+    cold = []
+    for i in range(COLD_ITERS):
+        pods = synth_pods(rng, zones, N_PODS, salt=10_000 + i)
+        t0 = time.perf_counter()
+        solve(pods)
+        cold.append((time.perf_counter() - t0) * 1000.0)
+    cold = np.array(cold)
+
+    p50, p99 = float(np.percentile(cold, 50)), float(np.percentile(cold, 99))
+    warm_p50, warm_p99 = float(np.percentile(warm, 50)), float(np.percentile(warm, 99))
+
+    # fleet price of the decision under the price objective, and the same
+    # workload solved with the legacy max-fit objective for the A/B
+    # (VERDICT round 2, item 3: price drop at equal placement count)
+    result = solve(workloads[0])
     fleet_price = sum(g.instance_types[0].cheapest_price() for g in result.new_groups)
+    fit_solver = TPUSolver(g_max=G_MAX, use_pallas=use_pallas, objective="fit")
+    fit_result = fit_solver.solve(pool, items, workloads[0])
+    fit_placed = sum(len(g.pods) for g in fit_result.new_groups)
+    fit_price = sum(g.instance_types[0].cheapest_price() for g in fit_result.new_groups)
+
+    stages, n_classes = _stage_breakdown(solver, pool, items, workloads[0])
 
     if profile:
-        pods = workloads[0]
-        t0 = time.perf_counter()
-        classes = encode.group_pods(pods, extra_requirements=pool.requirements())
-        t_group = (time.perf_counter() - t0) * 1e3
-        catalog = solver.catalog_tensors(items)
-        t0 = time.perf_counter()
-        encode.encode_classes(classes, catalog, c_pad=encode.bucket(len(classes), 16))
-        t_encode = (time.perf_counter() - t0) * 1e3
         print(
             f"# backend {backend}; catalog build {t_catalog * 1e3:.0f}ms; "
             f"pod synth {t_pods:.1f}s; first solve (compile) {t_compile:.1f}s; "
-            f"p50 {p50:.1f}ms p99 {p99:.1f}ms min {times.min():.1f}ms max {times.max():.1f}ms; "
-            f"host group {t_group:.1f}ms encode {t_encode:.1f}ms ({len(classes)} classes); "
+            f"cold p50 {p50:.1f}ms p99 {p99:.1f}ms min {cold.min():.1f}ms max {cold.max():.1f}ms; "
+            f"warm p50 {warm_p50:.1f}ms p99 {warm_p99:.1f}ms; "
+            f"stages (warm, serial) {stages} ({n_classes} classes); "
             f"groups opened {n_groups}; pods placed {placed}/{N_PODS}; "
-            f"fleet price ${fleet_price:.2f}/h",
+            f"fleet price ${fleet_price:.2f}/h (max-fit objective: ${fit_price:.2f}/h, "
+            f"{fit_placed} placed)",
             file=sys.stderr,
         )
 
@@ -270,10 +350,16 @@ def run(profile: bool, use_pallas: bool):
         "unit": "ms",
         "vs_baseline": round(TARGET_MS / p99, 3) if p99 > 0 else 0.0,
         "p50_ms": round(p50, 2),
+        "mode": "cold_pods",
+        "warm_p50_ms": round(warm_p50, 2),
+        "warm_p99_ms": round(warm_p99, 2),
+        "stages_ms": stages,
         "platform": backend,
         "groups_opened": n_groups,
         "pods_placed": placed,
         "fleet_price_per_hour": round(fleet_price, 2),
+        "fleet_price_fit_mode": round(fit_price, 2),
+        "objective": solver.objective,
     }
 
 

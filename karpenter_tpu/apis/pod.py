@@ -75,9 +75,11 @@ class Pod(APIObject):
         # status / spec binding
         self.node_name: str = ""
         self.phase: str = "Pending"
-        # memoized grouping signature (solver/encode.group_pods); pod specs
-        # are immutable post-creation in k8s, so computing once is sound
+        # memoized grouping signature + interned signature id
+        # (solver/encode.group_pods); pod specs are immutable post-creation
+        # in k8s, so computing once is sound
         self._group_sig: Optional[tuple] = None
+        self._sig_id: Optional[tuple] = None  # (intern generation, small int)
 
     def grouping_signature(self) -> tuple:
         """A cheap structural signature over every spec field that affects
@@ -86,21 +88,30 @@ class Pod(APIObject):
         construction + stable hash) is computed once per distinct signature,
         not per pod -- this is the hot-path grouping cache the 50k-pod
         scheduling budget depends on (reference hot loop #1:
-        designs/bin-packing.md:17-43 pre-groups pods the same way)."""
+        designs/bin-packing.md:17-43 pre-groups pods the same way).
+
+        Construction is cold-path tuned: the common empty spec fields short-
+        circuit to shared empty tuples, and the requests signature is
+        memoized on the (template-shared) Resources object itself."""
         sig = self._group_sig
         if sig is None:
+            ns = self.node_selector
+            tol = self.tolerations
+            tsc = self.topology_spread
+            aff = self.affinity_terms
+            nat = self.node_affinity_terms
             labels = self.metadata.labels
             sig = self._group_sig = (
-                tuple(sorted(self.requests.items())),
-                tuple(sorted(self.node_selector.items())) if self.node_selector else (),
+                self.requests.sig(),
+                tuple(sorted(ns.items())) if ns else (),
                 tuple(
                     tuple(
                         (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than, r.min_values)
                         for r in term
                     )
-                    for term in self.node_affinity_terms
-                ),
-                tuple((t.key, t.operator, t.value, t.effect) for t in self.tolerations),
+                    for term in nat
+                ) if nat else (),
+                tuple((t.key, t.operator, t.value, t.effect) for t in tol) if tol else (),
                 tuple(
                     (
                         t.topology_key,
@@ -109,12 +120,12 @@ class Pod(APIObject):
                         tuple(sorted(t.label_selector.items())),
                         all(labels.get(k) == v for k, v in t.label_selector.items()),
                     )
-                    for t in self.topology_spread
-                ),
+                    for t in tsc
+                ) if tsc else (),
                 tuple(
                     (tuple(sorted(t.label_selector.items())), t.topology_key, t.anti)
-                    for t in self.affinity_terms
-                ),
+                    for t in aff
+                ) if aff else (),
             )
         return sig
 

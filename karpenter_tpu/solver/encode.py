@@ -200,6 +200,9 @@ class PodClass:
     requests: np.ndarray             # [R] scaled, includes pods=1
     requirements: Requirements
     key: tuple
+    # price-envelope pod count for fresh-group sizing (solver/ffd.py price
+    # objective): -1 = use the in-scan leftover; spread sub-classes pin 1
+    env_count: int = -1
 
 
 @dataclass
@@ -209,6 +212,7 @@ class PodClassSet:
     c_pad: int
     req: np.ndarray                  # [C, R] float32
     count: np.ndarray                # [C] int32
+    env_count: np.ndarray            # [C] int32 (-1 = in-scan leftover)
     allowed: List[np.ndarray]        # per dim: [C, W_d] uint32 bitmasks
     num_lo: np.ndarray               # [C, ND] float32 exclusive lower bounds (-inf none)
     num_hi: np.ndarray               # [C, ND] float32 exclusive upper bounds (+inf none)
@@ -268,25 +272,51 @@ def _one_pod():
     return Resources.from_base_units({res.PODS: 1})
 
 
+# global signature intern table: structural signature -> small int. Interned
+# ids let the per-call grouping loop hash a machine int instead of re-hashing
+# a deep nested tuple for every one of 50k pods. Bounded by generation: if
+# the table ever grows past the cap (a pathological churn of distinct pod
+# shapes) it is cleared and the generation bumped, which invalidates every
+# pod's memoized (gen, id) pair -- they simply re-intern.
+_SIG_INTERN: Dict[tuple, int] = {}
+_SIG_GEN: int = 0
+_SIG_INTERN_MAX = 1 << 18
+
+
+def _intern_sig(sig: tuple) -> tuple:
+    global _SIG_GEN
+    sid = _SIG_INTERN.get(sig)
+    if sid is None:
+        if len(_SIG_INTERN) >= _SIG_INTERN_MAX:
+            _SIG_INTERN.clear()
+            _SIG_GEN += 1
+        sid = _SIG_INTERN[sig] = len(_SIG_INTERN)
+    return (_SIG_GEN, sid)
+
+
 def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] = None) -> List[PodClass]:
     """Collapse pods into equivalence classes. Pods with multiple affinity
     alternatives use their first term (the oracle handles full OR semantics;
     multi-term pods are rare and can be routed to the oracle).
 
-    Two-level grouping keeps the 50k-pod hot path inside the latency budget:
-    pods key by their memoized cheap structural signature
-    (Pod.grouping_signature -- raw spec tuples, no numpy / hashing), and
-    ONE canonical key (Requirements construction + stable hash + scaled
+    Three-level grouping keeps the 50k-pod hot path inside the latency
+    budget: pods carry an interned small-int signature id (memoized across
+    calls -- warm ticks hash machine ints, not tuples), distinct ids key by
+    the structural signature (Pod.grouping_signature -- raw spec tuples),
+    and ONE canonical key (Requirements construction + stable hash + scaled
     request vector) is computed per distinct signature. Signatures whose
     canonical keys coincide (e.g. the same constraint written as
     nodeSelector vs nodeAffinity) share a class. The single ordered pass
     preserves input order within each class -- required for exact
     differential equivalence with the oracle's stable per-pod sort."""
-    sig_to_class: Dict[tuple, PodClass] = {}
+    id_to_class: Dict[tuple, PodClass] = {}
     groups: Dict[tuple, PodClass] = {}
+    id_get = id_to_class.get
     for pod in pods:
-        sig = pod.grouping_signature()
-        pc = sig_to_class.get(sig)
+        sid = pod._sig_id
+        if sid is None or sid[0] != _SIG_GEN:
+            sid = pod._sig_id = _intern_sig(pod.grouping_signature())
+        pc = id_get(sid)
         if pc is None:
             reqs = pod.scheduling_requirements()[0]
             if extra_requirements is not None:
@@ -296,7 +326,7 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
             if pc is None:
                 requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
                 pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
-            sig_to_class[sig] = pc
+            id_to_class[sid] = pc
         pc.pods.append(pod)
     # FFD order: dominant resource descending with the canonical tie-break
     # (pod_sort_key) -- must match the oracle's sort for differential
@@ -349,6 +379,7 @@ def encode_classes(
         c_pad = max(8, ((c_real + 7) // 8) * 8)
     req = np.zeros((c_pad, R), dtype=np.float32)
     count = np.zeros((c_pad,), dtype=np.int32)
+    env_count = np.zeros((c_pad,), dtype=np.int32)
     allowed = [np.zeros((c_pad, w), dtype=np.uint32) for w in catalog.words]
     num_lo = np.full((c_pad, ND), -np.inf, dtype=np.float32)
     num_hi = np.full((c_pad, ND), np.inf, dtype=np.float32)
@@ -358,6 +389,7 @@ def encode_classes(
     for c, pc in enumerate(classes):
         req[c] = pc.requests
         count[c] = len(pc.pods)
+        env_count[c] = pc.env_count
         reqs = pc.requirements
         for d, dim in enumerate(LABEL_DIMS):
             allowed[d][c] = _allowed_bits_for(reqs, catalog.vocabs[d], dim, catalog.words[d])
@@ -379,8 +411,8 @@ def encode_classes(
         schedulable[c] = tolerates_all(pc.pods[0].tolerations, pool_taints)
     return PodClassSet(
         classes=list(classes), c_real=c_real, c_pad=c_pad, req=req, count=count,
-        allowed=allowed, num_lo=num_lo, num_hi=num_hi, azone=azone, acap=acap,
-        schedulable=schedulable,
+        env_count=env_count, allowed=allowed, num_lo=num_lo, num_hi=num_hi,
+        azone=azone, acap=acap, schedulable=schedulable,
     )
 
 

@@ -58,9 +58,11 @@ class SolveInputs(NamedTuple):
     tnum_present: jax.Array  # [K, ND] bool
     tzone: jax.Array        # [K, Z] bool
     tcap: jax.Array         # [K, CT] bool
+    price: jax.Array        # [K, Z, CT] f32 (+inf when unavailable)
     # classes
     req: jax.Array          # [C, R] f32
     count: jax.Array        # [C] i32
+    env_count: jax.Array    # [C] i32 price-envelope pod count; -1 = in-scan leftover
     allowed: jax.Array      # [C, TW] u32 (all dims concatenated)
     num_lo: jax.Array       # [C, ND] f32
     num_hi: jax.Array       # [C, ND] f32
@@ -105,29 +107,76 @@ def _device_compat(inp: SolveInputs, word_offsets: Tuple[int, ...], words: Tuple
 
 def _fit_counts(cap: jax.Array, accum: jax.Array, req: jax.Array) -> jax.Array:
     """[G, K] how many pods of `req` fit in (cap[k] - accum[g]).
-    req axes that are zero are unconstrained. Exact in f32 (small ints)."""
-    headroom = cap[None, :, :] - accum[:, None, :]                # [G, K, R]
-    per_axis = jnp.where(
-        req[None, None, :] > 0,
-        jnp.floor(headroom / jnp.where(req > 0, req, 1.0)[None, None, :]),
-        _INF,
-    )
-    n = jnp.min(per_axis, axis=-1)                                # [G, K]
+    req axes that are zero are unconstrained. Exact in f32 (small ints).
+
+    Unrolled over the small static R axis: a [G, K, R] temporary would put
+    R (7) in the TPU lane dimension, which the compiler pads to 128 --
+    ~18x the logical HBM traffic and the dominant cost of the whole solve.
+    R separate [G, K] passes keep K in the lanes and fuse into one kernel."""
+    n = None
+    for r in range(cap.shape[1]):
+        d = jnp.where(req[r] > 0.0, req[r], 1.0)
+        axis_n = jnp.where(
+            req[r] > 0.0, jnp.floor((cap[None, :, r] - accum[:, r, None]) / d), _INF
+        )                                                          # [G, K]
+        n = axis_n if n is None else jnp.minimum(n, axis_n)
     return jnp.maximum(n, 0.0)
 
 
-def ffd_solve_impl(inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...]) -> SolveOutputs:
+def _fresh_fit_counts(cap: jax.Array, req: jax.Array) -> jax.Array:
+    """[C, K] how many pods of class c fit an EMPTY node of type k.
+    Same R-unrolled formulation as _fit_counts (lane-dim discipline)."""
+    n = None
+    for r in range(cap.shape[1]):
+        req_r = req[:, r]                                          # [C]
+        d = jnp.where(req_r > 0.0, req_r, 1.0)
+        axis_n = jnp.where(
+            req_r[:, None] > 0.0, jnp.floor(cap[None, :, r] / d[:, None]), _INF
+        )                                                          # [C, K]
+        n = axis_n if n is None else jnp.minimum(n, axis_n)
+    return jnp.maximum(n, 0.0)
+
+
+def _class_type_price(inp: SolveInputs) -> Tuple[jax.Array, jax.Array]:
+    """([C, K] cheapest offering price of type k over the (zone, captype)
+    cells class c admits (+inf when none), [C, K] bool: an admitted RESERVED
+    offering exists). Z*CT static iterations of [C, K] work -- never
+    materializes the [C, K, Z, CT] join."""
+    from karpenter_tpu.solver.encode import CAPTYPE_INDEX
+    from karpenter_tpu.apis import labels as wk
+
+    Z = inp.tzone.shape[1]
+    CTn = inp.tcap.shape[1]
+    reserved_ct = CAPTYPE_INDEX[wk.CAPACITY_TYPE_RESERVED]
+    best = None
+    has_res = None
+    for z in range(Z):
+        for ct in range(CTn):
+            m = inp.azone[:, z] & inp.acap[:, ct]                  # [C]
+            cell = inp.price[None, :, z, ct]
+            cand = jnp.where(m[:, None], cell, _INF)
+            best = cand if best is None else jnp.minimum(best, cand)
+            if ct == reserved_ct:
+                r = m[:, None] & jnp.isfinite(cell)
+                has_res = r if has_res is None else (has_res | r)
+    return best, has_res
+
+
+def ffd_solve_impl(
+    inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
+    objective: str = "price",
+) -> SolveOutputs:
     """Unjitted body (jit via `ffd_solve`; exposed for graft-entry
     compile checks and sharded wrappers)."""
-    return _ffd_body(inp, g_max, word_offsets, words)
+    return _ffd_body(inp, g_max, word_offsets, words, objective=objective)
 
 
-@functools.partial(jax.jit, static_argnames=("g_max", "word_offsets", "words", "use_pallas"))
+@functools.partial(jax.jit, static_argnames=("g_max", "word_offsets", "words", "use_pallas", "objective"))
 def ffd_solve(
     inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
-    use_pallas: bool = False,
+    use_pallas: bool = False, objective: str = "price",
 ) -> SolveOutputs:
-    return _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas)
+    return _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas, objective=objective)
 
 
 _CT_SHIFT = 8  # captype bits live above the zone bits in the packed u32
@@ -170,7 +219,7 @@ def _joint_ok(x: jax.Array) -> jax.Array:
 
 def _ffd_body(
     inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
-    use_pallas: bool = False,
+    use_pallas: bool = False, objective: str = "price",
 ) -> SolveOutputs:
     C, Rr = inp.req.shape
     K = inp.cap.shape[0]
@@ -187,31 +236,35 @@ def _ffd_body(
     azc = _pack_zc(inp.azone, inp.acap)                           # [C] u32
 
     # fresh-group fit per (class, type): independent of the carry, so it is
-    # hoisted out of the scan entirely (one [C, K, R] pass instead of C
-    # [K, R] passes inside the sequential loop)
-    req_safe = jnp.where(inp.req > 0, inp.req, 1.0)               # [C, R]
-    n_fresh_all = jnp.maximum(
-        jnp.min(
-            jnp.where(
-                inp.req[:, None, :] > 0,
-                jnp.floor(inp.cap[None, :, :] / req_safe[:, None, :]),
-                _INF,
-            ),
-            axis=-1,
-        ),
-        0.0,
-    )                                                             # [C, K]
+    # hoisted out of the scan entirely (one batched [C, K] pass instead of C
+    # [K]-sized passes inside the sequential loop)
+    n_fresh_all = _fresh_fit_counts(inp.cap, inp.req)             # [C, K]
     fresh_join = _joint_ok(azc[:, None] & tzc[None, :])           # [C, K]
     fresh_mask_all = compat & fresh_join                          # [C, K]
-    per_new_all = jnp.max(
-        jnp.where(fresh_mask_all, n_fresh_all, 0.0), axis=-1
-    ).astype(jnp.int32)                                           # [C]
+    if objective == "price":
+        # price-aware opening (BASELINE.json configs 3-4): fresh groups are
+        # sized to the type minimizing the TOTAL cost of hosting the class's
+        # remaining pods, price[k] * ceil(remaining / fit[k]) -- for one pod
+        # this is "cheapest type that fits", for a large class it approaches
+        # min price-per-pod. The group's surviving set keeps only
+        # equally-cheap types that can hold the allocation, so the decoded
+        # price never exceeds the optimum chosen here. The envelope count is
+        # the in-scan leftover (or the pinned env_count for spread
+        # sub-classes). The oracle (solver/oracle.py _price_open_filter)
+        # applies the same float32 rule, keeping the paths differentially
+        # equal; the argmin/kstar selection happens per scan step below.
+        price_ck, has_res_ck = _class_type_price(inp)             # [C, K] x2
+    else:
+        price_ck = jnp.zeros_like(n_fresh_all)
+        has_res_ck = jnp.zeros(n_fresh_all.shape, dtype=bool)
 
     slot = jnp.arange(g_max, dtype=jnp.int32)
 
+    inf32 = jnp.float32(jnp.inf)
+
     def step(carry, xs):
         accum, gmask, gzc, n_open = carry
-        req_c, count_c, compat_c, azc_c, fresh_mask, n_fresh_row, per_new = xs
+        req_c, count_c, env_c, compat_c, azc_c, fresh_row, n_fresh_row, price_row, has_res_row = xs
 
         # -- joint feasibility of class c on each open group ---------------
         gzc_new = gzc & azc_c                                     # [G] u32
@@ -233,6 +286,55 @@ def _ffd_body(
         take = jnp.clip(count_c - cum_before, 0, n_grp)           # [G] i32
         placed = jnp.sum(take)
         leftover = count_c - placed
+
+        # -- fresh-group envelope: the price objective sizes groups by the
+        #    class's remaining pod count, so it lives inside the step.
+        #    env_c semantics: -1 = price envelope over the in-scan leftover;
+        #    0 = max-fit for this class (spread sub-classes: availability
+        #    beats cost and the remaining count is not statically knowable);
+        #    >0 = price envelope over a pinned count --------------------------
+        max_fit_f = jnp.max(jnp.where(fresh_row, n_fresh_row, 0.0))
+        per_new_fit = max_fit_f.astype(jnp.int32)
+        if objective == "price":
+            env = jnp.where(env_c > 0, env_c, jnp.maximum(leftover, 1))
+            ngroups = jnp.ceil(
+                env.astype(jnp.float32) / jnp.maximum(n_fresh_row, 1.0)
+            )                                                     # [K]
+            # density envelope: only types packing at least half the
+            # DEMANDED density -- min(best packer, remaining pods) -- compete
+            # on price. The unconstrained cost optimum fragments the fleet
+            # into thousands of tiny nodes (burstable types win pure $/cpu),
+            # exploding node count and solve latency for a few percent of
+            # cost; capping the reference density at the remaining count
+            # keeps small classes free to pick small cheap nodes.
+            # Reserved-capable types bypass the gate: prepaid capacity
+            # (priced ~0) beats any density argument (reference prefers
+            # reserved first, pkg/providers/instance/instance.go
+            # getCapacityType).
+            envf = env.astype(jnp.float32)
+            need = jnp.minimum(max_fit_f, envf)
+            eligible = (
+                fresh_row
+                & (n_fresh_row >= 1.0)
+                & ((2.0 * jnp.minimum(n_fresh_row, envf) >= need) | has_res_row)
+            )
+            total_cost = jnp.where(eligible, price_row * ngroups, inf32)
+            kstar = jnp.argmin(total_cost)
+            ok = jnp.isfinite(total_cost[kstar])
+            per_new_price = jnp.where(ok, n_fresh_row[kstar], 0.0).astype(jnp.int32)
+            p_star = price_row[kstar]
+            price_mask = (
+                fresh_row
+                & (n_fresh_row >= per_new_price.astype(n_fresh_row.dtype))
+                & (price_row <= p_star)
+                & ok
+            )
+            use_fit = env_c == 0
+            per_new = jnp.where(use_fit, per_new_fit, per_new_price)
+            open_mask = jnp.where(use_fit, fresh_row, price_mask)
+        else:
+            per_new = per_new_fit
+            open_mask = fresh_row
 
         # -- open fresh identical groups for the remainder -----------------
         can_open = (leftover > 0) & (per_new > 0)
@@ -262,7 +364,7 @@ def _ffd_body(
         )
         gmask2 = jnp.where(
             is_new[:, None],
-            fresh_mask[None, :] & (takef[:, None] <= n_fresh_row[None, :]),
+            open_mask[None, :] & (takef[:, None] <= n_fresh_row[None, :]),
             gmask2,
         )
         gzc2 = jnp.where(touched_existing, gzc_new, gzc)
@@ -277,7 +379,7 @@ def _ffd_body(
         jnp.zeros((g_max,), jnp.uint32),
         jnp.int32(0),
     )
-    xs = (inp.req, inp.count, compat, azc, fresh_mask_all, n_fresh_all, per_new_all)
+    xs = (inp.req, inp.count, inp.env_count, compat, azc, fresh_mask_all, n_fresh_all, price_ck, has_res_ck)
     (accum, gmask, gzc, n_open), (take, unplaced) = jax.lax.scan(step, init, xs)
     gzone, gcap = _unpack_zc(gzc, Z, CTn)
     return SolveOutputs(
@@ -354,6 +456,82 @@ def ffd_solve_packed(
     )
 
 
+class CompactDecision(NamedTuple):
+    """The full solve result compacted for one small device->host fetch.
+
+    The tunnel to the accelerator is bandwidth-poor (~85 ms measured for the
+    dense SolveOutputs' ~1.5 MB); this fits the same decision in ~50 KB:
+    - take is sparse (flat row-major [C, G] indices + counts; idx -1 pads);
+      `nnz` is the true count -- when it exceeds idx.shape[0] the caller
+      must fall back to the dense fetch (FFD placements are near-diagonal,
+      nnz ~ C + n_open, so the static budget of C + G never trips in
+      practice)
+    - the per-group surviving-type mask is bit-packed 32 types per u32 lane
+    - zones + captypes stay in the packed gzc u32 (see _pack_zc)
+    """
+
+    idx: jax.Array          # [NNZ] i32 flat indices into take.ravel()
+    val: jax.Array          # [NNZ] i32 pod counts
+    nnz: jax.Array          # scalar i32 true nonzero count
+    unplaced: jax.Array     # [C] i32
+    n_open: jax.Array       # scalar i32
+    gmask_bits: jax.Array   # [G, K/32] u32
+    gzc: jax.Array          # [G] u32
+
+
+@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "use_pallas", "objective"))
+def ffd_solve_compact(
+    inp: SolveInputs,
+    *,
+    g_max: int,
+    nnz_max: int,
+    word_offsets: Tuple[int, ...],
+    words: Tuple[int, ...],
+    use_pallas: bool = False,
+    objective: str = "price",
+) -> CompactDecision:
+    out = _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas, objective=objective)
+    flat = out.take.ravel()
+    nnz_true = jnp.sum(flat != 0).astype(jnp.int32)
+    (idx,) = jnp.nonzero(flat, size=nnz_max, fill_value=0)
+    valid = jnp.arange(nnz_max) < nnz_true
+    val = jnp.where(valid, flat[idx], 0).astype(jnp.int32)
+    idx = jnp.where(valid, idx, -1).astype(jnp.int32)
+    K = out.gmask.shape[1]
+    kw = K // 32
+    gmask_bits = jnp.sum(
+        out.gmask.reshape(g_max, kw, 32).astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32)[None, None, :],
+        axis=-1,
+    )
+    gzc = _pack_zc(out.gzone, out.gcap)
+    return CompactDecision(
+        idx=idx, val=val, nnz=nnz_true, unplaced=out.unplaced,
+        n_open=out.n_open, gmask_bits=gmask_bits, gzc=gzc,
+    )
+
+
+def expand_compact(dec, C: int, G: int, K: int, Z: int, CTn: int):
+    """Host-side (numpy) expansion of a fetched CompactDecision into the
+    dense (take, unplaced, n_open, gmask, gzone, gcap) decode inputs.
+    Returns None when nnz overflowed the static budget (dense refetch)."""
+    idx = np.asarray(dec.idx)
+    if int(dec.nnz) > idx.shape[0]:
+        return None
+    take = np.zeros((C * G,), dtype=np.int32)
+    valid = idx >= 0
+    take[idx[valid]] = np.asarray(dec.val)[valid]
+    take = take.reshape(C, G)
+    bits = np.asarray(dec.gmask_bits)                             # [G, K/32]
+    gmask = (
+        (bits[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(bool).reshape(G, K)
+    gzc = np.asarray(dec.gzc)
+    gzone = ((gzc[:, None] >> np.arange(Z, dtype=np.uint32)) & 1) != 0
+    gcap = ((gzc[:, None] >> np.arange(_CT_SHIFT, _CT_SHIFT + CTn, dtype=np.uint32)) & 1) != 0
+    return take, np.asarray(dec.unplaced), int(dec.n_open), gmask, gzone, gcap
+
+
 class StagedCatalog(NamedTuple):
     """Catalog tensors resident on device (uploaded once per catalog
     seqnum), plus the static bitset geometry. Per-solve traffic is then
@@ -391,7 +569,9 @@ def make_inputs_staged(staged: StagedCatalog, classes: PodClassSet) -> SolveInpu
     return SolveInputs(
         cap=staged.cap, tcode=staged.tcode, tnum=staged.tnum,
         tnum_present=staged.tnum_present, tzone=staged.tzone, tcap=staged.tcap,
-        req=classes.req, count=classes.count, allowed=allowed,
+        price=staged.price,
+        req=classes.req, count=classes.count, env_count=classes.env_count,
+        allowed=allowed,
         num_lo=classes.num_lo, num_hi=classes.num_hi, azone=classes.azone,
         acap=classes.acap, schedulable=classes.schedulable,
     )
@@ -408,8 +588,10 @@ def make_inputs(catalog: CatalogTensors, classes: PodClassSet) -> Tuple[SolveInp
         tnum_present=jnp.asarray(catalog.tnum_present),
         tzone=jnp.asarray(catalog.tzone),
         tcap=jnp.asarray(catalog.tcap),
+        price=jnp.asarray(catalog.price),
         req=jnp.asarray(classes.req),
         count=jnp.asarray(classes.count),
+        env_count=jnp.asarray(classes.env_count),
         allowed=jnp.asarray(allowed),
         num_lo=jnp.asarray(classes.num_lo),
         num_hi=jnp.asarray(classes.num_hi),

@@ -134,7 +134,12 @@ class Scheduler:
         pods_by_node: Optional[Dict[str, List[Pod]]] = None,
         nodepool_usage: Optional[Dict[str, Resources]] = None,
         zones: Optional[Set[str]] = None,
+        objective: str = "price",
     ):
+        # packing objective, mirrored from TPUSolver: "price" restricts a
+        # fresh group's candidate types to the min-price-per-pod envelope
+        # (solver/ffd.py _ffd_body); "fit" keeps every compatible type
+        self.objective = objective
         self.nodepools = sorted(nodepools, key=lambda p: -p.weight)
         self.instance_types = instance_types
         self.existing = list(existing_nodes)
@@ -144,23 +149,129 @@ class Scheduler:
         self.usage = dict(nodepool_usage or {})
         self.zones = zones or set()
         self._feasible_zone_cache: Dict[tuple, Set[str]] = {}
-        # anti-affinity occupancy: node/group id -> pod labels present
+        # price-envelope bookkeeping (objective == "price"): the envelope a
+        # class's FIRST group opens with is reused by its later groups --
+        # the batch solver opens all of a class's groups in one scan step
+        # with one envelope, so recomputing with a shrunken remaining count
+        # would diverge. Keys are the device's canonical class key merged
+        # with the pool context (encode._class_key orientation).
+        self._env_cache: Dict[tuple, Optional[Tuple[float, float]]] = {}
+        self._env_key_memo: Dict[tuple, tuple] = {}
+        self._env_totals: Dict[str, Dict[tuple, int]] = {}
+        self._env_placed: Dict[tuple, int] = {}
+        self._sched_pods: List[Pod] = []
+        # pod-(anti-)affinity occupancy (reference core scheduling algebra,
+        # SURVEY.md section 2.3; BOTH directions enforced):
+        #   _labels_on   location (node name / group id) -> pod labels
+        #   _zone_pods   zone -> pod labels (zone-topology terms; a group's
+        #                pods count once the group is pinned to one zone)
+        #   _anti_in     (topology key, domain) -> anti-affinity selectors of
+        #                resident pods (SYMMETRY: residents repel newcomers)
+        #   _all_labels  every placed pod's labels (bootstrap rule: a
+        #                required-affinity pod whose selector matches no pod
+        #                anywhere may place iff it matches itself)
         self._labels_on: Dict[str, List[Dict[str, str]]] = {}
+        self._zone_pods: Dict[str, List[Dict[str, str]]] = {}
+        self._anti_in: Dict[Tuple[str, str], List[Dict[str, str]]] = {}
+        self._all_labels: List[Dict[str, str]] = []
+        node_labels = {n.name: n.labels for n in self.existing}
         for node, pods in pods_by_node.items():
             self._labels_on[node] = [dict(p.metadata.labels) for p in pods]
+            zone = node_labels.get(node, {}).get(wk.ZONE_LABEL)
+            for p in pods:
+                labels = dict(p.metadata.labels)
+                self._all_labels.append(labels)
+                if zone:
+                    self._zone_pods.setdefault(zone, []).append(labels)
+                self._record_anti_terms(p, node, zone)
 
     # -- constraint checks --------------------------------------------------
-    def _anti_affinity_ok(self, pod: Pod, location: str) -> bool:
+    @staticmethod
+    def _match(labels: Dict[str, str], selector: Dict[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in selector.items())
+
+    def _record_anti_terms(self, pod: Pod, location: str, zone: Optional[str]) -> None:
         for term in pod.affinity_terms:
-            if not term.anti or term.topology_key != wk.HOSTNAME_LABEL:
+            if not term.anti:
                 continue
-            for labels in self._labels_on.get(location, []):
-                if all(labels.get(k) == v for k, v in term.label_selector.items()):
+            if term.topology_key == wk.HOSTNAME_LABEL:
+                self._anti_in.setdefault((wk.HOSTNAME_LABEL, location), []).append(
+                    dict(term.label_selector)
+                )
+            elif term.topology_key == wk.ZONE_LABEL and zone:
+                self._anti_in.setdefault((wk.ZONE_LABEL, zone), []).append(
+                    dict(term.label_selector)
+                )
+
+    def _any_match(self, selector: Dict[str, str]) -> bool:
+        return any(self._match(labels, selector) for labels in self._all_labels)
+
+    def _affinity_ok(self, pod: Pod, location: str, domain_labels: Dict[str, str]) -> bool:
+        """All required pod-(anti-)affinity terms of `pod` admit placing it
+        at `location` (an existing node or an open group), and no resident
+        pod's anti-affinity term repels it (full symmetry). Zone-topology
+        terms use the location's concrete zone when it has one
+        (`domain_labels`); a multi-zone group is treated as containing no
+        zone domain, so zone-affinity pods narrow or reject it instead
+        (see _affinity_narrow)."""
+        labels = pod.metadata.labels
+        zone = domain_labels.get(wk.ZONE_LABEL)
+        for term in pod.affinity_terms:
+            sel = term.label_selector
+            if term.topology_key == wk.HOSTNAME_LABEL:
+                dom = self._labels_on.get(location, [])
+            elif term.topology_key == wk.ZONE_LABEL:
+                dom = self._zone_pods.get(zone, []) if zone else []
+            else:
+                dom = []
+            if term.anti:
+                if any(self._match(l, sel) for l in dom):
                     return False
-        # symmetric check: existing pods' anti-affinity against this pod is
-        # approximated by the same-selector case (self anti-affinity), the
-        # overwhelmingly common pattern
+                # own anti-term also applies to itself landing in a domain
+                # already holding a match -- covered above; nothing else
+            else:
+                if any(self._match(l, sel) for l in dom):
+                    continue
+                # bootstrap: no matching pod anywhere -> self-match admits
+                if not self._any_match(sel) and self._match(labels, sel):
+                    continue
+                return False
+        # symmetry: residents' anti-affinity selectors repel this pod
+        for l_sel in self._anti_in.get((wk.HOSTNAME_LABEL, location), []):
+            if self._match(labels, l_sel):
+                return False
+        if zone:
+            for l_sel in self._anti_in.get((wk.ZONE_LABEL, zone), []):
+                if self._match(labels, l_sel):
+                    return False
         return True
+
+    def _affinity_narrow(self, pod: Pod, reqs: Requirements) -> Optional[Requirements]:
+        """Zone-topology affinity narrows a NEW group's zone requirement to
+        the admissible zones (the core narrows NodeClaim requirements the
+        same way): positive terms restrict to zones holding a matching pod
+        (any zone under the bootstrap rule); anti terms exclude zones
+        holding a match. Returns None when no zone survives."""
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        out = reqs
+        for term in pod.affinity_terms:
+            if term.topology_key != wk.ZONE_LABEL:
+                continue
+            sel = term.label_selector
+            matching = {z for z, pods in self._zone_pods.items() if any(self._match(l, sel) for l in pods)}
+            if term.anti:
+                if matching:
+                    out = out.copy()
+                    out.add(Requirement(wk.ZONE_LABEL, Operator.NOT_IN, sorted(matching)))
+            else:
+                if not matching:
+                    if not self._any_match(sel) and self._match(pod.metadata.labels, sel):
+                        continue  # bootstrap: any zone
+                    return None
+                out = out.copy()
+                out.add(Requirement(wk.ZONE_LABEL, Operator.IN, sorted(matching)))
+        return out
 
     def _spread_ok_existing(self, pod: Pod, node: ExistingNode) -> bool:
         for tsc in pod.topology_spread:
@@ -184,7 +295,13 @@ class Scheduler:
         return set(self.topology.count(tsc).keys())
 
     def _record_placement(self, pod: Pod, location: str, domain_labels: Dict[str, str]) -> None:
-        self._labels_on.setdefault(location, []).append(dict(pod.metadata.labels))
+        labels = dict(pod.metadata.labels)
+        self._labels_on.setdefault(location, []).append(labels)
+        self._all_labels.append(labels)
+        zone = domain_labels.get(wk.ZONE_LABEL)
+        if zone:
+            self._zone_pods.setdefault(zone, []).append(labels)
+        self._record_anti_terms(pod, location, zone)
         for tsc in pod.topology_spread:
             if not tsc.hard() or not _pod_matches_selector(pod, tsc.label_selector):
                 continue
@@ -203,7 +320,7 @@ class Scheduler:
             needed = pod.requests + Resources.from_base_units({res.PODS: 1})
             if not needed.fits(node.remaining()):
                 continue
-            if not self._anti_affinity_ok(pod, node.name):
+            if not self._affinity_ok(pod, node.name, node.labels):
                 continue
             if not self._spread_ok_existing(pod, node):
                 continue
@@ -311,7 +428,7 @@ class Scheduler:
             return False
         if not group.requirements.compatible(pod_reqs, allow_undefined=None):
             return False
-        if not self._anti_affinity_ok(pod, id(group)):
+        if not self._affinity_ok(pod, id(group), group.requirements.labels()):
             return False
         merged = group.requirements.copy().add(*pod_reqs)
         # zone topology spread narrows the merged requirements; the chosen
@@ -322,6 +439,11 @@ class Scheduler:
             base_fn=lambda: group.nodepool.requirements().copy().add(*pod_reqs),
             pool=group.nodepool,
         )
+        if narrowed is None:
+            return False
+        # zone-topology affinity narrows the joined group's zones too; an
+        # empty intersection surfaces as zero surviving types below
+        narrowed = self._affinity_narrow(pod, narrowed)
         if narrowed is None:
             return False
         requested = group.add_requested(pod)
@@ -339,6 +461,110 @@ class Scheduler:
         self._record_placement(pod, id(group), narrowed.labels())
         return True
 
+    def _env_key(self, pod: Pod, pool: NodePool) -> tuple:
+        from karpenter_tpu.solver import encode as _enc
+
+        memo_key = (pool.name, pod.grouping_signature())
+        key = self._env_key_memo.get(memo_key)
+        if key is None:
+            # group_pods orientation: pod requirements + pool extras
+            merged = pod.scheduling_requirements()[0].copy().add(*pool.requirements())
+            key = self._env_key_memo[memo_key] = (pool.name, _enc._class_key(pod, merged))
+        return key
+
+    def _note_placed(self, pod: Pod) -> None:
+        if self.objective != "price":
+            return
+        for pool in self.nodepools:
+            key = self._env_key(pod, pool)
+            self._env_placed[key] = self._env_placed.get(key, 0) + 1
+
+    def _remaining(self, pod: Pod, pool: NodePool) -> int:
+        totals = self._env_totals.get(pool.name)
+        if totals is None:
+            totals = self._env_totals[pool.name] = {}
+            for p in self._sched_pods:
+                k = self._env_key(p, pool)
+                totals[k] = totals.get(k, 0) + 1
+        key = self._env_key(pod, pool)
+        return totals.get(key, 1) - self._env_placed.get(key, 0)
+
+    def _price_open_filter(
+        self,
+        candidates: List[InstanceType],
+        narrowed: Requirements,
+        requested: Resources,
+        remaining: int,
+        env_key: Optional[tuple] = None,
+    ) -> List[InstanceType]:
+        """Price-aware opening envelope, the oracle half of the batch
+        solver's objective == "price" (solver/ffd.py _ffd_body step): pick
+        the candidate k* minimizing the TOTAL cost of hosting the class's
+        `remaining` pods -- price * ceil(remaining / fit) over the
+        (zone, captype) offerings the narrowed requirements admit -- then
+        keep only candidates at least as cheap that can hold k*'s
+        allocation. A class's later groups reuse the first group's cached
+        envelope (`env_key`). Arithmetic is float32 so floors, divisions,
+        and argmin ties agree with the device tensors exactly."""
+        import numpy as _np
+
+        from karpenter_tpu.solver import encode as _enc
+
+        req32 = _enc.scale_vector(requested.to_vector()).astype(_np.float32)
+        pos = req32 > 0
+        zreq = narrowed.get(wk.ZONE_LABEL)
+        creq = narrowed.get(wk.CAPACITY_TYPE_LABEL)
+        inf32 = _np.float32(_np.inf)
+        stats = []
+        for it in candidates:
+            cap32 = _enc.scale_vector(it.allocatable().to_vector()).astype(_np.float32)
+            n = _np.floor(cap32[pos] / req32[pos]).min() if pos.any() else inf32
+            price = inf32
+            has_reserved = False
+            for o in it.offerings:
+                if (
+                    o.available
+                    and (zreq is None or zreq.matches(o.zone))
+                    and (creq is None or creq.matches(o.capacity_type))
+                ):
+                    p32 = _np.float32(o.price)
+                    if p32 < price:
+                        price = p32
+                    if o.capacity_type == wk.CAPACITY_TYPE_RESERVED:
+                        has_reserved = True
+            stats.append((n, price, has_reserved))
+        env = self._env_cache.get(env_key) if env_key is not None else None
+        if env is None:
+            rem32 = _np.float32(max(remaining, 1))
+            n_max = max((n for n, _, _ in stats), default=_np.float32(0.0))
+            best_cost = inf32
+            env = False
+            need = min(n_max, rem32)
+            for (n, price, has_reserved) in stats:
+                # density envelope (mirrors ffd step): only types packing at
+                # least half the demanded density -- min(best packer,
+                # remaining) -- compete on price; reserved-capable types
+                # bypass the gate (prepaid capacity)
+                if n >= 1 and (
+                    _np.float32(2.0) * min(n, rem32) >= need or has_reserved
+                ):
+                    cost = price * _np.ceil(rem32 / n)
+                else:
+                    cost = inf32
+                if cost < best_cost:
+                    best_cost = cost
+                    env = (n, price)
+            if env_key is not None:
+                self._env_cache[env_key] = env
+        if env is False:
+            return []
+        n_star, p_star = env
+        return [
+            it
+            for it, (n, price, _) in zip(candidates, stats)
+            if n >= n_star and price <= p_star
+        ]
+
     def _open_group(self, pod: Pod, pod_reqs: Requirements, result: SchedulingResult) -> Optional[str]:
         last_reason = "no nodepool matches pod requirements"
         for pool in self.nodepools:
@@ -354,12 +580,47 @@ class Scheduler:
             if narrowed is None:
                 last_reason = "topology spread constraints unsatisfiable"
                 continue
+            # pod affinity on a FRESH node: a positive hostname term admits
+            # only the bootstrap case (the new node starts with no pods, so
+            # a pod that must co-locate with an existing match cannot start
+            # a new hostname domain); zone terms narrow the group's zones
+            affinity_blocked = False
+            for term in pod.affinity_terms:
+                if not term.anti and term.topology_key == wk.HOSTNAME_LABEL:
+                    sel = term.label_selector
+                    if self._any_match(sel) or not self._match(pod.metadata.labels, sel):
+                        affinity_blocked = True
+                        break
+            if affinity_blocked:
+                last_reason = "pod affinity requires co-location with an existing pod"
+                continue
+            narrowed = self._affinity_narrow(pod, narrowed)
+            if narrowed is None:
+                last_reason = "pod affinity unsatisfiable in any zone"
+                continue
             requested = pod.requests + Resources.from_base_units({res.PODS: 1})
             candidates = [
                 it
                 for it in self.instance_types.get(pool.name, [])
                 if it.requirements.compatible(narrowed) and _fits_type(it, requested)
             ]
+            if (
+                candidates
+                and self.objective == "price"
+                # hard-spread pods keep the full (max-fit) candidate set:
+                # spreading is an availability constraint and the batch
+                # solver marks spread sub-classes env_count = 0 (fit mode).
+                # A constraint whose selector the pod itself does not match
+                # never applies (the split pass ignores it the same way).
+                and not any(
+                    t.hard() and _pod_matches_selector(pod, t.label_selector)
+                    for t in pod.topology_spread
+                )
+            ):
+                candidates = self._price_open_filter(
+                    candidates, narrowed, requested,
+                    self._remaining(pod, pool), env_key=self._env_key(pod, pool),
+                )
             if not candidates:
                 last_reason = f"no instance type in nodepool {pool.name} fits pod"
                 continue
@@ -397,8 +658,10 @@ class Scheduler:
         from karpenter_tpu.solver.encode import pod_sort_key
 
         ordered = sorted(pods, key=pod_sort_key)
+        self._sched_pods = ordered
         for pod in ordered:
             if self._try_existing(pod, result):
+                self._note_placed(pod)
                 continue
             placed = False
             for pod_reqs in pod.scheduling_requirements():
@@ -409,6 +672,7 @@ class Scheduler:
                 if placed:
                     break
             if placed:
+                self._note_placed(pod)
                 continue
             reasons = []
             for pod_reqs in pod.scheduling_requirements():
@@ -419,4 +683,6 @@ class Scheduler:
                 reasons.append(reason)
             if not placed:
                 result.unschedulable[pod.metadata.name] = "; ".join(reasons) or "unschedulable"
+            else:
+                self._note_placed(pod)
         return result

@@ -180,13 +180,15 @@ class SolverServer:
         inp = ffd.SolveInputs(
             cap=entry.staged.cap, tcode=entry.staged.tcode, tnum=entry.staged.tnum,
             tnum_present=entry.staged.tnum_present, tzone=entry.staged.tzone,
-            tcap=entry.staged.tcap, req=t["req"], count=t["count"],
+            tcap=entry.staged.tcap, price=entry.staged.price,
+            req=t["req"], count=t["count"], env_count=t["env_count"],
             allowed=t["allowed"], num_lo=t["num_lo"], num_hi=t["num_hi"],
             azone=t["azone"], acap=t["acap"], schedulable=t["schedulable"],
         )
         out = ffd.ffd_solve(
             inp, g_max=int(header["g_max"]),
             word_offsets=entry.offsets, words=entry.words,
+            objective=str(header.get("objective", "price")),
         )
         arrays = jax.device_get(tuple(out))
         names = ffd.SolveOutputs._fields
@@ -261,14 +263,15 @@ class SolverClient:
 
     def solve_classes(
         self, seqnum: str, catalog: encode.CatalogTensors, class_set: encode.PodClassSet,
-        g_max: int = 512,
+        g_max: int = 512, objective: str = "price",
     ) -> ffd.SolveOutputs:
         with self._lock:  # atomic stage-then-solve (reentrant)
             if seqnum not in self._staged_seqnums:
                 self.stage_catalog(seqnum, catalog)
-            header = {"op": "solve", "seqnum": seqnum, "g_max": g_max}
+            header = {"op": "solve", "seqnum": seqnum, "g_max": g_max, "objective": objective}
             tensors = [
                 ("req", class_set.req), ("count", class_set.count),
+                ("env_count", class_set.env_count),
                 ("allowed", np.concatenate(class_set.allowed, axis=1)),
                 ("num_lo", class_set.num_lo), ("num_hi", class_set.num_hi),
                 ("azone", class_set.azone), ("acap", class_set.acap),

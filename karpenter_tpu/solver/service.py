@@ -32,9 +32,19 @@ _bucket = encode.bucket
 
 
 class TPUSolver:
-    def __init__(self, g_max: int = 512, c_pad_min: int = 16, client=None, use_pallas: bool = False):
+    def __init__(
+        self, g_max: int = 1024, c_pad_min: int = 16, client=None, use_pallas: bool = False,
+        objective: str = "price",
+    ):
+        # g_max default sized for the price objective at bench scale: cost-
+        # optimal packing opens ~1.6x the groups max-fit does (bench: 621 vs
+        # 377 for 50k pods)
         self.g_max = g_max
         self.c_pad_min = c_pad_min
+        # packing objective: "price" opens groups sized to the min
+        # price-per-pod type (BASELINE.json configs 3-4); "fit" is the
+        # legacy max-pods-per-node objective. The oracle mirrors both.
+        self.objective = objective
         # route the FFD step through the fused pallas kernel (TPU only;
         # interpreted elsewhere -- bench.py decides based on hardware)
         if client is not None and use_pallas:
@@ -51,6 +61,7 @@ class TPUSolver:
         self._cached_catalog_list = None   # strong ref: keeps the identity check sound
         self._cached_tensors: Optional[CatalogTensors] = None
         self._cached_staged = None         # (StagedCatalog, offsets, words)
+        self._cached_decode = None         # (types sorted by price, order idx)
         # wire seqnum for remote staging: id() is unsound across catalog
         # lifetimes (CPython reuses freed ids), and two controller processes
         # must never collide on the shared sidecar -- so a per-solver random
@@ -78,6 +89,14 @@ class TPUSolver:
                 # remote mode: the sidecar stages on ITS device; no local copy
                 self._cached_staged = (
                     ffd.stage_catalog(self._cached_tensors) if self.client is None else (None, None, None)
+                )
+                # decode acceleration: type objects pre-sorted by cheapest
+                # price so per-group survivor lists are one boolean fancy-
+                # index instead of a dict-lookup + sort per group
+                prices = np.array([it.cheapest_price() for it in instance_types])
+                order = np.argsort(prices, kind="stable")
+                self._cached_decode = (
+                    np.array(list(instance_types), dtype=object)[order], order
                 )
                 self._cached_catalog_list = instance_types
                 self._seq_counter += 1
@@ -112,6 +131,10 @@ class TPUSolver:
     # -- entry point (Provisioner contract) ---------------------------------
     def schedule(self, scheduler: Scheduler, pods: Sequence[Pod]) -> SchedulingResult:
         if not self.supports(scheduler, pods):
+            # the fallback must pack with THIS solver's objective -- callers
+            # construct the Scheduler without one, and a mixed-objective
+            # pass would break device/oracle differential equivalence
+            scheduler.objective = self.objective
             return scheduler.schedule(pods)
         pool = scheduler.nodepools[0]
         items = scheduler.instance_types.get(pool.name, [])
@@ -215,18 +238,42 @@ class TPUSolver:
         counts = class_set.count.copy()
         counts[: len(classes)] -= placed_existing.astype(counts.dtype)
         class_set.count = counts
+        dense = None
         if self.client is not None:
-            out = self.client.solve_classes(seqnum, catalog, class_set, g_max=self.g_max)
+            out = self.client.solve_classes(
+                seqnum, catalog, class_set, g_max=self.g_max, objective=self.objective
+            )
+            dense = (
+                np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
+                np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
+            )
         else:
             inp = ffd.make_inputs_staged(staged, class_set)
-            out = ffd.ffd_solve(
-                inp, g_max=self.g_max, word_offsets=offsets, words=words,
-                use_pallas=self.use_pallas,
+            # compact decision: ~50 KB over the (bandwidth-poor) device
+            # tunnel instead of the ~1.5 MB dense SolveOutputs
+            dec = ffd.ffd_solve_compact(
+                inp, g_max=self.g_max, nnz_max=class_set.c_pad + 4 * self.g_max,
+                word_offsets=offsets, words=words,
+                use_pallas=self.use_pallas, objective=self.objective,
             )
-            # one batched device->host fetch (transfers overlap; a single RTT)
-            out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
+            dec = ffd.CompactDecision(*jax.device_get(tuple(dec)))
+            dense = ffd.expand_compact(
+                dec, class_set.c_pad, self.g_max, catalog.k_pad, encode.Z_PAD, encode.CT
+            )
+            if dense is None:
+                # sparse budget overflow (placements not near-diagonal):
+                # refetch the dense decision -- correctness over latency
+                out = ffd.ffd_solve(
+                    inp, g_max=self.g_max, word_offsets=offsets, words=words,
+                    use_pallas=self.use_pallas, objective=self.objective,
+                )
+                out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
+                dense = (
+                    np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
+                    np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
+                )
         return self._decode(
-            pool, instance_types, catalog, class_set, out, nodepool_usage,
+            pool, instance_types, catalog, class_set, dense, nodepool_usage,
             result=result, class_offset=placed_existing,
         )
 
@@ -270,7 +317,7 @@ class TPUSolver:
         instance_types: Sequence,
         catalog: CatalogTensors,
         class_set,
-        out: ffd.SolveOutputs,
+        dense: Tuple,
         nodepool_usage: Optional[Resources],
         result: Optional[SchedulingResult] = None,
         class_offset: Optional[np.ndarray] = None,
@@ -279,27 +326,33 @@ class TPUSolver:
             result = SchedulingResult()
         if class_offset is None:
             class_offset = np.zeros((class_set.c_real,), dtype=np.int64)
-        take = np.asarray(out.take)                    # [C, G]
-        unplaced = np.asarray(out.unplaced)            # [C]
-        n_open = int(out.n_open)
-        gmask = np.asarray(out.gmask)                  # [G, K]
-        gzone = np.asarray(out.gzone)
-        gcap = np.asarray(out.gcap)
+        take, unplaced, n_open, gmask, gzone, gcap = dense
+        take = np.asarray(take)                        # [C, G]
+        unplaced = np.asarray(unplaced)                # [C]
+        n_open = int(n_open)
+        gmask = np.asarray(gmask)                      # [G, K]
+        gzone = np.asarray(gzone)
+        gcap = np.asarray(gcap)
         # cumulative placements per class: offset math in O(1) per (c, g)
         take_cum = np.concatenate(
             [np.zeros((take.shape[0], 1), dtype=take.dtype), np.cumsum(take, axis=1)], axis=1
         )
-        by_name = {it.name: it for it in instance_types}
-        # price memo: cheapest_price scans offerings; decode sorts candidate
-        # types per group, so resolve each type's price exactly once
-        price_of = {it.name: it.cheapest_price() for it in instance_types}
+        # price-ordered object array (memoized in _catalog): survivors per
+        # group come out cheapest-first via one boolean fancy-index
+        types_by_price, order = self._cached_decode
         captype_names = [wk.CAPACITY_TYPE_RESERVED, wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND]
 
         usage = nodepool_usage if nodepool_usage is not None else Resources()
         limited = pool.limits is not None
+        # transposed views: per-group column lookups below are contiguous
+        take_t = np.ascontiguousarray(take[:, :n_open].T) if n_open else take.T
+        gmask_real = gmask[:, : catalog.k_real]
+        zone_names = catalog.zones
+        n_zones = len(zone_names)
 
         for g in range(n_open):
-            classes_on_g = np.nonzero(take[:, g] > 0)[0]
+            col = take_t[g]
+            classes_on_g = np.nonzero(col > 0)[0]
             if classes_on_g.size == 0:
                 continue
             group_pods: List[Pod] = []
@@ -307,7 +360,7 @@ class TPUSolver:
             requested = Resources.from_base_units({res.PODS: 0})
             for c in classes_on_g:
                 pc = class_set.classes[c]
-                n = int(take[c, g])
+                n = int(col[c])
                 # pods before `off` went to existing nodes in phase 1
                 off = int(class_offset[c]) + int(take_cum[c, g])
                 group_pods.extend(pc.pods[off : off + n])
@@ -319,17 +372,18 @@ class TPUSolver:
                 requested = requested + (
                     pc.pods[0].requests + Resources.from_base_units({res.PODS: 1})
                 ) * n
-            type_names = [catalog.names[k] for k in np.nonzero(gmask[g][: catalog.k_real])[0]]
-            group_types = [by_name[n] for n in type_names if n in by_name]
+            group_types = types_by_price[gmask_real[g][order]].tolist()
             if not group_types:
                 for p in group_pods:
                     result.unschedulable[p.metadata.name] = "no surviving instance type"
                 continue
-            zones = [catalog.zones[z] for z in np.nonzero(gzone[g][: len(catalog.zones)])[0]]
+            zones = [zone_names[z] for z in np.nonzero(gzone[g][:n_zones])[0]]
             captypes = [captype_names[i] for i in np.nonzero(gcap[g])[0]]
-            if zones:
+            # a full mask is no constraint: the oracle's groups carry no
+            # zone/captype requirement when the pods imposed none
+            if zones and len(zones) < n_zones:
                 reqs.add(Requirement(wk.ZONE_LABEL, Operator.IN, zones))
-            if captypes:
+            if captypes and len(captypes) < len(captype_names):
                 reqs.add(Requirement(wk.CAPACITY_TYPE_LABEL, Operator.IN, captypes))
             # nodepool limits (host-side guard, mirroring the oracle)
             if limited:
@@ -343,7 +397,7 @@ class TPUSolver:
                 NewNodeGroup(
                     nodepool=pool,
                     requirements=reqs,
-                    instance_types=sorted(group_types, key=lambda it: price_of[it.name]),
+                    instance_types=group_types,
                     taints=list(pool.template.taints),
                     pods=group_pods,
                     requested=requested,

@@ -123,6 +123,27 @@ class SplitResult:
         self.unschedulable: Dict[str, str] = {}
 
 
+def _per_new_for_zone(
+    pc: PodClass, catalog: CatalogTensors, cat_z: int, compat_row: np.ndarray,
+) -> int:
+    """How many pods of class `pc` the batch solver will put on one fresh
+    group pinned to catalog zone `cat_z` -- the host mirror of
+    ffd._ffd_body's per-group sizing. Spread sub-classes always use the
+    MAX-FIT envelope (env_count = 0 in the scan): spreading is an
+    availability constraint, and the oracle's per-(class, zone) remaining
+    count depends on cross-zone placement order neither path can see
+    statically -- max fit is deterministic on both. Float32 so floors agree
+    with the device bit-for-bit."""
+    req32 = np.asarray(pc.requests, dtype=np.float32)
+    pos = req32 > 0
+    n = np.floor(catalog.cap[:, pos] / req32[pos]).min(axis=1)     # [K] f32
+    n = np.maximum(n, np.float32(0.0))
+    mask = compat_row & catalog.tzone[:, cat_z]
+    if not mask.any():
+        return 0
+    return int(n[mask].max())
+
+
 def split_zone_spread(
     classes: Sequence[PodClass],
     catalog: CatalogTensors,
@@ -131,7 +152,18 @@ def split_zone_spread(
     fits_one: np.ndarray,         # [C, K] one pod of class c fits type k
 ) -> SplitResult:
     """The carry pass: returns classes with every spread class replaced by
-    zone-pinned sub-classes (FFD order preserved; sub-classes adjacent)."""
+    zone-pinned sub-classes (FFD order preserved).
+
+    Sub-classes are emitted in GROUP-SIZED CHUNKS ordered by the oracle's
+    per-pod chronology, not zone-major: the oracle's min-count pinning
+    serves zones level by level (lexicographic within a level), so the k-th
+    group of zone z opens when z's count reaches c_z + (k-1)*per_new_z + 1.
+    Emitting one chunk per future group, sorted by that (level, zone)
+    open-order key, makes the scan's group slot order equal the oracle's
+    chronological open order -- later unconstrained classes then first-fit
+    into the SAME groups on both paths. (With max-fit sizing one zone chunk
+    rarely spans groups; the price objective sizes groups smaller, which is
+    what exposed the ordering.)"""
     zones = sorted(class_set_zones)
     state = SpreadState(zones)
     zone_to_idx = {z: i for i, z in enumerate(zones)}
@@ -166,22 +198,41 @@ def split_zone_spread(
         order = np.array([zone_to_idx[z] for z in domains], dtype=np.int64)
         take = _water_fill(counts, order, n)
         failed_from = None if domains else "topology spread constraints unsatisfiable"
-        counts += take
-        cursor = 0
+        # chunk each zone's allocation into future-group units and order
+        # chunks by the oracle's chronological group-open key
+        chunks = []  # (open_level, zone_lex_idx, zone, chunk_size)
         for zi in np.nonzero(take)[0]:
             z = zones[zi]
-            k = int(take[zi])
+            per_new = _per_new_for_zone(pc, catalog, cat_zone_idx[z], compat[ci])
+            total = int(take[zi])
+            if per_new <= 0:
+                # no opening possible in this zone (the solver will mark
+                # these unplaced); keep one chunk so pods route through
+                chunks.append((int(counts[zi]) + 1, int(zi), z, total))
+                continue
+            done = 0
+            g = 0
+            while done < total:
+                size = min(per_new, total - done)
+                chunks.append((int(counts[zi]) + g * per_new + 1, int(zi), z, size))
+                done += size
+                g += 1
+        chunks.sort(key=lambda ch: (ch[0], ch[1]))
+        counts += take
+        cursor = 0
+        for _, _, z, size in chunks:
             sub_reqs = pc.requirements.copy()
             sub_reqs.add(Requirement(wk.ZONE_LABEL, Operator.IN, [z]))
             out.classes.append(
                 PodClass(
-                    pods=pc.pods[cursor : cursor + k],
+                    pods=pc.pods[cursor : cursor + size],
                     requests=pc.requests,
                     requirements=sub_reqs,
-                    key=pc.key + (z,),
+                    key=pc.key + (z, cursor),
+                    env_count=0,
                 )
             )
-            cursor += k
+            cursor += size
         for p in pc.pods[cursor:]:
             out.unschedulable[p.metadata.name] = (
                 failed_from or "topology spread constraints unsatisfiable"

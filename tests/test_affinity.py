@@ -1,0 +1,208 @@
+"""Pod (anti-)affinity enforcement in the scheduling oracle.
+
+VERDICT round 2, item 5: required positive affinity must co-locate, a
+violating placement must be rejected, and anti-affinity must be SYMMETRIC
+(a resident pod's anti-affinity repels newcomers that match its selector).
+Reference behavior: the core scheduling algebra (SURVEY.md section 2.3);
+routing sends every affinity-carrying pod to this oracle
+(solver/service.py TPUSolver.supports, solver/consolidate.device_eligible).
+"""
+import pytest
+
+from karpenter_tpu.apis import NodePool, Pod, labels as wk
+from karpenter_tpu.apis.pod import PodAffinityTerm
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver.oracle import ExistingNode, Scheduler
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.apis import TPUNodeClass
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in cloud.describe_zones()},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return prov.list(nc)
+
+
+def mk_sched(items, existing=(), pods_by_node=None, zones=None):
+    pool = NodePool("default")
+    all_zones = zones if zones is not None else {
+        o.zone for it in items for o in it.available_offerings()
+    }
+    return pool, Scheduler(
+        nodepools=[pool],
+        instance_types={"default": items},
+        existing_nodes=existing,
+        pods_by_node=pods_by_node,
+        zones=all_zones,
+    )
+
+
+def small(name, **kw):
+    return Pod(name, requests=Resources({"cpu": "500m", "memory": "1Gi"}), **kw)
+
+
+def affinity(selector, key=wk.HOSTNAME_LABEL, anti=False):
+    return [PodAffinityTerm(label_selector=selector, topology_key=key, anti=anti)]
+
+
+class TestPositiveAffinity:
+    def test_required_affinity_colocates(self, catalog_items):
+        """A follower pod with required affinity to app=web lands in the
+        SAME group as the web pod."""
+        web = small("web", labels={"app": "web"})
+        follower = small("follower", affinity_terms=affinity({"app": "web"}))
+        _, sched = mk_sched(catalog_items)
+        result = sched.schedule([web, follower])
+        assert not result.unschedulable
+        group_of = {}
+        for gi, g in enumerate(result.new_groups):
+            for p in g.pods:
+                group_of[p.metadata.name] = gi
+        assert group_of["follower"] == group_of["web"]
+
+    def test_bootstrap_rule_self_match(self, catalog_items):
+        """First pod of a self-affine group may open a fresh node (k8s
+        bootstrap rule); replicas then pile onto the same domain."""
+        pods = [
+            small(f"p{i}", labels={"app": "ring"}, affinity_terms=affinity({"app": "ring"}))
+            for i in range(3)
+        ]
+        _, sched = mk_sched(catalog_items)
+        result = sched.schedule(pods)
+        assert not result.unschedulable
+        assert len(result.new_groups) == 1
+
+    def test_affinity_without_match_rejected(self, catalog_items):
+        """Required affinity to a label no pod carries (and the pod itself
+        does not carry) is unschedulable, not silently placed."""
+        p = small("lonely", affinity_terms=affinity({"app": "db"}))
+        _, sched = mk_sched(catalog_items)
+        result = sched.schedule([p])
+        assert "lonely" in result.unschedulable
+
+    def test_affinity_to_full_node_rejected(self, catalog_items):
+        """The matching pod sits on a FULL existing node: the follower may
+        not open a fresh (empty) hostname domain -- it stays pending."""
+        db = small("db", labels={"app": "db"})
+        node = ExistingNode(
+            name="n1",
+            labels={wk.ZONE_LABEL: "us-central-1a"},
+            allocatable=Resources({"cpu": "600m", "memory": "1100Mi", "pods": 8}),
+        )
+        node.used = Resources({"cpu": "600m", "memory": "1100Mi"})
+        follower = small("follower", affinity_terms=affinity({"app": "db"}))
+        _, sched = mk_sched(catalog_items, existing=[node], pods_by_node={"n1": [db]})
+        result = sched.schedule([follower])
+        assert "follower" in result.unschedulable
+
+    def test_zone_affinity_follows_zone(self, catalog_items):
+        """Zone-topology affinity: the follower's new group is pinned to the
+        zone already hosting the matching pod."""
+        web = small("web", labels={"app": "web"})
+        node = ExistingNode(
+            name="n1",
+            labels={wk.ZONE_LABEL: "us-central-1b"},
+            allocatable=Resources({"cpu": "600m", "memory": "1100Mi", "pods": 8}),
+        )
+        node.used = Resources({"cpu": "600m", "memory": "1100Mi"})  # full
+        follower = small(
+            "follower", affinity_terms=affinity({"app": "web"}, key=wk.ZONE_LABEL)
+        )
+        _, sched = mk_sched(catalog_items, existing=[node], pods_by_node={"n1": [web]})
+        result = sched.schedule([follower])
+        assert not result.unschedulable
+        assert len(result.new_groups) == 1
+        zreq = result.new_groups[0].requirements.get(wk.ZONE_LABEL)
+        assert zreq is not None and set(zreq.values) == {"us-central-1b"}
+
+
+class TestAntiAffinity:
+    def test_self_anti_affinity_spreads(self, catalog_items):
+        """Two replicas with hostname anti-affinity to their own label land
+        on different groups."""
+        pods = [
+            small(
+                f"r{i}", labels={"app": "spread"},
+                affinity_terms=affinity({"app": "spread"}, anti=True),
+            )
+            for i in range(2)
+        ]
+        _, sched = mk_sched(catalog_items)
+        result = sched.schedule(pods)
+        assert not result.unschedulable
+        assert len(result.new_groups) == 2
+
+    def test_symmetric_anti_affinity_repels_newcomer(self, catalog_items):
+        """A RESIDENT pod's anti-affinity term repels an incoming pod that
+        matches its selector, even though the incoming pod carries no anti
+        term itself (reference: full symmetry in the core scheduler)."""
+        guard = small(
+            "guard", labels={"app": "guard"},
+            affinity_terms=affinity({"app": "web"}, anti=True),
+        )
+        node = ExistingNode(
+            name="n1",
+            labels={wk.ZONE_LABEL: "us-central-1a"},
+            allocatable=Resources({"cpu": "8", "memory": "16Gi", "pods": 20}),
+        )
+        web = small("web", labels={"app": "web"})
+        _, sched = mk_sched(catalog_items, existing=[node], pods_by_node={"n1": [guard]})
+        result = sched.schedule([web])
+        assert not result.unschedulable
+        # plenty of room on n1, but the guard's anti-affinity repels web
+        assert "web" not in result.existing_assignments
+        assert len(result.new_groups) == 1
+
+    def test_zone_anti_affinity_excludes_zone(self, catalog_items):
+        """Zone-topology anti-affinity: the new group's zones exclude the
+        zone hosting the matching pod."""
+        web = small("web", labels={"app": "web"})
+        node = ExistingNode(
+            name="n1",
+            labels={wk.ZONE_LABEL: "us-central-1c"},
+            allocatable=Resources({"cpu": "600m", "memory": "1100Mi", "pods": 8}),
+        )
+        node.used = Resources({"cpu": "600m", "memory": "1100Mi"})
+        hater = small(
+            "hater", affinity_terms=affinity({"app": "web"}, key=wk.ZONE_LABEL, anti=True)
+        )
+        _, sched = mk_sched(catalog_items, existing=[node], pods_by_node={"n1": [web]})
+        result = sched.schedule([hater])
+        assert not result.unschedulable
+        zreq = result.new_groups[0].requirements.get(wk.ZONE_LABEL)
+        assert zreq is not None
+        assert not zreq.matches("us-central-1c")
+
+    def test_anti_affinity_blocks_join_not_just_open(self, catalog_items):
+        """An anti-affine pod refuses to JOIN a group holding a match."""
+        web = small("web", labels={"app": "web"})
+        hater = small(
+            "hater", labels={"app": "hater"},
+            affinity_terms=affinity({"app": "web"}, anti=True),
+        )
+        _, sched = mk_sched(catalog_items)
+        result = sched.schedule([web, hater])
+        assert not result.unschedulable
+        for g in result.new_groups:
+            names = {p.metadata.name for p in g.pods}
+            assert names != {"web", "hater"}
