@@ -219,14 +219,9 @@ def _stage_breakdown(solver, pool, items, pods):
     )
     if dense is None:
         # sparse-budget overflow: mirror the production dense refetch
-        out = ffd.ffd_solve(
+        dense = ffd.solve_dense_tuple(
             inp, g_max=solver.g_max, word_offsets=offsets, words=words,
             use_pallas=solver.use_pallas, objective=solver.objective,
-        )
-        out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
-        dense = (
-            np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
-            np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
         )
     solver._decode(pool, items, catalog, cs, dense, None)
     t["decode"] = time.perf_counter() - t0

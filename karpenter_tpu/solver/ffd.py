@@ -429,7 +429,19 @@ class PackedDecision(NamedTuple):
     sel_price: jax.Array    # [G] f32
 
 
-@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "use_pallas"))
+def _sparse_take(take: jax.Array, nnz_max: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(idx, val, nnz): flat row-major sparse encoding of the take matrix;
+    idx padding is -1. Shared by both compact decision layouts."""
+    flat = take.ravel()
+    nnz_true = jnp.sum(flat != 0).astype(jnp.int32)
+    (idx,) = jnp.nonzero(flat, size=nnz_max, fill_value=0)
+    valid = jnp.arange(nnz_max) < nnz_true
+    val = jnp.where(valid, flat[idx], 0).astype(jnp.int32)
+    idx = jnp.where(valid, idx, -1).astype(jnp.int32)
+    return idx, val, nnz_true
+
+
+@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "use_pallas", "objective"))
 def ffd_solve_packed(
     inp: SolveInputs,
     price: jax.Array,
@@ -439,15 +451,11 @@ def ffd_solve_packed(
     word_offsets: Tuple[int, ...],
     words: Tuple[int, ...],
     use_pallas: bool = False,
+    objective: str = "price",
 ) -> PackedDecision:
-    out = _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas)
+    out = _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas, objective=objective)
     k, z, ct, bp = select_offerings(price, out.gmask, out.gzone, out.gcap)
-    flat = out.take.ravel()
-    nnz_true = jnp.sum(flat != 0).astype(jnp.int32)
-    (idx,) = jnp.nonzero(flat, size=nnz_max, fill_value=0)
-    valid = jnp.arange(nnz_max) < nnz_true
-    val = jnp.where(valid, flat[idx], 0).astype(jnp.int32)
-    idx = jnp.where(valid, idx, -1).astype(jnp.int32)
+    idx, val, nnz_true = _sparse_take(out.take, nnz_max)
     return PackedDecision(
         idx=idx, val=val, nnz=nnz_true, unplaced=out.unplaced,
         n_open=out.n_open, sel_type=k.astype(jnp.int32),
@@ -491,12 +499,7 @@ def ffd_solve_compact(
     objective: str = "price",
 ) -> CompactDecision:
     out = _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas, objective=objective)
-    flat = out.take.ravel()
-    nnz_true = jnp.sum(flat != 0).astype(jnp.int32)
-    (idx,) = jnp.nonzero(flat, size=nnz_max, fill_value=0)
-    valid = jnp.arange(nnz_max) < nnz_true
-    val = jnp.where(valid, flat[idx], 0).astype(jnp.int32)
-    idx = jnp.where(valid, idx, -1).astype(jnp.int32)
+    idx, val, nnz_true = _sparse_take(out.take, nnz_max)
     K = out.gmask.shape[1]
     kw = K // 32
     gmask_bits = jnp.sum(
@@ -508,6 +511,24 @@ def ffd_solve_compact(
     return CompactDecision(
         idx=idx, val=val, nnz=nnz_true, unplaced=out.unplaced,
         n_open=out.n_open, gmask_bits=gmask_bits, gzc=gzc,
+    )
+
+
+def solve_dense_tuple(
+    inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
+    use_pallas: bool = False, objective: str = "price",
+):
+    """Dense solve fetched to host as the (take, unplaced, n_open, gmask,
+    gzone, gcap) decode tuple -- the fallback when a CompactDecision's
+    sparse budget overflows (expand_compact returned None)."""
+    out = ffd_solve(
+        inp, g_max=g_max, word_offsets=word_offsets, words=words,
+        use_pallas=use_pallas, objective=objective,
+    )
+    out = SolveOutputs(*jax.device_get(tuple(out)))
+    return (
+        np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
+        np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
     )
 
 

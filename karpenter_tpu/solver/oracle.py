@@ -273,6 +273,35 @@ class Scheduler:
                 out.add(Requirement(wk.ZONE_LABEL, Operator.IN, sorted(matching)))
         return out
 
+    def _zone_choice(self, pod: Pod, tsc: TopologySpreadConstraint) -> Optional[str]:
+        """The pod's pinned spread zone: lexicographically-first minimum-
+        count zone among skew-eligible feasible domains (the same choice
+        _spread_narrow_group makes when opening/joining groups, computed
+        against the highest-weight pool COMPATIBLE with the pod). Pinning
+        the SAME zone for existing-node packing keeps the oracle
+        differentially equal to the batch path, whose split pass assigns
+        zones before node packing."""
+        pod_reqs = pod.scheduling_requirements()[0]
+        pool = next(
+            (
+                p
+                for p in self.nodepools
+                if p.requirements().compatible(pod_reqs, allow_undefined=_ALLOW_UNDEFINED)
+            ),
+            None,
+        )
+        base = pod_reqs
+        if pool is not None:
+            base = pool.requirements().copy().add(*base)
+        requested = pod.requests + Resources.from_base_units({res.PODS: 1})
+        domains = self._feasible_spread_zones(pool, base, requested)
+        candidates = self._group_zone_domains(base) & domains
+        allowed = self.topology.allowed_domains(tsc, candidates, all_domains=domains)
+        if not allowed:
+            return None
+        counts = self.topology.count(tsc)
+        return min(sorted(allowed), key=lambda z: counts.get(z, 0))
+
     def _spread_ok_existing(self, pod: Pod, node: ExistingNode) -> bool:
         for tsc in pod.topology_spread:
             if not tsc.hard() or not _pod_matches_selector(pod, tsc.label_selector):
@@ -280,6 +309,14 @@ class Scheduler:
             domain = node.labels.get(tsc.topology_key)
             if domain is None:
                 return False
+            if tsc.topology_key == wk.ZONE_LABEL:
+                # zone spread packs onto existing nodes only in the pod's
+                # PINNED (min-count) zone -- a stricter deterministic
+                # refinement of the skew rule (min-count is always within
+                # skew) shared with the batch solver's split pass
+                if domain != self._zone_choice(pod, tsc):
+                    return False
+                continue
             candidates = self._domains_for(tsc)
             if domain not in self.topology.allowed_domains(tsc, candidates, all_domains=candidates):
                 return False
@@ -521,31 +558,39 @@ class Scheduler:
             n = _np.floor(cap32[pos] / req32[pos]).min() if pos.any() else inf32
             price = inf32
             has_reserved = False
+            zone_ok = cap_ok = False
             for o in it.offerings:
-                if (
-                    o.available
-                    and (zreq is None or zreq.matches(o.zone))
-                    and (creq is None or creq.matches(o.capacity_type))
-                ):
+                if not o.available:
+                    continue
+                z_m = zreq is None or zreq.matches(o.zone)
+                c_m = creq is None or creq.matches(o.capacity_type)
+                zone_ok = zone_ok or z_m
+                cap_ok = cap_ok or c_m
+                if z_m and c_m:
                     p32 = _np.float32(o.price)
                     if p32 < price:
                         price = p32
                     if o.capacity_type == wk.CAPACITY_TYPE_RESERVED:
                         has_reserved = True
-            stats.append((n, price, has_reserved))
+            # the device's fresh_row is the SEPARABLE availability join
+            # (admitted zone exists AND admitted captype exists, over
+            # available offerings); candidates outside it must not anchor
+            # the density reference n_max
+            joined = zone_ok and cap_ok
+            stats.append((n, price, has_reserved, joined))
         env = self._env_cache.get(env_key) if env_key is not None else None
         if env is None:
             rem32 = _np.float32(max(remaining, 1))
-            n_max = max((n for n, _, _ in stats), default=_np.float32(0.0))
+            n_max = max((n for n, _, _, j in stats if j), default=_np.float32(0.0))
             best_cost = inf32
             env = False
             need = min(n_max, rem32)
-            for (n, price, has_reserved) in stats:
+            for (n, price, has_reserved, joined) in stats:
                 # density envelope (mirrors ffd step): only types packing at
                 # least half the demanded density -- min(best packer,
                 # remaining) -- compete on price; reserved-capable types
                 # bypass the gate (prepaid capacity)
-                if n >= 1 and (
+                if joined and n >= 1 and (
                     _np.float32(2.0) * min(n, rem32) >= need or has_reserved
                 ):
                     cost = price * _np.ceil(rem32 / n)
@@ -561,7 +606,7 @@ class Scheduler:
         n_star, p_star = env
         return [
             it
-            for it, (n, price, _) in zip(candidates, stats)
+            for it, (n, price, _, _) in zip(candidates, stats)
             if n >= n_star and price <= p_star
         ]
 

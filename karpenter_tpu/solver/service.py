@@ -112,8 +112,6 @@ class TPUSolver:
     def supports(scheduler: Scheduler, pods: Sequence[Pod]) -> bool:
         from karpenter_tpu.solver import spread
 
-        if len(scheduler.nodepools) != 1:
-            return False
         any_spread = False
         for p in pods:
             if p.affinity_terms or len(p.node_affinity_terms) > 1:
@@ -121,12 +119,40 @@ class TPUSolver:
             if any(t.hard() for t in p.topology_spread):
                 any_spread = True
         if any_spread:
-            # zone spread is handled by the host carry pass (spread.py);
-            # it models fresh-cluster counts only, so live nodes route to
-            # the oracle (their pods seed counts the pass does not track)
-            if scheduler.existing or not spread.spread_eligible(pods):
+            # hostname spread and multi-constraint pods take the oracle;
+            # zone spread (incl. existing nodes: counts seed from the
+            # scheduler's topology state) stays on device. Spread + several
+            # pools would need cross-pool count carry -- oracle.
+            if not spread.spread_eligible(pods) or len(scheduler.nodepools) > 1:
                 return False
         return True
+
+    @staticmethod
+    def _pools_overlap(pools: Sequence[NodePool], pods: Sequence[Pod]) -> bool:
+        """True when some pod class is compatible with more than one pool
+        (the oracle's _open_group gate, per class instead of per pod)."""
+        from karpenter_tpu.solver.oracle import _ALLOW_UNDEFINED
+
+        pool_reqs = [p.requirements() for p in pools]
+        for pc in encode.group_pods(pods):
+            n = 0
+            for reqs in pool_reqs:
+                if reqs.compatible(pc.requirements, allow_undefined=_ALLOW_UNDEFINED):
+                    n += 1
+                    if n > 1:
+                        return True
+        return False
+
+    @staticmethod
+    def _spread_seeds(scheduler: Scheduler):
+        """The oracle's seeded per-selector zone counts, re-keyed for the
+        split pass (spread.py keys by selector only; the state is already
+        zone-scoped)."""
+        seeds: Dict[tuple, Dict[str, int]] = {}
+        for (tkey, sel_key), counts in scheduler.topology._counts.items():
+            if tkey == wk.ZONE_LABEL:
+                seeds[sel_key] = dict(counts)
+        return seeds
 
     # -- entry point (Provisioner contract) ---------------------------------
     def schedule(self, scheduler: Scheduler, pods: Sequence[Pod]) -> SchedulingResult:
@@ -136,19 +162,45 @@ class TPUSolver:
             # pass would break device/oracle differential equivalence
             scheduler.objective = self.objective
             return scheduler.schedule(pods)
-        pool = scheduler.nodepools[0]
-        items = scheduler.instance_types.get(pool.name, [])
-        if not items and not scheduler.existing:
-            result = SchedulingResult()
-            for p in pods:
+        # pools in weight order, first-feasible-pool-wins: each pool's batch
+        # solve takes the previous pool's unschedulable leftovers (the
+        # oracle's per-pod pool iteration collapses to this because every
+        # pod of a class routes identically; existing capacity is
+        # pool-agnostic and packed in the first round only)
+        pools = scheduler.nodepools
+        if len(pools) > 1 and self._pools_overlap(pools, pods):
+            # a class compatible with SEVERAL pools can join another
+            # class's open group across the pool boundary in the oracle's
+            # first-fit order (in-flight capacity beats weight preference,
+            # as in the reference core); pool-sequential solves cannot
+            # express that, so overlapping-compat batches take the oracle
+            scheduler.objective = self.objective
+            return scheduler.schedule(pods)
+        result = SchedulingResult()
+        pods_left: List[Pod] = list(pods)
+        for i, pool in enumerate(pools):
+            items = scheduler.instance_types.get(pool.name, [])
+            existing = scheduler.existing if i == 0 else ()
+            if not items and not existing:
+                continue
+            res = self.solve(
+                pool, items, pods_left,
+                nodepool_usage=scheduler.usage.get(pool.name),
+                existing_nodes=existing,
+                zones=sorted(scheduler.zones),
+                spread_seeds=self._spread_seeds(scheduler) if i == 0 else None,
+            )
+            result.new_groups.extend(res.new_groups)
+            result.existing_assignments.update(res.existing_assignments)
+            by_name = {p.metadata.name: p for p in pods_left}
+            result.unschedulable = res.unschedulable
+            pods_left = [by_name[n] for n in res.unschedulable if n in by_name]
+            if not pods_left:
+                break
+        if pods_left and not result.unschedulable:
+            for p in pods_left:
                 result.unschedulable[p.metadata.name] = "no instance types for nodepool"
-            return result
-        return self.solve(
-            pool, items, pods,
-            nodepool_usage=scheduler.usage.get(pool.name),
-            existing_nodes=scheduler.existing,
-            zones=sorted(scheduler.zones),
-        )
+        return result
 
     # -- the batch solve ----------------------------------------------------
     def solve(
@@ -159,6 +211,7 @@ class TPUSolver:
         nodepool_usage: Optional[Resources] = None,
         existing_nodes: Sequence = (),
         zones: Sequence[str] = (),
+        spread_seeds: Optional[Dict] = None,
     ) -> SchedulingResult:
         from karpenter_tpu.solver import spread as spread_mod
 
@@ -168,31 +221,33 @@ class TPUSolver:
                 "(hostname or multiple hard constraints); call schedule() so "
                 "routing can fall back to the oracle"
             )
-        if existing_nodes and any(
-            spread_mod.hard_zone_tsc(p) for p in pods
-        ):
-            # the carry pass models fresh-cluster counts only: pods already
-            # bound to existing nodes seed per-zone counts it cannot see, and
-            # _pack_existing checks pod-level requirements only (a zone-
-            # pinned sub-class could land on a wrong-zone node). schedule()'s
-            # routing sends this combination to the oracle; direct solve()
-            # calls must not bypass that invariant (ADVICE round 1).
-            raise ValueError(
-                "TPUSolver.solve: hard zone-spread pods cannot be combined "
-                "with existing_nodes; call schedule() so routing can fall "
-                "back to the oracle"
-            )
         pool_reqs = pool.requirements()
         classes = encode.group_pods(pods, extra_requirements=pool_reqs)
         result = SchedulingResult()
 
         # phase 0 (host): zone topology spread -- the carry pass splits
-        # spread classes into zone-pinned sub-classes with the oracle's
-        # exact pod distribution (solver/spread.py). Runs before the
-        # existing-node phase so class indices stay aligned; the routing in
-        # supports() guarantees spread pods never coexist with existing
-        # nodes on this path (live pods would seed counts this pass does
-        # not track).
+        # spread classes into zone-pinned, group-sized sub-classes with the
+        # oracle's exact pod distribution (solver/spread.py). Runs before
+        # the existing-node phase so the pinned zones gate node packing;
+        # counts seed from live pods (spread_seeds, the oracle's
+        # _TopologyState.seed_existing) so steady-state clusters stay on
+        # this path.
+        if not instance_types and any(spread_mod.hard_zone_tsc(pc.pods[0]) for pc in classes):
+            # no catalog -> no feasible spread domains: the oracle rejects
+            # every node for these pods (_zone_choice has no candidates),
+            # so they are unschedulable rather than packed skew-blind
+            kept = []
+            for pc in classes:
+                if spread_mod.hard_zone_tsc(pc.pods[0]):
+                    for p in pc.pods:
+                        result.unschedulable[p.metadata.name] = (
+                            "topology spread constraints unsatisfiable"
+                        )
+                else:
+                    kept.append(pc)
+            classes = kept
+            if not classes:
+                return result
         if instance_types and any(spread_mod.hard_zone_tsc(pc.pods[0]) for pc in classes):
             catalog0 = self._catalog(instance_types)[0]
             pre_set = encode.encode_classes(
@@ -204,7 +259,8 @@ class TPUSolver:
                 catalog0.cap[None, :, :] >= pre_set.req[: len(classes), None, :], axis=-1
             )
             split = spread_mod.split_zone_spread(
-                classes, catalog0, list(zones) or list(catalog0.zones), compat, fits_one
+                classes, catalog0, list(zones) or list(catalog0.zones), compat, fits_one,
+                seed_counts=spread_seeds,
             )
             classes = split.classes
             result.unschedulable.update(split.unschedulable)
@@ -263,14 +319,9 @@ class TPUSolver:
             if dense is None:
                 # sparse budget overflow (placements not near-diagonal):
                 # refetch the dense decision -- correctness over latency
-                out = ffd.ffd_solve(
+                dense = ffd.solve_dense_tuple(
                     inp, g_max=self.g_max, word_offsets=offsets, words=words,
                     use_pallas=self.use_pallas, objective=self.objective,
-                )
-                out = ffd.SolveOutputs(*jax.device_get(tuple(out)))
-                dense = (
-                    np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
-                    np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
                 )
         return self._decode(
             pool, instance_types, catalog, class_set, dense, nodepool_usage,
@@ -291,7 +342,7 @@ class TPUSolver:
             member[0, i] = len(pc.pods)
         feas = np.zeros((C, N), dtype=bool)
         feas[: len(classes), : len(existing_nodes)] = consolidate._node_feasibility(
-            classes, existing_nodes
+            classes, existing_nodes, class_zone_pins=True
         )
         headroom = np.zeros((N, encode.R), dtype=np.float32)
         for ni, node in enumerate(existing_nodes):

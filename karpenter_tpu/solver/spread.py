@@ -75,15 +75,23 @@ def _selector_key(t: TopologySpreadConstraint) -> tuple:
 @dataclass
 class SpreadState:
     """Per-selector zone counts (the oracle's _TopologyState for the zone
-    key), carried across classes in scan order."""
+    key), carried across classes in scan order. `seed` carries the counts
+    pods already bound to live nodes contribute (the oracle's
+    _TopologyState.seed_existing), so spread decisions on a steady-state
+    cluster stay on the device path."""
 
     zones: List[str]
     counts: Dict[tuple, np.ndarray] = field(default_factory=dict)
+    seed: Optional[Dict[tuple, Dict[str, int]]] = None
 
     def of(self, key: tuple) -> np.ndarray:
         c = self.counts.get(key)
         if c is None:
             c = self.counts[key] = np.zeros(len(self.zones), dtype=np.int64)
+            if self.seed:
+                for zone, n in self.seed.get(key, {}).items():
+                    if zone in self.zones:
+                        c[self.zones.index(zone)] = n
         return c
 
 
@@ -150,6 +158,7 @@ def split_zone_spread(
     class_set_zones: Sequence[str],
     compat: np.ndarray,           # [C, K] host compat (encode.compat_matrix)
     fits_one: np.ndarray,         # [C, K] one pod of class c fits type k
+    seed_counts: Optional[Dict[tuple, Dict[str, int]]] = None,
 ) -> SplitResult:
     """The carry pass: returns classes with every spread class replaced by
     zone-pinned sub-classes (FFD order preserved).
@@ -165,7 +174,7 @@ def split_zone_spread(
     rarely spans groups; the price objective sizes groups smaller, which is
     what exposed the ordering.)"""
     zones = sorted(class_set_zones)
-    state = SpreadState(zones)
+    state = SpreadState(zones, seed=seed_counts)
     zone_to_idx = {z: i for i, z in enumerate(zones)}
     # catalog zone axis may be ordered differently
     cat_zone_idx = {z: i for i, z in enumerate(catalog.zones)}

@@ -313,17 +313,14 @@ class TestSolverInProvisioner:
 
 
 class TestSolveGuards:
-    def test_direct_solve_rejects_existing_nodes_with_hard_spread(self):
+    def test_direct_solve_rejects_hostname_spread(self):
         """solve() called directly (bypassing schedule()'s routing) with
-        both existing nodes and hard zone-spread pods must refuse: the
-        spread carry pass cannot see counts seeded by live pods
-        (ADVICE round 1)."""
+        out-of-scope spread constraints (hostname topology) must refuse;
+        schedule() routes these to the oracle."""
         from karpenter_tpu.apis import NodePool, Pod
         from karpenter_tpu.apis.pod import TopologySpreadConstraint
         from karpenter_tpu.scheduling import Resources
-        from karpenter_tpu.solver.oracle import ExistingNode
         from karpenter_tpu.solver.service import TPUSolver
-        from karpenter_tpu.scheduling import resources as res
 
         pod = Pod(
             "spread-0",
@@ -332,18 +329,11 @@ class TestSolveGuards:
             topology_spread=[
                 TopologySpreadConstraint(
                     max_skew=1,
-                    topology_key=wk.ZONE_LABEL,
+                    topology_key=wk.HOSTNAME_LABEL,
                     label_selector={"app": "x"},
                 )
             ],
         )
-        node = ExistingNode(
-            name="n0",
-            labels={wk.ZONE_LABEL: "us-central-1a"},
-            allocatable=Resources.from_base_units(
-                {res.CPU: 4000, res.MEMORY: 8 * 2**30, res.PODS: 110}
-            ),
-        )
         solver = TPUSolver()
-        with pytest.raises(ValueError, match="existing_nodes"):
-            solver.solve(NodePool("default"), [], [pod], existing_nodes=[node])
+        with pytest.raises(ValueError, match="out-of-scope spread"):
+            solver.solve(NodePool("default"), [], [pod])

@@ -254,6 +254,221 @@ class TestSpreadDifferential:
         assert zone_distribution(oracle) == zone_distribution(device), f"seed {seed}"
 
 
+def run_both_scheduled(items, pods, existing=(), pods_by_node=None, pools=None):
+    """Differential through the FULL routing entry point (schedule), with
+    pre-seeded cluster state and/or several nodepools."""
+    import copy
+
+    pools = pools or [NodePool("default")]
+    zones = {o.zone for it in items for o in it.available_offerings()}
+    catalogs = {p.name: items for p in pools}
+
+    def mk():
+        return Scheduler(
+            nodepools=pools,
+            instance_types=catalogs,
+            existing_nodes=copy.deepcopy(list(existing)),
+            pods_by_node=pods_by_node,
+            zones=zones,
+        )
+
+    oracle = mk().schedule(list(pods))
+    device = TPUSolver(g_max=256).schedule(mk(), list(pods))
+    return oracle, device
+
+
+class TestSteadyStateSpread:
+    """VERDICT round 2, item 4: hard zone spread + existing nodes stays on
+    the device path, with counts seeded from live pods."""
+
+    def _node(self, name, zone, cpu="8", mem="16Gi", pods=30):
+        from karpenter_tpu.solver.oracle import ExistingNode
+
+        return ExistingNode(
+            name=name,
+            labels={wk.ZONE_LABEL: zone, "node": name},
+            allocatable=Resources({"cpu": cpu, "memory": mem, "pods": pods}),
+        )
+
+    def test_routing_keeps_spread_with_existing_on_device(self, catalog_items):
+        pool = NodePool("default")
+        sched = Scheduler(
+            nodepools=[pool], instance_types={"default": pool.name and catalog_items},
+            existing_nodes=[self._node("n1", "us-central-1a")],
+            zones={"us-central-1a", "us-central-1b"},
+        )
+        pods = [spread_pod(f"p{i}", "500m", "1Gi") for i in range(4)]
+        assert TPUSolver.supports(sched, pods)
+
+    def test_seeded_counts_steer_spreading(self, catalog_items):
+        """Zone-a already runs 3 matching pods: new spread pods must favor
+        the other zones first, identically on both paths."""
+        seeded = [
+            Pod(f"old{i}", requests=Resources({"cpu": "100m", "memory": "128Mi"}),
+                labels={"app": "web"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1, topology_key=wk.ZONE_LABEL,
+                        label_selector={"app": "web"},
+                    )
+                ])
+            for i in range(3)
+        ]
+        node = self._node("n1", "us-central-1a")
+        oracle, device = run_both_scheduled(
+            catalog_items,
+            [spread_pod(f"p{i}", "500m", "1Gi") for i in range(6)],
+            existing=[node],
+            pods_by_node={"n1": seeded},
+        )
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+        assert zone_distribution(oracle) == zone_distribution(device)
+        # zone-a starts at 3; 6 new pods water-fill b, c, d to 2 each
+        zones_used = [z for zs, n in zone_distribution(device) for z in zs for _ in range(n)]
+        assert zones_used.count("us-central-1a") == 0
+
+    def test_spread_packs_existing_in_pinned_zone(self, catalog_items):
+        """A spread pod whose min-count zone holds a live node with headroom
+        packs onto it (both paths), instead of opening a group."""
+        nodes = [self._node("na", "us-central-1a"), self._node("nb", "us-central-1b")]
+        oracle, device = run_both_scheduled(
+            catalog_items,
+            [spread_pod(f"p{i}", "500m", "1Gi") for i in range(2)],
+            existing=nodes,
+            pods_by_node={},
+        )
+        assert sorted(oracle.existing_assignments.items()) == sorted(
+            device.existing_assignments.items()
+        )
+        assert len(oracle.existing_assignments) == 2
+        assert not oracle.new_groups and not device.new_groups
+
+    def test_randomized_seeded_differential(self, catalog_items):
+        rng = np.random.default_rng(77)
+        for trial in range(4):
+            zones = ["us-central-1a", "us-central-1b", "us-central-1c", "us-central-1d"]
+            nodes = []
+            pods_by_node = {}
+            for ni in range(int(rng.integers(0, 4))):
+                z = zones[int(rng.integers(0, 4))]
+                n = self._node(f"t{trial}n{ni}", z, cpu="2", mem="4Gi", pods=10)
+                nodes.append(n)
+                bound = [
+                    Pod(f"t{trial}b{ni}-{j}",
+                        requests=Resources({"cpu": "100m", "memory": "128Mi"}),
+                        labels={"app": "web"},
+                        topology_spread=[
+                            TopologySpreadConstraint(
+                                max_skew=1, topology_key=wk.ZONE_LABEL,
+                                label_selector={"app": "web"},
+                            )
+                        ])
+                    for j in range(int(rng.integers(0, 3)))
+                ]
+                pods_by_node[n.name] = bound
+            pods = [
+                spread_pod(f"t{trial}p{i}", "500m", "1Gi")
+                for i in range(int(rng.integers(2, 12)))
+            ]
+            oracle, device = run_both_scheduled(
+                catalog_items, pods, existing=nodes, pods_by_node=pods_by_node
+            )
+            assert set(oracle.unschedulable) == set(device.unschedulable), f"trial {trial}"
+            assert zone_distribution(oracle) == zone_distribution(device), f"trial {trial}"
+            assert sorted(oracle.existing_assignments.values()) == sorted(
+                device.existing_assignments.values()
+            ), f"trial {trial}"
+
+
+class TestMultiNodePool:
+    """VERDICT round 2, item 4: several nodepools batch on device in weight
+    order, first-feasible-pool-wins."""
+
+    def test_disjoint_classes_stay_on_device(self, catalog_items, monkeypatch):
+        """Every class compatible with exactly one pool: the batch path
+        handles both pools itself (Scheduler.schedule must never fire)."""
+        from karpenter_tpu.scheduling import Requirement, Operator as Op
+
+        arm = NodePool("arm")
+        arm.weight = 10
+        arm.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"])]
+        amd = NodePool("amd")
+        amd.weight = 1
+        amd.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])]
+        pods = [
+            Pod(f"graviton{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "arm64"})
+            for i in range(3)
+        ] + [
+            Pod(f"x86-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(3)
+        ]
+        oracle, _ = run_both_scheduled(catalog_items, pods, pools=[arm, amd])
+        monkeypatch.setattr(
+            Scheduler, "schedule",
+            lambda self, p: (_ for _ in ()).throw(AssertionError("oracle fallback fired")),
+        )
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=[arm, amd],
+            instance_types={"arm": catalog_items, "amd": catalog_items},
+            zones=zones,
+        )
+        device = TPUSolver(g_max=256).schedule(sched, list(pods))
+
+        def by_pool(result):
+            out = {}
+            for g in result.new_groups:
+                out.setdefault(g.nodepool.name, []).append(sorted(p.metadata.name for p in g.pods))
+            return {k: sorted(v) for k, v in out.items()}
+
+        assert not oracle.unschedulable and not device.unschedulable
+        assert by_pool(oracle) == by_pool(device)
+        assert set(by_pool(oracle)) == {"arm", "amd"}
+
+    def test_single_pool_pods_fall_through_first_pool(self, catalog_items):
+        """Pods incompatible with the high-weight pool land on the second,
+        identically on both paths."""
+        from karpenter_tpu.scheduling import Requirement, Operator as Op
+
+        arm = NodePool("arm")
+        arm.weight = 10
+        arm.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"])]
+        amd = NodePool("amd")
+        amd.weight = 1
+        amd.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])]
+        pods = [
+            Pod(f"x86-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(4)
+        ]
+        oracle, device = run_both_scheduled(catalog_items, pods, pools=[arm, amd])
+        assert not oracle.unschedulable and not device.unschedulable
+        assert {g.nodepool.name for g in oracle.new_groups} == {"amd"}
+        assert {g.nodepool.name for g in device.new_groups} == {"amd"}
+        assert len(oracle.new_groups) == len(device.new_groups)
+
+    def test_overlapping_compat_falls_back_equal(self, catalog_items):
+        """Classes compatible with BOTH pools route to the oracle (cross-
+        pool group joins: in-flight capacity beats weight preference, as in
+        the reference core) -- schedule() must yield the oracle's decisions
+        verbatim."""
+        hi = NodePool("hi")
+        hi.weight = 10
+        lo = NodePool("lo")
+        lo.weight = 1
+        pods = [Pod(f"p{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"})) for i in range(6)]
+        oracle, device = run_both_scheduled(catalog_items, pods, pools=[hi, lo])
+        assert not oracle.unschedulable and not device.unschedulable
+        assert sorted(len(g.pods) for g in oracle.new_groups) == sorted(
+            len(g.pods) for g in device.new_groups
+        )
+        assert {g.nodepool.name for g in oracle.new_groups} == {
+            g.nodepool.name for g in device.new_groups
+        }
+
+
 class TestSpreadEndToEnd:
     def test_spread_burst_on_kwok_rig(self):
         from karpenter_tpu.cache.ttl import FakeClock
