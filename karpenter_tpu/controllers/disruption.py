@@ -356,7 +356,9 @@ class DisruptionController:
                 self._replace_then_disrupt(c, groups, REASON_UNDERUTILIZED, disrupting)
 
         # 5) multi-node consolidation: try deleting the k cheapest-to-disrupt
-        #    candidates together (pure deletion, no replacement)
+        #    candidates together; when pure deletion fails, collapse them
+        #    into ONE cheaper replacement node
+        #    (reference: designs/consolidation.md:5-36 node replacement)
         if len(self.last_decisions) < max_disruptions and len(consolidatable) >= 2:
             remaining = [
                 c
@@ -364,7 +366,8 @@ class DisruptionController:
                 if c.claim.metadata.name not in [n for n, _ in self.last_decisions]
                 and self._all_pods_evictable(c.pods)
             ]
-            subset = self._largest_deletable_prefix(remaining)
+            device_verdicts = self._device_prefix_verdicts(remaining)
+            subset = self._largest_deletable_prefix(remaining, device_verdicts)
             if subset:
                 # budgets re-checked per disruption as the count grows;
                 # deleting a prefix of the simulated subset is safe
@@ -373,43 +376,111 @@ class DisruptionController:
                     if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, disrupting, totals):
                         break
                     self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
+            elif len(remaining) >= 2:
+                self._multi_node_replacement(remaining, device_verdicts, disrupting, totals)
         return self.last_decisions
 
-    def _largest_deletable_prefix(self, remaining: List[Candidate]) -> List[Candidate]:
+    def _multi_node_replacement(
+        self,
+        remaining: List[Candidate],
+        device_verdicts: Optional[Dict[int, object]],
+        disrupting: Dict[str, int],
+        totals: Dict[str, int],
+    ) -> None:
+        """Replace N underutilized nodes with one cheaper node: largest
+        prefix (by the disruption-cost order) whose pods fit the survivors
+        plus ONE new node strictly cheaper than the prefix's aggregate
+        price. `device_verdicts` is the per-prefix batch already dispatched
+        for the deletion decision (replacement context included); the oracle
+        re-derives the replacement group before acting."""
+        for k in range(len(remaining), 1, -1):
+            prefix = remaining[:k]
+            if device_verdicts is not None:
+                v = device_verdicts.get(k)
+                if v is None or not self._device_replacement_cheaper_multi(prefix, v):
+                    continue
+            # the whole prefix drains behind one launch, so budget-check it
+            # as a unit: members from one pool count against that pool's
+            # budget cumulatively
+            trial = dict(disrupting)
+            ok_budget = True
+            for c in prefix:
+                if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, trial, totals):
+                    ok_budget = False
+                    break
+                trial[c.nodepool.name] = trial.get(c.nodepool.name, 0) + 1
+            if not ok_budget:
+                continue
+            ok, groups = self._simulate(prefix, allow_new_node=True)
+            if ok and groups and self._replacement_cheaper(prefix, groups):
+                for c in prefix:
+                    c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+                self._replace_then_disrupt(prefix, groups, REASON_UNDERUTILIZED, disrupting)
+                return
+
+    def _device_prefix_verdicts(self, remaining: List[Candidate]):
+        """k -> SetVerdict for every prefix (k = 2..N of the disruption-cost
+        order), judged in ONE device dispatch with replacement context --
+        serves both the deletion decision and the multi-node replacement
+        price gate. None when any pod is device-ineligible (the oracle
+        loops judge prefixes themselves)."""
+        if self.evaluator is None or len(remaining) < 2:
+            return None
+        from karpenter_tpu.solver.consolidate import device_eligible
+
+        resched = {
+            c.claim.metadata.name: [p for p in c.pods if p.reschedulable()]
+            for c in remaining
+        }
+        in_flight = self._in_flight_pods()
+        if not all(
+            device_eligible(resched[c.claim.metadata.name]) for c in remaining
+        ) or not device_eligible(in_flight):
+            return None
+        sets = []
+        ks = []
+        for k in range(2, len(remaining) + 1):
+            prefix = remaining[:k]
+            sets.append(
+                (
+                    in_flight + [p for c in prefix for p in resched[c.claim.metadata.name]],
+                    [c.node.metadata.name for c in prefix],
+                )
+            )
+            ks.append(k)
+        pools, catalogs = self._pool_context()
+        verdicts = self.evaluator.evaluate(
+            self._other_nodes(list(self._pass_disrupted)), sets,
+            pools=pools, catalogs=catalogs,
+        )
+        return dict(zip(ks, verdicts))
+
+    def _device_replacement_cheaper_multi(self, prefix: List[Candidate], v) -> bool:
+        import math
+
+        price = v.replace_price
+        if any(
+            c.claim.capacity_type == wk.CAPACITY_TYPE_SPOT for c in prefix
+        ) and not self.feature_gates.get("SpotToSpotConsolidation"):
+            price = v.replace_od_price
+        return math.isfinite(price) and price < sum(c.price for c in prefix)
+
+    def _largest_deletable_prefix(
+        self, remaining: List[Candidate], device_verdicts: Optional[Dict[int, object]] = None
+    ) -> List[Candidate]:
         """Largest k such that candidates[0:k] can all be deleted with their
-        pods repacked on surviving capacity. When every candidate is
-        device-eligible, all prefixes are judged in ONE batched dispatch
-        (solver/consolidate.py) instead of up to k-1 full simulations."""
+        pods repacked on surviving capacity. `device_verdicts` is the
+        per-prefix batch from _device_prefix_verdicts (one dispatch serves
+        deletion AND the replacement price gate); None falls back to the
+        oracle's descending-k simulation loop."""
         if len(remaining) < 2:
             return []
-        if self.evaluator is not None:
-            from karpenter_tpu.solver.consolidate import device_eligible
-
-            resched = {
-                c.claim.metadata.name: [p for p in c.pods if p.reschedulable()]
-                for c in remaining
-            }
-            in_flight = self._in_flight_pods()
-            if all(
-                device_eligible(resched[c.claim.metadata.name]) for c in remaining
-            ) and device_eligible(in_flight):
-                sets = []
-                for k in range(2, len(remaining) + 1):
-                    prefix = remaining[:k]
-                    sets.append(
-                        (
-                            in_flight
-                            + [p for c in prefix for p in resched[c.claim.metadata.name]],
-                            [c.node.metadata.name for c in prefix],
-                        )
-                    )
-                verdicts = self.evaluator.evaluate(
-                    self._other_nodes(list(self._pass_disrupted)), sets
-                )
-                for i in range(len(verdicts) - 1, -1, -1):  # largest k first
-                    if verdicts[i].can_delete:
-                        return remaining[: i + 2]
-                return []
+        if device_verdicts is not None:
+            for k in range(len(remaining), 1, -1):  # largest k first
+                v = device_verdicts.get(k)
+                if v is not None and v.can_delete:
+                    return remaining[:k]
+            return []
         k = len(remaining)
         while k >= 2:
             subset = remaining[:k]
@@ -471,25 +542,40 @@ class DisruptionController:
         except CloudError:
             return None
 
-    def _replacement_cheaper(self, c: Candidate, groups) -> bool:
-        """Replacement must be strictly cheaper; spot->spot consolidation is
-        feature-gated (reference gates SpotToSpotConsolidation)."""
+    def _replacement_cheaper(self, cands, groups) -> bool:
+        """Replacement must be strictly cheaper than the candidate set's
+        aggregate price; spot->spot consolidation is feature-gated
+        (reference gates SpotToSpotConsolidation). Accepts one Candidate or
+        a list (multi-node consolidation compares against the sum)."""
+        if isinstance(cands, Candidate):
+            cands = [cands]
         if not groups:
             return True
-        cheapest_new = min(min(it.cheapest_price() for it in g.instance_types) for g in groups)
-        if c.claim.capacity_type == wk.CAPACITY_TYPE_SPOT and not self.feature_gates.get("SpotToSpotConsolidation"):
-            # only consolidate spot into cheaper on-demand
-            od_prices = [
-                o.price
-                for g in groups
-                for it in g.instance_types
-                for o in it.available_offerings()
-                if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
-            ]
-            if not od_prices:
-                return False
-            cheapest_new = min(od_prices)
-        return cheapest_new < c.price
+        any_spot = any(c.claim.capacity_type == wk.CAPACITY_TYPE_SPOT for c in cands)
+        od_only = any_spot and not self.feature_gates.get("SpotToSpotConsolidation")
+
+        def group_price(g) -> float:
+            """Cheapest offering the group can actually LAUNCH: restricted
+            to the group's narrowed zone/captype requirements (a group whose
+            pods demand on-demand must not be priced at spot), and to
+            on-demand under the spot->spot gate."""
+            zreq = g.requirements.get(wk.ZONE_LABEL)
+            creq = g.requirements.get(wk.CAPACITY_TYPE_LABEL)
+            best = float("inf")
+            for it in g.instance_types:
+                for o in it.available_offerings():
+                    if zreq is not None and not zreq.matches(o.zone):
+                        continue
+                    if creq is not None and not creq.matches(o.capacity_type):
+                        continue
+                    if od_only and o.capacity_type != wk.CAPACITY_TYPE_ON_DEMAND:
+                        continue
+                    if o.price < best:
+                        best = o.price
+            return best
+
+        cheapest_new = min(group_price(g) for g in groups)
+        return cheapest_new < sum(c.price for c in cands)
 
     # -- execution ----------------------------------------------------------
     def _disrupt(self, c: Candidate, reason: str, disrupting: Dict[str, int]) -> None:
@@ -501,19 +587,23 @@ class DisruptionController:
         self.last_decisions.append((c.claim.metadata.name, reason))
         metrics.DISRUPTION_DECISIONS.inc(reason=reason)
 
-    def _replace_then_disrupt(self, c: Candidate, groups, reason: str, disrupting: Dict[str, int]) -> None:
+    def _replace_then_disrupt(self, cands, groups, reason: str, disrupting: Dict[str, int]) -> None:
         """Launch the replacement before draining (consolidation.md: delete
         the expensive node only 'when [the replacement] is ready'). If the
-        replacement launch fails (e.g. ICE at fleet time), the old node is
+        replacement launch fails (e.g. ICE at fleet time), the old nodes are
         KEPT -- disrupting without a live replacement is the capacity gap
-        this ordering exists to prevent."""
+        this ordering exists to prevent. Accepts one Candidate or a list
+        (multi-node consolidation drains the whole set behind one launch)."""
         from karpenter_tpu.controllers.provisioner import Provisioner
         from karpenter_tpu.solver.oracle import SchedulingResult
 
+        if isinstance(cands, Candidate):
+            cands = [cands]
         prov = Provisioner(self.cluster, self.cloud_provider)
         result = SchedulingResult()
         result.new_groups = list(groups)
         prov._launch(result)
         if result.unschedulable:
             return  # replacement did not materialize; try again next tick
-        self._disrupt(c, reason, disrupting)
+        for c in cands:
+            self._disrupt(c, reason, disrupting)

@@ -335,3 +335,141 @@ class TestPassStaleness:
             f"stale verdicts double-booked the survivor: {decisions}"
         )
         assert names[0] in ("cand-a", "cand-b")
+
+
+class TestMultiNodeReplacement:
+    """VERDICT round 2, item 6: N underutilized nodes collapse into ONE
+    strictly cheaper replacement node when pure deletion cannot repack
+    their pods (reference: designs/consolidation.md:5-36).
+
+    Economics use on-demand-restricted pods so prices are deterministic:
+    each candidate sits on the cheapest type fitting its own pod (single-
+    node replacement is never STRICTLY cheaper), pods cannot stack on each
+    other's node, and one bigger type undercuts the pair's aggregate."""
+
+    @staticmethod
+    def _mk_node(env, name, itype, pod_specs):
+        from karpenter_tpu.apis.nodeclaim import (
+            COND_INITIALIZED,
+            COND_LAUNCHED,
+            COND_REGISTERED,
+        )
+        from karpenter_tpu.scheduling import resources as res
+
+        catalog = env.cloud_provider.get_instance_types(env.cluster.get(NodePool, "default"))
+        it = next(i for i in catalog if i.name == itype)
+        alloc = it.allocatable()
+        claim = NodeClaim(name)
+        claim.metadata.labels[wk.NODEPOOL_LABEL] = "default"
+        claim.metadata.labels[wk.INSTANCE_TYPE_LABEL] = itype
+        claim.metadata.labels[wk.CAPACITY_TYPE_LABEL] = wk.CAPACITY_TYPE_ON_DEMAND
+        claim.metadata.labels[wk.ZONE_LABEL] = "us-central-1a"
+        claim.provider_id = f"tpu:///test/{name}"
+        for cond in (COND_LAUNCHED, COND_REGISTERED, COND_INITIALIZED):
+            claim.status_conditions.set_true(cond)
+        env.cluster.create(claim)
+        claim.metadata.creation_timestamp = env.clock.now() - (MIN_NODE_LIFETIME + 600)
+        node = Node(
+            name,
+            labels={
+                "kubernetes.io/hostname": name,
+                wk.ZONE_LABEL: "us-central-1a",
+                wk.NODEPOOL_LABEL: "default",
+            },
+            capacity=alloc,
+            allocatable=alloc,
+        )
+        node.provider_id = claim.provider_id
+        node.ready = True
+        env.cluster.create(node)
+        for pname, cpu_m, mem_mi in pod_specs:
+            p = Pod(
+                pname,
+                requests=Resources.from_base_units(
+                    {res.CPU: cpu_m, res.MEMORY: mem_mi * 2**20}
+                ),
+                node_selector={wk.CAPACITY_TYPE_LABEL: wk.CAPACITY_TYPE_ON_DEMAND},
+            )
+            env.cluster.create(p)
+            p.node_name = name
+            p.phase = "Running"
+        return claim
+
+    def _env(self, use_evaluator):
+        from karpenter_tpu.apis.nodepool import Budget
+        from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
+
+        op = Operator(
+            clock=FakeClock(100_000.0),
+            consolidation_evaluator=ConsolidationEvaluator() if use_evaluator else None,
+        )
+        op.cluster.create(TPUNodeClass("default"))
+        pool = NodePool("default")
+        pool.disruption.budgets = [Budget(nodes="100%")]
+        op.cluster.create(pool)
+        op.settle(max_ticks=5)  # hydrate the nodeclass so catalogs resolve
+        ctl = DisruptionController(
+            op.cluster,
+            op.cloud_provider,
+            op.pricing,
+            op.options.feature_gates,
+            evaluator=ConsolidationEvaluator() if use_evaluator else None,
+        )
+        return op, ctl
+
+    @pytest.mark.parametrize("use_evaluator", [False, True])
+    def test_two_nodes_collapse_into_one_cheaper(self, use_evaluator):
+        op, ctl = self._env(use_evaluator)
+        # t4g.large ($0.0439 OD) nodes, one 900m/3500Mi pod each: memory
+        # blocks stacking (2x3500Mi > 6804Mi) and no cheaper single fits one
+        # pod; t4g.xlarge ($0.0877) holds both for less than 2 x $0.0439
+        self._mk_node(op, "exp-a", "t4g.large", [("pa", 900, 3500)])
+        self._mk_node(op, "exp-b", "t4g.large", [("pb", 900, 3500)])
+        decisions = ctl.reconcile(max_disruptions=5)
+        names = sorted(n for n, _ in decisions)
+        assert names == ["exp-a", "exp-b"], decisions
+        assert all(r == "Underutilized" for _, r in decisions)
+        # one replacement claim was launched before draining the pair
+        live = [c for c in op.cluster.list(NodeClaim) if not c.deleting]
+        assert len(live) == 1, [c.metadata.name for c in op.cluster.list(NodeClaim)]
+        repl_price, ok = op.pricing.on_demand_price(live[0].instance_type)
+        assert ok and repl_price < 2 * 0.0439, (live[0].instance_type, repl_price)
+
+    @pytest.mark.parametrize("use_evaluator", [False, True])
+    def test_no_collapse_when_replacement_not_cheaper(self, use_evaluator):
+        op, ctl = self._env(use_evaluator)
+        # t4g.medium ($0.0219) nodes, one 700m/2800Mi pod each: the cheapest
+        # type holding both is t4g.large ($0.0439) > 2 x $0.0219 aggregate
+        self._mk_node(op, "cheap-a", "t4g.medium", [("pa", 700, 2800)])
+        self._mk_node(op, "cheap-b", "t4g.medium", [("pb", 700, 2800)])
+        decisions = ctl.reconcile(max_disruptions=5)
+        assert decisions == [], decisions
+        assert all(not c.deleting for c in op.cluster.list(NodeClaim))
+
+    @pytest.mark.parametrize("use_evaluator", [False, True])
+    def test_budget_blocks_pair_drain(self, use_evaluator):
+        """The prefix drains as a unit: a nodes=1 budget must refuse a
+        2-node replacement (members count cumulatively per pool)."""
+        from karpenter_tpu.apis.nodepool import Budget
+        from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
+
+        op = Operator(
+            clock=FakeClock(100_000.0),
+            consolidation_evaluator=ConsolidationEvaluator() if use_evaluator else None,
+        )
+        op.cluster.create(TPUNodeClass("default"))
+        pool = NodePool("default")
+        pool.disruption.budgets = [Budget(nodes="1")]
+        op.cluster.create(pool)
+        op.settle(max_ticks=5)
+        ctl = DisruptionController(
+            op.cluster,
+            op.cloud_provider,
+            op.pricing,
+            op.options.feature_gates,
+            evaluator=ConsolidationEvaluator() if use_evaluator else None,
+        )
+        self._mk_node(op, "exp-a", "t4g.large", [("pa", 900, 3500)])
+        self._mk_node(op, "exp-b", "t4g.large", [("pb", 900, 3500)])
+        decisions = ctl.reconcile(max_disruptions=5)
+        assert decisions == [], decisions
