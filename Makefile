@@ -10,10 +10,11 @@ PYTEST ?= $(PY) -m pytest
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
 
-deflake:  ## randomized order, repeated until it fails (race hunting)
+deflake:  ## shuffled test order (fresh seed per round), repeated (race hunting)
 	@for i in 1 2 3 4 5; do \
-		echo "deflake round $$i"; \
-		$(PYTEST) tests/ -q -p no:cacheprovider -o addopts= --maxfail=1 || exit 1; \
+		seed=$$(python -c "import random; print(random.randrange(1 << 31))"); \
+		echo "deflake round $$i (PYTEST_SHUFFLE_SEED=$$seed)"; \
+		PYTEST_SHUFFLE_SEED=$$seed $(PYTEST) tests/ -q -p no:cacheprovider -o addopts= --maxfail=1 || exit 1; \
 	done
 
 benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)

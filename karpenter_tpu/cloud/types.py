@@ -173,6 +173,9 @@ class CloudInstance:
     provider_id: str = ""
     nic_count: int = 0
     security_group_ids: List[str] = field(default_factory=list)
+    # fault injection (kwok rig): a degraded-but-running instance surfaces
+    # this condition type as False on its Node (repair-path exercise)
+    impaired_condition: str = ""
 
     def __post_init__(self):
         if not self.provider_id:

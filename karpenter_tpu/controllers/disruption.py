@@ -40,6 +40,7 @@ from karpenter_tpu.apis.nodeclaim import COND_CONSOLIDATABLE, COND_DRIFTED, COND
 from karpenter_tpu.cloudprovider import CloudProvider
 from karpenter_tpu.errors import CloudError
 from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.logging import get_logger
 from karpenter_tpu.scheduling import Resources
 from karpenter_tpu.scheduling import resources as res
 from karpenter_tpu.solver.oracle import ExistingNode, Scheduler
@@ -63,6 +64,8 @@ class Candidate:
 
 
 class DisruptionController:
+    log = get_logger("disruption")
+
     def __init__(
         self,
         cluster: Cluster,
@@ -586,6 +589,13 @@ class DisruptionController:
         disrupting[c.nodepool.name] = disrupting.get(c.nodepool.name, 0) + 1
         self.last_decisions.append((c.claim.metadata.name, reason))
         metrics.DISRUPTION_DECISIONS.inc(reason=reason)
+        self.log.info(
+            "disrupting node",
+            nodeclaim=c.claim.metadata.name,
+            nodepool=c.nodepool.name,
+            reason=reason,
+            pods=len(c.pods),
+        )
 
     def _replace_then_disrupt(self, cands, groups, reason: str, disrupting: Dict[str, int]) -> None:
         """Launch the replacement before draining (consolidation.md: delete

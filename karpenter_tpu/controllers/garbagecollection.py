@@ -16,11 +16,14 @@ from karpenter_tpu import metrics
 from karpenter_tpu.cloudprovider import CloudProvider
 from karpenter_tpu.errors import NotFoundError
 from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.logging import get_logger
 
 LAUNCH_GRACE = 60.0
 
 
 class GarbageCollectionController:
+    log = get_logger("garbagecollection")
+
     def __init__(self, cluster: Cluster, cloud_provider: CloudProvider):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -42,6 +45,7 @@ class GarbageCollectionController:
                 # reservation bookkeeping
                 self.cloud_provider.instances.delete(inst.id)
                 removed.append(inst.id)
+                self.log.info("garbage-collected orphan instance", instance=inst.id)
                 from karpenter_tpu import metrics
 
                 metrics.GARBAGE_COLLECTED.inc()

@@ -1,101 +1,92 @@
 """Interruption controller: queue events -> node drain.
 
-Rebuilds pkg/controllers/interruption/controller.go:96-248 + parser.go +
-messages/: polls the interruption queue, parses the five message kinds
-(spot interruption, scheduled maintenance/health change, instance state
-change, rebalance recommendation, noop), marks spot capacity unavailable in
-the ICE cache so the scheduler routes around it
-(:219-225), deletes the affected NodeClaim (cordon-and-drain), and deletes
-the message. Parsing fans out over a worker pool in the reference (:119);
-here messages are processed in one synchronous sweep per reconcile with the
-same per-message isolation (a bad message never blocks the batch).
+Rebuilds pkg/controllers/interruption/controller.go:96-248: polls the
+interruption queue, parses each body through the (version, source,
+detail-type)-keyed EventBridge parser registry
+(interruption_messages.EventParser -- the five kinds: spot interruption,
+scheduled health change, instance stopped, instance terminated, rebalance
+recommendation, plus no-op), marks reclaimed spot capacity unavailable in
+the ICE cache so the scheduler routes around it (controller.go:219-225),
+deletes the affected NodeClaim (cordon-and-drain), and deletes the message.
+
+Messages fan out over a worker pool exactly as the reference's
+workqueue.ParallelizeUntil(ctx, 10, ...) (controller.go:119); the in-memory
+cluster is lock-protected, and each worker keeps per-message isolation (a
+bad message never blocks the batch).
 """
 from __future__ import annotations
 
-import json
-from dataclasses import dataclass
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
-from karpenter_tpu.apis import NodeClaim, Node, labels as wk
+from karpenter_tpu.apis import NodeClaim, labels as wk
 from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
 from karpenter_tpu import metrics
 from karpenter_tpu.events import Recorder, WARNING
 from karpenter_tpu.cloud.api import QueueAPI
+from karpenter_tpu.controllers.interruption_messages import (
+    KIND_NOOP,
+    KIND_REBALANCE_RECOMMENDATION,
+    KIND_SPOT_INTERRUPTED,
+    EventParser,
+    Message,
+)
 from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.logging import get_logger
 
-KIND_SPOT_INTERRUPTION = "spot-interruption"
-KIND_SCHEDULED_CHANGE = "scheduled-change"
-KIND_STATE_CHANGE = "state-change"
-KIND_REBALANCE = "rebalance-recommendation"
-KIND_NOOP = "noop"
-
-# state-change states that warrant replacing the node
-_TERMINAL_STATES = {"stopping", "stopped", "shutting-down", "terminated"}
-
-
-@dataclass
-class ParsedMessage:
-    kind: str
-    instance_id: str = ""
-    zone: str = ""
-    state: str = ""
-
-
-def parse_message(body: str) -> ParsedMessage:
-    """Message taxonomy (reference: parser.go:1-93 + messages/*): unknown
-    shapes degrade to noop rather than erroring the batch."""
-    try:
-        doc = json.loads(body)
-    except (json.JSONDecodeError, TypeError):
-        return ParsedMessage(kind=KIND_NOOP)
-    kind = doc.get("kind", "")
-    instance_id = doc.get("instance_id", "")
-    if kind == KIND_SPOT_INTERRUPTION and instance_id:
-        return ParsedMessage(KIND_SPOT_INTERRUPTION, instance_id, doc.get("zone", ""))
-    if kind == KIND_SCHEDULED_CHANGE and instance_id:
-        return ParsedMessage(KIND_SCHEDULED_CHANGE, instance_id)
-    if kind == KIND_STATE_CHANGE and instance_id:
-        return ParsedMessage(KIND_STATE_CHANGE, instance_id, state=doc.get("state", ""))
-    if kind == KIND_REBALANCE and instance_id:
-        return ParsedMessage(KIND_REBALANCE, instance_id)
-    return ParsedMessage(kind=KIND_NOOP)
+PARALLELISM = 10  # reference: workqueue.ParallelizeUntil(ctx, 10, ...)
 
 
 class InterruptionController:
+    log = get_logger("interruption")
+
     def __init__(
         self,
         cluster: Cluster,
         queue: QueueAPI,
         unavailable: UnavailableOfferings,
         recorder: Optional[Recorder] = None,
+        parser: Optional[EventParser] = None,
     ):
         self.cluster = cluster
         self.queue = queue
         self.unavailable = unavailable
         self.recorder = recorder or Recorder()
+        self.parser = parser or EventParser()
+        # serializes the deleting-check + delete + count: two workers
+        # handling duplicate events for one instance must terminate (and
+        # count) the claim exactly once
+        self._drain_lock = threading.Lock()
 
     def reconcile(self, max_messages: int = 10) -> int:
         """One poll sweep; returns messages handled. The reference requeues
         immediately while messages remain (:114-136); callers loop."""
         handled = 0
-        while True:
-            batch = self.queue.receive(max_messages)
-            if not batch:
-                return handled
-            for msg in batch:
-                parsed = parse_message(msg.body)
-                metrics.INTERRUPTION_RECEIVED.inc(kind=parsed.kind)
-                try:
-                    self._handle(parsed)
-                except Exception as e:  # noqa: BLE001 -- per-message isolation:
-                    # one bad message must not strand the rest of the batch
-                    self.recorder.publish(
-                        ParsedMessage(parsed.kind), "InterruptionHandlingFailed", str(e), type=WARNING
-                    )
-                finally:
-                    self.queue.delete(msg.receipt)
-                    metrics.INTERRUPTION_DELETED.inc()
-                handled += 1
+        with ThreadPoolExecutor(max_workers=PARALLELISM) as pool:
+            while True:
+                batch = self.queue.receive(max_messages)
+                if not batch:
+                    return handled
+                list(pool.map(self._process, batch))
+                handled += len(batch)
+
+    def _process(self, msg) -> None:
+        parsed = None
+        try:
+            # parsing stays INSIDE the isolation boundary: a pathological
+            # body must neither strand the batch nor leave the message
+            # undeleted (the contract the module docstring promises)
+            parsed = self.parser.parse(msg.body)
+            metrics.INTERRUPTION_RECEIVED.inc(kind=parsed.kind)
+            self._handle(parsed)
+        except Exception as e:  # noqa: BLE001 -- per-message isolation
+            self.recorder.publish(
+                parsed, "InterruptionHandlingFailed", str(e), type=WARNING
+            )
+        finally:
+            self.queue.delete(msg.receipt)
+            metrics.INTERRUPTION_DELETED.inc()
 
     # -- handling -----------------------------------------------------------
     def _claim_for_instance(self, instance_id: str) -> Optional[NodeClaim]:
@@ -105,29 +96,43 @@ class InterruptionController:
                 return claim
         return None
 
-    def _handle(self, parsed: ParsedMessage) -> None:
+    def _handle(self, parsed: Message) -> None:
         if parsed.kind == KIND_NOOP:
             return
-        claim = self._claim_for_instance(parsed.instance_id)
-        if claim is None:
-            return
-        if parsed.kind == KIND_STATE_CHANGE and parsed.state not in _TERMINAL_STATES:
-            return
-        if parsed.kind == KIND_REBALANCE:
-            # advisory only: record, do not disrupt (reference treats
-            # rebalance recommendations as events unless configured)
-            self.recorder.publish(claim, "RebalanceRecommendation", "capacity may be reclaimed soon")
-            return
-        if parsed.kind == KIND_SPOT_INTERRUPTION:
-            # the pool is being reclaimed: negative-cache it so the
-            # scheduler stops offering this (type, zone, spot) pool (:219-225)
-            itype = claim.instance_type
-            zone = parsed.zone or claim.zone
-            if itype and zone:
-                self.unavailable.mark_unavailable(itype, zone, wk.CAPACITY_TYPE_SPOT, reason="SpotInterruption")
-        self.recorder.publish(claim, "Interrupted", f"{parsed.kind} for {parsed.instance_id}", type=WARNING)
-        if not claim.deleting:
-            self.cluster.delete(NodeClaim, claim.metadata.name)
-            metrics.NODECLAIMS_TERMINATED.inc(
-                nodepool=claim.nodepool_name or "", reason="interruption"
+        for instance_id in parsed.instance_ids:
+            claim = self._claim_for_instance(instance_id)
+            if claim is None:
+                continue
+            if parsed.kind == KIND_REBALANCE_RECOMMENDATION:
+                # advisory only: record, do not disrupt (reference treats
+                # rebalance recommendations as events unless configured)
+                self.recorder.publish(
+                    claim, "RebalanceRecommendation", "capacity may be reclaimed soon"
+                )
+                continue
+            if parsed.kind == KIND_SPOT_INTERRUPTED:
+                # the pool is being reclaimed: negative-cache it so the
+                # scheduler stops offering this (type, zone, spot) pool
+                # (controller.go:219-225)
+                itype = claim.instance_type
+                zone = claim.zone
+                if itype and zone:
+                    self.unavailable.mark_unavailable(
+                        itype, zone, wk.CAPACITY_TYPE_SPOT, reason="SpotInterruption"
+                    )
+            self.recorder.publish(
+                claim, "Interrupted", f"{parsed.kind} for {instance_id}", type=WARNING
             )
+            with self._drain_lock:
+                if claim.deleting:
+                    continue
+                self.cluster.delete(NodeClaim, claim.metadata.name)
+                metrics.NODECLAIMS_TERMINATED.inc(
+                    nodepool=claim.nodepool_name or "", reason="interruption"
+                )
+                self.log.info(
+                    "interruption drain",
+                    nodeclaim=claim.metadata.name,
+                    kind=parsed.kind,
+                    instance=instance_id,
+                )

@@ -12,6 +12,7 @@ from typing import Dict, Tuple
 from karpenter_tpu.apis import NodeClaim, labels as wk
 from karpenter_tpu import metrics
 from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.logging import get_logger
 
 INSTANCE_INFO = metrics.REGISTRY.gauge(
     "karpenter_cloudprovider_instance_info",
@@ -21,6 +22,8 @@ INSTANCE_INFO = metrics.REGISTRY.gauge(
 
 
 class MetricsController:
+    log = get_logger("metrics")
+
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
         self._series: Dict[str, Tuple] = {}  # claim name -> label values

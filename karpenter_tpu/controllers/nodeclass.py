@@ -34,11 +34,14 @@ from karpenter_tpu.kwok.cluster import Cluster
 from karpenter_tpu.providers.image import ImageProvider
 from karpenter_tpu.providers.securitygroup import SecurityGroupProvider
 from karpenter_tpu.providers.subnet import SubnetProvider
+from karpenter_tpu.logging import ChangeMonitor, get_logger
 
 TERMINATION_FINALIZER = "karpenter.tpu/termination"
 
 
 class NodeClassController:
+    log = get_logger("nodeclass")
+
     def __init__(
         self,
         cluster: Cluster,
@@ -55,6 +58,8 @@ class NodeClassController:
     ):
         from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
 
+        self.monitor = ChangeMonitor()  # per-instance: dedup state must not
+        # leak across operators (tests, in-process restarts)
         self.cluster = cluster
         self.compute_api = compute_api
         self.identity_api = identity_api
@@ -94,6 +99,10 @@ class NodeClassController:
         self._reconcile_instance_profile(nc)
         self._reconcile_validation(nc)
         nc.status_conditions.compute_root(NODECLASS_CONDITIONS)
+        ready = nc.status_conditions.is_true(nc.status_conditions.READY)
+        # readiness transitions log once per flip (ChangeMonitor dedup)
+        if self.monitor.has_changed(("ready", nc.metadata.name), ready):
+            self.log.info("nodeclass readiness", nodeclass=nc.metadata.name, ready=ready)
         self.cluster.update(nc)
 
     # -- chain stages -------------------------------------------------------

@@ -19,6 +19,7 @@ from typing import Optional
 from karpenter_tpu.apis import NodeClaim, Node, TPUNodeClass, labels as wk
 from karpenter_tpu.cache.ttl import Clock
 from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.logging import ChangeMonitor, get_logger
 
 REFRESH_INTERVAL = 12 * 3600.0
 
@@ -38,28 +39,42 @@ class _Periodic:
 
 
 class InstanceTypeRefreshController(_Periodic):
+    log = get_logger("providers.instancetype")
+
     def __init__(self, provider, clock: Clock, interval: float = REFRESH_INTERVAL):
         super().__init__(clock, interval)
         self.provider = provider
+        self.monitor = ChangeMonitor()  # per-instance dedup state
 
     def reconcile(self) -> bool:
         if not self.due():
             return False
         self.provider.update_instance_types()
         self.provider.update_instance_type_offerings()
+        # log only when the catalog actually changed (reference dedupes the
+        # same message with a ChangeMonitor, instancetype.go:267-271)
+        seq = getattr(self.provider, "seqnum", None)
+        if self.monitor.has_changed("catalog", seq):
+            self.log.info("instance types updated", seqnum=seq)
         return True
 
 
 class PricingRefreshController(_Periodic):
+    log = get_logger("providers.pricing")
+
     def __init__(self, pricing, clock: Clock, interval: float = REFRESH_INTERVAL):
         super().__init__(clock, interval)
         self.pricing = pricing
+        self.monitor = ChangeMonitor()  # per-instance dedup state
 
     def reconcile(self) -> bool:
         if not self.due():
             return False
         self.pricing.update_on_demand_pricing()
         self.pricing.update_spot_pricing()
+        snapshot = self.pricing.snapshot_hash() if hasattr(self.pricing, "snapshot_hash") else None
+        if self.monitor.has_changed("pricing", snapshot):
+            self.log.info("pricing updated")
         return True
 
 

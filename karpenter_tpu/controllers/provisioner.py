@@ -15,6 +15,7 @@ import time
 
 from karpenter_tpu.apis import NodeClaim, NodePool, Node, labels as wk
 from karpenter_tpu import metrics
+from karpenter_tpu.logging import get_logger
 from karpenter_tpu.apis.nodeclass import HASH_ANNOTATION, HASH_VERSION, HASH_VERSION_ANNOTATION, TPUNodeClass
 from karpenter_tpu.apis.objects import generate_name
 from karpenter_tpu.cloudprovider import CloudProvider
@@ -30,6 +31,8 @@ TERMINATION_FINALIZER = "karpenter.sh/termination"
 
 
 class Provisioner:
+    log = get_logger("provisioner")
+
     def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, solver=None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -114,6 +117,14 @@ class Provisioner:
             result = scheduler.schedule(pods)
         metrics.SCHEDULING_DURATION.observe(time.perf_counter() - t0)
         metrics.IGNORED_PODS.set(len(result.unschedulable))
+        if result.new_groups or result.unschedulable:
+            self.log.info(
+                "scheduling decision",
+                pods=len(pods),
+                new_groups=len(result.new_groups),
+                bound_existing=len(result.existing_assignments),
+                unschedulable=len(result.unschedulable),
+            )
         self._launch(result)
         self.last_result = result
         return result

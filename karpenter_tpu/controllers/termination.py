@@ -18,12 +18,15 @@ from karpenter_tpu.cloudprovider import CloudProvider
 from karpenter_tpu.errors import NotFoundError
 from karpenter_tpu.kwok.cluster import Cluster
 from karpenter_tpu.scheduling import Taint
+from karpenter_tpu.logging import get_logger
 
 TERMINATION_FINALIZER = "karpenter.sh/termination"
 DISRUPTED_TAINT = Taint("karpenter.sh/disrupted", effect="NoSchedule")
 
 
 class TerminationController:
+    log = get_logger("termination")
+
     def __init__(self, cluster: Cluster, cloud_provider: CloudProvider):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -74,3 +77,4 @@ class TerminationController:
             self.cluster.delete(Node, node.metadata.name)
         self.cluster.remove_finalizer(claim, TERMINATION_FINALIZER)
         self._drain_started.pop(claim.metadata.name, None)
+        self.log.info("terminated node", nodeclaim=claim.metadata.name)

@@ -462,6 +462,18 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
             inst.state = "terminated"
             return True
 
+    def degrade_instance(self, instance_id: str, condition: str = "Ready") -> bool:
+        """Leave the instance RUNNING but unhealthy: its Node reports
+        `condition`=False until replaced -- the auto-repair path (dead
+        instances take the GC path instead; the reference kwok kill thread
+        exercises both)."""
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst is None or inst.state == "terminated":
+                return False
+            inst.impaired_condition = condition
+            return True
+
     # -- checkpoint/restore (ec2.go:118-251) --------------------------------
     def checkpoint(self) -> str:
         with self._lock:

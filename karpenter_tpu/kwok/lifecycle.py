@@ -46,6 +46,7 @@ class NodeLifecycle:
         now = self.cluster.clock.now()
         self._register_nodes(now)
         self._initialize_nodes(now)
+        self._propagate_impairments()
         self._reap_dead_instances()
 
     # -- registration -------------------------------------------------------
@@ -94,6 +95,28 @@ class NodeLifecycle:
             self.cluster.update(claim)
 
     # -- failure propagation ------------------------------------------------
+    def _propagate_impairments(self) -> None:
+        """A degraded-but-running instance (FakeCloud.degrade_instance)
+        surfaces its condition as False on the Node -- the kubelet/agent
+        health reporting the auto-repair controller consumes. The node also
+        stops accepting new pods (NotReady)."""
+        impaired = {
+            i.provider_id: i.impaired_condition
+            for i in self.cloud.describe_instances()
+            if i.impaired_condition and i.state in ("pending", "running")
+        }
+        if not impaired:
+            return
+        for node in self.cluster.list(Node):
+            cond = impaired.get(node.provider_id)
+            if cond and (node.ready or not node.status_conditions.is_false(cond)):
+                # guard on the actual transition: unconditional updates
+                # would emit a MODIFIED event per node per tick for the
+                # whole toleration window
+                node.status_conditions.set_false(cond, "InstanceImpaired")
+                node.ready = False
+                self.cluster.update(node)
+
     def _reap_dead_instances(self) -> None:
         live = {i.provider_id for i in self.cloud.describe_instances() if i.state in ("pending", "running")}
         for node in self.cluster.list(Node):

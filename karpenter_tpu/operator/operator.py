@@ -30,6 +30,7 @@ from karpenter_tpu.controllers.providers import (
     VersionController,
 )
 from karpenter_tpu.controllers.provisioner import PodBinder, Provisioner
+from karpenter_tpu.controllers.repair import NodeRepairController
 from karpenter_tpu.controllers.tagging import TaggingController
 from karpenter_tpu.controllers.termination import TerminationController
 from karpenter_tpu.events import Recorder
@@ -144,6 +145,7 @@ class Operator:
             self.cluster, self.queue, self.unavailable, self.recorder
         )
         self.garbage_collection = GarbageCollectionController(self.cluster, self.cloud_provider)
+        self.repair = NodeRepairController(self.cluster, self.cloud_provider, self.recorder)
         self.tagging = TaggingController(self.cluster, self.cloud_provider)
         self.instance_type_refresh = InstanceTypeRefreshController(self.instance_types, self.clock)
         self.pricing_refresh = PricingRefreshController(self.pricing, self.clock)
@@ -181,6 +183,7 @@ class Operator:
         self.capacity_type_controller.reconcile_all()
         self.reservation_expiration.reconcile_all()
         self.interruption.reconcile()
+        self.repair.reconcile()
         self.provisioner.reconcile()
         self.lifecycle.step()
         self.binder.reconcile()

@@ -21,6 +21,7 @@ import jax
 import numpy as np
 
 from karpenter_tpu.apis import NodePool, Pod, labels as wk
+from karpenter_tpu.logging import ChangeMonitor, get_logger
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resources
 from karpenter_tpu.scheduling import resources as res
 from karpenter_tpu.solver import encode, ffd
@@ -32,6 +33,8 @@ _bucket = encode.bucket
 
 
 class TPUSolver:
+    log = get_logger("solver")
+
     def __init__(
         self, g_max: int = 1024, c_pad_min: int = 16, client=None, use_pallas: bool = False,
         objective: str = "price",
@@ -41,6 +44,7 @@ class TPUSolver:
         # 377 for 50k pods)
         self.g_max = g_max
         self.c_pad_min = c_pad_min
+        self._route_monitor = ChangeMonitor()  # per-instance dedup state
         # packing objective: "price" opens groups sized to the min
         # price-per-pod type (BASELINE.json configs 3-4); "fit" is the
         # legacy max-pods-per-node objective. The oracle mirrors both.
@@ -160,6 +164,8 @@ class TPUSolver:
             # the fallback must pack with THIS solver's objective -- callers
             # construct the Scheduler without one, and a mixed-objective
             # pass would break device/oracle differential equivalence
+            if self._route_monitor.has_changed("route", "oracle"):
+                self.log.info("routing to oracle", pods=len(pods), reason="unsupported constraints")
             scheduler.objective = self.objective
             return scheduler.schedule(pods)
         # pools in weight order, first-feasible-pool-wins: each pool's batch

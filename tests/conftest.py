@@ -19,3 +19,14 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_collection_modifyitems(config, items):
+    """Deterministic test-order shuffling for race/ordering-dependency
+    hunting: `make deflake` exports PYTEST_SHUFFLE_SEED with a fresh seed
+    per round (the reference's ginkgo --randomize-all)."""
+    seed = os.environ.get("PYTEST_SHUFFLE_SEED")
+    if seed:
+        import random
+
+        random.Random(int(seed)).shuffle(items)

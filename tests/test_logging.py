@@ -1,0 +1,117 @@
+"""Structured JSON logging + ChangeMonitor dedup (VERDICT round 2, item 9).
+
+The reference logs zap JSON with ChangeMonitor suppression
+(pkg/providers/instancetype/instancetype.go:267-271); here every controller
+carries a `karpenter.*` structured logger and repeat messages dedupe by
+value change.
+"""
+import io
+import json
+import logging as pylogging
+import pathlib
+
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.logging import ChangeMonitor, configure, get_logger
+
+
+def capture():
+    buf = io.StringIO()
+    configure(stream=buf, level=pylogging.DEBUG)
+    return buf
+
+
+class TestJSONOutput:
+    def test_one_json_object_per_line_with_fields(self):
+        buf = capture()
+        log = get_logger("testctl")
+        log.info("launched node group", nodepool="default", pods=12)
+        log.warning("drift detected", nodeclaim="n-1")
+        lines = [l for l in buf.getvalue().splitlines() if l]
+        assert len(lines) == 2
+        doc = json.loads(lines[0])
+        assert doc["msg"] == "launched node group"
+        assert doc["logger"] == "karpenter.testctl"
+        assert doc["level"] == "INFO"
+        assert doc["nodepool"] == "default" and doc["pods"] == 12
+        assert "ts" in doc
+        doc2 = json.loads(lines[1])
+        assert doc2["level"] == "WARNING" and doc2["nodeclaim"] == "n-1"
+
+    def test_unserializable_fields_degrade_to_repr(self):
+        buf = capture()
+        get_logger("testctl").info("odd", obj=object())
+        doc = json.loads(buf.getvalue().strip())
+        assert doc["obj"].startswith("<object object")
+
+
+class TestChangeMonitor:
+    def test_dedupes_same_value(self):
+        clock = FakeClock(0.0)
+        m = ChangeMonitor(ttl_seconds=3600.0, clock=clock)
+        assert m.has_changed("catalog", "v1")
+        assert not m.has_changed("catalog", "v1")
+        assert not m.has_changed("catalog", "v1")
+        # a different value logs again
+        assert m.has_changed("catalog", "v2")
+        assert not m.has_changed("catalog", "v2")
+        # flapping back also logs (value changed)
+        assert m.has_changed("catalog", "v1")
+
+    def test_keys_independent(self):
+        m = ChangeMonitor(clock=FakeClock(0.0))
+        assert m.has_changed("a", 1)
+        assert m.has_changed("b", 1)
+        assert not m.has_changed("a", 1)
+
+    def test_ttl_relogs_steady_state(self):
+        clock = FakeClock(0.0)
+        m = ChangeMonitor(ttl_seconds=100.0, clock=clock)
+        assert m.has_changed("k", "same")
+        clock.step(99.0)
+        assert not m.has_changed("k", "same")
+        clock.step(2.0)
+        assert m.has_changed("k", "same")
+
+
+class TestControllersCarryLoggers:
+    def test_every_controller_module_has_a_logger(self):
+        """The grep the VERDICT asked for, as a test: every controller
+        module under karpenter_tpu/controllers/ constructs a structured
+        logger (interruption_messages is a schema module, exempt)."""
+        root = pathlib.Path(__file__).resolve().parent.parent / "karpenter_tpu" / "controllers"
+        exempt = {"__init__.py", "interruption_messages.py"}
+        missing = []
+        for path in sorted(root.glob("*.py")):
+            if path.name in exempt:
+                continue
+            if "get_logger(" not in path.read_text():
+                missing.append(path.name)
+        assert not missing, f"controllers without structured loggers: {missing}"
+
+    def test_controller_logs_are_json(self):
+        """A real controller action produces a parseable JSON log line:
+        drive the repair controller end-to-end and capture its output."""
+        import os
+
+        buf = capture()
+        from karpenter_tpu.apis import NodeClaim, NodePool, Pod, TPUNodeClass
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.scheduling import Resources
+        from karpenter_tpu.utils import parse_instance_id
+
+        op = Operator(clock=FakeClock(100_000.0))
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        op.settle(max_ticks=30)
+        claim = op.cluster.list(NodeClaim)[0]
+        op.cloud.degrade_instance(parse_instance_id(claim.provider_id))
+        op.lifecycle.step()
+        op.repair.reconcile()
+        op.clock.step(31 * 60.0)
+        assert op.repair.reconcile() == 1
+        lines = [json.loads(l) for l in buf.getvalue().splitlines() if l]
+        repair_lines = [d for d in lines if d["logger"] == "karpenter.repair"]
+        assert repair_lines, [d["logger"] for d in lines]
+        assert repair_lines[0]["condition"] == "Ready"
+        assert repair_lines[0]["nodeclaim"] == claim.metadata.name

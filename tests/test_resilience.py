@@ -9,7 +9,15 @@ from karpenter_tpu.apis import NodeClaim, NodePool, Node, Pod, TPUNodeClass, lab
 from karpenter_tpu.apis.nodeclass import SelectorTerm
 from karpenter_tpu.cache.ttl import FakeClock
 from karpenter_tpu.cloud.types import CapacityReservationInfo
-from karpenter_tpu.controllers.interruption import parse_message
+from karpenter_tpu.controllers.interruption_messages import (
+    DETAIL_HEALTH_EVENT,
+    DETAIL_REBALANCE,
+    DETAIL_SPOT_INTERRUPTION,
+    DETAIL_STATE_CHANGE,
+    SOURCE_COMPUTE,
+    SOURCE_HEALTH,
+    EventParser,
+)
 from karpenter_tpu.operator import Operator
 from karpenter_tpu.scheduling import Resources
 from karpenter_tpu.utils import parse_instance_id
@@ -33,16 +41,96 @@ def provision(env, n=1, cpu="500m"):
     return pods
 
 
+def spot_msg(iid):
+    return json.dumps({
+        "version": "0", "source": SOURCE_COMPUTE,
+        "detail-type": DETAIL_SPOT_INTERRUPTION,
+        "id": "evt-1", "region": "us-central-1",
+        "detail": {"instance-id": iid, "instance-action": "terminate"},
+    })
+
+
+def state_msg(iid, state):
+    return json.dumps({
+        "version": "1", "source": SOURCE_COMPUTE,
+        "detail-type": DETAIL_STATE_CHANGE,
+        "detail": {"instance-id": iid, "state": state},
+    })
+
+
+def health_msg(ids):
+    return json.dumps({
+        "version": "0", "source": SOURCE_HEALTH,
+        "detail-type": DETAIL_HEALTH_EVENT,
+        "detail": {
+            "service": "COMPUTE", "eventTypeCategory": "scheduledChange",
+            "eventTypeCode": "CLOUD_COMPUTE_MAINTENANCE_SCHEDULED",
+            "affectedEntities": [{"entityValue": i} for i in ids],
+        },
+    })
+
+
+def rebalance_msg(iid):
+    return json.dumps({
+        "version": "0", "source": SOURCE_COMPUTE,
+        "detail-type": DETAIL_REBALANCE,
+        "detail": {"instance-id": iid},
+    })
+
+
 class TestMessageParsing:
-    def test_five_kinds(self):
-        assert parse_message(json.dumps({"kind": "spot-interruption", "instance_id": "i-1", "zone": "z"})).kind == "spot-interruption"
-        assert parse_message(json.dumps({"kind": "scheduled-change", "instance_id": "i-1"})).kind == "scheduled-change"
-        p = parse_message(json.dumps({"kind": "state-change", "instance_id": "i-1", "state": "stopping"}))
-        assert p.kind == "state-change" and p.state == "stopping"
-        assert parse_message(json.dumps({"kind": "rebalance-recommendation", "instance_id": "i-1"})).kind == "rebalance-recommendation"
-        assert parse_message("not json").kind == "noop"
-        assert parse_message(json.dumps({"kind": "mystery"})).kind == "noop"
-        assert parse_message(json.dumps({"kind": "spot-interruption"})).kind == "noop"  # no instance
+    """Parser-per-kind over the five real EventBridge-shaped bodies
+    (reference parser.go:1-93 + messages/)."""
+
+    def test_spot_interruption(self):
+        m = EventParser().parse(spot_msg("i-1"))
+        assert m.kind == "spot_interrupted" and m.instance_ids == ["i-1"]
+
+    def test_state_change_kinds(self):
+        p = EventParser()
+        assert p.parse(state_msg("i-1", "stopping")).kind == "instance_stopped"
+        assert p.parse(state_msg("i-1", "stopped")).kind == "instance_stopped"
+        assert p.parse(state_msg("i-1", "shutting-down")).kind == "instance_terminated"
+        assert p.parse(state_msg("i-1", "terminated")).kind == "instance_terminated"
+        # states outside the accepted set are no-ops (statechange parser)
+        assert p.parse(state_msg("i-1", "pending")).kind == "no_op"
+        assert p.parse(state_msg("i-1", "running")).kind == "no_op"
+
+    def test_health_event_multi_entity(self):
+        m = EventParser().parse(health_msg(["i-1", "i-2"]))
+        assert m.kind == "scheduled_change" and m.instance_ids == ["i-1", "i-2"]
+
+    def test_health_event_wrong_service_or_category(self):
+        body = json.loads(health_msg(["i-1"]))
+        body["detail"]["service"] = "STORAGE"
+        assert EventParser().parse(json.dumps(body)).kind == "no_op"
+        body = json.loads(health_msg(["i-1"]))
+        body["detail"]["eventTypeCategory"] = "accountNotification"
+        assert EventParser().parse(json.dumps(body)).kind == "no_op"
+
+    def test_rebalance(self):
+        m = EventParser().parse(rebalance_msg("i-9"))
+        assert m.kind == "rebalance_recommendation" and m.instance_ids == ["i-9"]
+
+    def test_noop_degradation(self):
+        p = EventParser()
+        assert p.parse("").kind == "no_op"
+        assert p.parse("not json").kind == "no_op"
+        assert p.parse(json.dumps({"detail-type": "Mystery"})).kind == "no_op"
+        # right detail-type, wrong source or version -> registry miss
+        body = json.loads(spot_msg("i-1"))
+        body["source"] = "cloud.other"
+        assert p.parse(json.dumps(body)).kind == "no_op"
+        body = json.loads(spot_msg("i-1"))
+        body["version"] = "7"
+        assert p.parse(json.dumps(body)).kind == "no_op"
+        # missing instance id degrades inside the parser
+        body = json.loads(spot_msg("i-1"))
+        body["detail"] = {}
+        assert p.parse(json.dumps(body)).kind == "no_op"
+        # envelope metadata survives onto the noop
+        m = p.parse(json.dumps({"version": "0", "source": "x", "detail-type": "y", "region": "r"}))
+        assert m.metadata.region == "r"
 
 
 class TestInterruption:
@@ -51,7 +139,7 @@ class TestInterruption:
         claim = env.cluster.list(NodeClaim)[0]
         iid = parse_instance_id(claim.provider_id)
         itype, zone = claim.instance_type, claim.zone
-        env.cloud.send(json.dumps({"kind": "spot-interruption", "instance_id": iid, "zone": zone}))
+        env.cloud.send(spot_msg(iid))
         handled = env.interruption.reconcile()
         assert handled == 1
         assert env.cluster.get(NodeClaim, claim.metadata.name).deleting
@@ -67,10 +155,10 @@ class TestInterruption:
         provision(env)
         claim = env.cluster.list(NodeClaim)[0]
         iid = parse_instance_id(claim.provider_id)
-        env.cloud.send(json.dumps({"kind": "state-change", "instance_id": iid, "state": "pending"}))
+        env.cloud.send(state_msg(iid, "pending"))
         env.interruption.reconcile()
         assert not env.cluster.get(NodeClaim, claim.metadata.name).deleting
-        env.cloud.send(json.dumps({"kind": "state-change", "instance_id": iid, "state": "stopping"}))
+        env.cloud.send(state_msg(iid, "stopping"))
         env.interruption.reconcile()
         assert env.cluster.get(NodeClaim, claim.metadata.name).deleting
 
@@ -78,18 +166,18 @@ class TestInterruption:
         provision(env)
         claim = env.cluster.list(NodeClaim)[0]
         iid = parse_instance_id(claim.provider_id)
-        env.cloud.send(json.dumps({"kind": "rebalance-recommendation", "instance_id": iid}))
+        env.cloud.send(rebalance_msg(iid))
         env.interruption.reconcile()
         assert not env.cluster.get(NodeClaim, claim.metadata.name).deleting
         assert env.recorder.with_reason("RebalanceRecommendation")
 
     def test_unknown_instance_ignored(self, env):
-        env.cloud.send(json.dumps({"kind": "spot-interruption", "instance_id": "i-nope", "zone": "z"}))
+        env.cloud.send(spot_msg("i-nope"))
         assert env.interruption.reconcile() == 1  # handled (deleted), no crash
 
     def test_queue_drained_in_batches(self, env):
         for i in range(25):
-            env.cloud.send(json.dumps({"kind": "mystery", "n": i}))
+            env.cloud.send(json.dumps({"detail-type": "Mystery", "n": i}))
         assert env.interruption.reconcile(max_messages=10) == 25
 
 
@@ -218,3 +306,64 @@ class TestObservability:
         env.clock.step(61)
         r.publish(claim, "Waiting", "still waiting")
         assert len(r.with_reason("Waiting")) == 2
+
+
+class TestNodeAutoRepair:
+    """VERDICT round 2, item 8: the repair controller consumes
+    CloudProvider.repair_policies() -- an unhealthy node condition is
+    tolerated for its policy window, then the node is replaced. Driven by
+    the kwok rig's degrade fault injection (a running-but-impaired
+    instance, the sibling of the kill switch)."""
+
+    def _degrade(self, env, condition="Ready"):
+        claim = env.cluster.list(NodeClaim)[0]
+        iid = parse_instance_id(claim.provider_id)
+        assert env.cloud.degrade_instance(iid, condition=condition)
+        env.lifecycle.step()  # impairment surfaces on the node
+        return claim
+
+    def test_tolerated_within_window(self, env):
+        provision(env)
+        claim = self._degrade(env)
+        node = env.cluster.node_for_nodeclaim(claim)
+        assert node.status_conditions.is_false("Ready")
+        env.clock.step(60.0)  # well inside the 30min Ready toleration
+        assert env.repair.reconcile() == 0
+        assert not env.cluster.get(NodeClaim, claim.metadata.name).deleting
+
+    def test_replaced_after_toleration_window(self, env):
+        provision(env)
+        claim = self._degrade(env)
+        env.repair.reconcile()  # first observation starts the window
+        env.clock.step(30 * 60.0 + 1)
+        assert env.repair.reconcile() == 1
+        assert env.cluster.get(NodeClaim, claim.metadata.name).deleting
+        assert env.recorder.with_reason("NodeRepairing")
+        # the loop drains the bad node and replaces the capacity
+        env.settle(max_ticks=40)
+        assert not env.cluster.pending_pods()
+        live = [c for c in env.cluster.list(NodeClaim) if not c.deleting]
+        assert live and live[0].metadata.name != claim.metadata.name
+
+    def test_accelerator_policy_shorter_window(self, env):
+        provision(env)
+        claim = self._degrade(env, condition="AcceleratedHardwareReady")
+        env.repair.reconcile()
+        env.clock.step(10 * 60.0 + 1)  # accelerator toleration is 10min
+        assert env.repair.reconcile() == 1
+        assert env.cluster.get(NodeClaim, claim.metadata.name).deleting
+
+    def test_healed_condition_resets_window(self, env):
+        provision(env)
+        claim = self._degrade(env)
+        env.repair.reconcile()
+        env.clock.step(29 * 60.0)
+        # heals before the window elapses
+        node = env.cluster.node_for_nodeclaim(claim)
+        node.status_conditions.set_true("Ready", "KubeletHealthy")
+        env.repair.reconcile()  # drops the tracked window
+        node.status_conditions.set_false("Ready", "Flapping")
+        env.repair.reconcile()  # new window starts NOW
+        env.clock.step(2 * 60.0)
+        assert env.repair.reconcile() == 0
+        assert not env.cluster.get(NodeClaim, claim.metadata.name).deleting
