@@ -12,7 +12,7 @@ test:  ## unit + component + differential suites
 
 deflake:  ## shuffled test order (fresh seed per round), repeated (race hunting)
 	@for i in 1 2 3 4 5; do \
-		seed=$$(python -c "import random; print(random.randrange(1 << 31))"); \
+		seed=$$($(PY) -c "import random; print(random.randrange(1 << 31))"); \
 		echo "deflake round $$i (PYTEST_SHUFFLE_SEED=$$seed)"; \
 		PYTEST_SHUFFLE_SEED=$$seed $(PYTEST) tests/ -q -p no:cacheprovider -o addopts= --maxfail=1 || exit 1; \
 	done
@@ -26,11 +26,13 @@ e2e:  ## scale + end-to-end suites only
 run:  ## controller loop over the kwok rig
 	$(PY) -m karpenter_tpu --max-ticks 50 --tick-interval 0.2 --metrics-dump
 
-docs:  ## regenerate generated docs
+docs:  ## regenerate generated docs + CRD manifests
 	$(PY) hack/metrics_gen.py
+	$(PY) hack/crd_gen.py
 
-docs-check:  ## fail if generated docs are stale
+docs-check:  ## fail if generated docs / CRD manifests are stale
 	$(PY) hack/metrics_gen.py --check
+	$(PY) hack/crd_gen.py --check
 
 verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun)
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -1,0 +1,537 @@
+"""Generate the CRD manifests for the three API kinds.
+
+The reference ships controller-gen-produced CRDs with CEL validation rules
+(/root/reference/pkg/apis/crds/*.yaml); this is the analogous codegen for
+the TPU provider's kinds. The schemas are authored here (the Python API
+types are plain objects, not kubebuilder-annotated structs) and the
+`x-kubernetes-validations` blocks carry the SAME invariants
+`karpenter_tpu/apis/validation.py` enforces at the in-memory admission seam
+-- one rule set, two enforcement points (a real apiserver deployment uses
+these manifests; the kwok rig uses the Python validators).
+
+Usage: python hack/crd_gen.py           (writes karpenter_tpu/apis/crds/)
+       python hack/crd_gen.py --check   (fails if manifests are stale)
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import yaml
+
+OUT_DIR = os.path.join(os.path.dirname(__file__), "..", "karpenter_tpu", "apis", "crds")
+
+GROUP_PROVIDER = "karpenter.tpu"
+GROUP_CORE = "karpenter.sh"
+
+
+def selector_term_schema(with_name: bool = False, with_alias: bool = False) -> dict:
+    props = {
+        "tags": {
+            "type": "object",
+            "additionalProperties": {"type": "string"},
+            "maxProperties": 20,
+            "x-kubernetes-validations": [
+                {
+                    "message": "empty tag keys or values aren't supported",
+                    "rule": "self.all(k, k != '' && self[k] != '')",
+                }
+            ],
+        },
+        "id": {"type": "string"},
+    }
+    if with_name:
+        props["name"] = {"type": "string"}
+    if with_alias:
+        props["alias"] = {
+            "type": "string",
+            "maxLength": 64,
+            "x-kubernetes-validations": [
+                {
+                    "message": "'alias' is improperly formatted, must match the format 'family@version'",
+                    "rule": "self.matches('^[a-zA-Z0-9]+@.+$')",
+                },
+                {
+                    "message": "family is not supported, must be one of the following: 'standard', 'accelerated', 'minimal', 'custom'",
+                    "rule": "self.split('@')[0] in ['standard','accelerated','minimal','custom']",
+                },
+            ],
+        }
+    return {"type": "object", "properties": props}
+
+
+def selector_terms_schema(with_name: bool = False, with_alias: bool = False, min_items: int = 1) -> dict:
+    fields = ["tags", "id"] + (["name"] if with_name else []) + (["alias"] if with_alias else [])
+    has_all = " || ".join(f"has(x.{f})" for f in fields)
+    others = [f for f in fields if f != "id"]
+    id_exclusive = " || ".join(f"has(x.{f})" for f in others)
+    rules = [
+        {
+            "message": f"expected at least one, got none, {fields}",
+            "rule": f"self.all(x, {has_all})",
+        },
+        {
+            "message": "'id' is mutually exclusive, cannot be set with a combination of other fields",
+            "rule": f"!self.exists(x, has(x.id) && ({id_exclusive}))",
+        },
+    ]
+    if with_alias:
+        rules.append(
+            {
+                "message": "'alias' is mutually exclusive, cannot be set with a combination of other fields",
+                "rule": "!self.exists(x, has(x.alias) && (has(x.id) || has(x.tags) || has(x.name)))",
+            }
+        )
+        rules.append(
+            {
+                "message": "'alias' is mutually exclusive, cannot be set with a combination of other image selector terms",
+                "rule": "!(self.exists(x, has(x.alias)) && self.size() != 1)",
+            }
+        )
+    out = {
+        "type": "array",
+        "maxItems": 30,
+        "items": selector_term_schema(with_name=with_name, with_alias=with_alias),
+        "x-kubernetes-validations": rules,
+    }
+    if min_items:
+        out["minItems"] = min_items
+    return out
+
+
+def quantity_map_schema(allowed_keys) -> dict:
+    keys = " || ".join(f"x=='{k}'" for k in allowed_keys)
+    return {
+        "type": "object",
+        "additionalProperties": {"type": "string"},
+        "x-kubernetes-validations": [
+            {"message": f"valid keys are {list(allowed_keys)}", "rule": f"self.all(x, {keys})"},
+            {"message": "quantities may not be negative", "rule": "self.all(x, !self[x].startsWith('-'))"},
+        ],
+    }
+
+
+def eviction_map_schema() -> dict:
+    signals = "','".join(
+        ["memory.available", "nodefs.available", "nodefs.inodesFree", "imagefs.available", "imagefs.inodesFree", "pid.available"]
+    )
+    return {
+        "type": "object",
+        "additionalProperties": {"type": "string"},
+        "x-kubernetes-validations": [
+            {
+                "message": "valid keys are eviction signals",
+                "rule": f"self.all(x, x in ['{signals}'])",
+            }
+        ],
+    }
+
+
+def nodeclass_crd() -> dict:
+    spec_props = {
+        "imageFamily": {
+            "type": "string",
+            "enum": ["Standard", "Accelerated", "Minimal", "Custom"],
+        },
+        "imageSelectorTerms": selector_terms_schema(with_name=True, with_alias=True),
+        "subnetSelectorTerms": selector_terms_schema(),
+        "securityGroupSelectorTerms": selector_terms_schema(with_name=True),
+        "capacityReservationSelectorTerms": selector_terms_schema(min_items=0),
+        "role": {
+            "type": "string",
+            "x-kubernetes-validations": [
+                {"message": "role cannot be empty", "rule": "self != ''"}
+            ],
+        },
+        "instanceProfile": {
+            "type": "string",
+            "x-kubernetes-validations": [
+                {"message": "instanceProfile cannot be empty", "rule": "self != ''"}
+            ],
+        },
+        "userData": {"type": "string"},
+        "tags": {
+            "type": "object",
+            "additionalProperties": {"type": "string"},
+            "x-kubernetes-validations": [
+                {
+                    "message": "empty tag keys or values aren't supported",
+                    "rule": "self.all(k, k != '' && self[k] != '')",
+                },
+                {
+                    "message": "tag contains a restricted tag matching karpenter.tpu/nodepool",
+                    "rule": "self.all(k, k != 'karpenter.tpu/nodepool')",
+                },
+                {
+                    "message": "tag contains a restricted tag matching karpenter.tpu/nodeclaim",
+                    "rule": "self.all(k, k != 'karpenter.tpu/nodeclaim')",
+                },
+                {
+                    "message": "tag contains a restricted tag matching kubernetes.io/cluster/",
+                    "rule": "self.all(k, !k.startsWith('kubernetes.io/cluster/'))",
+                },
+            ],
+        },
+        "kubelet": {
+            "type": "object",
+            "properties": {
+                "maxPods": {"type": "integer", "format": "int32", "minimum": 1},
+                "podsPerCore": {"type": "integer", "format": "int32", "minimum": 0},
+                "systemReserved": quantity_map_schema(["cpu", "memory", "ephemeral-storage", "pid"]),
+                "kubeReserved": quantity_map_schema(["cpu", "memory", "ephemeral-storage", "pid"]),
+                "evictionHard": eviction_map_schema(),
+                "evictionSoft": eviction_map_schema(),
+                "clusterDNS": {"type": "array", "items": {"type": "string"}},
+            },
+        },
+        "blockDeviceMappings": {
+            "type": "array",
+            "maxItems": 50,
+            "items": {
+                "type": "object",
+                "properties": {
+                    "deviceName": {"type": "string"},
+                    "volumeSizeGiB": {"type": "integer", "minimum": 1},
+                    "volumeType": {"type": "string", "enum": ["ssd", "balanced", "throughput"]},
+                    "iops": {"type": "integer"},
+                    "throughput": {"type": "integer"},
+                    "encrypted": {"type": "boolean"},
+                    "deleteOnTermination": {"type": "boolean"},
+                },
+            },
+        },
+        "metadataOptions": {
+            "type": "object",
+            "properties": {
+                "httpTokens": {"type": "string", "enum": ["required", "optional"]},
+            },
+        },
+        "associatePublicIPAddress": {"type": "boolean"},
+    }
+    spec = {
+        "type": "object",
+        "properties": spec_props,
+        "x-kubernetes-validations": [
+            {
+                "message": "'role' and 'instanceProfile' are mutually exclusive",
+                "rule": "!(has(self.role) && self.role != '' && has(self.instanceProfile) && self.instanceProfile != '')",
+            },
+            {
+                "message": "one of 'role' or 'instanceProfile' must be set",
+                "rule": "(has(self.role) && self.role != '') || (has(self.instanceProfile) && self.instanceProfile != '')",
+            },
+        ],
+    }
+    status = {
+        "type": "object",
+        "properties": {
+            "subnets": {"type": "array", "items": {"type": "object", "x-kubernetes-preserve-unknown-fields": True}},
+            "securityGroups": {"type": "array", "items": {"type": "object", "x-kubernetes-preserve-unknown-fields": True}},
+            "images": {"type": "array", "items": {"type": "object", "x-kubernetes-preserve-unknown-fields": True}},
+            "capacityReservations": {"type": "array", "items": {"type": "object", "x-kubernetes-preserve-unknown-fields": True}},
+            "instanceProfile": {"type": "string"},
+            "conditions": {"type": "array", "items": {"type": "object", "x-kubernetes-preserve-unknown-fields": True}},
+        },
+    }
+    return crd(
+        group=GROUP_PROVIDER,
+        kind="TPUNodeClass",
+        plural="tpunodeclasses",
+        singular="tpunodeclass",
+        short_names=["tpunc", "tpuncs"],
+        spec_schema=spec,
+        status_schema=status,
+        printer_columns=[
+            {"jsonPath": '.status.conditions[?(@.type=="Ready")].status', "name": "Ready", "type": "string"},
+            {"jsonPath": ".metadata.creationTimestamp", "name": "Age", "type": "date"},
+            {"jsonPath": ".spec.role", "name": "Role", "priority": 1, "type": "string"},
+        ],
+    )
+
+
+def requirement_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["key", "operator"],
+        "properties": {
+            "key": {
+                "type": "string",
+                "maxLength": 316,
+                "x-kubernetes-validations": [
+                    {
+                        "message": "requirement key karpenter.tpu/nodepool is restricted",
+                        "rule": "self != 'karpenter.tpu/nodepool'",
+                    }
+                ],
+            },
+            "operator": {
+                "type": "string",
+                "enum": ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"],
+            },
+            "values": {"type": "array", "items": {"type": "string"}, "maxItems": 50},
+            "minValues": {"type": "integer", "minimum": 1, "maximum": 50},
+        },
+        "x-kubernetes-validations": [
+            {
+                "message": "Gt/Lt operators take exactly one integer value",
+                "rule": "self.operator in ['Gt','Lt'] ? (self.values.size() == 1 && int(self.values[0]) >= 0) : true",
+            }
+        ],
+    }
+
+
+def taint_schema() -> dict:
+    return {
+        "type": "object",
+        "required": ["key", "effect"],
+        "properties": {
+            "key": {"type": "string", "minLength": 1},
+            "value": {"type": "string"},
+            "effect": {"type": "string", "enum": ["NoSchedule", "PreferNoSchedule", "NoExecute"]},
+        },
+    }
+
+
+def nodepool_crd() -> dict:
+    spec = {
+        "type": "object",
+        "properties": {
+            "weight": {"type": "integer", "format": "int32", "minimum": 0, "maximum": 10000},
+            "limits": {
+                "type": "object",
+                "additionalProperties": {"type": "string"},
+                "x-kubernetes-validations": [
+                    {"message": "limits may not be negative", "rule": "self.all(x, !self[x].startsWith('-'))"}
+                ],
+            },
+            "disruption": {
+                "type": "object",
+                "properties": {
+                    "consolidationPolicy": {
+                        "type": "string",
+                        "enum": ["WhenEmpty", "WhenEmptyOrUnderutilized"],
+                    },
+                    "consolidateAfter": {"type": "string"},
+                    "budgets": {
+                        "type": "array",
+                        "maxItems": 50,
+                        "items": {
+                            "type": "object",
+                            "properties": {
+                                "nodes": {
+                                    "type": "string",
+                                    "pattern": "^((100|[0-9]{1,2})%|[0-9]+)$",
+                                },
+                                "reasons": {
+                                    "type": "array",
+                                    "items": {
+                                        "type": "string",
+                                        "enum": ["Underutilized", "Empty", "Drifted", "Expired"],
+                                    },
+                                },
+                                "schedule": {"type": "string"},
+                                "duration": {"type": "string"},
+                            },
+                        },
+                    },
+                },
+            },
+            "template": {
+                "type": "object",
+                "properties": {
+                    "metadata": {
+                        "type": "object",
+                        "properties": {
+                            "labels": {"type": "object", "additionalProperties": {"type": "string"}},
+                            "annotations": {"type": "object", "additionalProperties": {"type": "string"}},
+                        },
+                    },
+                    "spec": {
+                        "type": "object",
+                        "properties": {
+                            "nodeClassRef": {
+                                "type": "object",
+                                "properties": {
+                                    "group": {"type": "string"},
+                                    "kind": {"type": "string"},
+                                    "name": {"type": "string"},
+                                },
+                            },
+                            "requirements": {"type": "array", "items": requirement_schema()},
+                            "taints": {"type": "array", "items": taint_schema()},
+                            "startupTaints": {"type": "array", "items": taint_schema()},
+                            "expireAfter": {"type": "string"},
+                            "terminationGracePeriod": {"type": "string"},
+                        },
+                    },
+                },
+            },
+        },
+    }
+    status = {
+        "type": "object",
+        "properties": {
+            "resources": {"type": "object", "additionalProperties": {"type": "string"}},
+            "conditions": {"type": "array", "items": {"type": "object", "x-kubernetes-preserve-unknown-fields": True}},
+        },
+    }
+    return crd(
+        group=GROUP_CORE,
+        kind="NodePool",
+        plural="nodepools",
+        singular="nodepool",
+        short_names=[],
+        spec_schema=spec,
+        status_schema=status,
+        printer_columns=[
+            {"jsonPath": ".spec.template.spec.nodeClassRef.name", "name": "NodeClass", "type": "string"},
+            {"jsonPath": ".status.resources.nodes", "name": "Nodes", "type": "string"},
+            {"jsonPath": '.status.conditions[?(@.type=="Ready")].status', "name": "Ready", "type": "string"},
+            {"jsonPath": ".metadata.creationTimestamp", "name": "Age", "type": "date"},
+            {"jsonPath": ".spec.weight", "name": "Weight", "priority": 1, "type": "integer"},
+        ],
+    )
+
+
+def nodeclaim_crd() -> dict:
+    spec = {
+        "type": "object",
+        "properties": {
+            "nodeClassRef": {
+                "type": "object",
+                "properties": {
+                    "group": {"type": "string"},
+                    "kind": {"type": "string"},
+                    "name": {"type": "string"},
+                },
+            },
+            "requirements": {"type": "array", "items": requirement_schema()},
+            "taints": {"type": "array", "items": taint_schema()},
+            "startupTaints": {"type": "array", "items": taint_schema()},
+            "resources": {
+                "type": "object",
+                "properties": {
+                    "requests": {"type": "object", "additionalProperties": {"type": "string"}},
+                },
+            },
+            "expireAfter": {"type": "string"},
+            "terminationGracePeriod": {"type": "string"},
+        },
+        "x-kubernetes-validations": [
+            {"message": "spec is immutable", "rule": "self == oldSelf"}
+        ],
+    }
+    status = {
+        "type": "object",
+        "properties": {
+            "providerID": {"type": "string"},
+            "nodeName": {"type": "string"},
+            "imageID": {"type": "string"},
+            "capacity": {"type": "object", "additionalProperties": {"type": "string"}},
+            "allocatable": {"type": "object", "additionalProperties": {"type": "string"}},
+            "conditions": {"type": "array", "items": {"type": "object", "x-kubernetes-preserve-unknown-fields": True}},
+        },
+    }
+    return crd(
+        group=GROUP_CORE,
+        kind="NodeClaim",
+        plural="nodeclaims",
+        singular="nodeclaim",
+        short_names=[],
+        spec_schema=spec,
+        status_schema=status,
+        printer_columns=[
+            {"jsonPath": '.metadata.labels.node\\.kubernetes\\.io/instance-type', "name": "Type", "type": "string"},
+            {"jsonPath": '.metadata.labels.karpenter\\.sh/capacity-type', "name": "Capacity", "type": "string"},
+            {"jsonPath": '.metadata.labels.topology\\.kubernetes\\.io/zone', "name": "Zone", "type": "string"},
+            {"jsonPath": ".status.nodeName", "name": "Node", "type": "string"},
+            {"jsonPath": '.status.conditions[?(@.type=="Ready")].status', "name": "Ready", "type": "string"},
+            {"jsonPath": ".metadata.creationTimestamp", "name": "Age", "type": "date"},
+        ],
+    )
+
+
+def crd(group, kind, plural, singular, short_names, spec_schema, status_schema, printer_columns) -> dict:
+    names = {
+        "categories": ["karpenter"],
+        "kind": kind,
+        "listKind": f"{kind}List",
+        "plural": plural,
+        "singular": singular,
+    }
+    if short_names:
+        names["shortNames"] = short_names
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {
+            "annotations": {"karpenter.tpu/crd-gen": "hack/crd_gen.py"},
+            "name": f"{plural}.{group}",
+        },
+        "spec": {
+            "group": group,
+            "names": names,
+            "scope": "Cluster",
+            "versions": [
+                {
+                    "additionalPrinterColumns": printer_columns,
+                    "name": "v1",
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "description": f"{kind} is the Schema for the {kind} API",
+                            "properties": {
+                                "apiVersion": {"type": "string"},
+                                "kind": {"type": "string"},
+                                "metadata": {"type": "object"},
+                                "spec": spec_schema,
+                                "status": status_schema,
+                            },
+                            "required": ["spec"],
+                            "type": "object",
+                        }
+                    },
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                }
+            ],
+        },
+    }
+
+
+FILES = {
+    "karpenter.tpu_tpunodeclasses.yaml": nodeclass_crd,
+    "karpenter.sh_nodepools.yaml": nodepool_crd,
+    "karpenter.sh_nodeclaims.yaml": nodeclaim_crd,
+}
+
+
+def render(fn) -> str:
+    return yaml.safe_dump(fn(), sort_keys=False, default_flow_style=False, width=100)
+
+
+def main(argv=None) -> int:
+    check = "--check" in (argv or sys.argv[1:])
+    os.makedirs(OUT_DIR, exist_ok=True)
+    stale = []
+    for fname, fn in FILES.items():
+        path = os.path.join(OUT_DIR, fname)
+        content = render(fn)
+        if check:
+            current = open(path).read() if os.path.exists(path) else ""
+            if current != content:
+                stale.append(fname)
+        else:
+            with open(path, "w") as f:
+                f.write(content)
+            print(f"wrote {path}")
+    if check and stale:
+        print(f"stale CRD manifests: {stale}; run `python hack/crd_gen.py`", file=sys.stderr)
+        return 1
+    if check:
+        print("CRD manifests up to date")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,294 @@
+"""Admission validation for the API types.
+
+The reference ships CEL validation rules compiled into its CRD manifests
+(/root/reference/pkg/apis/crds/*.yaml `x-kubernetes-validations`, exercised
+against a real apiserver by pkg/apis/v1/ec2nodeclass_validation_cel_test.go);
+the apiserver rejects invalid objects at admission. This framework's
+coordination bus is the in-memory cluster store, so the same invariants are
+enforced HERE: `kwok.Cluster.create/update` runs these validators for the
+three CRD kinds and refuses violations (AdmissionError), exactly where the
+apiserver would.
+
+Every rule mirrors a reference CEL rule (cited inline); the generated CRD
+manifests (`hack/crd_gen.py` -> `karpenter_tpu/apis/crds/*.yaml`) carry the
+same rules as `x-kubernetes-validations` for a real apiserver deployment.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+SUPPORTED_IMAGE_FAMILIES = ("standard", "accelerated", "minimal", "custom")
+SUPPORTED_VOLUME_TYPES = ("ssd", "balanced", "throughput")
+SUPPORTED_HTTP_TOKENS = ("required", "optional")
+EVICTION_SIGNALS = (
+    "memory.available",
+    "nodefs.available",
+    "nodefs.inodesFree",
+    "imagefs.available",
+    "imagefs.inodesFree",
+    "pid.available",
+)
+RESERVED_RESOURCES = ("cpu", "memory", "ephemeral-storage", "pid")
+VALID_TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
+VALID_OPERATORS = ("In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt")
+# tag namespace the controller owns; user tags may not forge it
+# (reference: ec2nodeclass tags CEL forbids kubernetes.io/cluster/*,
+# karpenter.sh/nodepool, karpenter.sh/nodeclaim, eks:eks-cluster-name)
+RESTRICTED_TAG_PATTERNS = (
+    re.compile(r"^karpenter\.tpu/nodepool$"),
+    re.compile(r"^karpenter\.tpu/nodeclaim$"),
+    re.compile(r"^kubernetes\.io/cluster/"),
+)
+
+_ALIAS_RE = re.compile(r"^[a-zA-Z0-9]+@.+$")
+
+
+@dataclass
+class Violation:
+    path: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}: {self.message}"
+
+
+class AdmissionError(ValueError):
+    """The in-memory store's stand-in for an apiserver admission refusal."""
+
+    def __init__(self, kind: str, name: str, violations: List[Violation]):
+        self.kind = kind
+        self.name = name
+        self.violations = violations
+        detail = "; ".join(str(v) for v in violations)
+        super().__init__(f"{kind}/{name} rejected: {detail}")
+
+
+def _check_tags(tags, path: str, out: List[Violation], restricted: bool = False) -> None:
+    for k, v in tags.items():
+        # ref CEL: "empty tag keys or values aren't supported"
+        if k == "" or v == "":
+            out.append(Violation(path, "empty tag keys or values aren't supported"))
+            break
+    if restricted:
+        for k in tags:
+            if any(p.match(k) for p in RESTRICTED_TAG_PATTERNS):
+                out.append(Violation(path, f"tag key {k!r} is restricted"))
+
+
+def _check_selector_terms(
+    terms, path: str, out: List[Violation], allow_name: bool = False, allow_alias: bool = False,
+    required: bool = True,
+) -> None:
+    """Mirrors the reference's selector-term CEL block: at least one term,
+    each term non-empty, 'id' mutually exclusive with everything else, and
+    (for image terms) 'alias' exclusive and alone."""
+    if required and not terms:
+        fields = ["tags", "id"] + (["name"] if allow_name else []) + (["alias"] if allow_alias else [])
+        out.append(Violation(path, f"expected at least one, got none, {fields}"))
+        return
+    n_alias = 0
+    for i, t in enumerate(terms):
+        tpath = f"{path}[{i}]"
+        has_tags = bool(t.tags)
+        has_id = bool(t.id)
+        has_name = bool(getattr(t, "name", "")) if allow_name or hasattr(t, "name") else False
+        has_alias = bool(getattr(t, "alias", "")) if allow_alias else False
+        if not (has_tags or has_id or has_name or has_alias):
+            out.append(Violation(tpath, "expected at least one selector field, got none"))
+            continue
+        if has_id and (has_tags or has_name or has_alias):
+            # ref CEL: "'id' is mutually exclusive, cannot be set with a
+            # combination of other fields"
+            out.append(Violation(tpath, "'id' is mutually exclusive with other selector fields"))
+        if has_alias:
+            n_alias += 1
+            if has_tags or has_name:
+                # ref CEL: "'alias' is mutually exclusive ..."
+                out.append(Violation(tpath, "'alias' is mutually exclusive with other selector fields"))
+            alias = t.alias
+            if not _ALIAS_RE.match(alias):
+                # ref CEL: "'alias' is improperly formatted, must match the
+                # format 'family@version'"
+                out.append(Violation(tpath, "'alias' must match the format 'family@version'"))
+            else:
+                family = alias.split("@", 1)[0].lower()
+                if family not in SUPPORTED_IMAGE_FAMILIES:
+                    # ref CEL: "family is not supported, must be one of ..."
+                    out.append(
+                        Violation(
+                            tpath,
+                            f"alias family {family!r} is not supported, must be one of {list(SUPPORTED_IMAGE_FAMILIES)}",
+                        )
+                    )
+        _check_tags(t.tags, tpath + ".tags", out)
+    if n_alias and len(terms) != 1:
+        # ref CEL: "'alias' is mutually exclusive, cannot be set with a
+        # combination of other image selector terms"
+        out.append(Violation(path, "an 'alias' term must be the only image selector term"))
+
+
+def _check_quantity_map(m, path: str, out: List[Violation], allowed_keys) -> None:
+    from karpenter_tpu.scheduling.resources import parse_quantity
+
+    for k, v in m.items():
+        if allowed_keys is not None and k not in allowed_keys:
+            out.append(Violation(f"{path}.{k}", f"key must be one of {list(allowed_keys)}"))
+            continue
+        try:
+            q = parse_quantity(v, k)
+        except ValueError:
+            out.append(Violation(f"{path}.{k}", f"unparseable quantity {v!r}"))
+            continue
+        if q < 0:
+            # ref CEL: "... may not be negative" (systemReserved/kubeReserved)
+            out.append(Violation(f"{path}.{k}", "quantity may not be negative"))
+
+
+def validate_nodeclass(nc) -> List[Violation]:
+    """The EC2NodeClass admission invariants
+    (karpenter.k8s.aws_ec2nodeclasses.yaml x-kubernetes-validations),
+    re-homed on TPUNodeClass vocabulary."""
+    out: List[Violation] = []
+    _check_selector_terms(
+        nc.image_selector_terms, "spec.imageSelectorTerms", out,
+        allow_name=True, allow_alias=True,
+    )
+    _check_selector_terms(nc.subnet_selector_terms, "spec.subnetSelectorTerms", out)
+    _check_selector_terms(
+        nc.security_group_selector_terms, "spec.securityGroupSelectorTerms", out,
+        allow_name=True,
+    )
+    _check_selector_terms(
+        nc.capacity_reservation_selector_terms, "spec.capacityReservationSelectorTerms",
+        out, required=False,
+    )
+    # ref CEL on role/instanceProfile: both are single-ownership paths; the
+    # pair is mutually exclusive and one must be set (ec2nodeclass.go
+    # admission: "must specify one of role or instanceProfile")
+    if nc.role and nc.instance_profile:
+        out.append(Violation("spec", "'role' and 'instanceProfile' are mutually exclusive"))
+    if not nc.role and not nc.instance_profile:
+        out.append(Violation("spec", "one of 'role' or 'instanceProfile' must be set"))
+    if nc.metadata_http_tokens not in SUPPORTED_HTTP_TOKENS:
+        out.append(
+            Violation("spec.metadataOptions.httpTokens", f"must be one of {list(SUPPORTED_HTTP_TOKENS)}")
+        )
+    _check_tags(nc.tags, "spec.tags", out, restricted=True)
+    seen_devices = set()
+    for i, b in enumerate(nc.block_device_mappings):
+        bpath = f"spec.blockDeviceMappings[{i}]"
+        if b.volume_size_gib < 1:
+            out.append(Violation(bpath, "volumeSize must be at least 1Gi"))
+        if b.volume_type not in SUPPORTED_VOLUME_TYPES:
+            out.append(Violation(bpath, f"volumeType must be one of {list(SUPPORTED_VOLUME_TYPES)}"))
+        if b.device_name in seen_devices:
+            out.append(Violation(bpath, f"duplicate deviceName {b.device_name!r}"))
+        seen_devices.add(b.device_name)
+    k = nc.kubelet
+    if k is not None:
+        if k.max_pods is not None and k.max_pods < 1:
+            out.append(Violation("spec.kubelet.maxPods", "must be at least 1"))
+        if k.pods_per_core is not None and k.pods_per_core < 0:
+            out.append(Violation("spec.kubelet.podsPerCore", "may not be negative"))
+        _check_quantity_map(k.system_reserved, "spec.kubelet.systemReserved", out, RESERVED_RESOURCES)
+        _check_quantity_map(k.kube_reserved, "spec.kubelet.kubeReserved", out, RESERVED_RESOURCES)
+        for field_name, m in (("evictionHard", k.eviction_hard), ("evictionSoft", k.eviction_soft)):
+            for key in m:
+                # ref CEL: eviction signal enumeration
+                if key not in EVICTION_SIGNALS:
+                    out.append(
+                        Violation(
+                            f"spec.kubelet.{field_name}.{key}",
+                            f"key must be one of {list(EVICTION_SIGNALS)}",
+                        )
+                    )
+    return out
+
+
+def _check_requirements(reqs, path: str, out: List[Violation]) -> None:
+    """Requirement objects normalize operators at construction (invalid
+    operators and malformed Gt/Lt raise there, the CEL operator-enum and
+    single-integer-value rules); what admission still owns is the key
+    discipline (ref: karpenter.sh/nodepool is a restricted key)."""
+    from karpenter_tpu.apis import labels as wk
+
+    for i, r in enumerate(reqs):
+        rpath = f"{path}[{i}]"
+        key = getattr(r, "key", "")
+        if not key:
+            out.append(Violation(rpath, "requirement key may not be empty"))
+        if key == wk.NODEPOOL_LABEL:
+            out.append(Violation(rpath, f"requirement key {key!r} is restricted"))
+
+
+def validate_nodepool(pool) -> List[Violation]:
+    """NodePool admission invariants (karpenter.sh_nodepools.yaml)."""
+    out: List[Violation] = []
+    # ref CRD: weight 1..10000 when set (0 = unset here)
+    if not (0 <= pool.weight <= 10_000):
+        out.append(Violation("spec.weight", "must be between 0 and 10000"))
+    if pool.limits is not None:
+        for key, value in pool.limits.items():
+            if value < 0:
+                out.append(Violation(f"spec.limits.{key}", "may not be negative"))
+    d = pool.disruption
+    if d.consolidate_after is not None and d.consolidate_after < 0:
+        out.append(Violation("spec.disruption.consolidateAfter", "may not be negative"))
+    for i, b in enumerate(d.budgets):
+        nodes = getattr(b, "nodes", None)
+        if isinstance(nodes, str):
+            # ref CEL: budgets.nodes matches "^((100|[0-9]{1,2})%|[0-9]+)$"
+            if not re.match(r"^((100|[0-9]{1,2})%|[0-9]+)$", nodes):
+                out.append(
+                    Violation(
+                        f"spec.disruption.budgets[{i}].nodes",
+                        "must be an integer or a percentage between 0%% and 100%%",
+                    )
+                )
+    for i, t in enumerate(list(pool.template.taints) + list(pool.template.startup_taints)):
+        if t.effect and t.effect not in VALID_TAINT_EFFECTS:
+            out.append(
+                Violation(f"spec.template.taints[{i}].effect", f"must be one of {list(VALID_TAINT_EFFECTS)}")
+            )
+    _check_requirements(pool.template.requirements, "spec.template.requirements", out)
+    return out
+
+
+def validate_nodeclaim(claim) -> List[Violation]:
+    """NodeClaim admission invariants (karpenter.sh_nodeclaims.yaml)."""
+    out: List[Violation] = []
+    for i, t in enumerate(list(claim.taints) + list(claim.startup_taints)):
+        if t.effect and t.effect not in VALID_TAINT_EFFECTS:
+            out.append(Violation(f"spec.taints[{i}].effect", f"must be one of {list(VALID_TAINT_EFFECTS)}"))
+    if claim.expire_after is not None and claim.expire_after < 0:
+        out.append(Violation("spec.expireAfter", "may not be negative"))
+    if claim.termination_grace_period is not None and claim.termination_grace_period < 0:
+        out.append(Violation("spec.terminationGracePeriod", "may not be negative"))
+    return out
+
+
+VALIDATORS: dict = {}
+
+
+def _register() -> None:
+    from karpenter_tpu.apis import NodeClaim, NodePool
+    from karpenter_tpu.apis.nodeclass import TPUNodeClass
+
+    VALIDATORS[TPUNodeClass.KIND] = validate_nodeclass
+    VALIDATORS[NodePool.KIND] = validate_nodepool
+    VALIDATORS[NodeClaim.KIND] = validate_nodeclaim
+
+
+def admit(obj) -> None:
+    """Raise AdmissionError when `obj` violates its kind's invariants
+    (no-op for kinds without validators)."""
+    if not VALIDATORS:
+        _register()
+    fn = VALIDATORS.get(getattr(obj, "KIND", None))
+    if fn is None:
+        return
+    violations = fn(obj)
+    if violations:
+        raise AdmissionError(obj.KIND, obj.metadata.name, violations)

@@ -53,5 +53,9 @@ class MetricsController:
         for name, values in list(self._series.items()):
             if live.get(name) != values:
                 INSTANCE_INFO.remove(**dict(zip(label_names, values)))
+        if len(live) != len(self._series):
+            self.log.debug(
+                "instance info series", series=len(live), pruned=len(self._series) - len(live)
+            )
         self._series = live
         return len(live)

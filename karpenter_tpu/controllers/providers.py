@@ -52,10 +52,14 @@ class InstanceTypeRefreshController(_Periodic):
         self.provider.update_instance_types()
         self.provider.update_instance_type_offerings()
         # log only when the catalog actually changed (reference dedupes the
-        # same message with a ChangeMonitor, instancetype.go:267-271)
-        seq = getattr(self.provider, "seqnum", None)
+        # same message with a ChangeMonitor, instancetype.go:267-271); the
+        # provider's seq counters bump only on observed change
+        seq = (self.provider.instance_types_seq, self.provider.offerings_seq)
         if self.monitor.has_changed("catalog", seq):
-            self.log.info("instance types updated", seqnum=seq)
+            self.log.info(
+                "instance types updated",
+                instance_types_seq=seq[0], offerings_seq=seq[1],
+            )
         return True
 
 
@@ -72,9 +76,9 @@ class PricingRefreshController(_Periodic):
             return False
         self.pricing.update_on_demand_pricing()
         self.pricing.update_spot_pricing()
-        snapshot = self.pricing.snapshot_hash() if hasattr(self.pricing, "snapshot_hash") else None
+        snapshot = self.pricing.snapshot_hash()
         if self.monitor.has_changed("pricing", snapshot):
-            self.log.info("pricing updated")
+            self.log.info("pricing updated", snapshot=snapshot)
         return True
 
 

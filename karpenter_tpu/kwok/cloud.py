@@ -122,11 +122,13 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
             ImageInfo(id="img-std-amd64", name="standard-k8s-1.32-amd64", arch="amd64", family="Standard", creation_time=100.0),
             ImageInfo(id="img-std-arm64", name="standard-k8s-1.32-arm64", arch="arm64", family="Standard", creation_time=100.0),
             ImageInfo(id="img-min-amd64", name="minimal-k8s-1.32-amd64", arch="amd64", family="Minimal", creation_time=90.0),
+            ImageInfo(id="img-acc-amd64", name="accelerated-k8s-1.32-amd64", arch="amd64", family="Accelerated", creation_time=100.0),
         ]
         self._params = {
             "/images/standard/latest/amd64": "img-std-amd64",
             "/images/standard/latest/arm64": "img-std-arm64",
             "/images/minimal/latest/amd64": "img-min-amd64",
+            "/images/accelerated/latest/amd64": "img-acc-amd64",
         }
         self._reservations: List[CapacityReservationInfo] = []
 

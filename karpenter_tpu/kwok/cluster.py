@@ -57,6 +57,12 @@ class Cluster:
 
     # -- CRUD ---------------------------------------------------------------
     def create(self, obj: APIObject) -> APIObject:
+        # admission: the store is the apiserver stand-in, so the CRD
+        # validation rules run here (apis/validation.py; reference: CEL
+        # rules compiled into pkg/apis/crds/*.yaml, enforced at admission)
+        from karpenter_tpu.apis.validation import admit
+
+        admit(obj)
         with self._lock:
             kind = type(obj).KIND
             if obj.metadata.name in self._store[kind]:
@@ -88,6 +94,9 @@ class Cluster:
         return items
 
     def update(self, obj: APIObject, expect_version: Optional[int] = None) -> APIObject:
+        from karpenter_tpu.apis.validation import admit
+
+        admit(obj)
         with self._lock:
             kind = type(obj).KIND
             current = self._store[kind].get(obj.metadata.name)

@@ -66,7 +66,12 @@ class StructuredAdapter(logging.LoggerAdapter):
 
     def _log_kw(self, level: int, msg: str, fields: Dict[str, Any]) -> None:
         if self.logger.isEnabledFor(level):
-            self.logger.log(level, msg, extra=fields)
+            # LogRecord refuses extras that shadow its own attributes
+            # (KeyError at the call site); prefix collisions instead
+            safe = {
+                (f"field_{k}" if k in _RESERVED else k): v for k, v in fields.items()
+            }
+            self.logger.log(level, msg, extra=safe)
 
     def debug(self, msg: str, **fields):
         self._log_kw(logging.DEBUG, msg, fields)

@@ -68,6 +68,20 @@ class PricingProvider:
             self._od = merged
             self.seq_num += 1
 
+    def snapshot_hash(self) -> str:
+        """Content hash of both price tables: the refresh controller logs
+        'pricing updated' only when this changes (seq_num bumps on every
+        refresh regardless of content, so it cannot drive the dedup)."""
+        import hashlib
+
+        with self._lock:
+            h = hashlib.blake2b(digest_size=8)
+            for k in sorted(self._od):
+                h.update(f"{k}={self._od[k]};".encode())
+            for k in sorted(self._spot):
+                h.update(f"{k}={self._spot[k]};".encode())
+        return h.hexdigest()
+
     def update_spot_pricing(self) -> None:
         if self._compute_api is None:
             return

@@ -204,7 +204,11 @@ class TestNodeClassLifecycle:
 
     def test_unready_nodeclass_blocks_launch(self, env):
         nc = env.cluster.get(TPUNodeClass, "default")
-        nc.subnet_selector_terms = []  # nothing matches -> SubnetsReady False
+        from karpenter_tpu.apis.nodeclass import SelectorTerm
+
+        # a selector matching nothing (an EMPTY list is now an admission
+        # error, as on the reference CRD) -> SubnetsReady False
+        nc.subnet_selector_terms = [SelectorTerm(tags={"no-such-tag": "true"})]
         env.cluster.update(nc)
         env.cluster.create(make_pods(1)[0])
         env.settle(max_ticks=3)
