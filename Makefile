@@ -26,13 +26,15 @@ e2e:  ## scale + end-to-end suites only
 run:  ## controller loop over the kwok rig
 	$(PY) -m karpenter_tpu --max-ticks 50 --tick-interval 0.2 --metrics-dump
 
-docs:  ## regenerate generated docs + CRD manifests
+docs:  ## regenerate generated docs + CRD manifests + compatibility matrix
 	$(PY) hack/metrics_gen.py
 	$(PY) hack/crd_gen.py
+	$(PY) hack/kompat.py
 
 docs-check:  ## fail if generated docs / CRD manifests are stale
 	$(PY) hack/metrics_gen.py --check
 	$(PY) hack/crd_gen.py --check
+	$(PY) hack/kompat.py --check
 
 verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun)
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

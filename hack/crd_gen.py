@@ -26,6 +26,9 @@ GROUP_CORE = "karpenter.sh"
 
 
 def selector_term_schema(with_name: bool = False, with_alias: bool = False) -> dict:
+    # every term kind supports name matching (SelectorTerm.matches); the
+    # schema must admit it everywhere or a real apiserver would prune it
+    with_name = True
     props = {
         "tags": {
             "type": "object",
@@ -53,7 +56,7 @@ def selector_term_schema(with_name: bool = False, with_alias: bool = False) -> d
                 },
                 {
                     "message": "family is not supported, must be one of the following: 'standard', 'accelerated', 'minimal', 'custom'",
-                    "rule": "self.split('@')[0] in ['standard','accelerated','minimal','custom']",
+                    "rule": "self.split('@')[0].lowerAscii() in ['standard','accelerated','minimal','custom']",
                 },
             ],
         }
@@ -159,12 +162,12 @@ def nodeclass_crd() -> dict:
                     "rule": "self.all(k, k != '' && self[k] != '')",
                 },
                 {
-                    "message": "tag contains a restricted tag matching karpenter.tpu/nodepool",
-                    "rule": "self.all(k, k != 'karpenter.tpu/nodepool')",
+                    "message": "tag contains a restricted tag matching karpenter.sh/nodepool",
+                    "rule": "self.all(k, k != 'karpenter.sh/nodepool')",
                 },
                 {
-                    "message": "tag contains a restricted tag matching karpenter.tpu/nodeclaim",
-                    "rule": "self.all(k, k != 'karpenter.tpu/nodeclaim')",
+                    "message": "tag contains a restricted tag matching karpenter.sh/nodeclaim",
+                    "rule": "self.all(k, k != 'karpenter.sh/nodeclaim')",
                 },
                 {
                     "message": "tag contains a restricted tag matching kubernetes.io/cluster/",
@@ -259,8 +262,8 @@ def requirement_schema() -> dict:
                 "maxLength": 316,
                 "x-kubernetes-validations": [
                     {
-                        "message": "requirement key karpenter.tpu/nodepool is restricted",
-                        "rule": "self != 'karpenter.tpu/nodepool'",
+                        "message": "requirement key karpenter.sh/nodepool is restricted",
+                        "rule": "self != 'karpenter.sh/nodepool'",
                     }
                 ],
             },

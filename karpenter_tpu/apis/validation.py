@@ -35,10 +35,12 @@ VALID_TAINT_EFFECTS = ("NoSchedule", "PreferNoSchedule", "NoExecute")
 VALID_OPERATORS = ("In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt")
 # tag namespace the controller owns; user tags may not forge it
 # (reference: ec2nodeclass tags CEL forbids kubernetes.io/cluster/*,
-# karpenter.sh/nodepool, karpenter.sh/nodeclaim, eks:eks-cluster-name)
+# karpenter.sh/nodepool, karpenter.sh/nodeclaim, eks:eks-cluster-name).
+# OUR ownership keys live under karpenter.sh (apis/labels.py NODEPOOL_LABEL,
+# providers/instance NODECLAIM_TAG) -- the rules must guard THAT namespace
 RESTRICTED_TAG_PATTERNS = (
-    re.compile(r"^karpenter\.tpu/nodepool$"),
-    re.compile(r"^karpenter\.tpu/nodeclaim$"),
+    re.compile(r"^karpenter\.sh/nodepool$"),
+    re.compile(r"^karpenter\.sh/nodeclaim$"),
     re.compile(r"^kubernetes\.io/cluster/"),
 )
 
@@ -93,7 +95,9 @@ def _check_selector_terms(
         tpath = f"{path}[{i}]"
         has_tags = bool(t.tags)
         has_id = bool(t.id)
-        has_name = bool(getattr(t, "name", "")) if allow_name or hasattr(t, "name") else False
+        # every SelectorTerm supports name-based matching (SelectorTerm.matches);
+        # allow_name only widens the "expected at least one" message
+        has_name = bool(getattr(t, "name", ""))
         has_alias = bool(getattr(t, "alias", "")) if allow_alias else False
         if not (has_tags or has_id or has_name or has_alias):
             out.append(Violation(tpath, "expected at least one selector field, got none"))
@@ -244,14 +248,21 @@ def validate_nodepool(pool) -> List[Violation]:
                 out.append(
                     Violation(
                         f"spec.disruption.budgets[{i}].nodes",
-                        "must be an integer or a percentage between 0%% and 100%%",
+                        "must be an integer or a percentage between 0% and 100%",
                     )
                 )
-    for i, t in enumerate(list(pool.template.taints) + list(pool.template.startup_taints)):
-        if t.effect and t.effect not in VALID_TAINT_EFFECTS:
-            out.append(
-                Violation(f"spec.template.taints[{i}].effect", f"must be one of {list(VALID_TAINT_EFFECTS)}")
-            )
+    for field_name, taints in (
+        ("taints", pool.template.taints),
+        ("startupTaints", pool.template.startup_taints),
+    ):
+        for i, t in enumerate(taints):
+            if t.effect and t.effect not in VALID_TAINT_EFFECTS:
+                out.append(
+                    Violation(
+                        f"spec.template.{field_name}[{i}].effect",
+                        f"must be one of {list(VALID_TAINT_EFFECTS)}",
+                    )
+                )
     _check_requirements(pool.template.requirements, "spec.template.requirements", out)
     return out
 
@@ -259,9 +270,12 @@ def validate_nodepool(pool) -> List[Violation]:
 def validate_nodeclaim(claim) -> List[Violation]:
     """NodeClaim admission invariants (karpenter.sh_nodeclaims.yaml)."""
     out: List[Violation] = []
-    for i, t in enumerate(list(claim.taints) + list(claim.startup_taints)):
-        if t.effect and t.effect not in VALID_TAINT_EFFECTS:
-            out.append(Violation(f"spec.taints[{i}].effect", f"must be one of {list(VALID_TAINT_EFFECTS)}"))
+    for field_name, taints in (("taints", claim.taints), ("startupTaints", claim.startup_taints)):
+        for i, t in enumerate(taints):
+            if t.effect and t.effect not in VALID_TAINT_EFFECTS:
+                out.append(
+                    Violation(f"spec.{field_name}[{i}].effect", f"must be one of {list(VALID_TAINT_EFFECTS)}")
+                )
     if claim.expire_after is not None and claim.expire_after < 0:
         out.append(Violation("spec.expireAfter", "may not be negative"))
     if claim.termination_grace_period is not None and claim.termination_grace_period < 0:

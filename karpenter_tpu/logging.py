@@ -31,7 +31,7 @@ from typing import Any, Dict, Optional
 ROOT = "karpenter"
 
 _RESERVED = set(
-    "name msg args levelname levelno pathname filename module exc_info "
+    "name msg args asctime levelname levelno pathname filename module exc_info "
     "exc_text stack_info lineno funcName created msecs relativeCreated "
     "thread threadName processName process taskName message".split()
 )

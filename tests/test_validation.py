@@ -124,7 +124,7 @@ class TestRoleAndProfile:
 
 class TestTagsAndDevices:
     def test_restricted_tags(self):
-        bad(TPUNodeClass("a", tags={"karpenter.tpu/nodepool": "x"}), "restricted")
+        bad(TPUNodeClass("a", tags={"karpenter.sh/nodepool": "x"}), "restricted")
         bad(TPUNodeClass("a", tags={"kubernetes.io/cluster/mine": "owned"}), "restricted")
         ok(TPUNodeClass("a", tags={"team": "ml"}))
 
@@ -202,7 +202,7 @@ class TestAdmissionSeam:
     def test_update_rejected(self):
         cluster = Cluster(clock=FakeClock(1.0))
         nc = cluster.create(TPUNodeClass("ok"))
-        nc.tags = {"karpenter.tpu/nodeclaim": "forged"}
+        nc.tags = {"karpenter.sh/nodeclaim": "forged"}
         with pytest.raises(AdmissionError, match="restricted"):
             cluster.update(nc)
 
