@@ -309,25 +309,30 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
     nodeSelector vs nodeAffinity) share a class. The single ordered pass
     preserves input order within each class -- required for exact
     differential equivalence with the oracle's stable per-pod sort."""
+    from karpenter_tpu.utils import gc_paused
+
     id_to_class: Dict[tuple, PodClass] = {}
     groups: Dict[tuple, PodClass] = {}
     id_get = id_to_class.get
-    for pod in pods:
-        sid = pod._sig_id
-        if sid is None or sid[0] != _SIG_GEN:
-            sid = pod._sig_id = _intern_sig(pod.grouping_signature())
-        pc = id_get(sid)
-        if pc is None:
-            reqs = pod.scheduling_requirements()[0]
-            if extra_requirements is not None:
-                reqs = reqs.copy().add(*extra_requirements)
-            key = _class_key(pod, reqs)
-            pc = groups.get(key)
+    # gc paused: cold grouping of 50k fresh pods allocates ~400k young
+    # containers; mid-loop generational collections multiply the cost ~6x
+    with gc_paused():
+        for pod in pods:
+            sid = pod._sig_id
+            if sid is None or sid[0] != _SIG_GEN:
+                sid = pod._sig_id = _intern_sig(pod.grouping_signature())
+            pc = id_get(sid)
             if pc is None:
-                requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
-                pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
-            id_to_class[sid] = pc
-        pc.pods.append(pod)
+                reqs = pod.scheduling_requirements()[0]
+                if extra_requirements is not None:
+                    reqs = reqs.copy().add(*extra_requirements)
+                key = _class_key(pod, reqs)
+                pc = groups.get(key)
+                if pc is None:
+                    requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
+                    pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
+                id_to_class[sid] = pc
+            pc.pods.append(pod)
     # FFD order: dominant resource descending with the canonical tie-break
     # (pod_sort_key) -- must match the oracle's sort for differential
     # equivalence, including between equal-sized classes

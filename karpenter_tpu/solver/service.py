@@ -407,64 +407,69 @@ class TPUSolver:
         zone_names = catalog.zones
         n_zones = len(zone_names)
 
-        for g in range(n_open):
-            col = take_t[g]
-            classes_on_g = np.nonzero(col > 0)[0]
-            if classes_on_g.size == 0:
-                continue
-            group_pods: List[Pod] = []
-            reqs = pool.requirements()
-            requested = Resources.from_base_units({res.PODS: 0})
-            for c in classes_on_g:
-                pc = class_set.classes[c]
-                n = int(col[c])
-                # pods before `off` went to existing nodes in phase 1
-                off = int(class_offset[c]) + int(take_cum[c, g])
-                group_pods.extend(pc.pods[off : off + n])
-                reqs.add(*pc.requirements)
-                # all pods in a class have identical requests (the canonical
-                # class key includes the scaled request vector), so the
-                # group total is one vector multiply per class, not one
-                # Resources add per pod -- decode is on the hot path
-                requested = requested + (
-                    pc.pods[0].requests + Resources.from_base_units({res.PODS: 1})
-                ) * n
-            group_types = types_by_price[gmask_real[g][order]].tolist()
-            if not group_types:
-                for p in group_pods:
-                    result.unschedulable[p.metadata.name] = "no surviving instance type"
-                continue
-            zones = [zone_names[z] for z in np.nonzero(gzone[g][:n_zones])[0]]
-            captypes = [captype_names[i] for i in np.nonzero(gcap[g])[0]]
-            # a full mask is no constraint: the oracle's groups carry no
-            # zone/captype requirement when the pods imposed none
-            if zones and len(zones) < n_zones:
-                reqs.add(Requirement(wk.ZONE_LABEL, Operator.IN, zones))
-            if captypes and len(captypes) < len(captype_names):
-                reqs.add(Requirement(wk.CAPACITY_TYPE_LABEL, Operator.IN, captypes))
-            # nodepool limits (host-side guard, mirroring the oracle)
-            if limited:
-                smallest = min(group_types, key=lambda it: it.capacity.get(res.CPU))
-                if not (usage + smallest.capacity).fits(pool.limits):
-                    for p in group_pods:
-                        result.unschedulable[p.metadata.name] = f"nodepool {pool.name} limits exceeded"
+        from karpenter_tpu.utils import gc_paused
+
+        # gc paused across the allocation-heavy per-group loop (same
+        # rationale as encode.group_pods)
+        with gc_paused():
+            for g in range(n_open):
+                col = take_t[g]
+                classes_on_g = np.nonzero(col > 0)[0]
+                if classes_on_g.size == 0:
                     continue
-                usage = usage + smallest.capacity
-            result.new_groups.append(
-                NewNodeGroup(
-                    nodepool=pool,
-                    requirements=reqs,
-                    instance_types=group_types,
-                    taints=list(pool.template.taints),
-                    pods=group_pods,
-                    requested=requested,
+                group_pods: List[Pod] = []
+                reqs = pool.requirements()
+                requested = Resources.from_base_units({res.PODS: 0})
+                for c in classes_on_g:
+                    pc = class_set.classes[c]
+                    n = int(col[c])
+                    # pods before `off` went to existing nodes in phase 1
+                    off = int(class_offset[c]) + int(take_cum[c, g])
+                    group_pods.extend(pc.pods[off : off + n])
+                    reqs.add(*pc.requirements)
+                    # all pods in a class have identical requests (the canonical
+                    # class key includes the scaled request vector), so the
+                    # group total is one vector multiply per class, not one
+                    # Resources add per pod -- decode is on the hot path
+                    requested = requested + (
+                        pc.pods[0].requests + Resources.from_base_units({res.PODS: 1})
+                    ) * n
+                group_types = types_by_price[gmask_real[g][order]].tolist()
+                if not group_types:
+                    for p in group_pods:
+                        result.unschedulable[p.metadata.name] = "no surviving instance type"
+                    continue
+                zones = [zone_names[z] for z in np.nonzero(gzone[g][:n_zones])[0]]
+                captypes = [captype_names[i] for i in np.nonzero(gcap[g])[0]]
+                # a full mask is no constraint: the oracle's groups carry no
+                # zone/captype requirement when the pods imposed none
+                if zones and len(zones) < n_zones:
+                    reqs.add(Requirement(wk.ZONE_LABEL, Operator.IN, zones))
+                if captypes and len(captypes) < len(captype_names):
+                    reqs.add(Requirement(wk.CAPACITY_TYPE_LABEL, Operator.IN, captypes))
+                # nodepool limits (host-side guard, mirroring the oracle)
+                if limited:
+                    smallest = min(group_types, key=lambda it: it.capacity.get(res.CPU))
+                    if not (usage + smallest.capacity).fits(pool.limits):
+                        for p in group_pods:
+                            result.unschedulable[p.metadata.name] = f"nodepool {pool.name} limits exceeded"
+                        continue
+                    usage = usage + smallest.capacity
+                result.new_groups.append(
+                    NewNodeGroup(
+                        nodepool=pool,
+                        requirements=reqs,
+                        instance_types=group_types,
+                        taints=list(pool.template.taints),
+                        pods=group_pods,
+                        requested=requested,
+                    )
                 )
-            )
-        for c in range(class_set.c_real):
-            n_un = int(unplaced[c])
-            if n_un > 0:
-                pc = class_set.classes[c]
-                placed = int(class_offset[c]) + int(take[c].sum())
-                for p in pc.pods[placed : placed + n_un]:
-                    result.unschedulable[p.metadata.name] = "no instance type fits pod requirements"
-        return result
+            for c in range(class_set.c_real):
+                n_un = int(unplaced[c])
+                if n_un > 0:
+                    pc = class_set.classes[c]
+                    placed = int(class_offset[c]) + int(take[c].sum())
+                    for p in pc.pods[placed : placed + n_un]:
+                        result.unschedulable[p.metadata.name] = "no instance type fits pod requirements"
+            return result
