@@ -54,13 +54,20 @@ class Provisioner:
                     used=self.cluster.node_usage(node.metadata.name),
                 )
             )
-        # launched-but-not-ready claims are virtual capacity
+        # launched-but-not-YET-ready claims are virtual capacity
         for claim in self.cluster.list(NodeClaim):
             if claim.deleting or not claim.launched():
                 continue
             node = self.cluster.node_for_nodeclaim(claim)
             if node is not None and node.ready:
                 continue  # already counted above
+            if claim.initialized() and node is not None:
+                # the node initialized and LOST readiness: an unhealthy node
+                # awaiting repair, not in-flight capacity. Counting it as an
+                # empty virtual node wedges provisioning -- pending pods
+                # simulate onto it every tick while the binder (correctly)
+                # refuses to bind to a NotReady node.
+                continue
             labels = dict(claim.metadata.labels)
             labels.update(claim.requirements.labels())
             out.append(

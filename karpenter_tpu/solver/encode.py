@@ -35,6 +35,7 @@ import numpy as np
 from karpenter_tpu.apis import Pod, labels as wk
 from karpenter_tpu.providers.instancetype.types import InstanceType
 from karpenter_tpu.scheduling import Requirements, Taint, tolerates_all
+from karpenter_tpu.utils import gc_paused
 from karpenter_tpu.scheduling import resources as res
 
 # -- static solver shape parameters (XLA wants fixed shapes) -----------------
@@ -309,8 +310,6 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
     nodeSelector vs nodeAffinity) share a class. The single ordered pass
     preserves input order within each class -- required for exact
     differential equivalence with the oracle's stable per-pod sort."""
-    from karpenter_tpu.utils import gc_paused
-
     id_to_class: Dict[tuple, PodClass] = {}
     groups: Dict[tuple, PodClass] = {}
     id_get = id_to_class.get

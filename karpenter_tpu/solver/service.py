@@ -27,6 +27,7 @@ from karpenter_tpu.scheduling import resources as res
 from karpenter_tpu.solver import encode, ffd
 from karpenter_tpu.solver.encode import CatalogTensors
 from karpenter_tpu.solver.oracle import NewNodeGroup, Scheduler, SchedulingResult
+from karpenter_tpu.utils import gc_paused
 
 
 _bucket = encode.bucket
@@ -406,8 +407,6 @@ class TPUSolver:
         gmask_real = gmask[:, : catalog.k_real]
         zone_names = catalog.zones
         n_zones = len(zone_names)
-
-        from karpenter_tpu.utils import gc_paused
 
         # gc paused across the allocation-heavy per-group loop (same
         # rationale as encode.group_pods)

@@ -1,0 +1,126 @@
+"""Soak: a long randomized mixed scenario over the kwok rig.
+
+The reference's scale/soak tooling (test/hack/soak, test/suites/integration)
+drives a live cluster through provisioning, disruption, interruption, and
+repair while watching for invariant violations. This is that shape on the
+in-memory rig: a seeded random event stream (pod bursts, pod deletions,
+spot interruptions, instance kills, degradations, clock jumps) with
+invariants checked EVERY tick:
+
+  - a bound pod's node exists
+  - no two claims share a provider id
+  - node usage never exceeds allocatable
+  - every live cloud instance is owned by a claim (eventually GC'd)
+  - the event stream always settles back to zero pending pods
+"""
+import json
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Node, Pod, TPUNodeClass
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.controllers.disruption import MIN_NODE_LIFETIME
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
+from karpenter_tpu.solver.service import TPUSolver
+from karpenter_tpu.utils import parse_instance_id
+
+
+def check_invariants(op):
+    nodes = {n.metadata.name: n for n in op.cluster.list(Node)}
+    claims = op.cluster.list(NodeClaim)
+    # bound pods point at live nodes
+    for p in op.cluster.list(Pod):
+        if p.node_name:
+            assert p.node_name in nodes, f"pod {p.metadata.name} bound to ghost node {p.node_name}"
+    # provider ids unique across claims
+    pids = [c.provider_id for c in claims if c.provider_id]
+    assert len(pids) == len(set(pids)), "duplicate provider ids across claims"
+    # node usage within allocatable
+    for name, node in nodes.items():
+        used = op.cluster.node_usage(name)
+        assert used.fits(node.allocatable), f"node {name} over-committed: {used}"
+
+
+def spot_msg(iid):
+    return json.dumps({
+        "version": "0", "source": "cloud.compute",
+        "detail-type": "Spot Instance Interruption Warning",
+        "detail": {"instance-id": iid, "instance-action": "terminate"},
+    })
+
+
+@pytest.mark.parametrize("seed", [11, 23])
+def test_soak_mixed_event_stream(seed):
+    rng = np.random.default_rng(seed)
+    op = Operator(
+        clock=FakeClock(50_000.0),
+        solver=TPUSolver(g_max=256),
+        consolidation_evaluator=ConsolidationEvaluator(),
+    )
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    pod_seq = 0
+    sizes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi")]
+
+    for round_i in range(12):
+        event = rng.choice(["burst", "shrink", "interrupt", "kill", "degrade", "age"])
+        if event == "burst":
+            n = int(rng.integers(3, 20))
+            for _ in range(n):
+                cpu, mem = sizes[int(rng.integers(0, len(sizes)))]
+                op.cluster.create(
+                    Pod(f"soak-{seed}-{pod_seq}", requests=Resources({"cpu": cpu, "memory": mem}))
+                )
+                pod_seq += 1
+        elif event == "shrink":
+            running = [p for p in op.cluster.list(Pod) if p.node_name]
+            for p in running[: int(rng.integers(0, max(1, len(running) // 2)))]:
+                p.metadata.finalizers = []
+                op.cluster.delete(Pod, p.metadata.name)
+        elif event == "interrupt":
+            claims = [c for c in op.cluster.list(NodeClaim) if c.provider_id and not c.deleting]
+            if claims:
+                victim = claims[int(rng.integers(0, len(claims)))]
+                op.cloud.send(spot_msg(parse_instance_id(victim.provider_id)))
+        elif event == "kill":
+            insts = [i for i in op.cloud.describe_instances() if i.state == "running"]
+            if insts:
+                op.cloud.kill_instance(insts[int(rng.integers(0, len(insts)))].id)
+        elif event == "degrade":
+            insts = [i for i in op.cloud.describe_instances() if i.state == "running"]
+            if insts:
+                op.cloud.degrade_instance(insts[int(rng.integers(0, len(insts)))].id)
+                # jump past the repair toleration so the sweep acts this round
+                op.clock.step(31 * 60.0)
+        elif event == "age":
+            op.clock.step(MIN_NODE_LIFETIME + 120)
+
+        # settle with invariant checks every tick
+        for _ in range(40):
+            op.tick()
+            check_invariants(op)
+            if not op.cluster.pending_pods():
+                break
+            op.clock.step(3.0)
+        assert not op.cluster.pending_pods(), f"round {round_i} ({event}) never settled"
+
+    # drain-down: delete all pods, age, and let consolidation/emptiness
+    # reclaim the fleet
+    for p in op.cluster.list(Pod):
+        p.metadata.finalizers = []
+        op.cluster.delete(Pod, p.metadata.name)
+    op.clock.step(MIN_NODE_LIFETIME + 120)
+    for _ in range(30):
+        op.tick()
+        check_invariants(op)
+        op.clock.step(10.0)
+    live_claims = [c for c in op.cluster.list(NodeClaim) if not c.deleting]
+    assert len(live_claims) <= 1, f"fleet not reclaimed: {[c.metadata.name for c in live_claims]}"
+    # no orphaned cloud instances remain past GC
+    claimed = {c.provider_id for c in op.cluster.list(NodeClaim)}
+    for inst in op.cloud.describe_instances():
+        if inst.state == "running":
+            assert inst.provider_id in claimed, f"orphan instance {inst.id}"
