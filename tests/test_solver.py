@@ -337,3 +337,106 @@ class TestSolveGuards:
         solver = TPUSolver()
         with pytest.raises(ValueError, match="out-of-scope spread"):
             solver.solve(NodePool("default"), [], [pod])
+
+
+class TestDifferentialFuzz:
+    """Broad randomized differential sweep through the FULL routing entry
+    point: selectors, capacity-type pins, zone pins, tolerations, existing
+    nodes with bound pods, zone spread, and nodepool weights all mixed in
+    one pending set. Every decision the device path makes must match the
+    oracle's exactly (packing signature + existing assignments +
+    unschedulable sets)."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_mixed_constraints(self, catalog_items, seed):
+        import copy
+
+        from karpenter_tpu.apis.pod import TopologySpreadConstraint
+        from karpenter_tpu.solver.oracle import ExistingNode
+
+        rng = np.random.default_rng(9000 + seed)
+        zones = sorted({o.zone for it in catalog_items for o in it.available_offerings()})
+
+        pods = []
+        use_spread = rng.random() < 0.5
+        for t in range(int(rng.integers(3, 10))):
+            cpu_m = int(rng.choice([100, 250, 500, 1000, 2000, 3000]))
+            mem_mi = int(rng.choice([128, 512, 1024, 4096]))
+            selector = {}
+            u = rng.random()
+            if u < 0.2:
+                selector[wk.ZONE_LABEL] = zones[int(rng.integers(0, len(zones)))]
+            elif u < 0.35:
+                selector[wk.CAPACITY_TYPE_LABEL] = "on-demand"
+            elif u < 0.45:
+                selector[wk.ARCH_LABEL] = "arm64" if rng.random() < 0.5 else "amd64"
+            tolerations = []
+            if rng.random() < 0.15:
+                tolerations.append(Toleration(key="dedicated", operator="Exists"))
+            spread = []
+            if use_spread and rng.random() < 0.4 and not selector:
+                spread = [
+                    TopologySpreadConstraint(
+                        max_skew=int(rng.choice([1, 2])),
+                        topology_key=wk.ZONE_LABEL,
+                        label_selector={"app": f"w{t}"},
+                    )
+                ]
+            for i in range(int(rng.integers(1, 7))):
+                pods.append(
+                    Pod(
+                        f"f{seed}-{t}-{i}",
+                        requests=Resources.from_base_units(
+                            {res.CPU: float(cpu_m), res.MEMORY: float(mem_mi) * 2**20}
+                        ),
+                        node_selector=selector,
+                        tolerations=tolerations,
+                        labels={"app": f"w{t}"},
+                        topology_spread=spread,
+                    )
+                )
+
+        existing = []
+        pods_by_node = {}
+        for ni in range(int(rng.integers(0, 4))):
+            z = zones[int(rng.integers(0, len(zones)))]
+            node = ExistingNode(
+                name=f"f{seed}-n{ni}",
+                labels={wk.ZONE_LABEL: z, wk.ARCH_LABEL: "amd64"},
+                allocatable=Resources.from_base_units(
+                    {res.CPU: 4000.0, res.MEMORY: 8.0 * 2**30, res.PODS: 20}
+                ),
+            )
+            existing.append(node)
+            bound = [
+                Pod(f"f{seed}-b{ni}-{j}",
+                    requests=Resources.from_base_units(
+                        {res.CPU: 200.0, res.MEMORY: 128.0 * 2**20}
+                    ),
+                    labels={"app": "resident"})
+                for j in range(int(rng.integers(0, 3)))
+            ]
+            pods_by_node[node.name] = bound
+            # residents consume real capacity: near-full-node fitting is
+            # part of what the differential must cover
+            for bp in bound:
+                node.used = node.used + bp.requests + Resources.from_base_units({res.PODS: 1})
+
+        pool = NodePool("default")
+
+        def mk():
+            return Scheduler(
+                nodepools=[pool],
+                instance_types={pool.name: catalog_items},
+                existing_nodes=copy.deepcopy(existing),
+                pods_by_node=pods_by_node,
+                zones=set(zones),
+            )
+
+        oracle = mk().schedule(list(pods))
+        device = TPUSolver(g_max=256).schedule(mk(), list(pods))
+        assert set(oracle.unschedulable) == set(device.unschedulable), f"seed {seed}"
+        assert sorted(oracle.existing_assignments.items()) == sorted(
+            device.existing_assignments.items()
+        ), f"seed {seed}"
+        assert _signature(oracle) == _signature(device), f"seed {seed}"
