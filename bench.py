@@ -204,7 +204,7 @@ def _stage_breakdown(solver, pool, items, pods):
     t0 = time.perf_counter()
     inp = ffd.make_inputs_staged(staged, cs)
     dec = ffd.ffd_solve_compact(
-        inp, g_max=solver.g_max, nnz_max=cs.c_pad + 4 * solver.g_max,
+        inp, g_max=solver.g_max, nnz_max=ffd.nnz_budget(cs.c_pad, solver.g_max),
         word_offsets=offsets, words=words, use_pallas=solver.use_pallas,
         objective=solver.objective,
     )

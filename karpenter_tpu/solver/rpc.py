@@ -140,6 +140,8 @@ class SolverServer:
                 self._op_stage(sock, header, tensors)
             elif op == "solve":
                 self._op_solve(sock, header, tensors)
+            elif op == "solve_compact":
+                self._op_solve_compact(sock, header, tensors)
             else:
                 _send_frame(sock, {"ok": False, "error": f"unknown op {op!r}"})
         except Exception as e:  # noqa: BLE001 -- errors cross the wire
@@ -162,9 +164,10 @@ class SolverServer:
             self._staged[seqnum] = _StagedEntry(staged, offsets, words)
         _send_frame(sock, {"ok": True, "seqnum": seqnum})
 
-    def _op_solve(self, sock, header: dict, t: Dict[str, np.ndarray]) -> None:
-        import jax
-
+    def _staged_inputs(self, sock, header: dict, t: Dict[str, np.ndarray]):
+        """(entry, SolveInputs) for the staged catalog named by the header's
+        seqnum (LRU-touched), or None after sending the unknown-seqnum error
+        (the client re-stages on that contract)."""
         seqnum = str(header["seqnum"])
         with self._lock:
             entry = self._staged.get(seqnum)
@@ -174,9 +177,8 @@ class SolverServer:
                 self._staged.pop(seqnum)
                 self._staged[seqnum] = entry
         if entry is None:
-            # the client re-stages on this error (cache-miss contract)
             _send_frame(sock, {"ok": False, "error": "unknown-seqnum"})
-            return
+            return None
         inp = ffd.SolveInputs(
             cap=entry.staged.cap, tcode=entry.staged.tcode, tnum=entry.staged.tnum,
             tnum_present=entry.staged.tnum_present, tzone=entry.staged.tzone,
@@ -185,6 +187,15 @@ class SolverServer:
             allowed=t["allowed"], num_lo=t["num_lo"], num_hi=t["num_hi"],
             azone=t["azone"], acap=t["acap"], schedulable=t["schedulable"],
         )
+        return entry, inp
+
+    def _op_solve(self, sock, header: dict, t: Dict[str, np.ndarray]) -> None:
+        import jax
+
+        hit = self._staged_inputs(sock, header, t)
+        if hit is None:
+            return
+        entry, inp = hit
         out = ffd.ffd_solve(
             inp, g_max=int(header["g_max"]),
             word_offsets=entry.offsets, words=entry.words,
@@ -195,6 +206,29 @@ class SolverServer:
         _send_frame(
             sock, {"ok": True},
             [(n, np.asarray(a)) for n, a in zip(names, arrays)],
+        )
+
+    def _op_solve_compact(self, sock, header: dict, t: Dict[str, np.ndarray]) -> None:
+        """The wire-efficient solve: the decision returns as a
+        CompactDecision (~50 KB) instead of the dense SolveOutputs
+        (~1.5 MB) -- this boundary exists for the TPU-VM topology where the
+        link is exactly the bandwidth-poor hop the compact layout is for."""
+        import jax
+
+        hit = self._staged_inputs(sock, header, t)
+        if hit is None:
+            return
+        entry, inp = hit
+        dec = ffd.ffd_solve_compact(
+            inp, g_max=int(header["g_max"]), nnz_max=int(header["nnz_max"]),
+            word_offsets=entry.offsets, words=entry.words,
+            objective=str(header.get("objective", "price")),
+        )
+        arrays = jax.device_get(tuple(dec))
+        names = ffd.CompactDecision._fields
+        _send_frame(
+            sock, {"ok": True},
+            [(n, np.atleast_1d(np.asarray(a))) for n, a in zip(names, arrays)],
         )
 
 
@@ -261,31 +295,63 @@ class SolverClient:
         with self._lock:
             self._staged_seqnums.add(seqnum)
 
-    def solve_classes(
-        self, seqnum: str, catalog: encode.CatalogTensors, class_set: encode.PodClassSet,
-        g_max: int = 512, objective: str = "price",
-    ) -> ffd.SolveOutputs:
+    @staticmethod
+    def _class_tensors(class_set: encode.PodClassSet):
+        """The pod-class tensor list both solve ops ship (ONE copy: a new
+        class tensor must appear here or the dense and compact paths
+        desynchronize)."""
+        return [
+            ("req", class_set.req), ("count", class_set.count),
+            ("env_count", class_set.env_count),
+            ("allowed", np.concatenate(class_set.allowed, axis=1)),
+            ("num_lo", class_set.num_lo), ("num_hi", class_set.num_hi),
+            ("azone", class_set.azone), ("acap", class_set.acap),
+            ("schedulable", class_set.schedulable),
+        ]
+
+    def _solve_op(self, op_header: dict, seqnum: str, catalog, class_set):
+        """Shared stage-if-needed + solve + unknown-seqnum retry."""
         with self._lock:  # atomic stage-then-solve (reentrant)
             if seqnum not in self._staged_seqnums:
                 self.stage_catalog(seqnum, catalog)
-            header = {"op": "solve", "seqnum": seqnum, "g_max": g_max, "objective": objective}
-            tensors = [
-                ("req", class_set.req), ("count", class_set.count),
-                ("env_count", class_set.env_count),
-                ("allowed", np.concatenate(class_set.allowed, axis=1)),
-                ("num_lo", class_set.num_lo), ("num_hi", class_set.num_hi),
-                ("azone", class_set.azone), ("acap", class_set.acap),
-                ("schedulable", class_set.schedulable),
-            ]
-            resp, out = self._roundtrip(header, tensors)
+            tensors = self._class_tensors(class_set)
+            resp, out = self._roundtrip(op_header, tensors)
             if not resp.get("ok"):
                 if resp.get("error") == "unknown-seqnum":
                     # server restarted / evicted: re-stage once and retry
                     self.stage_catalog(seqnum, catalog)
-                    resp, out = self._roundtrip(header, tensors)
+                    resp, out = self._roundtrip(op_header, tensors)
                 if not resp.get("ok"):
                     raise RuntimeError(f"solve failed: {resp.get('error')}")
-            return ffd.SolveOutputs(**{n: out[n] for n in ffd.SolveOutputs._fields})
+            return out
+
+    def solve_classes(
+        self, seqnum: str, catalog: encode.CatalogTensors, class_set: encode.PodClassSet,
+        g_max: int = 512, objective: str = "price",
+    ) -> ffd.SolveOutputs:
+        header = {"op": "solve", "seqnum": seqnum, "g_max": g_max, "objective": objective}
+        out = self._solve_op(header, seqnum, catalog, class_set)
+        return ffd.SolveOutputs(**{n: out[n] for n in ffd.SolveOutputs._fields})
+
+    def solve_classes_compact(
+        self, seqnum: str, catalog: encode.CatalogTensors, class_set: encode.PodClassSet,
+        g_max: int = 1024, nnz_max: int = 0, objective: str = "price",
+    ) -> ffd.CompactDecision:
+        """The ~50 KB response variant of solve_classes (the deployed
+        TPU-VM topology's hot path); the caller expands with
+        ffd.expand_compact and falls back to solve_classes on overflow."""
+        if not nnz_max:
+            nnz_max = ffd.nnz_budget(class_set.c_pad, g_max)
+        header = {
+            "op": "solve_compact", "seqnum": seqnum, "g_max": g_max,
+            "nnz_max": nnz_max, "objective": objective,
+        }
+        out = self._solve_op(header, seqnum, catalog, class_set)
+        fields = {n: out[n] for n in ffd.CompactDecision._fields}
+        # scalars travel as 1-element arrays
+        fields["nnz"] = fields["nnz"].reshape(())
+        fields["n_open"] = fields["n_open"].reshape(())
+        return ffd.CompactDecision(**fields)
 
 
 def serve_main(argv=None) -> int:

@@ -303,19 +303,36 @@ class TPUSolver:
         class_set.count = counts
         dense = None
         if self.client is not None:
-            out = self.client.solve_classes(
-                seqnum, catalog, class_set, g_max=self.g_max, objective=self.objective
-            )
-            dense = (
-                np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
-                np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
-            )
+            # compact over the wire too: this seam exists for the TPU-VM
+            # topology where the link IS the bandwidth-poor hop
+            try:
+                dec = self.client.solve_classes_compact(
+                    seqnum, catalog, class_set, g_max=self.g_max, objective=self.objective,
+                )
+                dense = ffd.expand_compact(
+                    dec, class_set.c_pad, self.g_max, catalog.k_pad, encode.Z_PAD, encode.CT
+                )
+            except RuntimeError as e:
+                if "unknown op" not in str(e):
+                    raise
+                # version skew: an older sidecar without solve_compact must
+                # not take scheduling down -- degrade to the dense op
+                dense = None
+            if dense is None:
+                # sparse budget overflow: dense refetch over the wire
+                out = self.client.solve_classes(
+                    seqnum, catalog, class_set, g_max=self.g_max, objective=self.objective
+                )
+                dense = (
+                    np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
+                    np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
+                )
         else:
             inp = ffd.make_inputs_staged(staged, class_set)
             # compact decision: ~50 KB over the (bandwidth-poor) device
             # tunnel instead of the ~1.5 MB dense SolveOutputs
             dec = ffd.ffd_solve_compact(
-                inp, g_max=self.g_max, nnz_max=class_set.c_pad + 4 * self.g_max,
+                inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(class_set.c_pad, self.g_max),
                 word_offsets=offsets, words=words,
                 use_pallas=self.use_pallas, objective=self.objective,
             )
