@@ -58,6 +58,13 @@ class NodeClassController:
     ):
         from karpenter_tpu.providers.instanceprofile import InstanceProfileProvider
 
+        from karpenter_tpu.cache.ttl import TTLCache
+
+        # validation results are cloud-state dependent (profile existence),
+        # so the cache is TTL'd like the reference's (validation.go): a
+        # fixed spec re-validates every 10 minutes, picking up cloud-side
+        # fixes without a spec edit or restart
+        self._validation_cache = TTLCache(default_ttl=10 * 60.0, clock=clock)
         self.monitor = ChangeMonitor()  # per-instance: dedup state must not
         # leak across operators (tests, in-process restarts)
         self.cluster = cluster
@@ -185,16 +192,47 @@ class NodeClassController:
         nc.status_conditions.set_true(COND_INSTANCE_PROFILE_READY)
 
     def _reconcile_validation(self, nc: TPUNodeClass) -> None:
-        """Authorization/launchability dry-run analogue (reference:
-        nodeclass/validation.go does cached dry-run auth checks)."""
+        """Launchability dry-run (reference: nodeclass/validation.go does
+        cached dry-run authorization/launch checks, keyed by the nodeclass
+        hash so they don't re-run every reconcile). Static spec invariants
+        belong to admission (apis/validation.py); this stage owns the
+        checks that need the CLOUD or the render pipeline:
+          - userdata must render for the image family (bad user TOML would
+            otherwise only fail at launch time)
+          - a USER-specified instance profile must actually exist (the
+            managed path creates its own)"""
+        cache_key = nc.static_hash()
+        hit, fresh = self._validation_cache.get(nc.metadata.name)
+        if fresh and hit[0] == cache_key:
+            ok, message = hit[1], hit[2]
+            if ok:
+                nc.status_conditions.set_true(COND_VALIDATION_SUCCEEDED)
+            else:
+                nc.status_conditions.set_false(COND_VALIDATION_SUCCEEDED, "ValidationFailed", message)
+            return
         problems = []
-        if nc.metadata_http_tokens not in ("required", "optional"):
-            problems.append(f"invalid metadata_http_tokens {nc.metadata_http_tokens!r}")
-        for b in nc.block_device_mappings:
-            if b.volume_size_gib <= 0:
-                problems.append(f"block device {b.device_name} has non-positive size")
+        from karpenter_tpu.providers.launchtemplate import bootstrap
+
+        try:
+            bootstrap.render(
+                nc.image_family,
+                cluster_name="validation",
+                endpoint="https://validation.invalid",
+                ca_bundle="validation",
+                nodeclass=nc,
+                labels={},
+                taints=[],
+                max_pods=None,
+            )
+        except ValueError as e:
+            problems.append(f"userdata does not render: {e}")
+        if nc.instance_profile:
+            if self.identity_api.get_instance_profile(nc.instance_profile) is None:
+                problems.append(f"instance profile {nc.instance_profile!r} not found")
+        message = "; ".join(problems)
+        self._validation_cache.set(nc.metadata.name, (cache_key, not problems, message))
         if problems:
-            nc.status_conditions.set_false(COND_VALIDATION_SUCCEEDED, "ValidationFailed", "; ".join(problems))
+            nc.status_conditions.set_false(COND_VALIDATION_SUCCEEDED, "ValidationFailed", message)
         else:
             nc.status_conditions.set_true(COND_VALIDATION_SUCCEEDED)
 
@@ -213,4 +251,6 @@ class NodeClassController:
             self.launch_templates.delete_all(nc)
         if not nc.instance_profile:  # only delete profiles we created
             self.instance_profiles.delete(nc.name)
+        # a recreated nodeclass of the same name must re-validate
+        self._validation_cache.delete(nc.metadata.name)
         self.cluster.remove_finalizer(nc, TERMINATION_FINALIZER)

@@ -232,3 +232,52 @@ class TestNodeClassLifecycle:
         env.lifecycle.step()
         assert env.cluster.pending_pods()  # pod back to pending
         assert not env.cluster.list(Node)
+
+
+class TestNodeClassValidationDryRun:
+    def test_bad_user_toml_fails_validation(self, env):
+        from karpenter_tpu.apis.nodeclass import COND_VALIDATION_SUCCEEDED
+
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.image_family = "Immutable"
+        nc.user_data = "[settings\nbroken = "
+        env.cluster.update(nc)
+        env.tick()
+        nc = env.cluster.get(TPUNodeClass, "default")
+        assert nc.status_conditions.is_false(COND_VALIDATION_SUCCEEDED)
+        cond = nc.status_conditions.get(COND_VALIDATION_SUCCEEDED)
+        assert "does not render" in cond.message
+        # a nodeclass failing validation blocks launches
+        env.cluster.create(make_pods(1, prefix="blocked")[0])
+        env.settle(max_ticks=3)
+        assert env.cluster.pending_pods()
+
+    def test_missing_user_profile_fails_validation(self, env):
+        from karpenter_tpu.apis.nodeclass import COND_VALIDATION_SUCCEEDED
+
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.role = ""
+        nc.instance_profile = "no-such-profile"
+        env.cluster.update(nc)
+        env.tick()
+        nc = env.cluster.get(TPUNodeClass, "default")
+        assert nc.status_conditions.is_false(COND_VALIDATION_SUCCEEDED)
+
+    def test_validation_result_cached_by_hash(self, env):
+        from karpenter_tpu.apis.nodeclass import COND_VALIDATION_SUCCEEDED
+
+        env.tick()
+        calls_before = env.cloud.calls.get("get_instance_profile", 0)
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.role = ""
+        nc.instance_profile = "real-profile"
+        env.cloud.create_instance_profile("real-profile", {})
+        env.cluster.update(nc)
+        env.tick()
+        env.tick()
+        env.tick()
+        # the existence check ran once for the new hash, not per tick
+        calls = env.cloud.calls.get("get_instance_profile", 0) - calls_before
+        assert calls == 1, calls
+        nc = env.cluster.get(TPUNodeClass, "default")
+        assert nc.status_conditions.is_true(COND_VALIDATION_SUCCEEDED)
