@@ -39,7 +39,6 @@ CPU backend and says so in the JSON ("platform": "cpu", "degraded": true).
 
 Usage: python bench.py            (one JSON line on stdout)
        python bench.py --profile  (extra breakdown on stderr)
-       python bench.py --pallas   (use the fused pallas step kernel)
        python bench.py --cpu      (skip the probe, force host CPU)
 """
 from __future__ import annotations
@@ -205,8 +204,7 @@ def _stage_breakdown(solver, pool, items, pods):
     inp = ffd.make_inputs_staged(staged, cs)
     dec = ffd.ffd_solve_compact(
         inp, g_max=solver.g_max, nnz_max=ffd.nnz_budget(cs.c_pad, solver.g_max),
-        word_offsets=offsets, words=words, use_pallas=solver.use_pallas,
-        objective=solver.objective,
+        word_offsets=offsets, words=words, objective=solver.objective,
     )
     jax.block_until_ready(dec)
     t["device_solve"] = time.perf_counter() - t0
@@ -221,27 +219,20 @@ def _stage_breakdown(solver, pool, items, pods):
         # sparse-budget overflow: mirror the production dense refetch
         dense = ffd.solve_dense_tuple(
             inp, g_max=solver.g_max, word_offsets=offsets, words=words,
-            use_pallas=solver.use_pallas, objective=solver.objective,
+            objective=solver.objective,
         )
     solver._decode(pool, items, catalog, cs, dense, None)
     t["decode"] = time.perf_counter() - t0
     return {k: round(v * 1e3, 2) for k, v in t.items()}, len(classes)
 
 
-def run(profile: bool, use_pallas: bool):
+def run(profile: bool):
     import jax
 
     from karpenter_tpu.apis import NodePool
     from karpenter_tpu.solver.service import TPUSolver
 
     backend = jax.default_backend()
-    if use_pallas and backend != "tpu":
-        print(
-            "# --pallas off-TPU runs the INTERPRETER (orders of magnitude "
-            "slower than either real lowering); timings below are not the "
-            "kernel's",
-            file=sys.stderr,
-        )
 
     t0 = time.perf_counter()
     items, cloud = build_catalog_items()
@@ -249,7 +240,7 @@ def run(profile: bool, use_pallas: bool):
     t_catalog = time.perf_counter() - t0
 
     pool = NodePool("default")
-    solver = TPUSolver(g_max=G_MAX, use_pallas=use_pallas)
+    solver = TPUSolver(g_max=G_MAX)
 
     rng = np.random.default_rng(42)
     t0 = time.perf_counter()
@@ -318,7 +309,7 @@ def run(profile: bool, use_pallas: bool):
     # (VERDICT round 2, item 3: price drop at equal placement count)
     result = solve(workloads[0])
     fleet_price = sum(g.instance_types[0].cheapest_price() for g in result.new_groups)
-    fit_solver = TPUSolver(g_max=G_MAX, use_pallas=use_pallas, objective="fit")
+    fit_solver = TPUSolver(g_max=G_MAX, objective="fit")
     fit_result = fit_solver.solve(pool, items, workloads[0])
     fit_placed = sum(len(g.pods) for g in fit_result.new_groups)
     fit_price = sum(g.instance_types[0].cheapest_price() for g in fit_result.new_groups)
@@ -360,7 +351,6 @@ def run(profile: bool, use_pallas: bool):
 
 def main() -> None:
     profile = "--profile" in sys.argv
-    use_pallas = "--pallas" in sys.argv
     force_cpu = "--cpu" in sys.argv
 
     degraded = False
@@ -380,7 +370,7 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     try:
-        out = run(profile, use_pallas)
+        out = run(profile)
         if degraded:
             out["degraded"] = True
             out["probe_error"] = (probe_err or "")[:300]

@@ -205,10 +205,7 @@ class NodeClassController:
         hit, fresh = self._validation_cache.get(nc.metadata.name)
         if fresh and hit[0] == cache_key:
             ok, message = hit[1], hit[2]
-            if ok:
-                nc.status_conditions.set_true(COND_VALIDATION_SUCCEEDED)
-            else:
-                nc.status_conditions.set_false(COND_VALIDATION_SUCCEEDED, "ValidationFailed", message)
+            self._set_validation_condition(nc, ok, message)
             return
         problems = []
         from karpenter_tpu.providers.launchtemplate import bootstrap
@@ -231,10 +228,14 @@ class NodeClassController:
                 problems.append(f"instance profile {nc.instance_profile!r} not found")
         message = "; ".join(problems)
         self._validation_cache.set(nc.metadata.name, (cache_key, not problems, message))
-        if problems:
-            nc.status_conditions.set_false(COND_VALIDATION_SUCCEEDED, "ValidationFailed", message)
-        else:
+        self._set_validation_condition(nc, not problems, message)
+
+    @staticmethod
+    def _set_validation_condition(nc: TPUNodeClass, ok: bool, message: str) -> None:
+        if ok:
             nc.status_conditions.set_true(COND_VALIDATION_SUCCEEDED)
+        else:
+            nc.status_conditions.set_false(COND_VALIDATION_SUCCEEDED, "ValidationFailed", message)
 
     # -- finalizer ----------------------------------------------------------
     def _finalize(self, nc: TPUNodeClass) -> None:

@@ -21,7 +21,11 @@ The TPU reformulation of the core scheduler's sequential FFD loop
   bool einsums -- the scan body stays VPU-only with no dtype conversions
 
 Everything is static-shaped; instances are padded into (C, G, K) buckets and
-compiled once per bucket. All resource values are small exact integers in
+compiled once per bucket. (A hand-written pallas step kernel was carried for
+two rounds and removed: it existed to keep the fit computation lane-aligned,
+which the R-unrolled `_fit_counts` formulation achieves in plain XLA; the
+kernel never validated on hardware and added a static-arg axis to every jit
+signature.) All resource values are small exact integers in
 float32 (encode.py scaling), so fit arithmetic is exact and differentially
 testable against the Python oracle.
 
@@ -171,12 +175,12 @@ def ffd_solve_impl(
     return _ffd_body(inp, g_max, word_offsets, words, objective=objective)
 
 
-@functools.partial(jax.jit, static_argnames=("g_max", "word_offsets", "words", "use_pallas", "objective"))
+@functools.partial(jax.jit, static_argnames=("g_max", "word_offsets", "words", "objective"))
 def ffd_solve(
     inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
-    use_pallas: bool = False, objective: str = "price",
+    objective: str = "price",
 ) -> SolveOutputs:
-    return _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas, objective=objective)
+    return _ffd_body(inp, g_max, word_offsets, words, objective=objective)
 
 
 _CT_SHIFT = 8  # captype bits live above the zone bits in the packed u32
@@ -219,19 +223,13 @@ def _joint_ok(x: jax.Array) -> jax.Array:
 
 def _ffd_body(
     inp: SolveInputs, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
-    use_pallas: bool = False, objective: str = "price",
+    objective: str = "price",
 ) -> SolveOutputs:
     C, Rr = inp.req.shape
     K = inp.cap.shape[0]
     Z = inp.tzone.shape[1]
     CTn = inp.tcap.shape[1]
     compat = _device_compat(inp, word_offsets, words)             # [C, K]
-    if use_pallas:
-        from karpenter_tpu.solver import kernels
-
-        cap_t = inp.cap.T                                         # [R, K]
-        pallas_interpret = kernels.default_interpret()
-
     tzc = _pack_zc(inp.tzone, inp.tcap)                           # [K] u32
     azc = _pack_zc(inp.azone, inp.acap)                           # [C] u32
 
@@ -271,14 +269,8 @@ def _ffd_body(
         m = gmask & compat_c[None, :] & _joint_ok(gzc_new[:, None] & tzc[None, :])
 
         # -- how many fit on each open group -------------------------------
-        if use_pallas:
-            n_fit, n_grp = kernels.fit_max_groups(
-                cap_t, accum, req_c, m.astype(jnp.float32),
-                interpret=pallas_interpret,
-            )                                                     # [G, K], [G]
-        else:
-            n_fit = _fit_counts(inp.cap, accum, req_c)            # [G, K]
-            n_grp = jnp.max(jnp.where(m, n_fit, 0.0), axis=-1)    # [G]
+        n_fit = _fit_counts(inp.cap, accum, req_c)                # [G, K]
+        n_grp = jnp.max(jnp.where(m, n_fit, 0.0), axis=-1)        # [G]
         n_grp = jnp.where(slot < n_open, n_grp, 0.0).astype(jnp.int32)
 
         # -- exact first-fit via exclusive cumsum --------------------------
@@ -441,7 +433,7 @@ def _sparse_take(take: jax.Array, nnz_max: int) -> Tuple[jax.Array, jax.Array, j
     return idx, val, nnz_true
 
 
-@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "use_pallas", "objective"))
+@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "objective"))
 def ffd_solve_packed(
     inp: SolveInputs,
     price: jax.Array,
@@ -450,10 +442,9 @@ def ffd_solve_packed(
     nnz_max: int,
     word_offsets: Tuple[int, ...],
     words: Tuple[int, ...],
-    use_pallas: bool = False,
     objective: str = "price",
 ) -> PackedDecision:
-    out = _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas, objective=objective)
+    out = _ffd_body(inp, g_max, word_offsets, words, objective=objective)
     k, z, ct, bp = select_offerings(price, out.gmask, out.gzone, out.gcap)
     idx, val, nnz_true = _sparse_take(out.take, nnz_max)
     return PackedDecision(
@@ -496,7 +487,7 @@ class CompactDecision(NamedTuple):
     gzc: jax.Array          # [G] u32
 
 
-@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "use_pallas", "objective"))
+@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "objective"))
 def ffd_solve_compact(
     inp: SolveInputs,
     *,
@@ -504,10 +495,9 @@ def ffd_solve_compact(
     nnz_max: int,
     word_offsets: Tuple[int, ...],
     words: Tuple[int, ...],
-    use_pallas: bool = False,
     objective: str = "price",
 ) -> CompactDecision:
-    out = _ffd_body(inp, g_max, word_offsets, words, use_pallas=use_pallas, objective=objective)
+    out = _ffd_body(inp, g_max, word_offsets, words, objective=objective)
     idx, val, nnz_true = _sparse_take(out.take, nnz_max)
     K = out.gmask.shape[1]
     kw = K // 32
@@ -525,14 +515,13 @@ def ffd_solve_compact(
 
 def solve_dense_tuple(
     inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
-    use_pallas: bool = False, objective: str = "price",
+    objective: str = "price",
 ):
     """Dense solve fetched to host as the (take, unplaced, n_open, gmask,
     gzone, gcap) decode tuple -- the fallback when a CompactDecision's
     sparse budget overflows (expand_compact returned None)."""
     out = ffd_solve(
-        inp, g_max=g_max, word_offsets=word_offsets, words=words,
-        use_pallas=use_pallas, objective=objective,
+        inp, g_max=g_max, word_offsets=word_offsets, words=words, objective=objective,
     )
     out = SolveOutputs(*jax.device_get(tuple(out)))
     return (

@@ -37,7 +37,7 @@ class TPUSolver:
     log = get_logger("solver")
 
     def __init__(
-        self, g_max: int = 1024, c_pad_min: int = 16, client=None, use_pallas: bool = False,
+        self, g_max: int = 1024, c_pad_min: int = 16, client=None,
         objective: str = "price",
     ):
         # g_max default sized for the price objective at bench scale: cost-
@@ -50,14 +50,6 @@ class TPUSolver:
         # price-per-pod type (BASELINE.json configs 3-4); "fit" is the
         # legacy max-pods-per-node objective. The oracle mirrors both.
         self.objective = objective
-        # route the FFD step through the fused pallas kernel (TPU only;
-        # interpreted elsewhere -- bench.py decides based on hardware)
-        if client is not None and use_pallas:
-            raise ValueError(
-                "use_pallas is not forwarded over the RPC sidecar; run the "
-                "solver in-process for the pallas path"
-            )
-        self.use_pallas = use_pallas
         # optional solver/rpc.SolverClient: tensor solves go over the wire
         # to the sidecar on the TPU VM instead of the in-process backend
         # (the SURVEY.md section 2.4 deployment seam); encode/decode and the
@@ -334,7 +326,7 @@ class TPUSolver:
             dec = ffd.ffd_solve_compact(
                 inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(class_set.c_pad, self.g_max),
                 word_offsets=offsets, words=words,
-                use_pallas=self.use_pallas, objective=self.objective,
+                objective=self.objective,
             )
             dec = ffd.CompactDecision(*jax.device_get(tuple(dec)))
             dense = ffd.expand_compact(
@@ -345,7 +337,7 @@ class TPUSolver:
                 # refetch the dense decision -- correctness over latency
                 dense = ffd.solve_dense_tuple(
                     inp, g_max=self.g_max, word_offsets=offsets, words=words,
-                    use_pallas=self.use_pallas, objective=self.objective,
+                    objective=self.objective,
                 )
         return self._decode(
             pool, instance_types, catalog, class_set, dense, nodepool_usage,
