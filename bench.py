@@ -234,6 +234,9 @@ def run(profile: bool):
 
     backend = jax.default_backend()
 
+    from karpenter_tpu.utils import enable_jax_compilation_cache
+
+    enable_jax_compilation_cache()
     t0 = time.perf_counter()
     items, cloud = build_catalog_items()
     zones = [z.name for z in cloud.describe_zones()]

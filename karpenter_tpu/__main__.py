@@ -33,7 +33,9 @@ def build_operator(args):
     if args.tpu_solver:
         from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
         from karpenter_tpu.solver.service import TPUSolver
+        from karpenter_tpu.utils import enable_jax_compilation_cache
 
+        enable_jax_compilation_cache()
         solver = TPUSolver()
         evaluator = ConsolidationEvaluator()
     return Operator(

@@ -60,3 +60,21 @@ def gc_paused():
             _gc_pause_depth -= 1
             if _gc_pause_depth == 0 and _gc_was_enabled:
                 gc.enable()
+
+
+def enable_jax_compilation_cache(cache_dir: str = "") -> None:
+    """Turn on JAX's persistent compilation cache so controller restarts /
+    bench runs skip the first-solve XLA compile (~4s per scan program).
+    Safe to call before or after jax import, but BEFORE the first jit."""
+    import os
+
+    import jax
+
+    path = cache_dir or os.path.join(
+        os.path.expanduser("~"), ".cache", "karpenter-tpu", "jax"
+    )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # cache every program, however small/fast-to-compile
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
