@@ -65,15 +65,31 @@ def gc_paused():
 def enable_jax_compilation_cache(cache_dir: str = "") -> None:
     """Turn on JAX's persistent compilation cache so controller restarts /
     bench runs skip the first-solve XLA compile (~4s per scan program).
-    Safe to call before or after jax import, but BEFORE the first jit."""
+    Safe to call before or after jax import, but BEFORE the first jit.
+
+    Resolution order: explicit arg > JAX_COMPILATION_CACHE_DIR (the
+    standard mechanism, e.g. a mounted writable volume in a pod) > a
+    home-dir default. An unwritable location degrades to no persistent
+    cache -- a cache optimization must never abort operator startup
+    (readOnlyRootFilesystem pods have no writable HOME)."""
     import os
 
     import jax
 
-    path = cache_dir or os.path.join(
-        os.path.expanduser("~"), ".cache", "karpenter-tpu", "jax"
+    path = (
+        cache_dir
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or os.path.join(os.path.expanduser("~"), ".cache", "karpenter-tpu", "jax")
     )
-    os.makedirs(path, exist_ok=True)
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        from karpenter_tpu.logging import get_logger
+
+        get_logger("utils").warning(
+            "compilation cache disabled", path=path, error=str(e)
+        )
+        return
     jax.config.update("jax_compilation_cache_dir", path)
     # cache every program, however small/fast-to-compile
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
