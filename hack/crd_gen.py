@@ -184,8 +184,22 @@ def nodeclass_crd() -> dict:
                 "kubeReserved": quantity_map_schema(["cpu", "memory", "ephemeral-storage", "pid"]),
                 "evictionHard": eviction_map_schema(),
                 "evictionSoft": eviction_map_schema(),
+                "evictionSoftGracePeriod": {
+                    "type": "object",
+                    "additionalProperties": {"type": "string"},
+                },
                 "clusterDNS": {"type": "array", "items": {"type": "string"}},
             },
+            "x-kubernetes-validations": [
+                {
+                    "message": "evictionSoft entries require a matching evictionSoftGracePeriod entry",
+                    "rule": "has(self.evictionSoft) ? self.evictionSoft.all(e, has(self.evictionSoftGracePeriod) && e in self.evictionSoftGracePeriod) : true",
+                },
+                {
+                    "message": "evictionSoftGracePeriod entries require a matching evictionSoft entry",
+                    "rule": "has(self.evictionSoftGracePeriod) ? self.evictionSoftGracePeriod.all(e, has(self.evictionSoft) && e in self.evictionSoft) : true",
+                },
+            ],
         },
         "blockDeviceMappings": {
             "type": "array",

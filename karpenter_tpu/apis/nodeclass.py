@@ -102,6 +102,7 @@ class KubeletConfiguration:
     kube_reserved: Dict[str, str] = field(default_factory=dict)
     eviction_hard: Dict[str, str] = field(default_factory=dict)
     eviction_soft: Dict[str, str] = field(default_factory=dict)
+    eviction_soft_grace_period: Dict[str, str] = field(default_factory=dict)
     cluster_dns: List[str] = field(default_factory=list)
 
 

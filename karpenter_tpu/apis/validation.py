@@ -199,7 +199,7 @@ def validate_nodeclass(nc) -> List[Violation]:
         _check_quantity_map(k.system_reserved, "spec.kubelet.systemReserved", out, RESERVED_RESOURCES)
         _check_quantity_map(k.kube_reserved, "spec.kubelet.kubeReserved", out, RESERVED_RESOURCES)
         for field_name, m in (("evictionHard", k.eviction_hard), ("evictionSoft", k.eviction_soft)):
-            for key in m:
+            for key, value in m.items():
                 # ref CEL: eviction signal enumeration
                 if key not in EVICTION_SIGNALS:
                     out.append(
@@ -208,6 +208,52 @@ def validate_nodeclass(nc) -> List[Violation]:
                             f"key must be one of {list(EVICTION_SIGNALS)}",
                         )
                     )
+                    continue
+                # values are an absolute quantity or a 0..100 percentage
+                # (ref: mustParsePercentage bounds)
+                if isinstance(value, str) and value.endswith("%"):
+                    try:
+                        pct = float(value[:-1])
+                    except ValueError:
+                        pct = -1.0
+                    if not (0.0 <= pct <= 100.0):
+                        out.append(
+                            Violation(
+                                f"spec.kubelet.{field_name}.{key}",
+                                f"percentage {value!r} must be between 0% and 100%",
+                            )
+                        )
+                else:
+                    from karpenter_tpu.scheduling.resources import parse_quantity
+
+                    try:
+                        parse_quantity(value, "memory")
+                    except ValueError:
+                        out.append(
+                            Violation(
+                                f"spec.kubelet.{field_name}.{key}",
+                                f"unparseable eviction threshold {value!r}",
+                            )
+                        )
+        # kubelet refuses soft thresholds without grace periods and vice
+        # versa (ref CEL: evictionSoft keys must appear in
+        # evictionSoftGracePeriod and the other way around)
+        soft_keys = set(k.eviction_soft)
+        grace_keys = set(k.eviction_soft_grace_period)
+        for missing in sorted(soft_keys - grace_keys):
+            out.append(
+                Violation(
+                    f"spec.kubelet.evictionSoft.{missing}",
+                    "a matching evictionSoftGracePeriod entry is required",
+                )
+            )
+        for extra in sorted(grace_keys - soft_keys):
+            out.append(
+                Violation(
+                    f"spec.kubelet.evictionSoftGracePeriod.{extra}",
+                    "has no matching evictionSoft entry",
+                )
+            )
     return out
 
 

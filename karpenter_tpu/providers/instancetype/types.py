@@ -151,8 +151,26 @@ class Resolver:
         mem = float(res.parse_quantity(kr["memory"], res.MEMORY)) if "memory" in kr else kube_reserved_memory_bytes(max_pods)
         cpu += float(res.parse_quantity(sr["cpu"], res.CPU)) if "cpu" in sr else 0.0
         mem += float(res.parse_quantity(sr["memory"], res.MEMORY)) if "memory" in sr else 100 * MIB
-        evict = nodeclass.kubelet.eviction_hard.get("memory.available", "100Mi")
-        mem += float(res.parse_quantity(evict, res.MEMORY))
+        # kubelet applies the LARGER of the hard and soft memory
+        # thresholds for scheduling purposes (reference merges both signal
+        # maps via MaxResources); each takes an absolute quantity ("100Mi")
+        # or a percentage ("5%") of node memory -- resolved against the
+        # vm-overhead-adjusted capacity compute_capacity reports, which is
+        # what kubelet sees. Admission validates the value forms
+        # (apis/validation.py), so parsing here is strict.
+        node_mem = info.memory_mib * MIB * (1 - self.vm_memory_overhead_percent)
+
+        def threshold_bytes(value: str) -> float:
+            if value.endswith("%"):
+                return node_mem * (float(value[:-1]) / 100.0)
+            return float(res.parse_quantity(value, res.MEMORY))
+
+        hard = nodeclass.kubelet.eviction_hard.get("memory.available", "100Mi")
+        soft = nodeclass.kubelet.eviction_soft.get("memory.available")
+        evict_bytes = threshold_bytes(hard)
+        if soft is not None:
+            evict_bytes = max(evict_bytes, threshold_bytes(soft))
+        mem += evict_bytes
         return Resources.from_base_units({res.CPU: cpu, res.MEMORY: mem})
 
     # -- requirements -------------------------------------------------------

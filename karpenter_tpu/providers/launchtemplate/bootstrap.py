@@ -28,6 +28,14 @@ def _kubelet_args(kubelet: KubeletConfiguration, max_pods: Optional[int]) -> Lis
         args.append("--system-reserved=" + ",".join(f"{k}={v}" for k, v in sorted(kubelet.system_reserved.items())))
     if kubelet.eviction_hard:
         args.append("--eviction-hard=" + ",".join(f"{k}<{v}" for k, v in sorted(kubelet.eviction_hard.items())))
+    if kubelet.eviction_soft:
+        args.append("--eviction-soft=" + ",".join(f"{k}<{v}" for k, v in sorted(kubelet.eviction_soft.items())))
+        # kubelet REQUIRES a grace period per soft signal (admission
+        # enforces the pairing, apis/validation.py)
+        args.append(
+            "--eviction-soft-grace-period="
+            + ",".join(f"{k}={v}" for k, v in sorted(kubelet.eviction_soft_grace_period.items()))
+        )
     if kubelet.cluster_dns:
         args.append("--cluster-dns=" + ",".join(kubelet.cluster_dns))
     return args

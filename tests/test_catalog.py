@@ -289,3 +289,81 @@ class TestICECache:
         assert not ice.is_unavailable("m5.large", "z1", "spot")
         ice.mark_unavailable("x", "y", "spot")
         assert ice.seq_num > seq
+
+
+class TestEvictionThresholds:
+    def test_percentage_eviction_threshold_in_overhead(self):
+        """kubelet eviction thresholds take absolute quantities OR
+        percentages of node memory; '5%' must resolve against the
+        instance's memory, not crash quantity parsing."""
+        from karpenter_tpu.apis.nodeclass import KubeletConfiguration, TPUNodeClass
+        from karpenter_tpu.kwok.cloud import FakeCloud
+        from karpenter_tpu.providers.instancetype import gen_catalog
+        from karpenter_tpu.providers.instancetype.types import MIB, Resolver
+        from karpenter_tpu.scheduling import resources as res
+
+        cloud = FakeCloud()
+        info = cloud.describe_instance_types()[0]
+        resolver = Resolver(gen_catalog.REGION)
+        pct = TPUNodeClass("p", kubelet=KubeletConfiguration(eviction_hard={"memory.available": "5%"}))
+        absolute = TPUNodeClass("a", kubelet=KubeletConfiguration(eviction_hard={"memory.available": "100Mi"}))
+        o_pct = resolver.compute_overhead(info, pct)
+        o_abs = resolver.compute_overhead(info, absolute)
+        expected_delta = info.memory_mib * MIB * (1 - 0.075) * 0.05 - 100 * MIB
+        assert abs((o_pct.get(res.MEMORY) - o_abs.get(res.MEMORY)) - expected_delta) < 1.0
+
+    def test_eviction_soft_rendered_in_bootstrap(self):
+        from karpenter_tpu.apis.nodeclass import KubeletConfiguration, TPUNodeClass
+        from karpenter_tpu.providers.launchtemplate import bootstrap
+
+        nc = TPUNodeClass("x", kubelet=KubeletConfiguration(
+            eviction_hard={"memory.available": "5%"},
+            eviction_soft={"memory.available": "10%"},
+            eviction_soft_grace_period={"memory.available": "2m"},
+        ))
+        out = bootstrap.render(
+            "Standard", cluster_name="c", endpoint="e", ca_bundle="b",
+            nodeclass=nc, labels={}, taints=[], max_pods=10,
+        )
+        assert "--eviction-hard=memory.available<5%" in out
+        assert "--eviction-soft=memory.available<10%" in out
+        assert "--eviction-soft-grace-period=memory.available=2m" in out
+
+
+    def test_soft_threshold_dominates_overhead(self):
+        from karpenter_tpu.apis.nodeclass import KubeletConfiguration, TPUNodeClass
+        from karpenter_tpu.kwok.cloud import FakeCloud
+        from karpenter_tpu.providers.instancetype import gen_catalog
+        from karpenter_tpu.providers.instancetype.types import MIB, Resolver
+        from karpenter_tpu.scheduling import resources as res
+
+        cloud = FakeCloud()
+        info = cloud.describe_instance_types()[0]
+        resolver = Resolver(gen_catalog.REGION)
+        both = TPUNodeClass("b", kubelet=KubeletConfiguration(
+            eviction_hard={"memory.available": "100Mi"},
+            eviction_soft={"memory.available": "2Gi"},
+            eviction_soft_grace_period={"memory.available": "2m"},
+        ))
+        hard_only = TPUNodeClass("h", kubelet=KubeletConfiguration(
+            eviction_hard={"memory.available": "100Mi"},
+        ))
+        o_both = resolver.compute_overhead(info, both)
+        o_hard = resolver.compute_overhead(info, hard_only)
+        # the LARGER (soft) threshold governs: 2Gi - 100Mi more overhead
+        assert abs((o_both.get(res.MEMORY) - o_hard.get(res.MEMORY)) - (2048 - 100) * MIB) < 1.0
+
+    def test_admission_requires_grace_period_pairing(self):
+        from karpenter_tpu.apis.nodeclass import KubeletConfiguration, TPUNodeClass
+        from karpenter_tpu.apis.validation import validate_nodeclass
+
+        nc = TPUNodeClass("x", kubelet=KubeletConfiguration(
+            eviction_soft={"memory.available": "10%"},
+        ))
+        v = validate_nodeclass(nc)
+        assert any("evictionSoftGracePeriod" in str(x) for x in v), [str(x) for x in v]
+        nc2 = TPUNodeClass("y", kubelet=KubeletConfiguration(
+            eviction_hard={"memory.available": "150%"},
+        ))
+        v2 = validate_nodeclass(nc2)
+        assert any("between 0% and 100%" in str(x) for x in v2), [str(x) for x in v2]
