@@ -118,6 +118,10 @@ def eviction_map_schema() -> dict:
     signals = "','".join(
         ["memory.available", "nodefs.available", "nodefs.inodesFree", "imagefs.available", "imagefs.inodesFree", "pid.available"]
     )
+    # values: an absolute k8s quantity or a 0..100 percentage (mirrors
+    # apis/validation.py's value-form checks -- the two admission paths
+    # must agree)
+    value_re = r"^((100|[0-9]{1,2})([.][0-9]+)?%|[0-9]+([.][0-9]+)?(Ki|Mi|Gi|Ti|Pi|Ei|k|M|G|T|P|E|m)?)$"
     return {
         "type": "object",
         "additionalProperties": {"type": "string"},
@@ -125,7 +129,11 @@ def eviction_map_schema() -> dict:
             {
                 "message": "valid keys are eviction signals",
                 "rule": f"self.all(x, x in ['{signals}'])",
-            }
+            },
+            {
+                "message": "values must be an absolute quantity or a percentage between 0% and 100%",
+                "rule": f"self.all(x, self[x].matches('{value_re}'))",
+            },
         ],
     }
 
@@ -187,6 +195,12 @@ def nodeclass_crd() -> dict:
                 "evictionSoftGracePeriod": {
                     "type": "object",
                     "additionalProperties": {"type": "string"},
+                    "x-kubernetes-validations": [
+                        {
+                            "message": "grace periods must be positive Go durations (e.g. 2m, 90s, 1m30s)",
+                            "rule": "self.all(x, self[x].matches('^([0-9]+(ns|us|ms|s|m|h))+$') && self[x] != '0s')",
+                        }
+                    ],
                 },
                 "clusterDNS": {"type": "array", "items": {"type": "string"}},
             },

@@ -238,6 +238,16 @@ def validate_nodeclass(nc) -> List[Violation]:
         # kubelet refuses soft thresholds without grace periods and vice
         # versa (ref CEL: evictionSoft keys must appear in
         # evictionSoftGracePeriod and the other way around)
+        for key, value in k.eviction_soft_grace_period.items():
+            # kubelet parses these as Go durations; reject what it would
+            # crashloop on (validated here AND in the generated CEL)
+            if not re.fullmatch(r"([0-9]+(ns|us|ms|s|m|h))+", str(value)) or value == "0s":
+                out.append(
+                    Violation(
+                        f"spec.kubelet.evictionSoftGracePeriod.{key}",
+                        f"{value!r} is not a positive Go duration (e.g. 2m, 90s)",
+                    )
+                )
         soft_keys = set(k.eviction_soft)
         grace_keys = set(k.eviction_soft_grace_period)
         for missing in sorted(soft_keys - grace_keys):

@@ -1,0 +1,217 @@
+"""Launch-template provider + bootstrap rendering suite.
+
+The reference's largest unit suite is launchtemplate
+(pkg/providers/launchtemplate/suite_test.go, 2,665 LoC): content-hash
+naming, per-(AMI x maxPods x NIC x ODCR) grouping, cache hydration,
+invalidation on NotFound, userdata merging per family. This covers the
+same surfaces on the TPU build.
+"""
+import tomllib
+
+import pytest
+
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass, labels as wk
+from karpenter_tpu.apis.nodeclass import ImageSelectorTerm, KubeletConfiguration
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.providers.launchtemplate import bootstrap
+from karpenter_tpu.scheduling import Resources, Taint
+
+
+@pytest.fixture
+def env():
+    op = Operator(clock=FakeClock(5_000.0))
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    return op
+
+
+def hydrated(env):
+    env.tick()
+    return env.cluster.get(TPUNodeClass, "default")
+
+
+class TestTemplateNaming:
+    def test_name_deterministic_and_context_sensitive(self, env):
+        nc = hydrated(env)
+        lt = env.launch_templates
+        ctx_a = lt.context_hash({"team": "a"}, [])
+        ctx_b = lt.context_hash({"team": "b"}, [])
+        n1 = lt.template_name(nc, "img-1", 58, 0, None, ctx_a)
+        n2 = lt.template_name(nc, "img-1", 58, 0, None, ctx_a)
+        assert n1 == n2 and n1.startswith("kt-")
+        # labels are rendered into userdata, so they are template identity
+        assert lt.template_name(nc, "img-1", 58, 0, None, ctx_b) != n1
+        # every identity axis changes the name
+        assert lt.template_name(nc, "img-2", 58, 0, None, ctx_a) != n1
+        assert lt.template_name(nc, "img-1", 29, 0, None, ctx_a) != n1
+        assert lt.template_name(nc, "img-1", 58, 4, None, ctx_a) != n1
+        assert lt.template_name(nc, "img-1", 58, 0, "cr-1", ctx_a) != n1
+
+    def test_spec_change_changes_name(self, env):
+        nc = hydrated(env)
+        lt = env.launch_templates
+        before = lt.template_name(nc, "img-1", 58, 0, None, "")
+        nc.user_data = "echo hi"
+        after = lt.template_name(nc, "img-1", 58, 0, None, "")
+        assert before != after  # static_hash covers user_data
+
+    def test_taint_ordering_is_canonical(self, env):
+        lt = env.launch_templates
+        t1 = [Taint("a", value="1"), Taint("b", value="2")]
+        t2 = [Taint("a", value="1"), Taint("b", value="2")]
+        assert lt.context_hash({}, t1) == lt.context_hash({}, t2)
+
+
+class TestGrouping:
+    def test_groups_by_image_maxpods_nic(self, env):
+        nc = hydrated(env)
+        pool = env.cluster.get(NodePool, "default")
+        items = env.cloud_provider.get_instance_types(pool)
+        groups = env.launch_templates.resolve_groups(nc, items)
+        assert len(groups) >= 2  # multiple (image, maxPods) buckets exist
+        names = [g.template_name for g in groups]
+        assert len(names) == len(set(names))
+        seen = set()
+        for g in groups:
+            key = (g.image.id, g.max_pods, g.nic_count)
+            assert key not in seen
+            seen.add(key)
+            for it in g.instance_types:
+                # each member's pod limit matches its group bucket
+                assert int(it.capacity["pods"]) == g.max_pods
+
+    def test_arch_routes_to_matching_image(self, env):
+        nc = hydrated(env)
+        pool = env.cluster.get(NodePool, "default")
+        items = env.cloud_provider.get_instance_types(pool)
+        groups = env.launch_templates.resolve_groups(nc, items)
+        img_by_type = {}
+        for g in groups:
+            for it in g.instance_types:
+                img_by_type[it.name] = g.image.name
+        for it in items:
+            if it.name in img_by_type:
+                arch = it.requirements.labels()[wk.ARCH_LABEL]
+                assert arch in img_by_type[it.name], (it.name, img_by_type[it.name])
+
+
+class TestEnsureAndInvalidate:
+    def test_ensure_creates_once_then_caches(self, env):
+        nc = hydrated(env)
+        pool = env.cluster.get(NodePool, "default")
+        items = env.cloud_provider.get_instance_types(pool)[:30]
+        before = env.cloud.calls.get("create_launch_template", 0)
+        env.launch_templates.ensure_all(nc, items, {}, [])
+        created = env.cloud.calls.get("create_launch_template", 0) - before
+        assert created >= 1
+        env.launch_templates.ensure_all(nc, items, {}, [])
+        assert env.cloud.calls.get("create_launch_template", 0) - before == created
+
+    def test_invalidate_recreates(self, env):
+        nc = hydrated(env)
+        pool = env.cluster.get(NodePool, "default")
+        items = env.cloud_provider.get_instance_types(pool)[:30]
+        groups = env.launch_templates.ensure_all(nc, items, {}, [])
+        name = groups[0].template_name
+        # the fleet-NotFound path: cache entry dropped, next ensure recreates
+        env.cloud.delete_launch_template(name)
+        env.launch_templates.invalidate(name)
+        before = env.cloud.calls.get("create_launch_template", 0)
+        env.launch_templates.ensure_all(nc, items, {}, [])
+        assert env.cloud.calls.get("create_launch_template", 0) > before
+
+    def test_bad_userdata_fails_only_that_nodeclass(self, env):
+        nc = hydrated(env)
+        nc.image_family = "Immutable"
+        nc.user_data = "[broken"
+        pool = env.cluster.get(NodePool, "default")
+        items = env.cloud_provider.get_instance_types(pool)[:10]
+        from karpenter_tpu.errors import CloudError
+
+        # surfaces as a CloudError so ONE bad nodeclass fails its own
+        # launch instead of crashing the provisioning tick
+        with pytest.raises(CloudError, match="bootstrap rendering failed"):
+            env.launch_templates.ensure_all(nc, items, {}, [])
+
+
+class TestBootstrapFamilies:
+    def _nc(self, family, user_data=""):
+        return TPUNodeClass("x", image_family=family, user_data=user_data)
+
+    def _render(self, family, user_data="", **kw):
+        return bootstrap.render(
+            family, cluster_name="c1", endpoint="https://api", ca_bundle="cab",
+            nodeclass=self._nc(family, user_data),
+            labels=kw.get("labels", {"karpenter.sh/nodepool": "default"}),
+            taints=kw.get("taints", []),
+            max_pods=kw.get("max_pods", 58),
+        )
+
+    def test_standard_script_without_userdata_is_bare(self):
+        out = self._render("Standard")
+        assert out.startswith("#!/bin/bash")
+        assert "MIME" not in out
+        assert "--cluster c1" in out and "--max-pods=58" in out
+
+    def test_standard_mime_merge_order(self):
+        out = self._render("Standard", user_data="#!/bin/bash\necho custom-first")
+        assert out.startswith("MIME-Version: 1.0")
+        # RFC 2046: custom part precedes the bootstrap part; terminated
+        assert out.index("custom-first") < out.index("bootstrap-node")
+        assert out.rstrip().endswith("--BOUNDARY--")
+        assert out.count("--BOUNDARY") == 3  # two parts + terminator
+
+    def test_declarative_carries_user_config(self):
+        out = self._render("Declarative", user_data="extra: true")
+        assert "node-config:" in out
+        assert "  user-config: |" in out and "    extra: true" in out
+
+    def test_immutable_toml_round_trips_and_generated_wins(self):
+        out = self._render(
+            "Immutable",
+            user_data='[settings.kubernetes]\ncluster-name = "user-tries-to-override"\nmotd = "hello"\n',
+        )
+        doc = tomllib.loads(out)
+        kube = doc["settings"]["kubernetes"]
+        assert kube["cluster-name"] == "c1"  # generated wins on conflict
+        assert kube["motd"] == "hello"      # user keys survive the merge
+        assert kube["node-labels"]["karpenter.sh/nodepool"] == "default"
+
+    def test_immutable_taints_aggregate_by_key(self):
+        nc = self._nc("Immutable")
+        out = bootstrap.render(
+            "Immutable", cluster_name="c", endpoint="e", ca_bundle="b",
+            nodeclass=nc, labels={},
+            taints=[Taint("dedicated", value="a"), Taint("dedicated", value="b", effect="NoExecute")],
+            max_pods=None,
+        )
+        doc = tomllib.loads(out)
+        vals = doc["settings"]["kubernetes"]["node-taints"]["dedicated"]
+        assert sorted(vals) == ["a:NoSchedule", "b:NoExecute"]
+
+    def test_windows_powershell_wraps_user_first(self):
+        out = self._render("Windows", user_data="Write-Host custom")
+        assert out.startswith("<powershell>") and out.endswith("</powershell>")
+        assert out.index("custom") < out.index("Bootstrap-Node")
+
+    def test_custom_family_is_verbatim(self):
+        out = self._render("Custom", user_data="raw bytes only")
+        assert out == "raw bytes only"
+
+    def test_kubelet_flags_render(self):
+        nc = TPUNodeClass("x", kubelet=KubeletConfiguration(
+            pods_per_core=4,
+            kube_reserved={"cpu": "100m"},
+            system_reserved={"memory": "200Mi"},
+            cluster_dns=["10.0.0.10"],
+        ))
+        out = bootstrap.render(
+            "Standard", cluster_name="c", endpoint="e", ca_bundle="b",
+            nodeclass=nc, labels={}, taints=[], max_pods=29,
+        )
+        for needle in (
+            "--max-pods=29", "--pods-per-core=4", "--kube-reserved=cpu=100m",
+            "--system-reserved=memory=200Mi", "--cluster-dns=10.0.0.10",
+        ):
+            assert needle in out, out

@@ -235,3 +235,29 @@ class TestCRDManifests:
         assert kinds == {"TPUNodeClass", "NodePool", "NodeClaim"}
         # the CEL rule surface is substantial, as in the reference
         assert n_rules >= 15, n_rules
+
+
+class TestEvictionValueForms:
+    def test_grace_period_duration_form(self):
+        from karpenter_tpu.apis.nodeclass import KubeletConfiguration
+
+        bad(TPUNodeClass("a", kubelet=KubeletConfiguration(
+            eviction_soft={"memory.available": "5%"},
+            eviction_soft_grace_period={"memory.available": "2 minutes"},
+        )), "Go duration")
+        bad(TPUNodeClass("b", kubelet=KubeletConfiguration(
+            eviction_soft={"memory.available": "5%"},
+            eviction_soft_grace_period={"memory.available": "0s"},
+        )), "Go duration")
+        ok(TPUNodeClass("c", kubelet=KubeletConfiguration(
+            eviction_soft={"memory.available": "5%"},
+            eviction_soft_grace_period={"memory.available": "1m30s"},
+        )))
+
+    def test_crd_carries_value_form_rules(self):
+        import pathlib
+
+        crd = (pathlib.Path(__file__).resolve().parent.parent
+               / "karpenter_tpu" / "apis" / "crds" / "karpenter.tpu_tpunodeclasses.yaml").read_text()
+        assert "percentage between 0% and 100%" in crd
+        assert "positive Go durations" in crd
