@@ -236,8 +236,8 @@ def run(profile: bool):
     # degraded-CPU runs measure a solve ~6x slower than the accelerator's;
     # trim iteration counts so the fallback stays bounded for the driver
     # (the percentiles remain meaningful, just coarser)
-    iters = ITERS if backend == "tpu" else max(10, ITERS // 3)
-    cold_iters = COLD_ITERS if backend == "tpu" else max(5, COLD_ITERS // 3)
+    iters = ITERS if backend != "cpu" else max(10, ITERS // 3)
+    cold_iters = COLD_ITERS if backend != "cpu" else max(5, COLD_ITERS // 3)
 
     from karpenter_tpu.utils import enable_jax_compilation_cache
 

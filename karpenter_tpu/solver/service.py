@@ -416,6 +416,22 @@ class TPUSolver:
         gmask_real = gmask[:, : catalog.k_real]
         zone_names = catalog.zones
         n_zones = len(zone_names)
+        # per-group requested totals in ONE matmul (decode previously built
+        # ~2 Resources objects per (class, group) pair -- object churn was
+        # the dominant decode cost). The class vectors are EXACT float64
+        # base units straight from the pod requests, not the float32 scaled
+        # tensors, so NewNodeGroup.requested stays bit-equal to the
+        # oracle's Resources arithmetic.
+        if n_open:
+            class_base = np.zeros((take_t.shape[1], encode.R), dtype=np.float64)
+            one_pod = Resources.from_base_units({res.PODS: 1})
+            for c, pc in enumerate(class_set.classes):
+                class_base[c] = (pc.pods[0].requests + one_pod).to_vector()
+            group_req_vecs = take_t.astype(np.float64) @ class_base
+        else:
+            group_req_vecs = np.zeros((0, encode.R))
+        # the pool's base requirement set builds once; groups copy it
+        pool_base_reqs = pool.requirements()
 
         # gc paused across the allocation-heavy per-group loop (same
         # rationale as encode.group_pods)
@@ -426,8 +442,7 @@ class TPUSolver:
                 if classes_on_g.size == 0:
                     continue
                 group_pods: List[Pod] = []
-                reqs = pool.requirements()
-                requested = Resources.from_base_units({res.PODS: 0})
+                reqs = pool_base_reqs.copy()
                 for c in classes_on_g:
                     pc = class_set.classes[c]
                     n = int(col[c])
@@ -435,13 +450,9 @@ class TPUSolver:
                     off = int(class_offset[c]) + int(take_cum[c, g])
                     group_pods.extend(pc.pods[off : off + n])
                     reqs.add(*pc.requirements)
-                    # all pods in a class have identical requests (the canonical
-                    # class key includes the scaled request vector), so the
-                    # group total is one vector multiply per class, not one
-                    # Resources add per pod -- decode is on the hot path
-                    requested = requested + (
-                        pc.pods[0].requests + Resources.from_base_units({res.PODS: 1})
-                    ) * n
+                requested = Resources.from_base_units(
+                    dict(zip(res.RESOURCE_AXES, group_req_vecs[g].tolist()))
+                )
                 group_types = types_by_price[gmask_real[g][order]].tolist()
                 if not group_types:
                     for p in group_pods:
