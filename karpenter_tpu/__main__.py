@@ -28,6 +28,11 @@ def build_operator(args):
         reserved_nics=args.reserved_nics,
         isolated_network=args.isolated_network,
     )
+    # feature gates merge over the defaults (reference: the core's
+    # --feature-gates flag, checked e.g. at cmd/controller/main.go:45-47)
+    for pair in filter(None, (args.feature_gates or "").split(",")):
+        name, _, value = pair.partition("=")
+        options.feature_gates[name.strip()] = value.strip().lower() in ("true", "1", "yes")
     solver = None
     evaluator = None
     if args.tpu_solver:
@@ -57,6 +62,11 @@ def main(argv=None) -> int:
     parser.add_argument("--vm-memory-overhead-percent", type=float, default=0.075)
     parser.add_argument("--reserved-nics", type=int, default=0)
     parser.add_argument("--isolated-network", action="store_true")
+    parser.add_argument(
+        "--feature-gates",
+        default="",
+        help="comma-separated Name=true|false (e.g. SpotToSpotConsolidation=true)",
+    )
     parser.add_argument(
         "--tpu-solver", action=argparse.BooleanOptionalAction, default=True,
         help="route scheduling + consolidation decisions through the accelerator",

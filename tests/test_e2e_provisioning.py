@@ -281,3 +281,21 @@ class TestNodeClassValidationDryRun:
         assert calls == 1, calls
         nc = env.cluster.get(TPUNodeClass, "default")
         assert nc.status_conditions.is_true(COND_VALIDATION_SUCCEEDED)
+
+
+class TestFeatureGateFlag:
+    def test_feature_gates_parse_and_apply(self):
+        from karpenter_tpu.__main__ import build_operator
+        import argparse
+
+        args = argparse.Namespace(
+            cluster_name="c", interruption_queue="", vm_memory_overhead_percent=0.075,
+            reserved_nics=0, isolated_network=False, tpu_solver=False,
+            feature_gates="SpotToSpotConsolidation=true,ReservedCapacity=false",
+            identity="",
+        )
+        op = build_operator(args)
+        assert op.options.feature_gates["SpotToSpotConsolidation"] is True
+        assert op.options.feature_gates["ReservedCapacity"] is False
+        # the disruption controller consumes the merged gates
+        assert op.disruption.feature_gates["SpotToSpotConsolidation"] is True
