@@ -194,7 +194,8 @@ def _stage_breakdown(solver, pool, items, pods):
     t0 = time.perf_counter()
     classes = encode.group_pods(pods, extra_requirements=pool.requirements())
     t["group"] = time.perf_counter() - t0
-    catalog, staged, offsets, words, _ = solver._catalog(items)
+    entry = solver._catalog(items)
+    catalog, staged, offsets, words = entry.tensors, entry.staged, entry.offsets, entry.words
     t0 = time.perf_counter()
     cs = encode.encode_classes(
         classes, catalog, c_pad=encode.bucket(len(classes), solver.c_pad_min)
@@ -221,7 +222,7 @@ def _stage_breakdown(solver, pool, items, pods):
             inp, g_max=solver.g_max, word_offsets=offsets, words=words,
             objective=solver.objective,
         )
-    solver._decode(pool, items, catalog, cs, dense, None)
+    solver._decode(pool, entry, cs, dense, None)
     t["decode"] = time.perf_counter() - t0
     return {k: round(v * 1e3, 2) for k, v in t.items()}, len(classes)
 
@@ -269,6 +270,13 @@ def run(profile: bool):
     assert placed + len(result.unschedulable) == N_PODS, "pod conservation violated"
     for w in workloads[1:]:
         solve(w)
+    # precompile every class-count bucket: a cold workload whose pod mix
+    # crosses a bucket boundary (e.g. 65 classes -> c_pad 128) would
+    # otherwise hit a multi-second XLA compile inside a measured iteration
+    # -- that was the whole of round 2's p99 tail
+    t0 = time.perf_counter()
+    solver.warm(items)
+    t_warm_buckets = time.perf_counter() - t0
 
     # adaptive warmup: a tunneled chip's first seconds after idle can be
     # pathologically slow; warm until solve time stabilizes near its floor
@@ -328,6 +336,7 @@ def run(profile: bool):
         print(
             f"# backend {backend}; catalog build {t_catalog * 1e3:.0f}ms; "
             f"pod synth {t_pods:.1f}s; first solve (compile) {t_compile:.1f}s; "
+            f"bucket warm {t_warm_buckets:.1f}s; "
             f"cold p50 {p50:.1f}ms p99 {p99:.1f}ms min {cold.min():.1f}ms max {cold.max():.1f}ms; "
             f"warm p50 {warm_p50:.1f}ms p99 {warm_p99:.1f}ms; "
             f"stages (warm, serial) {stages} ({n_classes} classes); "

@@ -31,8 +31,16 @@ def build_operator(args):
     # feature gates merge over the defaults (reference: the core's
     # --feature-gates flag, checked e.g. at cmd/controller/main.go:45-47)
     for pair in filter(None, (args.feature_gates or "").split(",")):
-        name, _, value = pair.partition("=")
-        options.feature_gates[name.strip()] = value.strip().lower() in ("true", "1", "yes")
+        name, sep, value = pair.partition("=")
+        value = value.strip().lower()
+        # malformed pairs fail startup loudly (the core's map-flag
+        # semantics): a bare gate name or a typo'd boolean silently
+        # becoming False would disable the feature the operator asked for
+        if not sep or value not in ("true", "false", "1", "0", "yes", "no"):
+            raise SystemExit(
+                f"--feature-gates: malformed pair {pair!r} (want Name=true|false)"
+            )
+        options.feature_gates[name.strip()] = value in ("true", "1", "yes")
     solver = None
     evaluator = None
     if args.tpu_solver:
@@ -41,7 +49,7 @@ def build_operator(args):
         from karpenter_tpu.utils import enable_jax_compilation_cache
 
         enable_jax_compilation_cache()
-        solver = TPUSolver()
+        solver = TPUSolver(auto_warm=True)
         evaluator = ConsolidationEvaluator()
     return Operator(
         options=options, solver=solver, consolidation_evaluator=evaluator,

@@ -204,6 +204,13 @@ class PodClass:
     # price-envelope pod count for fresh-group sizing (solver/ffd.py price
     # objective): -1 = use the in-scan leftover; spread sub-classes pin 1
     env_count: int = -1
+    # OR of routing-relevant constraint bits over EVERY signature that
+    # merged into this class (affinity terms are deliberately NOT part of
+    # _class_key -- the oracle's price envelope wants an affinity follower
+    # to share its anchor's class -- so the class representative alone
+    # cannot answer "does anyone here carry affinity?"; these bits can)
+    has_affinity: bool = False
+    multi_node_affinity: bool = False
 
 
 @dataclass
@@ -300,37 +307,63 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
     alternatives use their first term (the oracle handles full OR semantics;
     multi-term pods are rare and can be routed to the oracle).
 
-    Three-level grouping keeps the 50k-pod hot path inside the latency
-    budget: pods carry an interned small-int signature id (memoized across
-    calls -- warm ticks hash machine ints, not tuples), distinct ids key by
-    the structural signature (Pod.grouping_signature -- raw spec tuples),
-    and ONE canonical key (Requirements construction + stable hash + scaled
+    Four-level grouping keeps the 50k-pod hot path inside the latency
+    budget. Fast path: pods carry a shared-spec identity token
+    (Pod._spec_token -- ReplicaSet replicas constructed from the same
+    interned spec objects share it), so the common case is ONE dict lookup
+    per pod with the whole structural machinery running once per template.
+    Slow path (spread pods, or pods built from per-pod spec copies): an
+    interned small-int signature id (memoized across calls -- warm ticks
+    hash machine ints, not tuples), distinct ids key by the structural
+    signature (Pod.grouping_signature -- raw spec tuples), and ONE
+    canonical key (Requirements construction + stable hash + scaled
     request vector) is computed per distinct signature. Signatures whose
     canonical keys coincide (e.g. the same constraint written as
-    nodeSelector vs nodeAffinity) share a class. The single ordered pass
-    preserves input order within each class -- required for exact
-    differential equivalence with the oracle's stable per-pod sort."""
+    nodeSelector vs nodeAffinity) share a class, as do distinct tokens with
+    equal signatures. The single ordered pass preserves input order within
+    each class -- required for exact differential equivalence with the
+    oracle's stable per-pod sort."""
+    tok_to_class: Dict[tuple, PodClass] = {}
     id_to_class: Dict[tuple, PodClass] = {}
     groups: Dict[tuple, PodClass] = {}
+    tok_get = tok_to_class.get
     id_get = id_to_class.get
+
+    def classify(pod: Pod) -> PodClass:
+        sid = pod._sig_id
+        if sid is None or sid[0] != _SIG_GEN:
+            sid = pod._sig_id = _intern_sig(pod.grouping_signature())
+        pc = id_get(sid)
+        if pc is None:
+            reqs = pod.scheduling_requirements()[0]
+            if extra_requirements is not None:
+                reqs = reqs.copy().add(*extra_requirements)
+            key = _class_key(pod, reqs)
+            pc = groups.get(key)
+            if pc is None:
+                requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
+                pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
+            # routing bits OR over every signature the class absorbs, so a
+            # lone affinity pod merged behind a plain representative still
+            # routes the whole batch to the oracle (TPUSolver.supports)
+            if pod.affinity_terms:
+                pc.has_affinity = True
+            if len(pod.node_affinity_terms) > 1:
+                pc.multi_node_affinity = True
+            id_to_class[sid] = pc
+        return pc
+
     # gc paused: cold grouping of 50k fresh pods allocates ~400k young
     # containers; mid-loop generational collections multiply the cost ~6x
     with gc_paused():
         for pod in pods:
-            sid = pod._sig_id
-            if sid is None or sid[0] != _SIG_GEN:
-                sid = pod._sig_id = _intern_sig(pod.grouping_signature())
-            pc = id_get(sid)
-            if pc is None:
-                reqs = pod.scheduling_requirements()[0]
-                if extra_requirements is not None:
-                    reqs = reqs.copy().add(*extra_requirements)
-                key = _class_key(pod, reqs)
-                pc = groups.get(key)
+            tok = pod._spec_token
+            if tok is not None:
+                pc = tok_get(tok)
                 if pc is None:
-                    requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
-                    pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
-                id_to_class[sid] = pc
+                    pc = tok_to_class[tok] = classify(pod)
+            else:
+                pc = classify(pod)
             pc.pods.append(pod)
     # FFD order: dominant resource descending with the canonical tie-break
     # (pod_sort_key) -- must match the oracle's sort for differential
@@ -338,6 +371,23 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
     out = list(groups.values())
     out.sort(key=lambda pc: pod_sort_key(pc.pods[0]))
     return out
+
+
+def with_extra_requirements(classes: Sequence[PodClass], extra: Requirements) -> List[PodClass]:
+    """Re-base already-grouped classes onto a nodepool's requirements --
+    the per-class equivalent of group_pods(pods, extra_requirements=...),
+    letting one grouping pass serve routing plus every pool's solve.
+    Classes that would have merged under the extra requirements stay
+    separate, which the solver handles as independent rows."""
+    return [
+        PodClass(
+            pods=pc.pods, requests=pc.requests,
+            requirements=pc.requirements.copy().add(*extra),
+            key=pc.key, env_count=pc.env_count,
+            has_affinity=pc.has_affinity, multi_node_affinity=pc.multi_node_affinity,
+        )
+        for pc in classes
+    ]
 
 
 def _allowed_bits_for(reqs: Requirements, vocab: Vocab, dim: str, words: int) -> np.ndarray:

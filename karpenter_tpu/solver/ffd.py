@@ -523,6 +523,8 @@ def solve_dense_tuple(
     out = ffd_solve(
         inp, g_max=g_max, word_offsets=word_offsets, words=words, objective=objective,
     )
+    for leaf in out:
+        leaf.copy_to_host_async()   # hide the ~64 ms tunnel RTT (see service.solve)
     out = SolveOutputs(*jax.device_get(tuple(out)))
     return (
         np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),

@@ -15,7 +15,7 @@ path: many pods x one pool x full catalog) runs on the accelerator.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -33,13 +33,30 @@ from karpenter_tpu.utils import gc_paused
 _bucket = encode.bucket
 
 
+class _CatalogEntry(NamedTuple):
+    """One catalog's immutable staged snapshot (see TPUSolver._catalog)."""
+
+    tensors: CatalogTensors
+    staged: object                     # ffd.StagedCatalog | None (remote mode)
+    offsets: Tuple[int, ...]
+    words: Tuple[int, ...]
+    seqnum: str
+    types_by_price: np.ndarray         # object array, cheapest first
+    order: np.ndarray                  # argsort indices into the catalog list
+    catalog_list: Sequence             # strong ref: keeps the id() key sound
+
+
 class TPUSolver:
     log = get_logger("solver")
 
     def __init__(
         self, g_max: int = 1024, c_pad_min: int = 16, client=None,
-        objective: str = "price",
+        objective: str = "price", auto_warm: bool = False,
     ):
+        # auto_warm: precompile every class-count bucket in a background
+        # thread whenever a new catalog is staged (see warm()); opt-in so
+        # unit tests with tiny catalogs don't pay 5 compiles per solver
+        self.auto_warm = auto_warm
         # g_max default sized for the price objective at bench scale: cost-
         # optimal packing opens ~1.6x the groups max-fit does (bench: 621 vs
         # 377 for 50k pods)
@@ -55,10 +72,13 @@ class TPUSolver:
         # (the SURVEY.md section 2.4 deployment seam); encode/decode and the
         # existing-node pre-pass stay host-side either way
         self.client = client
-        self._cached_catalog_list = None   # strong ref: keeps the identity check sound
-        self._cached_tensors: Optional[CatalogTensors] = None
-        self._cached_staged = None         # (StagedCatalog, offsets, words)
-        self._cached_decode = None         # (types sorted by price, order idx)
+        # catalog entries keyed by list identity, LRU-capped: one solver
+        # serves several nodepools whose catalogs alternate within a tick;
+        # a single-slot cache would re-encode + re-stage (~200 ms) on every
+        # alternation, and a background warm thread re-staging a stale
+        # catalog would race the foreground solve (round-3 review finding)
+        self._catalog_cache: "Dict[int, _CatalogEntry]" = {}
+        self._catalog_cache_cap = 8
         # wire seqnum for remote staging: id() is unsound across catalog
         # lifetimes (CPython reuses freed ids), and two controller processes
         # must never collide on the shared sidecar -- so a per-solver random
@@ -71,48 +91,110 @@ class TPUSolver:
         self._lock = threading.Lock()
 
     # -- catalog staging ----------------------------------------------------
-    def _catalog(self, instance_types: Sequence):
-        """(tensors, staged, offsets, words), memoized by object identity
-        and returned from ONE lock acquisition so concurrent solves for
-        different catalogs can never pair one catalog's encoding with
-        another's staged device tensors. Holding a strong reference to the
-        keyed list makes the `is` check sound (a bare id() key could be
-        reused by a different list after GC). Staging uploads the catalog
-        to device once -- per-tick solves then only move the pod-class
-        tensors (SURVEY.md section 7 hard part #6)."""
+    def _catalog(self, instance_types: Sequence) -> "_CatalogEntry":
+        """The immutable staged-catalog snapshot for one catalog list,
+        memoized by object identity in a small LRU and built under ONE lock
+        acquisition, so concurrent solves for different catalogs can never
+        pair one catalog's encoding with another's staged device tensors.
+        The entry holds a strong reference to the keyed list, which makes
+        the id() key sound (a freed list's id could otherwise be reused).
+        Callers thread the ENTRY through their whole solve -- nothing reads
+        mutable solver state after this call, so a background warm thread
+        or a competing pool's staging can never swap tensors mid-decode.
+        Staging uploads the catalog to device once; per-tick solves then
+        only move the pod-class tensors (SURVEY.md section 7 hard part #6)."""
+        key = id(instance_types)
+        staged_entry = None
         with self._lock:
-            if self._cached_catalog_list is not instance_types:
-                self._cached_tensors = encode.encode_catalog(instance_types)
-                # remote mode: the sidecar stages on ITS device; no local copy
-                self._cached_staged = (
-                    ffd.stage_catalog(self._cached_tensors) if self.client is None else (None, None, None)
-                )
-                # decode acceleration: type objects pre-sorted by cheapest
-                # price so per-group survivor lists are one boolean fancy-
-                # index instead of a dict-lookup + sort per group
-                prices = np.array([it.cheapest_price() for it in instance_types])
-                order = np.argsort(prices, kind="stable")
-                self._cached_decode = (
-                    np.array(list(instance_types), dtype=object)[order], order
-                )
-                self._cached_catalog_list = instance_types
-                self._seq_counter += 1
-                self._cached_seqnum = f"{self._seq_prefix}-{self._seq_counter}"
-            staged, offsets, words = self._cached_staged
-            return self._cached_tensors, staged, offsets, words, self._cached_seqnum
+            entry = self._catalog_cache.get(key)
+            if entry is not None and entry.catalog_list is instance_types:
+                # LRU touch
+                self._catalog_cache[key] = self._catalog_cache.pop(key)
+                return entry
+            tensors = encode.encode_catalog(instance_types)
+            # remote mode: the sidecar stages on ITS device; no local copy
+            staged, offsets, words = (
+                ffd.stage_catalog(tensors) if self.client is None else (None, (), ())
+            )
+            # decode acceleration: type objects pre-sorted by cheapest
+            # price so per-group survivor lists are one boolean fancy-
+            # index instead of a dict-lookup + sort per group
+            prices = np.array([it.cheapest_price() for it in instance_types])
+            order = np.argsort(prices, kind="stable")
+            self._seq_counter += 1
+            entry = _CatalogEntry(
+                tensors=tensors, staged=staged, offsets=offsets, words=words,
+                seqnum=f"{self._seq_prefix}-{self._seq_counter}",
+                types_by_price=np.array(list(instance_types), dtype=object)[order],
+                order=order, catalog_list=instance_types,
+            )
+            self._catalog_cache[key] = entry
+            while len(self._catalog_cache) > self._catalog_cache_cap:
+                self._catalog_cache.pop(next(iter(self._catalog_cache)))
+            staged_entry = entry
+        if staged_entry is not None and self.auto_warm and self.client is None:
+            threading.Thread(
+                target=self._bg_warm, args=(staged_entry,), daemon=True,
+                name="tpusolver-warm",
+            ).start()
+        return entry
 
     def catalog_tensors(self, instance_types: Sequence) -> CatalogTensors:
-        return self._catalog(instance_types)[0]
+        return self._catalog(instance_types).tensors
+
+    def _bg_warm(self, entry: "_CatalogEntry") -> None:
+        try:
+            self._warm_entry(entry)
+        except Exception as e:  # noqa: BLE001 - warm-up is best-effort
+            self.log.info("background bucket warm-up failed", error=repr(e))
+
+    def warm(self, instance_types: Sequence, c_pads: Sequence[int] = (16, 32, 64, 128, 256)) -> None:
+        """Precompile the solve for every class-count bucket a live tick can
+        hit. jit caches by static shape, and c_pad is the scan length: a
+        tick whose pod mix crosses a bucket boundary (e.g. 64 -> 128
+        classes) otherwise pays a multi-second XLA compile inside the
+        scheduling decision -- the round-2 bench's entire p99 tail was two
+        such crossings. Zero-class sets compile the same programs the real
+        shapes dispatch; with the persistent compilation cache this is
+        mostly deserialization after the first process."""
+        if self.client is not None:
+            return
+        self._warm_entry(self._catalog(instance_types), c_pads)
+
+    def _warm_entry(self, entry: "_CatalogEntry", c_pads: Sequence[int] = (16, 32, 64, 128, 256)) -> None:
+        """Compile from a pinned snapshot: the warm thread must never
+        re-stage (its catalog may already be stale by the time it runs)."""
+        outs = []
+        for cp in c_pads:
+            cs = encode.encode_classes([], entry.tensors, c_pad=cp)
+            inp = ffd.make_inputs_staged(entry.staged, cs)
+            outs.append(
+                ffd.ffd_solve_compact(
+                    inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(cp, self.g_max),
+                    word_offsets=entry.offsets, words=entry.words, objective=self.objective,
+                )
+            )
+        jax.block_until_ready(outs)
 
     # -- routing ------------------------------------------------------------
     @staticmethod
-    def supports(scheduler: Scheduler, pods: Sequence[Pod]) -> bool:
+    def supports(scheduler: Scheduler, pods: Sequence[Pod], classes=None) -> bool:
         from karpenter_tpu.solver import spread
 
+        # routing features live on the classes: spread constraints are part
+        # of class identity (its representative answers for everyone), and
+        # affinity/node-affinity-arity are OR'd onto the class as flag bits
+        # when signatures merge (encode.PodClass.has_affinity) -- a 50k-pod
+        # scan becomes ~60 class checks
+        if classes is None:
+            classes = encode.group_pods(pods)
+        reps = []
         any_spread = False
-        for p in pods:
-            if p.affinity_terms or len(p.node_affinity_terms) > 1:
+        for pc in classes:
+            if pc.has_affinity or pc.multi_node_affinity:
                 return False
+            p = pc.pods[0]
+            reps.append(p)
             if any(t.hard() for t in p.topology_spread):
                 any_spread = True
         if any_spread:
@@ -120,18 +202,20 @@ class TPUSolver:
             # zone spread (incl. existing nodes: counts seed from the
             # scheduler's topology state) stays on device. Spread + several
             # pools would need cross-pool count carry -- oracle.
-            if not spread.spread_eligible(pods) or len(scheduler.nodepools) > 1:
+            if not spread.spread_eligible(reps) or len(scheduler.nodepools) > 1:
                 return False
         return True
 
     @staticmethod
-    def _pools_overlap(pools: Sequence[NodePool], pods: Sequence[Pod]) -> bool:
+    def _pools_overlap(pools: Sequence[NodePool], pods: Sequence[Pod], classes=None) -> bool:
         """True when some pod class is compatible with more than one pool
         (the oracle's _open_group gate, per class instead of per pod)."""
         from karpenter_tpu.solver.oracle import _ALLOW_UNDEFINED
 
         pool_reqs = [p.requirements() for p in pools]
-        for pc in encode.group_pods(pods):
+        if classes is None:
+            classes = encode.group_pods(pods)
+        for pc in classes:
             n = 0
             for reqs in pool_reqs:
                 if reqs.compatible(pc.requirements, allow_undefined=_ALLOW_UNDEFINED):
@@ -153,7 +237,11 @@ class TPUSolver:
 
     # -- entry point (Provisioner contract) ---------------------------------
     def schedule(self, scheduler: Scheduler, pods: Sequence[Pod]) -> SchedulingResult:
-        if not self.supports(scheduler, pods):
+        # ONE grouping pass serves routing (supports, _pools_overlap) and
+        # the first pool's solve; per-pool requirement merges are ~60 cheap
+        # class-level copies (encode.with_extra_requirements)
+        base_classes = encode.group_pods(pods)
+        if not self.supports(scheduler, pods, classes=base_classes):
             # the fallback must pack with THIS solver's objective -- callers
             # construct the Scheduler without one, and a mixed-objective
             # pass would break device/oracle differential equivalence
@@ -167,7 +255,7 @@ class TPUSolver:
         # pod of a class routes identically; existing capacity is
         # pool-agnostic and packed in the first round only)
         pools = scheduler.nodepools
-        if len(pools) > 1 and self._pools_overlap(pools, pods):
+        if len(pools) > 1 and self._pools_overlap(pools, pods, classes=base_classes):
             # a class compatible with SEVERAL pools can join another
             # class's open group across the pool boundary in the oracle's
             # first-fit order (in-flight capacity beats weight preference,
@@ -188,6 +276,7 @@ class TPUSolver:
                 existing_nodes=existing,
                 zones=sorted(scheduler.zones),
                 spread_seeds=self._spread_seeds(scheduler) if i == 0 else None,
+                classes=base_classes if i == 0 else None,
             )
             result.new_groups.extend(res.new_groups)
             result.existing_assignments.update(res.existing_assignments)
@@ -211,17 +300,27 @@ class TPUSolver:
         existing_nodes: Sequence = (),
         zones: Sequence[str] = (),
         spread_seeds: Optional[Dict] = None,
+        classes: Optional[List] = None,
     ) -> SchedulingResult:
         from karpenter_tpu.solver import spread as spread_mod
 
-        if not spread_mod.spread_eligible(pods):
+        pool_reqs = pool.requirements()
+        if classes is None:
+            classes = encode.group_pods(pods, extra_requirements=pool_reqs)
+        else:
+            # pre-grouped by schedule(): merge the pool's requirements per
+            # class instead of re-walking 50k pods
+            classes = encode.with_extra_requirements(classes, pool_reqs)
+        # eligibility on class representatives, not all pods: spread
+        # constraints (and the pod's self-match against their selectors) are
+        # part of grouping identity (encode._spread_sig), so one pod per
+        # class decides for the class -- a 50k-pod scan becomes ~60 checks
+        if not spread_mod.spread_eligible([pc.pods[0] for pc in classes]):
             raise ValueError(
                 "TPUSolver.solve: pods carry out-of-scope spread constraints "
                 "(hostname or multiple hard constraints); call schedule() so "
                 "routing can fall back to the oracle"
             )
-        pool_reqs = pool.requirements()
-        classes = encode.group_pods(pods, extra_requirements=pool_reqs)
         result = SchedulingResult()
 
         # phase 0 (host): zone topology spread -- the carry pass splits
@@ -248,7 +347,7 @@ class TPUSolver:
             if not classes:
                 return result
         if instance_types and any(spread_mod.hard_zone_tsc(pc.pods[0]) for pc in classes):
-            catalog0 = self._catalog(instance_types)[0]
+            catalog0 = self._catalog(instance_types).tensors
             pre_set = encode.encode_classes(
                 classes, catalog0, pool_taints=list(pool.template.taints),
                 c_pad=_bucket(len(classes), self.c_pad_min),
@@ -283,7 +382,10 @@ class TPUSolver:
             return result
 
         # phase 2 (device): batched FFD over the leftovers
-        catalog, staged, offsets, words, seqnum = self._catalog(instance_types)
+        entry = self._catalog(instance_types)
+        catalog, staged, offsets, words, seqnum = (
+            entry.tensors, entry.staged, entry.offsets, entry.words, entry.seqnum
+        )
         class_set = encode.encode_classes(
             classes,
             catalog,
@@ -328,6 +430,13 @@ class TPUSolver:
                 word_offsets=offsets, words=words,
                 objective=self.objective,
             )
+            # issue the D2H copies NOW, while the device is still solving:
+            # the tunnel to the chip costs ~64 ms RTT per synchronous fetch
+            # regardless of payload, but a copy enqueued at dispatch time
+            # streams back as soon as the result exists and the later reads
+            # drain in <1 ms (measured: 137 ms -> 83 ms per solve)
+            for leaf in dec:
+                leaf.copy_to_host_async()
             dec = ffd.CompactDecision(*jax.device_get(tuple(dec)))
             dense = ffd.expand_compact(
                 dec, class_set.c_pad, self.g_max, catalog.k_pad, encode.Z_PAD, encode.CT
@@ -340,7 +449,7 @@ class TPUSolver:
                     objective=self.objective,
                 )
         return self._decode(
-            pool, instance_types, catalog, class_set, dense, nodepool_usage,
+            pool, entry, class_set, dense, nodepool_usage,
             result=result, class_offset=placed_existing,
         )
 
@@ -381,14 +490,14 @@ class TPUSolver:
     def _decode(
         self,
         pool: NodePool,
-        instance_types: Sequence,
-        catalog: CatalogTensors,
+        entry: "_CatalogEntry",
         class_set,
         dense: Tuple,
         nodepool_usage: Optional[Resources],
         result: Optional[SchedulingResult] = None,
         class_offset: Optional[np.ndarray] = None,
     ) -> SchedulingResult:
+        catalog = entry.tensors
         if result is None:
             result = SchedulingResult()
         if class_offset is None:
@@ -406,7 +515,7 @@ class TPUSolver:
         )
         # price-ordered object array (memoized in _catalog): survivors per
         # group come out cheapest-first via one boolean fancy-index
-        types_by_price, order = self._cached_decode
+        types_by_price, order = entry.types_by_price, entry.order
         captype_names = [wk.CAPACITY_TYPE_RESERVED, wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND]
 
         usage = nodepool_usage if nodepool_usage is not None else Resources()
@@ -433,6 +542,19 @@ class TPUSolver:
         # the pool's base requirement set builds once; groups copy it
         pool_base_reqs = pool.requirements()
 
+        # FFD opens groups in runs -- consecutive groups hosting the same
+        # class mix carry IDENTICAL surviving-type masks, zone/captype sets,
+        # and merged requirements. Both expensive per-group products are
+        # memoized on those bytes: the survivors list (a boolean fancy-index
+        # over the catalog) and the merged Requirements object. Groups that
+        # share a memo entry share ONE Requirements/type-list object --
+        # NewNodeGroup.requirements/instance_types are read-only by
+        # contract; consumers copy before narrowing (provisioner.py
+        # _to_nodeclaim does reqs.copy()).
+        survivors_memo: Dict[bytes, List] = {}
+        reqs_memo: Dict[Tuple, Requirements] = {}
+        taints = list(pool.template.taints)
+
         # gc paused across the allocation-heavy per-group loop (same
         # rationale as encode.group_pods)
         with gc_paused():
@@ -442,30 +564,40 @@ class TPUSolver:
                 if classes_on_g.size == 0:
                     continue
                 group_pods: List[Pod] = []
-                reqs = pool_base_reqs.copy()
                 for c in classes_on_g:
                     pc = class_set.classes[c]
                     n = int(col[c])
                     # pods before `off` went to existing nodes in phase 1
                     off = int(class_offset[c]) + int(take_cum[c, g])
                     group_pods.extend(pc.pods[off : off + n])
-                    reqs.add(*pc.requirements)
                 requested = Resources.from_base_units(
                     dict(zip(res.RESOURCE_AXES, group_req_vecs[g].tolist()))
                 )
-                group_types = types_by_price[gmask_real[g][order]].tolist()
+                mask_key = gmask_real[g].tobytes()
+                group_types = survivors_memo.get(mask_key)
+                if group_types is None:
+                    group_types = survivors_memo[mask_key] = (
+                        types_by_price[gmask_real[g][order]].tolist()
+                    )
                 if not group_types:
                     for p in group_pods:
                         result.unschedulable[p.metadata.name] = "no surviving instance type"
                     continue
-                zones = [zone_names[z] for z in np.nonzero(gzone[g][:n_zones])[0]]
-                captypes = [captype_names[i] for i in np.nonzero(gcap[g])[0]]
-                # a full mask is no constraint: the oracle's groups carry no
-                # zone/captype requirement when the pods imposed none
-                if zones and len(zones) < n_zones:
-                    reqs.add(Requirement(wk.ZONE_LABEL, Operator.IN, zones))
-                if captypes and len(captypes) < len(captype_names):
-                    reqs.add(Requirement(wk.CAPACITY_TYPE_LABEL, Operator.IN, captypes))
+                req_key = (classes_on_g.tobytes(), gzone[g].tobytes(), gcap[g].tobytes())
+                reqs = reqs_memo.get(req_key)
+                if reqs is None:
+                    reqs = pool_base_reqs.copy()
+                    for c in classes_on_g:
+                        reqs.add(*class_set.classes[c].requirements)
+                    zones = [zone_names[z] for z in np.nonzero(gzone[g][:n_zones])[0]]
+                    captypes = [captype_names[i] for i in np.nonzero(gcap[g])[0]]
+                    # a full mask is no constraint: the oracle's groups carry
+                    # no zone/captype requirement when the pods imposed none
+                    if zones and len(zones) < n_zones:
+                        reqs.add(Requirement(wk.ZONE_LABEL, Operator.IN, zones))
+                    if captypes and len(captypes) < len(captype_names):
+                        reqs.add(Requirement(wk.CAPACITY_TYPE_LABEL, Operator.IN, captypes))
+                    reqs_memo[req_key] = reqs
                 # nodepool limits (host-side guard, mirroring the oracle)
                 if limited:
                     smallest = min(group_types, key=lambda it: it.capacity.get(res.CPU))
@@ -479,7 +611,7 @@ class TPUSolver:
                         nodepool=pool,
                         requirements=reqs,
                         instance_types=group_types,
-                        taints=list(pool.template.taints),
+                        taints=taints,
                         pods=group_pods,
                         requested=requested,
                     )

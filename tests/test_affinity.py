@@ -206,3 +206,84 @@ class TestAntiAffinity:
         for g in result.new_groups:
             names = {p.metadata.name for p in g.pods}
             assert names != {"web", "hater"}
+
+
+class TestRoutingOnMergedClasses:
+    """Round-3 review finding: affinity terms are not part of the canonical
+    class key (the oracle's price envelope wants a follower to share its
+    anchor's class), so grouping can merge an affinity pod into a plain
+    pod's class. Routing must still see the affinity bit and take the
+    oracle -- the flags are OR'd onto the class (encode.PodClass)."""
+
+    def test_merged_affinity_class_routes_to_oracle(self, catalog_items):
+        from karpenter_tpu.solver import encode
+        from karpenter_tpu.solver.service import TPUSolver
+
+        plain = small("plain")
+        anti = small(
+            "anti", labels={"app": "x"},
+            affinity_terms=affinity({"app": "x"}, anti=True),
+        )
+        classes = encode.group_pods([plain, anti])
+        # identical size/selector/tolerations: one merged class, fronted by
+        # the plain pod -- exactly the hole the flags exist to cover
+        merged = [pc for pc in classes if len(pc.pods) == 2]
+        assert merged and merged[0].pods[0] is plain
+        assert merged[0].has_affinity
+        _, sched = mk_sched(catalog_items)
+        assert not TPUSolver.supports(sched, [plain, anti])
+
+    def test_merged_multi_term_node_affinity_routes_to_oracle(self, catalog_items):
+        from karpenter_tpu.scheduling import Operator, Requirement
+        from karpenter_tpu.solver import encode
+        from karpenter_tpu.solver.service import TPUSolver
+
+        plain = small("plain")
+        multi = small(
+            "multi",
+            node_affinity_terms=[
+                [Requirement(wk.ZONE_LABEL, Operator.IN, ["us-central-1a"])],
+                [Requirement(wk.ZONE_LABEL, Operator.IN, ["us-central-1b"])],
+            ],
+        )
+        classes = encode.group_pods([plain, multi])
+        assert any(pc.multi_node_affinity for pc in classes)
+        _, sched = mk_sched(catalog_items)
+        assert not TPUSolver.supports(sched, [plain, multi])
+
+
+class TestSpecTokenSafety:
+    """Round-3 review finding: a caller that mutates and reuses one spec
+    dict across Pod constructions must not produce falsely-shared grouping
+    tokens (the id()s coincide; the content fingerprint must not)."""
+
+    def test_mutated_reused_selector_does_not_merge(self):
+        from karpenter_tpu.solver import encode
+
+        shared_req = Resources({"cpu": "500m", "memory": "1Gi"})
+        sel = {}
+        pods = []
+        for z in ("zone-a", "zone-b"):
+            sel[wk.ZONE_LABEL] = z   # same dict object, mutated in place
+            pods.append(Pod(f"p-{z}", requests=shared_req, node_selector=sel))
+        classes = encode.group_pods(pods)
+        # the second pod's signature (computed from its COPIED selector)
+        # must not be absorbed into the first pod's class via the token
+        assert len(classes) == 2
+        zones = sorted(pc.pods[0].node_selector[wk.ZONE_LABEL] for pc in classes)
+        assert zones == ["zone-a", "zone-b"]
+
+    def test_mutated_second_key_does_not_merge(self):
+        """Round-3 review: the fingerprint must cover ALL selector items,
+        not just the first -- mutating a non-first key of a reused dict
+        must still split the tokens."""
+        from karpenter_tpu.solver import encode
+
+        shared_req = Resources({"cpu": "500m", "memory": "1Gi"})
+        sel = {"disktype": "ssd"}
+        pods = []
+        for z in ("zone-a", "zone-b"):
+            sel[wk.ZONE_LABEL] = z   # second key of the same dict object
+            pods.append(Pod(f"q-{z}", requests=shared_req, node_selector=sel))
+        classes = encode.group_pods(pods)
+        assert len(classes) == 2
