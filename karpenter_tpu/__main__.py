@@ -85,6 +85,12 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     op = build_operator(args)
+    # latency GC policy: the provider graph and (if enabled) the jax
+    # runtime are now the long-lived baseline; freeze it and stop gen2
+    # collections from landing inside scheduling ticks
+    from karpenter_tpu.utils import configure_gc_for_latency
+
+    configure_gc_for_latency()
     # a default NodeClass + NodePool so the rig provisions out of the box
     from karpenter_tpu.apis import NodePool, TPUNodeClass
 

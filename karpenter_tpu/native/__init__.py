@@ -1,0 +1,80 @@
+"""Native (C) components of the runtime.
+
+The decision plane's device side is JAX/XLA (solver/); the host side's
+hottest loop -- bucketing 50k pending pods into equivalence classes every
+tick -- lives here as a CPython extension (_grouping.c). The extension is
+built on first import with the system compiler (no pip, no network): a
+single translation unit against the running interpreter's headers,
+cached as a shared object next to the source and rebuilt only when the
+source changes. Everything degrades to the pure-Python loop when no
+compiler is available, so the extension is a latency optimization, never
+a hard dependency.
+
+`grouping` is the imported module or None; see encode.group_pods for the
+call site and tests/test_solver.py::TestNativeGrouping for equivalence
+coverage.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "_grouping.c")
+
+
+def _build() -> str | None:
+    """Compile _grouping.c into this directory; returns the .so path or
+    None. The object name carries a source hash so stale builds are never
+    loaded and concurrent builders converge on the same file."""
+    try:
+        with open(_SRC, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:12]
+    except OSError:
+        return None
+    tag = f"cp{sys.version_info.major}{sys.version_info.minor}"
+    so_path = os.path.join(_DIR, f"_grouping_{tag}_{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    cc = os.environ.get("CC", "cc")
+    include = sysconfig.get_path("include")
+    cflags = ["-O2", "-fPIC", "-shared", "-fno-strict-aliasing"]
+    tmp = so_path + f".tmp{os.getpid()}"
+    try:
+        subprocess.run(
+            [cc, *cflags, f"-I{include}", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, so_path)  # atomic: concurrent builders race safely
+        return so_path
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load():
+    if os.environ.get("KARPENTER_TPU_NO_NATIVE"):
+        return None
+    so_path = _build()
+    if so_path is None:
+        return None
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("karpenter_tpu.native._grouping", so_path)
+    if spec is None or spec.loader is None:
+        return None
+    try:
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:  # noqa: BLE001 - fall back to pure Python on any load failure
+        return None
+
+
+grouping = _load()

@@ -318,16 +318,11 @@ class ConsolidationEvaluator:
 
 def _with_pool_requirements(classes: Sequence[encode.PodClass], pool: NodePool) -> List[encode.PodClass]:
     """Re-derive each class's requirements merged with the pool's (the class
-    set was grouped pool-agnostically; replacement compat is per-pool)."""
-    out = []
-    pool_reqs = pool.requirements()
-    for pc in classes:
-        # same orientation as oracle._open_group: pod reqs layered onto pool
-        merged = pool_reqs.copy().add(*pc.requirements)
-        out.append(
-            encode.PodClass(pods=pc.pods, requests=pc.requests, requirements=merged, key=pc.key)
-        )
-    return out
+    set was grouped pool-agnostically; replacement compat is per-pool).
+    One shared implementation with the provisioning path -- merge
+    orientation is immaterial because Requirement.intersect is commutative
+    in every branch (set ops + symmetric min/max windows)."""
+    return encode.with_extra_requirements(classes, pool.requirements())
 
 
 def device_eligible(pods: Sequence[Pod]) -> bool:

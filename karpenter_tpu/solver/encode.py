@@ -37,6 +37,7 @@ from karpenter_tpu.providers.instancetype.types import InstanceType
 from karpenter_tpu.scheduling import Requirements, Taint, tolerates_all
 from karpenter_tpu.utils import gc_paused
 from karpenter_tpu.scheduling import resources as res
+from karpenter_tpu.native import grouping as _native_grouping
 
 # -- static solver shape parameters (XLA wants fixed shapes) -----------------
 R = res.NUM_RESOURCE_AXES          # resource axes
@@ -356,15 +357,22 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
     # gc paused: cold grouping of 50k fresh pods allocates ~400k young
     # containers; mid-loop generational collections multiply the cost ~6x
     with gc_paused():
-        for pod in pods:
-            tok = pod._spec_token
-            if tok is not None:
-                pc = tok_get(tok)
-                if pc is None:
-                    pc = tok_to_class[tok] = classify(pod)
-            else:
-                pc = classify(pod)
-            pc.pods.append(pod)
+        if _native_grouping is not None:
+            # the C hot loop (native/_grouping.c): token attribute read +
+            # dict probe + list append per pod, calling classify() back
+            # only on per-template misses -- same semantics, ~5x less
+            # per-pod cost and far less sensitivity to a churned heap
+            _native_grouping.group_by_token(pods, classify)
+        else:
+            for pod in pods:
+                tok = pod._spec_token
+                if tok is not None:
+                    pc = tok_get(tok)
+                    if pc is None:
+                        pc = tok_to_class[tok] = classify(pod)
+                else:
+                    pc = classify(pod)
+                pc.pods.append(pod)
     # FFD order: dominant resource descending with the canonical tie-break
     # (pod_sort_key) -- must match the oracle's sort for differential
     # equivalence, including between equal-sized classes

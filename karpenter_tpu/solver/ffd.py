@@ -513,6 +513,81 @@ def ffd_solve_compact(
     )
 
 
+@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "objective"))
+def ffd_solve_fused(
+    inp: SolveInputs,
+    *,
+    g_max: int,
+    nnz_max: int,
+    word_offsets: Tuple[int, ...],
+    words: Tuple[int, ...],
+    objective: str = "price",
+) -> jax.Array:
+    """The CompactDecision flattened into ONE u32 vector on device.
+
+    The tunnel to the chip serializes per-array D2H copies (~5 ms each even
+    when issued async), so the in-process path fetches a single buffer and
+    slices it on the host (expand_fused). Layout, all 32-bit lanes:
+        [0]                  nnz (true sparse count)
+        [1]                  n_open
+        [2 : 2+C]            unplaced   (i32 bits)
+        [2+C : 2+C+N]        idx        (i32 bits, -1 pads)
+        [2+C+N : 2+C+2N]     val        (i32 bits)
+        [... : +G*K/32]      gmask_bits (u32)
+        [... : +G]           gzc        (u32)
+    """
+    dec = ffd_solve_compact(
+        inp, g_max=g_max, nnz_max=nnz_max, word_offsets=word_offsets,
+        words=words, objective=objective,
+    )
+    parts = [
+        dec.nnz.reshape(1).astype(jnp.uint32),
+        dec.n_open.reshape(1).astype(jnp.uint32),
+        jax.lax.bitcast_convert_type(dec.unplaced, jnp.uint32).ravel(),
+        jax.lax.bitcast_convert_type(dec.idx, jnp.uint32).ravel(),
+        jax.lax.bitcast_convert_type(dec.val, jnp.uint32).ravel(),
+        dec.gmask_bits.ravel(),
+        dec.gzc.ravel(),
+    ]
+    return jnp.concatenate(parts)
+
+
+def expand_fused(buf: np.ndarray, C: int, G: int, K: int, Z: int, CTn: int, nnz_max: int):
+    """Host-side split of the fused u32 vector back into the dense decode
+    inputs (same contract as expand_compact; None on sparse overflow)."""
+    buf = np.asarray(buf)
+    kw = K // 32
+    expect = 2 + C + 2 * nnz_max + G * kw + G
+    if buf.size != expect:
+        # geometry mismatch = caller paired the buffer with the wrong
+        # catalog entry / nnz budget; every positional slice below would
+        # decode wrong-but-plausible values, so fail loudly instead
+        raise ValueError(
+            f"expand_fused: buffer has {buf.size} lanes, geometry "
+            f"(C={C}, G={G}, K={K}, nnz_max={nnz_max}) expects {expect}"
+        )
+    nnz = int(buf[0])
+    if nnz > nnz_max:
+        return None
+    off = 2
+    unplaced = buf[off : off + C].view(np.int32); off += C
+    idx = buf[off : off + nnz_max].view(np.int32); off += nnz_max
+    val = buf[off : off + nnz_max].view(np.int32); off += nnz_max
+    gmask_bits = buf[off : off + G * kw].reshape(G, kw); off += G * kw
+    gzc = buf[off : off + G]
+    take = np.zeros((C * G,), dtype=np.int32)
+    valid = idx >= 0
+    take[idx[valid]] = val[valid]
+    take = take.reshape(C, G)
+    gmask = (
+        (gmask_bits[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(bool).reshape(G, K)
+    gzone = ((gzc[:, None] >> np.arange(Z, dtype=np.uint32)) & 1) != 0
+    gcap = ((gzc[:, None] >> np.arange(_CT_SHIFT, _CT_SHIFT + CTn, dtype=np.uint32)) & 1) != 0
+    n_open = int(buf[1])
+    return take, unplaced, n_open, gmask, gzone, gcap
+
+
 def solve_dense_tuple(
     inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
     objective: str = "price",

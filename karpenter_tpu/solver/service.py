@@ -87,7 +87,7 @@ class TPUSolver:
 
         self._seq_prefix = uuid.uuid4().hex[:12]
         self._seq_counter = 0
-        self._cached_seqnum = ""
+        self._warmed_pads: set = set()
         self._lock = threading.Lock()
 
     # -- catalog staging ----------------------------------------------------
@@ -148,11 +148,18 @@ class TPUSolver:
         except Exception as e:  # noqa: BLE001 - warm-up is best-effort
             self.log.info("background bucket warm-up failed", error=repr(e))
 
-    def warm(self, instance_types: Sequence, c_pads: Sequence[int] = (16, 32, 64, 128, 256)) -> None:
-        """Precompile the solve for every class-count bucket a live tick can
-        hit. jit caches by static shape, and c_pad is the scan length: a
-        tick whose pod mix crosses a bucket boundary (e.g. 64 -> 128
-        classes) otherwise pays a multi-second XLA compile inside the
+    # class-count buckets precompiled at warm-up: powers of two up to the
+    # group-slot budget (g_max defaults to 1024 -- more classes than groups
+    # cannot all place anyway, so larger buckets are already a degenerate
+    # regime). A dispatch beyond the warmed set still works; it pays an
+    # in-tick compile once and logs it (see solve()).
+    WARM_C_PADS = (16, 32, 64, 128, 256, 512, 1024)
+
+    def warm(self, instance_types: Sequence, c_pads: Sequence[int] = WARM_C_PADS) -> None:
+        """Precompile the solve for every class-count bucket a live tick is
+        expected to hit. jit caches by static shape, and c_pad is the scan
+        length: a tick whose pod mix crosses a bucket boundary (e.g. 64 ->
+        128 classes) otherwise pays a multi-second XLA compile inside the
         scheduling decision -- the round-2 bench's entire p99 tail was two
         such crossings. Zero-class sets compile the same programs the real
         shapes dispatch; with the persistent compilation cache this is
@@ -161,7 +168,7 @@ class TPUSolver:
             return
         self._warm_entry(self._catalog(instance_types), c_pads)
 
-    def _warm_entry(self, entry: "_CatalogEntry", c_pads: Sequence[int] = (16, 32, 64, 128, 256)) -> None:
+    def _warm_entry(self, entry: "_CatalogEntry", c_pads: Sequence[int] = WARM_C_PADS) -> None:
         """Compile from a pinned snapshot: the warm thread must never
         re-stage (its catalog may already be stale by the time it runs)."""
         outs = []
@@ -169,11 +176,12 @@ class TPUSolver:
             cs = encode.encode_classes([], entry.tensors, c_pad=cp)
             inp = ffd.make_inputs_staged(entry.staged, cs)
             outs.append(
-                ffd.ffd_solve_compact(
+                ffd.ffd_solve_fused(
                     inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(cp, self.g_max),
                     word_offsets=entry.offsets, words=entry.words, objective=self.objective,
                 )
             )
+            self._warmed_pads.add(cp)
         jax.block_until_ready(outs)
 
     # -- routing ------------------------------------------------------------
@@ -395,6 +403,17 @@ class TPUSolver:
         counts = class_set.count.copy()
         counts[: len(classes)] -= placed_existing.astype(counts.dtype)
         class_set.count = counts
+        if (
+            self._warmed_pads
+            and class_set.c_pad not in self._warmed_pads
+            and self._route_monitor.has_changed("unwarmed_c_pad", class_set.c_pad)
+        ):
+            # the tick will pay a one-off XLA compile for this bucket; say
+            # so instead of leaving an unexplained latency spike in the logs
+            self.log.info(
+                "class-count bucket was not precompiled; this tick compiles",
+                c_pad=class_set.c_pad, classes=len(classes),
+            )
         dense = None
         if self.client is not None:
             # compact over the wire too: this seam exists for the TPU-VM
@@ -423,23 +442,22 @@ class TPUSolver:
                 )
         else:
             inp = ffd.make_inputs_staged(staged, class_set)
-            # compact decision: ~50 KB over the (bandwidth-poor) device
-            # tunnel instead of the ~1.5 MB dense SolveOutputs
-            dec = ffd.ffd_solve_compact(
-                inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(class_set.c_pad, self.g_max),
+            # fused compact decision: the whole result in ONE ~140 KB u32
+            # buffer instead of 7 arrays (the tunnel serializes per-array
+            # copies at ~5 ms each), fetched with ONE async copy issued at
+            # dispatch time -- a synchronous fetch costs ~64 ms RTT flat,
+            # but a copy enqueued now streams back as soon as the result
+            # exists and the later read drains in <1 ms
+            nnz_max = ffd.nnz_budget(class_set.c_pad, self.g_max)
+            buf = ffd.ffd_solve_fused(
+                inp, g_max=self.g_max, nnz_max=nnz_max,
                 word_offsets=offsets, words=words,
                 objective=self.objective,
             )
-            # issue the D2H copies NOW, while the device is still solving:
-            # the tunnel to the chip costs ~64 ms RTT per synchronous fetch
-            # regardless of payload, but a copy enqueued at dispatch time
-            # streams back as soon as the result exists and the later reads
-            # drain in <1 ms (measured: 137 ms -> 83 ms per solve)
-            for leaf in dec:
-                leaf.copy_to_host_async()
-            dec = ffd.CompactDecision(*jax.device_get(tuple(dec)))
-            dense = ffd.expand_compact(
-                dec, class_set.c_pad, self.g_max, catalog.k_pad, encode.Z_PAD, encode.CT
+            buf.copy_to_host_async()
+            dense = ffd.expand_fused(
+                np.asarray(buf), class_set.c_pad, self.g_max, catalog.k_pad,
+                encode.Z_PAD, encode.CT, nnz_max,
             )
             if dense is None:
                 # sparse budget overflow (placements not near-diagonal):
@@ -563,13 +581,21 @@ class TPUSolver:
                 classes_on_g = np.nonzero(col > 0)[0]
                 if classes_on_g.size == 0:
                     continue
-                group_pods: List[Pod] = []
-                for c in classes_on_g:
+                if classes_on_g.size == 1:
+                    # the common shape (FFD opens group runs per class):
+                    # one slice, no extend-copy
+                    c = classes_on_g[0]
                     pc = class_set.classes[c]
-                    n = int(col[c])
-                    # pods before `off` went to existing nodes in phase 1
                     off = int(class_offset[c]) + int(take_cum[c, g])
-                    group_pods.extend(pc.pods[off : off + n])
+                    group_pods: List[Pod] = pc.pods[off : off + int(col[c])]
+                else:
+                    group_pods = []
+                    for c in classes_on_g:
+                        pc = class_set.classes[c]
+                        n = int(col[c])
+                        # pods before `off` went to existing nodes in phase 1
+                        off = int(class_offset[c]) + int(take_cum[c, g])
+                        group_pods.extend(pc.pods[off : off + n])
                 requested = Resources.from_base_units(
                     dict(zip(res.RESOURCE_AXES, group_req_vecs[g].tolist()))
                 )

@@ -440,3 +440,70 @@ class TestDifferentialFuzz:
             device.existing_assignments.items()
         ), f"seed {seed}"
         assert _signature(oracle) == _signature(device), f"seed {seed}"
+
+
+class TestNativeGrouping:
+    """The C hot loop (native/_grouping.c) must group EXACTLY as the pure
+    Python loop: same classes, same order, same pods per class, same
+    routing flags -- across shared-spec tokens, per-pod specs, and
+    token-less spread pods."""
+
+    def _mixed_pods(self):
+        import numpy as np
+
+        from karpenter_tpu.apis import Pod
+        from karpenter_tpu.apis.pod import TopologySpreadConstraint
+        from karpenter_tpu.scheduling import Resources, Toleration
+
+        rng = np.random.default_rng(11)
+        pods = []
+        # shared-spec templates (token fast path)
+        for t in range(6):
+            req = Resources({"cpu": f"{100 * (t + 1)}m", "memory": "256Mi"})
+            sel = {"topology.kubernetes.io/zone": f"us-central-1{'abc'[t % 3]}"} if t % 2 else None
+            tol = [Toleration(key="dedicated", operator="Exists")] if t == 3 else ()
+            for i in range(int(rng.integers(3, 30))):
+                pods.append(Pod(f"tpl{t}-{i}", requests=req, node_selector=sel, tolerations=tol))
+        # per-pod specs (distinct tokens, equal structure -> must merge)
+        for i in range(10):
+            pods.append(Pod(f"solo-{i}", requests=Resources({"cpu": "250m", "memory": "512Mi"})))
+        # token-less spread pods (classify path)
+        for i in range(8):
+            pods.append(
+                Pod(
+                    f"spread-{i}",
+                    requests=Resources({"cpu": "100m", "memory": "128Mi"}),
+                    labels={"app": "s"},
+                    topology_spread=[
+                        TopologySpreadConstraint(
+                            max_skew=1,
+                            topology_key="topology.kubernetes.io/zone",
+                            label_selector={"app": "s"},
+                        )
+                    ],
+                )
+            )
+        rng.shuffle(pods)  # interleave arrival order
+        return list(pods)
+
+    def test_native_matches_python(self, monkeypatch):
+        from karpenter_tpu import native
+        from karpenter_tpu.solver import encode
+
+        if native.grouping is None:
+            import pytest
+
+            pytest.skip("no compiler: native grouping unavailable")
+        pods = self._mixed_pods()
+        native_classes = encode.group_pods(pods)
+
+        monkeypatch.setattr(encode, "_native_grouping", None)
+        # fresh pods: _sig_id memos persist but per-call dicts do not
+        py_classes = encode.group_pods(pods)
+
+        assert len(native_classes) == len(py_classes)
+        for a, b in zip(native_classes, py_classes):
+            assert [p.metadata.name for p in a.pods] == [p.metadata.name for p in b.pods]
+            assert a.key == b.key
+            assert a.has_affinity == b.has_affinity
+            assert a.multi_node_affinity == b.multi_node_affinity
