@@ -90,6 +90,13 @@ class InterruptionController:
 
     # -- handling -----------------------------------------------------------
     def _claim_for_instance(self, instance_id: str) -> Optional[NodeClaim]:
+        # O(1) via the status.instanceID field index when the operator
+        # registered it (reference: NodeClaimInstanceIDIndexer,
+        # pkg/operator/operator.go:284-305); a bare controller without the
+        # index (unit tests) falls back to the scan
+        if self.cluster.has_index(NodeClaim, "status.instanceID"):
+            hits = self.cluster.by_index(NodeClaim, "status.instanceID", instance_id)
+            return hits[0] if hits else None
         suffix = f"/{instance_id}"
         for claim in self.cluster.list(NodeClaim):
             if claim.provider_id.endswith(suffix):

@@ -46,10 +46,73 @@ class Cluster:
         self._store: Dict[str, Dict[str, APIObject]] = {k.KIND: {} for k in self.KINDS}
         self._version = 0
         self._handlers: List[EventHandler] = []
+        # field indexers (reference: mgr.GetFieldIndexer().IndexField on
+        # NodeClaim status fields, pkg/operator/operator.go:284-305):
+        # (kind, index name) -> key fn; per index a forward map key ->
+        # {object name: object} and a reverse map object name -> key
+        self._indexers: Dict[Tuple[str, str], Callable[[APIObject], Optional[str]]] = {}
+        self._indexes: Dict[Tuple[str, str], Tuple[Dict[str, Dict[str, APIObject]], Dict[str, str]]] = {}
 
     # -- watch --------------------------------------------------------------
     def on_event(self, handler: EventHandler) -> None:
         self._handlers.append(handler)
+
+    # -- field indexes ------------------------------------------------------
+    def add_field_index(
+        self, kind: Type[APIObject], name: str, key_fn: Callable[[APIObject], Optional[str]]
+    ) -> None:
+        """Register an O(1) lookup over one derived key, maintained on
+        every create/update/delete -- the in-memory analogue of
+        controller-runtime's field indexer. key_fn returns None for
+        objects that should not be indexed (e.g. an empty providerID
+        before launch)."""
+        with self._lock:
+            self._indexers[(kind.KIND, name)] = key_fn
+            fwd: Dict[str, Dict[str, APIObject]] = {}
+            rev: Dict[str, str] = {}
+            for obj in self._store[kind.KIND].values():
+                key = key_fn(obj)
+                if key:
+                    fwd.setdefault(key, {})[obj.metadata.name] = obj
+                    rev[obj.metadata.name] = key
+            self._indexes[(kind.KIND, name)] = (fwd, rev)
+
+    def by_index(self, kind: Type[APIObject], name: str, key: str) -> List[APIObject]:
+        """Objects whose indexed key equals `key`. Hits are re-verified
+        against key_fn so an object mutated WITHOUT a cluster.update()
+        call is filtered rather than returned stale (informer caches have
+        the same contract: writes must go through the store)."""
+        with self._lock:
+            entry = self._indexes.get((kind.KIND, name))
+            if entry is None:
+                raise KeyError(f"no field index {name!r} for {kind.KIND}")
+            key_fn = self._indexers[(kind.KIND, name)]
+            return [o for o in entry[0].get(key, {}).values() if key_fn(o) == key]
+
+    def has_index(self, kind: Type[APIObject], name: str) -> bool:
+        with self._lock:
+            return (kind.KIND, name) in self._indexes
+
+    def _index_touch(self, obj: APIObject, removed: bool = False) -> None:
+        """Under self._lock: re-key `obj` in every index on its kind."""
+        kind = type(obj).KIND
+        oname = obj.metadata.name
+        for (ikind, iname), key_fn in self._indexers.items():
+            if ikind != kind:
+                continue
+            fwd, rev = self._indexes[(ikind, iname)]
+            old = rev.pop(oname, None)
+            if old is not None:
+                bucket = fwd.get(old)
+                if bucket is not None:
+                    bucket.pop(oname, None)
+                    if not bucket:
+                        fwd.pop(old, None)
+            if not removed:
+                key = key_fn(obj)
+                if key:
+                    fwd.setdefault(key, {})[oname] = obj
+                    rev[oname] = key
 
     def _emit(self, event: str, obj: APIObject) -> None:
         for h in self._handlers:
@@ -72,6 +135,7 @@ class Cluster:
             if not obj.metadata.creation_timestamp:
                 obj.metadata.creation_timestamp = self.clock.now()
             self._store[kind][obj.metadata.name] = obj
+            self._index_touch(obj)
         self._emit("ADDED", obj)
         return obj
 
@@ -107,6 +171,7 @@ class Cluster:
             self._version += 1
             obj.metadata.resource_version = self._version
             self._store[kind][obj.metadata.name] = obj
+            self._index_touch(obj)
         self._emit("MODIFIED", obj)
         return obj
 
@@ -125,6 +190,7 @@ class Cluster:
                 result = obj
             else:
                 del self._store[kind.KIND][name]
+                self._index_touch(obj, removed=True)
                 result = None
         if result is not None:
             self._emit("DELETING", obj)
@@ -138,6 +204,7 @@ class Cluster:
                 obj.metadata.finalizers.remove(finalizer)
             if obj.metadata.deletion_timestamp is not None and not obj.metadata.finalizers:
                 self._store[type(obj).KIND].pop(obj.metadata.name, None)
+                self._index_touch(obj, removed=True)
                 removed = True
             else:
                 removed = False

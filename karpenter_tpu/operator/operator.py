@@ -33,6 +33,7 @@ from karpenter_tpu.controllers.provisioner import PodBinder, Provisioner
 from karpenter_tpu.controllers.repair import NodeRepairController
 from karpenter_tpu.controllers.tagging import TaggingController
 from karpenter_tpu.controllers.termination import TerminationController
+from karpenter_tpu.apis import NodeClaim
 from karpenter_tpu.events import Recorder
 from karpenter_tpu.kwok.cloud import FakeCloud
 from karpenter_tpu.kwok.cluster import Cluster
@@ -141,6 +142,14 @@ class Operator:
             self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates,
             evaluator=consolidation_evaluator,
         )
+        # instance-id field index for interruption lookups, registered
+        # exactly when the interruption queue is configured (reference
+        # gates its status.instanceID indexers the same way,
+        # pkg/operator/operator.go:188-191, 284-305)
+        if self.options.interruption_queue:
+            from karpenter_tpu.utils import nodeclaim_instance_id
+
+            self.cluster.add_field_index(NodeClaim, "status.instanceID", nodeclaim_instance_id)
         self.interruption = InterruptionController(
             self.cluster, self.queue, self.unavailable, self.recorder
         )

@@ -17,6 +17,16 @@ def parse_instance_id(provider_id: str) -> str:
     return m.group("id")
 
 
+def nodeclaim_instance_id(claim) -> "str | None":
+    """Index key for the status.instanceID field index: the instance id
+    from a NodeClaim's providerID, or None when unset/unparseable (the
+    claim is then simply not indexed)."""
+    try:
+        return parse_instance_id(claim.provider_id) if claim.provider_id else None
+    except ValueError:
+        return None
+
+
 def merge_tags(*tag_maps: Mapping[str, str]) -> Dict[str, str]:
     """Later maps win (reference: GetTags merge order)."""
     out: Dict[str, str] = {}

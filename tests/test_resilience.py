@@ -367,3 +367,64 @@ class TestNodeAutoRepair:
         env.clock.step(2 * 60.0)
         assert env.repair.reconcile() == 0
         assert not env.cluster.get(NodeClaim, claim.metadata.name).deleting
+
+
+class TestFieldIndex:
+    """Field indexers on the in-memory cluster (reference registers a
+    status.instanceID indexer for interruption lookups when the queue is
+    configured, pkg/operator/operator.go:188-191, 284-305)."""
+
+    def _mk(self):
+        from karpenter_tpu.apis import NodeClaim
+        from karpenter_tpu.kwok.cluster import Cluster
+        from karpenter_tpu.utils import nodeclaim_instance_id
+
+        cluster = Cluster()
+        cluster.add_field_index(NodeClaim, "status.instanceID", nodeclaim_instance_id)
+        return cluster, NodeClaim
+
+    def test_index_tracks_create_update_delete(self):
+        cluster, NodeClaim = self._mk()
+        claim = NodeClaim("c-1")
+        cluster.create(claim)
+        assert cluster.by_index(NodeClaim, "status.instanceID", "i-abc") == []
+        claim.provider_id = "tpu:///us-central-1a/i-abc"
+        cluster.update(claim)
+        assert cluster.by_index(NodeClaim, "status.instanceID", "i-abc") == [claim]
+        # re-key on change
+        claim.provider_id = "tpu:///us-central-1a/i-def"
+        cluster.update(claim)
+        assert cluster.by_index(NodeClaim, "status.instanceID", "i-abc") == []
+        assert cluster.by_index(NodeClaim, "status.instanceID", "i-def") == [claim]
+        cluster.delete(NodeClaim, "c-1")
+        assert cluster.by_index(NodeClaim, "status.instanceID", "i-def") == []
+
+    def test_index_backfills_existing_and_verifies_stale(self):
+        from karpenter_tpu.apis import NodeClaim
+        from karpenter_tpu.kwok.cluster import Cluster
+        from karpenter_tpu.utils import nodeclaim_instance_id
+
+        cluster = Cluster()
+        claim = NodeClaim("c-1")
+        claim.provider_id = "tpu:///us-central-1a/i-abc"
+        cluster.create(claim)
+        cluster.add_field_index(NodeClaim, "status.instanceID", nodeclaim_instance_id)
+        assert cluster.by_index(NodeClaim, "status.instanceID", "i-abc") == [claim]
+        # mutation WITHOUT cluster.update: the hit is verified and filtered
+        claim.provider_id = "tpu:///us-central-1a/i-zzz"
+        assert cluster.by_index(NodeClaim, "status.instanceID", "i-abc") == []
+
+    def test_interruption_uses_index(self):
+        """The interruption controller resolves claims through the index
+        when the operator registered it (interruption-queue configured)."""
+        from karpenter_tpu.apis import NodeClaim
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.operator.operator import Options
+
+        op = Operator(options=Options(interruption_queue="q"))
+        assert op.cluster.has_index(NodeClaim, "status.instanceID")
+        claim = NodeClaim("c-1")
+        claim.provider_id = "tpu:///us-central-1a/i-42"
+        op.cluster.create(claim)
+        assert op.interruption._claim_for_instance("i-42") is claim
+        assert op.interruption._claim_for_instance("i-43") is None
