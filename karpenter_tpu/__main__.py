@@ -108,12 +108,13 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, on_signal)
 
     ticks = 0
+    op.watch_pods()   # pod arrivals wake the loop through the batch window
     while not stop["flag"]:
         op.tick()
         ticks += 1
         if args.max_ticks and ticks >= args.max_ticks:
             break
-        time.sleep(args.tick_interval)
+        op.wait_for_work(args.tick_interval)
 
     if args.metrics_dump:
         from karpenter_tpu import metrics

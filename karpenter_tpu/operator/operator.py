@@ -33,7 +33,10 @@ from karpenter_tpu.controllers.provisioner import PodBinder, Provisioner
 from karpenter_tpu.controllers.repair import NodeRepairController
 from karpenter_tpu.controllers.tagging import TaggingController
 from karpenter_tpu.controllers.termination import TerminationController
-from karpenter_tpu.apis import NodeClaim
+import threading
+import time
+
+from karpenter_tpu.apis import NodeClaim, Pod
 from karpenter_tpu.events import Recorder
 from karpenter_tpu.kwok.cloud import FakeCloud
 from karpenter_tpu.kwok.cluster import Cluster
@@ -212,3 +215,38 @@ class Operator:
             if isinstance(self.clock, FakeClock):
                 self.clock.step(step_seconds)
         return max_ticks
+
+    # -- event-driven tick trigger ------------------------------------------
+    def watch_pods(self) -> None:
+        """Arm the wall-clock run loop's pod-arrival wake-up: a watch
+        handler sets an event on every Pod ADDED, so wait_for_work can cut
+        an idle sleep short and batch the burst. Separate from the
+        deterministic tick()/settle() test path, which never blocks."""
+        if getattr(self, "pod_wake", None) is not None:
+            return
+        self.pod_wake = threading.Event()
+
+        def _on_event(event: str, obj) -> None:
+            if event == "ADDED" and isinstance(obj, Pod):
+                self.pod_wake.set()
+
+        self.cluster.on_event(_on_event)
+
+    def wait_for_work(self, tick_interval: float) -> None:
+        """Block until the next tick should run: at most tick_interval, but
+        a pod arrival wakes the loop early and the batching window (idle /
+        max durations from Options, the reference's 35 ms / 1 s request
+        batcher shape -- pkg/batcher/batcher.go:84-160) lets the rest of
+        the burst accumulate so one solve sees the whole pods x types
+        matrix (SURVEY.md section 2.4)."""
+        if getattr(self, "pod_wake", None) is None:
+            time.sleep(tick_interval)
+            return
+        if not self.pod_wake.wait(timeout=tick_interval):
+            return
+        deadline = time.monotonic() + self.options.batch_max_duration
+        while time.monotonic() < deadline:
+            self.pod_wake.clear()
+            if not self.pod_wake.wait(timeout=self.options.batch_idle_duration):
+                break
+        self.pod_wake.clear()

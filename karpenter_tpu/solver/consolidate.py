@@ -254,6 +254,10 @@ class ConsolidationEvaluator:
             leftover, _ = sharded_repack(self.mesh, headroom, feas, req, member, excl)
         else:
             leftover, _ = _repack(headroom, feas, req, member, excl)
+        if hasattr(leftover, "copy_to_host_async"):
+            # one async D2H issued at dispatch (a synchronous fetch over a
+            # tunneled device costs a flat ~64 ms RTT; see service.solve)
+            leftover.copy_to_host_async()
         leftover = np.asarray(leftover)
         left_total = leftover.sum(axis=1)
 
@@ -289,14 +293,15 @@ class ConsolidationEvaluator:
                 c_pad=C,
             )
             compat = encode.compat_matrix(catalog, cs)
-            best, best_od, best_k = (
-                np.asarray(x)
-                for x in _replacement_search(
-                    jnp.asarray(leftover), jnp.asarray(cs.req), jnp.asarray(compat),
-                    jnp.asarray(cs.azone), jnp.asarray(cs.acap),
-                    jnp.asarray(catalog.cap), jnp.asarray(catalog.price),
-                )
+            out = _replacement_search(
+                jnp.asarray(leftover), jnp.asarray(cs.req), jnp.asarray(compat),
+                jnp.asarray(cs.azone), jnp.asarray(cs.acap),
+                jnp.asarray(catalog.cap), jnp.asarray(catalog.price),
             )
+            for x in out:
+                if hasattr(x, "copy_to_host_async"):
+                    x.copy_to_host_async()  # overlap the three fetches
+            best, best_od, best_k = (np.asarray(x) for x in out)
             still = []
             for si in pending:
                 if np.isfinite(best[si]):

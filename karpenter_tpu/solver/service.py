@@ -493,7 +493,11 @@ class TPUSolver:
         _, takes = consolidate._repack(
             headroom, feas, req, member, np.zeros((1, N), dtype=bool)
         )
-        takes = np.asarray(takes[0])                       # [C, N]
+        if hasattr(takes, "copy_to_host_async"):
+            takes.copy_to_host_async()   # hide the tunnel RTT (see phase 2)
+        # convert the SAME object the prefetch primed, then slice on host
+        # (takes[0] would be a fresh device array with no cached host copy)
+        takes = np.asarray(takes)[0]                       # [C, N]
         placed = np.zeros((len(classes),), dtype=np.int64)
         for c, pc in enumerate(classes):
             cursor = 0

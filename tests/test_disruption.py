@@ -473,3 +473,51 @@ class TestMultiNodeReplacement:
         self._mk_node(op, "exp-b", "t4g.large", [("pb", 900, 3500)])
         decisions = ctl.reconcile(max_disruptions=5)
         assert decisions == [], decisions
+
+
+class TestCloudStateDrift:
+    """The three resolved-cloud-state drift kinds beyond the static hash
+    (reference pkg/cloudprovider/drift.go:43-157): image, subnet, and
+    security-group drift, each detected against the nodeclass's CURRENT
+    resolved status and driving a Drifted replacement."""
+
+    def _provisioned(self, env):
+        run_pods(env, [Pod("p0", requests=Resources({"cpu": "200m"}))])
+        claims = [c for c in env.cluster.list(NodeClaim) if not c.deleting]
+        assert claims
+        return claims[0]
+
+    def test_image_drift(self, env):
+        claim = self._provisioned(env)
+        nc = env.cluster.get(TPUNodeClass, "default")
+        assert claim.image_id, "launch must stamp the claim's image"
+        from karpenter_tpu.apis.nodeclass import ImageStatus
+
+        nc.status_images = [ImageStatus(id="img-new", name="img-new")]
+        env.cluster.update(nc)
+        age_all_claims(env)
+        decisions = env.disruption.reconcile()
+        assert decisions and decisions[0][1] == "Drifted"
+        assert env.cloud_provider.is_drifted(claim) == "ImageDrifted"
+
+    def test_subnet_drift(self, env):
+        claim = self._provisioned(env)
+        nc = env.cluster.get(TPUNodeClass, "default")
+        from karpenter_tpu.apis.nodeclass import SubnetStatus
+
+        nc.status_subnets = [SubnetStatus("subnet-nonexistent", "zone-x", "zx")]
+        env.cluster.update(nc)
+        assert env.cloud_provider.is_drifted(claim) == "SubnetDrifted"
+
+    def test_security_group_drift(self, env):
+        claim = self._provisioned(env)
+        nc = env.cluster.get(TPUNodeClass, "default")
+        from karpenter_tpu.apis.nodeclass import SecurityGroupStatus
+
+        nc.status_security_groups = [SecurityGroupStatus("sg-other", "other")]
+        env.cluster.update(nc)
+        assert env.cloud_provider.is_drifted(claim) == "SecurityGroupDrifted"
+
+    def test_no_drift_when_status_matches(self, env):
+        claim = self._provisioned(env)
+        assert env.cloud_provider.is_drifted(claim) is None

@@ -299,3 +299,51 @@ class TestFeatureGateFlag:
         assert op.options.feature_gates["ReservedCapacity"] is False
         # the disruption controller consumes the merged gates
         assert op.disruption.feature_gates["SpotToSpotConsolidation"] is True
+
+
+class TestPodArrivalWake:
+    """Event-driven tick trigger: a pod arrival wakes the run loop early
+    and the burst accumulates behind the batching window (the reference's
+    provisioning-side request batcher shape, pkg/batcher/batcher.go:84-160
+    mapped per SURVEY.md section 2.4)."""
+
+    def test_wake_on_pod_added_and_window_batches(self):
+        import threading
+        import time as _t
+
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.operator.operator import Options
+
+        op = Operator(options=Options(batch_idle_duration=0.02, batch_max_duration=0.2))
+        op.watch_pods()
+        # no pods: the wait honors the full (short) tick interval
+        t0 = _t.monotonic()
+        op.wait_for_work(0.05)
+        assert _t.monotonic() - t0 >= 0.05
+
+        # a burst arriving mid-wait wakes early, then the idle window
+        # closes ~20ms after the last arrival instead of the 5s interval
+        def burst():
+            for i in range(5):
+                op.cluster.create(Pod(f"w-{i}", requests=Resources({"cpu": "100m"})))
+                _t.sleep(0.005)
+
+        th = threading.Thread(target=burst)
+        t0 = _t.monotonic()
+        th.start()
+        op.wait_for_work(5.0)
+        elapsed = _t.monotonic() - t0
+        th.join()
+        assert elapsed < 1.0, f"wake took {elapsed:.3f}s; the 5s interval was not cut short"
+        # every pod of the burst is pending for the ONE solve that follows
+        assert len(op.cluster.pending_pods()) == 5
+
+    def test_wait_without_watch_sleeps_interval(self):
+        from karpenter_tpu.operator import Operator
+
+        import time as _t
+
+        op = Operator()
+        t0 = _t.monotonic()
+        op.wait_for_work(0.03)
+        assert _t.monotonic() - t0 >= 0.03
