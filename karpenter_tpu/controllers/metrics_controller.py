@@ -20,6 +20,23 @@ INSTANCE_INFO = metrics.REGISTRY.gauge(
     labels=("nodeclaim", "instance_type", "zone", "capacity_type", "nodepool", "reservation_id"),
 )
 
+# generic status-condition metrics (reference: the operatorpkg
+# status.Controller registered per watched kind,
+# pkg/controllers/controllers.go:98): object counts aggregated by
+# (kind, condition type, status, reason) -- bounded cardinality no matter
+# how many objects churn -- plus a transition counter bumped whenever an
+# object's condition changes status between sweeps.
+STATUS_CONDITION_COUNT = metrics.REGISTRY.gauge(
+    "karpenter_status_condition_count",
+    "Objects per (kind, condition type, condition status, reason).",
+    labels=("kind", "type", "condition_status", "reason"),
+)
+STATUS_CONDITION_TRANSITIONS = metrics.REGISTRY.counter(
+    "karpenter_status_condition_transitions_total",
+    "Condition status changes observed between metric sweeps.",
+    labels=("kind", "type", "condition_status"),
+)
+
 
 class MetricsController:
     log = get_logger("metrics")
@@ -27,6 +44,9 @@ class MetricsController:
     def __init__(self, cluster: Cluster):
         self.cluster = cluster
         self._series: Dict[str, Tuple] = {}  # claim name -> label values
+        # (kind, object name, condition type) -> status, for transitions
+        self._cond_last: Dict[Tuple[str, str, str], str] = {}
+        self._cond_series: set = set()  # live (kind, type, status, reason) keys
 
     def _labels_of(self, claim: NodeClaim) -> Dict[str, str]:
         l = claim.metadata.labels
@@ -58,4 +78,37 @@ class MetricsController:
                 "instance info series", series=len(live), pruned=len(self._series) - len(live)
             )
         self._series = live
+        self._sweep_conditions()
         return len(live)
+
+    def _sweep_conditions(self) -> None:
+        """Aggregate every object's status conditions into the bounded
+        (kind, type, status, reason) gauge and count transitions."""
+        from karpenter_tpu.apis import NodePool, TPUNodeClass
+
+        counts: Dict[Tuple[str, str, str, str], int] = {}
+        seen: Dict[Tuple[str, str, str], str] = {}
+        for kind in (NodeClaim, TPUNodeClass, NodePool):
+            for obj in self.cluster.list(kind):
+                for cond in obj.status_conditions.all():
+                    key = (kind.KIND, cond.type, cond.status, cond.reason or "")
+                    counts[key] = counts.get(key, 0) + 1
+                    # creation timestamp in the key: a deleted object and a
+                    # same-named successor are different objects, and the
+                    # successor's first status must not read as a transition
+                    tkey = (kind.KIND, obj.metadata.name, obj.metadata.creation_timestamp, cond.type)
+                    seen[tkey] = cond.status
+                    prev = self._cond_last.get(tkey)
+                    if prev is not None and prev != cond.status:
+                        STATUS_CONDITION_TRANSITIONS.inc(
+                            kind=kind.KIND, type=cond.type, condition_status=cond.status
+                        )
+        self._cond_last = seen
+        label_names = ("kind", "type", "condition_status", "reason")
+        for key, n in counts.items():
+            STATUS_CONDITION_COUNT.set(float(n), **dict(zip(label_names, key)))
+        # prune series whose (kind,type,status,reason) disappeared so the
+        # gauge never reports stale objects
+        for key in self._cond_series - set(counts):
+            STATUS_CONDITION_COUNT.remove(**dict(zip(label_names, key)))
+        self._cond_series = set(counts)

@@ -300,3 +300,41 @@ class TestE2EStillTagsClaims:
         claim = op.cluster.list(NodeClaim)[0]
         assert insts[0].tags["karpenter.sh/nodeclaim"] == claim.metadata.name
         assert insts[0].tags["Name"] == claim.node_name
+
+
+class TestStatusConditionMetrics:
+    """Generic status-condition metrics (reference: operatorpkg's
+    status.Controller registered per kind, pkg/controllers/controllers.go:98):
+    bounded-cardinality counts by (kind, type, status, reason) plus a
+    transition counter."""
+
+    def test_counts_and_transitions(self, clock):
+        from karpenter_tpu.apis import NodeClaim
+        from karpenter_tpu.controllers.metrics_controller import (
+            STATUS_CONDITION_COUNT,
+            STATUS_CONDITION_TRANSITIONS,
+            MetricsController,
+        )
+        from karpenter_tpu.kwok.cluster import Cluster
+
+        cluster = Cluster(clock)
+        ctrl = MetricsController(cluster)
+        claim = NodeClaim("c-1")
+        claim.status_conditions.set_false("Launched", reason="Pending")
+        cluster.create(claim)
+        ctrl.reconcile_all()
+        assert STATUS_CONDITION_COUNT.value(
+            kind="NodeClaim", type="Launched", condition_status="False", reason="Pending"
+        ) == 1.0
+        before = STATUS_CONDITION_TRANSITIONS.value(
+            kind="NodeClaim", type="Launched", condition_status="True"
+        )
+        claim.status_conditions.set_true("Launched", reason="Launched")
+        ctrl.reconcile_all()
+        assert STATUS_CONDITION_TRANSITIONS.value(
+            kind="NodeClaim", type="Launched", condition_status="True"
+        ) == before + 1
+        # the old (False, Pending) series is pruned, not left stale
+        assert STATUS_CONDITION_COUNT.value(
+            kind="NodeClaim", type="Launched", condition_status="False", reason="Pending"
+        ) in (None, 0.0)
