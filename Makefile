@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark e2e run docs-check docs verify-entry
+.PHONY: test deflake benchmark benchmark-interruption e2e run docs-check docs verify-entry
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -38,3 +38,6 @@ docs-check:  ## fail if generated docs / CRD manifests are stale
 
 verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun)
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+benchmark-interruption:  ## interruption-queue tier at 100/1k/5k(/15k) messages
+	KARPENTER_TPU_PERF=1 KARPENTER_TPU_BENCH_FULL=1 $(PYTEST) tests/test_interruption_bench.py -q -s

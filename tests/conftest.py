@@ -30,3 +30,17 @@ def pytest_collection_modifyitems(config, items):
         import random
 
         random.Random(int(seed)).shuffle(items)
+
+
+def spot_interruption_body(iid: str) -> str:
+    """Canonical EventBridge-shaped spot-interruption payload, shared by
+    the resilience, soak, and interruption-bench suites so the literal
+    tracks the parser registry in ONE place."""
+    import json
+
+    return json.dumps({
+        "version": "0", "source": "cloud.compute",
+        "detail-type": "Spot Instance Interruption Warning",
+        "id": f"evt-{iid}", "region": "us-central-1",
+        "detail": {"instance-id": iid, "instance-action": "terminate"},
+    })

@@ -42,12 +42,9 @@ def provision(env, n=1, cpu="500m"):
 
 
 def spot_msg(iid):
-    return json.dumps({
-        "version": "0", "source": SOURCE_COMPUTE,
-        "detail-type": DETAIL_SPOT_INTERRUPTION,
-        "id": "evt-1", "region": "us-central-1",
-        "detail": {"instance-id": iid, "instance-action": "terminate"},
-    })
+    from tests.conftest import spot_interruption_body
+
+    return spot_interruption_body(iid)
 
 
 def state_msg(iid, state):

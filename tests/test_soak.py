@@ -47,11 +47,9 @@ def check_invariants(op):
 
 
 def spot_msg(iid):
-    return json.dumps({
-        "version": "0", "source": "cloud.compute",
-        "detail-type": "Spot Instance Interruption Warning",
-        "detail": {"instance-id": iid, "instance-action": "terminate"},
-    })
+    from tests.conftest import spot_interruption_body
+
+    return spot_interruption_body(iid)
 
 
 @pytest.mark.parametrize("seed", [11, 23])
