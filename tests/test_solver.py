@@ -441,6 +441,18 @@ class TestDifferentialFuzz:
         ), f"seed {seed}"
         assert _signature(oracle) == _signature(device), f"seed {seed}"
 
+        # the legacy max-fit objective must ALSO stay differentially equal
+        # (the bench's fleet-price A/B solves the same workload under it)
+        sched_fit = mk()
+        sched_fit.objective = "fit"
+        oracle_fit = sched_fit.schedule(list(pods))
+        device_fit = TPUSolver(g_max=256, objective="fit").schedule(mk(), list(pods))
+        assert set(oracle_fit.unschedulable) == set(device_fit.unschedulable), f"seed {seed} (fit)"
+        assert sorted(oracle_fit.existing_assignments.items()) == sorted(
+            device_fit.existing_assignments.items()
+        ), f"seed {seed} (fit)"
+        assert _signature(oracle_fit) == _signature(device_fit), f"seed {seed} (fit)"
+
 
 class TestNativeGrouping:
     """The C hot loop (native/_grouping.c) must group EXACTLY as the pure
