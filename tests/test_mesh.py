@@ -14,11 +14,18 @@ from karpenter_tpu.solver import consolidate, encode, ffd
 from karpenter_tpu.solver.oracle import ExistingNode
 
 
-@pytest.fixture(scope="module")
-def mesh():
+@pytest.fixture(scope="module", params=["1d", "2x4"])
+def mesh(request):
+    """Both mesh layouts run every sharding test: the flat 8-device mesh
+    and the (hosts, types) multi-host layout (2 virtual hosts x 4
+    devices) -- one test body, no copy-paste divergence."""
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-device virtual mesh (tests/conftest.py)")
-    return make_mesh(8)
+    if request.param == "1d":
+        return make_mesh(8)
+    from karpenter_tpu.parallel.mesh import make_mesh_2d
+
+    return make_mesh_2d(2, 4)
 
 
 @pytest.fixture(scope="module")
@@ -162,3 +169,14 @@ class TestShardedRealisticShapes:
         placed = int(np.asarray(single.take).sum())
         unplaced = int(np.asarray(single.unplaced).sum())
         assert placed + unplaced == len(pods)
+
+
+class TestMultiHostMesh:
+    """Multi-host specifics not covered by the parametrized mesh fixture
+    (which already runs every sharding test on the 2x4 layout)."""
+
+    def test_init_distributed_noop_without_env(self, monkeypatch):
+        from karpenter_tpu.parallel.mesh import init_distributed
+
+        monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+        assert init_distributed() is False
