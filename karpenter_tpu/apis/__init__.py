@@ -12,6 +12,7 @@ from karpenter_tpu.apis.nodepool import (
 from karpenter_tpu.apis.nodeclaim import NodeClaim
 from karpenter_tpu.apis.nodeclass import TPUNodeClass, SelectorTerm, ImageSelectorTerm
 from karpenter_tpu.apis.pod import Pod, Node, TopologySpreadConstraint, PodAffinityTerm
+from karpenter_tpu.apis.pdb import PodDisruptionBudget
 
 __all__ = [
     "labels",
@@ -35,4 +36,5 @@ __all__ = [
     "Node",
     "TopologySpreadConstraint",
     "PodAffinityTerm",
+    "PodDisruptionBudget",
 ]

@@ -92,6 +92,7 @@ class DisruptionController:
         # called directly, e.g. from tests, fetch fresh)
         self._pass_pools: Optional[List[NodePool]] = None
         self._pass_catalogs: Optional[Dict[str, list]] = None
+        self._pass_pdb_guard = None
 
     # -- helpers ------------------------------------------------------------
     def _price_of(self, claim: NodeClaim) -> float:
@@ -153,7 +154,29 @@ class DisruptionController:
         return True
 
     def _all_pods_evictable(self, pods: Sequence[Pod]) -> bool:
-        return all(p.reschedulable() for p in pods)
+        """Every pod is controller-replaced, consented (no do-not-disrupt),
+        AND currently evictable under its PodDisruptionBudgets -- a node
+        whose drain would immediately stall on an exhausted budget is not
+        a voluntary-disruption candidate this pass (the budget freeing up
+        later makes it one again). ONE guard serves the whole pass
+        (_pass_pdb_guard): disrupting a claim does not unbind its pods, so
+        per-call guards would let several nodes sharing one allowance all
+        pass candidacy and then jointly stall the drain; the shared guard
+        consumes allowance across candidates exactly as the drains will.
+        Scan cost amortizes the same way (one PDB/pod sweep per pass)."""
+        if not all(p.reschedulable() for p in pods):
+            return False
+        from karpenter_tpu.controllers.pdb_guard import PDBGuard
+
+        if self._pass_pools is not None:
+            # inside a pass: one shared guard
+            guard = self._pass_pdb_guard
+            if guard is None:
+                guard = self._pass_pdb_guard = PDBGuard(self.cluster)
+        else:
+            # helper called directly (tests): fresh snapshot
+            guard = PDBGuard(self.cluster)
+        return all(guard.try_evict(p) for p in pods)
 
     # -- simulation ---------------------------------------------------------
     def _other_nodes(self, excluded: Sequence[str]) -> List[ExistingNode]:
@@ -237,6 +260,7 @@ class DisruptionController:
             return self._reconcile(max_disruptions)
         finally:
             self._pass_pools, self._pass_catalogs = None, None
+            self._pass_pdb_guard = None
             metrics.DISRUPTION_EVAL_DURATION.observe(_time.perf_counter() - t0)
 
     def _pool_context(self) -> Tuple[List[NodePool], Dict[str, list]]:
@@ -258,6 +282,7 @@ class DisruptionController:
         self.last_decisions = []
         self._pass_disrupted = []
         self._pass_pools, self._pass_catalogs = None, None
+        self._pass_pdb_guard = None
         self._pass_pools, self._pass_catalogs = self._pool_context()
         disrupting: Dict[str, int] = {}
         totals: Dict[str, int] = {}

@@ -1,0 +1,50 @@
+"""Eviction gating against PodDisruptionBudgets.
+
+Used at the two voluntary-disruption seams the reference routes through
+the eviction API: node drain (controllers/termination.py) and disruption
+candidacy (controllers/disruption.py). One guard instance snapshots PDB
+state for one sweep and DECREMENTS its remaining allowance as evictions
+are granted, so a single drain pass cannot evict five pods because each
+looked individually admissible against the same snapshot.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from karpenter_tpu.apis.pdb import PodDisruptionBudget
+from karpenter_tpu.logging import get_logger
+
+
+class PDBGuard:
+    log = get_logger("pdb")
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self._pdbs: List[PodDisruptionBudget] = cluster.list(PodDisruptionBudget)
+        self._remaining: Dict[str, int] = {}
+        if self._pdbs:
+            from karpenter_tpu.apis import Pod
+
+            pods = cluster.list(Pod)
+            for pdb in self._pdbs:
+                matching = [p for p in pods if pdb.matches(p)]
+                healthy = [p for p in matching if p.node_name and not p.deleting]
+                self._remaining[pdb.metadata.name] = pdb.allowed_disruptions(
+                    len(matching), len(healthy)
+                )
+
+    def try_evict(self, pod) -> bool:
+        """Consume allowance from every matching PDB; False (and no
+        consumption) when any budget is exhausted -- the eviction API's
+        429 path."""
+        matching = [p for p in self._pdbs if p.matches(pod)]
+        exhausted = [p.metadata.name for p in matching if self._remaining[p.metadata.name] < 1]
+        if exhausted:
+            self.log.debug(
+                "eviction deferred by disruption budget",
+                pod=pod.metadata.name, budgets=exhausted,
+            )
+            return False
+        for p in matching:
+            self._remaining[p.metadata.name] -= 1
+        return True

@@ -51,12 +51,32 @@ class TerminationController:
             pods = self.cluster.pods_on_node(node.metadata.name)
             evictable = [p for p in pods if p.reschedulable()]
             blocked = [p for p in pods if not p.reschedulable()]
+            grace = claim.termination_grace_period
+            grace_expired = grace is not None and now - started >= grace
+            # evictions go through the PDB guard (the eviction API's
+            # disruptionsAllowed); budget-exhausted pods stay bound and the
+            # drain retries next tick as budgets free up -- until the
+            # claim's termination grace expires, after which pods are
+            # drained regardless (the reference's terminationGracePeriod
+            # force-drain semantics)
+            from karpenter_tpu.controllers.pdb_guard import PDBGuard
+
+            guard = PDBGuard(self.cluster)
+            pdb_deferred = 0
             for p in evictable:
+                if not grace_expired and not guard.try_evict(p):
+                    pdb_deferred += 1
+                    continue
                 p.node_name = ""
                 p.phase = "Pending"
                 self.cluster.update(p)
-            grace = claim.termination_grace_period
-            if blocked and (grace is None or now - started < grace):
+            if pdb_deferred:
+                self.log.info(
+                    "drain waiting on pod disruption budgets",
+                    nodeclaim=claim.metadata.name, deferred=pdb_deferred,
+                )
+                return
+            if blocked and not grace_expired:
                 return  # wait for do-not-disrupt pods until grace expires
             # grace expired: non-reschedulable pods (static pods, bare pods)
             # die with the node rather than being requeued -- requeueing

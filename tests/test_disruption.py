@@ -521,3 +521,149 @@ class TestCloudStateDrift:
     def test_no_drift_when_status_matches(self, env):
         claim = self._provisioned(env)
         assert env.cloud_provider.is_drifted(claim) is None
+
+
+class TestPodDisruptionBudgets:
+    """Voluntary disruption respects PDBs (reference: drain goes through
+    the eviction API; designs/deprovisioning.md lists the pod's disruption
+    budget among the constraints)."""
+
+    def _web_pods(self, env, n, node_names=None):
+        from karpenter_tpu.apis import PodDisruptionBudget
+
+        pods = [
+            Pod(f"web-{i}", requests=Resources({"cpu": "200m"}), labels={"app": "web"})
+            for i in range(n)
+        ]
+        run_pods(env, pods)
+        return pods
+
+    def _expiring(self, env):
+        """A scenario that reliably produces a disruption decision absent
+        PDBs: the pool expires its nodes."""
+        pool = env.cluster.get(NodePool, "default")
+        pool.template.expire_after = 3600.0
+        env.cluster.update(pool)
+        self._web_pods(env, 2)
+        env.clock.step(3601)
+
+    def test_pdb_gates_consolidation_eligibility(self, env):
+        """Consolidation/drift candidacy (_all_pods_evictable) requires the
+        whole node's pod set to be jointly evictable under current PDB
+        allowances; expiration still nominates (graceful semantics -- the
+        DRAIN is what waits, covered below)."""
+        from karpenter_tpu.apis import PodDisruptionBudget
+
+        pods = self._web_pods(env, 2)
+        bound = [p for p in pods if p.node_name]
+        assert bound
+        env.cluster.create(
+            PodDisruptionBudget("web-pdb", selector={"app": "web"}, min_available="100%")
+        )
+        assert not env.disruption._all_pods_evictable(bound)
+        pdb = env.cluster.get(PodDisruptionBudget, "web-pdb")
+        pdb.min_available = None
+        pdb.max_unavailable = len(bound)
+        env.cluster.update(pdb)
+        assert env.disruption._all_pods_evictable(bound)
+
+    def test_expiration_nominates_but_drain_waits(self, env):
+        """Graceful expiry proceeds to a decision even with a zero-allowance
+        PDB; the eviction-time guard in termination is what holds the
+        pods (reference: expired nodes are tainted and drained through the
+        eviction API, which enforces the budget)."""
+        from karpenter_tpu.apis import NodeClaim, PodDisruptionBudget
+
+        self._expiring(env)
+        env.cluster.create(
+            PodDisruptionBudget("web-pdb", selector={"app": "web"}, min_available="100%")
+        )
+        decisions = env.disruption.reconcile()
+        assert decisions and decisions[0][1] == REASON_EXPIRED
+        env.termination.reconcile_all()
+        # the claim is draining but the budget holds every pod in place
+        deleting = [c for c in env.cluster.list(NodeClaim) if c.deleting]
+        assert deleting, "expired claim should be draining"
+        held = [p for p in env.cluster.list(Pod) if p.metadata.labels.get("app") == "web" and p.node_name]
+        assert held, "PDB must hold pods on the draining node"
+
+    def test_drain_defers_until_budget_frees(self, env):
+        from karpenter_tpu.apis import NodeClaim, PodDisruptionBudget
+
+        pods = self._web_pods(env, 4)
+        env.cluster.create(
+            PodDisruptionBudget("web-pdb", selector={"app": "web"}, max_unavailable=1)
+        )
+        claims = [c for c in env.cluster.list(NodeClaim) if not c.deleting]
+        assert claims
+        claim = claims[0]
+        node = env.cluster.node_for_nodeclaim(claim)
+        on_node = [p for p in pods if p.node_name == node.metadata.name]
+        assert len(on_node) >= 2, "need multiple budgeted pods on one node"
+        env.cluster.delete(NodeClaim, claim.metadata.name)
+        env.termination.reconcile(claim)
+        # one eviction consumed the whole budget; the drain must defer
+        still_bound = [p for p in on_node if p.node_name]
+        assert still_bound, "drain must defer beyond the budget"
+        assert env.cluster.try_get(NodeClaim, claim.metadata.name) is not None
+        # evicted pods reschedule (new capacity) -> healthy again -> the
+        # budget frees and the drain completes over subsequent ticks
+        for _ in range(12):
+            env.tick()
+            env.termination.reconcile_all()
+            env.clock.step(3.0)
+            if env.cluster.try_get(NodeClaim, claim.metadata.name) is None:
+                break
+        assert env.cluster.try_get(NodeClaim, claim.metadata.name) is None, "drain must finish"
+
+    def test_grace_expiry_overrides_pdb(self, env):
+        from karpenter_tpu.apis import NodeClaim, PodDisruptionBudget
+
+        pods = self._web_pods(env, 2)
+        env.cluster.create(
+            PodDisruptionBudget("web-pdb", selector={"app": "web"}, min_available="100%")
+        )
+        claims = [c for c in env.cluster.list(NodeClaim) if not c.deleting]
+        claim = claims[0]
+        claim.termination_grace_period = 30.0
+        env.cluster.delete(NodeClaim, claim.metadata.name)
+        env.termination.reconcile(claim)
+        assert env.cluster.try_get(NodeClaim, claim.metadata.name) is not None
+        env.clock.step(31.0)
+        env.termination.reconcile(claim)
+        assert env.cluster.try_get(NodeClaim, claim.metadata.name) is None, (
+            "termination grace expiry must force the drain through the PDB"
+        )
+
+    def test_shared_allowance_admits_one_candidate_per_pass(self, env):
+        """One maxUnavailable=1 PDB spanning pods on TWO nodes: a single
+        disruption pass may take at most ONE of them (per-pass guard
+        accounting; per-call guards would cordon both and stall a drain)."""
+        from karpenter_tpu.apis import PodDisruptionBudget
+
+        pods = [
+            Pod(f"big-{i}", requests=Resources({"cpu": "1500m", "memory": "2Gi"}),
+                labels={"app": "web"})
+            for i in range(2)
+        ]
+        run_pods(env, [pods[0]])
+        env.cluster.create(pods[1])
+        env.settle(max_ticks=30)
+        claims = [c for c in env.cluster.list(NodeClaim) if not c.deleting]
+        if len(claims) < 2:
+            pytest.skip("pods packed onto one node")
+        env.cluster.create(
+            PodDisruptionBudget("web-pdb", selector={"app": "web"}, max_unavailable=1)
+        )
+        pool = env.cluster.get(NodePool, "default")
+        pool.template.expire_after = None
+        env.cluster.update(pool)
+        # drive drift on BOTH claims: both would be disrupted without the PDB
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.user_data = "#!/bin/bash\necho v2"
+        env.cluster.update(nc)
+        env.nodeclass_controller.reconcile_all()
+        age_all_claims(env)
+        decisions = env.disruption.reconcile(max_disruptions=5)
+        drifted = [d for d in decisions if d[1] == "Drifted"]
+        assert len(drifted) <= 1, f"shared budget of 1 admitted {len(drifted)} disruptions"
