@@ -45,7 +45,6 @@ from __future__ import annotations
 
 import json
 import os
-import subprocess
 import sys
 import time
 import traceback
@@ -60,40 +59,14 @@ WARMUP = 5
 G_MAX = 1024        # price objective opens ~1.6x max-fit's group count
 TARGET_MS = 100.0
 
-_PROBE_CODE = (
-    "import jax, sys\n"
-    "d = jax.devices()\n"
-    "import jax.numpy as jnp\n"
-    "x = jnp.arange(8.0)\n"
-    "assert float((x * 2).sum()) == 56.0\n"
-    "print('BACKEND=' + jax.default_backend())\n"
-)
+def probe_backend(**kw):
+    """Subprocess backend probe (shared with the operator entry point --
+    karpenter_tpu.utils.probe_jax_backend, whose defaults this forwards):
+    a hung device tunnel must not hang the benchmark; round 1 lost its
+    number to exactly that."""
+    from karpenter_tpu.utils import probe_jax_backend
 
-
-def probe_backend(timeout_s: int = 120, attempts: int = 2):
-    """Initialize the environment's default JAX backend in a SUBPROCESS so a
-    hung device tunnel cannot hang the benchmark. Returns (backend, error):
-    backend is the platform name on success, None on failure."""
-    err = None
-    for i in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", _PROBE_CODE],
-                timeout=timeout_s,
-                capture_output=True,
-                text=True,
-            )
-            for line in r.stdout.splitlines():
-                if line.startswith("BACKEND="):
-                    return line.split("=", 1)[1], None
-            err = (r.stderr or r.stdout)[-500:]
-        except subprocess.TimeoutExpired:
-            err = f"backend probe timed out after {timeout_s}s (attempt {i + 1})"
-        except Exception as e:  # noqa: BLE001 - diagnostic path must not raise
-            err = repr(e)
-        if i < attempts - 1:
-            time.sleep(3 * (i + 1))
-    return None, err
+    return probe_jax_backend(**kw)
 
 
 def build_catalog_items():

@@ -44,9 +44,27 @@ def build_operator(args):
     solver = None
     evaluator = None
     if args.tpu_solver:
+        from karpenter_tpu.logging import get_logger
+        from karpenter_tpu.utils import enable_jax_compilation_cache, probe_jax_backend
+
+        # probe the accelerator in a subprocess FIRST: a hung device tunnel
+        # would otherwise block operator startup forever at jax backend
+        # init; on failure the solver runs on the host CPU backend (same
+        # code path, degraded speed) instead of taking the controller down
+        # operator startup patience: one 60s attempt (the bench keeps its
+        # longer 2x120s patience -- it must salvage a flaky tunnel; the
+        # controller must come up and serve)
+        backend, err = probe_jax_backend(timeout_s=60, attempts=1)
+        if backend is None:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            get_logger("operator").warning(
+                "accelerator probe failed; solver degrades to host cpu backend",
+                error=(err or "")[:200],
+            )
         from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
         from karpenter_tpu.solver.service import TPUSolver
-        from karpenter_tpu.utils import enable_jax_compilation_cache
 
         enable_jax_compilation_cache()
         solver = TPUSolver(auto_warm=True)

@@ -72,6 +72,48 @@ def gc_paused():
                 gc.enable()
 
 
+_PROBE_CODE = (
+    "import jax, sys\n"
+    "d = jax.devices()\n"
+    "import jax.numpy as jnp\n"
+    "x = jnp.arange(8.0)\n"
+    "assert float((x * 2).sum()) == 56.0\n"
+    "print('BACKEND=' + jax.default_backend())\n"
+)
+
+
+def probe_jax_backend(timeout_s: int = 120, attempts: int = 2):
+    """Initialize the environment's default JAX backend in a SUBPROCESS so
+    a hung accelerator tunnel cannot hang the caller (the chip may sit
+    behind a network tunnel that blocks indefinitely at backend init).
+    Returns (backend_name, error): backend_name is None on failure.
+    Callers degrade to the CPU platform via
+    jax.config.update("jax_platforms", "cpu") -- the env var alone is not
+    enough when a sitecustomize hook pins a plugin platform."""
+    import subprocess
+    import sys
+    import time
+
+    err = None
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                timeout=timeout_s, capture_output=True, text=True,
+            )
+            for line in r.stdout.splitlines():
+                if line.startswith("BACKEND="):
+                    return line.split("=", 1)[1], None
+            err = (r.stderr or r.stdout)[-500:]
+        except subprocess.TimeoutExpired:
+            err = f"backend probe timed out after {timeout_s}s (attempt {i + 1})"
+        except Exception as e:  # noqa: BLE001 - diagnostic path must not raise
+            err = repr(e)
+        if i < attempts - 1:
+            time.sleep(3 * (i + 1))
+    return None, err
+
+
 def configure_gc_for_latency() -> None:
     """Tune the cyclic collector for a latency-critical tick loop.
 
