@@ -47,6 +47,11 @@ RESTRICTED_TAG_PATTERNS = (
 _ALIAS_RE = re.compile(r"^[a-zA-Z0-9]+@.+$")
 
 
+# karpenter.sh nodepool budgets.nodes CEL shape (0-100% cap is the
+# reference's rule; PDB percents are NOT capped -- see _PDB_VALUE_RE)
+_BUDGET_NODES_RE = re.compile(r"(100|[0-9]{1,2})%|[0-9]+")
+
+
 @dataclass
 class Violation:
     path: str
@@ -300,7 +305,7 @@ def validate_nodepool(pool) -> List[Violation]:
         nodes = getattr(b, "nodes", None)
         if isinstance(nodes, str):
             # ref CEL: budgets.nodes matches "^((100|[0-9]{1,2})%|[0-9]+)$"
-            if not re.match(r"^((100|[0-9]{1,2})%|[0-9]+)$", nodes):
+            if not _BUDGET_NODES_RE.fullmatch(nodes):
                 out.append(
                     Violation(
                         f"spec.disruption.budgets[{i}].nodes",
@@ -339,16 +344,51 @@ def validate_nodeclaim(claim) -> List[Violation]:
     return out
 
 
+# policy/v1 percent semantics: any non-negative integer percent is legal
+# (e.g. minAvailable "150%" is a valid never-disrupt idiom on a real
+# apiserver); fullmatch so a trailing newline cannot slip past admission
+# and crash _resolve later
+_PDB_VALUE_RE = re.compile(r"[0-9]+%|[0-9]+")
+
+
+def validate_pdb(pdb) -> List[Violation]:
+    """PodDisruptionBudget admission invariants (policy/v1 semantics:
+    minAvailable xor maxUnavailable, each an integer or percent)."""
+    out: List[Violation] = []
+    if pdb.min_available is not None and pdb.max_unavailable is not None:
+        out.append(Violation("spec", "minAvailable and maxUnavailable are mutually exclusive"))
+    for field_name, value in (
+        ("minAvailable", pdb.min_available),
+        ("maxUnavailable", pdb.max_unavailable),
+    ):
+        if value is None:
+            continue
+        if isinstance(value, str):
+            if not _PDB_VALUE_RE.fullmatch(value):
+                out.append(
+                    Violation(
+                        f"spec.{field_name}",
+                        "must be a non-negative integer or integer percent",
+                    )
+                )
+        elif isinstance(value, bool) or not isinstance(value, int):
+            out.append(Violation(f"spec.{field_name}", "must be an integer or percent string"))
+        elif value < 0:
+            out.append(Violation(f"spec.{field_name}", "may not be negative"))
+    return out
+
+
 VALIDATORS: dict = {}
 
 
 def _register() -> None:
-    from karpenter_tpu.apis import NodeClaim, NodePool
+    from karpenter_tpu.apis import NodeClaim, NodePool, PodDisruptionBudget
     from karpenter_tpu.apis.nodeclass import TPUNodeClass
 
     VALIDATORS[TPUNodeClass.KIND] = validate_nodeclass
     VALIDATORS[NodePool.KIND] = validate_nodepool
     VALIDATORS[NodeClaim.KIND] = validate_nodeclaim
+    VALIDATORS[PodDisruptionBudget.KIND] = validate_pdb
 
 
 def admit(obj) -> None:

@@ -261,3 +261,43 @@ class TestEvictionValueForms:
                / "karpenter_tpu" / "apis" / "crds" / "karpenter.tpu_tpunodeclasses.yaml").read_text()
         assert "percentage between 0% and 100%" in crd
         assert "positive Go durations" in crd
+
+
+class TestPDBValidation:
+    """PodDisruptionBudget admission (policy/v1 semantics), enforced at
+    the store boundary like every other kind."""
+
+    def test_valid_forms(self):
+        from karpenter_tpu.apis import PodDisruptionBudget
+        from karpenter_tpu.apis.validation import validate_pdb
+
+        assert not validate_pdb(PodDisruptionBudget("a", min_available=1))
+        assert not validate_pdb(PodDisruptionBudget("b", max_unavailable="25%"))
+        assert not validate_pdb(PodDisruptionBudget("c", selector={"app": "x"}))
+
+    def test_bad_percent_rejected_at_admission(self):
+        from karpenter_tpu.apis import PodDisruptionBudget
+        from karpenter_tpu.apis.validation import AdmissionError
+        from karpenter_tpu.kwok.cluster import Cluster
+
+        import pytest as _pytest
+
+        with _pytest.raises(AdmissionError):
+            Cluster().create(PodDisruptionBudget("bad", min_available="50%\n"))
+        with _pytest.raises(AdmissionError):
+            Cluster().create(PodDisruptionBudget("bad2", max_unavailable=-1))
+        # policy/v1 allows >100% (a never-disrupt idiom); must admit
+        Cluster().create(PodDisruptionBudget("over", min_available="150%"))
+
+    def test_mutual_exclusion_is_constructor_and_admission(self):
+        from karpenter_tpu.apis import PodDisruptionBudget
+        from karpenter_tpu.apis.validation import validate_pdb
+
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            PodDisruptionBudget("both", min_available=1, max_unavailable=1)
+        # an object mutated into the bad state is still caught at admission
+        pdb = PodDisruptionBudget("late", min_available=1)
+        pdb.max_unavailable = 1
+        assert any("mutually exclusive" in str(v) for v in validate_pdb(pdb))
