@@ -386,7 +386,11 @@ def main() -> None:
     if force_cpu:
         backend, probe_err = None, "forced by --cpu"
     else:
-        backend, probe_err = probe_backend()
+        # patient: the driver runs this once per round, and the tunnel has
+        # been observed to drop for stretches -- four attempts (~9 min
+        # worst case) maximize the odds of recording a real device number
+        # before degrading to the host CPU
+        backend, probe_err = probe_backend(timeout_s=120, attempts=4)
     if backend is None:
         degraded = not force_cpu
         if probe_err and not force_cpu:

@@ -344,11 +344,12 @@ def validate_nodeclaim(claim) -> List[Violation]:
     return out
 
 
-# policy/v1 percent semantics: any non-negative integer percent is legal
-# (e.g. minAvailable "150%" is a valid never-disrupt idiom on a real
-# apiserver); fullmatch so a trailing newline cannot slip past admission
-# and crash _resolve later
-_PDB_VALUE_RE = re.compile(r"[0-9]+%|[0-9]+")
+# policy/v1 percent semantics: a STRING value must be an integer percent
+# (the apiserver's IsValidPercent -- bare numeric strings are rejected;
+# integers arrive as ints), with no 100% cap (minAvailable "150%" is a
+# valid never-disrupt idiom); fullmatch so a trailing newline cannot slip
+# past admission and crash _resolve later
+_PDB_VALUE_RE = re.compile(r"[0-9]+%")
 
 
 def validate_pdb(pdb) -> List[Violation]:
@@ -368,7 +369,7 @@ def validate_pdb(pdb) -> List[Violation]:
                 out.append(
                     Violation(
                         f"spec.{field_name}",
-                        "must be a non-negative integer or integer percent",
+                        "string values must be an integer percent (e.g. \"50%\")",
                     )
                 )
         elif isinstance(value, bool) or not isinstance(value, int):

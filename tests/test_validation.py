@@ -301,3 +301,12 @@ class TestPDBValidation:
         pdb = PodDisruptionBudget("late", min_available=1)
         pdb.max_unavailable = 1
         assert any("mutually exclusive" in str(v) for v in validate_pdb(pdb))
+
+    def test_bare_numeric_string_rejected(self):
+        """policy/v1 IsValidPercent: string values need the % suffix; bare
+        integers are only valid as ints."""
+        from karpenter_tpu.apis import PodDisruptionBudget
+        from karpenter_tpu.apis.validation import validate_pdb
+
+        assert any("percent" in str(v) for v in validate_pdb(PodDisruptionBudget("s", min_available="5")))
+        assert not validate_pdb(PodDisruptionBudget("i", min_available=5))
