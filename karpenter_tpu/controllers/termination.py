@@ -22,6 +22,11 @@ from karpenter_tpu.logging import get_logger
 
 TERMINATION_FINALIZER = "karpenter.sh/termination"
 DISRUPTED_TAINT = Taint("karpenter.sh/disrupted", effect="NoSchedule")
+# system-cluster-critical / system-node-critical priority band: these pods
+# drain LAST so the services they provide (DNS, CNI agents) outlive the
+# workloads that depend on them during the drain (the reference's
+# terminator drains in priority waves)
+SYSTEM_CRITICAL_PRIORITY = 2_000_000_000
 
 
 class TerminationController:
@@ -61,9 +66,23 @@ class TerminationController:
             # force-drain semantics)
             from karpenter_tpu.controllers.pdb_guard import PDBGuard
 
+            # priority waves: non-critical pods drain first; cluster-
+            # critical pods (DNS, node agents) go only once no lower-
+            # priority pod remains bound, one wave per reconcile
+            noncritical = [p for p in evictable if p.priority < SYSTEM_CRITICAL_PRIORITY]
+            critical = [p for p in evictable if p.priority >= SYSTEM_CRITICAL_PRIORITY]
+            # the critical wave waits for EVERY lower-priority pod to leave
+            # the node -- including blocked (do-not-disrupt/static) ones
+            # that only clear at grace expiry; evicting DNS while a blocked
+            # workload keeps running would be exactly the outage the waves
+            # exist to prevent
+            lower_blocked = [p for p in blocked if p.priority < SYSTEM_CRITICAL_PRIORITY]
+            wave = noncritical or (
+                critical if (grace_expired or not lower_blocked) else []
+            )
             guard = PDBGuard(self.cluster)
             pdb_deferred = 0
-            for p in evictable:
+            for p in wave:
                 if not grace_expired and not guard.try_evict(p):
                     pdb_deferred += 1
                     continue
@@ -76,6 +95,8 @@ class TerminationController:
                     nodeclaim=claim.metadata.name, deferred=pdb_deferred,
                 )
                 return
+            if noncritical and critical:
+                return  # critical pods drain on the next pass
             if blocked and not grace_expired:
                 return  # wait for do-not-disrupt pods until grace expires
             # grace expired: non-reschedulable pods (static pods, bare pods)

@@ -367,3 +367,82 @@ class TestEvictionThresholds:
         ))
         v2 = validate_nodeclass(nc2)
         assert any("between 0% and 100%" in str(x) for x in v2), [str(x) for x in v2]
+
+
+class TestCapacityModel:
+    """The resolver's node capacity arithmetic (reference
+    types.go:313-522): kube-reserved curves, NIC-limited pod density, VM
+    memory overhead, and kubelet-config overrides."""
+
+    def test_kube_reserved_cpu_tiers(self):
+        from karpenter_tpu.providers.instancetype.types import kube_reserved_cpu_milli
+
+        # 6% of core 1, 1% of core 2, 0.5% of cores 3-4, 0.25% beyond
+        assert kube_reserved_cpu_milli(1) == 60.0
+        assert kube_reserved_cpu_milli(2) == 70.0
+        assert kube_reserved_cpu_milli(4) == 80.0
+        assert kube_reserved_cpu_milli(16) == 80.0 + 12 * 1000 * 0.0025
+        # monotone non-decreasing in vcpu
+        vals = [kube_reserved_cpu_milli(v) for v in range(1, 65)]
+        assert vals == sorted(vals)
+
+    def test_kube_reserved_memory_per_pod_slot(self):
+        from karpenter_tpu.providers.instancetype.types import (
+            MIB,
+            kube_reserved_memory_bytes,
+        )
+
+        assert kube_reserved_memory_bytes(0) == 255 * MIB
+        assert kube_reserved_memory_bytes(110) == (255 + 11 * 110) * MIB
+
+    def test_nic_limited_pod_density(self, provider, nodeclass):
+        from karpenter_tpu.providers.instancetype.types import pods_limit
+
+        items = {it.name: it for it in provider.list(nodeclass)}
+        it = items["m5.large"]
+        info = it.info
+        expected = info.max_network_interfaces * (info.ipv4_per_interface - 1) + 2
+        assert pods_limit(info, nodeclass) == expected
+        # reserved NICs shrink the density (operator flag --reserved-nics)
+        assert pods_limit(info, nodeclass, reserved_nics=1) == expected - (info.ipv4_per_interface - 1)
+
+    def test_kubelet_overrides_win(self, provider, nodeclass):
+        from karpenter_tpu.providers.instancetype.types import pods_limit
+
+        items = {it.name: it for it in provider.list(nodeclass)}
+        info = items["m5.large"].info
+        nodeclass.kubelet.max_pods = 42
+        try:
+            assert pods_limit(info, nodeclass) == 42
+            nodeclass.kubelet.max_pods = None
+            nodeclass.kubelet.pods_per_core = 4
+            assert pods_limit(info, nodeclass) == min(info.eni_pod_limit(), 4 * info.vcpu)
+        finally:
+            nodeclass.kubelet.max_pods = None
+            nodeclass.kubelet.pods_per_core = None
+
+    def test_vm_memory_overhead_shrinks_capacity(self, cloud):
+        from karpenter_tpu.apis import TPUNodeClass
+        from karpenter_tpu.providers.instancetype import gen_catalog
+        from karpenter_tpu.providers.instancetype.types import MIB, Resolver
+
+        info = cloud.describe_instance_types()[0]
+        nc = TPUNodeClass("x")
+        lean = Resolver(gen_catalog.REGION, vm_memory_overhead_percent=0.0)
+        fat = Resolver(gen_catalog.REGION, vm_memory_overhead_percent=0.075)
+        from karpenter_tpu.scheduling import resources as res
+
+        m_lean = lean.compute_capacity(info, nc).get(res.MEMORY)
+        m_fat = fat.compute_capacity(info, nc).get(res.MEMORY)
+        assert m_lean == info.memory_mib * MIB
+        assert abs(m_fat - m_lean * 0.925) < 1.0
+
+    def test_allocatable_is_capacity_minus_overhead(self, provider, nodeclass):
+        from karpenter_tpu.scheduling import resources as res
+
+        items = {it.name: it for it in provider.list(nodeclass)}
+        it = items["m5.large"]
+        alloc = it.allocatable()
+        for axis in (res.CPU, res.MEMORY):
+            assert alloc.get(axis) < it.capacity.get(axis)
+            assert alloc.get(axis) > 0

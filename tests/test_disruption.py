@@ -667,3 +667,51 @@ class TestPodDisruptionBudgets:
         decisions = env.disruption.reconcile(max_disruptions=5)
         drifted = [d for d in decisions if d[1] == "Drifted"]
         assert len(drifted) <= 1, f"shared budget of 1 admitted {len(drifted)} disruptions"
+
+
+class TestPriorityDrainWaves:
+    """Drain evicts in priority waves: cluster-critical pods (DNS, node
+    agents) leave only after every lower-priority pod is off the node
+    (reference terminator semantics)."""
+
+    def test_critical_pod_drains_last(self, env):
+        from karpenter_tpu.controllers.termination import SYSTEM_CRITICAL_PRIORITY
+
+        web = Pod("web", requests=Resources({"cpu": "200m"}))
+        dns = Pod("dns", requests=Resources({"cpu": "100m"}),
+                  priority=SYSTEM_CRITICAL_PRIORITY)
+        run_pods(env, [web, dns])
+        if web.node_name != dns.node_name:
+            pytest.skip("pods landed on different nodes")
+        claim = env.cluster.list(NodeClaim)[0]
+        env.cluster.delete(NodeClaim, claim.metadata.name)
+        env.termination.reconcile(claim)
+        # wave 1: web evicted, dns still bound
+        assert not web.node_name
+        assert dns.node_name, "critical pod must outlive the first wave"
+        env.termination.reconcile(claim)
+        # wave 2: dns evicted, node proceeds to termination
+        assert not dns.node_name
+
+    def test_blocked_workload_holds_critical_wave(self, env):
+        """A low-priority do-not-disrupt pod keeps the critical pod bound
+        until grace expiry: DNS must not leave while a blocked workload
+        still runs."""
+        from karpenter_tpu.controllers.termination import SYSTEM_CRITICAL_PRIORITY
+
+        stuck = Pod("stuck", requests=Resources({"cpu": "200m"}),
+                    annotations={"karpenter.sh/do-not-disrupt": "true"})
+        dns = Pod("dns2", requests=Resources({"cpu": "100m"}),
+                  priority=SYSTEM_CRITICAL_PRIORITY)
+        run_pods(env, [stuck, dns])
+        if stuck.node_name != dns.node_name:
+            pytest.skip("pods landed on different nodes")
+        claim = env.cluster.list(NodeClaim)[0]
+        claim.termination_grace_period = 60.0
+        env.cluster.delete(NodeClaim, claim.metadata.name)
+        env.termination.reconcile(claim)
+        assert dns.node_name, "critical pod must wait for the blocked workload"
+        env.clock.step(61.0)
+        env.termination.reconcile(claim)
+        # grace expired: everything drains and the node terminates
+        assert env.cluster.try_get(NodeClaim, claim.metadata.name) is None
