@@ -13,6 +13,7 @@ from karpenter_tpu.apis.nodeclaim import NodeClaim
 from karpenter_tpu.apis.nodeclass import TPUNodeClass, SelectorTerm, ImageSelectorTerm
 from karpenter_tpu.apis.pod import Pod, Node, TopologySpreadConstraint, PodAffinityTerm
 from karpenter_tpu.apis.pdb import PodDisruptionBudget
+from karpenter_tpu.apis.daemonset import DaemonSet
 
 __all__ = [
     "labels",
@@ -37,4 +38,5 @@ __all__ = [
     "TopologySpreadConstraint",
     "PodAffinityTerm",
     "PodDisruptionBudget",
+    "DaemonSet",
 ]

@@ -93,6 +93,7 @@ class DisruptionController:
         self._pass_pools: Optional[List[NodePool]] = None
         self._pass_catalogs: Optional[Dict[str, list]] = None
         self._pass_pdb_guard = None
+        self._pass_daemon_overhead: Optional[Dict[str, Resources]] = None
 
     # -- helpers ------------------------------------------------------------
     def _price_of(self, claim: NodeClaim) -> float:
@@ -238,6 +239,7 @@ class DisruptionController:
             pods_by_node={k: v for k, v in self._pods_by_node().items() if k not in excluded},
             nodepool_usage={p.name: self.cluster.nodepool_usage(p.name) for p in nodepools},
             zones=zones,
+            daemon_overhead=self._daemon_overhead(nodepools),
         )
         result = sched.schedule(pods)
         if result.unschedulable:
@@ -261,7 +263,23 @@ class DisruptionController:
         finally:
             self._pass_pools, self._pass_catalogs = None, None
             self._pass_pdb_guard = None
+            self._pass_daemon_overhead = None
             metrics.DISRUPTION_EVAL_DURATION.observe(_time.perf_counter() - t0)
+
+    def _daemon_overhead(self, pools) -> Dict[str, "Resources"]:
+        """Per-pool fresh-node daemonset reserve, SNAPSHOT per pass like
+        _pool_context: every candidate in one pass must be judged against
+        the same node sizing (a mid-pass DaemonSet change applies next
+        pass)."""
+        if self._pass_daemon_overhead is not None:
+            return self._pass_daemon_overhead
+        from karpenter_tpu.apis import DaemonSet
+        from karpenter_tpu.apis.daemonset import overhead_by_pool
+
+        out = overhead_by_pool(self.cluster.list(DaemonSet), pools)
+        if self._pass_pools is not None:
+            self._pass_daemon_overhead = out
+        return out
 
     def _pool_context(self) -> Tuple[List[NodePool], Dict[str, list]]:
         """(live pools, their catalogs). Inside a pass this is the snapshot
@@ -283,6 +301,7 @@ class DisruptionController:
         self._pass_disrupted = []
         self._pass_pools, self._pass_catalogs = None, None
         self._pass_pdb_guard = None
+        self._pass_daemon_overhead = None
         self._pass_pools, self._pass_catalogs = self._pool_context()
         disrupting: Dict[str, int] = {}
         totals: Dict[str, int] = {}
@@ -480,6 +499,7 @@ class DisruptionController:
         verdicts = self.evaluator.evaluate(
             self._other_nodes(list(self._pass_disrupted)), sets,
             pools=pools, catalogs=catalogs,
+            daemon_overhead=self._daemon_overhead(pools),
         )
         return dict(zip(ks, verdicts))
 
@@ -547,6 +567,7 @@ class DisruptionController:
         verdicts = self.evaluator.evaluate(
             self._other_nodes(list(self._pass_disrupted)), sets,
             pools=pools, catalogs=catalogs,
+            daemon_overhead=self._daemon_overhead(pools),
         )
         return {c.claim.metadata.name: v for c, v in zip(eligible, verdicts)}
 

@@ -109,6 +109,9 @@ class Provisioner:
             for it in items:
                 for o in it.available_offerings():
                     zones.add(o.zone)
+        from karpenter_tpu.apis import DaemonSet
+        from karpenter_tpu.apis.daemonset import overhead_by_pool
+
         scheduler = Scheduler(
             nodepools=nodepools,
             instance_types=catalogs,
@@ -116,6 +119,10 @@ class Provisioner:
             pods_by_node=self._pods_by_node(),
             nodepool_usage={p.name: self.cluster.nodepool_usage(p.name) for p in nodepools},
             zones=zones,
+            # fresh nodes reserve the daemonsets that will land on them
+            # (apis/daemonset; the reference core sizes simulated nodes
+            # the same way)
+            daemon_overhead=overhead_by_pool(self.cluster.list(DaemonSet), nodepools),
         )
         t0 = time.perf_counter()
         if self.solver is not None:

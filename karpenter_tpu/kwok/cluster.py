@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type
 
-from karpenter_tpu.apis import NodeClaim, NodePool, Pod, Node, PodDisruptionBudget, TPUNodeClass
+from karpenter_tpu.apis import DaemonSet, NodeClaim, NodePool, Pod, Node, PodDisruptionBudget, TPUNodeClass
 from karpenter_tpu.apis.objects import APIObject, Lease
 from karpenter_tpu.cache.ttl import Clock
 from karpenter_tpu.scheduling import Resources
@@ -38,7 +38,7 @@ EventHandler = Callable[[str, APIObject], None]  # (event_type, object)
 
 
 class Cluster:
-    KINDS: Tuple[Type[APIObject], ...] = (Pod, Node, NodeClaim, NodePool, TPUNodeClass, Lease, PodDisruptionBudget)
+    KINDS: Tuple[Type[APIObject], ...] = (Pod, Node, NodeClaim, NodePool, TPUNodeClass, Lease, PodDisruptionBudget, DaemonSet)
 
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or Clock()

@@ -203,10 +203,13 @@ class ConsolidationEvaluator:
         sets: Sequence[Tuple[Sequence[Pod], Sequence[str]]],
         pools: Sequence[NodePool] = (),
         catalogs: Optional[Dict[str, list]] = None,
+        daemon_overhead: Optional[Dict[str, "Resources"]] = None,
     ) -> List[SetVerdict]:
         """nodes: surviving-capacity snapshot (oracle node order).
         sets: per candidate set, (pods to repack, names of excluded nodes).
         pools/catalogs: replacement context (optional; omit for delete-only).
+        daemon_overhead: per-pool fresh-node reserve (apis/daemonset) --
+        a replacement node must fit the leftovers PLUS its daemonsets.
         """
         if not sets:
             return []
@@ -293,10 +296,16 @@ class ConsolidationEvaluator:
                 c_pad=C,
             )
             compat = encode.compat_matrix(catalog, cs)
+            cap_eff = catalog.cap
+            ovh = (daemon_overhead or {}).get(pool.name)
+            if ovh is not None:
+                ovh_vec = encode.scale_vector(ovh.to_vector()).astype(np.float32)
+                if np.any(ovh_vec):
+                    cap_eff = np.maximum(cap_eff - ovh_vec[None, :], np.float32(0.0))
             out = _replacement_search(
                 jnp.asarray(leftover), jnp.asarray(cs.req), jnp.asarray(compat),
                 jnp.asarray(cs.azone), jnp.asarray(cs.acap),
-                jnp.asarray(catalog.cap), jnp.asarray(catalog.price),
+                jnp.asarray(cap_eff), jnp.asarray(catalog.price),
             )
             for x in out:
                 if hasattr(x, "copy_to_host_async"):

@@ -228,6 +228,9 @@ class PodClassSet:
     azone: np.ndarray                # [C, Z] bool allowed zones
     acap: np.ndarray                 # [C, CT] bool allowed captypes
     schedulable: np.ndarray          # [C] bool (taints tolerated etc.)
+    # [R] f32 per-fresh-node reserve (daemonset overhead for the solved
+    # pool, apis/daemonset.pool_daemon_overhead); zeros = no reserve
+    node_overhead: np.ndarray = None
 
 
 def _spread_sig(pod: Pod) -> tuple:
@@ -435,6 +438,7 @@ def encode_classes(
     catalog: CatalogTensors,
     pool_taints: Sequence[Taint] = (),
     c_pad: Optional[int] = None,
+    node_overhead: Optional[np.ndarray] = None,
 ) -> PodClassSet:
     c_real = len(classes)
     if c_pad is None:
@@ -475,6 +479,10 @@ def encode_classes(
         classes=list(classes), c_real=c_real, c_pad=c_pad, req=req, count=count,
         env_count=env_count, allowed=allowed, num_lo=num_lo, num_hi=num_hi,
         azone=azone, acap=acap, schedulable=schedulable,
+        node_overhead=(
+            node_overhead.astype(np.float32)
+            if node_overhead is not None else np.zeros((R,), dtype=np.float32)
+        ),
     )
 
 

@@ -73,6 +73,7 @@ class SolveInputs(NamedTuple):
     azone: jax.Array        # [C, Z] bool
     acap: jax.Array         # [C, CT] bool
     schedulable: jax.Array  # [C] bool
+    node_overhead: jax.Array  # [R] f32 per-fresh-node reserve (daemonsets)
 
 
 class SolveOutputs(NamedTuple):
@@ -230,13 +231,17 @@ def _ffd_body(
     Z = inp.tzone.shape[1]
     CTn = inp.tcap.shape[1]
     compat = _device_compat(inp, word_offsets, words)             # [C, K]
+    # fresh nodes reserve the pool's daemonset overhead: every fit count
+    # (in-scan and fresh) sees the reduced capacity. Padding rows clip to
+    # zero so they stay unusable.
+    cap_eff = jnp.maximum(inp.cap - inp.node_overhead[None, :], 0.0)
     tzc = _pack_zc(inp.tzone, inp.tcap)                           # [K] u32
     azc = _pack_zc(inp.azone, inp.acap)                           # [C] u32
 
     # fresh-group fit per (class, type): independent of the carry, so it is
     # hoisted out of the scan entirely (one batched [C, K] pass instead of C
     # [K]-sized passes inside the sequential loop)
-    n_fresh_all = _fresh_fit_counts(inp.cap, inp.req)             # [C, K]
+    n_fresh_all = _fresh_fit_counts(cap_eff, inp.req)             # [C, K]
     fresh_join = _joint_ok(azc[:, None] & tzc[None, :])           # [C, K]
     fresh_mask_all = compat & fresh_join                          # [C, K]
     if objective == "price":
@@ -269,7 +274,7 @@ def _ffd_body(
         m = gmask & compat_c[None, :] & _joint_ok(gzc_new[:, None] & tzc[None, :])
 
         # -- how many fit on each open group -------------------------------
-        n_fit = _fit_counts(inp.cap, accum, req_c)                # [G, K]
+        n_fit = _fit_counts(cap_eff, accum, req_c)                # [G, K]
         n_grp = jnp.max(jnp.where(m, n_fit, 0.0), axis=-1)        # [G]
         n_grp = jnp.where(slot < n_open, n_grp, 0.0).astype(jnp.int32)
 
@@ -670,6 +675,7 @@ def make_inputs_staged(staged: StagedCatalog, classes: PodClassSet) -> SolveInpu
         allowed=allowed,
         num_lo=classes.num_lo, num_hi=classes.num_hi, azone=classes.azone,
         acap=classes.acap, schedulable=classes.schedulable,
+        node_overhead=classes.node_overhead,
     )
 
 
@@ -694,5 +700,6 @@ def make_inputs(catalog: CatalogTensors, classes: PodClassSet) -> Tuple[SolveInp
         azone=jnp.asarray(classes.azone),
         acap=jnp.asarray(classes.acap),
         schedulable=jnp.asarray(classes.schedulable),
+        node_overhead=jnp.asarray(classes.node_overhead),
     )
     return inp, offsets, words

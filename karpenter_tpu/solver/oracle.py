@@ -135,11 +135,19 @@ class Scheduler:
         nodepool_usage: Optional[Dict[str, Resources]] = None,
         zones: Optional[Set[str]] = None,
         objective: str = "price",
+        daemon_overhead: Optional[Dict[str, Resources]] = None,
     ):
+        # per-nodepool daemonset overhead: every FRESH node of the pool
+        # reserves these resources before workload pods pack onto it
+        # (apis/daemonset.overhead_by_pool; the reference core sizes its
+        # simulated nodes the same way). Existing nodes are unaffected --
+        # their daemon pods are already bound and counted in usage.
+        self.daemon_overhead = daemon_overhead or {}
         # packing objective, mirrored from TPUSolver: "price" restricts a
         # fresh group's candidate types to the min-price-per-pod envelope
         # (solver/ffd.py _ffd_body); "fit" keeps every compatible type
         self.objective = objective
+        self._zero_overhead = Resources()
         self.nodepools = sorted(nodepools, key=lambda p: -p.weight)
         self.instance_types = instance_types
         self.existing = list(existing_nodes)
@@ -377,6 +385,9 @@ class Scheduler:
             return {z for z in self.zones if zreq.matches(z)}
         return set(zreq.values)
 
+    def _ovh(self, pool: NodePool) -> Resources:
+        return self.daemon_overhead.get(pool.name) or self._zero_overhead
+
     def _feasible_spread_zones(self, pool: Optional[NodePool], base: Requirements, requested: Resources) -> Set[str]:
         """Zones where some instance type of `pool` is compatible with the
         pod+pool requirements pinned to that zone, fits one pod, and has an
@@ -399,7 +410,7 @@ class Scheduler:
             for it in items:
                 if (
                     it.requirements.compatible(reqz)
-                    and _fits_type(it, requested)
+                    and _fits_type(it, requested + self._ovh(pool))
                     and any(o.available and o.zone == z for o in it.offerings)
                 ):
                     out.add(z)
@@ -484,10 +495,11 @@ class Scheduler:
         if narrowed is None:
             return False
         requested = group.add_requested(pod)
+        effective = requested + self._ovh(group.nodepool)
         survivors = [
             it
             for it in group.instance_types
-            if it.requirements.compatible(narrowed) and _fits_type(it, requested)
+            if it.requirements.compatible(narrowed) and _fits_type(it, effective)
         ]
         if not survivors:
             return False
@@ -533,6 +545,7 @@ class Scheduler:
         requested: Resources,
         remaining: int,
         env_key: Optional[tuple] = None,
+        overhead: Optional[Resources] = None,
     ) -> List[InstanceType]:
         """Price-aware opening envelope, the oracle half of the batch
         solver's objective == "price" (solver/ffd.py _ffd_body step): pick
@@ -548,6 +561,10 @@ class Scheduler:
         from karpenter_tpu.solver import encode as _enc
 
         req32 = _enc.scale_vector(requested.to_vector()).astype(_np.float32)
+        ovh32 = (
+            _enc.scale_vector(overhead.to_vector()).astype(_np.float32)
+            if overhead is not None else None
+        )
         pos = req32 > 0
         zreq = narrowed.get(wk.ZONE_LABEL)
         creq = narrowed.get(wk.CAPACITY_TYPE_LABEL)
@@ -555,6 +572,11 @@ class Scheduler:
         stats = []
         for it in candidates:
             cap32 = _enc.scale_vector(it.allocatable().to_vector()).astype(_np.float32)
+            if ovh32 is not None:
+                # fresh nodes reserve the pool's daemonset overhead before
+                # workload pods pack (the device subtracts the same scaled
+                # vector from cap -- float32 exactness holds, small ints)
+                cap32 = _np.maximum(cap32 - ovh32, _np.float32(0.0))
             n = _np.floor(cap32[pos] / req32[pos]).min() if pos.any() else inf32
             price = inf32
             has_reserved = False
@@ -644,10 +666,11 @@ class Scheduler:
                 last_reason = "pod affinity unsatisfiable in any zone"
                 continue
             requested = pod.requests + Resources.from_base_units({res.PODS: 1})
+            effective = requested + self._ovh(pool)
             candidates = [
                 it
                 for it in self.instance_types.get(pool.name, [])
-                if it.requirements.compatible(narrowed) and _fits_type(it, requested)
+                if it.requirements.compatible(narrowed) and _fits_type(it, effective)
             ]
             if (
                 candidates
@@ -665,6 +688,7 @@ class Scheduler:
                 candidates = self._price_open_filter(
                     candidates, narrowed, requested,
                     self._remaining(pod, pool), env_key=self._env_key(pod, pool),
+                    overhead=self._ovh(pool),
                 )
             if not candidates:
                 last_reason = f"no instance type in nodepool {pool.name} fits pod"

@@ -186,6 +186,11 @@ class SolverServer:
             req=t["req"], count=t["count"], env_count=t["env_count"],
             allowed=t["allowed"], num_lo=t["num_lo"], num_hi=t["num_hi"],
             azone=t["azone"], acap=t["acap"], schedulable=t["schedulable"],
+            # older clients do not send the per-node daemonset reserve;
+            # zeros preserves their semantics exactly
+            node_overhead=t.get(
+                "node_overhead", np.zeros((t["req"].shape[1],), dtype=np.float32)
+            ),
         )
         return entry, inp
 
@@ -307,6 +312,7 @@ class SolverClient:
             ("num_lo", class_set.num_lo), ("num_hi", class_set.num_hi),
             ("azone", class_set.azone), ("acap", class_set.acap),
             ("schedulable", class_set.schedulable),
+            ("node_overhead", class_set.node_overhead),
         ]
 
     def _solve_op(self, op_header: dict, seqnum: str, catalog, class_set):

@@ -285,6 +285,7 @@ class TPUSolver:
                 zones=sorted(scheduler.zones),
                 spread_seeds=self._spread_seeds(scheduler) if i == 0 else None,
                 classes=base_classes if i == 0 else None,
+                daemon_overhead=scheduler.daemon_overhead.get(pool.name),
             )
             result.new_groups.extend(res.new_groups)
             result.existing_assignments.update(res.existing_assignments)
@@ -309,10 +310,16 @@ class TPUSolver:
         zones: Sequence[str] = (),
         spread_seeds: Optional[Dict] = None,
         classes: Optional[List] = None,
+        daemon_overhead: Optional[Resources] = None,
     ) -> SchedulingResult:
         from karpenter_tpu.solver import spread as spread_mod
 
         pool_reqs = pool.requirements()
+        # per-fresh-node daemonset reserve (apis/daemonset), scaled to the
+        # solver's exact small-int float32 vector; None/zero = no reserve
+        overhead_vec = None
+        if daemon_overhead is not None and any(daemon_overhead.to_vector()):
+            overhead_vec = encode.scale_vector(daemon_overhead.to_vector()).astype(np.float32)
         if classes is None:
             classes = encode.group_pods(pods, extra_requirements=pool_reqs)
         else:
@@ -361,12 +368,15 @@ class TPUSolver:
                 c_pad=_bucket(len(classes), self.c_pad_min),
             )
             compat = encode.compat_matrix(catalog0, pre_set)[: len(classes)]
+            cap0 = catalog0.cap
+            if overhead_vec is not None:
+                cap0 = np.maximum(cap0 - overhead_vec[None, :], np.float32(0.0))
             fits_one = np.all(
-                catalog0.cap[None, :, :] >= pre_set.req[: len(classes), None, :], axis=-1
+                cap0[None, :, :] >= pre_set.req[: len(classes), None, :], axis=-1
             )
             split = spread_mod.split_zone_spread(
                 classes, catalog0, list(zones) or list(catalog0.zones), compat, fits_one,
-                seed_counts=spread_seeds,
+                seed_counts=spread_seeds, node_overhead=overhead_vec,
             )
             classes = split.classes
             result.unschedulable.update(split.unschedulable)
@@ -399,6 +409,7 @@ class TPUSolver:
             catalog,
             pool_taints=list(pool.template.taints),
             c_pad=_bucket(len(classes), self.c_pad_min),
+            node_overhead=overhead_vec,
         )
         counts = class_set.count.copy()
         counts[: len(classes)] -= placed_existing.astype(counts.dtype)

@@ -133,6 +133,7 @@ class SplitResult:
 
 def _per_new_for_zone(
     pc: PodClass, catalog: CatalogTensors, cat_z: int, compat_row: np.ndarray,
+    node_overhead: Optional[np.ndarray] = None,
 ) -> int:
     """How many pods of class `pc` the batch solver will put on one fresh
     group pinned to catalog zone `cat_z` -- the host mirror of
@@ -144,7 +145,12 @@ def _per_new_for_zone(
     with the device bit-for-bit."""
     req32 = np.asarray(pc.requests, dtype=np.float32)
     pos = req32 > 0
-    n = np.floor(catalog.cap[:, pos] / req32[pos]).min(axis=1)     # [K] f32
+    cap = catalog.cap
+    if node_overhead is not None:
+        # fresh nodes reserve the pool's daemonset overhead (same scaled
+        # vector the device subtracts -- float32-exact, small ints)
+        cap = np.maximum(cap - node_overhead[None, :].astype(np.float32), np.float32(0.0))
+    n = np.floor(cap[:, pos] / req32[pos]).min(axis=1)     # [K] f32
     n = np.maximum(n, np.float32(0.0))
     mask = compat_row & catalog.tzone[:, cat_z]
     if not mask.any():
@@ -159,6 +165,7 @@ def split_zone_spread(
     compat: np.ndarray,           # [C, K] host compat (encode.compat_matrix)
     fits_one: np.ndarray,         # [C, K] one pod of class c fits type k
     seed_counts: Optional[Dict[tuple, Dict[str, int]]] = None,
+    node_overhead: Optional[np.ndarray] = None,
 ) -> SplitResult:
     """The carry pass: returns classes with every spread class replaced by
     zone-pinned sub-classes (FFD order preserved).
@@ -212,7 +219,7 @@ def split_zone_spread(
         chunks = []  # (open_level, zone_lex_idx, zone, chunk_size)
         for zi in np.nonzero(take)[0]:
             z = zones[zi]
-            per_new = _per_new_for_zone(pc, catalog, cat_zone_idx[z], compat[ci])
+            per_new = _per_new_for_zone(pc, catalog, cat_zone_idx[z], compat[ci], node_overhead)
             total = int(take[zi])
             if per_new <= 0:
                 # no opening possible in this zone (the solver will mark

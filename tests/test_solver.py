@@ -553,3 +553,107 @@ class TestNativeGrouping:
             assert a.key == b.key
             assert a.has_affinity == b.has_affinity
             assert a.multi_node_affinity == b.multi_node_affinity
+
+
+
+class TestDaemonSetOverhead:
+    """Fresh-node sizing reserves daemonset overhead (reference: the core
+    sizes every simulated node with the daemonsets that will land on it;
+    apis/daemonset.pool_daemon_overhead). Existing nodes are unaffected --
+    their daemon pods are already bound."""
+
+    def test_matches_pool_selector_and_taints(self):
+        from karpenter_tpu.apis import DaemonSet, NodePool
+        from karpenter_tpu.scheduling import Requirement, Taint, Toleration
+
+        pool = NodePool("default")
+        assert DaemonSet("cni").matches_pool(pool)
+        picky = DaemonSet("gpu-agent", node_selector={wk.ARCH_LABEL: "arm64"})
+        amd = NodePool("amd", requirements=[Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])])
+        assert not picky.matches_pool(amd)
+        tainted = NodePool("t")
+        tainted.template.taints = [Taint("dedicated", value="x", effect="NoSchedule")]
+        assert not DaemonSet("cni2").matches_pool(tainted)
+        tolerant = DaemonSet("cni3", tolerations=[Toleration(key="dedicated", operator="Exists")])
+        assert tolerant.matches_pool(tainted)
+
+    def test_overhead_shrinks_per_node_fit_differentially(self, catalog_items):
+        """With a fat daemonset, fewer pods fit per node -- and the oracle
+        and device paths agree exactly on the new packing."""
+        from karpenter_tpu.apis import DaemonSet
+        from karpenter_tpu.apis.daemonset import overhead_by_pool
+        from karpenter_tpu.scheduling import Resources as Rz
+
+        pool = NodePool("default")
+        ds = [DaemonSet("fat", requests=Rz({"cpu": "1", "memory": "2Gi"}))]
+        overhead = overhead_by_pool(ds, [pool])
+        pods = [make_pod(f"p{i}", "1", 2) for i in range(40)]
+
+        def mk(dov):
+            return Scheduler(
+                nodepools=[pool],
+                instance_types={pool.name: catalog_items},
+                zones={o.zone for it in catalog_items for o in it.available_offerings()},
+                daemon_overhead=dov,
+            )
+
+        oracle_plain = mk(None).schedule(list(pods))
+        oracle_ds = mk(overhead).schedule(list(pods))
+        device_ds = TPUSolver(g_max=256).schedule(mk(overhead), list(pods))
+        assert not oracle_ds.unschedulable
+        # reserving a core + 2Gi per node must cost capacity somewhere:
+        # never fewer groups than the unreserved packing
+        assert len(oracle_ds.new_groups) >= len(oracle_plain.new_groups)
+        assert _signature(oracle_ds) == _signature(device_ds)
+        assert len(oracle_ds.new_groups) == len(device_ds.new_groups)
+
+    def test_overhead_can_make_pods_unschedulable(self, catalog_items):
+        """A pod that exactly fills the biggest node no longer fits once
+        the daemonset reserve is subtracted -- on both paths."""
+        from karpenter_tpu.apis import DaemonSet
+        from karpenter_tpu.apis.daemonset import overhead_by_pool
+        from karpenter_tpu.scheduling import Resources as Rz
+        from karpenter_tpu.scheduling import resources as rs
+
+        pool = NodePool("default")
+        biggest = max(catalog_items, key=lambda it: it.allocatable().get(rs.CPU))
+        cpu_m = biggest.allocatable().get(rs.CPU)
+        pod = Pod("whale", requests=Rz.from_base_units({rs.CPU: cpu_m - 100.0}))
+        ds_over = overhead_by_pool([DaemonSet("fat", requests=Rz({"cpu": "500m"}))], [pool])
+
+        def mk(dov):
+            return Scheduler(
+                nodepools=[pool], instance_types={pool.name: catalog_items},
+                zones={o.zone for it in catalog_items for o in it.available_offerings()},
+                daemon_overhead=dov,
+            )
+
+        assert not mk(None).schedule([pod]).unschedulable
+        o = mk(ds_over).schedule([pod])
+        d = TPUSolver(g_max=64).schedule(mk(ds_over), [pod])
+        assert set(o.unschedulable) == set(d.unschedulable) == {"whale"}
+
+    def test_existing_nodes_unaffected(self, catalog_items):
+        """Daemon overhead reserves on FRESH nodes only; packing onto live
+        capacity ignores it (daemon pods there are already bound)."""
+        from karpenter_tpu.apis import DaemonSet
+        from karpenter_tpu.apis.daemonset import overhead_by_pool
+        from karpenter_tpu.scheduling import Resources as Rz
+        from karpenter_tpu.scheduling import resources as rs
+        from karpenter_tpu.solver.oracle import ExistingNode
+
+        pool = NodePool("default")
+        node = ExistingNode(
+            name="live", labels={},
+            allocatable=Rz.from_base_units({rs.CPU: 1000.0, rs.MEMORY: 2.0 * 2**30, rs.PODS: 10}),
+        )
+        pod = Pod("snug", requests=Rz.from_base_units({rs.CPU: 900.0}))
+        ds_over = overhead_by_pool([DaemonSet("fat", requests=Rz({"cpu": "500m"}))], [pool])
+        sched = Scheduler(
+            nodepools=[pool], instance_types={pool.name: catalog_items},
+            existing_nodes=[node],
+            zones={o.zone for it in catalog_items for o in it.available_offerings()},
+            daemon_overhead=ds_over,
+        )
+        result = TPUSolver(g_max=64).schedule(sched, [pod])
+        assert result.existing_assignments.get("snug") == "live"
