@@ -347,3 +347,26 @@ class TestPodArrivalWake:
         t0 = _t.monotonic()
         op.wait_for_work(0.03)
         assert _t.monotonic() - t0 >= 0.03
+
+
+class TestDaemonSetOverheadE2E:
+    """The provisioner wires store DaemonSets into node sizing: a pod that
+    exactly fills the biggest node becomes unschedulable once a daemonset
+    must fit beside it."""
+
+    def test_daemonset_reserves_capacity(self, env):
+        from karpenter_tpu.apis import DaemonSet
+        from karpenter_tpu.scheduling import Resources
+
+        env.tick()  # resolve nodeclass status so the catalog is available
+        items = env.cloud_provider.get_instance_types(env.cluster.get(NodePool, "default"))
+        biggest = max(items, key=lambda it: it.allocatable().get(res.CPU))
+        cpu_m = biggest.allocatable().get(res.CPU)
+        whale = Pod("whale", requests=Resources.from_base_units({res.CPU: cpu_m - 100.0}))
+        env.cluster.create(DaemonSet("cni", requests=Resources({"cpu": "500m"})))
+        env.cluster.create(whale)
+        env.settle(max_ticks=10)
+        assert whale.pending, "daemonset reserve must make the whale unschedulable"
+        env.cluster.delete(DaemonSet, "cni")
+        env.settle(max_ticks=10)
+        assert not whale.pending, "with the daemonset gone the whale fits again"
