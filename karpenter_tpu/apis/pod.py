@@ -47,6 +47,7 @@ class Pod(APIObject):
         limits: Optional[Resources] = None,
         node_selector: Optional[Mapping[str, str]] = None,
         node_affinity_terms: Sequence[Sequence[Requirement]] = (),
+        preferred_node_affinity_terms: Sequence = (),
         tolerations: Sequence[Toleration] = (),
         topology_spread: Sequence[TopologySpreadConstraint] = (),
         affinity_terms: Sequence[PodAffinityTerm] = (),
@@ -65,6 +66,13 @@ class Pod(APIObject):
         self.node_selector = dict(node_selector or {})
         # required node affinity: OR over terms, each term a list of Requirements
         self.node_affinity_terms = [list(t) for t in node_affinity_terms]
+        # preferred node affinity: (weight, [Requirement]) pairs. Scheduled
+        # via the core's preference-relaxation model (oracle.schedule):
+        # preferences apply as requirements, and on failure the lowest-
+        # weight one is dropped and the pod retried, until it places.
+        self.preferred_node_affinity_terms = [
+            (int(w), list(term)) for w, term in preferred_node_affinity_terms
+        ]
         self.tolerations = list(tolerations)
         self.topology_spread = list(topology_spread)
         self.affinity_terms = list(affinity_terms)
@@ -97,7 +105,10 @@ class Pod(APIObject):
             self._spec_refs = None
             self._spec_token = None
         else:
-            self._spec_refs = (requests, node_selector, node_affinity_terms, tolerations, affinity_terms)
+            self._spec_refs = (
+                requests, node_selector, node_affinity_terms, tolerations,
+                affinity_terms, preferred_node_affinity_terms,
+            )
             # the node_selector fingerprint is its FULL sorted content: a
             # caller that mutates one dict between constructions (e.g.
             # sel['zone'] = z in a loop, any key) reuses the id but changes
@@ -111,11 +122,12 @@ class Pod(APIObject):
             ns_fp = tuple(sorted(node_selector.items())) if node_selector else ()
             self._spec_token = (
                 id(requests), id(node_selector), id(node_affinity_terms),
-                id(tolerations), id(affinity_terms),
+                id(tolerations), id(affinity_terms), id(preferred_node_affinity_terms),
                 ns_fp,
                 len(tolerations) if tolerations else 0,
                 len(node_affinity_terms) if node_affinity_terms else 0,
                 len(affinity_terms) if affinity_terms else 0,
+                len(preferred_node_affinity_terms) if preferred_node_affinity_terms else 0,
             )
 
     def grouping_signature(self) -> tuple:
@@ -137,6 +149,7 @@ class Pod(APIObject):
             tsc = self.topology_spread
             aff = self.affinity_terms
             nat = self.node_affinity_terms
+            pref = self.preferred_node_affinity_terms
             labels = self.metadata.labels
             sig = self._group_sig = (
                 self.requests.sig(),
@@ -163,8 +176,23 @@ class Pod(APIObject):
                     (tuple(sorted(t.label_selector.items())), t.topology_key, t.anti)
                     for t in aff
                 ) if aff else (),
+                tuple(
+                    (w, tuple(
+                        (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+                        for r in term
+                    ))
+                    for w, term in pref
+                ) if pref else (),
             )
         return sig
+
+    def preference_variants(self):
+        """Requirement-term sets to try, strongest first (the core's
+        preference relaxation): all preferred terms as requirements, then
+        dropping the lowest-weight one per attempt, ending with none."""
+        prefs = sorted(self.preferred_node_affinity_terms, key=lambda p: -p[0])
+        for n in range(len(prefs), -1, -1):
+            yield [term for _, term in prefs[:n]]
 
     # -- scheduling views ---------------------------------------------------
     def scheduling_requirements(self) -> List[Requirements]:

@@ -212,6 +212,7 @@ class PodClass:
     # cannot answer "does anyone here carry affinity?"; these bits can)
     has_affinity: bool = False
     multi_node_affinity: bool = False
+    has_preferences: bool = False
 
 
 @dataclass
@@ -354,6 +355,8 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
                 pc.has_affinity = True
             if len(pod.node_affinity_terms) > 1:
                 pc.multi_node_affinity = True
+            if pod.preferred_node_affinity_terms:
+                pc.has_preferences = True
             id_to_class[sid] = pc
         return pc
 
@@ -396,6 +399,7 @@ def with_extra_requirements(classes: Sequence[PodClass], extra: Requirements) ->
             requirements=pc.requirements.copy().add(*extra),
             key=pc.key, env_count=pc.env_count,
             has_affinity=pc.has_affinity, multi_node_affinity=pc.multi_node_affinity,
+            has_preferences=pc.has_preferences,
         )
         for pc in classes
     ]

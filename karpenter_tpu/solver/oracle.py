@@ -729,29 +729,53 @@ class Scheduler:
         ordered = sorted(pods, key=pod_sort_key)
         self._sched_pods = ordered
         for pod in ordered:
-            if self._try_existing(pod, result):
-                self._note_placed(pod)
-                continue
-            placed = False
-            for pod_reqs in pod.scheduling_requirements():
-                for group in result.new_groups:
-                    if self._try_group(pod, group, pod_reqs):
-                        placed = True
-                        break
-                if placed:
-                    break
-            if placed:
-                self._note_placed(pod)
-                continue
-            reasons = []
-            for pod_reqs in pod.scheduling_requirements():
-                reason = self._open_group(pod, pod_reqs, result)
-                if reason is None:
-                    placed = True
-                    break
-                reasons.append(reason)
+            placed, reasons = False, []
+            if not pod.preferred_node_affinity_terms:
+                placed, reasons = self._attempt_placement(pod, result)
+            else:
+                # preference relaxation (the core's preferences model): the
+                # pod's preferred node-affinity terms apply as
+                # REQUIREMENTS, strongest set first; each failed attempt
+                # drops the lowest-weight preference and retries, ending
+                # with none. Attempts mutate-and-restore
+                # node_affinity_terms; the grouping signature is memoized
+                # FROM THE ORIGINAL SPEC first, so helpers that read it
+                # mid-attempt (_env_key) can never capture a variant.
+                pod.grouping_signature()
+                original_nat = pod.node_affinity_terms
+                try:
+                    for prefs in pod.preference_variants():
+                        if prefs:
+                            base = original_nat or [[]]
+                            flat = [r for term in prefs for r in term]
+                            pod.node_affinity_terms = [list(t) + flat for t in base]
+                        else:
+                            pod.node_affinity_terms = original_nat
+                        placed, reasons = self._attempt_placement(pod, result)
+                        if placed:
+                            break
+                finally:
+                    pod.node_affinity_terms = original_nat
             if not placed:
                 result.unschedulable[pod.metadata.name] = "; ".join(reasons) or "unschedulable"
             else:
                 self._note_placed(pod)
         return result
+
+    def _attempt_placement(self, pod: Pod, result: SchedulingResult):
+        """One full placement attempt under the pod's CURRENT constraints:
+        existing nodes, then open groups, then a fresh group. Side effects
+        only on success. Returns (placed, reasons)."""
+        if self._try_existing(pod, result):
+            return True, []
+        for pod_reqs in pod.scheduling_requirements():
+            for group in result.new_groups:
+                if self._try_group(pod, group, pod_reqs):
+                    return True, []
+        reasons = []
+        for pod_reqs in pod.scheduling_requirements():
+            reason = self._open_group(pod, pod_reqs, result)
+            if reason is None:
+                return True, []
+            reasons.append(reason)
+        return False, reasons

@@ -199,7 +199,7 @@ class TPUSolver:
         reps = []
         any_spread = False
         for pc in classes:
-            if pc.has_affinity or pc.multi_node_affinity:
+            if pc.has_affinity or pc.multi_node_affinity or pc.has_preferences:
                 return False
             p = pc.pods[0]
             reps.append(p)

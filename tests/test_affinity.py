@@ -287,3 +287,88 @@ class TestSpecTokenSafety:
             pods.append(Pod(f"q-{z}", requests=shared_req, node_selector=sel))
         classes = encode.group_pods(pods)
         assert len(classes) == 2
+
+
+class TestPreferenceRelaxation:
+    """Preferred node affinity via the core's preference-relaxation model:
+    preferences apply as requirements; a pod that cannot place drops the
+    lowest-weight preference and retries, ending with none."""
+
+    def _prefs(self, *pairs):
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        return [
+            (w, [Requirement(key, Operator.IN, [val])]) for (w, key, val) in pairs
+        ]
+
+    def test_satisfiable_preference_is_honored(self, catalog_items):
+        zone = sorted({o.zone for it in catalog_items for o in it.available_offerings()})[0]
+        p = small("pref", preferred_node_affinity_terms=self._prefs((10, wk.ZONE_LABEL, zone)))
+        _, sched = mk_sched(catalog_items)
+        result = sched.schedule([p])
+        assert not result.unschedulable
+        g = result.new_groups[0]
+        zreq = g.requirements.get(wk.ZONE_LABEL)
+        assert zreq is not None and zreq.matches(zone) and not zreq.matches("other")
+
+    def test_unsatisfiable_preference_relaxes(self, catalog_items):
+        p = small(
+            "wishful",
+            preferred_node_affinity_terms=self._prefs((10, wk.ZONE_LABEL, "zone-on-the-moon")),
+        )
+        _, sched = mk_sched(catalog_items)
+        result = sched.schedule([p])
+        assert not result.unschedulable, "preference must relax, not block"
+
+    def test_lowest_weight_drops_first(self, catalog_items):
+        zones = sorted({o.zone for it in catalog_items for o in it.available_offerings()})
+        p = small(
+            "ranked",
+            preferred_node_affinity_terms=self._prefs(
+                (100, wk.ZONE_LABEL, zones[0]),          # strong: satisfiable
+                (1, wk.ZONE_LABEL, "zone-on-the-moon"),  # weak: impossible
+            ),
+        )
+        _, sched = mk_sched(catalog_items)
+        result = sched.schedule([p])
+        assert not result.unschedulable
+        zreq = result.new_groups[0].requirements.get(wk.ZONE_LABEL)
+        # the weak impossible preference was dropped; the strong one held
+        assert zreq is not None and zreq.matches(zones[0])
+
+    def test_preference_pods_route_to_oracle(self, catalog_items):
+        from karpenter_tpu.solver.service import TPUSolver
+
+        p = small("pref2", preferred_node_affinity_terms=self._prefs((1, wk.ARCH_LABEL, "arm64")))
+        _, sched = mk_sched(catalog_items)
+        assert not TPUSolver.supports(sched, [p])
+        # end-to-end through the router: the preference is honored
+        result = TPUSolver(g_max=64).schedule(sched, [p])
+        assert not result.unschedulable
+        areq = result.new_groups[0].requirements.get(wk.ARCH_LABEL)
+        assert areq is not None and areq.matches("arm64") and not areq.matches("amd64")
+
+    def test_identical_preference_pods_share_one_group_via_direct_oracle(self, catalog_items):
+        """Round-3 review repro: the oracle called DIRECTLY (provisioner
+        without solver, disruption simulation) must not let a preference
+        variant pollute the memoized grouping signature -- two identical
+        preference pods share one price-envelope class and pack onto ONE
+        node, exactly like their plain twins."""
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        zones = sorted({o.zone for it in catalog_items for o in it.available_offerings()})
+        prefs = [(10, [Requirement(wk.ZONE_LABEL, Operator.IN, [zones[0]])])]
+        pods = [
+            small(f"twin-{i}", preferred_node_affinity_terms=prefs) for i in range(2)
+        ]
+        plain = [small(f"plain-{i}") for i in range(2)]
+        _, sched_pref = mk_sched(catalog_items)
+        _, sched_plain = mk_sched(catalog_items)
+        r_pref = sched_pref.schedule(pods)
+        r_plain = sched_plain.schedule(plain)
+        assert not r_pref.unschedulable
+        assert len(r_pref.new_groups) == len(r_plain.new_groups)
+        # and the signature memo still reflects the ORIGINAL (pref-free
+        # required affinity) spec
+        for p in pods:
+            assert p._group_sig is not None and p._group_sig[2] == ()
