@@ -209,7 +209,14 @@ class TPUSolver:
             # hostname spread and multi-constraint pods take the oracle;
             # zone spread (incl. existing nodes: counts seed from the
             # scheduler's topology state) stays on device. Spread + several
-            # pools would need cross-pool count carry -- oracle.
+            # pools would need cross-pool count carry -- oracle. Spread
+            # mixed with other zone-narrowing classes STAYS on device with
+            # an accepted deviation: which mixed group a spread pod shares
+            # with plain pods (and hence total group count, by one in
+            # either direction) can differ from the sequential oracle,
+            # while unschedulable sets, plain-class packing, and
+            # per-(selector, zone) distributions stay identical -- the
+            # contract solver/spread.py documents and the fuzz enforces.
             if not spread.spread_eligible(reps) or len(scheduler.nodepools) > 1:
                 return False
         return True
@@ -340,7 +347,7 @@ class TPUSolver:
 
         # phase 0 (host): zone topology spread -- the carry pass splits
         # spread classes into zone-pinned, group-sized sub-classes with the
-        # oracle's exact pod distribution (solver/spread.py). Runs before
+        # oracle's exact per-zone distribution (solver/spread.py). Runs before
         # the existing-node phase so the pinned zones gate node packing;
         # counts seed from live pods (spread_seeds, the oracle's
         # _TopologyState.seed_existing) so steady-state clusters stay on

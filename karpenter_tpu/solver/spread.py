@@ -10,6 +10,15 @@ zone-pinned sub-classes carrying the exact per-zone pod counts the oracle's
 per-pod loop would produce, and the batched FFD solve (solver/ffd.py) then
 runs unchanged on the pinned sub-classes.
 
+Equivalence contract vs the oracle (tests/test_solver.py fuzz, 100+
+seeds): identical unschedulable sets, identical packing of non-spread
+classes, identical per-(selector, zone) spread distributions, identical
+existing-node totals. NOT contractual: which mixed group a spread pod
+shares with plain pods (and hence occasionally total group count by one in
+either direction) -- that pairing depends on the order zone narrowings
+land across classes mid-solve, which a pre-pass provably cannot observe;
+both outcomes are valid FFD placements of the same distribution.
+
 Semantics mirrored from solver/oracle.py (greedy min-count spreading over
 feasible domains):
 - counts are keyed by the spread selector (different workloads spread
@@ -23,9 +32,9 @@ feasible domains):
 - pods that do not match their own constraint's selector are unconstrained
 
 Scope (routing in solver/service.py): single hard zone-spread constraint
-per pod, no existing nodes; hostname spread and multi-constraint pods take
-the oracle path. Soft (ScheduleAnyway) constraints are ignored exactly as
-the oracle ignores them.
+per pod (existing nodes supported via seeded counts); hostname spread and
+multi-constraint pods take the oracle path. Soft (ScheduleAnyway)
+constraints are ignored exactly as the oracle ignores them.
 """
 from __future__ import annotations
 
