@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark benchmark-interruption e2e run docs-check docs verify-entry
+.PHONY: test deflake benchmark benchmark-interruption fuzz-extended e2e run docs-check docs verify-entry
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -41,3 +41,6 @@ verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun)
 
 benchmark-interruption:  ## interruption-queue tier at 100/1k/5k(/15k) messages
 	KARPENTER_TPU_PERF=1 KARPENTER_TPU_BENCH_FULL=1 $(PYTEST) tests/test_interruption_bench.py -q -s
+
+fuzz-extended:  ## 101-seed differential sweep (device vs oracle, both objectives)
+	KARPENTER_TPU_FUZZ_EXTENDED=1 $(PYTEST) tests/test_solver.py -k FuzzExtended -q

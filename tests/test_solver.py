@@ -1,6 +1,8 @@
 """TPU solver tests: encoding correctness, device/host compat parity, and
 differential FFD equivalence against the Python oracle on randomized
 instances (the solver's correctness contract, SURVEY.md section 7 step 5)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -509,9 +511,16 @@ class TestDifferentialFuzz:
         assert assignment_sig(oracle) == assignment_sig(device), f"seed {seed}"
         assert group_sig(oracle) == group_sig(device), f"seed {seed}"
         assert spread_zone_distribution(oracle) == spread_zone_distribution(device), f"seed {seed}"
-        # the accepted pairing freedom is bounded: a splitter regression
-        # that fragments spread pods one-per-node would blow this up
-        assert abs(len(oracle.new_groups) - len(device.new_groups)) <= 1, f"seed {seed}"
+        # the accepted pairing freedom is small: an EMPIRICAL bound (one
+        # per spread selector could shift in principle; every seed 0-100
+        # stays within 1) whose real job is to catch a splitter
+        # regression that fragments spread pods one-per-node
+        n_selectors = len({
+            tuple(sorted(t.label_selector.items()))
+            for p in pods for t in p.topology_spread if t.hard()
+        })
+        bound = max(1, n_selectors)
+        assert abs(len(oracle.new_groups) - len(device.new_groups)) <= bound, f"seed {seed}"
 
         # the legacy max-fit objective must ALSO stay differentially equal
         # (the bench's fleet-price A/B solves the same workload under it)
@@ -523,7 +532,7 @@ class TestDifferentialFuzz:
         assert assignment_sig(oracle_fit) == assignment_sig(device_fit), f"seed {seed} (fit)"
         assert group_sig(oracle_fit) == group_sig(device_fit), f"seed {seed} (fit)"
         assert spread_zone_distribution(oracle_fit) == spread_zone_distribution(device_fit), f"seed {seed} (fit)"
-        assert abs(len(oracle_fit.new_groups) - len(device_fit.new_groups)) <= 1, f"seed {seed} (fit)"
+        assert abs(len(oracle_fit.new_groups) - len(device_fit.new_groups)) <= bound, f"seed {seed} (fit)"
 
 
 class TestNativeGrouping:
@@ -695,3 +704,17 @@ class TestDaemonSetOverhead:
         )
         result = TPUSolver(g_max=64).schedule(sched, [pod])
         assert result.existing_assignments.get("snug") == "live"
+
+
+@pytest.mark.skipif(
+    not os.environ.get("KARPENTER_TPU_FUZZ_EXTENDED"),
+    reason="extended differential sweep: set KARPENTER_TPU_FUZZ_EXTENDED=1",
+)
+class TestDifferentialFuzzExtended:
+    """The wide sweep (seeds 0-100) behind make fuzz-extended: same
+    instance generator and contract as TestDifferentialFuzz, two orders
+    of magnitude more randomized coverage than the per-commit tier."""
+
+    @pytest.mark.parametrize("seed", range(0, 101))
+    def test_sweep(self, catalog_items, seed):
+        TestDifferentialFuzz().test_mixed_constraints(catalog_items, seed)
