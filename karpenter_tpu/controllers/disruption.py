@@ -127,6 +127,15 @@ class DisruptionController:
             node = self.cluster.node_for_nodeclaim(claim)
             if node is None or node.deleting or node.unschedulable:
                 continue
+            # node-level control: the karpenter.sh/do-not-disrupt
+            # annotation on the Node or its NodeClaim blocks VOLUNTARY
+            # disruption of the whole node (forceful paths -- interruption,
+            # repair, manual delete -- ignore it, as upstream documents)
+            if (
+                node.metadata.annotations.get(wk.DO_NOT_DISRUPT_ANNOTATION) == "true"
+                or claim.metadata.annotations.get(wk.DO_NOT_DISRUPT_ANNOTATION) == "true"
+            ):
+                continue
             pool_name = claim.nodepool_name
             pool = self.cluster.try_get(NodePool, pool_name) if pool_name else None
             if pool is None:

@@ -715,3 +715,40 @@ class TestPriorityDrainWaves:
         env.termination.reconcile(claim)
         # grace expired: everything drains and the node terminates
         assert env.cluster.try_get(NodeClaim, claim.metadata.name) is None
+
+
+class TestNodeLevelDoNotDisrupt:
+    """karpenter.sh/do-not-disrupt on the NODE (or its NodeClaim) blocks
+    voluntary disruption of the whole node; forceful paths (interruption,
+    repair, manual delete) ignore it -- upstream's node-level control."""
+
+    def test_annotated_node_excluded_from_voluntary_disruption(self, env):
+        pool = env.cluster.get(NodePool, "default")
+        pool.template.expire_after = 3600.0
+        env.cluster.update(pool)
+        run_pods(env, [Pod("p0", requests=Resources({"cpu": "200m"}))])
+        node = env.cluster.list(Node)[0]
+        node.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        env.cluster.update(node)
+        env.clock.step(3601)
+        assert env.disruption.reconcile() == [], "annotated node must not be disrupted"
+        # removing the annotation restores disruption
+        del node.metadata.annotations["karpenter.sh/do-not-disrupt"]
+        env.cluster.update(node)
+        decisions = env.disruption.reconcile()
+        assert decisions and decisions[0][1] == REASON_EXPIRED
+
+    def test_interruption_ignores_node_annotation(self, env):
+        """Forceful path: a spot interruption drains the node regardless."""
+        run_pods(env, [Pod("p1", requests=Resources({"cpu": "200m"}))])
+        claim = [c for c in env.cluster.list(NodeClaim) if not c.deleting][0]
+        claim.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        node = env.cluster.node_for_nodeclaim(claim)
+        node.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        env.cluster.update(node)
+        from tests.conftest import spot_interruption_body
+        from karpenter_tpu.utils import parse_instance_id
+
+        env.cloud.send(spot_interruption_body(parse_instance_id(claim.provider_id)))
+        env.interruption.reconcile()
+        assert claim.deleting, "forceful interruption must ignore the annotation"
