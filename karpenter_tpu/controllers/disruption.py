@@ -61,6 +61,10 @@ class Candidate:
     pods: List[Pod]
     price: float
     disruption_cost: float
+    # node/claim-level karpenter.sh/do-not-disrupt: blocks the GRACEFUL
+    # voluntary reasons (drift, emptiness, consolidation); expiration is a
+    # forceful method upstream and proceeds regardless
+    do_not_disrupt: bool = False
 
 
 class DisruptionController:
@@ -127,15 +131,10 @@ class DisruptionController:
             node = self.cluster.node_for_nodeclaim(claim)
             if node is None or node.deleting or node.unschedulable:
                 continue
-            # node-level control: the karpenter.sh/do-not-disrupt
-            # annotation on the Node or its NodeClaim blocks VOLUNTARY
-            # disruption of the whole node (forceful paths -- interruption,
-            # repair, manual delete -- ignore it, as upstream documents)
-            if (
+            dnd = (
                 node.metadata.annotations.get(wk.DO_NOT_DISRUPT_ANNOTATION) == "true"
                 or claim.metadata.annotations.get(wk.DO_NOT_DISRUPT_ANNOTATION) == "true"
-            ):
-                continue
+            )
             pool_name = claim.nodepool_name
             pool = self.cluster.try_get(NodePool, pool_name) if pool_name else None
             if pool is None:
@@ -149,6 +148,7 @@ class DisruptionController:
                     pods=pods,
                     price=self._price_of(claim),
                     disruption_cost=self._disruption_cost(claim, pods),
+                    do_not_disrupt=dnd,
                 )
             )
         return out
@@ -338,6 +338,8 @@ class DisruptionController:
                 return self.last_decisions
             if c.claim.metadata.name in [n for n, _ in self.last_decisions]:
                 continue
+            if c.do_not_disrupt:
+                continue
             drift = self._drift_reason(c)
             if drift and self._all_pods_evictable(c.pods):
                 if not self._budget_allows(c.nodepool, REASON_DRIFTED, disrupting, totals):
@@ -354,7 +356,8 @@ class DisruptionController:
             (
                 c
                 for c in candidates
-                if c.claim.metadata.name not in [n for n, _ in self.last_decisions]
+                if not c.do_not_disrupt
+                and c.claim.metadata.name not in [n for n, _ in self.last_decisions]
                 and now - c.claim.metadata.creation_timestamp
                 >= max(MIN_NODE_LIFETIME, c.nodepool.disruption.consolidate_after)
             ),

@@ -722,19 +722,35 @@ class TestNodeLevelDoNotDisrupt:
     voluntary disruption of the whole node; forceful paths (interruption,
     repair, manual delete) ignore it -- upstream's node-level control."""
 
-    def test_annotated_node_excluded_from_voluntary_disruption(self, env):
-        pool = env.cluster.get(NodePool, "default")
-        pool.template.expire_after = 3600.0
-        env.cluster.update(pool)
+    def test_annotated_node_excluded_from_graceful_disruption(self, env):
+        """Drift (graceful) is blocked by the annotation; removing it
+        restores the disruption."""
         run_pods(env, [Pod("p0", requests=Resources({"cpu": "200m"}))])
         node = env.cluster.list(Node)[0]
         node.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
         env.cluster.update(node)
-        env.clock.step(3601)
-        assert env.disruption.reconcile() == [], "annotated node must not be disrupted"
-        # removing the annotation restores disruption
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.user_data = "#!/bin/bash\necho v2"
+        env.cluster.update(nc)
+        env.nodeclass_controller.reconcile_all()
+        age_all_claims(env)
+        assert env.disruption.reconcile() == [], "annotated node must not drift-disrupt"
         del node.metadata.annotations["karpenter.sh/do-not-disrupt"]
         env.cluster.update(node)
+        decisions = env.disruption.reconcile()
+        assert decisions and decisions[0][1] == "Drifted"
+
+    def test_expiration_is_forceful_despite_annotation(self, env):
+        """Upstream lists Expiration among the forceful methods the
+        annotation does NOT exclude."""
+        pool = env.cluster.get(NodePool, "default")
+        pool.template.expire_after = 3600.0
+        env.cluster.update(pool)
+        run_pods(env, [Pod("px", requests=Resources({"cpu": "200m"}))])
+        node = env.cluster.list(Node)[0]
+        node.metadata.annotations["karpenter.sh/do-not-disrupt"] = "true"
+        env.cluster.update(node)
+        env.clock.step(3601)
         decisions = env.disruption.reconcile()
         assert decisions and decisions[0][1] == REASON_EXPIRED
 
