@@ -341,7 +341,18 @@ class DisruptionController:
             if c.do_not_disrupt:
                 continue
             drift = self._drift_reason(c)
-            if drift and self._all_pods_evictable(c.pods):
+            if not drift:
+                continue
+            # the evictability check ALWAYS runs for a drifted candidate so
+            # its pods charge the shared per-pass PDB guard -- a
+            # grace-period candidate that skipped accounting would let a
+            # later candidate double-book the same allowance and stall its
+            # drain. With a terminationGracePeriod on the claim, drift then
+            # proceeds even when the check fails (do-not-disrupt pods or
+            # exhausted budgets): the grace force-drain guarantees
+            # completion, exactly the upstream carve-out.
+            evictable = self._all_pods_evictable(c.pods)
+            if evictable or c.claim.termination_grace_period is not None:
                 if not self._budget_allows(c.nodepool, REASON_DRIFTED, disrupting, totals):
                     continue
                 c.claim.status_conditions.set_true(COND_DRIFTED, drift)

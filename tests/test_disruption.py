@@ -768,3 +768,26 @@ class TestNodeLevelDoNotDisrupt:
         env.cloud.send(spot_interruption_body(parse_instance_id(claim.provider_id)))
         env.interruption.reconcile()
         assert claim.deleting, "forceful interruption must ignore the annotation"
+
+
+class TestDriftWithGracePeriod:
+    """With terminationGracePeriod set on the claim, drift proceeds even
+    when pods block eviction (the upstream carve-out: the grace
+    force-drain guarantees the disruption completes)."""
+
+    def test_grace_period_unblocks_drift(self, env):
+        blocked = Pod("held", requests=Resources({"cpu": "200m"}),
+                      annotations={"karpenter.sh/do-not-disrupt": "true"})
+        run_pods(env, [blocked])
+        claim = [c for c in env.cluster.list(NodeClaim) if not c.deleting][0]
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.user_data = "#!/bin/bash\necho v3"
+        env.cluster.update(nc)
+        env.nodeclass_controller.reconcile_all()
+        age_all_claims(env)
+        # without a grace period the blocked pod holds drift off
+        assert env.disruption.reconcile() == []
+        claim.termination_grace_period = 120.0
+        env.cluster.update(claim)
+        decisions = env.disruption.reconcile()
+        assert decisions and decisions[0][1] == "Drifted"
