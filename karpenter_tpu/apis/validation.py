@@ -312,6 +312,29 @@ def validate_nodepool(pool) -> List[Violation]:
                         "must be an integer or a percentage between 0% and 100%",
                     )
                 )
+        # ref CEL: "'schedule' must be set with 'duration'"
+        if (getattr(b, "schedule", None) is None) != (getattr(b, "duration", None) is None):
+            out.append(
+                Violation(
+                    f"spec.disruption.budgets[{i}]",
+                    "'schedule' must be set with 'duration'",
+                )
+            )
+        sched = getattr(b, "schedule", None)
+        if sched is not None:
+            from karpenter_tpu.apis.nodepool import validate_cron
+
+            try:
+                validate_cron(sched)
+            except ValueError as e:
+                out.append(
+                    Violation(f"spec.disruption.budgets[{i}].schedule", str(e))
+                )
+        dur = getattr(b, "duration", None)
+        if dur is not None and dur <= 0:
+            out.append(
+                Violation(f"spec.disruption.budgets[{i}].duration", "must be positive")
+            )
     for field_name, taints in (
         ("taints", pool.template.taints),
         ("startupTaints", pool.template.startup_taints),

@@ -98,6 +98,8 @@ class DisruptionController:
         self._pass_catalogs: Optional[Dict[str, list]] = None
         self._pass_pdb_guard = None
         self._pass_daemon_overhead: Optional[Dict[str, Resources]] = None
+        # (budget id, minute) -> bool; bounded, cleared on overflow
+        self._budget_active_memo: Dict[tuple, bool] = {}
 
     # -- helpers ------------------------------------------------------------
     def _price_of(self, claim: NodeClaim) -> float:
@@ -156,9 +158,22 @@ class DisruptionController:
     def _budget_allows(self, pool: NodePool, reason: str, disrupting: Dict[str, int], totals: Dict[str, int]) -> bool:
         total = totals.get(pool.name, 0)
         current = disrupting.get(pool.name, 0)
+        now = self.cluster.clock.now()
         for budget in pool.disruption.budgets:
             if budget.reasons is not None and reason not in budget.reasons:
                 continue
+            # activity memoized per (budget, minute): the window scan walks
+            # duration/60 cron checks, and _budget_allows runs per
+            # candidate -- hundreds of candidates x a 24h window would be
+            # ~10^5 redundant parses per pass
+            akey = (id(budget), int(now // 60))
+            active = self._budget_active_memo.get(akey)
+            if active is None:
+                active = self._budget_active_memo[akey] = budget.active(now)
+                if len(self._budget_active_memo) > 256:
+                    self._budget_active_memo.clear()
+            if not active:
+                continue  # scheduled budget outside its window
             if current + 1 > budget.allowed(total):
                 return False
         return True

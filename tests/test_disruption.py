@@ -791,3 +791,46 @@ class TestDriftWithGracePeriod:
         env.cluster.update(claim)
         decisions = env.disruption.reconcile()
         assert decisions and decisions[0][1] == "Drifted"
+
+
+class TestScheduledBudgets:
+    """Disruption budgets with a cron schedule constrain ONLY inside
+    their window (occurrence within the trailing duration, UTC) -- the
+    nodepool CRD's schedule/duration semantics."""
+
+    def _expired_env(self, env, budget):
+        pool = env.cluster.get(NodePool, "default")
+        pool.template.expire_after = 3600.0
+        pool.disruption.budgets = [budget]
+        env.cluster.update(pool)
+        run_pods(env, [Pod("pb", requests=Resources({"cpu": "200m"}))])
+        env.clock.step(3601)
+
+    def test_zero_budget_blocks_inside_window(self, env):
+        # clock epoch 100_000 + steps; window = every minute of every hour
+        self._expired_env(env, Budget(nodes="0", schedule="* * * * *", duration=3600.0))
+        assert env.disruption.reconcile() == []
+
+    def test_zero_budget_ignored_outside_window(self, env):
+        import time as _time
+
+        now = env.clock.now() + 3601
+        t = _time.gmtime(now)
+        # a schedule that can never cover `now`: fires at another hour
+        # with a one-minute window
+        other_hour = (t.tm_hour + 6) % 24
+        self._expired_env(
+            env, Budget(nodes="0", schedule=f"0 {other_hour} * * *", duration=60.0)
+        )
+        decisions = env.disruption.reconcile()
+        assert decisions and decisions[0][1] == REASON_EXPIRED
+
+    def test_schedule_requires_duration_at_admission(self, env):
+        from karpenter_tpu.apis.validation import AdmissionError
+
+        pool = env.cluster.get(NodePool, "default")
+        pool.disruption.budgets = [Budget(nodes="1", schedule="0 9 * * *")]
+        with pytest.raises(AdmissionError):
+            env.cluster.update(pool)
+        pool.disruption.budgets = []
+        env.cluster.update(pool)

@@ -310,3 +310,28 @@ class TestPDBValidation:
 
         assert any("percent" in str(v) for v in validate_pdb(PodDisruptionBudget("s", min_available="5")))
         assert not validate_pdb(PodDisruptionBudget("i", min_available=5))
+
+
+class TestBudgetScheduleValidation:
+    """Cron syntax and duration positivity are enforced at admission --
+    a malformed schedule must never reach the reconcile loop (where the
+    budget fails closed, freezing disruption)."""
+
+    def test_malformed_cron_rejected(self):
+        from karpenter_tpu.apis import Budget, NodePool
+        from karpenter_tpu.apis.validation import validate_nodepool
+
+        for bad in ("@daily", "x x x x x", "30-5 * * * *", "70 * * * *"):
+            p = NodePool("p")
+            p.disruption.budgets = [Budget(nodes="1", schedule=bad, duration=60.0)]
+            assert any("schedule" in v.path for v in validate_nodepool(p)), bad
+
+    def test_valid_cron_and_positive_duration_admit(self):
+        from karpenter_tpu.apis import Budget, NodePool
+        from karpenter_tpu.apis.validation import validate_nodepool
+
+        p = NodePool("p")
+        p.disruption.budgets = [Budget(nodes="0", schedule="0 9 * * 1-5", duration=8 * 3600.0)]
+        assert not validate_nodepool(p)
+        p.disruption.budgets = [Budget(nodes="0", schedule="0 9 * * 1-5", duration=-1.0)]
+        assert any("duration" in v.path for v in validate_nodepool(p))
