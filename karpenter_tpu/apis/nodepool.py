@@ -114,7 +114,11 @@ class Budget:
         if self.schedule is None:
             return True
         if not self.duration:
-            return False  # schedule without duration never opens (CEL forbids it)
+            # schedule without duration is inadmissible (CEL) -- for a
+            # pre-validation object, fail CLOSED: before these fields were
+            # consulted such a budget always constrained, and a freeze
+            # must not silently lift on upgrade
+            return True
         import math
 
         # fail CLOSED on a malformed schedule that slipped past admission:

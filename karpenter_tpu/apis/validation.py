@@ -284,6 +284,28 @@ def _check_requirements(reqs, path: str, out: List[Violation]) -> None:
         key = getattr(r, "key", "")
         if not key:
             out.append(Violation(rpath, "requirement key may not be empty"))
+        mv = getattr(r, "min_values", None)
+        if mv is not None:
+            # ref CRD: minValues 1..50, meaningful only for the operators
+            # that admit an open or listed value set. Representation
+            # (requirements.py): In = values w/o complement; Exists =
+            # complement with NO values and no numeric window; NotIn =
+            # complement WITH values; DoesNotExist = no values, no
+            # complement.
+            if not (1 <= mv <= 50):
+                out.append(Violation(f"{rpath}.minValues", "must be between 1 and 50"))
+            is_in = (not r.complement) and bool(r.values)
+            is_exists = (
+                r.complement and not r.values
+                and r.greater_than is None and r.less_than is None
+            )
+            if not (is_in or is_exists):
+                out.append(
+                    Violation(
+                        f"{rpath}.minValues",
+                        "may only be set with the In or Exists operators",
+                    )
+                )
         if key == wk.NODEPOOL_LABEL:
             out.append(Violation(rpath, f"requirement key {key!r} is restricted"))
 

@@ -166,7 +166,7 @@ class DisruptionController:
             # duration/60 cron checks, and _budget_allows runs per
             # candidate -- hundreds of candidates x a 24h window would be
             # ~10^5 redundant parses per pass
-            akey = (id(budget), int(now // 60))
+            akey = (budget.schedule, budget.duration, int(now // 60))
             active = self._budget_active_memo.get(akey)
             if active is None:
                 active = self._budget_active_memo[akey] = budget.active(now)

@@ -203,8 +203,11 @@ class Provisioner:
         from karpenter_tpu.scheduling import Operator, Requirement
 
         reqs = group.requirements.copy()
-        type_names = [it.name for it in sorted(group.instance_types, key=lambda i: i.cheapest_price())]
-        reqs.add(Requirement(wk.INSTANCE_TYPE_LABEL, Operator.IN, type_names[:MAX_TYPES_PER_CLAIM]))
+        from karpenter_tpu.scheduling.requirements import truncate_preserving_min_values
+
+        by_price = sorted(group.instance_types, key=lambda i: i.cheapest_price())
+        kept = truncate_preserving_min_values(reqs, by_price, MAX_TYPES_PER_CLAIM)
+        reqs.add(Requirement(wk.INSTANCE_TYPE_LABEL, Operator.IN, [it.name for it in kept]))
         claim = NodeClaim(
             name=generate_name(f"{pool.name}-"),
             requirements=list(reqs),

@@ -92,7 +92,7 @@ class InstanceProvider:
         if not candidates:
             raise InsufficientCapacityError("all requested instance types were unavailable")
         capacity_type = self._capacity_type(candidates, reqs)
-        candidates = self._truncate(candidates, capacity_type)
+        candidates = self._truncate(candidates, capacity_type, claim)
         return self._launch(nodeclass, claim, candidates, capacity_type)
 
     def _capacity_type(self, items: Sequence[InstanceType], reqs: Requirements) -> str:
@@ -117,15 +117,23 @@ class InstanceProvider:
             return ct
         return wk.CAPACITY_TYPE_ON_DEMAND
 
-    def _truncate(self, items: Sequence[InstanceType], capacity_type: str) -> List[InstanceType]:
+    def _truncate(self, items: Sequence[InstanceType], capacity_type: str, claim=None) -> List[InstanceType]:
         """Cheapest-first truncation to 60 (reference sorts by price then
-        truncates, :242-270)."""
+        truncates, :242-270), preserving any minValues flexibility the
+        claim's requirements demand."""
 
         def price(it: InstanceType) -> float:
             ps = [o.price for o in it.available_offerings() if o.capacity_type == capacity_type]
             return min(ps) if ps else float("inf")
 
-        return sorted(items, key=price)[:MAX_INSTANCE_TYPES]
+        by_price = sorted(items, key=price)
+        if claim is not None:
+            from karpenter_tpu.scheduling import Requirements
+            from karpenter_tpu.scheduling.requirements import truncate_preserving_min_values
+
+            reqs = Requirements(claim.requirements)
+            return truncate_preserving_min_values(reqs, by_price, MAX_INSTANCE_TYPES)
+        return by_price[:MAX_INSTANCE_TYPES]
 
     def _overrides(
         self,

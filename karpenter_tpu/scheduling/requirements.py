@@ -312,3 +312,84 @@ class Requirements:
                 f"{k}|{r.complement}|{sorted(r.values)}|{r.greater_than}|{r.less_than};".encode()
             )
         return h.hexdigest()
+
+
+def min_values_shortfall(reqs: "Requirements", instance_types) -> Optional[str]:
+    """The first requirement key whose minValues flexibility is NOT met by
+    `instance_types` (fewer distinct label values than required), or None.
+    The karpenter v1 minValues contract: a NodeClaim must keep at least N
+    distinct values of the key among its candidate types, guaranteeing
+    launch flexibility."""
+    for r in reqs:
+        if r.min_values is None:
+            continue
+        distinct = {
+            it.requirements.labels().get(r.key)
+            for it in instance_types
+            if it.requirements.labels().get(r.key) is not None
+        }
+        if len(distinct) < r.min_values:
+            return r.key
+    return None
+
+
+def truncate_preserving_min_values(
+    reqs: "Requirements", types_sorted, cap: int
+):
+    """Truncate a cheapest-first type list to `cap`, keeping minValues
+    satisfied when the full list satisfies it: fill cheapest-first, then
+    for each unmet key swap in the cheapest remaining type contributing a
+    NEW value, evicting the most expensive chosen type whose removal
+    breaks nothing. Mirrors the reference's truncation honoring
+    spec.requirements[].minValues."""
+    chosen = list(types_sorted[:cap])
+    if len(types_sorted) <= cap:
+        return chosen
+    min_reqs = [r for r in reqs if r.min_values is not None]
+    if not min_reqs:
+        return chosen
+    rest = list(types_sorted[cap:])
+
+    def values_of(pool, key):
+        out = {}
+        for it in pool:
+            v = it.requirements.labels().get(key)
+            if v is not None:
+                out.setdefault(v, 0)
+                out[v] += 1
+        return out
+
+    for r in min_reqs:
+        have = values_of(chosen, r.key)
+        need = r.min_values - len(have)
+        if need <= 0:
+            continue
+        for it in rest:
+            if need <= 0:
+                break
+            v = it.requirements.labels().get(r.key)
+            if v is None or v in have:
+                continue
+            # evict the priciest chosen type that is not the last holder
+            # of any minValues-contributing value
+            evict_idx = None
+            for j in range(len(chosen) - 1, -1, -1):
+                cand = chosen[j]
+                safe = True
+                for r2 in min_reqs:
+                    v2 = cand.requirements.labels().get(r2.key)
+                    if v2 is not None:
+                        holders = values_of(chosen, r2.key)
+                        if holders.get(v2, 0) <= 1 and len(holders) <= r2.min_values:
+                            safe = False
+                            break
+                if safe:
+                    evict_idx = j
+                    break
+            if evict_idx is None:
+                break
+            chosen.pop(evict_idx)
+            chosen.append(it)
+            have[v] = 1
+            need -= 1
+    return chosen

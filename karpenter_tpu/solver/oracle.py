@@ -503,6 +503,10 @@ class Scheduler:
         ]
         if not survivors:
             return False
+        from karpenter_tpu.scheduling.requirements import min_values_shortfall
+
+        if min_values_shortfall(narrowed, survivors) is not None:
+            return False  # joining would shrink flexibility below minValues
         group.requirements = narrowed
         group.instance_types = survivors
         group.pods.append(pod)
@@ -672,9 +676,25 @@ class Scheduler:
                 for it in self.instance_types.get(pool.name, [])
                 if it.requirements.compatible(narrowed) and _fits_type(it, effective)
             ]
+            from karpenter_tpu.scheduling.requirements import min_values_shortfall
+
+            has_min_values = any(r.min_values is not None for r in narrowed)
+            if candidates and has_min_values:
+                # checked on the FULL candidate set, before any cost
+                # narrowing: minValues is a flexibility floor
+                short = min_values_shortfall(narrowed, candidates)
+                if short is not None:
+                    last_reason = (
+                        f"minValues requirement for {short} not met by nodepool {pool.name}"
+                    )
+                    continue
             if (
                 candidates
                 and self.objective == "price"
+                # minValues groups keep the full candidate set: the price
+                # envelope narrows types and would defeat the flexibility
+                # floor (availability beats cost, as with spread)
+                and not has_min_values
                 # hard-spread pods keep the full (max-fit) candidate set:
                 # spreading is an availability constraint and the batch
                 # solver marks spread sub-classes env_count = 0 (fit mode).

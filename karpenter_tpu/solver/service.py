@@ -196,6 +196,24 @@ class TPUSolver:
         # scan becomes ~60 class checks
         if classes is None:
             classes = encode.group_pods(pods)
+        # minValues flexibility is a set-cardinality constraint over a
+        # group's SURVIVING types -- stateful across joins, oracle-only.
+        # Scoped to pools some class could actually schedule to: a niche
+        # minValues pool behind taints/labels must not knock unrelated
+        # batches off the fast path
+        from karpenter_tpu.solver.oracle import _ALLOW_UNDEFINED
+
+        mv_pools = [
+            p for p in scheduler.nodepools
+            if any(r.min_values is not None for r in p.requirements())
+        ]
+        if mv_pools:
+            for pc in classes:
+                if any(
+                    p.requirements().compatible(pc.requirements, allow_undefined=_ALLOW_UNDEFINED)
+                    for p in mv_pools
+                ):
+                    return False
         reps = []
         any_spread = False
         for pc in classes:
@@ -203,6 +221,8 @@ class TPUSolver:
                 return False
             p = pc.pods[0]
             reps.append(p)
+            if any(r.min_values is not None for r in pc.requirements):
+                return False
             if any(t.hard() for t in p.topology_spread):
                 any_spread = True
         if any_spread:

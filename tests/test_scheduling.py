@@ -240,3 +240,160 @@ class TestAPITypes:
         nc.status_conditions.set_false(NODECLASS_CONDITIONS[0], "boom")
         nc.status_conditions.compute_root(NODECLASS_CONDITIONS)
         assert nc.status_conditions.is_false("Ready")
+
+
+class TestMinValues:
+    """spec.requirements[].minValues: a group must keep at least N distinct
+    values of the key among its candidate types (launch flexibility)."""
+
+    def _items(self):
+        from karpenter_tpu.apis import TPUNodeClass
+        from karpenter_tpu.apis.nodeclass import SubnetStatus
+        from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+        from karpenter_tpu.kwok.cloud import FakeCloud
+        from karpenter_tpu.providers.instancetype import gen_catalog
+        from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+        from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+        from karpenter_tpu.providers.instancetype.types import Resolver
+        from karpenter_tpu.providers.pricing import PricingProvider
+
+        cloud = FakeCloud()
+        prov = InstanceTypeProvider(
+            cloud, Resolver(gen_catalog.REGION),
+            OfferingsBuilder(
+                PricingProvider(cloud, cloud, gen_catalog.REGION), UnavailableOfferings(),
+                {z.name: z.zone_id for z in cloud.describe_zones()},
+            ),
+            UnavailableOfferings(),
+        )
+        nc = TPUNodeClass("default")
+        nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+        return prov.list(nc)
+
+    def test_shortfall_detection(self):
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+        from karpenter_tpu.scheduling.requirements import min_values_shortfall
+
+        items = self._items()
+        fam = wk.LABEL_INSTANCE_FAMILY
+        reqs = Requirements([Requirement(fam, Operator.EXISTS, min_values=3)])
+        assert min_values_shortfall(reqs, items) is None
+        one_family = [it for it in items if it.requirements.labels()[fam] == "m5"]
+        assert min_values_shortfall(reqs, one_family) == fam
+
+    def test_truncation_preserves_flexibility(self):
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.scheduling import Operator, Requirement, Requirements
+        from karpenter_tpu.scheduling.requirements import (
+            min_values_shortfall,
+            truncate_preserving_min_values,
+        )
+
+        items = sorted(self._items(), key=lambda it: it.cheapest_price())
+        fam = wk.LABEL_INSTANCE_FAMILY
+        families = sorted({it.requirements.labels()[fam] for it in items})
+        want = min(len(families), 8)
+        reqs = Requirements([Requirement(fam, Operator.EXISTS, min_values=want)])
+        # a cap small enough that naive cheapest-first might under-cover
+        kept = truncate_preserving_min_values(reqs, items, 10)
+        assert len(kept) <= 10
+        assert min_values_shortfall(reqs, kept) is None
+
+    def test_oracle_enforces_and_routes(self):
+        from karpenter_tpu.apis import NodePool, Pod, labels as wk
+        from karpenter_tpu.scheduling import Operator, Requirement, Resources
+        from karpenter_tpu.solver.oracle import Scheduler
+        from karpenter_tpu.solver.service import TPUSolver
+
+        items = self._items()
+        fam = wk.LABEL_INSTANCE_FAMILY
+        n_fam = len({it.requirements.labels()[fam] for it in items})
+        pod = Pod("flex", requests=Resources({"cpu": "500m", "memory": "1Gi"}))
+
+        def mk(minv):
+            pool = NodePool(
+                "default",
+                requirements=[Requirement(fam, Operator.EXISTS, min_values=minv)],
+            )
+            return pool, Scheduler(
+                nodepools=[pool], instance_types={"default": items},
+                zones={o.zone for it in items for o in it.available_offerings()},
+            )
+
+        pool, sched = mk(2)
+        assert not TPUSolver.supports(sched, [pod]), "minValues must route to oracle"
+        result = TPUSolver(g_max=64).schedule(sched, [pod])
+        assert not result.unschedulable
+        types = result.new_groups[0].instance_types
+        assert len({it.requirements.labels()[fam] for it in types}) >= 2
+
+        _, sched_impossible = mk(n_fam + 5)
+        result = TPUSolver(g_max=64).schedule(sched_impossible, [pod])
+        assert "flex" in result.unschedulable
+        assert "minValues" in result.unschedulable["flex"]
+
+    def test_validation(self):
+        from karpenter_tpu.apis import NodePool, labels as wk
+        from karpenter_tpu.apis.validation import validate_nodepool
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        p = NodePool("p", requirements=[
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Operator.EXISTS, min_values=0)
+        ])
+        assert any("minValues" in v.path for v in validate_nodepool(p))
+        p2 = NodePool("p2", requirements=[
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Operator.NOT_IN, ["m5"], min_values=2)
+        ])
+        assert any("minValues" in v.path for v in validate_nodepool(p2))
+
+    def test_exists_with_min_values_admits(self):
+        """Round-3 review blocker: the feature's primary configuration
+        (Exists + minValues) must pass admission, and DoesNotExist must
+        produce a violation, not a crash."""
+        from karpenter_tpu.apis import NodePool, labels as wk
+        from karpenter_tpu.apis.validation import validate_nodepool
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        ok = NodePool("ok", requirements=[
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Operator.EXISTS, min_values=3)
+        ])
+        assert not validate_nodepool(ok)
+        ok2 = NodePool("ok2", requirements=[
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Operator.IN, ["m5", "c4", "t4g"], min_values=2)
+        ])
+        assert not validate_nodepool(ok2)
+        bad = NodePool("bad", requirements=[
+            Requirement(wk.LABEL_INSTANCE_GPU_NAME, Operator.DOES_NOT_EXIST, min_values=1)
+        ])
+        assert any("minValues" in v.path for v in validate_nodepool(bad))
+
+    def test_routing_scoped_to_compatible_pools(self):
+        """A niche minValues pool that no pod in the batch can use must not
+        knock the batch off the device path."""
+        from karpenter_tpu.apis import NodePool, Pod, labels as wk
+        from karpenter_tpu.scheduling import Operator, Requirement, Resources, Taint
+        from karpenter_tpu.solver.oracle import Scheduler
+        from karpenter_tpu.solver.service import TPUSolver
+
+        items = self._items()
+        niche = NodePool(
+            "flex",
+            requirements=[
+                Requirement(wk.LABEL_INSTANCE_FAMILY, Operator.EXISTS, min_values=2),
+                Requirement(wk.ARCH_LABEL, Operator.IN, ["arm64"]),
+            ],
+        )
+        main = NodePool("main", weight=10)
+        pod = Pod(
+            "plain", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+            node_selector={wk.ARCH_LABEL: "amd64"},
+        )
+        sched = Scheduler(
+            nodepools=[main, niche],
+            instance_types={"main": items, "flex": items},
+            zones={o.zone for it in items for o in it.available_offerings()},
+        )
+        assert TPUSolver.supports(sched, [pod]), (
+            "arm64-gated minValues pool must not route an amd64 batch to the oracle"
+        )
