@@ -46,6 +46,11 @@ from karpenter_tpu.scheduling import resources as res
 from karpenter_tpu.solver.oracle import ExistingNode, Scheduler
 
 MIN_NODE_LIFETIME = 5 * 60.0  # consolidation waits for PVC binding etc.
+# spot->spot consolidation keeps at least this many cheaper instance-type
+# options on the replacement (upstream's flexibility minimum: replacing a
+# spot node with a single cheaper spot type would trade price for a much
+# higher re-interruption probability)
+MIN_TYPES_SPOT_TO_SPOT = 15
 
 REASON_EXPIRED = "Expired"
 REASON_DRIFTED = "Drifted"
@@ -641,14 +646,16 @@ class DisruptionController:
         any_spot = any(c.claim.capacity_type == wk.CAPACITY_TYPE_SPOT for c in cands)
         od_only = any_spot and not self.feature_gates.get("SpotToSpotConsolidation")
 
-        def group_price(g) -> float:
-            """Cheapest offering the group can actually LAUNCH: restricted
-            to the group's narrowed zone/captype requirements (a group whose
-            pods demand on-demand must not be priced at spot), and to
-            on-demand under the spot->spot gate."""
+        def group_price(g) -> tuple:
+            """(price, capacity type) of the cheapest offering the group
+            can actually LAUNCH: restricted to the group's narrowed
+            zone/captype requirements (a group whose pods demand on-demand
+            must not be priced at spot), and to on-demand under the
+            spot->spot gate."""
             zreq = g.requirements.get(wk.ZONE_LABEL)
             creq = g.requirements.get(wk.CAPACITY_TYPE_LABEL)
             best = float("inf")
+            best_ct = None
             for it in g.instance_types:
                 for o in it.available_offerings():
                     if zreq is not None and not zreq.matches(o.zone):
@@ -659,10 +666,50 @@ class DisruptionController:
                         continue
                     if o.price < best:
                         best = o.price
-            return best
+                        best_ct = o.capacity_type
+            return best, best_ct
 
-        cheapest_new = min(group_price(g) for g in groups)
-        return cheapest_new < sum(c.price for c in cands)
+        priced = [group_price(g) for g in groups]
+        cheapest_new = min(p for p, _ in priced)
+        budget = sum(c.price for c in cands)
+        if cheapest_new >= budget:
+            return False
+        if any_spot and not od_only:
+            # spot->spot ONLY: when the replacement would actually launch
+            # spot, it must keep >= 15 cheaper launchable spot options or
+            # the savings buy re-interruption churn. A spot->on-demand
+            # replacement (the group's cheapest launchable offering is
+            # OD, or its captype requirement forbids spot) is exempt.
+            def cheaper_spot_types(g) -> int:
+                zreq = g.requirements.get(wk.ZONE_LABEL)
+                creq = g.requirements.get(wk.CAPACITY_TYPE_LABEL)
+                n = 0
+                for it in g.instance_types:
+                    for o in it.available_offerings():
+                        if o.capacity_type != wk.CAPACITY_TYPE_SPOT:
+                            continue
+                        if creq is not None and not creq.matches(o.capacity_type):
+                            continue
+                        if zreq is not None and not zreq.matches(o.zone):
+                            continue
+                        if o.price < budget:
+                            n += 1
+                            break
+                return n
+
+            ok = False
+            for g, (price, ct) in zip(groups, priced):
+                if price >= budget:
+                    continue
+                if ct != wk.CAPACITY_TYPE_SPOT:
+                    ok = True  # spot -> on-demand: gate does not apply
+                    break
+                if cheaper_spot_types(g) >= MIN_TYPES_SPOT_TO_SPOT:
+                    ok = True
+                    break
+            if not ok:
+                return False
+        return True
 
     # -- execution ----------------------------------------------------------
     def _disrupt(self, c: Candidate, reason: str, disrupting: Dict[str, int]) -> None:

@@ -834,3 +834,59 @@ class TestScheduledBudgets:
             env.cluster.update(pool)
         pool.disruption.budgets = []
         env.cluster.update(pool)
+
+
+class TestSpotToSpotFlexibility:
+    """Spot->spot consolidation requires the replacement to keep at least
+    15 cheaper spot instance-type options (upstream's flexibility minimum
+    against re-interruption churn)."""
+
+    def _cand(self, env, price=1.0):
+        from karpenter_tpu.controllers.disruption import Candidate
+        from karpenter_tpu.apis import NodeClaim, Node
+
+        claim = NodeClaim("spot-claim")
+        claim.metadata.labels[wk.CAPACITY_TYPE_LABEL] = wk.CAPACITY_TYPE_SPOT
+        node = Node("spot-node")
+        pool = env.cluster.get(NodePool, "default")
+        return Candidate(claim=claim, node=node, nodepool=pool, pods=[],
+                         price=price, disruption_cost=0.0)
+
+    def _group(self, env, n_types):
+        from karpenter_tpu.solver.oracle import NewNodeGroup
+        from karpenter_tpu.scheduling import Requirements
+
+        items = env.cloud_provider.get_instance_types(env.cluster.get(NodePool, "default"))
+        spot_items = [
+            it for it in items
+            if any(o.capacity_type == wk.CAPACITY_TYPE_SPOT and o.price < 0.5
+                   for o in it.available_offerings())
+        ]
+        assert len(spot_items) >= 20, "catalog must offer enough cheap spot types"
+        return NewNodeGroup(
+            nodepool=env.cluster.get(NodePool, "default"),
+            requirements=Requirements(),
+            instance_types=spot_items[:n_types],
+            taints=[], pods=[],
+        )
+
+    def test_thin_spot_replacement_rejected(self, env):
+        env.tick()
+        env.disruption.feature_gates["SpotToSpotConsolidation"] = True
+        c = self._cand(env, price=1.0)
+        assert not env.disruption._replacement_cheaper(c, [self._group(env, 5)])
+        assert env.disruption._replacement_cheaper(c, [self._group(env, 18)])
+
+    def test_spot_to_on_demand_exempt_from_flexibility_gate(self, env):
+        """A replacement whose captype requirement forbids spot launches
+        on-demand: the 15-type spot gate must not block it."""
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        env.tick()
+        env.disruption.feature_gates["SpotToSpotConsolidation"] = True
+        c = self._cand(env, price=5.0)
+        g = self._group(env, 3)
+        g.requirements.add(
+            Requirement(wk.CAPACITY_TYPE_LABEL, Operator.IN, [wk.CAPACITY_TYPE_ON_DEMAND])
+        )
+        assert env.disruption._replacement_cheaper(c, [g])
