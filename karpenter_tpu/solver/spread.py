@@ -10,14 +10,17 @@ zone-pinned sub-classes carrying the exact per-zone pod counts the oracle's
 per-pod loop would produce, and the batched FFD solve (solver/ffd.py) then
 runs unchanged on the pinned sub-classes.
 
-Equivalence contract vs the oracle (tests/test_solver.py fuzz, 100+
-seeds): identical unschedulable sets, identical packing of non-spread
-classes, identical per-(selector, zone) spread distributions, identical
-existing-node totals. NOT contractual: which mixed group a spread pod
-shares with plain pods (and hence occasionally total group count by one in
-either direction) -- that pairing depends on the order zone narrowings
-land across classes mid-solve, which a pre-pass provably cannot observe;
-both outcomes are valid FFD placements of the same distribution.
+Equivalence contract vs the oracle (tests/test_solver.py fuzz, 200+
+seeds): for SPREAD-FREE batches, exact equality down to pod names. For
+batches with hard spread: identical unschedulable sets, identical
+per-(selector, zone) spread distributions, identical existing-node
+placement totals, and group count within one per spread selector. NOT
+contractual there: which mixed group a spread pod shares with plain pods
+-- a joining spread pod narrows the group's zone, shifting its surviving
+types and hence which plain classes share it; that pairing depends on the
+order narrowings land across classes mid-solve, which a pre-pass provably
+cannot observe. Both outcomes are valid FFD placements of the same
+distribution.
 
 Semantics mirrored from solver/oracle.py (greedy min-count spreading over
 feasible domains):
