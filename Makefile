@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark benchmark-interruption fuzz-extended e2e run docs-check docs verify-entry
+.PHONY: test deflake benchmark benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -44,3 +44,6 @@ benchmark-interruption:  ## interruption-queue tier at 100/1k/5k(/15k) messages
 
 fuzz-extended:  ## 101-seed differential sweep (device vs oracle, both objectives)
 	KARPENTER_TPU_FUZZ_EXTENDED=1 $(PYTEST) tests/test_solver.py -k FuzzExtended -q
+
+benchmark-consolidation:  ## consolidation decision-rate tier on the kwok rig
+	KARPENTER_TPU_PERF=1 $(PYTEST) tests/test_consolidation_bench.py -q -s
