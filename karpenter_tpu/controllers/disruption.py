@@ -629,6 +629,20 @@ class DisruptionController:
         pool_hash = c.claim.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION)
         if pool_hash is not None and pool_hash != c.nodepool.static_hash():
             return "NodePoolDrifted"
+        # dynamic requirement drift (the upstream NodeRequirement kind):
+        # requirements are deliberately OUTSIDE the static hash -- a pool
+        # whose requirements changed only drifts the claims whose concrete
+        # labels the CURRENT requirements no longer admit. Same machinery
+        # and absence semantics as scheduling compatibility everywhere
+        # else: only well-known labels may be undefined on the claim side,
+        # so a newly demanded custom label drifts pre-existing nodes.
+        from karpenter_tpu.scheduling import Requirements
+
+        labels = {**c.claim.metadata.labels, **c.node.metadata.labels}
+        if not Requirements.from_labels(labels).compatible(
+            c.nodepool.requirements(), allow_undefined=wk.WELL_KNOWN_LABELS
+        ):
+            return "NodeRequirementDrifted"
         try:
             return self.cloud_provider.is_drifted(c.claim)
         except CloudError:

@@ -890,3 +890,45 @@ class TestSpotToSpotFlexibility:
             Requirement(wk.CAPACITY_TYPE_LABEL, Operator.IN, [wk.CAPACITY_TYPE_ON_DEMAND])
         )
         assert env.disruption._replacement_cheaper(c, [g])
+
+
+class TestRequirementDrift:
+    """Dynamic requirement drift: a pool whose requirements changed drifts
+    exactly the claims whose concrete labels the CURRENT requirements no
+    longer admit (requirements live outside the static hash)."""
+
+    def test_narrowed_pool_requirements_drift_incompatible_claims(self, env):
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        run_pods(env, [Pod("p0", requests=Resources({"cpu": "200m"}))])
+        claims = [c for c in env.cluster.list(NodeClaim) if not c.deleting]
+        node = env.cluster.node_for_nodeclaim(claims[0])
+        arch = node.metadata.labels[wk.ARCH_LABEL]
+        other = "arm64" if arch == "amd64" else "amd64"
+        pool = env.cluster.get(NodePool, "default")
+
+        # still-compatible narrowing: no drift
+        pool.template.requirements = [Requirement(wk.ARCH_LABEL, Operator.IN, [arch, other])]
+        env.cluster.update(pool)
+        age_all_claims(env)
+        assert env.disruption.reconcile() == []
+
+        # incompatible narrowing: the claim drifts and is replaced
+        pool.template.requirements = [Requirement(wk.ARCH_LABEL, Operator.IN, [other])]
+        env.cluster.update(pool)
+        decisions = env.disruption.reconcile()
+        assert decisions and decisions[0][1] == "Drifted"
+
+    def test_newly_demanded_custom_label_drifts_old_nodes(self, env):
+        """A pool that starts requiring a custom label drifts nodes
+        launched before the change (absence is only permissive for
+        well-known labels)."""
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        run_pods(env, [Pod("p1", requests=Resources({"cpu": "200m"}))])
+        pool = env.cluster.get(NodePool, "default")
+        pool.template.requirements = [Requirement("team", Operator.IN, ["ml"])]
+        env.cluster.update(pool)
+        age_all_claims(env)
+        decisions = env.disruption.reconcile()
+        assert decisions and decisions[0][1] == "Drifted"
