@@ -1,0 +1,34 @@
+#!/bin/bash
+# Patient TPU bench capture: probe the axon tunnel in a loop; the moment it
+# answers, run the full benchmark and save the JSON + profile log. Exits 0
+# on a successful non-degraded TPU capture; keeps trying otherwise.
+cd /root/repo
+OUT=BENCH_TPU_CAPTURE.json
+LOG=BENCH_TPU_CAPTURE.log
+for i in $(seq 1 200); do
+  echo "[capture] probe attempt $i $(date -u +%H:%M:%S)" >> "$LOG"
+  if timeout 150 python -c "
+import jax
+d = jax.devices()
+import jax.numpy as jnp
+x = jnp.arange(8.0)
+assert float((x * 2).sum()) == 56.0
+print('BACKEND=' + jax.default_backend())
+" >> "$LOG" 2>&1; then
+    echo "[capture] tunnel up, running bench $(date -u +%H:%M:%S)" >> "$LOG"
+    if timeout 2400 python bench.py --profile > "$OUT.tmp" 2>> "$LOG"; then
+      if grep -q '"platform": "tpu"' "$OUT.tmp" && ! grep -q '"degraded"' "$OUT.tmp"; then
+        mv "$OUT.tmp" "$OUT"
+        echo "[capture] SUCCESS $(date -u +%H:%M:%S)" >> "$LOG"
+        exit 0
+      fi
+      echo "[capture] bench ran but degraded/non-tpu; retrying" >> "$LOG"
+      cat "$OUT.tmp" >> "$LOG"
+    else
+      echo "[capture] bench timed out or failed" >> "$LOG"
+    fi
+  fi
+  sleep 90
+done
+echo "[capture] gave up after 200 attempts" >> "$LOG"
+exit 1

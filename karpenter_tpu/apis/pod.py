@@ -105,29 +105,42 @@ class Pod(APIObject):
             self._spec_refs = None
             self._spec_token = None
         else:
+            # pin the containers AND their current elements: the token
+            # carries per-element ids, and an id is only a sound identity
+            # while the object it named is alive (CPython reuses freed
+            # addresses; a replaced-then-freed element could otherwise
+            # alias a new element's id)
             self._spec_refs = (
                 requests, node_selector, node_affinity_terms, tolerations,
                 affinity_terms, preferred_node_affinity_terms,
+                tuple(tolerations), tuple(node_affinity_terms),
+                tuple(affinity_terms), tuple(preferred_node_affinity_terms),
             )
             # the node_selector fingerprint is its FULL sorted content: a
             # caller that mutates one dict between constructions (e.g.
             # sel['zone'] = z in a loop, any key) reuses the id but changes
-            # the fingerprint, so the pods do not falsely share a token.
-            # Construction is off the scheduling-latency path, so the
-            # sorted-items cost lands on watch ingestion, not the solve.
-            # In-place ELEMENT mutation of the list args (tolerations /
-            # affinity term objects) remains undetected -- the same
-            # spec-immutability doctrine the _group_sig memo already
-            # relies on; the length guards catch append/remove reuse.
+            # the fingerprint, so the pods do not falsely share a token
+            # (dict values are strings, so content covers the dict fully).
+            # The list args carry per-ELEMENT id tuples: swapping, adding,
+            # removing, or replacing an element between constructions
+            # changes the tuple, so those pods do not falsely share either
+            # -- the same realistic reuse pattern the node_selector case
+            # covers. Construction is off the scheduling-latency path, so
+            # the fingerprint cost lands on watch ingestion, not the solve.
+            # The one remaining doctrine hole is mutating an element
+            # OBJECT's attributes in place between constructions (e.g.
+            # toleration.key = x on a shared Toleration) -- the same
+            # spec-immutability assumption the _group_sig memo already
+            # relies on, now uniform across every pinned container.
             ns_fp = tuple(sorted(node_selector.items())) if node_selector else ()
             self._spec_token = (
                 id(requests), id(node_selector), id(node_affinity_terms),
                 id(tolerations), id(affinity_terms), id(preferred_node_affinity_terms),
                 ns_fp,
-                len(tolerations) if tolerations else 0,
-                len(node_affinity_terms) if node_affinity_terms else 0,
-                len(affinity_terms) if affinity_terms else 0,
-                len(preferred_node_affinity_terms) if preferred_node_affinity_terms else 0,
+                tuple(map(id, tolerations)) if tolerations else (),
+                tuple(map(id, node_affinity_terms)) if node_affinity_terms else (),
+                tuple(map(id, affinity_terms)) if affinity_terms else (),
+                tuple(map(id, preferred_node_affinity_terms)) if preferred_node_affinity_terms else (),
             )
 
     def grouping_signature(self) -> tuple:

@@ -183,7 +183,7 @@ class DisruptionController:
                 return False
         return True
 
-    def _all_pods_evictable(self, pods: Sequence[Pod]) -> bool:
+    def _all_pods_evictable(self, pods: Sequence[Pod], charge_always: bool = False) -> bool:
         """Every pod is controller-replaced, consented (no do-not-disrupt),
         AND currently evictable under its PodDisruptionBudgets -- a node
         whose drain would immediately stall on an exhausted budget is not
@@ -193,9 +193,15 @@ class DisruptionController:
         per-call guards would let several nodes sharing one allowance all
         pass candidacy and then jointly stall the drain; the shared guard
         consumes allowance across candidates exactly as the drains will.
-        Scan cost amortizes the same way (one PDB/pod sweep per pass)."""
-        if not all(p.reschedulable() for p in pods):
-            return False
+        Accounting is ATOMIC per candidate (try_evict_all): a rejected
+        candidate consumes nothing, so it cannot block a sibling node
+        sharing the same budget (ADVICE round 3). With charge_always (the
+        terminationGracePeriod carve-out, where the caller force-drains
+        regardless of the verdict) a failing candidate still charges its
+        pods, so a later candidate cannot double-book allowance the forced
+        drain will consume; the charge is conservative when a downstream
+        gate (disruption budget, failed simulation) then skips the drift
+        -- siblings just defer to the next pass."""
         from karpenter_tpu.controllers.pdb_guard import PDBGuard
 
         if self._pass_pools is not None:
@@ -206,7 +212,11 @@ class DisruptionController:
         else:
             # helper called directly (tests): fresh snapshot
             guard = PDBGuard(self.cluster)
-        return all(guard.try_evict(p) for p in pods)
+        if all(p.reschedulable() for p in pods):
+            return guard.try_evict_all(pods, charge_on_fail=charge_always)
+        if charge_always:
+            guard.charge(pods)
+        return False
 
     # -- simulation ---------------------------------------------------------
     def _other_nodes(self, excluded: Sequence[str]) -> List[ExistingNode]:
@@ -363,16 +373,16 @@ class DisruptionController:
             drift = self._drift_reason(c)
             if not drift:
                 continue
-            # the evictability check ALWAYS runs for a drifted candidate so
-            # its pods charge the shared per-pass PDB guard -- a
-            # grace-period candidate that skipped accounting would let a
-            # later candidate double-book the same allowance and stall its
-            # drain. With a terminationGracePeriod on the claim, drift then
-            # proceeds even when the check fails (do-not-disrupt pods or
-            # exhausted budgets): the grace force-drain guarantees
-            # completion, exactly the upstream carve-out.
-            evictable = self._all_pods_evictable(c.pods)
-            if evictable or c.claim.termination_grace_period is not None:
+            # With a terminationGracePeriod on the claim, drift proceeds
+            # even when the evictability check fails (do-not-disrupt pods
+            # or exhausted budgets): the grace force-drain guarantees
+            # completion, exactly the upstream carve-out. charge_always
+            # makes that forced drain's pods charge the shared per-pass
+            # PDB guard even on a failing verdict, so a later candidate
+            # cannot double-book the same allowance and stall its drain.
+            has_grace = c.claim.termination_grace_period is not None
+            evictable = self._all_pods_evictable(c.pods, charge_always=has_grace)
+            if evictable or has_grace:
                 if not self._budget_allows(c.nodepool, REASON_DRIFTED, disrupting, totals):
                     continue
                 c.claim.status_conditions.set_true(COND_DRIFTED, drift)
@@ -684,17 +694,27 @@ class DisruptionController:
             return best, best_ct
 
         priced = [group_price(g) for g in groups]
-        cheapest_new = min(p for p, _ in priced)
+        if any(p == float("inf") for p, _ in priced):
+            return False  # a group with no launchable offering cannot be priced
+        total_new = sum(p for p, _ in priced)
         budget = sum(c.price for c in cands)
-        if cheapest_new >= budget:
+        # the SUM of the replacement groups' launch prices must beat the
+        # candidate set's aggregate -- comparing only the cheapest group
+        # against the full budget (the pre-r4 check) let a multi-group
+        # replacement whose total exceeded the candidates' pass (ADVICE
+        # round 3)
+        if total_new >= budget:
             return False
         if any_spot and not od_only:
-            # spot->spot ONLY: when the replacement would actually launch
-            # spot, it must keep >= 15 cheaper launchable spot options or
-            # the savings buy re-interruption churn. A spot->on-demand
-            # replacement (the group's cheapest launchable offering is
-            # OD, or its captype requirement forbids spot) is exempt.
-            def cheaper_spot_types(g) -> int:
+            # spot->spot: EVERY group whose cheapest launchable offering is
+            # spot must keep >= 15 cheaper launchable spot options, or the
+            # savings buy re-interruption churn; one well-diversified group
+            # must not ungate its siblings. "Cheaper" is judged against the
+            # group's RESIDUAL budget (candidate-set price minus what the
+            # other groups cost), not the aggregate -- for single-node
+            # consolidation this is exactly the candidate node's price.
+            # Groups launching on-demand are exempt.
+            def cheaper_spot_types(g, target: float) -> int:
                 zreq = g.requirements.get(wk.ZONE_LABEL)
                 creq = g.requirements.get(wk.CAPACITY_TYPE_LABEL)
                 n = 0
@@ -706,23 +726,17 @@ class DisruptionController:
                             continue
                         if zreq is not None and not zreq.matches(o.zone):
                             continue
-                        if o.price < budget:
+                        if o.price < target:
                             n += 1
                             break
                 return n
 
-            ok = False
             for g, (price, ct) in zip(groups, priced):
-                if price >= budget:
-                    continue
                 if ct != wk.CAPACITY_TYPE_SPOT:
-                    ok = True  # spot -> on-demand: gate does not apply
-                    break
-                if cheaper_spot_types(g) >= MIN_TYPES_SPOT_TO_SPOT:
-                    ok = True
-                    break
-            if not ok:
-                return False
+                    continue  # spot -> on-demand: gate does not apply
+                residual = budget - (total_new - price)
+                if cheaper_spot_types(g, residual) < MIN_TYPES_SPOT_TO_SPOT:
+                    return False
         return True
 
     # -- execution ----------------------------------------------------------

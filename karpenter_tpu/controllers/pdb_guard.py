@@ -37,14 +37,41 @@ class PDBGuard:
         """Consume allowance from every matching PDB; False (and no
         consumption) when any budget is exhausted -- the eviction API's
         429 path."""
-        matching = [p for p in self._pdbs if p.matches(pod)]
-        exhausted = [p.metadata.name for p in matching if self._remaining[p.metadata.name] < 1]
-        if exhausted:
-            self.log.debug(
-                "eviction deferred by disruption budget",
-                pod=pod.metadata.name, budgets=exhausted,
-            )
-            return False
-        for p in matching:
-            self._remaining[p.metadata.name] -= 1
-        return True
+        return self.try_evict_all([pod])
+
+    def try_evict_all(self, pods, charge_on_fail: bool = False) -> bool:
+        """Atomic candidacy check: either EVERY pod's eviction is
+        admissible and all allowances are consumed, or nothing is
+        consumed. A per-pod try_evict loop that short-circuits on the
+        first refusal leaves partial consumption behind, wrongly blocking
+        sibling candidates whose pods share the same budget (ADVICE
+        round 3). With charge_on_fail (the terminationGracePeriod
+        force-drain carve-out, where the caller drains regardless of the
+        verdict) a failing set still consumes its allowance -- possibly
+        past exhaustion -- so later candidates in the pass see it spent."""
+        needed = self._needed(pods)
+        short = [name for name, n in needed.items() if self._remaining[name] < n]
+        ok = not short
+        if short:
+            self.log.debug("candidacy deferred by disruption budget", budgets=short)
+        if ok or charge_on_fail:
+            for name, n in needed.items():
+                self._remaining[name] -= n
+        return ok
+
+    def charge(self, pods) -> None:
+        """Unconditionally consume allowance (may go negative) without a
+        verdict -- the force-drain accounting for a candidate that never
+        reached the atomic check (e.g. failed reschedulability first)."""
+        for name, n in self._needed(pods).items():
+            self._remaining[name] -= n
+
+    def _needed(self, pods) -> Dict[str, int]:
+        """Allowances the eviction of `pods` consumes, per matching PDB --
+        the one matching sweep both try_evict_all and charge rely on."""
+        needed: Dict[str, int] = {}
+        for pod in pods:
+            for p in self._pdbs:
+                if p.matches(pod):
+                    needed[p.metadata.name] = needed.get(p.metadata.name, 0) + 1
+        return needed

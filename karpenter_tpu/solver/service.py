@@ -168,6 +168,17 @@ class TPUSolver:
             return
         self._warm_entry(self._catalog(instance_types), c_pads)
 
+    @staticmethod
+    def _warm_key(c_pad: int, entry: "_CatalogEntry") -> tuple:
+        """Warm-coverage key. jit caches by static arguments AND input
+        shapes, so 'this c_pad is compiled' is only true per catalog
+        geometry: after a catalog refresh changes k_pad or the packed-word
+        layout, old-coverage pads dispatch an uncompiled program. Keying by
+        (c_pad, k_pad, offsets, words) makes the unwarmed-bucket log fire
+        for exactly the dispatches that will actually compile (ADVICE
+        round 3)."""
+        return (c_pad, entry.tensors.k_pad, entry.offsets, entry.words)
+
     def _warm_entry(self, entry: "_CatalogEntry", c_pads: Sequence[int] = WARM_C_PADS) -> None:
         """Compile from a pinned snapshot: the warm thread must never
         re-stage (its catalog may already be stale by the time it runs)."""
@@ -181,7 +192,12 @@ class TPUSolver:
                     word_offsets=entry.offsets, words=entry.words, objective=self.objective,
                 )
             )
-            self._warmed_pads.add(cp)
+            self._warmed_pads.add(self._warm_key(cp, entry))
+        # geometry-keyed entries accumulate across catalog refreshes while
+        # _catalog_cache is LRU-capped; bound the set rather than track
+        # eviction (a cleared key merely re-fires the unwarmed-bucket log)
+        if len(self._warmed_pads) > 128:
+            self._warmed_pads.clear()
         jax.block_until_ready(outs)
 
     # -- routing ------------------------------------------------------------
@@ -441,10 +457,11 @@ class TPUSolver:
         counts = class_set.count.copy()
         counts[: len(classes)] -= placed_existing.astype(counts.dtype)
         class_set.count = counts
+        warm_key = self._warm_key(class_set.c_pad, entry)
         if (
             self._warmed_pads
-            and class_set.c_pad not in self._warmed_pads
-            and self._route_monitor.has_changed("unwarmed_c_pad", class_set.c_pad)
+            and warm_key not in self._warmed_pads
+            and self._route_monitor.has_changed("unwarmed_c_pad", warm_key)
         ):
             # the tick will pay a one-off XLA compile for this bucket; say
             # so instead of leaving an unexplained latency spike in the logs

@@ -635,6 +635,75 @@ class TestPodDisruptionBudgets:
             "termination grace expiry must force the drain through the PDB"
         )
 
+    def test_try_evict_all_is_atomic(self, env):
+        """A candidate rejected by the guard consumes NOTHING: partial
+        consumption from a short-circuited per-pod loop would wrongly
+        block a sibling node sharing the same budget (ADVICE round 3)."""
+        from karpenter_tpu.apis import PodDisruptionBudget
+        from karpenter_tpu.controllers.pdb_guard import PDBGuard
+
+        pods = self._web_pods(env, 5)
+        bound = [p for p in pods if p.node_name]
+        assert len(bound) == 5
+        env.cluster.create(
+            PodDisruptionBudget("web-pdb", selector={"app": "web"}, max_unavailable=2)
+        )
+        guard = PDBGuard(env.cluster)
+        # 3 pods need 3 allowances against a budget of 2: rejected, AND
+        # nothing consumed -- the 2-pod sibling still qualifies
+        assert not guard.try_evict_all(bound[:3])
+        assert guard.try_evict_all(bound[3:5])
+        # the budget is now genuinely spent
+        assert not guard.try_evict_all([bound[0]])
+
+    def test_charge_spends_allowance_unconditionally(self, env):
+        """charge() (the terminationGracePeriod force-drain accounting)
+        consumes allowance even past exhaustion, so later candidates in
+        the pass see it spent."""
+        from karpenter_tpu.apis import PodDisruptionBudget
+        from karpenter_tpu.controllers.pdb_guard import PDBGuard
+
+        pods = self._web_pods(env, 4)
+        bound = [p for p in pods if p.node_name]
+        env.cluster.create(
+            PodDisruptionBudget("web-pdb", selector={"app": "web"}, max_unavailable=2)
+        )
+        guard = PDBGuard(env.cluster)
+        guard.charge(bound[:2])
+        assert not guard.try_evict_all([bound[2]])
+
+    def test_grace_candidate_charges_guard_on_failed_verdict(self, env):
+        """_all_pods_evictable(charge_always=True): a grace-period
+        candidate failing evictability (do-not-disrupt pod) still charges
+        its evictable pods, so a sibling candidate cannot double-book the
+        allowance the forced drain will consume (ADVICE round 3)."""
+        from karpenter_tpu.apis import PodDisruptionBudget
+        from karpenter_tpu.apis.pod import DO_NOT_DISRUPT_ANNOTATION
+
+        pods = self._web_pods(env, 4)
+        bound = [p for p in pods if p.node_name]
+        assert len(bound) == 4
+        bound[0].metadata.annotations[DO_NOT_DISRUPT_ANNOTATION] = "true"
+        env.cluster.create(
+            PodDisruptionBudget("web-pdb", selector={"app": "web"}, max_unavailable=2)
+        )
+        # simulate one pass: shared guard
+        env.disruption._pass_pools = [env.cluster.get(NodePool, "default")]
+        env.disruption._pass_catalogs = {}
+        env.disruption._pass_pdb_guard = None
+        try:
+            # grace candidate with 2 budgeted pods, one do-not-disrupt:
+            # verdict False, but both pods charge the shared guard
+            assert not env.disruption._all_pods_evictable(
+                bound[:2], charge_always=True
+            )
+            # a sibling trying to use the same allowance is refused
+            assert not env.disruption._all_pods_evictable(bound[2:4])
+        finally:
+            env.disruption._pass_pools = None
+            env.disruption._pass_catalogs = None
+            env.disruption._pass_pdb_guard = None
+
     def test_shared_allowance_admits_one_candidate_per_pass(self, env):
         """One maxUnavailable=1 PDB spanning pods on TWO nodes: a single
         disruption pass may take at most ONE of them (per-pass guard
@@ -890,6 +959,83 @@ class TestSpotToSpotFlexibility:
             Requirement(wk.CAPACITY_TYPE_LABEL, Operator.IN, [wk.CAPACITY_TYPE_ON_DEMAND])
         )
         assert env.disruption._replacement_cheaper(c, [g])
+
+    def _synth_group(self, env, prefix, n_types, spot_price=None, prices=None):
+        """A replacement group over synthetic instance types, each with ONE
+        spot offering at a controlled price -- price-band tests need exact
+        prices the generated catalog cannot guarantee. Pass `prices` for a
+        heterogeneous per-type price list (residual-band tests need options
+        priced ABOVE the group's cheapest launchable offering)."""
+        from karpenter_tpu.providers.instancetype.types import InstanceType, Offering
+        from karpenter_tpu.scheduling import Requirements, Resources
+        from karpenter_tpu.solver.oracle import NewNodeGroup
+
+        if prices is None:
+            prices = [spot_price] * n_types
+        items = [
+            InstanceType(
+                name=f"{prefix}-{i}",
+                requirements=Requirements(),
+                capacity=Resources({"cpu": "4", "memory": "8Gi"}),
+                overhead=Resources({}),
+                offerings=[
+                    Offering(wk.CAPACITY_TYPE_SPOT, "zone-a", "za1", p)
+                ],
+            )
+            for i, p in enumerate(prices)
+        ]
+        return NewNodeGroup(
+            nodepool=env.cluster.get(NodePool, "default"),
+            requirements=Requirements(), instance_types=items, taints=[], pods=[],
+        )
+
+    def test_every_spot_group_must_satisfy_flexibility(self, env):
+        """Multi-group replacement: ONE well-diversified spot group must not
+        ungate a thin sibling (ADVICE round 3) -- every group whose cheapest
+        launchable offering is spot needs the 15-type floor."""
+        env.tick()
+        env.disruption.feature_gates["SpotToSpotConsolidation"] = True
+        cands = [self._cand(env, price=1.0), self._cand(env, price=1.0)]
+        rich = self._synth_group(env, "rich", 18, spot_price=0.2)
+        thin = self._synth_group(env, "thin", 5, spot_price=0.2)
+        assert not env.disruption._replacement_cheaper(cands, [rich, thin])
+        rich2 = self._synth_group(env, "rich2", 18, spot_price=0.2)
+        assert env.disruption._replacement_cheaper(cands, [rich, rich2])
+
+    def test_flexibility_counted_against_residual_budget(self, env):
+        """'Cheaper' spot options are judged against the group's RESIDUAL
+        budget (candidate-set price minus the other groups' launch prices),
+        not the aggregate: options priced between the residual and the
+        aggregate must NOT count toward the 15-type floor (ADVICE round 3).
+        The groups here launch cheap (total 1.5 < budget 2.0, so the
+        total-price gate passes) while 17 of the thin group's 18 options
+        sit at 0.9 -- under the residual 0.6, over nothing else."""
+        env.tick()
+        env.disruption.feature_gates["SpotToSpotConsolidation"] = True
+        cands = [self._cand(env, price=1.5), self._cand(env, price=0.5)]
+        # sibling launches at 1.4 -> the other group's residual is
+        # 2.0 - 1.4 = 0.6; sibling's own 18 options at 1.4 < its residual
+        # 1.9, so sibling itself passes the floor
+        sibling = self._synth_group(env, "sib", 18, spot_price=1.4)
+        # cheapest launchable 0.1 (so total_new = 1.5 < 2.0), but only
+        # that ONE option beats the 0.6 residual; the 17 at 0.9 beat the
+        # aggregate 2.0 only -- the pre-r4 aggregate comparison passed this
+        over = self._synth_group(env, "over", 18, prices=[0.1] + [0.9] * 17)
+        assert not env.disruption._replacement_cheaper(cands, [sibling, over])
+        # same shape with the 17 options under the residual passes
+        under = self._synth_group(env, "under", 18, prices=[0.1] + [0.5] * 17)
+        assert env.disruption._replacement_cheaper(cands, [sibling, under])
+
+    def test_replacement_total_price_must_beat_candidate_sum(self, env):
+        """The SUM of the replacement groups' launch prices gates the
+        consolidation, not just the cheapest group (ADVICE round 3)."""
+        env.tick()
+        env.disruption.feature_gates["SpotToSpotConsolidation"] = True
+        cands = [self._cand(env, price=1.0), self._cand(env, price=1.0)]
+        cheap = self._synth_group(env, "cheap", 18, spot_price=0.4)
+        pricey = self._synth_group(env, "pricey", 18, spot_price=1.8)
+        # cheapest group (0.4) beats the 2.0 budget, but the pair costs 2.2
+        assert not env.disruption._replacement_cheaper(cands, [cheap, pricey])
 
 
 class TestRequirementDrift:

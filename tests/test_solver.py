@@ -584,6 +584,65 @@ class TestNativeGrouping:
 
 
 
+class TestSpecTokenFingerprint:
+    """The shared-spec grouping token must not falsely merge pods whose
+    caller reused a spec container but changed its CONTENT between
+    constructions (ADVICE round 3: element swap keeping length was
+    undetected while node_selector mutation was caught)."""
+
+    def _req(self):
+        from karpenter_tpu.scheduling import Resources
+
+        return Resources({"cpu": "100m"})
+
+    def test_identical_shared_spec_shares_token(self):
+        from karpenter_tpu.apis import Pod
+        from karpenter_tpu.scheduling import Toleration
+
+        req = self._req()
+        tol = [Toleration(key="a", operator="Exists")]
+        p1 = Pod("p1", requests=req, tolerations=tol)
+        p2 = Pod("p2", requests=req, tolerations=tol)
+        assert p1._spec_token == p2._spec_token
+
+    def test_node_selector_value_mutation_splits_token(self):
+        from karpenter_tpu.apis import Pod
+
+        req = self._req()
+        sel = {"topology.kubernetes.io/zone": "us-central-1a"}
+        p1 = Pod("p1", requests=req, node_selector=sel)
+        sel["topology.kubernetes.io/zone"] = "us-central-1b"
+        p2 = Pod("p2", requests=req, node_selector=sel)
+        assert p1._spec_token != p2._spec_token
+
+    def test_element_swap_keeping_length_splits_token(self):
+        from karpenter_tpu.apis import Pod
+        from karpenter_tpu.scheduling import Toleration
+        from karpenter_tpu.solver import encode
+
+        req = self._req()
+        tol = [Toleration(key="a", operator="Exists")]
+        p1 = Pod("p1", requests=req, tolerations=tol)
+        tol[0] = Toleration(key="b", operator="Exists")
+        p2 = Pod("p2", requests=req, tolerations=tol)
+        assert p1._spec_token != p2._spec_token, (
+            "same-length element swap must change the token"
+        )
+        classes = encode.group_pods([p1, p2])
+        assert len(classes) == 2, "swapped-element pods must not merge"
+
+    def test_affinity_term_swap_splits_token(self):
+        from karpenter_tpu.apis import Pod
+        from karpenter_tpu.scheduling import Operator, Requirement
+
+        req = self._req()
+        terms = [[Requirement("topology.kubernetes.io/zone", Operator.IN, ["us-central-1a"])]]
+        p1 = Pod("p1", requests=req, node_affinity_terms=terms)
+        terms[0] = [Requirement("topology.kubernetes.io/zone", Operator.IN, ["us-central-1b"])]
+        p2 = Pod("p2", requests=req, node_affinity_terms=terms)
+        assert p1._spec_token != p2._spec_token
+
+
 class TestDaemonSetOverhead:
     """Fresh-node sizing reserves daemonset overhead (reference: the core
     sizes every simulated node with the daemonsets that will land on it;
