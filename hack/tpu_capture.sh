@@ -17,7 +17,8 @@ print('BACKEND=' + jax.default_backend())
 " >> "$LOG" 2>&1; then
     echo "[capture] tunnel up, running bench $(date -u +%H:%M:%S)" >> "$LOG"
     if timeout 2400 python bench.py --profile > "$OUT.tmp" 2>> "$LOG"; then
-      if grep -q '"platform": "tpu"' "$OUT.tmp" && ! grep -q '"degraded"' "$OUT.tmp"; then
+      if ! grep -q '"platform": "cpu"' "$OUT.tmp" && grep -q '"platform"' "$OUT.tmp" \
+         && ! grep -q '"degraded"' "$OUT.tmp" && ! grep -q '"partial"' "$OUT.tmp"; then
         mv "$OUT.tmp" "$OUT"
         echo "[capture] SUCCESS $(date -u +%H:%M:%S)" >> "$LOG"
         exit 0

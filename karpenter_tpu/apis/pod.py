@@ -98,49 +98,44 @@ class Pod(APIObject):
         # batch grouper (solver/encode.group_pods) then runs its expensive
         # structural path once per distinct token instead of once per pod --
         # the difference between ~180 ms and ~20 ms for a 50k-pod cold tick.
-        # Pods with topology spread constraints are excluded (their grouping
-        # identity also depends on metadata.labels matching the constraint's
-        # selector, which is per-pod); they take the signature path.
-        if topology_spread:
+        # Excluded from the token fast path, taking the (per-pod, still
+        # interned) signature path instead:
+        # - topology spread pods: grouping identity also depends on
+        #   metadata.labels matching the constraint's selector (per-pod);
+        # - pods with NESTED term structures (node/pod affinity,
+        #   preferences): an inner-list element replaced in place between
+        #   constructions changes no outer id, so no cheap fingerprint is
+        #   sound against realistic spec reuse (round-4 review) -- and
+        #   these are the rare shapes, several of which route to the
+        #   oracle anyway.
+        # The dominant template shapes (plain, nodeSelector, tolerations)
+        # keep the token with FULL content fingerprints: a caller that
+        # mutates the selector dict or the tolerations list between
+        # constructions (any key, any element, same length or not) changes
+        # the fingerprint, so pods never falsely share a token. Both
+        # containers hold flat immutable-content entries (strings /
+        # Toleration fields), so content covers them fully; construction is
+        # off the scheduling-latency path, so the fingerprint cost lands on
+        # watch ingestion, not the solve. The sole remaining doctrine hole
+        # is mutating a shared Toleration OBJECT's attributes in place --
+        # the same spec-immutability assumption the _group_sig memo
+        # already relies on.
+        if (
+            topology_spread or node_affinity_terms or affinity_terms
+            or preferred_node_affinity_terms
+        ):
             self._spec_refs = None
             self._spec_token = None
         else:
-            # pin the containers AND their current elements: the token
-            # carries per-element ids, and an id is only a sound identity
-            # while the object it named is alive (CPython reuses freed
-            # addresses; a replaced-then-freed element could otherwise
-            # alias a new element's id)
-            self._spec_refs = (
-                requests, node_selector, node_affinity_terms, tolerations,
-                affinity_terms, preferred_node_affinity_terms,
-                tuple(tolerations), tuple(node_affinity_terms),
-                tuple(affinity_terms), tuple(preferred_node_affinity_terms),
-            )
-            # the node_selector fingerprint is its FULL sorted content: a
-            # caller that mutates one dict between constructions (e.g.
-            # sel['zone'] = z in a loop, any key) reuses the id but changes
-            # the fingerprint, so the pods do not falsely share a token
-            # (dict values are strings, so content covers the dict fully).
-            # The list args carry per-ELEMENT id tuples: swapping, adding,
-            # removing, or replacing an element between constructions
-            # changes the tuple, so those pods do not falsely share either
-            # -- the same realistic reuse pattern the node_selector case
-            # covers. Construction is off the scheduling-latency path, so
-            # the fingerprint cost lands on watch ingestion, not the solve.
-            # The one remaining doctrine hole is mutating an element
-            # OBJECT's attributes in place between constructions (e.g.
-            # toleration.key = x on a shared Toleration) -- the same
-            # spec-immutability assumption the _group_sig memo already
-            # relies on, now uniform across every pinned container.
-            ns_fp = tuple(sorted(node_selector.items())) if node_selector else ()
+            # pin the id-carrying containers: an id is only a sound
+            # identity while the object it names is alive (CPython reuses
+            # freed addresses)
+            self._spec_refs = (requests, node_selector, tolerations)
             self._spec_token = (
-                id(requests), id(node_selector), id(node_affinity_terms),
-                id(tolerations), id(affinity_terms), id(preferred_node_affinity_terms),
-                ns_fp,
-                tuple(map(id, tolerations)) if tolerations else (),
-                tuple(map(id, node_affinity_terms)) if node_affinity_terms else (),
-                tuple(map(id, affinity_terms)) if affinity_terms else (),
-                tuple(map(id, preferred_node_affinity_terms)) if preferred_node_affinity_terms else (),
+                id(requests), id(node_selector), id(tolerations),
+                tuple(sorted(node_selector.items())) if node_selector else (),
+                tuple((t.key, t.operator, t.value, t.effect) for t in tolerations)
+                if tolerations else (),
             )
 
     def grouping_signature(self) -> tuple:

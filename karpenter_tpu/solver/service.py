@@ -182,6 +182,12 @@ class TPUSolver:
     def _warm_entry(self, entry: "_CatalogEntry", c_pads: Sequence[int] = WARM_C_PADS) -> None:
         """Compile from a pinned snapshot: the warm thread must never
         re-stage (its catalog may already be stale by the time it runs)."""
+        # geometry-keyed coverage accumulates across catalog refreshes while
+        # _catalog_cache is LRU-capped; bound the set BEFORE adding this
+        # entry's keys so the coverage just computed survives (a cleared
+        # stale key merely re-fires the unwarmed-bucket log once)
+        if len(self._warmed_pads) > 128:
+            self._warmed_pads.clear()
         outs = []
         for cp in c_pads:
             cs = encode.encode_classes([], entry.tensors, c_pad=cp)
@@ -193,11 +199,6 @@ class TPUSolver:
                 )
             )
             self._warmed_pads.add(self._warm_key(cp, entry))
-        # geometry-keyed entries accumulate across catalog refreshes while
-        # _catalog_cache is LRU-capped; bound the set rather than track
-        # eviction (a cleared key merely re-fires the unwarmed-bucket log)
-        if len(self._warmed_pads) > 128:
-            self._warmed_pads.clear()
         jax.block_until_ready(outs)
 
     # -- routing ------------------------------------------------------------

@@ -82,31 +82,45 @@ _PROBE_CODE = (
 )
 
 
-def probe_jax_backend(timeout_s: int = 120, attempts: int = 2):
+def probe_jax_backend(
+    timeout_s: int = 120, attempts: int = 2,
+    backoff: float = 1.0, budget_s: float | None = None,
+):
     """Initialize the environment's default JAX backend in a SUBPROCESS so
     a hung accelerator tunnel cannot hang the caller (the chip may sit
     behind a network tunnel that blocks indefinitely at backend init).
     Returns (backend_name, error): backend_name is None on failure.
     Callers degrade to the CPU platform via
     jax.config.update("jax_platforms", "cpu") -- the env var alone is not
-    enough when a sitecustomize hook pins a plugin platform."""
+    enough when a sitecustomize hook pins a plugin platform.
+
+    backoff grows the per-attempt timeout geometrically (a tunnel that
+    answers slowly needs a LONGER wait, not more identical ones); budget_s
+    caps total wall-clock spent probing, including sleeps."""
     import subprocess
     import sys
     import time
 
     err = None
+    start = time.monotonic()
     for i in range(attempts):
+        t = timeout_s * (backoff ** i)
+        if budget_s is not None:
+            remaining = budget_s - (time.monotonic() - start)
+            if remaining <= 5:
+                break
+            t = min(t, remaining)
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_CODE],
-                timeout=timeout_s, capture_output=True, text=True,
+                timeout=t, capture_output=True, text=True,
             )
             for line in r.stdout.splitlines():
                 if line.startswith("BACKEND="):
                     return line.split("=", 1)[1], None
             err = (r.stderr or r.stdout)[-500:]
         except subprocess.TimeoutExpired:
-            err = f"backend probe timed out after {timeout_s}s (attempt {i + 1})"
+            err = f"backend probe timed out after {t:.0f}s (attempt {i + 1})"
         except Exception as e:  # noqa: BLE001 - diagnostic path must not raise
             err = repr(e)
         if i < attempts - 1:

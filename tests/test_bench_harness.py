@@ -1,0 +1,69 @@
+"""Bench harness robustness (VERDICT round 3, item 1): the parent/child
+split must turn a mid-run tunnel loss into the best completed accelerator
+partial, and a degraded run must carry the committed TPU capture as claim
+provenance. These test the assembly logic directly; the subprocess
+machinery is exercised by running bench.py itself (slow tiers)."""
+import json
+
+import bench
+
+
+def _iter_events(kind, vals, backend="tpu"):
+    evs = [{"ev": "backend", "backend": backend}]
+    evs += [{"ev": kind, "i": i, "ms": v, "gc2": 0} for i, v in enumerate(vals)]
+    return evs
+
+
+class TestAssemblePartial:
+    def test_cold_partial_preferred(self):
+        evs = _iter_events("cold_iter", [100.0 + i for i in range(8)])
+        evs += _iter_events("warm_iter", [50.0] * 10)[1:]
+        out = bench._assemble_partial(evs, "no progress for 360s (tunnel stall)")
+        assert out["partial"] is True
+        assert out["mode"] == "cold_pods_partial"
+        assert out["platform"] == "tpu"
+        assert out["claim_basis"] == "accelerator_partial_8_iters"
+        assert 100.0 <= out["value"] <= 108.0
+        assert out["partial_reason"].startswith("no progress")
+
+    def test_warm_partial_when_cold_insufficient(self):
+        evs = _iter_events("cold_iter", [100.0] * 3)
+        evs += _iter_events("warm_iter", [80.0] * 12)[1:]
+        out = bench._assemble_partial(evs, "stall")
+        assert out["mode"] == "warm_partial"
+        assert out["value"] == 80.0
+
+    def test_too_few_iterations_returns_none(self):
+        evs = _iter_events("cold_iter", [100.0] * 2)
+        assert bench._assemble_partial(evs, "stall") is None
+
+    def test_no_backend_event_returns_none(self):
+        evs = [{"ev": "cold_iter", "i": i, "ms": 100.0, "gc2": 0} for i in range(9)]
+        assert bench._assemble_partial(evs, "stall") is None
+
+
+class TestCaptureProvenance:
+    def test_capture_attached_with_claim_basis(self, tmp_path, monkeypatch):
+        cap = {"value": 130.29, "platform": "tpu", "compute_sum_ms": 52.5,
+               "cold_iters_ms": [1.0] * 25}
+        p = tmp_path / "BENCH_TPU_CAPTURE.json"
+        p.write_text(json.dumps(cap))
+        monkeypatch.setattr(bench, "CAPTURE_PATH", str(p))
+        out = bench._attach_capture({"platform": "cpu", "degraded": True})
+        assert out["tpu_capture"]["value"] == 130.29
+        assert "claim_basis" in out["tpu_capture"]
+        # iteration lists stay in the committed file, not the artifact
+        assert "cold_iters_ms" not in out["tpu_capture"]
+
+    def test_missing_capture_is_silent(self, monkeypatch, tmp_path):
+        monkeypatch.setattr(bench, "CAPTURE_PATH", str(tmp_path / "absent.json"))
+        out = bench._attach_capture({"degraded": True})
+        assert "tpu_capture" not in out
+
+
+class TestEventParsing:
+    def test_read_events_skips_torn_lines(self, tmp_path):
+        p = tmp_path / "progress.jsonl"
+        p.write_text('{"ev": "backend", "backend": "tpu"}\n{"ev": "cold_it')
+        evs = bench._read_events(str(p))
+        assert evs == [{"ev": "backend", "backend": "tpu"}]

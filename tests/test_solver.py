@@ -631,16 +631,44 @@ class TestSpecTokenFingerprint:
         classes = encode.group_pods([p1, p2])
         assert len(classes) == 2, "swapped-element pods must not merge"
 
-    def test_affinity_term_swap_splits_token(self):
+    def test_toleration_attribute_content_splits_token(self):
+        """Tolerations are content-fingerprinted: replacing an element with
+        one of different CONTENT splits even when the swap preserves both
+        the container id and the element count."""
+        from karpenter_tpu.apis import Pod
+        from karpenter_tpu.scheduling import Toleration
+        from karpenter_tpu.solver import encode
+
+        req = self._req()
+        tol = [Toleration(key="a", operator="Exists"),
+               Toleration(key="x", operator="Exists")]
+        p1 = Pod("p1", requests=req, tolerations=tol)
+        tol[1] = Toleration(key="y", operator="Exists")
+        p2 = Pod("p2", requests=req, tolerations=tol)
+        assert p1._spec_token != p2._spec_token
+        assert len(encode.group_pods([p1, p2])) == 2
+
+    def test_nested_term_pods_take_signature_path(self):
+        """Pods with nested term structures (node/pod affinity,
+        preferences) carry NO token: an inner-list element replaced in
+        place changes no outer id, so no cheap fingerprint is sound --
+        the signature path groups them correctly instead (round-4
+        review: terms[0][0] = ... falsely merged under element-id
+        tokens)."""
         from karpenter_tpu.apis import Pod
         from karpenter_tpu.scheduling import Operator, Requirement
+        from karpenter_tpu.solver import encode
 
         req = self._req()
         terms = [[Requirement("topology.kubernetes.io/zone", Operator.IN, ["us-central-1a"])]]
         p1 = Pod("p1", requests=req, node_affinity_terms=terms)
-        terms[0] = [Requirement("topology.kubernetes.io/zone", Operator.IN, ["us-central-1b"])]
+        assert p1._spec_token is None
+        # the inner-element mutation that defeats id fingerprints
+        terms[0][0] = Requirement("topology.kubernetes.io/zone", Operator.IN, ["us-central-1b"])
         p2 = Pod("p2", requests=req, node_affinity_terms=terms)
-        assert p1._spec_token != p2._spec_token
+        assert p2._spec_token is None
+        classes = encode.group_pods([p1, p2])
+        assert len(classes) == 2, "zone-a and zone-b affinity pods must not merge"
 
 
 class TestDaemonSetOverhead:
