@@ -69,11 +69,15 @@ import traceback
 
 import numpy as np
 
-def _env_i(name: str, default: int) -> int:
+def _env_f(name: str, default: float) -> float:
     try:
-        return int(os.environ.get(name, ""))
+        return float(os.environ.get(name, ""))
     except ValueError:
         return default
+
+
+def _env_i(name: str, default: int) -> int:
+    return int(_env_f(name, default))
 
 
 # scale knobs env-overridable for harness smoke tests ONLY; the driver's
@@ -87,13 +91,6 @@ G_MAX = 1024        # price objective opens ~1.6x max-fit's group count
 TARGET_MS = 100.0
 
 CAPTURE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_CAPTURE.json")
-
-
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, ""))
-    except ValueError:
-        return default
 
 
 def probe_backend(**kw):
@@ -310,18 +307,22 @@ def run(profile: bool, progress=lambda ev: None):
     # otherwise hit a multi-second XLA compile inside a measured iteration
     # -- that was the whole of round 2's p99 tail
     t0 = time.perf_counter()
-    solver.warm(items)
+    # one bucket at a time so the watchdog sees a heartbeat per XLA
+    # compile instead of one event after all seven
+    for cp in TPUSolver.WARM_C_PADS:
+        solver.warm(items, c_pads=(cp,))
+        progress({"ev": "phase", "name": f"bucket_warm_{cp}"})
     t_warm_buckets = time.perf_counter() - t0
-    progress({"ev": "phase", "name": "bucket_warm", "secs": round(t_warm_buckets, 2)})
 
     # adaptive warmup: a tunneled chip's first seconds after idle can be
     # pathologically slow; warm until solve time stabilizes near its floor
     best = float("inf")
     stable = 0
-    for _ in range(40):
+    for wi in range(40):
         t0 = time.perf_counter()
         solve(workloads[0])
         dt = time.perf_counter() - t0
+        progress({"ev": "phase", "name": f"warmup_{wi}"})
         if dt < best * 0.9:
             stable = 0
         elif dt <= best * 1.3:
@@ -331,7 +332,6 @@ def run(profile: bool, progress=lambda ev: None):
         else:
             stable = 0
         best = min(best, dt)
-    progress({"ev": "phase", "name": "adaptive_warmup"})
 
     # latency GC policy: freeze the warm baseline, stop gen2 collections
     # from firing inside measured ticks (the operator applies the same
@@ -514,6 +514,12 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
     start = time.monotonic()
     last_size = -1
     last_change = start
+    measuring = False
+    # single long operations before the first measured iteration (the
+    # first XLA compile of a 50k-pod program over a cold tunnel, a slow
+    # catalog stage) legitimately emit nothing for minutes -- give the
+    # startup phases a longer leash than the per-iteration cadence
+    startup_stall = max(stall_s, _env_f("BENCH_STARTUP_STALL_S", 900))
     why = ""
     while True:
         rc = proc.poll()
@@ -528,13 +534,19 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
         if size != last_size:
             last_size = size
             last_change = now
+            if not measuring:
+                measuring = any(
+                    e.get("ev") in ("cold_iter", "warm_iter")
+                    for e in _read_events(path)
+                )
         if now - start > budget_s:
             why = f"budget exceeded ({budget_s:.0f}s)"
             proc.kill()
             proc.wait()
             break
-        if now - last_change > stall_s:
-            why = f"no progress for {stall_s:.0f}s (tunnel stall)"
+        limit = stall_s if measuring else startup_stall
+        if now - last_change > limit:
+            why = f"no progress for {limit:.0f}s (tunnel stall)"
             proc.kill()
             proc.wait()
             break

@@ -53,7 +53,10 @@ class PDBGuard:
         short = [name for name, n in needed.items() if self._remaining[name] < n]
         ok = not short
         if short:
-            self.log.debug("candidacy deferred by disruption budget", budgets=short)
+            self.log.debug(
+                "eviction deferred by disruption budget",
+                pods=[p.metadata.name for p in pods][:5], budgets=short,
+            )
         if ok or charge_on_fail:
             for name, n in needed.items():
                 self._remaining[name] -= n

@@ -235,18 +235,24 @@ class PodClassSet:
 
 
 def _spread_sig(pod: Pod) -> tuple:
-    """Hard spread constraints are part of scheduling identity: pods that
-    spread differently (or match their own selector differently) must not
-    collapse into one class (solver/spread.py distributes per class)."""
+    """Spread constraints that shape placement are part of scheduling
+    identity: pods that spread differently (or match their own selector
+    differently) must not collapse into one class (solver/spread.py
+    distributes per class). That is every HARD constraint plus soft ZONE
+    constraints (the round-4 preference water-fill); soft non-zone
+    constraints stay scoring no-ops and deliberately do not fragment
+    classes. when_unsatisfiable is in the tuple so a hard and a soft
+    constraint of the same shape never share a class."""
     return tuple(
         (
             t.topology_key,
             t.max_skew,
+            t.when_unsatisfiable,
             tuple(sorted(t.label_selector.items())),
             all(pod.metadata.labels.get(k) == v for k, v in t.label_selector.items()),
         )
         for t in pod.topology_spread
-        if t.hard()
+        if t.hard() or t.topology_key == wk.ZONE_LABEL
     )
 
 

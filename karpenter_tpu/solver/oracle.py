@@ -90,10 +90,13 @@ class _TopologyState:
         return (tsc.topology_key, tuple(sorted(tsc.label_selector.items())))
 
     def seed_existing(self, pods_by_node: Dict[str, List[Pod]], node_labels: Dict[str, Dict[str, str]]):
+        # soft ZONE constraints seed too: bound pods of a ScheduleAnyway
+        # deployment shape where its pending replicas prefer to go (the
+        # split pass reads the same zone-keyed state via _spread_seeds)
         for node, pods in pods_by_node.items():
             for p in pods:
                 for tsc in p.topology_spread:
-                    if not tsc.hard():
+                    if not tsc.hard() and tsc.topology_key != wk.ZONE_LABEL:
                         continue
                     domain = node_labels.get(node, {}).get(tsc.topology_key)
                     if domain:
@@ -121,6 +124,14 @@ class _TopologyState:
 
 def _pod_matches_selector(pod: Pod, selector: Dict[str, str]) -> bool:
     return all(pod.metadata.labels.get(k) == v for k, v in selector.items())
+
+
+def _soft_zone_tsc(pod: Pod):
+    """The pod's effective soft zone-spread preference (shared definition
+    with the split pass, solver/spread.py)."""
+    from karpenter_tpu.solver.spread import soft_zone_tsc
+
+    return soft_zone_tsc(pod)
 
 
 class Scheduler:
@@ -168,6 +179,15 @@ class Scheduler:
         self._env_totals: Dict[str, Dict[tuple, int]] = {}
         self._env_placed: Dict[tuple, int] = {}
         self._sched_pods: List[Pod] = []
+        # soft-spread relaxation state: True only inside a _place_pod retry
+        # where the pod's ScheduleAnyway zone preference has been dropped
+        self._soft_relaxed = False
+        # per-placement memo for _zone_choice: topology counts change only
+        # when a placement lands (_record_placement clears), so the pinned
+        # zone is invariant across the existing-node loop -- without the
+        # memo every candidate node pays a catalog/zone scan (round-4
+        # review)
+        self._zone_choice_memo: Dict[tuple, Optional[str]] = {}
         # pod-(anti-)affinity occupancy (reference core scheduling algebra,
         # SURVEY.md section 2.3; BOTH directions enforced):
         #   _labels_on   location (node name / group id) -> pod labels
@@ -281,14 +301,20 @@ class Scheduler:
                 out.add(Requirement(wk.ZONE_LABEL, Operator.IN, sorted(matching)))
         return out
 
-    def _zone_choice(self, pod: Pod, tsc: TopologySpreadConstraint) -> Optional[str]:
+    def _zone_choice(
+        self, pod: Pod, tsc: TopologySpreadConstraint, skew: bool = True
+    ) -> Optional[str]:
         """The pod's pinned spread zone: lexicographically-first minimum-
         count zone among skew-eligible feasible domains (the same choice
         _spread_narrow_group makes when opening/joining groups, computed
         against the highest-weight pool COMPATIBLE with the pod). Pinning
         the SAME zone for existing-node packing keeps the oracle
         differentially equal to the batch path, whose split pass assigns
-        zones before node packing."""
+        zones before node packing. skew=False is the soft-spread variant:
+        a preference biases placement but never gates on max_skew."""
+        memo_key = (id(pod), id(tsc), skew, self._soft_relaxed)
+        if memo_key in self._zone_choice_memo:
+            return self._zone_choice_memo[memo_key]
         pod_reqs = pod.scheduling_requirements()[0]
         pool = next(
             (
@@ -304,11 +330,17 @@ class Scheduler:
         requested = pod.requests + Resources.from_base_units({res.PODS: 1})
         domains = self._feasible_spread_zones(pool, base, requested)
         candidates = self._group_zone_domains(base) & domains
-        allowed = self.topology.allowed_domains(tsc, candidates, all_domains=domains)
+        if skew:
+            allowed = self.topology.allowed_domains(tsc, candidates, all_domains=domains)
+        else:
+            allowed = candidates
         if not allowed:
-            return None
-        counts = self.topology.count(tsc)
-        return min(sorted(allowed), key=lambda z: counts.get(z, 0))
+            choice = None
+        else:
+            counts = self.topology.count(tsc)
+            choice = min(sorted(allowed), key=lambda z: counts.get(z, 0))
+        self._zone_choice_memo[memo_key] = choice
+        return choice
 
     def _spread_ok_existing(self, pod: Pod, node: ExistingNode) -> bool:
         for tsc in pod.topology_spread:
@@ -328,6 +360,15 @@ class Scheduler:
             candidates = self._domains_for(tsc)
             if domain not in self.topology.allowed_domains(tsc, candidates, all_domains=candidates):
                 return False
+        if not self._soft_relaxed:
+            # soft zone preference: existing-node joins honor the pinned
+            # (min-count) zone like hard spread; the relaxation retry
+            # (_place_pod) lifts this when the pinned placement fails
+            t = _soft_zone_tsc(pod)
+            if t is not None:
+                choice = self._zone_choice(pod, t, skew=False)
+                if choice is not None and node.labels.get(wk.ZONE_LABEL) != choice:
+                    return False
         return True
 
     def _domains_for(self, tsc: TopologySpreadConstraint) -> Set[str]:
@@ -340,6 +381,9 @@ class Scheduler:
         return set(self.topology.count(tsc).keys())
 
     def _record_placement(self, pod: Pod, location: str, domain_labels: Dict[str, str]) -> None:
+        # a landed placement can move topology counts: pinned-zone memos
+        # computed against the previous counts are now stale
+        self._zone_choice_memo.clear()
         labels = dict(pod.metadata.labels)
         self._labels_on.setdefault(location, []).append(labels)
         self._all_labels.append(labels)
@@ -353,6 +397,15 @@ class Scheduler:
             domain = domain_labels.get(tsc.topology_key)
             if domain:
                 self.topology.add(tsc, domain)
+        if not self._soft_relaxed:
+            # applied soft zone preferences count (the split pass adds its
+            # delivered water-fill the same way); RELAXED placements do not
+            # -- the device cannot know their zones pre-solve
+            t = _soft_zone_tsc(pod)
+            if t is not None:
+                domain = domain_labels.get(wk.ZONE_LABEL)
+                if domain:
+                    self.topology.add(t, domain)
 
     # -- existing-node packing ---------------------------------------------
     def _try_existing(self, pod: Pod, result: SchedulingResult) -> bool:
@@ -469,6 +522,25 @@ class Scheduler:
                 global_min = min((counts.get(d, 0) for d in domains), default=0)
                 if 1 - global_min > tsc.max_skew:
                     return None
+        if not self._soft_relaxed:
+            # soft (ScheduleAnyway) zone spread: pin the min-count feasible
+            # zone as a PREFERENCE -- same water-fill choice as hard but
+            # with no skew gate; with no feasible candidate it constrains
+            # nothing (the split pass passes such classes through), and a
+            # pinned placement that fails is retried relaxed (_place_pod)
+            t = _soft_zone_tsc(pod)
+            if t is not None:
+                base = base_fn() if base_fn is not None else out
+                requested = pod.requests + Resources.from_base_units({res.PODS: 1})
+                domains = self._feasible_spread_zones(pool, base, requested)
+                candidates = self._group_zone_domains(base) & domains
+                if candidates:
+                    counts = self.topology.count(t)
+                    want = min(sorted(candidates), key=lambda z: counts.get(z, 0))
+                    if want not in self._group_zone_domains(out):
+                        return None  # this group cannot host the preferred zone
+                    out = out.copy()
+                    out.add(Requirement(wk.ZONE_LABEL, Operator.IN, [want]))
         return out
 
     def _try_group(self, pod: Pod, group: NewNodeGroup, pod_reqs: Requirements) -> bool:
@@ -636,6 +708,17 @@ class Scheduler:
             if n >= n_star and price <= p_star
         ]
 
+    def _spread_pin_applies(self, pod: Pod) -> bool:
+        """True when the pod's placement carries a spread zone pin (hard,
+        or soft not yet relaxed): pinned pods keep the full max-fit
+        candidate set, mirroring the split pass's env_count = 0."""
+        if any(
+            t.hard() and _pod_matches_selector(pod, t.label_selector)
+            for t in pod.topology_spread
+        ):
+            return True
+        return not self._soft_relaxed and _soft_zone_tsc(pod) is not None
+
     def _open_group(self, pod: Pod, pod_reqs: Requirements, result: SchedulingResult) -> Optional[str]:
         last_reason = "no nodepool matches pod requirements"
         for pool in self.nodepools:
@@ -700,10 +783,10 @@ class Scheduler:
                 # solver marks spread sub-classes env_count = 0 (fit mode).
                 # A constraint whose selector the pod itself does not match
                 # never applies (the split pass ignores it the same way).
-                and not any(
-                    t.hard() and _pod_matches_selector(pod, t.label_selector)
-                    for t in pod.topology_spread
-                )
+                # Applied soft pins are excluded the same way; a RELAXED
+                # soft pod keeps the price envelope (the split's unpinned
+                # residual keeps the class env_count).
+                and not self._spread_pin_applies(pod)
             ):
                 candidates = self._price_open_filter(
                     candidates, narrowed, requested,
@@ -749,38 +832,53 @@ class Scheduler:
         ordered = sorted(pods, key=pod_sort_key)
         self._sched_pods = ordered
         for pod in ordered:
-            placed, reasons = False, []
-            if not pod.preferred_node_affinity_terms:
-                placed, reasons = self._attempt_placement(pod, result)
-            else:
-                # preference relaxation (the core's preferences model): the
-                # pod's preferred node-affinity terms apply as
-                # REQUIREMENTS, strongest set first; each failed attempt
-                # drops the lowest-weight preference and retries, ending
-                # with none. Attempts mutate-and-restore
-                # node_affinity_terms; the grouping signature is memoized
-                # FROM THE ORIGINAL SPEC first, so helpers that read it
-                # mid-attempt (_env_key) can never capture a variant.
-                pod.grouping_signature()
-                original_nat = pod.node_affinity_terms
+            placed, reasons = self._place_pod(pod, result)
+            if not placed and not self._soft_relaxed and _soft_zone_tsc(pod) is not None:
+                # ScheduleAnyway: the zone preference must never make a pod
+                # unschedulable -- retry the full placement with the soft
+                # pin dropped (the split pass's unpinned residual is the
+                # device-side mirror of this relaxation)
+                self._soft_relaxed = True
                 try:
-                    for prefs in pod.preference_variants():
-                        if prefs:
-                            base = original_nat or [[]]
-                            flat = [r for term in prefs for r in term]
-                            pod.node_affinity_terms = [list(t) + flat for t in base]
-                        else:
-                            pod.node_affinity_terms = original_nat
-                        placed, reasons = self._attempt_placement(pod, result)
-                        if placed:
-                            break
+                    placed, reasons = self._place_pod(pod, result)
                 finally:
-                    pod.node_affinity_terms = original_nat
+                    self._soft_relaxed = False
             if not placed:
                 result.unschedulable[pod.metadata.name] = "; ".join(reasons) or "unschedulable"
             else:
                 self._note_placed(pod)
         return result
+
+    def _place_pod(self, pod: Pod, result: SchedulingResult):
+        """One placement pass under the current soft-spread state,
+        including the preferred-node-affinity relaxation ladder."""
+        if not pod.preferred_node_affinity_terms:
+            return self._attempt_placement(pod, result)
+        # preference relaxation (the core's preferences model): the
+        # pod's preferred node-affinity terms apply as
+        # REQUIREMENTS, strongest set first; each failed attempt
+        # drops the lowest-weight preference and retries, ending
+        # with none. Attempts mutate-and-restore
+        # node_affinity_terms; the grouping signature is memoized
+        # FROM THE ORIGINAL SPEC first, so helpers that read it
+        # mid-attempt (_env_key) can never capture a variant.
+        pod.grouping_signature()
+        original_nat = pod.node_affinity_terms
+        placed, reasons = False, []
+        try:
+            for prefs in pod.preference_variants():
+                if prefs:
+                    base = original_nat or [[]]
+                    flat = [r for term in prefs for r in term]
+                    pod.node_affinity_terms = [list(t) + flat for t in base]
+                else:
+                    pod.node_affinity_terms = original_nat
+                placed, reasons = self._attempt_placement(pod, result)
+                if placed:
+                    break
+        finally:
+            pod.node_affinity_terms = original_nat
+        return placed, reasons
 
     def _attempt_placement(self, pod: Pod, result: SchedulingResult):
         """One full placement attempt under the pod's CURRENT constraints:

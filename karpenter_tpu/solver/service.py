@@ -233,6 +233,7 @@ class TPUSolver:
                     return False
         reps = []
         any_spread = False
+        any_soft = False
         for pc in classes:
             if pc.has_affinity or pc.multi_node_affinity or pc.has_preferences:
                 return False
@@ -242,6 +243,14 @@ class TPUSolver:
                 return False
             if any(t.hard() for t in p.topology_spread):
                 any_spread = True
+            elif spread.soft_zone_tsc(p) is not None:
+                any_spread = any_soft = True
+        if any_soft and any(p.limits is not None for p in scheduler.nodepools):
+            # soft spread is pin-then-relax: a pool limit can reject the
+            # pinned zone while the relaxed pod still fits elsewhere, and
+            # the device's single dispatch cannot express the retry --
+            # oracle (its _place_pod relaxation handles it per pod)
+            return False
         if any_spread:
             # hostname spread and multi-constraint pods take the oracle;
             # zone spread (incl. existing nodes: counts seed from the
@@ -405,7 +414,11 @@ class TPUSolver:
             classes = kept
             if not classes:
                 return result
-        if instance_types and any(spread_mod.hard_zone_tsc(pc.pods[0]) for pc in classes):
+        if instance_types and any(
+            spread_mod.hard_zone_tsc(pc.pods[0]) is not None
+            or spread_mod.soft_zone_tsc(pc.pods[0]) is not None
+            for pc in classes
+        ):
             catalog0 = self._catalog(instance_types).tensors
             pre_set = encode.encode_classes(
                 classes, catalog0, pool_taints=list(pool.template.taints),

@@ -377,11 +377,17 @@ class TestDifferentialFuzz:
                 tolerations.append(Toleration(key="dedicated", operator="Exists"))
             spread = []
             if use_spread and rng.random() < 0.4 and not selector:
+                # ~30% of spread workloads carry the SOFT variant: the
+                # water-fill pin + relax-don't-fail contract must hold
+                # differentially too (round 4)
                 spread = [
                     TopologySpreadConstraint(
                         max_skew=int(rng.choice([1, 2])),
                         topology_key=wk.ZONE_LABEL,
                         label_selector={"app": f"w{t}"},
+                        when_unsatisfiable=(
+                            "ScheduleAnyway" if rng.random() < 0.3 else "DoNotSchedule"
+                        ),
                     )
                 ]
             for i in range(int(rng.integers(1, 7))):
@@ -440,7 +446,7 @@ class TestDifferentialFuzz:
             pods, the exact quantity topology spread constrains."""
             from collections import Counter
 
-            from karpenter_tpu.solver.spread import hard_zone_tsc
+            from karpenter_tpu.solver.spread import hard_zone_tsc, soft_zone_tsc
 
             out = Counter()
             for g in result.new_groups:
@@ -451,7 +457,7 @@ class TestDifferentialFuzz:
                     else ("any",)
                 )
                 for p in g.pods:
-                    if hard_zone_tsc(p) is not None:
+                    if hard_zone_tsc(p) is not None or soft_zone_tsc(p) is not None:
                         out[(p.metadata.name.rsplit("-", 2)[1], zone)] += 1
             return out
 
@@ -473,8 +479,9 @@ class TestDifferentialFuzz:
             )
 
         from karpenter_tpu.solver.spread import hard_zone_tsc as _hz
+        from karpenter_tpu.solver.spread import soft_zone_tsc as _sz
 
-        has_spread = any(_hz(p) is not None for p in pods)
+        has_spread = any(_hz(p) is not None or _sz(p) is not None for p in pods)
 
         oracle = mk().schedule(list(pods))
         device = TPUSolver(g_max=256).schedule(mk(), list(pods))
@@ -497,7 +504,8 @@ class TestDifferentialFuzz:
         # regression that fragments spread pods one-per-node
         n_selectors = len({
             tuple(sorted(t.label_selector.items()))
-            for p in pods for t in p.topology_spread if t.hard()
+            for p in pods for t in p.topology_spread
+            if t.hard() or t.topology_key == wk.ZONE_LABEL
         })
         bound = max(1, n_selectors)
         assert abs(len(oracle.new_groups) - len(device.new_groups)) <= bound, f"seed {seed}"

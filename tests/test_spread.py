@@ -142,7 +142,9 @@ class TestSpreadDifferential:
         oracle, device = run_both(catalog_items, pods)
         assert zone_distribution(oracle) == zone_distribution(device)
 
-    def test_soft_spread_ignored(self, catalog_items):
+    def test_soft_hostname_spread_is_scoring_noop(self, catalog_items):
+        """Soft NON-ZONE spread stays a scoring no-op on both paths (the
+        documented parity delta is hostname-only after round 4)."""
         pods = [
             Pod(
                 f"p{i}",
@@ -150,7 +152,7 @@ class TestSpreadDifferential:
                 labels={"app": "web"},
                 topology_spread=[
                     TopologySpreadConstraint(
-                        max_skew=1, topology_key=wk.ZONE_LABEL,
+                        max_skew=1, topology_key=wk.HOSTNAME_LABEL,
                         label_selector={"app": "web"}, when_unsatisfiable="ScheduleAnyway",
                     )
                 ],
@@ -232,6 +234,9 @@ class TestSpreadDifferential:
             skew = int(rng.choice([1, 2]))
             cpu_m = int(rng.choice([250, 500, 1000, 2000]))
             mem_mi = int(rng.choice([512, 1024, 4096]))
+            # a third of workloads carry the SOFT (ScheduleAnyway) variant:
+            # same water-fill, relax-don't-fail semantics on both paths
+            unsat = "ScheduleAnyway" if rng.random() < 0.33 else "DoNotSchedule"
             for i in range(int(rng.integers(2, 18))):
                 pods.append(
                     Pod(
@@ -242,6 +247,7 @@ class TestSpreadDifferential:
                             TopologySpreadConstraint(
                                 max_skew=skew, topology_key=wk.ZONE_LABEL,
                                 label_selector={"app": app},
+                                when_unsatisfiable=unsat,
                             )
                         ],
                     )
@@ -378,6 +384,135 @@ class TestSteadyStateSpread:
             assert sorted(oracle.existing_assignments.values()) == sorted(
                 device.existing_assignments.values()
             ), f"trial {trial}"
+
+
+def soft_spread_pod(name, cpu, mem, labels=None, node_selector=None, app="web"):
+    labels = dict(labels or {})
+    labels.setdefault("app", app)
+    return Pod(
+        name,
+        requests=Resources({"cpu": cpu, "memory": mem}),
+        labels=labels,
+        node_selector=node_selector,
+        topology_spread=[
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=wk.ZONE_LABEL,
+                label_selector={"app": app},
+                when_unsatisfiable="ScheduleAnyway",
+            )
+        ],
+    )
+
+
+class TestSoftSpreadPreference:
+    """VERDICT round 3, item 4: ScheduleAnyway zone spread biases pods
+    toward the least-loaded admissible zone WITHOUT leaving the device
+    path, never makes a pod unschedulable, and stays differentially equal
+    to the oracle's pin-then-relax."""
+
+    def test_soft_pods_balance_across_zones(self, catalog_items):
+        pods = [soft_spread_pod(f"p{i}", "500m", "1Gi") for i in range(12)]
+        oracle, device = run_both(catalog_items, pods)
+        assert not oracle.unschedulable and not device.unschedulable
+        assert zone_distribution(oracle) == zone_distribution(device)
+        # the preference balances exactly like hard spread here: 4 zones,
+        # 12 pods -> 3 per zone (pre-round-4, all 12 packed one zone)
+        sizes = sorted(n for _, n in zone_distribution(device))
+        assert sizes == [3, 3, 3, 3]
+
+    def test_stays_on_device_path(self, catalog_items):
+        pool = NodePool("default")
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(nodepools=[pool], instance_types={pool.name: catalog_items}, zones=zones)
+        pods = [soft_spread_pod(f"p{i}", "500m", "1Gi") for i in range(4)]
+        assert TPUSolver.supports(sched, pods)
+
+    def test_pool_limits_route_to_oracle(self, catalog_items):
+        """Soft spread is pin-then-relax; a pool limit can reject the pin
+        while the relaxed pod fits, which one device dispatch cannot
+        express -- routing sends the batch to the oracle."""
+        pool = NodePool("default")
+        pool.limits = Resources({"cpu": "1000"})
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(nodepools=[pool], instance_types={pool.name: catalog_items}, zones=zones)
+        pods = [soft_spread_pod(f"p{i}", "500m", "1Gi") for i in range(4)]
+        assert not TPUSolver.supports(sched, pods)
+
+    def test_zone_selector_restricts_preference_domains(self, catalog_items):
+        """A soft-spread pod pinned by nodeSelector to one zone schedules
+        there (preference constrained to reachable domains, not broken)."""
+        pods = [
+            soft_spread_pod(f"p{i}", "500m", "1Gi",
+                            node_selector={wk.ZONE_LABEL: "us-central-1b"})
+            for i in range(4)
+        ]
+        oracle, device = run_both(catalog_items, pods)
+        assert not oracle.unschedulable and not device.unschedulable
+        assert zone_distribution(oracle) == zone_distribution(device)
+        zones_used = {z for zs, _ in zone_distribution(device) for z in zs}
+        assert zones_used == {"us-central-1b"}
+
+    def test_seeded_soft_counts_steer_away_from_loaded_zone(self, catalog_items):
+        """Bound ScheduleAnyway pods in zone-a bias new replicas toward the
+        other zones, identically on both paths (seeds flow through the
+        same zone-keyed topology state as hard spread)."""
+        from karpenter_tpu.solver.oracle import ExistingNode
+
+        seeded = [
+            soft_spread_pod(f"old{i}", "100m", "128Mi") for i in range(3)
+        ]
+        node = ExistingNode(
+            name="n1",
+            labels={wk.ZONE_LABEL: "us-central-1a", "node": "n1"},
+            allocatable=Resources({"cpu": "8", "memory": "16Gi", "pods": 30}),
+        )
+        oracle, device = run_both_scheduled(
+            catalog_items,
+            [soft_spread_pod(f"p{i}", "500m", "1Gi") for i in range(6)],
+            existing=[node],
+            pods_by_node={"n1": seeded},
+        )
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+        assert zone_distribution(oracle) == zone_distribution(device)
+        zones_used = [z for zs, n in zone_distribution(device) for z in zs for _ in range(n)]
+        assert zones_used.count("us-central-1a") == 0
+
+    def test_mixed_soft_and_plain_pods(self, catalog_items):
+        pods = [soft_spread_pod(f"s{i}", "1", "2Gi") for i in range(8)]
+        pods += [
+            Pod(f"plain{i}", requests=Resources({"cpu": "250m", "memory": "512Mi"}))
+            for i in range(20)
+        ]
+        oracle, device = run_both(catalog_items, pods)
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+        # soft pods spread evenly on both paths
+        def soft_zones(result):
+            out = []
+            for g in result.new_groups:
+                n = sum(1 for p in g.pods if p.metadata.name.startswith("s"))
+                if n:
+                    out.append((group_zone(g), n))
+            return sorted(out)
+
+        assert soft_zones(oracle) == soft_zones(device)
+        sizes = sorted(n for _, n in soft_zones(device))
+        assert sizes == [2, 2, 2, 2]
+
+    def test_hard_and_soft_share_selector_counts(self, catalog_items):
+        """A hard-spread workload and a soft-spread workload with the SAME
+        selector share one count state: soft pods fill the zones the hard
+        pods left emptiest, both paths identical."""
+        pods = [spread_pod(f"h{i}", "500m", "1Gi") for i in range(2)]
+        pods += [soft_spread_pod(f"s{i}", "500m", "1Gi") for i in range(6)]
+        oracle, device = run_both(catalog_items, pods)
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+        assert zone_distribution(oracle) == zone_distribution(device)
+        # 8 matching pods over 4 zones -> 2 per zone
+        zones_used = [z for zs, n in zone_distribution(device) for z in zs for _ in range(n)]
+        assert sorted(
+            zones_used.count(f"us-central-1{c}") for c in "abcd"
+        ) == [2, 2, 2, 2]
 
 
 class TestMultiNodePool:
