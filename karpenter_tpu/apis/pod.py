@@ -51,6 +51,7 @@ class Pod(APIObject):
         tolerations: Sequence[Toleration] = (),
         topology_spread: Sequence[TopologySpreadConstraint] = (),
         affinity_terms: Sequence[PodAffinityTerm] = (),
+        preferred_affinity_terms: Sequence = (),
         priority: int = 0,
         labels: Optional[Dict[str, str]] = None,
         annotations: Optional[Dict[str, str]] = None,
@@ -76,6 +77,14 @@ class Pod(APIObject):
         self.tolerations = list(tolerations)
         self.topology_spread = list(topology_spread)
         self.affinity_terms = list(affinity_terms)
+        # preferred pod (anti-)affinity: (weight, PodAffinityTerm) pairs,
+        # scheduled by the SAME relaxation ladder as preferred node
+        # affinity (oracle._place_pod): all preferences apply as required
+        # terms, strongest set first; each failed attempt drops the
+        # lowest-weight preference of EITHER kind and retries
+        self.preferred_affinity_terms = [
+            (int(w), t) for w, t in preferred_affinity_terms
+        ]
         self.priority = priority
         self.owner_kind = owner_kind  # "" = bare pod (blocks consolidation)
         self.scheduling_gates = list(scheduling_gates)
@@ -122,7 +131,7 @@ class Pod(APIObject):
         # already relies on.
         if (
             topology_spread or node_affinity_terms or affinity_terms
-            or preferred_node_affinity_terms
+            or preferred_node_affinity_terms or preferred_affinity_terms
         ):
             self._spec_refs = None
             self._spec_token = None
@@ -191,16 +200,12 @@ class Pod(APIObject):
                     ))
                     for w, term in pref
                 ) if pref else (),
+                tuple(
+                    (w, tuple(sorted(t.label_selector.items())), t.topology_key, t.anti)
+                    for w, t in self.preferred_affinity_terms
+                ) if self.preferred_affinity_terms else (),
             )
         return sig
-
-    def preference_variants(self):
-        """Requirement-term sets to try, strongest first (the core's
-        preference relaxation): all preferred terms as requirements, then
-        dropping the lowest-weight one per attempt, ending with none."""
-        prefs = sorted(self.preferred_node_affinity_terms, key=lambda p: -p[0])
-        for n in range(len(prefs), -1, -1):
-            yield [term for _, term in prefs[:n]]
 
     # -- scheduling views ---------------------------------------------------
     def scheduling_requirements(self) -> List[Requirements]:

@@ -253,6 +253,8 @@ class PodBinder:
         # incrementally on each bind -- kube-scheduler's skew bookkeeping
         counts_cache: Dict[tuple, Dict[str, int]] = {}
         node_by_name = {n.metadata.name: n for n in nodes}
+        from karpenter_tpu.solver.spread import soft_zone_tsc
+
         for pod in self.cluster.pending_pods():
             needed = pod.requests + Resources.from_base_units({res.PODS: 1})
             tscs = self._matching_spread(pod)
@@ -260,6 +262,18 @@ class PodBinder:
                 (tsc, self._counts_for(tsc, nodes, node_by_name, counts_cache))
                 for tsc in tscs
             ]
+            # ScheduleAnyway zone spread is SCORED, not filtered, exactly
+            # as kube-scheduler does: among feasible nodes prefer the one
+            # in the least-loaded zone for the pod's soft constraint (the
+            # decision layer already balanced the fleet shape; scoring at
+            # bind time keeps the assignment from drifting off it)
+            soft = soft_zone_tsc(pod)
+            soft_counts = (
+                self._counts_for(soft, nodes, node_by_name, counts_cache)
+                if soft is not None else None
+            )
+            chosen = None
+            chosen_count = None
             for node in nodes:
                 if not tolerates_all(pod.tolerations, node.taints):
                     continue
@@ -272,13 +286,28 @@ class PodBinder:
                     continue
                 if not self._spread_ok(node, spread_counts):
                     continue
-                self.cluster.bind_pod(pod, node)
-                for tsc, counts in spread_counts:
-                    d = node.metadata.labels.get(tsc.topology_key)
-                    if d is not None:
-                        counts[d] = counts.get(d, 0) + 1
-                bound += 1
-                break
+                if soft is None:
+                    chosen = node
+                    break
+                z = node.metadata.labels.get(soft.topology_key)
+                # a node lacking the topology key scores WORST, as in
+                # kube-scheduler's PodTopologySpread (it is outside every
+                # domain); it is still eligible when nothing else fits
+                c = soft_counts.get(z, 0) if z is not None else float("inf")
+                if chosen is None or c < chosen_count:
+                    chosen, chosen_count = node, c
+            if chosen is None:
+                continue
+            self.cluster.bind_pod(pod, chosen)
+            for tsc, counts in spread_counts:
+                d = chosen.metadata.labels.get(tsc.topology_key)
+                if d is not None:
+                    counts[d] = counts.get(d, 0) + 1
+            if soft_counts is not None:
+                d = chosen.metadata.labels.get(soft.topology_key)
+                if d is not None:
+                    soft_counts[d] = soft_counts.get(d, 0) + 1
+            bound += 1
         if bound:
             metrics.PODS_BOUND.inc(bound)
         metrics.NODES_READY.set(float(len(nodes)))

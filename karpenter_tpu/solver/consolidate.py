@@ -343,7 +343,7 @@ def device_eligible(pods: Sequence[Pod]) -> bool:
     """True when every pod is free of the stateful constraints the batch
     evaluator does not model (routing mirror of solver/service.py)."""
     for p in pods:
-        if p.affinity_terms or p.preferred_node_affinity_terms:
+        if p.affinity_terms or p.preferred_node_affinity_terms or p.preferred_affinity_terms:
             return False
         if any(t.hard() for t in p.topology_spread):
             return False

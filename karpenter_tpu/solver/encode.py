@@ -234,16 +234,40 @@ class PodClassSet:
     node_overhead: np.ndarray = None
 
 
+def soft_zone_tsc(pod: Pod):
+    """The pod's single EFFECTIVE soft (ScheduleAnyway) zone-spread
+    preference, or None. Applies only when the pod carries NO hard
+    constraints (a hard constraint owns the pin -- one deterministic pin
+    per pod is what keeps both paths equal) and the pod matches its own
+    selector. With several soft zone constraints the first applies, the
+    rest are scoring no-ops. Canonical definition (solver/spread.py
+    re-exports; living here keeps the import graph acyclic since the
+    class signature below needs it too)."""
+    if any(t.hard() for t in pod.topology_spread):
+        return None
+    soft = [
+        t for t in pod.topology_spread
+        if not t.hard() and t.topology_key == wk.ZONE_LABEL
+    ]
+    if not soft:
+        return None
+    t = soft[0]
+    if not all(pod.metadata.labels.get(k) == v for k, v in t.label_selector.items()):
+        return None
+    return t
+
+
 def _spread_sig(pod: Pod) -> tuple:
     """Spread constraints that shape placement are part of scheduling
     identity: pods that spread differently (or match their own selector
     differently) must not collapse into one class (solver/spread.py
-    distributes per class). That is every HARD constraint plus soft ZONE
-    constraints (the round-4 preference water-fill); soft non-zone
-    constraints stay scoring no-ops and deliberately do not fragment
-    classes. when_unsatisfiable is in the tuple so a hard and a soft
-    constraint of the same shape never share a class."""
-    return tuple(
+    distributes per class). That is every HARD constraint plus the
+    single EFFECTIVE soft zone preference (soft_zone_tsc -- an INERT
+    soft constraint must not fragment otherwise-identical classes);
+    soft non-zone constraints stay scoring no-ops. when_unsatisfiable
+    is in the tuple so a hard and a soft constraint of the same shape
+    never share a class."""
+    sig = tuple(
         (
             t.topology_key,
             t.max_skew,
@@ -252,8 +276,20 @@ def _spread_sig(pod: Pod) -> tuple:
             all(pod.metadata.labels.get(k) == v for k, v in t.label_selector.items()),
         )
         for t in pod.topology_spread
-        if t.hard() or t.topology_key == wk.ZONE_LABEL
+        if t.hard()
     )
+    t = soft_zone_tsc(pod)
+    if t is not None:
+        sig += (
+            (
+                t.topology_key,
+                t.max_skew,
+                t.when_unsatisfiable,
+                tuple(sorted(t.label_selector.items())),
+                True,
+            ),
+        )
+    return sig
 
 
 def pod_sort_key(pod: Pod) -> tuple:
@@ -361,7 +397,7 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
                 pc.has_affinity = True
             if len(pod.node_affinity_terms) > 1:
                 pc.multi_node_affinity = True
-            if pod.preferred_node_affinity_terms:
+            if pod.preferred_node_affinity_terms or pod.preferred_affinity_terms:
                 pc.has_preferences = True
             id_to_class[sid] = pc
         return pc

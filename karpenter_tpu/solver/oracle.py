@@ -90,17 +90,24 @@ class _TopologyState:
         return (tsc.topology_key, tuple(sorted(tsc.label_selector.items())))
 
     def seed_existing(self, pods_by_node: Dict[str, List[Pod]], node_labels: Dict[str, Dict[str, str]]):
-        # soft ZONE constraints seed too: bound pods of a ScheduleAnyway
-        # deployment shape where its pending replicas prefer to go (the
-        # split pass reads the same zone-keyed state via _spread_seeds)
+        # seeds mirror live accounting (_record_placement) exactly: hard
+        # constraints count when the pod matches its own selector, and the
+        # single EFFECTIVE soft zone preference counts once -- a pod with
+        # both a hard and a soft constraint on one selector must not seed
+        # the shared (topology_key, selector) count twice (round-4 review)
         for node, pods in pods_by_node.items():
             for p in pods:
                 for tsc in p.topology_spread:
-                    if not tsc.hard() and tsc.topology_key != wk.ZONE_LABEL:
+                    if not tsc.hard() or not _pod_matches_selector(p, tsc.label_selector):
                         continue
                     domain = node_labels.get(node, {}).get(tsc.topology_key)
                     if domain:
                         self.count(tsc)[domain] = self.count(tsc).get(domain, 0) + 1
+                t = _soft_zone_tsc(p)
+                if t is not None:
+                    domain = node_labels.get(node, {}).get(wk.ZONE_LABEL)
+                    if domain:
+                        self.count(t)[domain] = self.count(t).get(domain, 0) + 1
 
     def count(self, tsc: TopologySpreadConstraint) -> Dict[str, int]:
         return self._counts.setdefault(self._key(tsc), {})
@@ -186,8 +193,9 @@ class Scheduler:
         # when a placement lands (_record_placement clears), so the pinned
         # zone is invariant across the existing-node loop -- without the
         # memo every candidate node pays a catalog/zone scan (round-4
-        # review)
+        # review). _attempt_gen keys one ladder attempt's entries.
         self._zone_choice_memo: Dict[tuple, Optional[str]] = {}
+        self._attempt_gen = 0
         # pod-(anti-)affinity occupancy (reference core scheduling algebra,
         # SURVEY.md section 2.3; BOTH directions enforced):
         #   _labels_on   location (node name / group id) -> pod labels
@@ -312,7 +320,14 @@ class Scheduler:
         differentially equal to the batch path, whose split pass assigns
         zones before node packing. skew=False is the soft-spread variant:
         a preference biases placement but never gates on max_skew."""
-        memo_key = (id(pod), id(tsc), skew, self._soft_relaxed)
+        # the preference-relaxation ladder rebinds node_affinity_terms per
+        # attempt, and the choice below reads scheduling_requirements();
+        # the monotonic attempt counter invalidates the memo across
+        # attempts (a stale None would reject every existing node after
+        # the preference was dropped -- round-4 review; an id() of the
+        # transient terms list is NOT sound, CPython reuses freed
+        # addresses across attempts)
+        memo_key = (id(pod), id(tsc), skew, self._soft_relaxed, self._attempt_gen)
         if memo_key in self._zone_choice_memo:
             return self._zone_choice_memo[memo_key]
         pod_reqs = pod.scheduling_requirements()[0]
@@ -851,33 +866,51 @@ class Scheduler:
 
     def _place_pod(self, pod: Pod, result: SchedulingResult):
         """One placement pass under the current soft-spread state,
-        including the preferred-node-affinity relaxation ladder."""
-        if not pod.preferred_node_affinity_terms:
+        including the UNIFIED preference-relaxation ladder over preferred
+        node affinity AND preferred pod (anti-)affinity (the core's
+        preferences model): all preferences apply as requirements,
+        strongest set first; each failed attempt drops the lowest-weight
+        preference of either kind and retries, ending with none.
+
+        Attempts mutate-and-restore node_affinity_terms/affinity_terms;
+        the grouping signature is memoized FROM THE ORIGINAL SPEC first,
+        so helpers that read it mid-attempt (_env_key) can never capture
+        a variant. An HONORED preferred anti-affinity term is recorded
+        like a required one (_record_anti_terms reads the live terms), so
+        it keeps repelling later arrivals -- a stricter deterministic
+        refinement of upstream's per-pod scoring, in the same spirit as
+        the min-count spread pin."""
+        self._attempt_gen += 1
+        node_prefs = [(w, "node", term) for w, term in pod.preferred_node_affinity_terms]
+        pod_prefs = [(w, "pod", t) for w, t in pod.preferred_affinity_terms]
+        if not node_prefs and not pod_prefs:
             return self._attempt_placement(pod, result)
-        # preference relaxation (the core's preferences model): the
-        # pod's preferred node-affinity terms apply as
-        # REQUIREMENTS, strongest set first; each failed attempt
-        # drops the lowest-weight preference and retries, ending
-        # with none. Attempts mutate-and-restore
-        # node_affinity_terms; the grouping signature is memoized
-        # FROM THE ORIGINAL SPEC first, so helpers that read it
-        # mid-attempt (_env_key) can never capture a variant.
+        prefs = sorted(node_prefs + pod_prefs, key=lambda p: -p[0])
         pod.grouping_signature()
         original_nat = pod.node_affinity_terms
+        original_aff = pod.affinity_terms
         placed, reasons = False, []
         try:
-            for prefs in pod.preference_variants():
-                if prefs:
+            for n in range(len(prefs), -1, -1):
+                self._attempt_gen += 1
+                active = prefs[:n]
+                node_terms = [term for _, kind, term in active if kind == "node"]
+                pod_terms = [t for _, kind, t in active if kind == "pod"]
+                if node_terms:
                     base = original_nat or [[]]
-                    flat = [r for term in prefs for r in term]
+                    flat = [r for term in node_terms for r in term]
                     pod.node_affinity_terms = [list(t) + flat for t in base]
                 else:
                     pod.node_affinity_terms = original_nat
+                pod.affinity_terms = (
+                    original_aff + pod_terms if pod_terms else original_aff
+                )
                 placed, reasons = self._attempt_placement(pod, result)
                 if placed:
                     break
         finally:
             pod.node_affinity_terms = original_nat
+            pod.affinity_terms = original_aff
         return placed, reasons
 
     def _attempt_placement(self, pod: Pod, result: SchedulingResult):

@@ -80,25 +80,9 @@ def hard_zone_tsc(pod: Pod) -> Optional[TopologySpreadConstraint]:
     return t
 
 
-def soft_zone_tsc(pod: Pod) -> Optional[TopologySpreadConstraint]:
-    """The pod's single effective SOFT (ScheduleAnyway) zone-spread
-    preference, or None. Applies only when the pod carries NO hard
-    constraints (a hard constraint owns the pin -- one deterministic pin
-    per pod is what keeps both paths equal) and the pod matches its own
-    selector. With several soft zone constraints the first applies, the
-    rest are scoring no-ops."""
-    if any(t.hard() for t in pod.topology_spread):
-        return None
-    soft = [
-        t for t in pod.topology_spread
-        if not t.hard() and t.topology_key == wk.ZONE_LABEL
-    ]
-    if not soft:
-        return None
-    t = soft[0]
-    if not all(pod.metadata.labels.get(k) == v for k, v in t.label_selector.items()):
-        return None
-    return t
+# canonical definition lives in encode (the class signature needs it and
+# this module imports encode); re-exported here as the public name
+soft_zone_tsc = encode.soft_zone_tsc
 
 
 def spread_eligible(pods: Sequence[Pod]) -> bool:

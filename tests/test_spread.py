@@ -515,6 +515,110 @@ class TestSoftSpreadPreference:
         ) == [2, 2, 2, 2]
 
 
+class TestSpreadPreferenceInteractions:
+    """Round-4 review regressions: spread state vs the preference ladder
+    and class-identity edges."""
+
+    def test_zone_choice_recomputed_after_preference_relaxes(self, catalog_items):
+        """A hard-spread pod whose preferred node affinity pins an
+        infeasible zone: after the preference drops, the pod must still
+        pack onto the existing node in its min-count zone (a stale
+        zone-choice memo from the failed attempt rejected every node)."""
+        from karpenter_tpu.scheduling import Operator, Requirement
+        from karpenter_tpu.solver.oracle import ExistingNode
+
+        node = ExistingNode(
+            name="n1",
+            labels={wk.ZONE_LABEL: "us-central-1a", "node": "n1"},
+            allocatable=Resources({"cpu": "8", "memory": "16Gi", "pods": 30}),
+        )
+        p = Pod(
+            "p0",
+            requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+            labels={"app": "web"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"}
+                )
+            ],
+            preferred_node_affinity_terms=[
+                (10, [Requirement(wk.ZONE_LABEL, Operator.IN, ["zone-on-the-moon"])])
+            ],
+        )
+        pool = NodePool("default")
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=[pool], instance_types={pool.name: catalog_items},
+            existing_nodes=[node], zones=zones,
+        )
+        result = sched.schedule([p])
+        assert not result.unschedulable
+        assert result.existing_assignments.get("p0") == "n1", (
+            "relaxed pod must pack onto the existing min-count-zone node"
+        )
+
+    def test_hard_plus_soft_same_selector_seeds_once(self, catalog_items):
+        """A bound pod carrying BOTH a hard and a soft zone constraint on
+        one selector seeds the shared (zone, selector) count ONCE."""
+        from karpenter_tpu.solver.oracle import ExistingNode
+
+        both = Pod(
+            "both",
+            requests=Resources({"cpu": "100m"}),
+            labels={"app": "web"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"}
+                ),
+                TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"},
+                    when_unsatisfiable="ScheduleAnyway",
+                ),
+            ],
+        )
+        node = ExistingNode(
+            name="n1",
+            labels={wk.ZONE_LABEL: "us-central-1a"},
+            allocatable=Resources({"cpu": "8", "memory": "16Gi", "pods": 30}),
+        )
+        pool = NodePool("default")
+        sched = Scheduler(
+            nodepools=[pool], instance_types={pool.name: catalog_items},
+            existing_nodes=[node], pods_by_node={"n1": [both]},
+            zones={"us-central-1a", "us-central-1b"},
+        )
+        counts = sched.topology._counts[
+            (wk.ZONE_LABEL, (("app", "web"),))
+        ]
+        assert counts == {"us-central-1a": 1}, counts
+
+    def test_inert_soft_constraint_does_not_fragment_classes(self):
+        """An extra ScheduleAnyway zone constraint that is INERT (the pod
+        also carries a hard constraint, which owns the pin) must not split
+        otherwise-identical pods into separate classes."""
+        from karpenter_tpu.solver import encode
+
+        def mk(name, with_inert_soft):
+            tscs = [
+                TopologySpreadConstraint(
+                    max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"}
+                )
+            ]
+            if with_inert_soft:
+                tscs.append(
+                    TopologySpreadConstraint(
+                        max_skew=2, topology_key=wk.ZONE_LABEL,
+                        label_selector={"app": "web"},
+                        when_unsatisfiable="ScheduleAnyway",
+                    )
+                )
+            return Pod(name, requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                       labels={"app": "web"}, topology_spread=tscs)
+
+        classes = encode.group_pods([mk("a", False), mk("b", True)])
+        assert len(classes) == 1, "inert soft constraint fragmented the class"
+
+
 class TestMultiNodePool:
     """VERDICT round 2, item 4: several nodepools batch on device in weight
     order, first-feasible-pool-wins."""
