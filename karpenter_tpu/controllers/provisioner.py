@@ -262,18 +262,21 @@ class PodBinder:
                 (tsc, self._counts_for(tsc, nodes, node_by_name, counts_cache))
                 for tsc in tscs
             ]
-            # ScheduleAnyway zone spread is SCORED, not filtered, exactly
-            # as kube-scheduler does: among feasible nodes prefer the one
-            # in the least-loaded zone for the pod's soft constraint (the
-            # decision layer already balanced the fleet shape; scoring at
-            # bind time keeps the assignment from drifting off it)
+            # preferences are SCORED, not filtered, exactly as
+            # kube-scheduler does (PodTopologySpread scoring for
+            # ScheduleAnyway, InterPodAffinity scoring for weighted
+            # (anti-)affinity): among feasible nodes prefer the one with
+            # the highest satisfied preference weight, least-loaded zone
+            # as the tie-break (the decision layer already honored these;
+            # scoring at bind time keeps the assignment from drifting)
             soft = soft_zone_tsc(pod)
             soft_counts = (
                 self._counts_for(soft, nodes, node_by_name, counts_cache)
                 if soft is not None else None
             )
+            prefs = pod.preferred_affinity_terms
             chosen = None
-            chosen_count = None
+            chosen_key = None
             for node in nodes:
                 if not tolerates_all(pod.tolerations, node.taints):
                     continue
@@ -286,16 +289,22 @@ class PodBinder:
                     continue
                 if not self._spread_ok(node, spread_counts):
                     continue
-                if soft is None:
+                if soft is None and not prefs:
                     chosen = node
                     break
-                z = node.metadata.labels.get(soft.topology_key)
-                # a node lacking the topology key scores WORST, as in
-                # kube-scheduler's PodTopologySpread (it is outside every
-                # domain); it is still eligible when nothing else fits
-                c = soft_counts.get(z, 0) if z is not None else float("inf")
-                if chosen is None or c < chosen_count:
-                    chosen, chosen_count = node, c
+                if soft is not None:
+                    z = node.metadata.labels.get(soft.topology_key)
+                    # a node lacking the topology key scores WORST, as in
+                    # kube-scheduler's PodTopologySpread (outside every
+                    # domain); still eligible when nothing else fits
+                    c = soft_counts.get(z, 0) if z is not None else float("inf")
+                else:
+                    c = 0
+                # higher satisfied preference weight wins; fewer same-
+                # selector pods in the zone breaks ties; then first-fit
+                key = (-self._preference_score(pod, node, prefs), c)
+                if chosen is None or key < chosen_key:
+                    chosen, chosen_key = node, key
             if chosen is None:
                 continue
             self.cluster.bind_pod(pod, chosen)
@@ -360,6 +369,37 @@ class PodBinder:
             if counts.get(domain, 0) + 1 - global_min > tsc.max_skew:
                 return False
         return True
+
+    def _preference_score(self, pod, node, prefs) -> int:
+        """Total weight of the pod's preferred (anti-)affinity terms a bind
+        to `node` would satisfy -- kube-scheduler's InterPodAffinity
+        scoring over the hostname and zone topology keys."""
+        if not prefs:
+            return 0
+        from karpenter_tpu.apis import Pod as _Pod
+
+        score = 0
+        node_zone = node.metadata.labels.get(wk.ZONE_LABEL)
+        for w, term in prefs:
+            if term.topology_key == wk.HOSTNAME_LABEL:
+                dom = self.cluster.pods_on_node(node.metadata.name)
+            elif term.topology_key == wk.ZONE_LABEL and node_zone is not None:
+                dom = []
+                for p in self.cluster.list(_Pod):
+                    if not p.node_name:
+                        continue
+                    pn = self.cluster.try_get(Node, p.node_name)
+                    if pn is not None and pn.metadata.labels.get(wk.ZONE_LABEL) == node_zone:
+                        dom.append(p)
+            else:
+                continue
+            matched = any(
+                all(o.metadata.labels.get(k) == v for k, v in term.label_selector.items())
+                for o in dom
+            )
+            if matched != term.anti:
+                score += w
+        return score
 
     def _anti_affinity_ok(self, pod, node) -> bool:
         on_node = self.cluster.pods_on_node(node.metadata.name)

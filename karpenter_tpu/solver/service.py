@@ -215,22 +215,20 @@ class TPUSolver:
             classes = encode.group_pods(pods)
         # minValues flexibility is a set-cardinality constraint over a
         # group's SURVIVING types -- stateful across joins, oracle-only.
-        # Scoped to pools some class could actually schedule to: a niche
-        # minValues pool behind taints/labels must not knock unrelated
-        # batches off the fast path
-        from karpenter_tpu.solver.oracle import _ALLOW_UNDEFINED
-
-        mv_pools = [
-            p for p in scheduler.nodepools
-            if any(r.min_values is not None for r in p.requirements())
-        ]
-        if mv_pools:
-            for pc in classes:
-                if any(
-                    p.requirements().compatible(pc.requirements, allow_undefined=_ALLOW_UNDEFINED)
-                    for p in mv_pools
-                ):
-                    return False
+        # Round 4 narrows the cliff from batch-global to CLASS-level: only
+        # the classes a minValues pool could actually schedule are carved
+        # off to the oracle (schedule() does the split); the rest stay on
+        # device. The whole batch still routes to the oracle when every
+        # class is affected, or when the two partitions could contend
+        # (_mv_partition_blocked: a shared existing node or a shared
+        # spread selector couples them, and a partitioned solve could
+        # then diverge from the oracle's interleaved order).
+        mv_classes = TPUSolver._mv_classes(scheduler, classes)
+        if mv_classes:
+            mv_ids = {id(pc) for pc in mv_classes}
+            rest = [pc for pc in classes if id(pc) not in mv_ids]
+            if not rest or TPUSolver._mv_partition_blocked(scheduler, mv_classes, rest):
+                return False
         reps = []
         any_spread = False
         any_soft = False
@@ -266,6 +264,75 @@ class TPUSolver:
             if not spread.spread_eligible(reps) or len(scheduler.nodepools) > 1:
                 return False
         return True
+
+    @staticmethod
+    def _mv_classes(scheduler: Scheduler, classes) -> list:
+        """The classes some minValues pool could schedule (the
+        oracle-bound partition). Scoped to pools a class is actually
+        compatible with: a niche minValues pool behind taints/labels must
+        not knock unrelated classes off the fast path."""
+        from karpenter_tpu.solver.oracle import _ALLOW_UNDEFINED
+
+        mv_pools = [
+            p for p in scheduler.nodepools
+            if any(r.min_values is not None for r in p.requirements())
+        ]
+        if not mv_pools:
+            return []
+        return [
+            pc for pc in classes
+            if any(
+                p.requirements().compatible(pc.requirements, allow_undefined=_ALLOW_UNDEFINED)
+                for p in mv_pools
+            )
+        ]
+
+    @staticmethod
+    def _mv_partition_blocked(scheduler: Scheduler, mv_classes, rest) -> bool:
+        """True when the minValues partition could CONTEND with the device
+        partition, so the split would not be oracle-equivalent:
+
+        - some existing node admits pods from BOTH sides (the oracle packs
+          existing capacity in one interleaved FFD order; two independent
+          passes could book it differently), or
+        - the two sides share a topology-spread selector (spread counts
+          are global per selector; splitting the state diverges).
+
+        Cross-pool GROUP sharing needs no check here: a class compatible
+        with both a minValues pool and a plain pool is overlapping-compat
+        and schedule() routes the whole batch to the oracle first."""
+        from karpenter_tpu.scheduling import tolerates_all
+
+        # per-class admission inputs hoisted out of the node loop:
+        # scheduling_requirements() builds fresh Requirements per call, and
+        # this check runs on the hot routing path (round-4 review)
+        def side_reqs(side):
+            return [
+                (pc.pods[0].tolerations, pc.pods[0].scheduling_requirements())
+                for pc in side
+            ]
+
+        mv_reqs, rest_reqs = side_reqs(mv_classes), side_reqs(rest)
+
+        def admits(node, tol, alts) -> bool:
+            if not tolerates_all(tol, node.taints):
+                return False
+            return any(alt.matches_labels(node.labels) for alt in alts)
+
+        for node in scheduler.existing:
+            if any(admits(node, tol, alts) for tol, alts in mv_reqs) and any(
+                admits(node, tol, alts) for tol, alts in rest_reqs
+            ):
+                return True
+
+        def spread_keys(side) -> set:
+            return {
+                (t.topology_key, tuple(sorted(t.label_selector.items())))
+                for pc in side
+                for t in pc.pods[0].topology_spread
+            }
+
+        return bool(spread_keys(mv_classes) & spread_keys(rest))
 
     @staticmethod
     def _pools_overlap(pools: Sequence[NodePool], pods: Sequence[Pod], classes=None) -> bool:
@@ -324,7 +391,33 @@ class TPUSolver:
             # express that, so overlapping-compat batches take the oracle
             scheduler.objective = self.objective
             return scheduler.schedule(pods)
+        # minValues class-level split (round 4): supports() has already
+        # verified the partition is uncoupled (no shared existing node, no
+        # shared spread selector; overlap was gated above), so the
+        # minValues-affected classes run on the oracle and everything else
+        # stays on device. The oracle pass runs first and mutates the
+        # shared existing-node accounting, which the device pass then sees.
+        mv_classes = self._mv_classes(scheduler, base_classes)
+        mv_result = None
+        if mv_classes:
+            mv_ids = {id(pc) for pc in mv_classes}
+            mv_pods = [p for pc in mv_classes for p in pc.pods]
+            base_classes = [pc for pc in base_classes if id(pc) not in mv_ids]
+            pods = [p for pc in base_classes for p in pc.pods]
+            if self._route_monitor.has_changed("route_mv", len(mv_pods)):
+                self.log.info(
+                    "minValues classes to oracle, remainder on device",
+                    oracle_pods=len(mv_pods), device_pods=len(pods),
+                )
+            scheduler.objective = self.objective
+            mv_result = scheduler.schedule(mv_pods)
         result = SchedulingResult()
+        if mv_result is not None:
+            result.new_groups.extend(mv_result.new_groups)
+            result.existing_assignments.update(mv_result.existing_assignments)
+            if not pods:
+                result.unschedulable.update(mv_result.unschedulable)
+                return result
         pods_left: List[Pod] = list(pods)
         for i, pool in enumerate(pools):
             items = scheduler.instance_types.get(pool.name, [])
@@ -350,6 +443,11 @@ class TPUSolver:
         if pods_left and not result.unschedulable:
             for p in pods_left:
                 result.unschedulable[p.metadata.name] = "no instance types for nodepool"
+        if mv_result is not None:
+            # merged last: the pool loop REPLACES result.unschedulable with
+            # each round's leftovers, which must not clobber the oracle
+            # partition's entries
+            result.unschedulable.update(mv_result.unschedulable)
         return result
 
     # -- the batch solve ----------------------------------------------------

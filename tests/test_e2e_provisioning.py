@@ -179,6 +179,32 @@ class TestTopologyAndAffinity:
         assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
         assert len(zone_counts) >= 3
 
+    def test_preferred_anti_affinity_survives_bind_time(self, env):
+        """The binder must not drift off an honored preference: the anchor
+        lands in its pinned zone, and the replica with preferred zone
+        anti-affinity must bind OUTSIDE that zone even when the anchor's
+        node has room (kube-scheduler scores InterPodAffinity; first-fit
+        would co-locate)."""
+        anchor = Pod("anchor", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                     labels={"app": "spready"},
+                     node_selector={wk.ZONE_LABEL: "us-central-1a"})
+        repelled = Pod(
+            "repelled", requests=Resources({"cpu": "250m", "memory": "512Mi"}),
+            labels={"app": "spready"},
+            preferred_affinity_terms=[
+                (10, PodAffinityTerm(label_selector={"app": "spready"},
+                                     topology_key=wk.ZONE_LABEL, anti=True))
+            ],
+        )
+        env.cluster.create(anchor)
+        env.cluster.create(repelled)
+        env.settle()
+        assert not env.cluster.pending_pods()
+        za = env.cluster.get(Node, anchor.node_name).metadata.labels[wk.ZONE_LABEL]
+        zr = env.cluster.get(Node, repelled.node_name).metadata.labels[wk.ZONE_LABEL]
+        assert za == "us-central-1a"
+        assert zr != za, "bind-time scoring must honor the anti preference"
+
     def test_hostname_anti_affinity(self, env):
         term = PodAffinityTerm(label_selector={"app": "solo"}, topology_key=wk.HOSTNAME_LABEL, anti=True)
         for i in range(3):

@@ -708,6 +708,162 @@ class TestMultiNodePool:
         }
 
 
+class TestMinValuesPartition:
+    """Round-4 cliff narrowing (VERDICT item 6): only the classes a
+    minValues pool could schedule route to the oracle; the remainder of
+    the batch stays on the device path."""
+
+    def _pools(self):
+        from karpenter_tpu.scheduling import Operator as Op, Requirement
+
+        mv = NodePool("arm-flex")
+        mv.weight = 10
+        mv.template.requirements = [
+            Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"]),
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Op.EXISTS, min_values=2),
+        ]
+        plain = NodePool("amd")
+        plain.weight = 1
+        plain.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])]
+        return mv, plain
+
+    def _pods(self, n_mv=3, n_plain=5):
+        mv_pods = [
+            Pod(f"graviton{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "arm64"})
+            for i in range(n_mv)
+        ]
+        plain_pods = [
+            Pod(f"x86-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(n_plain)
+        ]
+        return mv_pods, plain_pods
+
+    def test_partition_supported_and_differential(self, catalog_items):
+        mv, plain = self._pools()
+        mv_pods, plain_pods = self._pods()
+        pods = mv_pods + plain_pods
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+
+        def mk():
+            return Scheduler(
+                nodepools=[mv, plain],
+                instance_types={"arm-flex": catalog_items, "amd": catalog_items},
+                zones=zones,
+            )
+
+        assert TPUSolver.supports(mk(), pods), (
+            "a niche minValues pool must not knock the whole batch off device"
+        )
+        oracle = mk().schedule(list(pods))
+        device = TPUSolver(g_max=256).schedule(mk(), list(pods))
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+
+        def by_pool(result):
+            out = {}
+            for g in result.new_groups:
+                out.setdefault(g.nodepool.name, []).append(
+                    sorted(p.metadata.name for p in g.pods)
+                )
+            return {k: sorted(v) for k, v in out.items()}
+
+        assert by_pool(oracle) == by_pool(device)
+        # the minValues groups keep the flexibility floor
+        for g in device.new_groups:
+            if g.nodepool.name == "arm-flex":
+                fams = {it.requirements.labels()[wk.LABEL_INSTANCE_FAMILY]
+                        for it in g.instance_types}
+                assert len(fams) >= 2
+
+    def test_only_mv_classes_hit_the_oracle(self, catalog_items, monkeypatch):
+        """The oracle sees EXACTLY the minValues partition's pods."""
+        mv, plain = self._pools()
+        mv_pods, plain_pods = self._pods()
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=[mv, plain],
+            instance_types={"arm-flex": catalog_items, "amd": catalog_items},
+            zones=zones,
+        )
+        seen = []
+        orig = Scheduler.schedule
+
+        def spy(self, pods):
+            seen.append(sorted(p.metadata.name for p in pods))
+            return orig(self, pods)
+
+        monkeypatch.setattr(Scheduler, "schedule", spy)
+        result = TPUSolver(g_max=256).schedule(sched, mv_pods + plain_pods)
+        assert not result.unschedulable
+        assert seen == [sorted(p.metadata.name for p in mv_pods)], (
+            "oracle must see only the minValues partition"
+        )
+
+    def test_whole_batch_affected_routes_whole_batch(self, catalog_items):
+        """Every class compatible with the minValues pool: no partition."""
+        mv, _ = self._pools()
+        mv_pods, _ = self._pods(n_plain=0)
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=[mv], instance_types={"arm-flex": catalog_items}, zones=zones,
+        )
+        assert not TPUSolver.supports(sched, mv_pods)
+
+    def test_shared_existing_node_blocks_partition(self, catalog_items):
+        """An existing node that admits pods from BOTH partitions couples
+        them (the oracle packs existing capacity in one interleaved FFD
+        order, which two independent passes cannot reproduce): the whole
+        batch routes to the oracle. The node here satisfies the mv side's
+        arch demand AND the device side's category demand -- each side
+        conflicts with the OTHER pool, so there is no pool overlap, yet
+        both can land on this one node."""
+        from karpenter_tpu.scheduling import Operator as Op, Requirement
+        from karpenter_tpu.solver.oracle import ExistingNode
+
+        mv = NodePool("arm-flex")
+        mv.template.requirements = [
+            Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"]),
+            Requirement(wk.LABEL_INSTANCE_CATEGORY, Op.IN, ["c"]),
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Op.EXISTS, min_values=2),
+        ]
+        plain = NodePool("amd")
+        plain.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])]
+        mv_pods = [
+            Pod(f"graviton{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "arm64"})
+            for i in range(2)
+        ]
+        m_pods = [
+            Pod(f"mcat-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                node_selector={wk.LABEL_INSTANCE_CATEGORY: "m"})
+            for i in range(2)
+        ]
+        node = ExistingNode(
+            name="n1",
+            labels={
+                wk.ARCH_LABEL: "arm64",
+                wk.LABEL_INSTANCE_CATEGORY: "m",
+                wk.ZONE_LABEL: "us-central-1a",
+            },
+            allocatable=Resources({"cpu": "8", "memory": "16Gi", "pods": 30}),
+        )
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+
+        def mk(existing):
+            return Scheduler(
+                nodepools=[mv, plain],
+                instance_types={"arm-flex": catalog_items, "amd": catalog_items},
+                existing_nodes=existing,
+                zones=zones,
+            )
+
+        # without the node, the partition is clean
+        assert TPUSolver.supports(mk([]), mv_pods + m_pods)
+        # with the coupling node, the whole batch must take the oracle
+        assert not TPUSolver.supports(mk([node]), mv_pods + m_pods)
+
+
 class TestSpreadEndToEnd:
     def test_spread_burst_on_kwok_rig(self):
         from karpenter_tpu.cache.ttl import FakeClock
