@@ -275,6 +275,11 @@ class PodBinder:
                 if soft is not None else None
             )
             prefs = pod.preferred_affinity_terms
+            pref_zone_counts = {
+                id(term): self._pref_zone_counts(term, node_by_name, counts_cache)
+                for _, term in prefs
+                if term.topology_key == wk.ZONE_LABEL
+            }
             chosen = None
             chosen_key = None
             for node in nodes:
@@ -302,7 +307,7 @@ class PodBinder:
                     c = 0
                 # higher satisfied preference weight wins; fewer same-
                 # selector pods in the zone breaks ties; then first-fit
-                key = (-self._preference_score(pod, node, prefs), c)
+                key = (-self._preference_score(pod, node, prefs, pref_zone_counts), c)
                 if chosen is None or key < chosen_key:
                     chosen, chosen_key = node, key
             if chosen is None:
@@ -316,6 +321,15 @@ class PodBinder:
                 d = chosen.metadata.labels.get(soft.topology_key)
                 if d is not None:
                     soft_counts[d] = soft_counts.get(d, 0) + 1
+            # the bound pod may match other pods' preferred-affinity
+            # selectors cached this reconcile: keep those domains current
+            zb = chosen.metadata.labels.get(wk.ZONE_LABEL)
+            if zb is not None:
+                for (kind, sel), counts in counts_cache.items():
+                    if kind == "prefzone" and all(
+                        pod.metadata.labels.get(k) == v for k, v in sel
+                    ):
+                        counts[zb] = counts.get(zb, 0) + 1
             bound += 1
         if bound:
             metrics.PODS_BOUND.inc(bound)
@@ -370,33 +384,51 @@ class PodBinder:
                 return False
         return True
 
-    def _preference_score(self, pod, node, prefs) -> int:
-        """Total weight of the pod's preferred (anti-)affinity terms a bind
-        to `node` would satisfy -- kube-scheduler's InterPodAffinity
-        scoring over the hostname and zone topology keys."""
-        if not prefs:
-            return 0
+    def _pref_zone_counts(self, term, node_by_name, cache):
+        """Per-zone count of bound pods matching a preferred-affinity
+        term's selector: ONE cluster scan per distinct selector per
+        reconcile (same pattern as _counts_for; a per-candidate-node scan
+        would be O(pods x nodes) -- round-4 review), updated on bind."""
         from karpenter_tpu.apis import Pod as _Pod
 
+        key = ("prefzone", tuple(sorted(term.label_selector.items())))
+        counts = cache.get(key)
+        if counts is not None:
+            return counts
+        counts = cache[key] = {}
+        for p in self.cluster.list(_Pod):
+            if not p.node_name:
+                continue
+            if not all(p.metadata.labels.get(k) == v for k, v in term.label_selector.items()):
+                continue
+            pn = node_by_name.get(p.node_name) or self.cluster.try_get(Node, p.node_name)
+            if pn is None:
+                continue
+            z = pn.metadata.labels.get(wk.ZONE_LABEL)
+            if z is not None:
+                counts[z] = counts.get(z, 0) + 1
+        return counts
+
+    def _preference_score(self, pod, node, prefs, zone_counts) -> int:
+        """Total weight of the pod's preferred (anti-)affinity terms a bind
+        to `node` would satisfy -- kube-scheduler's InterPodAffinity
+        scoring over the hostname and zone topology keys. `zone_counts`
+        maps id(term) -> the term's per-zone matched-pod counts
+        (_pref_zone_counts, cached per reconcile)."""
+        if not prefs:
+            return 0
         score = 0
         node_zone = node.metadata.labels.get(wk.ZONE_LABEL)
         for w, term in prefs:
             if term.topology_key == wk.HOSTNAME_LABEL:
-                dom = self.cluster.pods_on_node(node.metadata.name)
+                matched = any(
+                    all(o.metadata.labels.get(k) == v for k, v in term.label_selector.items())
+                    for o in self.cluster.pods_on_node(node.metadata.name)
+                )
             elif term.topology_key == wk.ZONE_LABEL and node_zone is not None:
-                dom = []
-                for p in self.cluster.list(_Pod):
-                    if not p.node_name:
-                        continue
-                    pn = self.cluster.try_get(Node, p.node_name)
-                    if pn is not None and pn.metadata.labels.get(wk.ZONE_LABEL) == node_zone:
-                        dom.append(p)
+                matched = zone_counts[id(term)].get(node_zone, 0) > 0
             else:
                 continue
-            matched = any(
-                all(o.metadata.labels.get(k) == v for k, v in term.label_selector.items())
-                for o in dom
-            )
             if matched != term.anti:
                 score += w
         return score

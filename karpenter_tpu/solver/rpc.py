@@ -3,13 +3,26 @@
 SURVEY.md section 2.4/5 maps the reference's cloud-RPC seam (aws-sdk over
 HTTPS with batching) to an RPC boundary between the host-side reconcilers
 and the solver process on the TPU VM. This module implements that boundary
-as a dependency-free length-prefixed binary protocol over TCP (the image
-ships no grpc; the frame layout below is trivially portable to gRPC
-streaming messages later):
+as a dependency-free length-prefixed binary protocol (the image ships no
+grpc; the frame layout below is trivially portable to gRPC streaming
+messages later):
 
     frame := u32 header_len | header_json | payload_bytes
     header := {"op"|"ok": ..., meta..., "tensors": [{name, dtype, shape}]}
     payload := the tensors' raw little-endian buffers, concatenated
+
+Security posture (round 4, mirroring the reference's HTTPS+SigV4 seams,
+`pkg/operator/operator.go:97-98`):
+
+- the DEFAULT transport is a UNIX domain socket (mode 0600) -- filesystem
+  permissions are the trust boundary, exactly right for the sidecar
+  topology where reconcilers and solver share a pod;
+- a TCP listener REQUIRES a shared token (constructor arg or
+  KARPENTER_TPU_SOLVER_TOKEN) unless `insecure_tcp=True` is an explicit
+  operator decision; the client proves it with an `auth` frame -- the
+  FIRST frame on the connection, compared constant-time -- before any
+  other op is dispatched;
+- TCP can additionally be wrapped in TLS (`ssl_context` on both ends).
 
 Design constraints carried over from the in-process solver (SURVEY.md
 section 7 hard part #6 -- the 100 ms budget leaves no room for re-shipping
@@ -23,7 +36,9 @@ Server-side compute = the same jitted kernels the in-process path uses
 """
 from __future__ import annotations
 
+import hmac
 import json
+import os
 import socket
 import socketserver
 import struct
@@ -33,6 +48,23 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from karpenter_tpu.solver import encode, ffd
+
+TOKEN_ENV = "KARPENTER_TPU_SOLVER_TOKEN"
+
+
+def default_socket_path() -> str:
+    """Default sidecar socket location. Without XDG_RUNTIME_DIR the
+    fallback is a PER-USER mode-0700 directory, never bare /tmp: a
+    predictable world-writable path invites local socket squatting (an
+    attacker pre-binds it and serves forged scheduling decisions)."""
+    base = os.environ.get("XDG_RUNTIME_DIR")
+    if not base:
+        base = f"/tmp/karpenter-tpu-{os.getuid()}"
+        os.makedirs(base, mode=0o700, exist_ok=True)
+        # pre-existing dir: enforce ownership semantics loudly (chmod on
+        # another user's squatted dir raises EPERM instead of trusting it)
+        os.chmod(base, 0o700)
+    return os.path.join(base, "karpenter-tpu-solver.sock")
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 256 * 1024 * 1024
@@ -62,9 +94,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket) -> Tuple[dict, Dict[str, np.ndarray]]:
+def _recv_frame(
+    sock: socket.socket, limit: int = MAX_FRAME
+) -> Tuple[dict, Dict[str, np.ndarray]]:
     (hlen,) = _LEN.unpack(_recv_exact(sock, 4))
-    if hlen > MAX_FRAME:
+    if hlen > limit:
         raise ConnectionError(f"oversized header ({hlen} bytes)")
     header = json.loads(_recv_exact(sock, hlen))
     tensors: Dict[str, np.ndarray] = {}
@@ -79,7 +113,7 @@ def _recv_frame(sock: socket.socket) -> Tuple[dict, Dict[str, np.ndarray]]:
         total += nbytes
         # bound the payload BEFORE allocating: a hostile header must not be
         # able to make the sidecar allocate unbounded buffers
-        if nbytes > MAX_FRAME or total > MAX_FRAME:
+        if nbytes > limit or total > limit:
             raise ConnectionError(f"oversized tensor payload ({total} bytes)")
         raw = _recv_exact(sock, nbytes)
         tensors[spec["name"]] = np.frombuffer(raw, dtype=dtype).reshape(shape)
@@ -96,29 +130,106 @@ class _StagedEntry:
 
 
 class SolverServer:
-    """Serves stage/solve/ping over persistent TCP connections. One staged
-    catalog per seqnum (bounded LRU of 4: catalogs change 12-hourly)."""
+    """Serves auth/stage/solve/ping over persistent connections. One staged
+    catalog per seqnum (bounded LRU of 4: catalogs change 12-hourly).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    Transports: `path` -> UNIX domain socket (mode 0600, the default
+    deployment); `host`/`port` -> TCP, which REQUIRES a shared token
+    unless `insecure_tcp=True`; `ssl_context` optionally wraps accepted
+    TCP connections in TLS."""
+
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 0, *,
+        path: Optional[str] = None, token: Optional[str] = None,
+        insecure_tcp: bool = False, ssl_context=None,
+    ):
         self._staged: Dict[str, _StagedEntry] = {}
         self._lock = threading.Lock()
+        self._token = token if token is not None else os.environ.get(TOKEN_ENV)
+        # an empty token is UNSET, not a guessable one-value secret: it
+        # must neither satisfy the TCP guard nor be compared against
+        if not self._token:
+            self._token = None
+        if path is None and self._token is None and not insecure_tcp:
+            raise ValueError(
+                "a TCP solver listener requires a shared token (token= or "
+                f"${TOKEN_ENV}); pass insecure_tcp=True only as an explicit "
+                "operator decision, or use a UNIX socket (path=)"
+            )
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
+                # per-connection auth state: with a token configured, the
+                # FIRST frame must be a valid auth op; anything else closes
+                # the connection (no op is dispatched unauthenticated).
+                # Pre-auth frames are capped at 4 KB -- an unauthenticated
+                # peer must not be able to force MAX_FRAME allocations.
+                authed = outer._token is None
                 try:
+                    if ssl_context is not None:
+                        # handshake in THIS per-connection thread, never in
+                        # the accept loop (a stalled handshake must not
+                        # wedge the server), and bounded by a timeout
+                        self.request.settimeout(30.0)
+                        self.request = ssl_context.wrap_socket(
+                            self.request, server_side=True
+                        )
+                        self.request.settimeout(None)
                     while True:
-                        header, tensors = _recv_frame(self.request)
+                        header, tensors = _recv_frame(
+                            self.request,
+                            limit=MAX_FRAME if authed else 4096,
+                        )
+                        op = header.get("op")
+                        if op == "auth":
+                            supplied = str(header.get("token", ""))
+                            if outer._token is None or hmac.compare_digest(
+                                supplied, outer._token
+                            ):
+                                authed = True
+                                _send_frame(self.request, {"ok": True})
+                                continue
+                            _send_frame(
+                                self.request, {"ok": False, "error": "unauthenticated"}
+                            )
+                            return
+                        if not authed:
+                            _send_frame(
+                                self.request, {"ok": False, "error": "unauthenticated"}
+                            )
+                            return
                         outer._dispatch(self.request, header, tensors)
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError, ValueError):
                     return
 
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
+        if path is not None:
+            class Server(socketserver.ThreadingUnixStreamServer):
+                daemon_threads = True
 
-        self._server = Server((host, port), Handler)
-        self.address = self._server.server_address
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            # bind under a restrictive umask: chmod-after-bind leaves a
+            # window where any local user could connect and keep the
+            # (tokenless) connection past the chmod
+            old_umask = os.umask(0o177)
+            try:
+                self._server = Server(path, Handler)
+            finally:
+                os.umask(old_umask)
+            os.chmod(path, 0o600)
+            self.address = path
+            self.path = path
+        else:
+            class Server(socketserver.ThreadingTCPServer):
+                allow_reuse_address = True
+                daemon_threads = True
+
+            self._server = Server((host, port), Handler)
+            self.address = self._server.server_address
+            self.path = None
         self._thread: Optional[threading.Thread] = None
 
     def start(self) -> "SolverServer":
@@ -244,9 +355,18 @@ class SolverClient:
     one persistent connection; `solve_classes` mirrors the tensor half of
     TPUSolver.solve (the caller does host-side encode/decode)."""
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0):
-        self.addr = (host, port)
+    def __init__(
+        self, host: Optional[str] = None, port: Optional[int] = None,
+        timeout: float = 30.0, *, path: Optional[str] = None,
+        token: Optional[str] = None, ssl_context=None,
+        server_hostname: Optional[str] = None,
+    ):
+        self.addr = (host, port) if path is None else None
+        self.path = path
         self.timeout = timeout
+        self.token = (token if token is not None else os.environ.get(TOKEN_ENV)) or None
+        self._ssl_context = ssl_context
+        self._server_hostname = server_hostname or (host if host else None)
         self._sock: Optional[socket.socket] = None
         self._staged_seqnums: set = set()
         # one reentrant lock serializes the socket AND the staging set: the
@@ -257,9 +377,28 @@ class SolverClient:
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection(self.addr, timeout=self.timeout)
-            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            if self.path is not None:
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                sock.settimeout(self.timeout)
+                sock.connect(self.path)
+            else:
+                sock = socket.create_connection(self.addr, timeout=self.timeout)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if self._ssl_context is not None:
+                    sock = self._ssl_context.wrap_socket(
+                        sock, server_hostname=self._server_hostname
+                    )
+            self._sock = sock
             self._staged_seqnums.clear()
+            if self.token:
+                # prove the shared token before any op (the server closes
+                # unauthenticated connections on the first non-auth frame)
+                _send_frame(sock, {"op": "auth", "token": self.token})
+                header, _ = _recv_frame(sock)
+                if not header.get("ok"):
+                    sock.close()
+                    self._sock = None
+                    raise ConnectionError("solver auth rejected")
         return self._sock
 
     def close(self) -> None:
@@ -361,25 +500,56 @@ class SolverClient:
 
 
 def serve_main(argv=None) -> int:
-    """`python -m karpenter_tpu.solver.rpc --port 7077` -- run the solver
-    sidecar (the process that lives on the TPU VM)."""
+    """`python -m karpenter_tpu.solver.rpc` -- run the solver sidecar (the
+    process that lives on the TPU VM). Default transport: a mode-0600 UNIX
+    socket. TCP (--host/--port) requires --token-file / $KARPENTER_TPU_
+    SOLVER_TOKEN, or the explicit --insecure flag; --tls-cert/--tls-key
+    add TLS on top."""
     import argparse
 
     parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
-    # TRUST BOUNDARY: the sidecar speaks an unauthenticated length-prefixed
-    # protocol and will stage multi-MB catalogs / run solves for any peer
-    # that can connect. Default to loopback; binding a routable address is
-    # an explicit operator decision (front it with mTLS or network policy,
-    # the way the reference trusts only the in-cluster apiserver bus).
     parser.add_argument(
-        "--host",
-        default="127.0.0.1",
-        help="bind address (default loopback; see trust-boundary note)",
+        "--socket", default=None, metavar="PATH",
+        help=f"UNIX socket path (default {default_socket_path()} unless --host/--port given)",
     )
+    parser.add_argument("--host", default=None, help="TCP bind address (requires a token)")
     parser.add_argument("--port", type=int, default=7077)
+    parser.add_argument(
+        "--token-file", default=None,
+        help=f"file holding the shared token (or set ${TOKEN_ENV})",
+    )
+    parser.add_argument(
+        "--insecure", action="store_true",
+        help="allow a tokenless TCP listener (explicit operator decision)",
+    )
+    parser.add_argument("--tls-cert", default=None)
+    parser.add_argument("--tls-key", default=None)
     args = parser.parse_args(argv)
-    server = SolverServer(args.host, args.port).start()
-    print(f"solver service listening on {server.address[0]}:{server.address[1]}", flush=True)
+
+    token = None
+    if args.token_file:
+        with open(args.token_file) as f:
+            token = f.read().strip()
+    ctx = None
+    if args.tls_cert:
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(args.tls_cert, args.tls_key)
+    if args.host is not None:
+        server = SolverServer(
+            args.host, args.port, token=token,
+            insecure_tcp=args.insecure, ssl_context=ctx,
+        ).start()
+        print(
+            f"solver service listening on {server.address[0]}:{server.address[1]}",
+            flush=True,
+        )
+    else:
+        path = args.socket or default_socket_path()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        server = SolverServer(path=path, token=token).start()
+        print(f"solver service listening on {path}", flush=True)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:
