@@ -69,9 +69,28 @@ def build_operator(args):
         enable_jax_compilation_cache()
         solver = TPUSolver(auto_warm=True)
         evaluator = ConsolidationEvaluator()
+    cluster = None
+    if getattr(args, "kubeconfig", None) or getattr(args, "in_cluster", False):
+        # real coordination bus (the reference's kwok deployment topology:
+        # live apiserver, emulated cloud). Apply apis/crds/*.yaml first.
+        from karpenter_tpu.kube import KubeClient, KubeConfig, KubeCluster
+
+        cfg = (
+            KubeConfig.in_cluster()
+            if getattr(args, "in_cluster", False)
+            else KubeConfig.from_kubeconfig(args.kubeconfig)
+        )
+        cluster = KubeCluster(KubeClient(cfg))
+        # over a real bus, on_event handlers fire from watch threads (the
+        # in-memory store dispatches synchronously from writes); without
+        # this the pod-arrival wake-up and its batching window never engage
+        from karpenter_tpu.apis import Pod
+
+        cluster.watch_events([Pod])
     return Operator(
         options=options, solver=solver, consolidation_evaluator=evaluator,
         identity=getattr(args, "identity", ""),
+        cluster=cluster,
     )
 
 
@@ -96,6 +115,14 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--tpu-solver", action=argparse.BooleanOptionalAction, default=True,
         help="route scheduling + consolidation decisions through the accelerator",
+    )
+    parser.add_argument(
+        "--kubeconfig", default="",
+        help="run against a REAL apiserver via this kubeconfig (apply apis/crds/*.yaml first)",
+    )
+    parser.add_argument(
+        "--in-cluster", action="store_true",
+        help="use the pod serviceaccount to reach the apiserver",
     )
     parser.add_argument("--tick-interval", type=float, default=1.0, help="seconds between sweeps")
     parser.add_argument("--max-ticks", type=int, default=0, help="stop after N sweeps (0 = run forever)")

@@ -1,0 +1,4 @@
+from karpenter_tpu.kube.client import KubeClient, KubeConfig, ApiError, Conflict, NotFound
+from karpenter_tpu.kube.cluster import KubeCluster
+
+__all__ = ["KubeClient", "KubeConfig", "KubeCluster", "ApiError", "Conflict", "NotFound"]

@@ -1,0 +1,933 @@
+"""Typed object <-> Kubernetes manifest conversion.
+
+One converter per kind the controllers touch. Field names mirror the shipped
+CRD schemas exactly (hack/crd_gen.py -- the reference's controller-gen
+equivalents) and core/v1 for Pod/Node/PDB/DaemonSet. Conversions are scoped
+to the fields the scheduling and reconciliation planes read; unknown fields
+on incoming manifests are ignored (a real apiserver owns schema pruning).
+
+Quantities serialize to base-unit k8s strings (cpu millicores as "1500m",
+bytes as plain integers), durations to "<seconds>s" (parsing accepts any
+metav1.Duration form), timestamps to RFC3339.
+"""
+from __future__ import annotations
+
+import calendar
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.apis import (
+    DaemonSet,
+    Node,
+    NodeClaim,
+    NodePool,
+    Pod,
+    PodDisruptionBudget,
+    TPUNodeClass,
+)
+from karpenter_tpu.apis.nodeclass import ImageSelectorTerm, SelectorTerm
+from karpenter_tpu.apis.nodepool import Budget, Disruption, NodeClaimTemplate, NodeClassRef
+from karpenter_tpu.apis.objects import APIObject, ObjectMeta
+from karpenter_tpu.apis.pod import PodAffinityTerm, TopologySpreadConstraint
+from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resources, Taint, Toleration
+from karpenter_tpu.scheduling import resources as res
+
+GROUP_CORE = "karpenter.sh"
+GROUP_PROVIDER = "karpenter.tpu"
+VERSION = "v1"
+
+_DURATION_RE = re.compile(r"([0-9]+(?:\.[0-9]+)?)(ns|us|ms|s|m|h|d)")
+_DURATION_UNITS = {"ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+
+
+# -- scalar helpers ----------------------------------------------------------
+
+def parse_duration(s: Optional[str]) -> Optional[float]:
+    if s is None or s == "" or s == "Never":
+        return None
+    total = 0.0
+    matched = False
+    for m in _DURATION_RE.finditer(s):
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        matched = True
+    if not matched:
+        raise ValueError(f"invalid duration {s!r}")
+    return total
+
+
+def format_duration(seconds: Optional[float]) -> Optional[str]:
+    if seconds is None:
+        return None
+    return f"{int(seconds)}s"
+
+
+def format_time(epoch: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(epoch))
+
+
+def parse_time(s: Optional[str]) -> float:
+    if not s:
+        return 0.0
+    return float(calendar.timegm(time.strptime(s[:19], "%Y-%m-%dT%H:%M:%S")))
+
+
+def quantity_str(axis: str, value: float) -> str:
+    if axis == res.CPU:
+        return f"{int(value)}m"  # base unit is millicores
+    return str(int(value))
+
+
+def resources_to_map(r: Resources) -> Dict[str, str]:
+    return {axis: quantity_str(axis, v) for axis, v in r.items() if v}
+
+
+def resources_from_map(m: Optional[Dict[str, str]]) -> Resources:
+    return Resources(dict(m or {}))
+
+
+# -- requirements ------------------------------------------------------------
+
+def requirement_to_manifest(r: Requirement) -> dict:
+    out: dict = {"key": r.key}
+    if r.greater_than is not None:
+        out["operator"] = "Gt"
+        out["values"] = [str(int(r.greater_than))]
+    elif r.less_than is not None:
+        out["operator"] = "Lt"
+        out["values"] = [str(int(r.less_than))]
+    elif r.complement and not r.values:
+        out["operator"] = "Exists"
+    elif r.complement:
+        out["operator"] = "NotIn"
+        out["values"] = sorted(r.values)
+    elif r.values:
+        out["operator"] = "In"
+        out["values"] = sorted(r.values)
+    else:
+        out["operator"] = "DoesNotExist"
+    if r.min_values is not None:
+        out["minValues"] = int(r.min_values)
+    return out
+
+
+def requirement_from_manifest(m: dict) -> Requirement:
+    return Requirement(
+        m["key"], Operator(m["operator"]), list(m.get("values", ())),
+        min_values=m.get("minValues"),
+    )
+
+
+def taint_to_manifest(t: Taint) -> dict:
+    out = {"key": t.key, "effect": t.effect}
+    if t.value:
+        out["value"] = t.value
+    return out
+
+
+def taint_from_manifest(m: dict) -> Taint:
+    return Taint(key=m["key"], effect=m.get("effect", "NoSchedule"), value=m.get("value", ""))
+
+
+def toleration_to_manifest(t: Toleration) -> dict:
+    out: dict = {}
+    if t.key:
+        out["key"] = t.key
+    out["operator"] = t.operator
+    if t.value:
+        out["value"] = t.value
+    if t.effect:
+        out["effect"] = t.effect
+    return out
+
+
+def toleration_from_manifest(m: dict) -> Toleration:
+    return Toleration(
+        key=m.get("key", ""), operator=m.get("operator", "Equal"),
+        value=m.get("value", ""), effect=m.get("effect", ""),
+    )
+
+
+# -- metadata ----------------------------------------------------------------
+
+def meta_to_manifest(meta: ObjectMeta) -> dict:
+    out: dict = {"name": meta.name}
+    if meta.namespace:
+        out["namespace"] = meta.namespace
+    if meta.labels:
+        out["labels"] = dict(meta.labels)
+    if meta.annotations:
+        out["annotations"] = dict(meta.annotations)
+    if meta.finalizers:
+        out["finalizers"] = list(meta.finalizers)
+    if meta.resource_version:
+        out["resourceVersion"] = str(meta.resource_version)
+    if meta.uid:
+        out["uid"] = meta.uid
+    if meta.creation_timestamp:
+        out["creationTimestamp"] = format_time(meta.creation_timestamp)
+    return out
+
+
+def meta_from_manifest(obj: APIObject, m: dict) -> None:
+    meta = m.get("metadata", {})
+    obj.metadata.name = meta.get("name", obj.metadata.name)
+    obj.metadata.namespace = meta.get("namespace", "")
+    obj.metadata.labels = dict(meta.get("labels", {}))
+    obj.metadata.annotations = dict(meta.get("annotations", {}))
+    obj.metadata.finalizers = list(meta.get("finalizers", ()))
+    obj.metadata.uid = meta.get("uid", obj.metadata.uid)
+    rv = meta.get("resourceVersion")
+    if rv is not None:
+        try:
+            obj.metadata.resource_version = int(rv)
+        except ValueError:
+            # apiserver resourceVersions are opaque strings; keep them
+            # comparable by stashing the raw value separately
+            obj.metadata.resource_version = 0
+    obj._raw_resource_version = meta.get("resourceVersion")  # type: ignore[attr-defined]
+    # only REAL owner references (carrying a uid) count: the synthetic
+    # entry pod_to_manifest emits to persist owner_kind has uid "" and
+    # must not make a bare rig pod look controller-managed
+    obj.metadata.owner_references = [
+        o["uid"] for o in meta.get("ownerReferences", ()) if o.get("uid")
+    ]
+    obj.metadata.creation_timestamp = parse_time(meta.get("creationTimestamp"))
+    if meta.get("deletionTimestamp"):
+        obj.metadata.deletion_timestamp = parse_time(meta.get("deletionTimestamp"))
+
+
+def conditions_to_manifest(obj: APIObject) -> List[dict]:
+    out = []
+    for c in obj.status_conditions.all():
+        out.append(
+            {
+                "type": c.type, "status": c.status, "reason": c.reason or "Unknown",
+                "message": c.message, "lastTransitionTime": format_time(c.last_transition_time),
+            }
+        )
+    return out
+
+
+def conditions_from_manifest(obj: APIObject, conds: List[dict]) -> None:
+    for c in conds or ():
+        if c.get("status") == "True":
+            obj.status_conditions.set_true(c["type"], c.get("reason", ""), c.get("message", ""))
+        elif c.get("status") == "False":
+            obj.status_conditions.set_false(c["type"], c.get("reason", ""), c.get("message", ""))
+        else:
+            obj.status_conditions.set_unknown(c["type"], c.get("reason", ""), c.get("message", ""))
+
+
+# -- NodePool ----------------------------------------------------------------
+
+def nodepool_to_manifest(p: NodePool) -> dict:
+    t = p.template
+    tmpl_spec: dict = {
+        "nodeClassRef": {
+            "group": t.node_class_ref.group, "kind": t.node_class_ref.kind,
+            "name": t.node_class_ref.name,
+        },
+        "requirements": [requirement_to_manifest(r) for r in t.requirements],
+    }
+    if t.taints:
+        tmpl_spec["taints"] = [taint_to_manifest(x) for x in t.taints]
+    if t.startup_taints:
+        tmpl_spec["startupTaints"] = [taint_to_manifest(x) for x in t.startup_taints]
+    tmpl_spec["expireAfter"] = format_duration(t.expire_after) or "Never"
+    if t.termination_grace_period is not None:
+        tmpl_spec["terminationGracePeriod"] = format_duration(t.termination_grace_period)
+    spec: dict = {
+        "weight": p.weight,
+        "disruption": {
+            "consolidationPolicy": p.disruption.consolidation_policy,
+            "consolidateAfter": format_duration(p.disruption.consolidate_after) or "0s",
+            "budgets": [
+                {
+                    k: v
+                    for k, v in (
+                        ("nodes", b.nodes),
+                        ("reasons", b.reasons),
+                        ("schedule", b.schedule),
+                        ("duration", format_duration(b.duration)),
+                    )
+                    if v is not None
+                }
+                for b in p.disruption.budgets
+            ],
+        },
+        "template": {
+            "metadata": {"labels": dict(t.labels), "annotations": dict(t.annotations)},
+            "spec": tmpl_spec,
+        },
+    }
+    if p.limits is not None:
+        spec["limits"] = resources_to_map(p.limits)
+    return {
+        "apiVersion": f"{GROUP_CORE}/{VERSION}", "kind": "NodePool",
+        "metadata": meta_to_manifest(p.metadata),
+        "spec": spec,
+        "status": {
+            "resources": resources_to_map(p.status_resources),
+            "conditions": conditions_to_manifest(p),
+        },
+    }
+
+
+def nodepool_from_manifest(m: dict) -> NodePool:
+    spec = m.get("spec", {})
+    tmpl = spec.get("template", {})
+    tmeta, tspec = tmpl.get("metadata", {}), tmpl.get("spec", {})
+    ref = tspec.get("nodeClassRef", {})
+    template = NodeClaimTemplate(
+        labels=dict(tmeta.get("labels", {})),
+        annotations=dict(tmeta.get("annotations", {})),
+        requirements=[requirement_from_manifest(r) for r in tspec.get("requirements", ())],
+        taints=[taint_from_manifest(x) for x in tspec.get("taints", ())],
+        startup_taints=[taint_from_manifest(x) for x in tspec.get("startupTaints", ())],
+        node_class_ref=NodeClassRef(
+            name=ref.get("name", "default"), kind=ref.get("kind", "TPUNodeClass"),
+            group=ref.get("group", GROUP_PROVIDER),
+        ),
+        expire_after=parse_duration(tspec.get("expireAfter")),
+        termination_grace_period=parse_duration(tspec.get("terminationGracePeriod")),
+    )
+    d = spec.get("disruption", {})
+    disruption = Disruption(
+        consolidation_policy=d.get("consolidationPolicy", "WhenEmptyOrUnderutilized"),
+        consolidate_after=parse_duration(d.get("consolidateAfter")) or 0.0,
+        budgets=[
+            Budget(
+                nodes=b.get("nodes", "10%"), reasons=b.get("reasons"),
+                schedule=b.get("schedule"), duration=parse_duration(b.get("duration")),
+            )
+            for b in d.get("budgets", ())
+        ]
+        or [Budget()],
+    )
+    pool = NodePool(
+        m["metadata"]["name"],
+        limits=resources_from_map(spec["limits"]) if "limits" in spec else None,
+        weight=int(spec.get("weight", 0)),
+        template=template,
+        disruption=disruption,
+    )
+    meta_from_manifest(pool, m)
+    status = m.get("status", {})
+    pool.status_resources = resources_from_map(status.get("resources"))
+    conditions_from_manifest(pool, status.get("conditions"))
+    return pool
+
+
+# -- NodeClaim ---------------------------------------------------------------
+
+def nodeclaim_to_manifest(c: NodeClaim) -> dict:
+    spec: dict = {
+        "nodeClassRef": {
+            "group": c.node_class_ref.group, "kind": c.node_class_ref.kind,
+            "name": c.node_class_ref.name,
+        },
+        "requirements": [requirement_to_manifest(r) for r in c.requirements],
+        "resources": {"requests": resources_to_map(c.resources_requested)},
+        "expireAfter": format_duration(c.expire_after) or "Never",
+    }
+    if c.taints:
+        spec["taints"] = [taint_to_manifest(x) for x in c.taints]
+    if c.startup_taints:
+        spec["startupTaints"] = [taint_to_manifest(x) for x in c.startup_taints]
+    if c.termination_grace_period is not None:
+        spec["terminationGracePeriod"] = format_duration(c.termination_grace_period)
+    return {
+        "apiVersion": f"{GROUP_CORE}/{VERSION}", "kind": "NodeClaim",
+        "metadata": meta_to_manifest(c.metadata),
+        "spec": spec,
+        "status": {
+            "providerID": c.provider_id, "nodeName": c.node_name, "imageID": c.image_id,
+            "capacity": resources_to_map(c.capacity),
+            "allocatable": resources_to_map(c.allocatable),
+            "conditions": conditions_to_manifest(c),
+        },
+    }
+
+
+def nodeclaim_from_manifest(m: dict) -> NodeClaim:
+    spec = m.get("spec", {})
+    ref = spec.get("nodeClassRef", {})
+    claim = NodeClaim(
+        m["metadata"]["name"],
+        requirements=[requirement_from_manifest(r) for r in spec.get("requirements", ())],
+        resources_requested=resources_from_map(spec.get("resources", {}).get("requests")),
+        node_class_ref=NodeClassRef(
+            name=ref.get("name", "default"), kind=ref.get("kind", "TPUNodeClass"),
+            group=ref.get("group", GROUP_PROVIDER),
+        ),
+        taints=[taint_from_manifest(x) for x in spec.get("taints", ())],
+        startup_taints=[taint_from_manifest(x) for x in spec.get("startupTaints", ())],
+        expire_after=parse_duration(spec.get("expireAfter")),
+    )
+    claim.termination_grace_period = parse_duration(spec.get("terminationGracePeriod"))
+    meta_from_manifest(claim, m)
+    status = m.get("status", {})
+    claim.provider_id = status.get("providerID", "")
+    claim.node_name = status.get("nodeName", "")
+    claim.image_id = status.get("imageID", "")
+    claim.capacity = resources_from_map(status.get("capacity"))
+    claim.allocatable = resources_from_map(status.get("allocatable"))
+    conditions_from_manifest(claim, status.get("conditions"))
+    return claim
+
+
+# -- TPUNodeClass ------------------------------------------------------------
+
+def _term_to_manifest(t: SelectorTerm) -> dict:
+    out: dict = {}
+    if t.tags:
+        out["tags"] = dict(t.tags)
+    if t.id:
+        out["id"] = t.id
+    if getattr(t, "name", ""):
+        out["name"] = t.name
+    if getattr(t, "alias", ""):
+        out["alias"] = t.alias
+    return out
+
+
+def _term_from_manifest(m: dict, image: bool = False) -> SelectorTerm:
+    if image:
+        return ImageSelectorTerm(
+            tags=dict(m.get("tags", {})), id=m.get("id", ""),
+            name=m.get("name", ""), alias=m.get("alias", ""),
+        )
+    return SelectorTerm(
+        tags=dict(m.get("tags", {})), id=m.get("id", ""), name=m.get("name", "")
+    )
+
+
+def nodeclass_to_manifest(nc: TPUNodeClass) -> dict:
+    k = nc.kubelet
+    kubelet: dict = {}
+    if k.max_pods is not None:
+        kubelet["maxPods"] = k.max_pods
+    if k.pods_per_core is not None:
+        kubelet["podsPerCore"] = k.pods_per_core
+    for name, val in (
+        ("systemReserved", k.system_reserved), ("kubeReserved", k.kube_reserved),
+        ("evictionHard", k.eviction_hard), ("evictionSoft", k.eviction_soft),
+        ("evictionSoftGracePeriod", k.eviction_soft_grace_period),
+    ):
+        if val:
+            kubelet[name] = dict(val)
+    if k.cluster_dns:
+        kubelet["clusterDNS"] = list(k.cluster_dns)
+    spec: dict = {
+        "imageFamily": nc.image_family,
+        "imageSelectorTerms": [_term_to_manifest(t) for t in nc.image_selector_terms],
+        "subnetSelectorTerms": [_term_to_manifest(t) for t in nc.subnet_selector_terms],
+        "securityGroupSelectorTerms": [_term_to_manifest(t) for t in nc.security_group_selector_terms],
+    }
+    if nc.capacity_reservation_selector_terms:
+        spec["capacityReservationSelectorTerms"] = [
+            _term_to_manifest(t) for t in nc.capacity_reservation_selector_terms
+        ]
+    if nc.role:
+        spec["role"] = nc.role
+    if nc.instance_profile:
+        spec["instanceProfile"] = nc.instance_profile
+    if nc.user_data:
+        spec["userData"] = nc.user_data
+    if nc.tags:
+        spec["tags"] = dict(nc.tags)
+    if kubelet:
+        spec["kubelet"] = kubelet
+    if nc.block_device_mappings:
+        spec["blockDeviceMappings"] = [
+            {"deviceName": b.device_name, "volumeSize": f"{b.volume_size_gib}Gi",
+             "volumeType": b.volume_type}
+            for b in nc.block_device_mappings
+        ]
+    if nc.metadata_http_tokens:
+        spec["metadataOptions"] = {"httpTokens": nc.metadata_http_tokens}
+    if nc.associate_public_ip is not None:
+        spec["associatePublicIPAddress"] = nc.associate_public_ip
+    status: dict = {"conditions": conditions_to_manifest(nc)}
+    if nc.status_subnets:
+        status["subnets"] = [
+            {"id": s.id, "zone": s.zone, "zoneID": s.zone_id} for s in nc.status_subnets
+        ]
+    if nc.status_security_groups:
+        status["securityGroups"] = [
+            {"id": s.id, "name": s.name} for s in nc.status_security_groups
+        ]
+    if nc.status_images:
+        status["images"] = [
+            {
+                "id": i.id, "name": i.name,
+                "requirements": [requirement_to_manifest(r) for r in i.requirements],
+            }
+            for i in nc.status_images
+        ]
+    if nc.status_capacity_reservations:
+        status["capacityReservations"] = [
+            {
+                "id": c.id, "instanceType": c.instance_type, "zone": c.zone,
+                "ownerID": c.owner_id, "reservationType": c.reservation_type,
+                "state": c.state, "availableCount": c.available_count,
+                **({"endTime": format_time(c.end_time)} if c.end_time else {}),
+            }
+            for c in nc.status_capacity_reservations
+        ]
+    if nc.status_instance_profile:
+        status["instanceProfile"] = nc.status_instance_profile
+    return {
+        "apiVersion": f"{GROUP_PROVIDER}/{VERSION}", "kind": "TPUNodeClass",
+        "metadata": meta_to_manifest(nc.metadata),
+        "spec": spec,
+        "status": status,
+    }
+
+
+def nodeclass_from_manifest(m: dict) -> TPUNodeClass:
+    from karpenter_tpu.apis.nodeclass import BlockDeviceMapping
+
+    spec = m.get("spec", {})
+    nc = TPUNodeClass(m["metadata"]["name"])
+    nc.image_family = spec.get("imageFamily", nc.image_family)
+    if "imageSelectorTerms" in spec:
+        nc.image_selector_terms = [_term_from_manifest(t, image=True) for t in spec["imageSelectorTerms"]]
+    if "subnetSelectorTerms" in spec:
+        nc.subnet_selector_terms = [_term_from_manifest(t) for t in spec["subnetSelectorTerms"]]
+    if "securityGroupSelectorTerms" in spec:
+        nc.security_group_selector_terms = [_term_from_manifest(t) for t in spec["securityGroupSelectorTerms"]]
+    if "capacityReservationSelectorTerms" in spec:
+        nc.capacity_reservation_selector_terms = [
+            _term_from_manifest(t) for t in spec["capacityReservationSelectorTerms"]
+        ]
+    nc.role = spec.get("role", "")
+    nc.instance_profile = spec.get("instanceProfile", "")
+    nc.user_data = spec.get("userData", "")
+    nc.tags = dict(spec.get("tags", {}))
+    k = spec.get("kubelet", {})
+    nc.kubelet.max_pods = k.get("maxPods")
+    nc.kubelet.pods_per_core = k.get("podsPerCore")
+    nc.kubelet.system_reserved = dict(k.get("systemReserved", {}))
+    nc.kubelet.kube_reserved = dict(k.get("kubeReserved", {}))
+    nc.kubelet.eviction_hard = dict(k.get("evictionHard", {}))
+    nc.kubelet.eviction_soft = dict(k.get("evictionSoft", {}))
+    nc.kubelet.eviction_soft_grace_period = dict(k.get("evictionSoftGracePeriod", {}))
+    nc.kubelet.cluster_dns = list(k.get("clusterDNS", ()))
+    if "blockDeviceMappings" in spec:
+        nc.block_device_mappings = [
+            BlockDeviceMapping(
+                device_name=b.get("deviceName", ""),
+                volume_size_gib=int(str(b.get("volumeSize", "0Gi")).rstrip("Gi") or 0),
+                volume_type=b.get("volumeType", "ssd"),
+            )
+            for b in spec["blockDeviceMappings"]
+        ]
+    nc.metadata_http_tokens = spec.get("metadataOptions", {}).get("httpTokens", nc.metadata_http_tokens)
+    if "associatePublicIPAddress" in spec:
+        nc.associate_public_ip = spec["associatePublicIPAddress"]
+    meta_from_manifest(nc, m)
+    status = m.get("status", {})
+    conditions_from_manifest(nc, status.get("conditions"))
+    from karpenter_tpu.apis.nodeclass import (
+        CapacityReservationStatus,
+        ImageStatus,
+        SecurityGroupStatus,
+        SubnetStatus,
+    )
+
+    nc.status_subnets = [
+        SubnetStatus(id=s.get("id", ""), zone=s.get("zone", ""), zone_id=s.get("zoneID", ""))
+        for s in status.get("subnets", ())
+    ]
+    nc.status_security_groups = [
+        SecurityGroupStatus(id=s.get("id", ""), name=s.get("name", ""))
+        for s in status.get("securityGroups", ())
+    ]
+    nc.status_images = [
+        ImageStatus(
+            id=i.get("id", ""), name=i.get("name", ""),
+            requirements=[requirement_from_manifest(r) for r in i.get("requirements", ())],
+        )
+        for i in status.get("images", ())
+    ]
+    nc.status_capacity_reservations = [
+        CapacityReservationStatus(
+            id=c.get("id", ""), instance_type=c.get("instanceType", ""),
+            zone=c.get("zone", ""), owner_id=c.get("ownerID", ""),
+            reservation_type=c.get("reservationType", "default"),
+            state=c.get("state", "active"),
+            end_time=parse_time(c["endTime"]) if c.get("endTime") else None,
+            available_count=int(c.get("availableCount", 0)),
+        )
+        for c in status.get("capacityReservations", ())
+    ]
+    nc.status_instance_profile = status.get("instanceProfile", "")
+    return nc
+
+
+# -- Pod ---------------------------------------------------------------------
+
+def pod_to_manifest(p: Pod) -> dict:
+    spec: dict = {
+        "containers": [
+            {
+                "name": "main",
+                "resources": {"requests": resources_to_map(p.requests)}
+                | ({"limits": resources_to_map(p.limits)} if any(v for _, v in p.limits.items()) else {}),
+            }
+        ],
+    }
+    if p.node_selector:
+        spec["nodeSelector"] = dict(p.node_selector)
+    if p.tolerations:
+        spec["tolerations"] = [toleration_to_manifest(t) for t in p.tolerations]
+    affinity: dict = {}
+    if p.node_affinity_terms:
+        affinity["nodeAffinity"] = {
+            "requiredDuringSchedulingIgnoredDuringExecution": {
+                "nodeSelectorTerms": [
+                    {"matchExpressions": [requirement_to_manifest(r) for r in term]}
+                    for term in p.node_affinity_terms
+                ]
+            }
+        }
+    if p.preferred_node_affinity_terms:
+        affinity.setdefault("nodeAffinity", {})[
+            "preferredDuringSchedulingIgnoredDuringExecution"
+        ] = [
+            {
+                "weight": w,
+                "preference": {"matchExpressions": [requirement_to_manifest(r) for r in term]},
+            }
+            for w, term in p.preferred_node_affinity_terms
+        ]
+
+    def aff_term(t: PodAffinityTerm) -> dict:
+        return {
+            "labelSelector": {"matchLabels": dict(t.label_selector)},
+            "topologyKey": t.topology_key,
+        }
+
+    pos = [t for t in p.affinity_terms if not t.anti]
+    neg = [t for t in p.affinity_terms if t.anti]
+    pref_pos = [(w, t) for w, t in p.preferred_affinity_terms if not t.anti]
+    pref_neg = [(w, t) for w, t in p.preferred_affinity_terms if t.anti]
+    if pos or pref_pos:
+        pa: dict = {}
+        if pos:
+            pa["requiredDuringSchedulingIgnoredDuringExecution"] = [aff_term(t) for t in pos]
+        if pref_pos:
+            pa["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                {"weight": w, "podAffinityTerm": aff_term(t)} for w, t in pref_pos
+            ]
+        affinity["podAffinity"] = pa
+    if neg or pref_neg:
+        paa: dict = {}
+        if neg:
+            paa["requiredDuringSchedulingIgnoredDuringExecution"] = [aff_term(t) for t in neg]
+        if pref_neg:
+            paa["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                {"weight": w, "podAffinityTerm": aff_term(t)} for w, t in pref_neg
+            ]
+        affinity["podAntiAffinity"] = paa
+    if affinity:
+        spec["affinity"] = affinity
+    if p.topology_spread:
+        spec["topologySpreadConstraints"] = [
+            {
+                "maxSkew": t.max_skew, "topologyKey": t.topology_key,
+                "whenUnsatisfiable": t.when_unsatisfiable,
+                "labelSelector": {"matchLabels": dict(t.label_selector)},
+            }
+            for t in p.topology_spread
+        ]
+    if p.priority:
+        spec["priority"] = p.priority
+    if p.scheduling_gates:
+        spec["schedulingGates"] = [{"name": g} for g in p.scheduling_gates]
+    if p.node_name:
+        spec["nodeName"] = p.node_name
+    meta = meta_to_manifest(p.metadata)
+    if p.owner_kind:
+        meta["ownerReferences"] = [
+            {"apiVersion": "apps/v1", "kind": p.owner_kind, "name": "owner", "uid": "", "controller": True}
+        ]
+    return {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": meta,
+        "spec": spec,
+        "status": {"phase": p.phase},
+    }
+
+
+def pod_from_manifest(m: dict) -> Pod:
+    spec = m.get("spec", {})
+    requests = Resources()
+    limits = Resources()
+    for c in spec.get("containers", ()):
+        rr = c.get("resources", {})
+        requests = requests + resources_from_map(rr.get("requests"))
+        limits = limits + resources_from_map(rr.get("limits"))
+    aff = spec.get("affinity", {})
+    na = aff.get("nodeAffinity", {})
+    nat = [
+        [requirement_from_manifest(e) for e in term.get("matchExpressions", ())]
+        for term in na.get("requiredDuringSchedulingIgnoredDuringExecution", {}).get(
+            "nodeSelectorTerms", ()
+        )
+    ]
+    pref_nat = [
+        (int(e.get("weight", 1)),
+         [requirement_from_manifest(x) for x in e.get("preference", {}).get("matchExpressions", ())])
+        for e in na.get("preferredDuringSchedulingIgnoredDuringExecution", ())
+    ]
+
+    def read_aff(block: dict, anti: bool) -> Tuple[list, list]:
+        req, pref = [], []
+        for t in block.get("requiredDuringSchedulingIgnoredDuringExecution", ()):
+            req.append(
+                PodAffinityTerm(
+                    label_selector=dict(t.get("labelSelector", {}).get("matchLabels", {})),
+                    topology_key=t.get("topologyKey", "kubernetes.io/hostname"), anti=anti,
+                )
+            )
+        for e in block.get("preferredDuringSchedulingIgnoredDuringExecution", ()):
+            t = e.get("podAffinityTerm", {})
+            pref.append(
+                (
+                    int(e.get("weight", 1)),
+                    PodAffinityTerm(
+                        label_selector=dict(t.get("labelSelector", {}).get("matchLabels", {})),
+                        topology_key=t.get("topologyKey", "kubernetes.io/hostname"), anti=anti,
+                    ),
+                )
+            )
+        return req, pref
+
+    pos_req, pos_pref = read_aff(aff.get("podAffinity", {}), anti=False)
+    neg_req, neg_pref = read_aff(aff.get("podAntiAffinity", {}), anti=True)
+    owners = m.get("metadata", {}).get("ownerReferences", ())
+    owner_kind = owners[0]["kind"] if owners else ""
+    pod = Pod(
+        m["metadata"]["name"],
+        namespace=m.get("metadata", {}).get("namespace", "default"),
+        requests=requests,
+        limits=limits,
+        node_selector=spec.get("nodeSelector"),
+        node_affinity_terms=nat,
+        preferred_node_affinity_terms=pref_nat,
+        tolerations=[toleration_from_manifest(t) for t in spec.get("tolerations", ())],
+        topology_spread=[
+            TopologySpreadConstraint(
+                max_skew=int(t.get("maxSkew", 1)),
+                topology_key=t.get("topologyKey", ""),
+                when_unsatisfiable=t.get("whenUnsatisfiable", "DoNotSchedule"),
+                label_selector=dict(t.get("labelSelector", {}).get("matchLabels", {})),
+            )
+            for t in spec.get("topologySpreadConstraints", ())
+        ],
+        affinity_terms=pos_req + neg_req,
+        preferred_affinity_terms=pos_pref + neg_pref,
+        priority=int(spec.get("priority", 0)),
+        labels=m.get("metadata", {}).get("labels"),
+        annotations=m.get("metadata", {}).get("annotations"),
+        owner_kind=owner_kind,
+        scheduling_gates=[g.get("name", "") for g in spec.get("schedulingGates", ())],
+    )
+    meta_from_manifest(pod, m)
+    pod.node_name = spec.get("nodeName", "")
+    pod.phase = m.get("status", {}).get("phase", "Pending")
+    return pod
+
+
+# -- Node --------------------------------------------------------------------
+
+def node_to_manifest(n: Node) -> dict:
+    spec: dict = {}
+    if n.taints:
+        spec["taints"] = [taint_to_manifest(t) for t in n.taints]
+    if n.unschedulable:
+        spec["unschedulable"] = True
+    if n.provider_id:
+        spec["providerID"] = n.provider_id
+    return {
+        "apiVersion": "v1", "kind": "Node",
+        "metadata": meta_to_manifest(n.metadata),
+        "spec": spec,
+        "status": {
+            "capacity": resources_to_map(n.capacity),
+            "allocatable": resources_to_map(n.allocatable),
+            "conditions": [
+                {"type": "Ready", "status": "True" if n.ready else "False"}
+            ],
+        },
+    }
+
+
+def node_from_manifest(m: dict) -> Node:
+    spec = m.get("spec", {})
+    status = m.get("status", {})
+    n = Node(
+        m["metadata"]["name"],
+        labels=m.get("metadata", {}).get("labels"),
+        capacity=resources_from_map(status.get("capacity")),
+        allocatable=resources_from_map(status.get("allocatable")),
+        taints=[taint_from_manifest(t) for t in spec.get("taints", ())],
+        provider_id=spec.get("providerID", ""),
+    )
+    meta_from_manifest(n, m)
+    n.unschedulable = bool(spec.get("unschedulable", False))
+    n.ready = any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in status.get("conditions", ())
+    )
+    return n
+
+
+# -- PodDisruptionBudget -----------------------------------------------------
+
+def pdb_to_manifest(p: PodDisruptionBudget) -> dict:
+    spec: dict = {"selector": {"matchLabels": dict(p.selector)}}
+    if p.min_available is not None:
+        spec["minAvailable"] = p.min_available
+    if p.max_unavailable is not None:
+        spec["maxUnavailable"] = p.max_unavailable
+    return {
+        "apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+        "metadata": meta_to_manifest(p.metadata),
+        "spec": spec,
+    }
+
+
+def pdb_from_manifest(m: dict) -> PodDisruptionBudget:
+    spec = m.get("spec", {})
+    p = PodDisruptionBudget(
+        m["metadata"]["name"],
+        namespace=m.get("metadata", {}).get("namespace", "default"),
+        selector=dict(spec.get("selector", {}).get("matchLabels", {})),
+        min_available=spec.get("minAvailable"),
+        max_unavailable=spec.get("maxUnavailable"),
+    )
+    meta_from_manifest(p, m)
+    return p
+
+
+# -- DaemonSet ---------------------------------------------------------------
+
+def daemonset_to_manifest(d: DaemonSet) -> dict:
+    pod_spec: dict = {
+        "containers": [
+            {"name": "main", "resources": {"requests": resources_to_map(d.requests)}}
+        ]
+    }
+    if d.node_selector:
+        pod_spec["nodeSelector"] = dict(d.node_selector)
+    if d.tolerations:
+        pod_spec["tolerations"] = [toleration_to_manifest(t) for t in d.tolerations]
+    return {
+        "apiVersion": "apps/v1", "kind": "DaemonSet",
+        "metadata": meta_to_manifest(d.metadata),
+        "spec": {"template": {"spec": pod_spec}},
+    }
+
+
+def daemonset_from_manifest(m: dict) -> DaemonSet:
+    pod_spec = m.get("spec", {}).get("template", {}).get("spec", {})
+    requests = Resources()
+    for c in pod_spec.get("containers", ()):
+        requests = requests + resources_from_map(c.get("resources", {}).get("requests"))
+    d = DaemonSet(
+        m["metadata"]["name"],
+        namespace=m.get("metadata", {}).get("namespace", "kube-system"),
+        requests=requests,
+        node_selector=pod_spec.get("nodeSelector"),
+        tolerations=[toleration_from_manifest(t) for t in pod_spec.get("tolerations", ())],
+    )
+    meta_from_manifest(d, m)
+    return d
+
+
+# -- Lease (leader election) -------------------------------------------------
+
+def lease_to_manifest(l) -> dict:
+    return {
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": meta_to_manifest(l.metadata),
+        "spec": {
+            "holderIdentity": l.holder,
+            "renewTime": format_time(l.renew_deadline) if l.renew_deadline else None,
+        },
+    }
+
+
+def lease_from_manifest(m: dict):
+    from karpenter_tpu.apis.objects import Lease
+
+    spec = m.get("spec", {})
+    l = Lease(
+        m["metadata"]["name"],
+        holder=spec.get("holderIdentity", "") or "",
+        renew_deadline=parse_time(spec.get("renewTime")),
+    )
+    meta_from_manifest(l, m)
+    return l
+
+
+# -- registry ----------------------------------------------------------------
+
+class KindInfo:
+    def __init__(self, kind, api_version, plural, namespaced, to_manifest, from_manifest, status_subresource=False):
+        self.kind = kind
+        self.api_version = api_version
+        self.plural = plural
+        self.namespaced = namespaced
+        self.to_manifest = to_manifest
+        self.from_manifest = from_manifest
+        self.status_subresource = status_subresource
+
+    def base_path(self, namespace: str = "") -> str:
+        if "/" in self.api_version:
+            root = f"/apis/{self.api_version}"
+        else:
+            root = f"/api/{self.api_version}"
+        if self.namespaced:
+            return f"{root}/namespaces/{namespace or 'default'}/{self.plural}"
+        return f"{root}/{self.plural}"
+
+
+REGISTRY: Dict[type, KindInfo] = {
+    NodePool: KindInfo(
+        NodePool, f"{GROUP_CORE}/{VERSION}", "nodepools", False,
+        nodepool_to_manifest, nodepool_from_manifest, status_subresource=True,
+    ),
+    NodeClaim: KindInfo(
+        NodeClaim, f"{GROUP_CORE}/{VERSION}", "nodeclaims", False,
+        nodeclaim_to_manifest, nodeclaim_from_manifest, status_subresource=True,
+    ),
+    TPUNodeClass: KindInfo(
+        TPUNodeClass, f"{GROUP_PROVIDER}/{VERSION}", "tpunodeclasses", False,
+        nodeclass_to_manifest, nodeclass_from_manifest, status_subresource=True,
+    ),
+    Pod: KindInfo(Pod, "v1", "pods", True, pod_to_manifest, pod_from_manifest),
+    # nodes/status is a real subresource (the kubelet's seam); the kwok
+    # lifecycle writes readiness/capacity through it
+    Node: KindInfo(
+        Node, "v1", "nodes", False, node_to_manifest, node_from_manifest,
+        status_subresource=True,
+    ),
+    PodDisruptionBudget: KindInfo(
+        PodDisruptionBudget, "policy/v1", "poddisruptionbudgets", True,
+        pdb_to_manifest, pdb_from_manifest,
+    ),
+    DaemonSet: KindInfo(
+        DaemonSet, "apps/v1", "daemonsets", True, daemonset_to_manifest, daemonset_from_manifest
+    ),
+}
+
+from karpenter_tpu.apis.objects import Lease as _Lease  # noqa: E402
+
+REGISTRY[_Lease] = KindInfo(
+    _Lease, "coordination.k8s.io/v1", "leases", True, lease_to_manifest, lease_from_manifest
+)

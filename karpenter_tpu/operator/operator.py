@@ -82,11 +82,15 @@ class Operator:
         solver=None,
         consolidation_evaluator=None,
         identity: str = "",
+        cluster=None,
     ):
         self.clock = clock or Clock()
         self.options = options or Options()
         self.cloud = cloud or FakeCloud(clock=self.clock)
-        self.cluster = Cluster(clock=self.clock)
+        # the coordination bus: the in-memory store by default; pass a
+        # karpenter_tpu.kube.KubeCluster to run against a real apiserver
+        # (the reference's kwok topology: real bus, emulated cloud)
+        self.cluster = cluster if cluster is not None else Cluster(clock=self.clock)
 
         self.recorder = Recorder(self.clock)
 

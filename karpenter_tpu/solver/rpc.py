@@ -53,18 +53,23 @@ TOKEN_ENV = "KARPENTER_TPU_SOLVER_TOKEN"
 
 
 def default_socket_path() -> str:
-    """Default sidecar socket location. Without XDG_RUNTIME_DIR the
-    fallback is a PER-USER mode-0700 directory, never bare /tmp: a
-    predictable world-writable path invites local socket squatting (an
-    attacker pre-binds it and serves forged scheduling decisions)."""
-    base = os.environ.get("XDG_RUNTIME_DIR")
-    if not base:
-        base = f"/tmp/karpenter-tpu-{os.getuid()}"
-        os.makedirs(base, mode=0o700, exist_ok=True)
-        # pre-existing dir: enforce ownership semantics loudly (chmod on
-        # another user's squatted dir raises EPERM instead of trusting it)
-        os.chmod(base, 0o700)
+    """Default sidecar socket location (PURE -- no filesystem side
+    effects; callers that will bind/connect run ensure_socket_dir).
+    Without XDG_RUNTIME_DIR the fallback is a PER-USER directory, never
+    bare /tmp: a predictable world-writable path invites local socket
+    squatting (an attacker pre-binds it and serves forged decisions)."""
+    base = os.environ.get("XDG_RUNTIME_DIR") or f"/tmp/karpenter-tpu-{os.getuid()}"
     return os.path.join(base, "karpenter-tpu-solver.sock")
+
+
+def ensure_socket_dir(path: str) -> None:
+    """Create the socket's parent as mode 0700 and enforce ownership
+    loudly: chmod on another user's squatted directory raises EPERM
+    instead of silently trusting it."""
+    parent = os.path.dirname(path) or "."
+    os.makedirs(parent, mode=0o700, exist_ok=True)
+    if parent not in ("/tmp", "/run", "."):
+        os.chmod(parent, 0o700)
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 256 * 1024 * 1024
@@ -510,7 +515,8 @@ def serve_main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="karpenter-tpu-solver")
     parser.add_argument(
         "--socket", default=None, metavar="PATH",
-        help=f"UNIX socket path (default {default_socket_path()} unless --host/--port given)",
+        help="UNIX socket path (default: $XDG_RUNTIME_DIR/karpenter-tpu-solver.sock, "
+             "or a per-user /tmp dir; ignored when --host is given)",
     )
     parser.add_argument("--host", default=None, help="TCP bind address (requires a token)")
     parser.add_argument("--port", type=int, default=7077)
@@ -546,8 +552,15 @@ def serve_main(argv=None) -> int:
             flush=True,
         )
     else:
+        if args.tls_cert or args.tls_key or args.insecure:
+            # accepting-and-ignoring a security flag is how plaintext
+            # traffic ships with an operator believing it is encrypted
+            parser.error("--tls-cert/--tls-key/--insecure apply to TCP mode (--host)")
         path = args.socket or default_socket_path()
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if args.socket:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        else:
+            ensure_socket_dir(path)  # squatting defense for the default dir
         server = SolverServer(path=path, token=token).start()
         print(f"solver service listening on {path}", flush=True)
     try:

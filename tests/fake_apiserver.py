@@ -1,0 +1,240 @@
+"""A minimal in-process apiserver speaking the kube REST wire protocol.
+
+Enough of the real surface to exercise karpenter_tpu.kube end-to-end over
+genuine HTTP: typed paths (/api/v1, /apis/<group>/<version>), CRUD with
+resourceVersion optimistic concurrency (409 on stale PUT), finalizer-aware
+DELETE (deletionTimestamp set, object retained until finalizers clear),
+/status subresource, pod binding subresource, and chunked watch streams.
+The store is raw manifests keyed by (path-prefix, name) -- no typed
+knowledge, exactly like the real server's generic registry.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import urlparse, parse_qs
+
+
+class _Store:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.rv = 0
+        # prefix -> name -> manifest
+        self.objects: Dict[str, Dict[str, dict]] = {}
+        self.watchers: List[Tuple[str, "queue.Queue"]] = []
+
+    def bump(self) -> str:
+        self.rv += 1
+        return str(self.rv)
+
+
+import queue  # noqa: E402
+
+
+class FakeApiServer:
+    def __init__(self):
+        store = _Store()
+        self.store = store
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            # -- helpers ---------------------------------------------------
+            def _send(self, code: int, body: dict):
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def _split(self) -> Tuple[str, Optional[str], Optional[str], dict]:
+                """(collection-prefix, name, subresource, query)."""
+                u = urlparse(self.path)
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                parts = [p for p in u.path.split("/") if p]
+                # /api/v1/<res>[/name[/sub]] | /api/v1/namespaces/ns/<res>[...]
+                # /apis/g/v/<res>[...]      | /apis/g/v/namespaces/ns/<res>[...]
+                root = 2 if parts[0] == "api" else 3
+                rest = parts[root:]
+                if rest and rest[0] == "namespaces" and len(rest) >= 3:
+                    rest = ["namespaces", rest[1], rest[2]] + rest[3:]
+                    prefix = "/" + "/".join(parts[:root] + rest[:3])
+                    tail = rest[3:]
+                else:
+                    prefix = "/" + "/".join(parts[:root] + rest[:1])
+                    tail = rest[1:]
+                name = tail[0] if tail else None
+                sub = tail[1] if len(tail) > 1 else None
+                return prefix, name, sub, q
+
+            def _emit(self, prefix: str, ev: str, manifest: dict):
+                for pfx, ch in list(store.watchers):
+                    if pfx == prefix:
+                        ch.put({"type": ev, "object": manifest})
+
+            # -- verbs -----------------------------------------------------
+            def do_GET(self):
+                if self.path == "/version":
+                    return self._send(200, {"major": "1", "minor": "31", "gitVersion": "v1.31.0-fake"})
+                prefix, name, sub, q = self._split()
+                if name is None and q.get("watch") == "true":
+                    # never under the store lock: the stream blocks for
+                    # its whole lifetime and would deadlock every write
+                    return self._watch(prefix, q)
+                with store.lock:
+                    coll = store.objects.get(prefix, {})
+                    if name is None:
+                        return self._send(
+                            200,
+                            {
+                                "kind": "List", "apiVersion": "v1",
+                                "metadata": {"resourceVersion": str(store.rv)},
+                                "items": list(coll.values()),
+                            },
+                        )
+                    obj = coll.get(name)
+                if obj is None:
+                    return self._send(404, {"message": f"{name} not found"})
+                return self._send(200, obj)
+
+            def _watch(self, prefix: str, q: dict):
+                ch: "queue.Queue" = queue.Queue()
+                store.watchers.append((prefix, ch))
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    deadline = time.monotonic() + min(int(q.get("timeoutSeconds", 5)), 10)
+                    while time.monotonic() < deadline:
+                        try:
+                            ev = ch.get(timeout=0.2)
+                        except queue.Empty:
+                            continue
+                        line = (json.dumps(ev) + "\n").encode()
+                        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionError):
+                    pass
+                finally:
+                    store.watchers.remove((prefix, ch))
+
+            def do_POST(self):
+                prefix, name, sub, _ = self._split()
+                body = self._body()
+                if sub == "binding":
+                    # pod binding subresource: set spec.nodeName
+                    with store.lock:
+                        obj = store.objects.get(prefix, {}).get(name)
+                        if obj is None:
+                            return self._send(404, {"message": "pod not found"})
+                        obj.setdefault("spec", {})["nodeName"] = body.get("target", {}).get("name", "")
+                        obj.setdefault("status", {})["phase"] = "Running"
+                        obj["metadata"]["resourceVersion"] = store.bump()
+                    self._emit(prefix, "MODIFIED", obj)
+                    return self._send(201, {"kind": "Status", "status": "Success"})
+                oname = body.get("metadata", {}).get("name")
+                with store.lock:
+                    coll = store.objects.setdefault(prefix, {})
+                    if oname in coll:
+                        return self._send(
+                            409, {"reason": "AlreadyExists", "message": f"{oname} AlreadyExists"}
+                        )
+                    meta = body.setdefault("metadata", {})
+                    meta["resourceVersion"] = store.bump()
+                    meta.setdefault("uid", f"uid-{store.rv}")
+                    meta.setdefault(
+                        "creationTimestamp",
+                        time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                    )
+                    # creates never carry status (subresource owns it)
+                    body.pop("status", None)
+                    coll[oname] = body
+                self._emit(prefix, "ADDED", body)
+                return self._send(201, body)
+
+            def do_PUT(self):
+                prefix, name, sub, _ = self._split()
+                body = self._body()
+                with store.lock:
+                    coll = store.objects.setdefault(prefix, {})
+                    current = coll.get(name)
+                    if current is None:
+                        return self._send(404, {"message": f"{name} not found"})
+                    sent_rv = body.get("metadata", {}).get("resourceVersion")
+                    cur_rv = current.get("metadata", {}).get("resourceVersion")
+                    if sent_rv and sent_rv != cur_rv:
+                        return self._send(
+                            409, {"reason": "Conflict", "message": "resourceVersion stale"}
+                        )
+                    if sub == "status":
+                        current["status"] = body.get("status", {})
+                        current["metadata"]["resourceVersion"] = store.bump()
+                        obj = current
+                    else:
+                        # spec updates keep server-owned fields + status
+                        body.setdefault("metadata", {})
+                        body["metadata"]["uid"] = current["metadata"].get("uid")
+                        body["metadata"]["creationTimestamp"] = current["metadata"].get("creationTimestamp")
+                        if current["metadata"].get("deletionTimestamp"):
+                            body["metadata"]["deletionTimestamp"] = current["metadata"]["deletionTimestamp"]
+                        body["status"] = current.get("status", {})
+                        body["metadata"]["resourceVersion"] = store.bump()
+                        coll[name] = body
+                        obj = body
+                    # finalizer clearing completes a pending delete
+                    if obj["metadata"].get("deletionTimestamp") and not obj["metadata"].get("finalizers"):
+                        del coll[name]
+                        self._emit(prefix, "DELETED", obj)
+                        return self._send(200, obj)
+                self._emit(prefix, "MODIFIED", obj)
+                return self._send(200, obj)
+
+            def do_DELETE(self):
+                prefix, name, _, _ = self._split()
+                with store.lock:
+                    coll = store.objects.setdefault(prefix, {})
+                    obj = coll.get(name)
+                    if obj is None:
+                        return self._send(404, {"message": f"{name} not found"})
+                    if obj.get("metadata", {}).get("finalizers"):
+                        obj["metadata"]["deletionTimestamp"] = time.strftime(
+                            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                        )
+                        obj["metadata"]["resourceVersion"] = store.bump()
+                        event = ("MODIFIED", obj)
+                    else:
+                        del coll[name]
+                        event = ("DELETED", obj)
+                self._emit(prefix, *event)
+                return self._send(200, obj)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.address = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address[0]}:{self.address[1]}"
+
+    def start(self) -> "FakeApiServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
