@@ -136,12 +136,15 @@ def main(argv=None) -> int:
     from karpenter_tpu.utils import configure_gc_for_latency
 
     configure_gc_for_latency()
-    # a default NodeClass + NodePool so the rig provisions out of the box
+    # a default NodeClass + NodePool so the RIG provisions out of the box.
+    # Never against a real apiserver: auto-writing a provisioning policy
+    # into live infrastructure is an operator decision, not a default.
     from karpenter_tpu.apis import NodePool, TPUNodeClass
 
-    if not op.cluster.list(TPUNodeClass):
+    kube_mode = bool(args.kubeconfig or args.in_cluster)
+    if not kube_mode and not op.cluster.list(TPUNodeClass):
         op.cluster.create(TPUNodeClass("default"))
-    if not op.cluster.list(NodePool):
+    if not kube_mode and not op.cluster.list(NodePool):
         op.cluster.create(NodePool("default"))
 
     stop = {"flag": False}

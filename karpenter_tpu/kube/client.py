@@ -150,15 +150,19 @@ class KubeClient:
     def request(
         self, method: str, path: str, body: Optional[dict] = None,
         params: Optional[Dict[str, str]] = None,
+        content_type: Optional[str] = None,
     ) -> dict:
         if params:
             path = f"{path}?{urllib.parse.urlencode(params)}"
         conn = self._connect()
         try:
+            headers = self._headers()
+            if content_type:
+                headers["Content-Type"] = content_type
             conn.request(
                 method, path,
                 body=json.dumps(body) if body is not None else None,
-                headers=self._headers(),
+                headers=headers,
             )
             resp = conn.getresponse()
             raw = resp.read()
@@ -184,6 +188,14 @@ class KubeClient:
 
     def update(self, path: str, manifest: dict) -> dict:
         return self.request("PUT", path, body=manifest)
+
+    def patch(self, path: str, patch: dict) -> dict:
+        """application/merge-patch+json: update only the named fields --
+        the write verb for kinds whose objects carry server/kubelet-owned
+        fields a whole-object PUT would clobber (pods, nodes)."""
+        return self.request(
+            "PATCH", path, body=patch, content_type="application/merge-patch+json"
+        )
 
     def patch_status(self, path: str, manifest: dict) -> dict:
         return self.request("PUT", f"{path}/status", body=manifest)

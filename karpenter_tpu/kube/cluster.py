@@ -37,14 +37,14 @@ from karpenter_tpu.apis.objects import APIObject
 from karpenter_tpu.cache.ttl import Clock
 from karpenter_tpu.kube import convert
 from karpenter_tpu.kube.client import ApiError, Conflict as HttpConflict, KubeClient, NotFound as HttpNotFound
-from karpenter_tpu.kwok.cluster import AlreadyExists, Conflict, NotFound
+from karpenter_tpu.kwok.cluster import AlreadyExists, Conflict, NotFound, RelationalQueries
 from karpenter_tpu.logging import get_logger
 from karpenter_tpu.scheduling import Resources
 
 EventHandler = Callable[[str, APIObject], None]
 
 
-class KubeCluster:
+class KubeCluster(RelationalQueries):
     log = get_logger("kube")
 
     def __init__(
@@ -145,7 +145,7 @@ class KubeCluster:
                 if hit is not None and now - hit[0] <= self._list_cache_ttl:
                     manifests = hit[1]
         if manifests is None:
-            out = self.client.list(info.base_path(self.namespace))
+            out = self.client.list(info.list_path())
             manifests = list(out.get("items", ()))
             if self._list_cache_ttl:
                 with self._list_lock:
@@ -160,6 +160,14 @@ class KubeCluster:
             self._list_cache.pop(kind.KIND, None)
 
     def update(self, obj: APIObject, expect_version: Optional[int] = None) -> APIObject:
+        # pods and nodes carry server/kubelet-owned fields this framework
+        # does not model (real container specs, podCIDR, ...): a whole-
+        # object PUT would clobber them (or be rejected -- spec.nodeName
+        # is immutable). Those kinds go through field-scoped writes.
+        if isinstance(obj, Pod):
+            return self._update_pod(obj)
+        if isinstance(obj, Node):
+            return self._update_node(obj)
         info = self._info(type(obj))
         manifest = info.to_manifest(obj)
         raw_rv = getattr(obj, "_raw_resource_version", None)
@@ -180,6 +188,70 @@ class KubeCluster:
             except HttpNotFound:
                 pass  # the update cleared the last finalizer: object is gone
         return obj
+
+    def _meta_patch(self, obj: APIObject) -> dict:
+        return {
+            "labels": dict(obj.metadata.labels),
+            "annotations": dict(obj.metadata.annotations),
+            "finalizers": list(obj.metadata.finalizers),
+        }
+
+    def _update_pod(self, pod: Pod) -> Pod:
+        """Pod writes the controllers perform: unbinding (drain) and
+        metadata/phase changes. spec.nodeName is immutable, so clearing it
+        is EVICTION -- delete, and re-create pending when no controller
+        will (mirroring unbind_pods)."""
+        server = self.try_get(Pod, pod.metadata.name)
+        if server is not None and server.node_name and not pod.node_name:
+            self.delete(Pod, pod.metadata.name)
+            if not pod.metadata.owner_references:
+                info = self._info(Pod)
+                manifest = info.to_manifest(pod)
+                manifest["metadata"].pop("resourceVersion", None)
+                manifest["metadata"].pop("uid", None)
+                manifest["spec"].pop("nodeName", None)
+                manifest["status"] = {"phase": "Pending"}
+                ns = pod.metadata.namespace or self.namespace
+                try:
+                    self.client.create(info.base_path(ns), manifest)
+                except ApiError as e:
+                    self.log.warning(
+                        "bare pod re-create deferred",
+                        pod=pod.metadata.name, error=str(e)[:120],
+                    )
+            self._invalidate(Pod)
+            return pod
+        out = self.client.patch(
+            self._obj_path(pod),
+            {"metadata": self._meta_patch(pod), "status": {"phase": pod.phase}},
+        )
+        self._sync_meta(pod, self._info(Pod).from_manifest(out))
+        self._invalidate(Pod)
+        return pod
+
+    def _update_node(self, node: Node) -> Node:
+        """Node writes the controllers perform: cordon (unschedulable),
+        taints, labels -- field-scoped so kubelet-owned spec/status fields
+        survive; readiness/capacity go through nodes/status."""
+        info = self._info(Node)
+        patch = {
+            "metadata": self._meta_patch(node),
+            "spec": {
+                "unschedulable": bool(node.unschedulable),
+                "taints": [
+                    {"key": t.key, "effect": t.effect, **({"value": t.value} if t.value else {})}
+                    for t in node.taints
+                ],
+            },
+        }
+        out = self.client.patch(self._obj_path(node), patch)
+        self._sync_meta(node, info.from_manifest(out))
+        self._invalidate(Node)
+        try:
+            self._put_status(node)
+        except (HttpConflict, HttpNotFound):
+            pass
+        return node
 
     def delete(self, kind: Type[APIObject], name: str) -> Optional[APIObject]:
         info = self._info(kind)
@@ -242,7 +314,7 @@ class KubeCluster:
 
     def _watch_loop(self, kind: Type[APIObject]) -> None:
         info = self._info(kind)
-        path = info.base_path(self.namespace)
+        path = info.list_path()
         rv: Optional[str] = None
         while not self._stop.is_set():
             try:
@@ -259,6 +331,10 @@ class KubeCluster:
                         # busy-loop on the stale RV
                         if manifest.get("code") == 410:
                             rv = None
+                        else:
+                            # unknown in-band error: back off instead of
+                            # re-opening the watch in a tight loop
+                            self._stop.wait(1.0)
                         break
                     mrv = manifest.get("metadata", {}).get("resourceVersion")
                     if mrv:
@@ -281,12 +357,6 @@ class KubeCluster:
                 self._stop.wait(2.0)
 
     # -- relational queries (Cluster surface) --------------------------------
-    def pending_pods(self) -> List[Pod]:
-        return [p for p in self.list(Pod) if p.schedulable()]
-
-    def pods_on_node(self, node_name: str) -> List[Pod]:
-        return [p for p in self.list(Pod) if p.node_name == node_name]
-
     def bind_pod(self, pod: Pod, node: Node) -> None:
         # the real apiserver path: pods/{name}/binding (the kube-scheduler
         # verb); spec.nodeName is immutable through plain updates
@@ -333,35 +403,16 @@ class KubeCluster:
                 ns = p.metadata.namespace or self.namespace
                 try:
                     self.client.create(info.base_path(ns), manifest)
-                except ApiError:
-                    pass
+                except ApiError as e:
+                    # a finalizer-gated delete leaves the old object in
+                    # place (409 here); the pod is NOT pending again --
+                    # say so instead of silently losing the workload
+                    self.log.warning(
+                        "bare pod re-create deferred",
+                        pod=p.metadata.name, error=str(e)[:120],
+                    )
+                    continue
             out.append(p)
         self._invalidate(Pod)
         return out
 
-    def nodeclaim_for_node(self, node: Node) -> Optional[NodeClaim]:
-        for nc in self.list(NodeClaim):
-            if nc.provider_id and nc.provider_id == node.provider_id:
-                return nc
-        return None
-
-    def node_for_nodeclaim(self, claim: NodeClaim) -> Optional[Node]:
-        for n in self.list(Node):
-            if n.provider_id and n.provider_id == claim.provider_id:
-                return n
-        return None
-
-    def node_usage(self, node_name: str) -> Resources:
-        total = Resources()
-        for p in self.pods_on_node(node_name):
-            total = total + p.requests
-        return total
-
-    def nodepool_usage(self, nodepool_name: str) -> Resources:
-        from karpenter_tpu.apis import labels as wk
-
-        total = Resources()
-        for nc in self.list(NodeClaim):
-            if nc.metadata.labels.get(wk.NODEPOOL_LABEL) == nodepool_name and not nc.deleting:
-                total = total + nc.capacity
-        return total

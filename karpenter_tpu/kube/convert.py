@@ -59,7 +59,9 @@ def parse_duration(s: Optional[str]) -> Optional[float]:
 def format_duration(seconds: Optional[float]) -> Optional[str]:
     if seconds is None:
         return None
-    return f"{int(seconds)}s"
+    # %g keeps fractional seconds ("0.5s"); int() would silently turn a
+    # 500ms consolidation window into 0s
+    return f"{seconds:g}s"
 
 
 def format_time(epoch: float) -> str:
@@ -895,6 +897,14 @@ class KindInfo:
         if self.namespaced:
             return f"{root}/namespaces/{namespace or 'default'}/{self.plural}"
         return f"{root}/{self.plural}"
+
+    def list_path(self) -> str:
+        """Cluster-wide collection path: LISTs span ALL namespaces (the
+        in-memory store is namespace-agnostic; a default-namespace-only
+        view would hide workloads and mis-count node usage)."""
+        if "/" in self.api_version:
+            return f"/apis/{self.api_version}/{self.plural}"
+        return f"/api/{self.api_version}/{self.plural}"
 
 
 REGISTRY: Dict[type, KindInfo] = {

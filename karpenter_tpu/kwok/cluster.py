@@ -37,7 +37,48 @@ class AlreadyExists(Exception):
 EventHandler = Callable[[str, APIObject], None]  # (event_type, object)
 
 
-class Cluster:
+
+class RelationalQueries:
+    """Read-only pod/node/claim relations derived purely from list() --
+    shared verbatim by the in-memory Cluster and the apiserver-backed
+    KubeCluster so the two buses can never drift on these semantics."""
+
+    def pending_pods(self) -> List[Pod]:
+        return [p for p in self.list(Pod) if p.schedulable()]
+
+    def pods_on_node(self, node_name: str) -> List[Pod]:
+        return [p for p in self.list(Pod) if p.node_name == node_name]
+
+    def nodeclaim_for_node(self, node: Node) -> Optional[NodeClaim]:
+        for nc in self.list(NodeClaim):
+            if nc.provider_id and nc.provider_id == node.provider_id:
+                return nc
+        return None
+
+    def node_for_nodeclaim(self, claim: NodeClaim) -> Optional[Node]:
+        for n in self.list(Node):
+            if n.provider_id and n.provider_id == claim.provider_id:
+                return n
+        return None
+
+    def node_usage(self, node_name: str) -> Resources:
+        total = Resources()
+        for p in self.pods_on_node(node_name):
+            total = total + p.requests
+        return total
+
+    def nodepool_usage(self, nodepool_name: str) -> Resources:
+        from karpenter_tpu.apis import labels as wk
+
+        total = Resources()
+        for nc in self.list(NodeClaim):
+            if nc.metadata.labels.get(wk.NODEPOOL_LABEL) == nodepool_name and not nc.deleting:
+                total = total + nc.capacity
+        return total
+
+
+
+class Cluster(RelationalQueries):
     KINDS: Tuple[Type[APIObject], ...] = (Pod, Node, NodeClaim, NodePool, TPUNodeClass, Lease, PodDisruptionBudget, DaemonSet)
 
     def __init__(self, clock: Optional[Clock] = None):
@@ -211,13 +252,7 @@ class Cluster:
         if removed:
             self._emit("DELETED", obj)
 
-    # -- relational queries (cluster-state role) ----------------------------
-    def pending_pods(self) -> List[Pod]:
-        return [p for p in self.list(Pod) if p.schedulable()]
-
-    def pods_on_node(self, node_name: str) -> List[Pod]:
-        return [p for p in self.list(Pod) if p.node_name == node_name]
-
+    # -- relational writes (reads shared via RelationalQueries) -------------
     def bind_pod(self, pod: Pod, node: Node) -> None:
         pod.node_name = node.metadata.name
         pod.phase = "Running"
@@ -233,30 +268,3 @@ class Cluster:
             self.update(p)
             out.append(p)
         return out
-
-    def nodeclaim_for_node(self, node: Node) -> Optional[NodeClaim]:
-        for nc in self.list(NodeClaim):
-            if nc.provider_id and nc.provider_id == node.provider_id:
-                return nc
-        return None
-
-    def node_for_nodeclaim(self, claim: NodeClaim) -> Optional[Node]:
-        for n in self.list(Node):
-            if n.provider_id and n.provider_id == claim.provider_id:
-                return n
-        return None
-
-    def node_usage(self, node_name: str) -> Resources:
-        total = Resources()
-        for p in self.pods_on_node(node_name):
-            total = total + p.requests
-        return total
-
-    def nodepool_usage(self, nodepool_name: str) -> Resources:
-        from karpenter_tpu.apis import labels as wk
-
-        total = Resources()
-        for nc in self.list(NodeClaim):
-            if nc.metadata.labels.get(wk.NODEPOOL_LABEL) == nodepool_name and not nc.deleting:
-                total = total + nc.capacity
-        return total

@@ -69,8 +69,10 @@ class FakeApiServer:
                 root = 2 if parts[0] == "api" else 3
                 rest = parts[root:]
                 if rest and rest[0] == "namespaces" and len(rest) >= 3:
-                    rest = ["namespaces", rest[1], rest[2]] + rest[3:]
-                    prefix = "/" + "/".join(parts[:root] + rest[:3])
+                    # canonical storage key ignores the namespace segment
+                    # (like the real server's generic registry keyed by
+                    # resource; cluster-wide LISTs then see every object)
+                    prefix = "/" + "/".join(parts[:root] + [rest[2]])
                     tail = rest[3:]
                 else:
                     prefix = "/" + "/".join(parts[:root] + rest[:1])
@@ -202,6 +204,32 @@ class FakeApiServer:
                         return self._send(200, obj)
                 self._emit(prefix, "MODIFIED", obj)
                 return self._send(200, obj)
+
+            def do_PATCH(self):
+                prefix, name, sub, _ = self._split()
+                body = self._body()
+
+                def merge(base, over):
+                    out = dict(base)
+                    for k, v in over.items():
+                        if v is None:
+                            out.pop(k, None)
+                        elif isinstance(v, dict) and isinstance(out.get(k), dict):
+                            out[k] = merge(out[k], v)
+                        else:
+                            out[k] = v
+                    return out
+
+                with store.lock:
+                    coll = store.objects.setdefault(prefix, {})
+                    current = coll.get(name)
+                    if current is None:
+                        return self._send(404, {"message": f"{name} not found"})
+                    merged = merge(current, body)
+                    merged["metadata"]["resourceVersion"] = store.bump()
+                    coll[name] = merged
+                self._emit(prefix, "MODIFIED", merged)
+                return self._send(200, merged)
 
             def do_DELETE(self):
                 prefix, name, _, _ = self._split()

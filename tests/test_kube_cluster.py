@@ -239,6 +239,63 @@ class TestKubeClusterCRUD:
         assert ("ADDED", "NodePool", "watched") in seen
 
 
+class TestRealBusSemantics:
+    """Round-4 review regressions: semantics a REAL apiserver enforces
+    that the in-memory store does not."""
+
+    def test_pod_unbind_update_is_eviction(self, cluster):
+        """spec.nodeName is immutable: a drain's update(node_name='')
+        must translate to delete + pending re-create (bare pod), never a
+        whole-object PUT."""
+        cluster.create(Node("n1", capacity=Resources({"cpu": "8"})))
+        pod = cluster.create(Pod("w", requests=Resources({"cpu": "1"})))
+        cluster.bind_pod(pod, cluster.get(Node, "n1"))
+        pod.node_name = ""
+        pod.phase = "Pending"
+        cluster.update(pod)
+        back = cluster.get(Pod, "w")
+        assert back.node_name == "" and back.schedulable(), (
+            "bare pod must come back pending after the eviction-style update"
+        )
+
+    def test_pod_metadata_update_is_field_scoped(self, cluster):
+        """A metadata update must not clobber the bound nodeName (a
+        whole-object PUT from a stale reader would)."""
+        cluster.create(Node("n1", capacity=Resources({"cpu": "8"})))
+        pod = cluster.create(Pod("w2", requests=Resources({"cpu": "1"})))
+        cluster.bind_pod(pod, cluster.get(Node, "n1"))
+        pod.metadata.annotations["seen"] = "true"
+        cluster.update(pod)
+        back = cluster.get(Pod, "w2")
+        assert back.node_name == "n1"
+        assert back.metadata.annotations.get("seen") == "true"
+
+    def test_node_cordon_is_field_scoped(self, cluster):
+        node = cluster.create(Node("n2", capacity=Resources({"cpu": "8"})))
+        node.unschedulable = True
+        cluster.update(node)
+        back = cluster.get(Node, "n2")
+        assert back.unschedulable
+        assert back.capacity.get("cpu") == 8000.0, "status must survive the cordon"
+
+    def test_lists_span_namespaces(self, cluster):
+        """The in-memory store is namespace-agnostic; the adapter must
+        see pods outside its default namespace or consolidation would
+        treat their nodes as empty."""
+        cluster.create(Pod("w-default", requests=Resources({"cpu": "1"})))
+        cluster.create(Pod("w-app", namespace="app", requests=Resources({"cpu": "1"})))
+        names = {p.metadata.name for p in cluster.list(Pod)}
+        assert names == {"w-default", "w-app"}
+
+    def test_subsecond_durations_roundtrip(self):
+        from karpenter_tpu.kube import convert
+
+        pool = NodePool("frac")
+        pool.disruption.consolidate_after = 0.5
+        back = convert.nodepool_from_manifest(convert.nodepool_to_manifest(pool))
+        assert back.disruption.consolidate_after == 0.5
+
+
 class TestProvisionLoopOverKube:
     """The decision plane running with the REAL-bus adapter: pending pods
     through the oracle/solver to NodeClaims, all state on the (fake)
