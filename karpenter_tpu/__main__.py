@@ -67,7 +67,17 @@ def build_operator(args):
         from karpenter_tpu.solver.service import TPUSolver
 
         enable_jax_compilation_cache()
-        solver = TPUSolver(auto_warm=True)
+        # sidecar topology (deploy/controller.yaml): the solver process
+        # owns the chip; this process ships tensors over its UNIX socket
+        import os as _os
+
+        sock = _os.environ.get("KARPENTER_TPU_SOLVER_SOCKET", "")
+        client = None
+        if sock:
+            from karpenter_tpu.solver.rpc import SolverClient
+
+            client = SolverClient(path=sock)
+        solver = TPUSolver(auto_warm=client is None, client=client)
         evaluator = ConsolidationEvaluator()
     cluster = None
     if getattr(args, "kubeconfig", None) or getattr(args, "in_cluster", False):

@@ -128,12 +128,22 @@ class KubeCluster(RelationalQueries):
         return obj
 
     def try_get(self, kind: Type[APIObject], name: str) -> Optional[APIObject]:
+        """The Cluster surface is name-keyed (the in-memory store is
+        namespace-agnostic): try the configured namespace first, then fall
+        back to a cluster-wide scan so objects in other namespaces are
+        reachable by name too."""
         info = self._info(kind)
         try:
             out = self.client.get(f"{info.base_path(self.namespace)}/{name}")
+            return info.from_manifest(out)
         except HttpNotFound:
+            pass
+        if not info.namespaced:
             return None
-        return info.from_manifest(out)
+        for obj in self.list(kind):
+            if obj.metadata.name == name:
+                return obj
+        return None
 
     def list(self, kind: Type[APIObject], predicate=None) -> List[APIObject]:
         info = self._info(kind)
@@ -189,10 +199,21 @@ class KubeCluster(RelationalQueries):
                 pass  # the update cleared the last finalizer: object is gone
         return obj
 
-    def _meta_patch(self, obj: APIObject) -> dict:
+    def _meta_patch(self, obj: APIObject, server: Optional[APIObject]) -> dict:
+        """RFC 7386 merge-patch deletes only keys explicitly set to null:
+        removed labels/annotations must be nulled against the SERVER copy
+        or they silently survive (e.g. a lapsed reservation-id label)."""
+
+        def with_nulls(new: dict, old: dict) -> dict:
+            out: dict = {k: None for k in old if k not in new}
+            out.update(new)
+            return out
+
+        old_labels = dict(server.metadata.labels) if server else {}
+        old_annos = dict(server.metadata.annotations) if server else {}
         return {
-            "labels": dict(obj.metadata.labels),
-            "annotations": dict(obj.metadata.annotations),
+            "labels": with_nulls(dict(obj.metadata.labels), old_labels),
+            "annotations": with_nulls(dict(obj.metadata.annotations), old_annos),
             "finalizers": list(obj.metadata.finalizers),
         }
 
@@ -205,37 +226,48 @@ class KubeCluster(RelationalQueries):
         if server is not None and server.node_name and not pod.node_name:
             self.delete(Pod, pod.metadata.name)
             if not pod.metadata.owner_references:
-                info = self._info(Pod)
-                manifest = info.to_manifest(pod)
-                manifest["metadata"].pop("resourceVersion", None)
-                manifest["metadata"].pop("uid", None)
-                manifest["spec"].pop("nodeName", None)
-                manifest["status"] = {"phase": "Pending"}
-                ns = pod.metadata.namespace or self.namespace
-                try:
-                    self.client.create(info.base_path(ns), manifest)
-                except ApiError as e:
-                    self.log.warning(
-                        "bare pod re-create deferred",
-                        pod=pod.metadata.name, error=str(e)[:120],
-                    )
+                self._recreate_bare_pod(pod)
             self._invalidate(Pod)
             return pod
         out = self.client.patch(
-            self._obj_path(pod),
-            {"metadata": self._meta_patch(pod), "status": {"phase": pod.phase}},
+            self._obj_path(pod), {"metadata": self._meta_patch(pod, server)}
         )
+        # pod status is a SUBRESOURCE: a phase change on the main resource
+        # would be silently dropped by a real apiserver
+        if server is None or server.phase != pod.phase:
+            self.client.patch(
+                f"{self._obj_path(pod)}/status", {"status": {"phase": pod.phase}}
+            )
         self._sync_meta(pod, self._info(Pod).from_manifest(out))
         self._invalidate(Pod)
         return pod
+
+    def _recreate_bare_pod(self, pod: Pod) -> None:
+        """Re-create an evicted OWNERLESS pod as pending (nothing else
+        will); shared by the eviction-style update and unbind_pods."""
+        info = self._info(Pod)
+        manifest = info.to_manifest(pod)
+        manifest["metadata"].pop("resourceVersion", None)
+        manifest["metadata"].pop("uid", None)
+        manifest["spec"].pop("nodeName", None)
+        manifest["status"] = {"phase": "Pending"}
+        ns = pod.metadata.namespace or self.namespace
+        try:
+            self.client.create(info.base_path(ns), manifest)
+        except ApiError as e:
+            self.log.warning(
+                "bare pod re-create deferred",
+                pod=pod.metadata.name, error=str(e)[:120],
+            )
 
     def _update_node(self, node: Node) -> Node:
         """Node writes the controllers perform: cordon (unschedulable),
         taints, labels -- field-scoped so kubelet-owned spec/status fields
         survive; readiness/capacity go through nodes/status."""
         info = self._info(Node)
+        server = self.try_get(Node, node.metadata.name)
         patch = {
-            "metadata": self._meta_patch(node),
+            "metadata": self._meta_patch(node, server),
             "spec": {
                 "unschedulable": bool(node.unschedulable),
                 "taints": [
@@ -255,9 +287,15 @@ class KubeCluster(RelationalQueries):
 
     def delete(self, kind: Type[APIObject], name: str) -> Optional[APIObject]:
         info = self._info(kind)
-        path = f"{info.base_path(self.namespace)}/{name}"
+        # resolve the object's OWN namespace: deleting by the configured
+        # namespace would 404 (or hit a same-named neighbor) for objects
+        # that live elsewhere
+        existing = self.try_get(kind, name)
+        if existing is None:
+            return None
+        ns = existing.metadata.namespace or self.namespace
         try:
-            self.client.delete(path)
+            self.client.delete(f"{info.base_path(ns)}/{name}")
         except HttpNotFound:
             return None
         self._invalidate(kind)
@@ -383,7 +421,6 @@ class KubeCluster(RelationalQueries):
         deleted and the controller re-creates them; bare pods are deleted
         and RE-CREATED here, pending, preserving their spec -- deleting
         them outright would destroy the workload."""
-        info = self._info(Pod)
         out = []
         for p in self.pods_on_node(node_name):
             try:
@@ -395,23 +432,7 @@ class KubeCluster(RelationalQueries):
             if not p.metadata.owner_references:
                 # no REAL owner (uid-carrying ownerReference): nothing
                 # will re-create this pod, so we do
-                manifest = info.to_manifest(p)
-                manifest["metadata"].pop("resourceVersion", None)
-                manifest["metadata"].pop("uid", None)
-                manifest["spec"].pop("nodeName", None)
-                manifest["status"] = {"phase": "Pending"}
-                ns = p.metadata.namespace or self.namespace
-                try:
-                    self.client.create(info.base_path(ns), manifest)
-                except ApiError as e:
-                    # a finalizer-gated delete leaves the old object in
-                    # place (409 here); the pod is NOT pending again --
-                    # say so instead of silently losing the workload
-                    self.log.warning(
-                        "bare pod re-create deferred",
-                        pod=p.metadata.name, error=str(e)[:120],
-                    )
-                    continue
+                self._recreate_bare_pod(p)
             out.append(p)
         self._invalidate(Pod)
         return out

@@ -59,9 +59,12 @@ def parse_duration(s: Optional[str]) -> Optional[float]:
 def format_duration(seconds: Optional[float]) -> Optional[str]:
     if seconds is None:
         return None
-    # %g keeps fractional seconds ("0.5s"); int() would silently turn a
-    # 500ms consolidation window into 0s
-    return f"{seconds:g}s"
+    # decimal, never exponent notation: %g would emit "2.592e+06s" for a
+    # 30-day expireAfter, which no duration parser accepts; int() would
+    # silently turn a 500ms consolidation window into 0s
+    if seconds == int(seconds):
+        return f"{int(seconds)}s"
+    return f"{seconds:.9f}".rstrip("0").rstrip(".") + "s"
 
 
 def format_time(epoch: float) -> str:

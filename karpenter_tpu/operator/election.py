@@ -46,15 +46,22 @@ class LeaderElector:
         """Acquire or renew; fires on_elected hooks on each transition into
         leadership (the reference re-hydrates caches on every election win,
         not only the first). Returns whether this replica currently leads."""
+        from karpenter_tpu.kwok.cluster import AlreadyExists, Conflict
+
         now = self.cluster.clock.now()
         lease = self.cluster.try_get(Lease, self.lease_name)
-        if lease is None:
-            lease = Lease(self.lease_name, self.identity, now + self.lease_duration)
-            self.cluster.create(lease)
-        elif lease.holder == self.identity or lease.renew_deadline <= now:
-            lease.holder = self.identity
-            lease.renew_deadline = now + self.lease_duration
-            self.cluster.update(lease)
+        try:
+            if lease is None:
+                lease = Lease(self.lease_name, self.identity, now + self.lease_duration)
+                self.cluster.create(lease)
+            elif lease.holder == self.identity or lease.renew_deadline <= now:
+                lease.holder = self.identity
+                lease.renew_deadline = now + self.lease_duration
+                self.cluster.update(lease)
+        except (AlreadyExists, Conflict):
+            # lost the acquire race to another replica (a real apiserver
+            # surfaces this as 409); the re-read below decides leadership
+            pass
         holding = self.elected
         if holding and not self._was_elected:
             for hook in self.on_elected:

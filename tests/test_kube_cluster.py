@@ -295,6 +295,38 @@ class TestRealBusSemantics:
         back = convert.nodepool_from_manifest(convert.nodepool_to_manifest(pool))
         assert back.disruption.consolidate_after == 0.5
 
+    def test_long_durations_never_exponent(self):
+        """30-day expireAfter must round-trip (%g would emit 2.592e+06s,
+        which no duration parser accepts)."""
+        from karpenter_tpu.kube import convert
+
+        assert convert.format_duration(2_592_000.0) == "2592000s"
+        assert convert.parse_duration(convert.format_duration(2_592_000.0)) == 2_592_000.0
+
+    def test_label_removal_reaches_the_server(self, cluster):
+        """Merge-patch deletes only nulled keys: a popped label must be
+        nulled against the server copy or it survives forever."""
+        node = cluster.create(Node("n3", labels={"keep": "1", "lapsed": "1"},
+                                   capacity=Resources({"cpu": "8"})))
+        node.metadata.labels.pop("lapsed")
+        cluster.update(node)
+        back = cluster.get(Node, "n3")
+        assert "lapsed" not in back.metadata.labels
+        assert back.metadata.labels.get("keep") == "1"
+
+    def test_cross_namespace_pod_eviction_targets_right_pod(self, cluster):
+        """delete()/eviction must resolve the pod's OWN namespace, not the
+        adapter default."""
+        cluster.create(Node("n1", capacity=Resources({"cpu": "8"})))
+        pod = cluster.create(Pod("w-app", namespace="app", requests=Resources({"cpu": "1"})))
+        cluster.bind_pod(pod, cluster.get(Node, "n1"))
+        pod.node_name = ""
+        pod.phase = "Pending"
+        cluster.update(pod)
+        back = cluster.get(Pod, "w-app")
+        assert back.metadata.namespace == "app"
+        assert back.schedulable(), "evicted app-namespace pod must come back pending"
+
 
 class TestProvisionLoopOverKube:
     """The decision plane running with the REAL-bus adapter: pending pods
