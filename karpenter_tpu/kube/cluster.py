@@ -224,7 +224,7 @@ class KubeCluster(RelationalQueries):
         will (mirroring unbind_pods)."""
         server = self.try_get(Pod, pod.metadata.name)
         if server is not None and server.node_name and not pod.node_name:
-            self.delete(Pod, pod.metadata.name)
+            self.delete_object(server)
             if not pod.metadata.owner_references:
                 self._recreate_bare_pod(pod)
             self._invalidate(Pod)
@@ -286,22 +286,41 @@ class KubeCluster(RelationalQueries):
         return node
 
     def delete(self, kind: Type[APIObject], name: str) -> Optional[APIObject]:
+        """Name-keyed delete (the in-memory surface is name-unique). The
+        configured namespace is tried first; outside it the target must be
+        UNAMBIGUOUS -- with several same-named objects across namespaces
+        nothing is deleted (deleting 'the first one found' would destroy
+        an unrelated workload). Callers holding the object use its exact
+        path (delete_object)."""
         info = self._info(kind)
-        # resolve the object's OWN namespace: deleting by the configured
-        # namespace would 404 (or hit a same-named neighbor) for objects
-        # that live elsewhere
-        existing = self.try_get(kind, name)
-        if existing is None:
-            return None
-        ns = existing.metadata.namespace or self.namespace
         try:
-            self.client.delete(f"{info.base_path(ns)}/{name}")
+            self.client.delete(f"{info.base_path(self.namespace)}/{name}")
+            self._invalidate(kind)
+            return self.try_get(kind, name)
+        except HttpNotFound:
+            pass
+        if not info.namespaced:
+            return None
+        matches = [o for o in self.list(kind) if o.metadata.name == name]
+        if not matches:
+            return None
+        if len(matches) > 1:
+            self.log.warning(
+                "name-keyed delete is ambiguous across namespaces; refusing",
+                kind=kind.KIND, name=name,
+                namespaces=[m.metadata.namespace for m in matches],
+            )
+            return None
+        return self.delete_object(matches[0])
+
+    def delete_object(self, obj: APIObject) -> Optional[APIObject]:
+        """Namespace-exact delete for callers holding the object."""
+        try:
+            self.client.delete(self._obj_path(obj))
         except HttpNotFound:
             return None
-        self._invalidate(kind)
-        # finalizer semantics: the object survives (deleting) while
-        # finalizers remain -- mirror the in-memory contract by re-reading
-        return self.try_get(kind, name)
+        self._invalidate(type(obj))
+        return self.try_get(type(obj), obj.metadata.name)
 
     def remove_finalizer(self, obj: APIObject, finalizer: str) -> None:
         if finalizer in obj.metadata.finalizers:
@@ -424,7 +443,7 @@ class KubeCluster(RelationalQueries):
         out = []
         for p in self.pods_on_node(node_name):
             try:
-                self.delete(Pod, p.metadata.name)
+                self.delete_object(p)
             except ApiError:
                 continue
             p.node_name = ""

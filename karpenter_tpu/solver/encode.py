@@ -77,6 +77,16 @@ NUMERIC_DIMS: Tuple[str, ...] = (
 )
 ND = len(NUMERIC_DIMS)
 
+# requirement keys the tensor encoding can express; constraints on any
+# OTHER key are invisible to the device compat (they ride into the decoded
+# group requirements but cannot gate joins), so routing must keep classes
+# with DIVERGENT un-encodable constraints off the device path
+# (service.supports; the oracle's _try_group would refuse those joins)
+ENCODABLE_KEYS = frozenset(LABEL_DIMS) | frozenset(NUMERIC_DIMS) | {
+    wk.ZONE_LABEL,
+    wk.CAPACITY_TYPE_LABEL,
+}
+
 # unit scaling per resource axis: raw base units -> small exact ints
 _SCALE = np.ones((R,), dtype=np.float64)
 _SCALE[res.AXIS_INDEX[res.MEMORY]] = 1.0 / 2**20          # bytes -> MiB
@@ -232,6 +242,9 @@ class PodClassSet:
     # [R] f32 per-fresh-node reserve (daemonset overhead for the solved
     # pool, apis/daemonset.pool_daemon_overhead); zeros = no reserve
     node_overhead: np.ndarray = None
+    # [C, K] bool open-restriction mask (merged multi-pool solves only;
+    # None = open anywhere compat allows). See ffd.SolveInputs.open_allowed.
+    open_allowed: np.ndarray = None
 
 
 def soft_zone_tsc(pod: Pod):

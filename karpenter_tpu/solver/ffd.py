@@ -74,6 +74,12 @@ class SolveInputs(NamedTuple):
     acap: jax.Array         # [C, CT] bool
     schedulable: jax.Array  # [C] bool
     node_overhead: jax.Array  # [R] f32 per-fresh-node reserve (daemonsets)
+    # [C, K] bool: columns class c may OPEN fresh groups on (joins use the
+    # full compat). All-true except the merged multi-pool solve, where a
+    # class opens only in its highest-weight feasible pool (the oracle's
+    # _open_group pool-order preference) while joining any admitted
+    # pool's in-flight groups.
+    open_allowed: jax.Array
 
 
 class SolveOutputs(NamedTuple):
@@ -243,7 +249,7 @@ def _ffd_body(
     # [K]-sized passes inside the sequential loop)
     n_fresh_all = _fresh_fit_counts(cap_eff, inp.req)             # [C, K]
     fresh_join = _joint_ok(azc[:, None] & tzc[None, :])           # [C, K]
-    fresh_mask_all = compat & fresh_join                          # [C, K]
+    fresh_mask_all = compat & fresh_join & inp.open_allowed       # [C, K]
     if objective == "price":
         # price-aware opening (BASELINE.json configs 3-4): fresh groups are
         # sized to the type minimizing the TOTAL cost of hosting the class's
@@ -663,6 +669,13 @@ def stage_catalog(catalog: CatalogTensors, device=None) -> Tuple[StagedCatalog, 
     return staged, offsets, words
 
 
+def _open_allowed(classes: PodClassSet, k_pad: int) -> np.ndarray:
+    oa = getattr(classes, "open_allowed", None)
+    if oa is None:
+        return np.ones((classes.c_pad, k_pad), dtype=bool)
+    return oa
+
+
 def make_inputs_staged(staged: StagedCatalog, classes: PodClassSet) -> SolveInputs:
     """SolveInputs over a pre-staged device catalog; class-side leaves stay
     host numpy so the jit dispatch streams them asynchronously."""
@@ -676,6 +689,7 @@ def make_inputs_staged(staged: StagedCatalog, classes: PodClassSet) -> SolveInpu
         num_lo=classes.num_lo, num_hi=classes.num_hi, azone=classes.azone,
         acap=classes.acap, schedulable=classes.schedulable,
         node_overhead=classes.node_overhead,
+        open_allowed=_open_allowed(classes, int(staged.cap.shape[0])),
     )
 
 
@@ -701,5 +715,6 @@ def make_inputs(catalog: CatalogTensors, classes: PodClassSet) -> Tuple[SolveInp
         acap=jnp.asarray(classes.acap),
         schedulable=jnp.asarray(classes.schedulable),
         node_overhead=jnp.asarray(classes.node_overhead),
+        open_allowed=jnp.asarray(_open_allowed(classes, catalog.k_pad)),
     )
     return inp, offsets, words

@@ -307,6 +307,11 @@ class SolverServer:
             node_overhead=t.get(
                 "node_overhead", np.zeros((t["req"].shape[1],), dtype=np.float32)
             ),
+            # ones preserves pre-multipool clients: open anywhere compat allows
+            open_allowed=t.get(
+                "open_allowed",
+                np.ones((t["req"].shape[0], entry.staged.cap.shape[0]), dtype=bool),
+            ),
         )
         return entry, inp
 
@@ -457,7 +462,10 @@ class SolverClient:
             ("azone", class_set.azone), ("acap", class_set.acap),
             ("schedulable", class_set.schedulable),
             ("node_overhead", class_set.node_overhead),
-        ]
+        ] + (
+            [("open_allowed", class_set.open_allowed)]
+            if getattr(class_set, "open_allowed", None) is not None else []
+        )
 
     def _solve_op(self, op_header: dict, seqnum: str, catalog, class_set):
         """Shared stage-if-needed + solve + unknown-seqnum retry."""

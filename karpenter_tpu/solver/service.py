@@ -44,6 +44,22 @@ class _CatalogEntry(NamedTuple):
     types_by_price: np.ndarray         # object array, cheapest first
     order: np.ndarray                  # argsort indices into the catalog list
     catalog_list: Sequence             # strong ref: keeps the id() key sound
+    # merged multi-pool solves only (solver/multipool.py): pool index per
+    # real column, the pool objects (weight order), and the ORIGINAL type
+    # objects in types_by_price order for decode emission
+    col_pools: Optional[np.ndarray] = None
+    pools: Optional[tuple] = None
+    decode_types: Optional[np.ndarray] = None
+
+
+class _MergedVirtualPool(NodePool):
+    """The solve-level stand-in pool for merged multi-pool dispatches: no
+    requirements of its own (each class carries its admitted-pool pin; each
+    column carries its pool's requirements), no taints (toleration is part
+    of host-side admission), no limits (carved out)."""
+
+    def requirements(self):
+        return Requirements()
 
 
 class TPUSolver:
@@ -88,6 +104,9 @@ class TPUSolver:
         self._seq_prefix = uuid.uuid4().hex[:12]
         self._seq_counter = 0
         self._warmed_pads: set = set()
+        # merged multi-pool catalog lists, keyed by (per-pool catalog ids,
+        # per-pool requirement hashes); bounded (catalogs refresh 12-hourly)
+        self._merged_cache: Dict[tuple, tuple] = {}
         self._lock = threading.Lock()
 
     # -- catalog staging ----------------------------------------------------
@@ -223,6 +242,22 @@ class TPUSolver:
         # (_mv_partition_blocked: a shared existing node or a shared
         # spread selector couples them, and a partitioned solve could
         # then diverge from the oracle's interleaved order).
+        # un-encodable requirement keys (custom labels, zone-id, ...):
+        # the device compat cannot see them, so two classes with DIFFERENT
+        # constraints on one such key would falsely share groups (the
+        # oracle's join gate refuses conflicting requirements). A single
+        # uniform constraint per key is safe -- it rides into the decoded
+        # group requirements unchanged.
+        unenc: Dict[str, set] = {}
+        for pc in classes:
+            for r in pc.requirements:
+                if r.key not in encode.ENCODABLE_KEYS:
+                    unenc.setdefault(r.key, set()).add(
+                        (r.complement, tuple(sorted(r.values)),
+                         r.greater_than, r.less_than)
+                    )
+        if any(len(v) > 1 for v in unenc.values()):
+            return False
         mv_classes = TPUSolver._mv_classes(scheduler, classes)
         if mv_classes:
             mv_ids = {id(pc) for pc in mv_classes}
@@ -387,8 +422,12 @@ class TPUSolver:
             # a class compatible with SEVERAL pools can join another
             # class's open group across the pool boundary in the oracle's
             # first-fit order (in-flight capacity beats weight preference,
-            # as in the reference core); pool-sequential solves cannot
-            # express that, so overlapping-compat batches take the oracle
+            # as in the reference core). Round 4: the MERGED-CATALOG solve
+            # (solver/multipool.py) expresses exactly that on device; the
+            # oracle remains the fallback for the carve-outs.
+            merged = self._try_solve_merged(scheduler, pods, base_classes)
+            if merged is not None:
+                return merged
             scheduler.objective = self.objective
             return scheduler.schedule(pods)
         # minValues class-level split (round 4): supports() has already
@@ -448,6 +487,126 @@ class TPUSolver:
             # each round's leftovers, which must not clobber the oracle
             # partition's entries
             result.unschedulable.update(mv_result.unschedulable)
+        return result
+
+    @staticmethod
+    def _unify_envelopes(classes, class_set, pool_of) -> None:
+        """The oracle's price envelope is keyed per (pool, merged
+        requirement class) (_env_key/_remaining): two classes whose
+        requirements COINCIDE once the opening pool's requirements merge
+        (e.g. a pod selecting the very label the pool pins) share ONE
+        remaining-count envelope, so a node opened for the first class is
+        sized for BOTH. Mirror it by pinning each such row's env_count to
+        the TAIL total of its coinciding rows in scan order -- the
+        oracle's remaining at that row's first open."""
+        from karpenter_tpu.solver.encode import _class_key
+
+        keys = []
+        for c, pc in enumerate(classes):
+            info = pool_of(c)
+            if info is None:
+                keys.append(None)
+                continue
+            pool_name, extra = info
+            reqs = pc.requirements
+            if extra is not None:
+                reqs = reqs.copy().add(*extra)
+            keys.append((pool_name, _class_key(pc.pods[0], reqs)))
+        from collections import Counter
+
+        dup = {k for k, n in Counter(k for k in keys if k is not None).items() if n > 1}
+        if not dup:
+            return
+        tail: dict = {}
+        for c in range(len(classes) - 1, -1, -1):
+            k = keys[c]
+            if k not in dup:
+                continue
+            tail[k] = tail.get(k, 0) + len(classes[c].pods)
+            if class_set.env_count[c] == -1:
+                class_set.env_count[c] = tail[k]
+
+    # -- merged multi-pool solve (solver/multipool.py) -----------------------
+    def _try_solve_merged(self, scheduler, pods, base_classes):
+        """Overlapping-compat multi-pool batch on device via the merged
+        catalog, or None when a carve-out applies (the caller falls back
+        to the oracle). Carve-outs: pool limits, minValues pools, unequal
+        per-pool daemonset overhead. Spread classes never reach here
+        (supports() routes multi-pool spread to the oracle first)."""
+        from karpenter_tpu.solver import multipool
+
+        pools = scheduler.nodepools  # weight-descending (oracle order)
+        if any(p.limits is not None for p in pools):
+            return None
+        if any(
+            any(r.min_values is not None for r in p.requirements()) for p in pools
+        ):
+            return None
+        overheads = [
+            scheduler.daemon_overhead.get(p.name) or Resources() for p in pools
+        ]
+        vecs = [encode.scale_vector(o.to_vector()) for o in overheads]
+        if any(not np.array_equal(vecs[0], v) for v in vecs[1:]):
+            return None
+        # per-pool taints would need per-COLUMN toleration gating (the
+        # oracle's join check tolerates the GROUP's pool taints); with
+        # identical taints the global schedulable flag covers it
+        taints0 = [(t.key, t.value, t.effect) for t in pools[0].template.taints]
+        if any(
+            [(t.key, t.value, t.effect) for t in p.template.taints] != taints0
+            for p in pools[1:]
+        ):
+            return None
+        # cache keyed by per-pool catalog identity + requirement hashes;
+        # the entry RETAINS the catalog lists and re-checks identity on hit
+        # (the same id()-reuse hazard _catalog documents: a freed list's
+        # address can be recycled by the 12-hourly refresh)
+        cat_lists = tuple(scheduler.instance_types.get(p.name) for p in pools)
+        key = (
+            tuple(id(cl) for cl in cat_lists),
+            tuple(p.requirements().stable_hash() for p in pools),
+        )
+        cached = self._merged_cache.get(key)
+        if cached is not None and all(
+            a is b for a, b in zip(cached[0], cat_lists)
+        ):
+            _, merged_items, originals, col_pools = cached
+        else:
+            merged_items, originals, col_pools = multipool.build_merged(
+                pools, scheduler.instance_types
+            )
+            if not merged_items:
+                return None
+            self._merged_cache[key] = (cat_lists, merged_items, originals, col_pools)
+            while len(self._merged_cache) > 4:
+                self._merged_cache.pop(next(iter(self._merged_cache)))
+        classes = base_classes
+        result = SchedulingResult()
+        entry = self._catalog(merged_items)
+        if entry.col_pools is None:
+            entry = entry._replace(
+                col_pools=col_pools, pools=tuple(pools),
+                decode_types=np.array(list(originals), dtype=object)[entry.order],
+            )
+            with self._lock:
+                self._catalog_cache[id(merged_items)] = entry
+        if self._route_monitor.has_changed("route_merged", key[1]):
+            self.log.info(
+                "overlapping multi-pool batch on device via merged catalog",
+                pools=[p.name for p in pools], columns=len(merged_items),
+            )
+        virtual = _MergedVirtualPool("__merged__")
+        virtual.template.taints = list(pools[0].template.taints)
+        res_solve = self.solve(
+            virtual, merged_items, list(pods),
+            existing_nodes=scheduler.existing,
+            zones=sorted(scheduler.zones),
+            classes=classes,
+            daemon_overhead=overheads[0],
+        )
+        result.new_groups.extend(res_solve.new_groups)
+        result.existing_assignments.update(res_solve.existing_assignments)
+        result.unschedulable.update(res_solve.unschedulable)
         return result
 
     # -- the batch solve ----------------------------------------------------
@@ -566,6 +725,41 @@ class TPUSolver:
             c_pad=_bucket(len(classes), self.c_pad_min),
             node_overhead=overhead_vec,
         )
+        if entry.col_pools is not None:
+            # merged multi-pool dispatch: opening is restricted to each
+            # class's first feasible pool in weight order (the oracle's
+            # _open_group pool iteration); joins stay free across all
+            # admitted columns (solver/multipool.py)
+            from karpenter_tpu.solver import multipool
+
+            compat_h = encode.compat_matrix(catalog, class_set)[: len(classes)]
+            cap_h = catalog.cap
+            if overhead_vec is not None:
+                cap_h = np.maximum(cap_h - overhead_vec[None, :], np.float32(0.0))
+            fits_one_h = np.all(
+                cap_h[None, :, :] >= class_set.req[: len(classes), None, :], axis=-1
+            )
+            admitted_all = [
+                multipool.admitted_pools(pc, entry.pools) for pc in classes
+            ]
+            class_set.open_allowed, open_pool_idx = multipool.open_allowed_mask(
+                classes, admitted_all, entry.col_pools, compat_h, fits_one_h,
+                class_set.c_pad, catalog.k_pad,
+            )
+            if self.objective == "price":
+                # envelope unification under each class's OPENING pool --
+                # the SAME choice the open mask encodes
+                self._unify_envelopes(
+                    classes, class_set,
+                    lambda c: None if open_pool_idx[c] < 0 else (
+                        entry.pools[open_pool_idx[c]].name,
+                        entry.pools[open_pool_idx[c]].requirements(),
+                    ),
+                )
+        elif self.objective == "price":
+            # single-pool: class requirements already carry the pool's
+            # extras, so the envelope key needs no further merge
+            self._unify_envelopes(classes, class_set, lambda c: (pool.name, None))
         counts = class_set.count.copy()
         counts[: len(classes)] -= placed_existing.astype(counts.dtype)
         class_set.count = counts
@@ -728,8 +922,16 @@ class TPUSolver:
             group_req_vecs = take_t.astype(np.float64) @ class_base
         else:
             group_req_vecs = np.zeros((0, encode.R))
-        # the pool's base requirement set builds once; groups copy it
+        # the pool's base requirement set builds once; groups copy it.
+        # Merged multi-pool entries attribute each group to the pool of its
+        # surviving columns (single-pool by construction: the open mask
+        # seeds gmask inside one pool and joins only narrow), with that
+        # pool's base requirements and taints.
+        merged = entry.col_pools is not None
         pool_base_reqs = pool.requirements()
+        pool_base_memo: Dict[int, Requirements] = {}
+        if merged:
+            types_by_price = entry.decode_types
 
         # FFD opens groups in runs -- consecutive groups hosting the same
         # class mix carry IDENTICAL surviving-type masks, zone/captype sets,
@@ -788,10 +990,22 @@ class TPUSolver:
                     for p in group_pods:
                         result.unschedulable[p.metadata.name] = "no surviving instance type"
                     continue
+                g_pool = pool
+                if merged:
+                    cols = np.nonzero(gmask_real[g])[0]
+                    pi = int(entry.col_pools[cols[0]])
+                    g_pool = entry.pools[pi]
+                    base = pool_base_memo.get(pi)
+                    if base is None:
+                        base = pool_base_memo[pi] = g_pool.requirements()
+                else:
+                    base = pool_base_reqs
                 req_key = (classes_on_g.tobytes(), gzone[g].tobytes(), gcap[g].tobytes())
+                if merged:
+                    req_key = req_key + (id(g_pool),)
                 reqs = reqs_memo.get(req_key)
                 if reqs is None:
-                    reqs = pool_base_reqs.copy()
+                    reqs = base.copy()
                     for c in classes_on_g:
                         reqs.add(*class_set.classes[c].requirements)
                     zones = [zone_names[z] for z in np.nonzero(gzone[g][:n_zones])[0]]
@@ -813,10 +1027,10 @@ class TPUSolver:
                     usage = usage + smallest.capacity
                 result.new_groups.append(
                     NewNodeGroup(
-                        nodepool=pool,
+                        nodepool=g_pool,
                         requirements=reqs,
                         instance_types=group_types,
-                        taints=taints,
+                        taints=list(g_pool.template.taints) if merged else taints,
                         pods=group_pods,
                         requested=requested,
                     )
