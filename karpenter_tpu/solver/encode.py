@@ -232,7 +232,11 @@ class PodClassSet:
     c_pad: int
     req: np.ndarray                  # [C, R] float32
     count: np.ndarray                # [C] int32
-    env_count: np.ndarray            # [C] int32 (-1 = in-scan leftover)
+    env_count: np.ndarray            # [C] i32 price-envelope pod count:
+                                     # >0 pinned; <0 in-scan leftover plus
+                                     # (-env-1) shared-envelope tail pods
+                                     # (-1 = plain leftover; see
+                                     # service._unify_envelopes / ffd.py)
     allowed: List[np.ndarray]        # per dim: [C, W_d] uint32 bitmasks
     num_lo: np.ndarray               # [C, ND] float32 exclusive lower bounds (-inf none)
     num_hi: np.ndarray               # [C, ND] float32 exclusive upper bounds (+inf none)

@@ -66,7 +66,9 @@ class SolveInputs(NamedTuple):
     # classes
     req: jax.Array          # [C, R] f32
     count: jax.Array        # [C] i32
-    env_count: jax.Array    # [C] i32 price-envelope pod count; -1 = in-scan leftover
+    env_count: jax.Array    # [C] i32 price-envelope pod count; <0: in-scan
+                            # leftover plus (-env-1) tail pods of classes
+                            # sharing the envelope (-1 = plain leftover)
     allowed: jax.Array      # [C, TW] u32 (all dims concatenated)
     num_lo: jax.Array       # [C, ND] f32
     num_hi: jax.Array       # [C, ND] f32
@@ -292,14 +294,19 @@ def _ffd_body(
 
         # -- fresh-group envelope: the price objective sizes groups by the
         #    class's remaining pod count, so it lives inside the step.
-        #    env_c semantics: -1 = price envelope over the in-scan leftover;
+        #    env_c semantics: <0 = price envelope over the in-scan leftover
+        #    PLUS (-env_c - 1) pods of LATER classes sharing this class's
+        #    envelope under its opening pool (service._unify_envelopes --
+        #    the oracle sizes one envelope across coinciding classes);
         #    0 = max-fit for this class (spread sub-classes: availability
         #    beats cost and the remaining count is not statically knowable);
         #    >0 = price envelope over a pinned count --------------------------
         max_fit_f = jnp.max(jnp.where(fresh_row, n_fresh_row, 0.0))
         per_new_fit = max_fit_f.astype(jnp.int32)
         if objective == "price":
-            env = jnp.where(env_c > 0, env_c, jnp.maximum(leftover, 1))
+            env = jnp.where(
+                env_c > 0, env_c, jnp.maximum(leftover + (-env_c - 1), 1)
+            )
             ngroups = jnp.ceil(
                 env.astype(jnp.float32) / jnp.maximum(n_fresh_row, 1.0)
             )                                                     # [K]

@@ -492,39 +492,68 @@ class TPUSolver:
     @staticmethod
     def _unify_envelopes(classes, class_set, pool_of) -> None:
         """The oracle's price envelope is keyed per (pool, merged
-        requirement class) (_env_key/_remaining): two classes whose
-        requirements COINCIDE once the opening pool's requirements merge
-        (e.g. a pod selecting the very label the pool pins) share ONE
-        remaining-count envelope, so a node opened for the first class is
-        sized for BOTH. Mirror it by pinning each such row's env_count to
-        the TAIL total of its coinciding rows in scan order -- the
-        oracle's remaining at that row's first open."""
+        requirement class) (_env_key/_remaining): classes whose
+        requirements COINCIDE once a pool's requirements merge (e.g. a
+        pod selecting the very label the pool pins) share ONE
+        remaining-count envelope, decremented by EVERY placement of a
+        coinciding pod. Mirror it per row r (opening pool p): the
+        oracle's remaining at r's open = r's own in-scan leftover (its
+        joins already placed) + the counts of LATER rows coinciding
+        under p (earlier coinciding rows are fully placed by then).
+        Encoded as env_count = -(1 + tail_after) (kernel semantics:
+        leftover + (-env - 1)); unique rows keep -1.
+
+        Coincidence for row r is judged under r's OWN opening pool for
+        ALL rows -- a row that opens elsewhere still shares r's envelope
+        if p's merge unifies them (the oracle's totals are per (pool,
+        key) over every scheduled pod)."""
         from karpenter_tpu.solver.encode import _class_key
 
-        keys = []
-        for c, pc in enumerate(classes):
-            info = pool_of(c)
-            if info is None:
-                keys.append(None)
-                continue
-            pool_name, extra = info
-            reqs = pc.requirements
-            if extra is not None:
-                reqs = reqs.copy().add(*extra)
-            keys.append((pool_name, _class_key(pc.pods[0], reqs)))
-        from collections import Counter
+        n = len(classes)
+        infos = [pool_of(c) for c in range(n)]
+        # class keys under each distinct opening pool, computed lazily
+        keys_under: Dict[str, list] = {}
 
-        dup = {k for k, n in Counter(k for k in keys if k is not None).items() if n > 1}
-        if not dup:
-            return
-        tail: dict = {}
-        for c in range(len(classes) - 1, -1, -1):
-            k = keys[c]
-            if k not in dup:
+        def keys_for(pool_name: str, extra) -> list:
+            out = keys_under.get(pool_name)
+            if out is None:
+                out = []
+                for pc in classes:
+                    reqs = pc.requirements
+                    if extra is not None:
+                        reqs = reqs.copy().add(*extra)
+                    out.append(_class_key(pc.pods[0], reqs))
+                keys_under[pool_name] = out
+            return out
+
+        # the oracle CACHES the envelope per (pool, key): the FIRST member
+        # to open computes it (join-aware remaining) and every later
+        # coinciding member REUSES it (oracle.py _env_cache). Mirror: the
+        # first member gets the leftover-aware encoding; later members of
+        # the same (open pool, key) get a STATIC pin equal to the first
+        # member's first-open envelope (its tail total -- join-blind, the
+        # one approximation left: the oracle's cached value saw the first
+        # member's in-scan joins).
+        first_member: Dict[tuple, int] = {}
+        for c in range(n):
+            if class_set.env_count[c] != -1 or infos[c] is None:
                 continue
-            tail[k] = tail.get(k, 0) + len(classes[c].pods)
-            if class_set.env_count[c] == -1:
-                class_set.env_count[c] = tail[k]
+            pool_name, extra = infos[c]
+            keys = keys_for(pool_name, extra)
+            group_key = (pool_name, keys[c])
+            first = first_member.get(group_key)
+            if first is None:
+                first_member[group_key] = c
+                tail_after = sum(
+                    len(classes[j].pods) for j in range(c + 1, n) if keys[j] == keys[c]
+                )
+                if tail_after:
+                    class_set.env_count[c] = -(1 + tail_after)
+            else:
+                fkeys = keys_for(*infos[first])
+                class_set.env_count[c] = sum(
+                    len(classes[j].pods) for j in range(first, n) if fkeys[j] == fkeys[first]
+                )
 
     # -- merged multi-pool solve (solver/multipool.py) -----------------------
     def _try_solve_merged(self, scheduler, pods, base_classes):

@@ -264,3 +264,105 @@ class TestMergedMultiPool:
         oracle, device = run_both(catalog_items, pods, pools)
         assert set(oracle.unschedulable) == set(device.unschedulable), f"seed {seed}"
         assert by_pool_signature(oracle) == by_pool_signature(device), f"seed {seed}"
+
+
+class TestSharedEnvelopes:
+    """The oracle's price envelope is cached per (pool, merged class) and
+    decremented by every coinciding placement; this shape (fuzz seed
+    7706's minimal core) exercises the whole machinery: a plain class and
+    a pool-pinned class coincide under the pinned pool, the first opener
+    sizes for BOTH, a cross-pool join consumes shared headroom, and the
+    leftovers open elsewhere."""
+
+    def test_coinciding_classes_share_the_opening_envelope(self, catalog_items):
+        p0 = NodePool("pool0", weight=3,
+                      requirements=[Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])])
+        p2 = NodePool("pool2", weight=9,
+                      requirements=[Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"])])
+        pods = [
+            Pod(f"t0-{i}", requests=Resources({"cpu": "250m", "memory": "8Gi"}))
+            for i in range(3)
+        ] + [
+            Pod(f"t1-{i}", requests=Resources({"cpu": "250m", "memory": "8Gi"}),
+                node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(2)
+        ]
+        oracle, device = run_both(catalog_items, pods, [p0, p2])
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+        assert by_pool_signature(oracle) == by_pool_signature(device)
+        # the signature includes the cross-pool join: one pool0 group must
+        # host a t0 pod alongside the t1 pods (shared-envelope headroom)
+        mixed = [
+            g for g in device.new_groups
+            if g.nodepool.name == "pool0"
+            and {p.metadata.name[:2] for p in g.pods} == {"t0", "t1"}
+        ]
+        assert mixed, "the shared envelope must admit the coinciding class's join"
+
+
+import os
+
+
+@pytest.mark.skipif(
+    not os.environ.get("KARPENTER_TPU_FUZZ_EXTENDED"),
+    reason="extended multipool sweep: set KARPENTER_TPU_FUZZ_EXTENDED=1",
+)
+class TestMergedMultiPoolFuzzExtended:
+    """Wide randomized sweep over overlapping multi-pool shapes: 2-3 pools
+    with random weights, zone pins, captype pins, and occasional taints
+    (taints exercise the carve-out fallback -- equality must hold either
+    way). No spread/affinity here (separately routed), so equality is
+    EXACT per (pool, group, pod-name-set)."""
+
+    @pytest.mark.parametrize("seed", range(40))
+    def test_sweep(self, catalog_items, seed):
+        from karpenter_tpu.scheduling import Taint, Toleration
+
+        rng = np.random.default_rng(7700 + seed)
+        n_pools = int(rng.integers(2, 4))
+        pools = []
+        for i in range(n_pools):
+            reqs = []
+            u = rng.random()
+            if u < 0.4:
+                reqs.append(Requirement(wk.ARCH_LABEL, Op.IN,
+                                        [str(rng.choice(["arm64", "amd64"]))]))
+            elif u < 0.55:
+                reqs.append(Requirement(wk.ZONE_LABEL, Op.IN,
+                                        [str(rng.choice(["us-central-1a", "us-central-1b"]))]))
+            elif u < 0.65:
+                reqs.append(Requirement(wk.CAPACITY_TYPE_LABEL, Op.IN, ["on-demand"]))
+            pool = NodePool(f"pool{i}", weight=int(rng.integers(0, 30)), requirements=reqs)
+            if rng.random() < 0.15:
+                # per-pool taints hit the oracle carve-out; equality holds
+                pool.template.taints = [Taint(key=f"dedicated{i}", effect="NoSchedule")]
+            pools.append(pool)
+        pods = []
+        for t in range(int(rng.integers(2, 8))):
+            cpu_m = int(rng.choice([250, 500, 1000, 2000, 4000]))
+            mem_mi = int(rng.choice([512, 1024, 2048, 8192]))
+            selector = {}
+            tolerations = []
+            u = rng.random()
+            if u < 0.25:
+                selector[wk.ARCH_LABEL] = str(rng.choice(["arm64", "amd64"]))
+            elif u < 0.4:
+                selector[wk.ZONE_LABEL] = str(
+                    rng.choice(["us-central-1a", "us-central-1b", "us-central-1c"])
+                )
+            if rng.random() < 0.2:
+                tolerations = [Toleration(operator="Exists")]
+            for i in range(int(rng.integers(1, 6))):
+                pods.append(
+                    Pod(
+                        f"x{seed}-{t}-{i}",
+                        requests=Resources.from_base_units(
+                            {"cpu": float(cpu_m), "memory": float(mem_mi) * 2**20}
+                        ),
+                        node_selector=selector,
+                        tolerations=tolerations,
+                    )
+                )
+        oracle, device = run_both(catalog_items, pods, pools)
+        assert set(oracle.unschedulable) == set(device.unschedulable), f"seed {seed}"
+        assert by_pool_signature(oracle) == by_pool_signature(device), f"seed {seed}"
