@@ -274,6 +274,15 @@ class PodBinder:
                 self._counts_for(soft, nodes, node_by_name, counts_cache)
                 if soft is not None else None
             )
+            # soft HOSTNAME spread is also scored here (kube-scheduler
+            # does); the decision plane cannot express a per-node
+            # preference for NEW nodes, so bind time is where it lives
+            soft_host = [
+                (t, self._counts_for(t, nodes, node_by_name, counts_cache))
+                for t in pod.topology_spread
+                if not t.hard() and t.topology_key == wk.HOSTNAME_LABEL
+                and all(pod.metadata.labels.get(k) == v for k, v in t.label_selector.items())
+            ]
             prefs = pod.preferred_affinity_terms
             pref_zone_counts = {
                 id(term): self._pref_zone_counts(term, node_by_name, counts_cache)
@@ -294,7 +303,7 @@ class PodBinder:
                     continue
                 if not self._spread_ok(node, spread_counts):
                     continue
-                if soft is None and not prefs:
+                if soft is None and not prefs and not soft_host:
                     chosen = node
                     break
                 if soft is not None:
@@ -305,31 +314,38 @@ class PodBinder:
                     c = soft_counts.get(z, 0) if z is not None else float("inf")
                 else:
                     c = 0
+                h = sum(
+                    counts.get(node.metadata.name, 0) for _, counts in soft_host
+                )
                 # higher satisfied preference weight wins; fewer same-
-                # selector pods in the zone breaks ties; then first-fit
-                key = (-self._preference_score(pod, node, prefs, pref_zone_counts), c)
+                # selector pods in the zone, then on the node, break ties;
+                # then first-fit
+                key = (-self._preference_score(pod, node, prefs, pref_zone_counts), c, h)
                 if chosen is None or key < chosen_key:
                     chosen, chosen_key = node, key
             if chosen is None:
                 continue
             self.cluster.bind_pod(pod, chosen)
-            for tsc, counts in spread_counts:
-                d = chosen.metadata.labels.get(tsc.topology_key)
+            # ONE cache update covers every consumer: a bound pod counts
+            # toward EVERY cached (topology key / preferred-affinity)
+            # selector it matches -- kube-scheduler's bookkeeping counts
+            # pods by selector regardless of the bound pod's own
+            # constraints, and the per-list updates this replaces went
+            # stale exactly when a matching pod WITHOUT the constraint
+            # bound mid-reconcile (round-4 review). The spread/soft/pref
+            # lists above alias these same cached dicts.
+            node_labels = chosen.metadata.labels
+            for (kind, sel), counts in counts_cache.items():
+                if not all(pod.metadata.labels.get(k) == v for k, v in sel):
+                    continue
+                dkey = wk.ZONE_LABEL if kind == "prefzone" else kind
+                d = (
+                    chosen.metadata.name
+                    if dkey == wk.HOSTNAME_LABEL and dkey not in node_labels
+                    else node_labels.get(dkey)
+                )
                 if d is not None:
                     counts[d] = counts.get(d, 0) + 1
-            if soft_counts is not None:
-                d = chosen.metadata.labels.get(soft.topology_key)
-                if d is not None:
-                    soft_counts[d] = soft_counts.get(d, 0) + 1
-            # the bound pod may match other pods' preferred-affinity
-            # selectors cached this reconcile: keep those domains current
-            zb = chosen.metadata.labels.get(wk.ZONE_LABEL)
-            if zb is not None:
-                for (kind, sel), counts in counts_cache.items():
-                    if kind == "prefzone" and all(
-                        pod.metadata.labels.get(k) == v for k, v in sel
-                    ):
-                        counts[zb] = counts.get(zb, 0) + 1
             bound += 1
         if bound:
             metrics.PODS_BOUND.inc(bound)

@@ -550,9 +550,11 @@ class TPUSolver:
                 if tail_after:
                     class_set.env_count[c] = -(1 + tail_after)
             else:
-                fkeys = keys_for(*infos[first])
+                # group_key equality guarantees the same pool (and so the
+                # same cached key list): the first member's envelope total
+                # is computable directly from `keys`
                 class_set.env_count[c] = sum(
-                    len(classes[j].pods) for j in range(first, n) if fkeys[j] == fkeys[first]
+                    len(classes[j].pods) for j in range(first, n) if keys[j] == keys[c]
                 )
 
     # -- merged multi-pool solve (solver/multipool.py) -----------------------

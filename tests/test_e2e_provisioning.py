@@ -205,6 +205,32 @@ class TestTopologyAndAffinity:
         assert za == "us-central-1a"
         assert zr != za, "bind-time scoring must honor the anti preference"
 
+    def test_soft_hostname_spread_scored_at_bind(self, env):
+        """ScheduleAnyway hostname spread: the binder spreads replicas
+        across nodes with headroom instead of first-fit stacking (the
+        kube-scheduler scoring the stand-in must mirror)."""
+        # two one-pod anchors force two nodes up front
+        anchors = [
+            Pod(f"anchor-{i}", requests=Resources({"cpu": "3"}), labels={"a": "x"})
+            for i in range(2)
+        ]
+        for p in anchors:
+            env.cluster.create(p)
+        env.settle()
+        assert len({env.cluster.get(Pod, p.metadata.name).node_name for p in anchors}) == 2
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.HOSTNAME_LABEL,
+            label_selector={"app": "web"}, when_unsatisfiable="ScheduleAnyway",
+        )
+        for i in range(2):
+            env.cluster.create(
+                Pod(f"web-{i}", requests=Resources({"cpu": "100m"}),
+                    labels={"app": "web"}, topology_spread=[tsc])
+            )
+        env.settle()
+        nodes = {env.cluster.get(Pod, f"web-{i}").node_name for i in range(2)}
+        assert len(nodes) == 2, "soft hostname spread must bias across nodes"
+
     def test_hostname_anti_affinity(self, env):
         term = PodAffinityTerm(label_selector={"app": "solo"}, topology_key=wk.HOSTNAME_LABEL, anti=True)
         for i in range(3):
