@@ -1,0 +1,29 @@
+# The image deploy/controller.yaml runs for BOTH containers (controller +
+# solver sidecar). Build with the TPU-enabled jax wheel for TPU-VM node
+# pools; swap the extra for `jax` (CPU) to run the control plane alone.
+#
+#   docker build -t karpenter-tpu:latest .
+#
+# (No container runtime ships in the dev image, so this Dockerfile is the
+# recipe, validated by tests/test_deploy.py for structure only.)
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends gcc \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+# jax[tpu] pulls libtpu from the Google releases index on TPU VMs
+RUN pip install --no-cache-dir "jax[tpu]" \
+      -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    && pip install --no-cache-dir numpy pyyaml
+
+COPY karpenter_tpu/ karpenter_tpu/
+COPY hack/ hack/
+
+# the native grouping hot loop compiles at first import when gcc is
+# present; build it now so runtime containers start warm
+RUN python -c "from karpenter_tpu import native; assert native.grouping" || true
+
+ENV PYTHONUNBUFFERED=1
+ENTRYPOINT ["python", "-m", "karpenter_tpu"]
+CMD ["--in-cluster"]
