@@ -6,11 +6,14 @@ in-process version of the reference's out-of-process seam (SURVEY.md
 section 2.4 maps the cloud-RPC boundary to a gRPC solver service; the
 request/response here is already tensor-shaped for that move).
 
-Scope routing (v1): instances with stateful-constraint features the batch
-solver does not yet vectorize -- existing-node packing, topology spread,
-pod affinity, multi-term node affinity, multiple nodepools -- fall back to
-the Python oracle, which is authoritative. Everything else (the throughput
-path: many pods x one pool x full catalog) runs on the accelerator.
+Scope routing (round 4): the batch path covers existing-node packing,
+zone topology spread (hard and soft), several nodepools (disjoint via
+pool-sequential solves, overlapping via the merged-catalog solve in
+solver/multipool.py), and class-level minValues partitioning. What still
+falls back to the authoritative Python oracle: pod (anti-)affinity and
+weighted preferences (per-pod relaxation ladders), hostname spread,
+multi-term node affinity, and the documented carve-outs
+(docs/parity.md).
 """
 from __future__ import annotations
 
