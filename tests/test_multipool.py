@@ -266,6 +266,73 @@ class TestMergedMultiPool:
         assert by_pool_signature(oracle) == by_pool_signature(device), f"seed {seed}"
 
 
+class TestSteadyStateMultiPool:
+    """The merged path with EXISTING capacity: live nodes (belonging to
+    either pool) are packed pool-agnostically before fresh groups open,
+    exactly as the oracle's _try_existing runs before _open_group."""
+
+    def _node(self, name, arch, pool_name, cpu="8", mem="16Gi"):
+        from karpenter_tpu.solver.oracle import ExistingNode
+
+        return ExistingNode(
+            name=name,
+            labels={wk.ARCH_LABEL: arch, wk.NODEPOOL_LABEL: pool_name,
+                    wk.ZONE_LABEL: "us-central-1a", "kubernetes.io/hostname": name},
+            allocatable=Resources({"cpu": cpu, "memory": mem, "pods": 30}),
+        )
+
+    def test_existing_nodes_absorb_before_fresh_groups(self, catalog_items):
+        import copy
+
+        arm, amd = mk_pools(arm_weight=10, amd_weight=1)
+        nodes = [self._node("n-arm", "arm64", "arm"), self._node("n-amd", "amd64", "amd")]
+        pods = [small(f"p{i}") for i in range(4)]
+        pods += [small("amd-only", node_selector={wk.ARCH_LABEL: "amd64"})]
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        cats = {"arm": catalog_items, "amd": catalog_items}
+
+        def mk():
+            return Scheduler(
+                nodepools=[arm, amd], instance_types=cats,
+                existing_nodes=copy.deepcopy(nodes), zones=zones,
+            )
+
+        oracle = mk().schedule(list(pods))
+        device = TPUSolver(g_max=128).schedule(mk(), list(pods))
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+        assert sorted(oracle.existing_assignments.items()) == sorted(
+            device.existing_assignments.items()
+        )
+        assert by_pool_signature(oracle) == by_pool_signature(device)
+        # everything fits on the live nodes: no fresh groups on either path
+        assert not oracle.new_groups and not device.new_groups
+
+    def test_overflow_opens_fresh_after_existing(self, catalog_items):
+        import copy
+
+        arm, amd = mk_pools(arm_weight=10, amd_weight=1)
+        nodes = [self._node("n-amd", "amd64", "amd", cpu="2", mem="4Gi")]
+        pods = [small(f"p{i}") for i in range(8)]
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        cats = {"arm": catalog_items, "amd": catalog_items}
+
+        def mk():
+            return Scheduler(
+                nodepools=[arm, amd], instance_types=cats,
+                existing_nodes=copy.deepcopy(nodes), zones=zones,
+            )
+
+        oracle = mk().schedule(list(pods))
+        device = TPUSolver(g_max=128).schedule(mk(), list(pods))
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+        assert sorted(oracle.existing_assignments.items()) == sorted(
+            device.existing_assignments.items()
+        )
+        assert by_pool_signature(oracle) == by_pool_signature(device)
+        assert oracle.existing_assignments, "the live node must absorb its fill first"
+        assert oracle.new_groups, "the overflow must open fresh groups"
+
+
 class TestSharedEnvelopes:
     """The oracle's price envelope is cached per (pool, merged class) and
     decremented by every coinciding placement; this shape (fuzz seed
