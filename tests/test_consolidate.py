@@ -194,14 +194,15 @@ class TestReplacementSearch:
         assert v.replace_od_price >= v.replace_price  # spot can only be cheaper
 
 
-def build_overprovisioned(clock_start=100_000.0, evaluator=None):
+def build_overprovisioned(clock_start=100_000.0, evaluator=None, pools=None):
     """Two nodes left holding one small pod each (the big pods that forced
     two nodes are deleted): the classic deletion-consolidation setup the
-    reference scale tests use."""
+    reference scale tests use. Pass `pools` for a multi-pool variant."""
     clock = FakeClock(clock_start)
     op = Operator(clock=clock, consolidation_evaluator=evaluator)
     op.cluster.create(TPUNodeClass("default"))
-    op.cluster.create(NodePool("default"))
+    for pool in (pools if pools is not None else [NodePool("default")]):
+        op.cluster.create(pool)
     for i in range(2):
         op.cluster.create(Pod(f"big{i}", requests=Resources({"cpu": "3", "memory": "4Gi"})))
         op.settle(max_ticks=30)
@@ -226,6 +227,47 @@ class TestControllerEquivalence:
         def logical(op, decisions):
             """(reason, sorted pod names on the disrupted node) -- claim
             names carry random suffixes and cannot compare across clusters."""
+            out = []
+            for name, reason in decisions:
+                claim = op.cluster.try_get(NodeClaim, name)
+                node = op.cluster.node_for_nodeclaim(claim) if claim else None
+                pods = (
+                    sorted(p.metadata.name for p in op.cluster.pods_on_node(node.metadata.name))
+                    if node
+                    else []
+                )
+                out.append((reason, tuple(pods)))
+            return out
+
+        d_plain = plain.disruption.reconcile(max_disruptions=5)
+        d_device = device.disruption.reconcile(max_disruptions=5)
+        assert d_plain, "scenario should produce a consolidation decision"
+        assert logical(plain, d_plain) == logical(device, d_device)
+
+    def test_same_decisions_across_overlapping_pools(self):
+        """Multi-pool parity: the device evaluator's verdicts and the
+        oracle-only controller make the same consolidation decisions when
+        two overlapping pools own the fleet (replacement simulations now
+        run through the merged-catalog solve)."""
+        from karpenter_tpu.apis import labels as _wk
+        from karpenter_tpu.scheduling import Operator as _Op, Requirement
+
+        def pools():
+            return [
+                NodePool("arm", weight=10,
+                         requirements=[Requirement(_wk.ARCH_LABEL, _Op.IN, ["arm64"])]),
+                NodePool("amd", weight=1,
+                         requirements=[Requirement(_wk.ARCH_LABEL, _Op.IN, ["amd64"])]),
+            ]
+
+        plain = build_overprovisioned(pools=pools())
+        device = build_overprovisioned(evaluator=ConsolidationEvaluator(), pools=pools())
+        if len(plain.cluster.list(NodeClaim)) < 2:
+            pytest.skip("pods packed onto one node; nothing to consolidate")
+        for op in (plain, device):
+            op.clock.step(MIN_NODE_LIFETIME + 60)
+
+        def logical(op, decisions):
             out = []
             for name, reason in decisions:
                 claim = op.cluster.try_get(NodeClaim, name)
