@@ -31,13 +31,13 @@ spread classes (already oracle-routed for multi-pool by supports()).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Sequence, Tuple
 
 import numpy as np
 
 from karpenter_tpu.apis import NodePool, labels as wk
 from karpenter_tpu.providers.instancetype.types import InstanceType
-from karpenter_tpu.scheduling import Operator, Requirement, tolerates_all
+from karpenter_tpu.scheduling import tolerates_all
 
 
 def build_merged(
