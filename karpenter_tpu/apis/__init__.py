@@ -14,6 +14,7 @@ from karpenter_tpu.apis.nodeclass import TPUNodeClass, SelectorTerm, ImageSelect
 from karpenter_tpu.apis.pod import Pod, Node, TopologySpreadConstraint, PodAffinityTerm
 from karpenter_tpu.apis.pdb import PodDisruptionBudget
 from karpenter_tpu.apis.daemonset import DaemonSet
+from karpenter_tpu.apis.storage import PersistentVolumeClaim, StorageClass
 
 __all__ = [
     "labels",

@@ -57,6 +57,7 @@ class Pod(APIObject):
         annotations: Optional[Dict[str, str]] = None,
         owner_kind: str = "ReplicaSet",
         scheduling_gates: Sequence[str] = (),
+        volume_claims: Sequence[str] = (),
     ):
         super().__init__(name=name)
         self.metadata.namespace = namespace
@@ -88,6 +89,13 @@ class Pod(APIObject):
         self.priority = priority
         self.owner_kind = owner_kind  # "" = bare pod (blocks consolidation)
         self.scheduling_gates = list(scheduling_gates)
+        # PVC references (claim names in the pod's namespace). Resolution
+        # into solver vocabulary -- attach counts + bound-zone pins -- is
+        # external (apis/storage.effective_pods) because it depends on
+        # claim state at SCHEDULE time, not construction time; the
+        # scheduler swaps in resolved copies, so claim-carrying pods
+        # must not ride the shared-spec token fast path below.
+        self.volume_claims = tuple(volume_claims)
 
         # status / spec binding
         self.node_name: str = ""
@@ -132,6 +140,7 @@ class Pod(APIObject):
         if (
             topology_spread or node_affinity_terms or affinity_terms
             or preferred_node_affinity_terms or preferred_affinity_terms
+            or volume_claims
         ):
             self._spec_refs = None
             self._spec_token = None
@@ -204,6 +213,10 @@ class Pod(APIObject):
                     (w, tuple(sorted(t.label_selector.items())), t.topology_key, t.anti)
                     for w, t in self.preferred_affinity_terms
                 ) if self.preferred_affinity_terms else (),
+                # raw (unresolved) claim identity: claim-carrying pods only
+                # reach the solver as resolved copies (apis/storage), but a
+                # direct group_pods call must still not merge across claims
+                self.volume_claims,
             )
         return sig
 

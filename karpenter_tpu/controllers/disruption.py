@@ -220,7 +220,10 @@ class DisruptionController:
 
     # -- simulation ---------------------------------------------------------
     def _other_nodes(self, excluded: Sequence[str]) -> List[ExistingNode]:
+        from karpenter_tpu.apis.storage import VolumeIndex
+
         out = []
+        vol_index = VolumeIndex.from_cluster(self.cluster)
         for node in self.cluster.list(Node):
             if node.metadata.name in excluded or node.deleting or node.unschedulable or not node.ready:
                 continue
@@ -230,7 +233,7 @@ class DisruptionController:
                     labels=dict(node.metadata.labels),
                     allocatable=node.allocatable,
                     taints=list(node.taints),
-                    used=self.cluster.node_usage(node.metadata.name),
+                    used=self.cluster.node_usage(node.metadata.name, vol_index),
                 )
             )
         return out
@@ -258,10 +261,18 @@ class DisruptionController:
     def _simulate(self, candidates: Sequence[Candidate], allow_new_node: bool):
         """Can every pod on the candidate set reschedule elsewhere (plus at
         most one new node when allow_new_node)? Returns (ok, new_groups)."""
+        from karpenter_tpu.apis.storage import VolumeIndex, effective_pods
+
         excluded = [c.node.metadata.name for c in candidates] + list(self._pass_disrupted)
         pods = self._in_flight_pods() + [
             p for c in candidates for p in c.pods if p.reschedulable()
         ]
+        # volume-backed pods re-simulate with their attach counts and
+        # bound-zone pins (claims are bound by now: the pod ran), so
+        # consolidation never plans a move a zonal volume forbids
+        pods, vol_blocked = effective_pods(pods, VolumeIndex.from_cluster(self.cluster))
+        if vol_blocked:
+            return False, []
         nodepools, pass_catalogs = self._pool_context()
         catalogs: Dict[str, list] = {}
         zones: set = set()

@@ -41,7 +41,10 @@ class Provisioner:
 
     # -- snapshot -----------------------------------------------------------
     def _existing_nodes(self) -> List[ExistingNode]:
+        from karpenter_tpu.apis.storage import VolumeIndex
+
         out = []
+        vol_index = VolumeIndex.from_cluster(self.cluster)
         for node in self.cluster.list(Node):
             if node.deleting or node.unschedulable or not node.ready:
                 continue
@@ -51,7 +54,7 @@ class Provisioner:
                     labels=dict(node.metadata.labels),
                     allocatable=node.allocatable,
                     taints=list(node.taints),
-                    used=self.cluster.node_usage(node.metadata.name),
+                    used=self.cluster.node_usage(node.metadata.name, vol_index),
                 )
             )
         # launched-but-not-YET-ready claims are virtual capacity
@@ -92,9 +95,22 @@ class Provisioner:
 
     # -- reconcile ----------------------------------------------------------
     def reconcile(self) -> SchedulingResult:
+        from karpenter_tpu.apis.storage import VolumeIndex, effective_pods
+
         pods = self.cluster.pending_pods()
         result = SchedulingResult()
         if not pods:
+            self.last_result = result
+            return result
+        # lower volume claims into solver vocabulary (attach counts on the
+        # attachable-volumes axis, bound zones as selector pins); pods
+        # whose claims cannot resolve are unschedulable this tick
+        # (apis/storage module docstring; the reference core's volume
+        # topology translation does the same lowering)
+        pods, vol_blocked = effective_pods(pods, VolumeIndex.from_cluster(self.cluster))
+        result.unschedulable.update(vol_blocked)
+        if not pods:
+            metrics.IGNORED_PODS.set(len(result.unschedulable))
             self.last_result = result
             return result
         nodepools = [p for p in self.cluster.list(NodePool) if not p.deleting]
@@ -129,6 +145,7 @@ class Provisioner:
             result = self.solver.schedule(scheduler, pods)
         else:
             result = scheduler.schedule(pods)
+        result.unschedulable.update(vol_blocked)
         metrics.SCHEDULING_DURATION.observe(time.perf_counter() - t0)
         metrics.IGNORED_PODS.set(len(result.unschedulable))
         if result.new_groups or result.unschedulable:
@@ -244,6 +261,7 @@ class PodBinder:
         self.cluster = cluster
 
     def reconcile(self) -> int:
+        from karpenter_tpu.apis.storage import VolumeIndex
         from karpenter_tpu.scheduling import tolerates_all
 
         bound = 0
@@ -255,8 +273,22 @@ class PodBinder:
         node_by_name = {n.metadata.name: n for n in nodes}
         from karpenter_tpu.solver.spread import soft_zone_tsc
 
+        # built once per reconcile: node_usage consults it for bound pods'
+        # attachments in the per-(pod, node) loop below
+        vol_index = VolumeIndex.from_cluster(self.cluster)
         for pod in self.cluster.pending_pods():
             needed = pod.requests + Resources.from_base_units({res.PODS: 1})
+            vol_zone = None
+            if pod.volume_claims:
+                # claims charge the node's attach budget and, once bound,
+                # pin the zone (apis/storage); unresolvable claims leave
+                # the pod pending for a later tick
+                n_vols, vol_zone, blocked = vol_index.lookup(pod)
+                if blocked is not None:
+                    continue
+                needed = needed + Resources.from_base_units(
+                    {res.ATTACHABLE_VOLUMES: float(n_vols)}
+                )
             tscs = self._matching_spread(pod)
             spread_counts = [
                 (tsc, self._counts_for(tsc, nodes, node_by_name, counts_cache))
@@ -296,7 +328,9 @@ class PodBinder:
                     continue
                 if not any(alt.matches_labels(node.metadata.labels) for alt in pod.scheduling_requirements()):
                     continue
-                used = self.cluster.node_usage(node.metadata.name)
+                if vol_zone is not None and node.metadata.labels.get(wk.ZONE_LABEL) != vol_zone:
+                    continue
+                used = self.cluster.node_usage(node.metadata.name, vol_index)
                 if not (used + needed).fits(node.allocatable):
                     continue
                 if not self._anti_affinity_ok(pod, node):
@@ -326,6 +360,13 @@ class PodBinder:
             if chosen is None:
                 continue
             self.cluster.bind_pod(pod, chosen)
+            if pod.volume_claims:
+                # first-consumer binding: the landing zone binds the pod's
+                # still-unbound WaitForFirstConsumer claims (the PV
+                # controller's job upstream)
+                vol_index.bind_on_schedule(
+                    pod, chosen.metadata.labels.get(wk.ZONE_LABEL), self.cluster
+                )
             # ONE cache update covers every consumer: a bound pod counts
             # toward EVERY cached (topology key / preferred-affinity)
             # selector it matches -- kube-scheduler's bookkeeping counts

@@ -178,6 +178,10 @@ class KubeCluster(RelationalQueries):
             return self._update_pod(obj)
         if isinstance(obj, Node):
             return self._update_node(obj)
+        from karpenter_tpu.apis.storage import PersistentVolumeClaim as _PVC
+
+        if isinstance(obj, _PVC):
+            return self._update_pvc(obj)
         info = self._info(type(obj))
         manifest = info.to_manifest(obj)
         raw_rv = getattr(obj, "_raw_resource_version", None)
@@ -198,6 +202,25 @@ class KubeCluster(RelationalQueries):
             except HttpNotFound:
                 pass  # the update cleared the last finalizer: object is gone
         return obj
+
+    def _update_pvc(self, claim) -> APIObject:
+        """PVC spec is immutable server-side (and accessModes/storage are
+        PV-controller territory this framework never changes): the only
+        field the scheduler owns is the bound-zone annotation, so the
+        write is an annotation merge-patch, never a whole-object PUT."""
+        from karpenter_tpu.kube.convert import BOUND_ZONE_ANNOTATION
+
+        patch = {
+            "metadata": {
+                "annotations": {BOUND_ZONE_ANNOTATION: claim.bound_zone}
+            }
+        }
+        try:
+            self.client.patch(self._obj_path(claim), patch)
+        except HttpConflict as e:
+            raise Conflict(f"PersistentVolumeClaim/{claim.metadata.name}") from e
+        self._invalidate(type(claim))
+        return claim
 
     def _meta_patch(self, obj: APIObject, server: Optional[APIObject]) -> dict:
         """RFC 7386 merge-patch deletes only keys explicitly set to null:

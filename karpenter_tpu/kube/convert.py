@@ -652,6 +652,11 @@ def pod_to_manifest(p: Pod) -> dict:
         spec["priority"] = p.priority
     if p.scheduling_gates:
         spec["schedulingGates"] = [{"name": g} for g in p.scheduling_gates]
+    if p.volume_claims:
+        spec["volumes"] = [
+            {"name": f"vol-{i}", "persistentVolumeClaim": {"claimName": ref}}
+            for i, ref in enumerate(p.volume_claims)
+        ]
     if p.node_name:
         spec["nodeName"] = p.node_name
     meta = meta_to_manifest(p.metadata)
@@ -740,6 +745,11 @@ def pod_from_manifest(m: dict) -> Pod:
         annotations=m.get("metadata", {}).get("annotations"),
         owner_kind=owner_kind,
         scheduling_gates=[g.get("name", "") for g in spec.get("schedulingGates", ())],
+        volume_claims=[
+            v["persistentVolumeClaim"]["claimName"]
+            for v in spec.get("volumes", ())
+            if v.get("persistentVolumeClaim", {}).get("claimName")
+        ],
     )
     meta_from_manifest(pod, m)
     pod.node_name = spec.get("nodeName", "")
@@ -748,6 +758,18 @@ def pod_from_manifest(m: dict) -> Pod:
 
 
 # -- Node --------------------------------------------------------------------
+
+def _node_status_map(r: Resources) -> Dict[str, str]:
+    """resources_to_map + the attach-budget default: emitting the axis on
+    the WRITE side keeps to->from->to round-trips idempotent with the
+    read-side defaulting in node_resources_from_map."""
+    out = resources_to_map(r)
+    if res.ATTACHABLE_VOLUMES not in out and out:
+        out[res.ATTACHABLE_VOLUMES] = quantity_str(
+            res.ATTACHABLE_VOLUMES, DEFAULT_NODE_ATTACH_LIMIT
+        )
+    return out
+
 
 def node_to_manifest(n: Node) -> dict:
     spec: dict = {}
@@ -762,13 +784,46 @@ def node_to_manifest(n: Node) -> dict:
         "metadata": meta_to_manifest(n.metadata),
         "spec": spec,
         "status": {
-            "capacity": resources_to_map(n.capacity),
-            "allocatable": resources_to_map(n.allocatable),
+            "capacity": _node_status_map(n.capacity),
+            "allocatable": _node_status_map(n.allocatable),
             "conditions": [
                 {"type": "Ready", "status": "True" if n.ready else "False"}
             ],
         },
     }
+
+
+# attach budget assumed for nodes that report NO attachable-volumes-*
+# key: modern CSI drivers publish limits on CSINode objects (which this
+# adapter does not watch), not in node status -- leaving the axis at 0
+# would make every claim-carrying pod unfittable on every real node.
+# 24 is at/below every curve value providers/instancetype/types.
+# volume_attach_limit produces, so the assumption only ever under-packs.
+DEFAULT_NODE_ATTACH_LIMIT = 24.0
+
+
+def node_resources_from_map(m: Optional[Dict[str, str]]) -> Resources:
+    """Node capacity/allocatable maps come from kubelets, whose vocabulary
+    is wider than the solver's dense axes: `attachable-volumes-<driver>`
+    keys fold onto the attachable-volumes axis (smallest driver limit
+    wins, matching how the core takes the binding driver's CSINode
+    limit; absent entirely -> DEFAULT_NODE_ATTACH_LIMIT, see above), and
+    keys with no axis (hugepages-*, vendor extended resources) are
+    dropped rather than poisoning to_vector."""
+    out: Dict[str, str] = {}
+    attach: Optional[float] = None
+    for k, v in (m or {}).items():
+        if k.startswith("attachable-volumes-"):
+            n = float(res.parse_quantity(v))
+            attach = n if attach is None else min(attach, n)
+        elif k in res.AXIS_INDEX:
+            out[k] = v
+    r = Resources(out)
+    if attach is None and out and res.ATTACHABLE_VOLUMES not in r.keys():
+        attach = DEFAULT_NODE_ATTACH_LIMIT
+    if attach is not None and res.ATTACHABLE_VOLUMES not in r.keys():
+        r = r + Resources.from_base_units({res.ATTACHABLE_VOLUMES: attach})
+    return r
 
 
 def node_from_manifest(m: dict) -> Node:
@@ -777,8 +832,8 @@ def node_from_manifest(m: dict) -> Node:
     n = Node(
         m["metadata"]["name"],
         labels=m.get("metadata", {}).get("labels"),
-        capacity=resources_from_map(status.get("capacity")),
-        allocatable=resources_from_map(status.get("allocatable")),
+        capacity=node_resources_from_map(status.get("capacity")),
+        allocatable=node_resources_from_map(status.get("allocatable")),
         taints=[taint_from_manifest(t) for t in spec.get("taints", ())],
         provider_id=spec.get("providerID", ""),
     )
@@ -852,6 +907,75 @@ def daemonset_from_manifest(m: dict) -> DaemonSet:
     )
     meta_from_manifest(d, m)
     return d
+
+
+# -- PersistentVolumeClaim / StorageClass ------------------------------------
+# The model carries the PV's zone on the claim (apis/storage: bound_zone);
+# on the wire -- where topology lives on the PV object this framework does
+# not model -- it rides a claim annotation, so round-trips are lossless.
+
+BOUND_ZONE_ANNOTATION = "storage.karpenter.tpu/bound-zone"
+
+
+def pvc_to_manifest(c) -> dict:
+    meta = meta_to_manifest(c.metadata)
+    if c.bound_zone is not None:
+        meta.setdefault("annotations", {})[BOUND_ZONE_ANNOTATION] = c.bound_zone
+    spec: dict = {
+        "accessModes": list(c.access_modes),
+        "resources": {"requests": {"storage": c.storage_request}},
+    }
+    if c.storage_class_name:
+        spec["storageClassName"] = c.storage_class_name
+    if c.volume_name:
+        spec["volumeName"] = c.volume_name
+    return {
+        "apiVersion": "v1", "kind": "PersistentVolumeClaim",
+        "metadata": meta, "spec": spec,
+        "status": {"phase": "Bound" if c.bound else "Pending"},
+    }
+
+
+def pvc_from_manifest(m: dict):
+    from karpenter_tpu.apis.storage import PersistentVolumeClaim
+
+    spec = m.get("spec", {})
+    ann = m.get("metadata", {}).get("annotations", {}) or {}
+    c = PersistentVolumeClaim(
+        m["metadata"]["name"],
+        namespace=m.get("metadata", {}).get("namespace", "default"),
+        storage_class_name=spec.get("storageClassName", "") or "",
+        bound_zone=ann.get(BOUND_ZONE_ANNOTATION),
+        volume_name=spec.get("volumeName", "") or "",
+        access_modes=spec.get("accessModes", ("ReadWriteOnce",)),
+        storage_request=spec.get("resources", {}).get("requests", {}).get("storage", "1Gi"),
+    )
+    meta_from_manifest(c, m)
+    return c
+
+
+def storageclass_to_manifest(s) -> dict:
+    return {
+        "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+        "metadata": meta_to_manifest(s.metadata),
+        "provisioner": s.provisioner,
+        "volumeBindingMode": s.binding_mode,
+    }
+
+
+def storageclass_from_manifest(m: dict):
+    from karpenter_tpu.apis.storage import StorageClass
+
+    s = StorageClass(
+        m["metadata"]["name"],
+        # the Kubernetes API defaults an unset volumeBindingMode to
+        # Immediate -- mirroring that here is what makes VolumeIndex
+        # treat unbound claims of such classes as blocked
+        binding_mode=m.get("volumeBindingMode", "Immediate"),
+        provisioner=m.get("provisioner", ""),
+    )
+    meta_from_manifest(s, m)
+    return s
 
 
 # -- Lease (leader election) -------------------------------------------------
@@ -938,6 +1062,17 @@ REGISTRY: Dict[type, KindInfo] = {
         DaemonSet, "apps/v1", "daemonsets", True, daemonset_to_manifest, daemonset_from_manifest
     ),
 }
+
+from karpenter_tpu.apis.storage import PersistentVolumeClaim as _PVC  # noqa: E402
+from karpenter_tpu.apis.storage import StorageClass as _SC  # noqa: E402
+
+REGISTRY[_PVC] = KindInfo(
+    _PVC, "v1", "persistentvolumeclaims", True, pvc_to_manifest, pvc_from_manifest
+)
+REGISTRY[_SC] = KindInfo(
+    _SC, "storage.k8s.io/v1", "storageclasses", False,
+    storageclass_to_manifest, storageclass_from_manifest,
+)
 
 from karpenter_tpu.apis.objects import Lease as _Lease  # noqa: E402
 

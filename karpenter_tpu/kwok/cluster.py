@@ -16,7 +16,10 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Iterable, List, Optional, Tuple, Type
 
-from karpenter_tpu.apis import DaemonSet, NodeClaim, NodePool, Pod, Node, PodDisruptionBudget, TPUNodeClass
+from karpenter_tpu.apis import (
+    DaemonSet, NodeClaim, NodePool, Pod, Node, PersistentVolumeClaim,
+    PodDisruptionBudget, StorageClass, TPUNodeClass,
+)
 from karpenter_tpu.apis.objects import APIObject, Lease
 from karpenter_tpu.cache.ttl import Clock
 from karpenter_tpu.scheduling import Resources
@@ -61,10 +64,22 @@ class RelationalQueries:
                 return n
         return None
 
-    def node_usage(self, node_name: str) -> Resources:
+    def node_usage(self, node_name: str, vol_index=None) -> Resources:
+        from karpenter_tpu.apis.storage import PersistentVolumeClaim, pod_volume_requests, VolumeIndex
+
         total = Resources()
         for p in self.pods_on_node(node_name):
             total = total + p.requests
+            if p.volume_claims:
+                # bound pods charge their claim attachments to the node
+                # (apis/storage): pod.requests never carries the volume
+                # axis on the RAW object -- resolution is external.
+                # Per-reconcile callers (binder, existing-node snapshots)
+                # pass a prebuilt index; building one per call would put
+                # an O(claims) list scan in the bind inner loop.
+                if vol_index is None:
+                    vol_index = VolumeIndex(self.list(PersistentVolumeClaim))
+                total = total + pod_volume_requests(p, vol_index)
         return total
 
     def nodepool_usage(self, nodepool_name: str) -> Resources:
@@ -79,7 +94,10 @@ class RelationalQueries:
 
 
 class Cluster(RelationalQueries):
-    KINDS: Tuple[Type[APIObject], ...] = (Pod, Node, NodeClaim, NodePool, TPUNodeClass, Lease, PodDisruptionBudget, DaemonSet)
+    KINDS: Tuple[Type[APIObject], ...] = (
+        Pod, Node, NodeClaim, NodePool, TPUNodeClass, Lease,
+        PodDisruptionBudget, DaemonSet, PersistentVolumeClaim, StorageClass,
+    )
 
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or Clock()

@@ -113,6 +113,20 @@ def pods_limit(info: InstanceTypeInfo, nodeclass: TPUNodeClass, reserved_nics: i
     return max(1, limit)
 
 
+def volume_attach_limit(info: InstanceTypeInfo) -> int:
+    """Per-instance data-volume attach budget.
+
+    Models the EBS-style shared attachment ceiling: a fixed per-instance
+    slot count shared between NICs and data volumes (so NIC-rich types
+    attach fewer volumes), with the root volume already carved out.
+    Deterministic from catalog fields, like the NIC-derived pod density
+    above (reference: the core's CSI volume-limit scheduling; AWS's
+    per-instance EBS attachment ceiling).
+    """
+    slots = 28 if info.vcpu <= 64 else 40
+    return max(8, slots - info.max_network_interfaces - 1)
+
+
 class Resolver:
     """Converts raw InstanceTypeInfo + nodeclass config into InstanceTypes.
 
@@ -134,6 +148,7 @@ class Resolver:
             res.EPHEMERAL_STORAGE: float(storage_gib * 2**30),
             res.PODS: float(pods_limit(info, nodeclass)),
             res.PRIVATE_IPV4: float(info.max_network_interfaces * info.ipv4_per_interface),
+            res.ATTACHABLE_VOLUMES: float(volume_attach_limit(info)),
         }
         if info.gpu_count:
             vals[res.GPU] = float(info.gpu_count)

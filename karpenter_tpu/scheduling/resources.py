@@ -30,6 +30,15 @@ GPU = "gpu.devices.dev/gpu"            # generic GPU-like extended resource
 ACCELERATOR = "accelerator.dev/chips"  # generic ML accelerator (TPU-like)
 NIC = "network.dev/nic"                # EFA-like high-perf NIC resource
 PRIVATE_IPV4 = "private-ipv4"          # per-instance IP budget (subnet math)
+# Per-node persistent-volume attach budget. The reference core counts a
+# pod's CSI volumes against the node's attach limit during its scheduling
+# simulation (karpenter core scheduling volume-usage tracking; the AWS
+# analogue is the EBS per-instance attachment ceiling). Here it is ONE
+# MORE DENSE AXIS: pods with resolved claims carry their volume count on
+# it, instance types carry their attach limit, and the same vector fit
+# that bounds cpu/mem/pods bounds attachments -- on the device kernel,
+# the oracle, and the binder, with zero special-case code in any of them.
+ATTACHABLE_VOLUMES = "attachable-volumes"
 
 # The dense axis order for the solver. Static: changing it is a schema bump.
 RESOURCE_AXES: Tuple[str, ...] = (
@@ -41,6 +50,7 @@ RESOURCE_AXES: Tuple[str, ...] = (
     ACCELERATOR,
     NIC,
     PRIVATE_IPV4,
+    ATTACHABLE_VOLUMES,
 )
 AXIS_INDEX: Dict[str, int] = {name: i for i, name in enumerate(RESOURCE_AXES)}
 NUM_RESOURCE_AXES = len(RESOURCE_AXES)

@@ -1,0 +1,383 @@
+"""Volume-aware scheduling: attach limits, volume topology, claim binding.
+
+Mirrors the scenario intent of the reference's `test/suites/storage` E2E
+suite (stateful workloads: PVC-per-replica fan-out, zonal volume
+affinity, WaitForFirstConsumer binding) plus unit coverage of the
+lowering in apis/storage: claims become attach counts on the
+attachable-volumes resource axis and bound-zone selector pins, so the
+device kernel / oracle / binder enforce them with the same vector math
+as every other resource.
+"""
+import pytest
+
+from karpenter_tpu.apis import (
+    Node,
+    NodeClaim,
+    NodePool,
+    PersistentVolumeClaim,
+    Pod,
+    StorageClass,
+    TPUNodeClass,
+    labels as wk,
+)
+from karpenter_tpu.apis.storage import (
+    BINDING_IMMEDIATE,
+    VolumeIndex,
+    effective_pods,
+)
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.scheduling import resources as res
+from karpenter_tpu.solver.oracle import Scheduler
+from karpenter_tpu.solver.service import TPUSolver
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in gen_catalog.ZONES},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return prov.list(nc)
+
+
+def mk_pod(name, claims=(), cpu="100m", **kw):
+    return Pod(
+        name,
+        requests=Resources({"cpu": cpu, "memory": "256Mi"}),
+        volume_claims=claims,
+        **kw,
+    )
+
+
+class TestVolumeIndex:
+    def test_counts_and_zone_pin(self):
+        idx = VolumeIndex(
+            [
+                PersistentVolumeClaim("a", bound_zone="zone-a"),
+                PersistentVolumeClaim("b"),
+            ]
+        )
+        count, zone, blocked = idx.lookup(mk_pod("p", claims=("a", "b")))
+        assert (count, zone, blocked) == (2, "zone-a", None)
+
+    def test_missing_claim_blocks(self):
+        count, zone, blocked = VolumeIndex([]).lookup(mk_pod("p", claims=("nope",)))
+        assert blocked is not None and "not found" in blocked
+
+    def test_zone_conflict_blocks(self):
+        idx = VolumeIndex(
+            [
+                PersistentVolumeClaim("a", bound_zone="zone-a"),
+                PersistentVolumeClaim("b", bound_zone="zone-b"),
+            ]
+        )
+        _, _, blocked = idx.lookup(mk_pod("p", claims=("a", "b")))
+        assert blocked is not None and "conflict" in blocked
+
+    def test_unbound_immediate_blocks_but_wffc_passes(self):
+        idx = VolumeIndex(
+            [PersistentVolumeClaim("a", storage_class_name="fast")],
+            [StorageClass("fast", binding_mode=BINDING_IMMEDIATE)],
+        )
+        _, _, blocked = idx.lookup(mk_pod("p", claims=("a",)))
+        assert blocked is not None and "awaiting binding" in blocked
+        idx_wffc = VolumeIndex(
+            [PersistentVolumeClaim("a", storage_class_name="slow")],
+            [StorageClass("slow")],
+        )
+        count, zone, blocked = idx_wffc.lookup(mk_pod("p", claims=("a",)))
+        assert (count, zone, blocked) == (1, None, None)
+
+    def test_namespaces_are_scoping(self):
+        idx = VolumeIndex([PersistentVolumeClaim("a", namespace="other")])
+        _, _, blocked = idx.lookup(mk_pod("p", claims=("a",)))
+        assert blocked is not None  # claim lives in another namespace
+
+    def test_named_but_unknown_class_blocks(self):
+        # a NAMED storage class absent from the index is conservatively
+        # Immediate (the Kubernetes API default for unset binding mode):
+        # scheduling the pod would stamp a zone the real provisioner may
+        # contradict
+        idx = VolumeIndex([PersistentVolumeClaim("a", storage_class_name="ghost")])
+        _, _, blocked = idx.lookup(mk_pod("p", claims=("a",)))
+        assert blocked is not None and "awaiting binding" in blocked
+
+    def test_classless_unbound_claim_passes(self):
+        idx = VolumeIndex([PersistentVolumeClaim("a")])
+        count, zone, blocked = idx.lookup(mk_pod("p", claims=("a",)))
+        assert (count, zone, blocked) == (1, None, None)
+
+
+class TestEffectivePods:
+    def test_claimless_pods_pass_by_identity(self):
+        pods = [mk_pod(f"p{i}") for i in range(3)]
+        out, uns = effective_pods(pods, VolumeIndex([]))
+        assert len(out) == 3 and all(a is b for a, b in zip(out, pods)) and not uns
+
+    def test_resolution_lands_on_axis_and_selector(self):
+        idx = VolumeIndex([PersistentVolumeClaim("a", bound_zone="zone-b")])
+        out, uns = effective_pods([mk_pod("p", claims=("a",))], idx)
+        assert not uns
+        eff = out[0]
+        assert eff.requests.get(res.ATTACHABLE_VOLUMES) == 1.0
+        assert eff.node_selector[wk.ZONE_LABEL] == "zone-b"
+        assert eff.metadata.name == "p"  # decisions map back by name
+
+    def test_selector_conflict_is_unschedulable(self):
+        idx = VolumeIndex([PersistentVolumeClaim("a", bound_zone="zone-b")])
+        pod = mk_pod("p", claims=("a",), node_selector={wk.ZONE_LABEL: "zone-a"})
+        out, uns = effective_pods([pod], idx)
+        assert not out and "conflict" in uns["p"]
+
+    def test_replicas_share_one_equivalence_class(self):
+        # StatefulSet shape: per-replica claims, same count, no zone yet
+        from karpenter_tpu.solver import encode
+
+        claims = [PersistentVolumeClaim(f"data-{i}") for i in range(6)]
+        shared_req = Resources({"cpu": "100m", "memory": "256Mi"})
+        pods = [
+            Pod(f"web-{i}", requests=shared_req, volume_claims=(f"data-{i}",))
+            for i in range(6)
+        ]
+        out, uns = effective_pods(pods, VolumeIndex(claims))
+        assert not uns
+        classes = encode.group_pods(out)
+        assert len(classes) == 1 and len(classes[0].pods) == 6
+
+
+class TestAttachLimits:
+    def test_capacity_carries_attach_limit(self, catalog_items):
+        for it in catalog_items[:20]:
+            limit = it.capacity.get(res.ATTACHABLE_VOLUMES)
+            assert 8 <= limit <= 40
+
+    def test_volume_fanout_differential(self, catalog_items):
+        """Attach-heavy pods must fan out across nodes, identically on the
+        oracle and the device path -- the axis rides the same vector fit."""
+        pool = NodePool("default")
+        claims = [PersistentVolumeClaim(f"d{i}{j}") for i in range(12) for j in range(9)]
+        shared_req = Resources({"cpu": "100m", "memory": "256Mi"})
+        pods = [
+            Pod(
+                f"p{i}",
+                requests=shared_req,
+                volume_claims=tuple(f"d{i}{j}" for j in range(9)),
+            )
+            for i in range(12)
+        ]
+        eff, uns = effective_pods(pods, VolumeIndex(claims))
+        assert not uns
+        sched = Scheduler(
+            nodepools=[pool],
+            instance_types={pool.name: catalog_items},
+            zones={o.zone for it in catalog_items for o in it.available_offerings()},
+        )
+        o = sched.schedule(list(eff))
+        s = TPUSolver(g_max=256).solve(pool, catalog_items, list(eff))
+        assert not o.unschedulable and not s.unschedulable
+        assert len(o.new_groups) == len(s.new_groups)
+        o_sig = sorted(tuple(sorted(p.metadata.name for p in g.pods)) for g in o.new_groups)
+        s_sig = sorted(tuple(sorted(p.metadata.name for p in g.pods)) for g in s.new_groups)
+        assert o_sig == s_sig
+        # 12 pods x 9 volumes = 108 attachments; no catalog type attaches
+        # more than 39, so one node can never hold them all
+        assert len(s.new_groups) >= 2
+        for g in s.new_groups:
+            # one group = one future node; its attachments fit every
+            # surviving type's budget
+            assert 9 * len(g.pods) <= min(
+                it.capacity.get(res.ATTACHABLE_VOLUMES) for it in g.instance_types
+            )
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock(start=10_000.0)
+    op = Operator(clock=clock)
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    return op
+
+
+class TestStorageE2E:
+    def test_wait_for_first_consumer_binds_on_schedule(self, env):
+        env.cluster.create(StorageClass("standard"))
+        env.cluster.create(PersistentVolumeClaim("data-0", storage_class_name="standard"))
+        pod = mk_pod("web-0", claims=("data-0",))
+        env.cluster.create(pod)
+        env.settle()
+        assert pod.node_name, "pod did not bind"
+        node = next(n for n in env.cluster.list(Node) if n.metadata.name == pod.node_name)
+        claim = env.cluster.get(PersistentVolumeClaim, "data-0")
+        assert claim.bound_zone == node.zone
+
+    def test_bound_zone_pins_provisioning(self, env):
+        from karpenter_tpu.providers.instancetype import gen_catalog
+
+        zone = gen_catalog.ZONE_NAMES[1]
+        env.cluster.create(PersistentVolumeClaim("data-0", bound_zone=zone))
+        pod = mk_pod("web-0", claims=("data-0",))
+        env.cluster.create(pod)
+        env.settle()
+        assert pod.node_name
+        node = next(n for n in env.cluster.list(Node) if n.metadata.name == pod.node_name)
+        assert node.zone == zone
+
+    def test_missing_claim_reported_then_heals(self, env):
+        pod = mk_pod("web-0", claims=("data-0",))
+        env.cluster.create(pod)
+        env.tick()
+        assert not pod.node_name
+        assert "data-0" in env.provisioner.last_result.unschedulable.get("web-0", "")
+        env.cluster.create(PersistentVolumeClaim("data-0"))
+        env.settle()
+        assert pod.node_name
+
+    def test_node_usage_counts_attachments(self, env):
+        env.cluster.create(PersistentVolumeClaim("data-0"))
+        env.cluster.create(PersistentVolumeClaim("data-1"))
+        pod = mk_pod("web-0", claims=("data-0", "data-1"))
+        env.cluster.create(pod)
+        env.settle()
+        assert pod.node_name
+        usage = env.cluster.node_usage(pod.node_name)
+        assert usage.get(res.ATTACHABLE_VOLUMES) == 2.0
+
+    def test_attach_heavy_pods_fan_out(self, env):
+        for i in range(5):
+            for j in range(10):
+                env.cluster.create(PersistentVolumeClaim(f"d{i}-{j}"))
+        for i in range(5):
+            env.cluster.create(
+                mk_pod(f"web-{i}", claims=tuple(f"d{i}-{j}" for j in range(10)))
+            )
+        env.settle()
+        assert not env.cluster.pending_pods()
+        # 50 attachments exceed any single type's budget (max 39)
+        assert len(env.cluster.list(Node)) >= 2
+
+    def test_zonal_volume_keeps_consolidation_in_zone(self, env):
+        """A pod whose volume is bound to one zone cannot be simulated onto
+        capacity pinned to another: the rescheduling simulation must fail,
+        so the node survives consolidation."""
+        from karpenter_tpu.providers.instancetype import gen_catalog
+        from karpenter_tpu.scheduling import Requirement, Operator as Op
+
+        zone_b, zone_a = gen_catalog.ZONE_NAMES[1], gen_catalog.ZONE_NAMES[0]
+        env.cluster.create(PersistentVolumeClaim("data-0", bound_zone=zone_b))
+        pod = mk_pod("web-0", claims=("data-0",))
+        env.cluster.create(pod)
+        env.settle()
+        node_b = next(n for n in env.cluster.list(Node) if n.zone == zone_b)
+        # rescheduling simulation with only zone-a capacity: the effective
+        # pod carries the zone-b pin, so the solve cannot place it
+        eff, _ = effective_pods([pod], VolumeIndex.from_cluster(env.cluster))
+        pool_a = NodePool(
+            "zone-a-only",
+            requirements=[Requirement(wk.ZONE_LABEL, Op.IN, [zone_a])],
+        )
+        items = env.cloud_provider.get_instance_types(pool_a)
+        sim = Scheduler(
+            nodepools=[pool_a],
+            instance_types={pool_a.name: items},
+            zones={zone_a},
+        )
+        r = sim.schedule(list(eff))
+        assert r.unschedulable, "zone-bound volume pod must not simulate cross-zone"
+        assert node_b.metadata.name  # the hosting node remains
+
+
+class TestKubeConversions:
+    def test_pvc_round_trip(self):
+        from karpenter_tpu.kube import convert
+
+        c = PersistentVolumeClaim(
+            "d0", namespace="apps", storage_class_name="fast",
+            bound_zone="zone-c", volume_name="pv-7",
+        )
+        m = convert.pvc_to_manifest(c)
+        assert m["status"]["phase"] == "Bound"
+        c2 = convert.pvc_from_manifest(m)
+        assert (c2.storage_class_name, c2.bound_zone, c2.volume_name) == ("fast", "zone-c", "pv-7")
+        assert c2.metadata.namespace == "apps"
+
+    def test_storageclass_round_trip(self):
+        from karpenter_tpu.kube import convert
+
+        s = StorageClass("fast", binding_mode=BINDING_IMMEDIATE)
+        s2 = convert.storageclass_from_manifest(convert.storageclass_to_manifest(s))
+        assert s2.binding_mode == BINDING_IMMEDIATE
+
+    def test_storageclass_unset_mode_defaults_immediate(self):
+        # the Kubernetes API default for volumeBindingMode is Immediate
+        from karpenter_tpu.kube import convert
+
+        s = convert.storageclass_from_manifest(
+            {"apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
+             "metadata": {"name": "legacy"}, "provisioner": "p"}
+        )
+        assert s.binding_mode == BINDING_IMMEDIATE
+
+    def test_pvc_manifest_is_apiserver_valid(self):
+        # accessModes required; storage request round-trips verbatim
+        from karpenter_tpu.kube import convert
+
+        c = PersistentVolumeClaim("d0", access_modes=("ReadWriteMany",), storage_request="100Gi")
+        m = convert.pvc_to_manifest(c)
+        assert m["spec"]["accessModes"] == ["ReadWriteMany"]
+        assert m["spec"]["resources"]["requests"]["storage"] == "100Gi"
+        c2 = convert.pvc_from_manifest(m)
+        assert c2.access_modes == ("ReadWriteMany",) and c2.storage_request == "100Gi"
+
+    def test_node_without_attach_keys_gets_default_budget(self):
+        # CSI limits live on CSINode objects, not node status: a real
+        # node reporting no attachable-volumes-* key must not read as 0
+        from karpenter_tpu.kube import convert
+
+        r = convert.node_resources_from_map({"cpu": "8", "memory": "32Gi"})
+        assert r.get(res.ATTACHABLE_VOLUMES) == convert.DEFAULT_NODE_ATTACH_LIMIT
+
+    def test_pod_volumes_round_trip(self):
+        from karpenter_tpu.kube import convert
+
+        p = mk_pod("p", claims=("a", "b"))
+        p2 = convert.pod_from_manifest(convert.pod_to_manifest(p))
+        assert p2.volume_claims == ("a", "b")
+
+    def test_node_resources_tolerant_mapping(self):
+        from karpenter_tpu.kube import convert
+
+        r = convert.node_resources_from_map(
+            {
+                "cpu": "8",
+                "memory": "32Gi",
+                "pods": "110",
+                "attachable-volumes-csi-a": "25",
+                "attachable-volumes-csi-b": "39",
+                "hugepages-2Mi": "0",
+                "vendor.example/fpga": "2",
+            }
+        )
+        assert r.get("cpu") == 8000.0
+        assert r.get(res.ATTACHABLE_VOLUMES) == 25.0  # smallest driver wins
+        assert "hugepages-2Mi" not in r.keys()
