@@ -97,6 +97,26 @@ class PersistentVolumeClaim(APIObject):
         return self.bound_zone is not None or bool(self.volume_name)
 
 
+class CSINode(APIObject):
+    """Per-node CSI driver registry: where real clusters publish volume
+    attach limits (spec.drivers[].allocatable.count). The kube adapter
+    overlays these onto Node.allocatable's attachable-volumes axis; the
+    kwok rig does not need them (its nodes inherit the axis from instance
+    type capacity)."""
+
+    KIND = "CSINode"
+
+    def __init__(self, name: str, drivers: Sequence[Tuple[str, Optional[int]]] = ()):
+        super().__init__(name=name)
+        # (driver name, allocatable count or None when the driver reports
+        # no limit)
+        self.drivers = tuple((d, None if c is None else int(c)) for d, c in drivers)
+
+    def attach_limit(self) -> Optional[int]:
+        counts = [c for _, c in self.drivers if c is not None]
+        return min(counts) if counts else None
+
+
 class VolumeIndex:
     """Point-in-time claim/class lookup built once per scheduling pass."""
 

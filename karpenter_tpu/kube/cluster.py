@@ -68,6 +68,9 @@ class KubeCluster(RelationalQueries):
         self._list_cache_ttl = list_cache_ttl
         self._list_cache: Dict[str, Tuple[float, List[dict]]] = {}
         self._list_lock = threading.Lock()
+        from karpenter_tpu.logging import ChangeMonitor
+
+        self._csi_err_monitor = ChangeMonitor()
 
     # -- plumbing -----------------------------------------------------------
     def _info(self, kind: Type[APIObject]) -> convert.KindInfo:
@@ -127,15 +130,19 @@ class KubeCluster(RelationalQueries):
             raise NotFound(f"{kind.KIND}/{name}")
         return obj
 
-    def try_get(self, kind: Type[APIObject], name: str) -> Optional[APIObject]:
+    def try_get(self, kind: Type[APIObject], name: str, _overlay: bool = True) -> Optional[APIObject]:
         """The Cluster surface is name-keyed (the in-memory store is
         namespace-agnostic): try the configured namespace first, then fall
         back to a cluster-wide scan so objects in other namespaces are
-        reachable by name too."""
+        reachable by name too. `_overlay=False` skips the CSINode join for
+        internal callers that only read metadata (field-scoped updates)."""
         info = self._info(kind)
         try:
             out = self.client.get(f"{info.base_path(self.namespace)}/{name}")
-            return info.from_manifest(out)
+            obj = info.from_manifest(out)
+            if _overlay and kind is Node:
+                self._overlay_csi_limits([obj])
+            return obj
         except HttpNotFound:
             pass
         if not info.namespaced:
@@ -161,9 +168,50 @@ class KubeCluster(RelationalQueries):
                 with self._list_lock:
                     self._list_cache[info.kind.KIND] = (now, manifests)
         items = [info.from_manifest(m) for m in manifests]
+        if kind is Node:
+            self._overlay_csi_limits(items)
         if predicate is not None:
             items = [o for o in items if predicate(o)]
         return items
+
+    def _overlay_csi_limits(self, nodes: List[APIObject]) -> None:
+        """Real clusters publish attach limits on CSINode objects, not in
+        node status: where a CSINode exists for a node, its smallest
+        driver allocatable.count REPLACES the conversion-time default on
+        the attachable-volumes axis (kept when no CSINode/driver reports
+        a count)."""
+        from karpenter_tpu.apis.storage import CSINode
+        from karpenter_tpu.scheduling import resources as res
+
+        try:
+            csinodes = {c.metadata.name: c for c in self.list(CSINode)}
+        except HttpNotFound:
+            return  # apiserver without the storage API group
+        except ApiError as e:
+            # RBAC denial / server trouble: fall back to the conversion
+            # default, but say so -- silent degradation here surfaces as
+            # unexplained over/under-packing (ChangeMonitor dedups)
+            if self._csi_err_monitor.has_changed("csinode_list", type(e).__name__):
+                self.log.warning(
+                    "csinode list failed; using default attach limits",
+                    error=str(e)[:200],
+                )
+            return
+        if not csinodes:
+            return
+        for n in nodes:
+            c = csinodes.get(n.metadata.name)
+            limit = c.attach_limit() if c is not None else None
+            if limit is None:
+                continue
+            for attr in ("capacity", "allocatable"):
+                r = getattr(n, attr)
+                delta = float(limit) - r.get(res.ATTACHABLE_VOLUMES)
+                if delta:
+                    setattr(
+                        n, attr,
+                        r + Resources.from_base_units({res.ATTACHABLE_VOLUMES: delta}),
+                    )
 
     def _invalidate(self, kind: Type[APIObject]) -> None:
         with self._list_lock:
@@ -288,7 +336,7 @@ class KubeCluster(RelationalQueries):
         taints, labels -- field-scoped so kubelet-owned spec/status fields
         survive; readiness/capacity go through nodes/status."""
         info = self._info(Node)
-        server = self.try_get(Node, node.metadata.name)
+        server = self.try_get(Node, node.metadata.name, _overlay=False)
         patch = {
             "metadata": self._meta_patch(node, server),
             "spec": {

@@ -794,9 +794,11 @@ def node_to_manifest(n: Node) -> dict:
 
 
 # attach budget assumed for nodes that report NO attachable-volumes-*
-# key: modern CSI drivers publish limits on CSINode objects (which this
-# adapter does not watch), not in node status -- leaving the axis at 0
-# would make every claim-carrying pod unfittable on every real node.
+# key: modern CSI drivers publish limits on CSINode objects, not in node
+# status -- KubeCluster._overlay_csi_limits replaces this default with
+# the node's real CSINode driver count when one exists; this constant
+# covers nodes with no CSINode (or no driver reporting a count), where
+# leaving the axis at 0 would make every claim-carrying pod unfittable.
 # 24 is at/below every curve value providers/instancetype/types.
 # volume_attach_limit produces, so the assumption only ever under-packs.
 DEFAULT_NODE_ATTACH_LIMIT = 24.0
@@ -954,6 +956,34 @@ def pvc_from_manifest(m: dict):
     return c
 
 
+def csinode_to_manifest(c) -> dict:
+    return {
+        "apiVersion": "storage.k8s.io/v1", "kind": "CSINode",
+        "metadata": meta_to_manifest(c.metadata),
+        "spec": {
+            "drivers": [
+                {"name": d, "nodeID": c.metadata.name}
+                | ({"allocatable": {"count": n}} if n is not None else {})
+                for d, n in c.drivers
+            ]
+        },
+    }
+
+
+def csinode_from_manifest(m: dict):
+    from karpenter_tpu.apis.storage import CSINode
+
+    c = CSINode(
+        m["metadata"]["name"],
+        drivers=[
+            (d.get("name", ""), d.get("allocatable", {}).get("count"))
+            for d in m.get("spec", {}).get("drivers", ())
+        ],
+    )
+    meta_from_manifest(c, m)
+    return c
+
+
 def storageclass_to_manifest(s) -> dict:
     return {
         "apiVersion": "storage.k8s.io/v1", "kind": "StorageClass",
@@ -1072,6 +1102,13 @@ REGISTRY[_PVC] = KindInfo(
 REGISTRY[_SC] = KindInfo(
     _SC, "storage.k8s.io/v1", "storageclasses", False,
     storageclass_to_manifest, storageclass_from_manifest,
+)
+
+from karpenter_tpu.apis.storage import CSINode as _CSINode  # noqa: E402
+
+REGISTRY[_CSINode] = KindInfo(
+    _CSINode, "storage.k8s.io/v1", "csinodes", False,
+    csinode_to_manifest, csinode_from_manifest,
 )
 
 from karpenter_tpu.apis.objects import Lease as _Lease  # noqa: E402

@@ -349,6 +349,44 @@ class TestKubeConversions:
         c2 = convert.pvc_from_manifest(m)
         assert c2.access_modes == ("ReadWriteMany",) and c2.storage_request == "100Gi"
 
+    def test_csinode_round_trip(self):
+        from karpenter_tpu.apis.storage import CSINode
+        from karpenter_tpu.kube import convert
+
+        c = CSINode("node-1", drivers=[("csi.a", 25), ("csi.b", None)])
+        m = convert.csinode_to_manifest(c)
+        c2 = convert.csinode_from_manifest(m)
+        assert c2.drivers == (("csi.a", 25), ("csi.b", None))
+        assert c2.attach_limit() == 25
+
+    def test_csinode_overlay_on_real_bus(self):
+        """The kube adapter takes a node's attach budget from its CSINode
+        (smallest driver count), falling back to the conversion default
+        otherwise -- where real clusters actually publish the limit."""
+        from karpenter_tpu.apis.storage import CSINode
+        from karpenter_tpu.kube import convert
+        from karpenter_tpu.kube.client import KubeClient, KubeConfig
+        from karpenter_tpu.kube.cluster import KubeCluster
+        from tests.fake_apiserver import FakeApiServer
+
+        srv = FakeApiServer().start()
+        cl = KubeCluster(KubeClient(KubeConfig(server=srv.url)))
+        try:
+            n = Node("n1", capacity=Resources({"cpu": "4", "memory": "8Gi"}))
+            n.allocatable = Resources({"cpu": "4", "memory": "8Gi"})
+            cl.create(n)
+            cl.create(CSINode("n1", drivers=[("csi.a", 17)]))
+            got = next(o for o in cl.list(Node) if o.metadata.name == "n1")
+            assert got.allocatable.get(res.ATTACHABLE_VOLUMES) == 17.0
+            assert cl.get(Node, "n1").allocatable.get(res.ATTACHABLE_VOLUMES) == 17.0
+            # a node WITHOUT a CSINode keeps the conversion default
+            cl.create(Node("n2", capacity=Resources({"cpu": "4", "memory": "8Gi"})))
+            got2 = next(o for o in cl.list(Node) if o.metadata.name == "n2")
+            assert got2.allocatable.get(res.ATTACHABLE_VOLUMES) == convert.DEFAULT_NODE_ATTACH_LIMIT
+        finally:
+            cl.stop()
+            srv.stop()
+
     def test_node_without_attach_keys_gets_default_budget(self):
         # CSI limits live on CSINode objects, not node status: a real
         # node reporting no attachable-volumes-* key must not read as 0
