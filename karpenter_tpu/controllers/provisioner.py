@@ -14,7 +14,7 @@ from typing import Dict, List, Optional
 import time
 
 from karpenter_tpu.apis import NodeClaim, NodePool, Node, labels as wk
-from karpenter_tpu import metrics
+from karpenter_tpu import events, metrics
 from karpenter_tpu.logging import get_logger
 from karpenter_tpu.apis.nodeclass import HASH_ANNOTATION, HASH_VERSION, HASH_VERSION_ANNOTATION, TPUNodeClass
 from karpenter_tpu.apis.objects import generate_name
@@ -27,16 +27,27 @@ from karpenter_tpu.solver.oracle import ExistingNode, NewNodeGroup, Scheduler, S
 
 MAX_TYPES_PER_CLAIM = 60  # mirror of the launch truncation for claim size
 
+
+class _PodRef:
+    """Event-target shim: unschedulable reasons are keyed by pod NAME in
+    SchedulingResult (the pod object may be an effective volume copy)."""
+
+    KIND = "Pod"
+
+    def __init__(self, name: str):
+        self.name = name
+
 TERMINATION_FINALIZER = "karpenter.sh/termination"
 
 
 class Provisioner:
     log = get_logger("provisioner")
 
-    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, solver=None):
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, solver=None, recorder=None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.solver = solver  # optional TPU solver; None = oracle
+        self.recorder = recorder  # optional events.Recorder
         self.last_result: Optional[SchedulingResult] = None
 
     # -- snapshot -----------------------------------------------------------
@@ -111,6 +122,7 @@ class Provisioner:
         result.unschedulable.update(vol_blocked)
         if not pods:
             metrics.IGNORED_PODS.set(len(result.unschedulable))
+            self._publish_unschedulable(result)
             self.last_result = result
             return result
         nodepools = [p for p in self.cluster.list(NodePool) if not p.deleting]
@@ -148,6 +160,7 @@ class Provisioner:
         result.unschedulable.update(vol_blocked)
         metrics.SCHEDULING_DURATION.observe(time.perf_counter() - t0)
         metrics.IGNORED_PODS.set(len(result.unschedulable))
+        self._publish_unschedulable(result)
         if result.new_groups or result.unschedulable:
             self.log.info(
                 "scheduling decision",
@@ -159,6 +172,17 @@ class Provisioner:
         self._launch(result)
         self.last_result = result
         return result
+
+    def _publish_unschedulable(self, result: SchedulingResult) -> None:
+        """Per-pod FailedScheduling events with the decision's reason (the
+        core publishes the same through its events.Recorder); the
+        recorder's window dedups repeats across ticks."""
+        if self.recorder is None:
+            return
+        for pod_name, reason in result.unschedulable.items():
+            self.recorder.publish(
+                _PodRef(pod_name), "FailedScheduling", reason, type=events.WARNING,
+            )
 
     # -- NodeClaim creation + launch ---------------------------------------
     # worker parallelism for cloud launches, mirroring the reference's

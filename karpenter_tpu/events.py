@@ -45,8 +45,14 @@ class Recorder:
             for e in reversed(self.events[-50:]):
                 if (
                     e.kind == kind and e.name == name and e.reason == reason
+                    and e.message == message
                     and now - e.timestamp < self.dedupe_window
                 ):
+                    # identical events coalesce; a CHANGED message under
+                    # the same reason (e.g. an unschedulable pod's cause
+                    # moving from a missing claim to no-capacity) records
+                    # fresh -- suppressing it would hide the new cause
+                    # for the whole window
                     e.count += 1
                     return
             self.events.append(

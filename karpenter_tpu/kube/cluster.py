@@ -38,7 +38,7 @@ from karpenter_tpu.cache.ttl import Clock
 from karpenter_tpu.kube import convert
 from karpenter_tpu.kube.client import ApiError, Conflict as HttpConflict, KubeClient, NotFound as HttpNotFound
 from karpenter_tpu.kwok.cluster import AlreadyExists, Conflict, NotFound, RelationalQueries
-from karpenter_tpu.logging import get_logger
+from karpenter_tpu.logging import ChangeMonitor, get_logger
 from karpenter_tpu.scheduling import Resources
 
 EventHandler = Callable[[str, APIObject], None]
@@ -68,8 +68,6 @@ class KubeCluster(RelationalQueries):
         self._list_cache_ttl = list_cache_ttl
         self._list_cache: Dict[str, Tuple[float, List[dict]]] = {}
         self._list_lock = threading.Lock()
-        from karpenter_tpu.logging import ChangeMonitor
-
         self._csi_err_monitor = ChangeMonitor()
 
     # -- plumbing -----------------------------------------------------------
@@ -141,7 +139,7 @@ class KubeCluster(RelationalQueries):
             out = self.client.get(f"{info.base_path(self.namespace)}/{name}")
             obj = info.from_manifest(out)
             if _overlay and kind is Node:
-                self._overlay_csi_limits([obj])
+                self._overlay_csi_one(obj)
             return obj
         except HttpNotFound:
             pass
@@ -174,6 +172,26 @@ class KubeCluster(RelationalQueries):
             items = [o for o in items if predicate(o)]
         return items
 
+    def _overlay_csi_one(self, node: APIObject) -> None:
+        """Single-node overlay via a targeted GET (CSINode names equal node
+        names): a cluster-wide CSINode LIST per node GET would multiply
+        through per-pod try_get loops."""
+        from karpenter_tpu.apis.storage import CSINode
+
+        info = self._info(CSINode)
+        try:
+            m = self.client.get(f"{info.base_path()}/{node.metadata.name}")
+        except HttpNotFound:
+            return
+        except ApiError as e:
+            if self._csi_err_monitor.has_changed("csinode_get", type(e).__name__):
+                self.log.warning(
+                    "csinode get failed; using default attach limits",
+                    error=str(e)[:200],
+                )
+            return
+        self._apply_csi_limit(node, info.from_manifest(m).attach_limit())
+
     def _overlay_csi_limits(self, nodes: List[APIObject]) -> None:
         """Real clusters publish attach limits on CSINode objects, not in
         node status: where a CSINode exists for a node, its smallest
@@ -201,17 +219,22 @@ class KubeCluster(RelationalQueries):
             return
         for n in nodes:
             c = csinodes.get(n.metadata.name)
-            limit = c.attach_limit() if c is not None else None
-            if limit is None:
-                continue
-            for attr in ("capacity", "allocatable"):
-                r = getattr(n, attr)
-                delta = float(limit) - r.get(res.ATTACHABLE_VOLUMES)
-                if delta:
-                    setattr(
-                        n, attr,
-                        r + Resources.from_base_units({res.ATTACHABLE_VOLUMES: delta}),
-                    )
+            self._apply_csi_limit(n, c.attach_limit() if c is not None else None)
+
+    @staticmethod
+    def _apply_csi_limit(node: APIObject, limit: Optional[int]) -> None:
+        from karpenter_tpu.scheduling import resources as res
+
+        if limit is None:
+            return
+        for attr in ("capacity", "allocatable"):
+            r = getattr(node, attr)
+            delta = float(limit) - r.get(res.ATTACHABLE_VOLUMES)
+            if delta:
+                setattr(
+                    node, attr,
+                    r + Resources.from_base_units({res.ATTACHABLE_VOLUMES: delta}),
+                )
 
     def _invalidate(self, kind: Type[APIObject]) -> None:
         with self._list_lock:
@@ -402,6 +425,16 @@ class KubeCluster(RelationalQueries):
     def _put_status(self, obj: APIObject) -> None:
         info = self._info(type(obj))
         manifest = info.to_manifest(obj)
+        if isinstance(obj, Node):
+            # the attachable-volumes axis is DERIVED at read time (CSINode
+            # overlay, else the conversion default) -- writing it back
+            # would persist a point-in-time overlay into node status and
+            # pin it past CSINode changes
+            from karpenter_tpu.scheduling import resources as res
+
+            for m in (manifest.get("status", {}).get("capacity", {}),
+                      manifest.get("status", {}).get("allocatable", {})):
+                m.pop(res.ATTACHABLE_VOLUMES, None)
         raw_rv = getattr(obj, "_raw_resource_version", None)
         if raw_rv:
             manifest["metadata"]["resourceVersion"] = raw_rv

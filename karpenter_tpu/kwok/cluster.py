@@ -20,6 +20,7 @@ from karpenter_tpu.apis import (
     DaemonSet, NodeClaim, NodePool, Pod, Node, PersistentVolumeClaim,
     PodDisruptionBudget, StorageClass, TPUNodeClass,
 )
+from karpenter_tpu.apis.storage import CSINode
 from karpenter_tpu.apis.objects import APIObject, Lease
 from karpenter_tpu.cache.ttl import Clock
 from karpenter_tpu.scheduling import Resources
@@ -97,6 +98,7 @@ class Cluster(RelationalQueries):
     KINDS: Tuple[Type[APIObject], ...] = (
         Pod, Node, NodeClaim, NodePool, TPUNodeClass, Lease,
         PodDisruptionBudget, DaemonSet, PersistentVolumeClaim, StorageClass,
+        CSINode,
     )
 
     def __init__(self, clock: Optional[Clock] = None):

@@ -22,6 +22,7 @@ from karpenter_tpu.apis import NodeClaim, Node, labels as wk
 from karpenter_tpu.apis.nodeclaim import COND_INITIALIZED, COND_LAUNCHED, COND_REGISTERED
 from karpenter_tpu.kwok.cloud import FakeCloud
 from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.scheduling import resources as res
 
 
 class NodeLifecycle:
@@ -72,6 +73,17 @@ class NodeLifecycle:
                 provider_id=claim.provider_id,
             )
             self.cluster.create(node)
+            # the kubelet-analogue also publishes the node's CSI driver
+            # registry: attach limits live on CSINode in real clusters
+            # (kube adapter overlays them onto the node at read time),
+            # and node STATUS writes never carry the derived axis
+            attach = node.allocatable.get(res.ATTACHABLE_VOLUMES)
+            if attach:
+                from karpenter_tpu.apis.storage import CSINode
+
+                self.cluster.create(
+                    CSINode(node_name, drivers=[("csi.kwok.dev", int(attach))])
+                )
             claim.node_name = node_name
             claim.status_conditions.set_true(COND_REGISTERED, "NodeRegistered")
             self.cluster.update(claim)
@@ -118,12 +130,16 @@ class NodeLifecycle:
                 self.cluster.update(node)
 
     def _reap_dead_instances(self) -> None:
+        from karpenter_tpu.apis.storage import CSINode
+
         live = {i.provider_id for i in self.cloud.describe_instances() if i.state in ("pending", "running")}
         for node in self.cluster.list(Node):
             if node.provider_id and node.provider_id not in live:
                 self.cluster.unbind_pods(node.metadata.name)
                 node.metadata.finalizers = []
                 self.cluster.delete(Node, node.metadata.name)
+                if self.cluster.try_get(CSINode, node.metadata.name) is not None:
+                    self.cluster.delete(CSINode, node.metadata.name)
         # A claim whose instance died is phantom capacity: if it survived,
         # the provisioner would keep counting it as an in-flight node and
         # never replace the lost pods (core nodeclaim-lifecycle behavior).

@@ -141,7 +141,9 @@ class Operator:
             capacity_reservations=self.capacity_reservations,
             instance_profiles=self.instance_profiles,
         )
-        self.provisioner = Provisioner(self.cluster, self.cloud_provider, solver=solver)
+        self.provisioner = Provisioner(
+            self.cluster, self.cloud_provider, solver=solver, recorder=self.recorder
+        )
         self.binder = PodBinder(self.cluster)
         self.lifecycle = NodeLifecycle(self.cluster, self.cloud)
         self.termination = TerminationController(self.cluster, self.cloud_provider)

@@ -249,6 +249,10 @@ class TestStorageE2E:
         env.tick()
         assert not pod.node_name
         assert "data-0" in env.provisioner.last_result.unschedulable.get("web-0", "")
+        # the decision surfaces as a FailedScheduling pod event (the core
+        # publishes the same through its events.Recorder)
+        evs = [e for e in env.recorder.with_reason("FailedScheduling") if e.name == "web-0"]
+        assert evs and "data-0" in evs[0].message and evs[0].type == "Warning"
         env.cluster.create(PersistentVolumeClaim("data-0"))
         env.settle()
         assert pod.node_name
@@ -386,6 +390,72 @@ class TestKubeConversions:
         finally:
             cl.stop()
             srv.stop()
+
+    def test_lifecycle_publishes_csinode(self, env):
+        """The kwok kubelet-analogue publishes a CSINode per registered
+        node carrying the instance type's attach limit -- where real
+        clusters put it."""
+        from karpenter_tpu.apis.storage import CSINode
+
+        env.cluster.create(mk_pod("p0"))
+        env.settle()
+        nodes = env.cluster.list(Node)
+        assert nodes
+        for n in nodes:
+            c = env.cluster.try_get(CSINode, n.metadata.name)
+            assert c is not None
+            assert c.attach_limit() == int(n.allocatable.get(res.ATTACHABLE_VOLUMES))
+
+    def test_status_writes_never_persist_derived_axis(self):
+        """Node status writes strip attachable-volumes: the axis is
+        derived at read time (CSINode overlay, else default), so a
+        point-in-time overlay must not pin itself into server status."""
+        from karpenter_tpu.apis.storage import CSINode
+        from karpenter_tpu.kube import convert
+        from karpenter_tpu.kube.client import KubeClient, KubeConfig
+        from karpenter_tpu.kube.cluster import KubeCluster
+        from tests.fake_apiserver import FakeApiServer
+
+        srv = FakeApiServer().start()
+        cl = KubeCluster(KubeClient(KubeConfig(server=srv.url)))
+        try:
+            cl.create(Node("n1", capacity=Resources({"cpu": "4", "memory": "8Gi"})))
+            cl.create(CSINode("n1", drivers=[("csi.a", 17)]))
+            n = cl.get(Node, "n1")
+            assert n.allocatable.get(res.ATTACHABLE_VOLUMES) == 17.0
+            n.unschedulable = True  # cordon -> field-scoped update + status PUT
+            cl.update(n)
+            raw = cl.client.get("/api/v1/nodes/n1")
+            assert res.ATTACHABLE_VOLUMES not in raw["status"].get("allocatable", {})
+            # reads still derive 17 from the CSINode
+            assert cl.get(Node, "n1").allocatable.get(res.ATTACHABLE_VOLUMES) == 17.0
+            # CSINode gone -> reads fall back to the default, not a stale 17
+            cl.delete(CSINode, "n1")
+            assert (
+                cl.get(Node, "n1").allocatable.get(res.ATTACHABLE_VOLUMES)
+                == convert.DEFAULT_NODE_ATTACH_LIMIT
+            )
+        finally:
+            cl.stop()
+            srv.stop()
+
+    def test_event_message_change_not_swallowed(self):
+        """A FailedScheduling event whose CAUSE changes within the dedupe
+        window must surface, not coalesce into the stale message."""
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.events import Recorder, WARNING
+
+        rec = Recorder(clock=FakeClock(100.0))
+
+        class Ref:
+            KIND = "Pod"
+            name = "p"
+
+        rec.publish(Ref(), "FailedScheduling", "waiting for claim", type=WARNING)
+        rec.publish(Ref(), "FailedScheduling", "waiting for claim", type=WARNING)
+        assert len(rec.events) == 1 and rec.events[0].count == 2
+        rec.publish(Ref(), "FailedScheduling", "no capacity", type=WARNING)
+        assert len(rec.events) == 2 and rec.events[1].message == "no capacity"
 
     def test_node_without_attach_keys_gets_default_budget(self):
         # CSI limits live on CSINode objects, not node status: a real
