@@ -82,11 +82,13 @@ class DisruptionController:
         pricing,
         feature_gates: Optional[dict] = None,
         evaluator=None,
+        recorder=None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.pricing = pricing
         self.feature_gates = feature_gates or {}
+        self.recorder = recorder  # optional events.Recorder
         # batched device evaluator (solver/consolidate.py): all candidate
         # sets are judged in one dispatch; candidates with stateful
         # constraints fall back to the per-candidate oracle simulation
@@ -759,6 +761,13 @@ class DisruptionController:
         disrupting[c.nodepool.name] = disrupting.get(c.nodepool.name, 0) + 1
         self.last_decisions.append((c.claim.metadata.name, reason))
         metrics.DISRUPTION_DECISIONS.inc(reason=reason)
+        if self.recorder is not None:
+            # the core publishes a Disrupted event per acted candidate
+            # (events.Recorder through the disruption controller)
+            self.recorder.publish(
+                c.claim, "Disrupted",
+                f"disrupting via {reason} ({len(c.pods)} pods reschedule)",
+            )
         self.log.info(
             "disrupting node",
             nodeclaim=c.claim.metadata.name,

@@ -49,6 +49,7 @@ class NodeLifecycle:
         self._initialize_nodes(now)
         self._propagate_impairments()
         self._reap_dead_instances()
+        self._sweep_orphan_csinodes()
 
     # -- registration -------------------------------------------------------
     def _register_nodes(self, now: float) -> None:
@@ -130,16 +131,12 @@ class NodeLifecycle:
                 self.cluster.update(node)
 
     def _reap_dead_instances(self) -> None:
-        from karpenter_tpu.apis.storage import CSINode
-
         live = {i.provider_id for i in self.cloud.describe_instances() if i.state in ("pending", "running")}
         for node in self.cluster.list(Node):
             if node.provider_id and node.provider_id not in live:
                 self.cluster.unbind_pods(node.metadata.name)
                 node.metadata.finalizers = []
                 self.cluster.delete(Node, node.metadata.name)
-                if self.cluster.try_get(CSINode, node.metadata.name) is not None:
-                    self.cluster.delete(CSINode, node.metadata.name)
         # A claim whose instance died is phantom capacity: if it survived,
         # the provisioner would keep counting it as an in-flight node and
         # never replace the lost pods (core nodeclaim-lifecycle behavior).
@@ -149,3 +146,15 @@ class NodeLifecycle:
                 self.cluster.delete(NodeClaim, claim.metadata.name)
                 self._launched_seen.pop(claim.metadata.name, None)
                 self._registered_at.pop(claim.node_name, None)
+
+    def _sweep_orphan_csinodes(self) -> None:
+        """CSINode lifetime is this kubelet-analogue's job (as on a real
+        cluster): whatever deleted the Node -- termination, GC, the reap
+        above -- the companion CSINode follows on the next step, so no
+        deletion call site needs to know about the cascade."""
+        from karpenter_tpu.apis.storage import CSINode
+
+        names = {n.metadata.name for n in self.cluster.list(Node)}
+        for c in self.cluster.list(CSINode):
+            if c.metadata.name not in names:
+                self.cluster.delete(CSINode, c.metadata.name)

@@ -149,7 +149,7 @@ class Operator:
         self.termination = TerminationController(self.cluster, self.cloud_provider)
         self.disruption = DisruptionController(
             self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates,
-            evaluator=consolidation_evaluator,
+            evaluator=consolidation_evaluator, recorder=self.recorder,
         )
         # instance-id field index for interruption lookups, registered
         # exactly when the interruption queue is configured (reference

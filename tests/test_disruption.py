@@ -34,7 +34,7 @@ def env():
     op.cluster.create(TPUNodeClass("default"))
     op.cluster.create(NodePool("default"))
     op.disruption = DisruptionController(op.cluster, op.cloud_provider, op.pricing,
-                                         op.options.feature_gates)
+                                         op.options.feature_gates, recorder=op.recorder)
     op.termination = TerminationController(op.cluster, op.cloud_provider)
     return op
 
@@ -67,6 +67,10 @@ class TestEmptiness:
         age_all_claims(env)
         decisions = env.disruption.reconcile()
         assert decisions and decisions[0][1] == REASON_EMPTY
+        # the decision surfaces as a Disrupted event on the claim (the
+        # core publishes the same through its events.Recorder)
+        evs = env.recorder.with_reason("Disrupted")
+        assert evs and evs[0].name == decisions[0][0] and REASON_EMPTY in evs[0].message
         drain_cycle(env)
         assert not env.cluster.list(Node)
         assert not env.cluster.list(NodeClaim)

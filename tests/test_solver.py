@@ -390,13 +390,18 @@ class TestDifferentialFuzz:
                         ),
                     )
                 ]
+            req = {res.CPU: float(cpu_m), res.MEMORY: float(mem_mi) * 2**20}
+            if rng.random() < 0.15:
+                # volume-backed shape: the attachable-volumes axis rides
+                # pod requests exactly as apis/storage.effective_pods
+                # resolves claims, so the fuzz exercises attach-limit
+                # packing differentially like every other axis
+                req[res.ATTACHABLE_VOLUMES] = float(rng.integers(1, 7))
             for i in range(int(rng.integers(1, 7))):
                 pods.append(
                     Pod(
                         f"f{seed}-{t}-{i}",
-                        requests=Resources.from_base_units(
-                            {res.CPU: float(cpu_m), res.MEMORY: float(mem_mi) * 2**20}
-                        ),
+                        requests=Resources.from_base_units(req),
                         node_selector=selector,
                         tolerations=tolerations,
                         labels={"app": f"w{t}"},
@@ -412,7 +417,8 @@ class TestDifferentialFuzz:
                 name=f"f{seed}-n{ni}",
                 labels={wk.ZONE_LABEL: z, wk.ARCH_LABEL: "amd64"},
                 allocatable=Resources.from_base_units(
-                    {res.CPU: 4000.0, res.MEMORY: 8.0 * 2**30, res.PODS: 20}
+                    {res.CPU: 4000.0, res.MEMORY: 8.0 * 2**30, res.PODS: 20,
+                     res.ATTACHABLE_VOLUMES: 8.0}
                 ),
             )
             existing.append(node)

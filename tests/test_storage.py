@@ -406,6 +406,22 @@ class TestKubeConversions:
             assert c is not None
             assert c.attach_limit() == int(n.allocatable.get(res.ATTACHABLE_VOLUMES))
 
+    def test_csinode_follows_node_deletion(self, env):
+        """Whatever path deletes a Node (termination, GC, reap), the
+        companion CSINode is swept on the next lifecycle step -- no
+        orphan accumulation across consolidation churn."""
+        from karpenter_tpu.apis.storage import CSINode
+
+        env.cluster.create(mk_pod("p0"))
+        env.settle()
+        node = env.cluster.list(Node)[0]
+        assert env.cluster.try_get(CSINode, node.metadata.name) is not None
+        env.cluster.unbind_pods(node.metadata.name)
+        node.metadata.finalizers = []
+        env.cluster.delete(Node, node.metadata.name)
+        env.lifecycle.step()
+        assert env.cluster.try_get(CSINode, node.metadata.name) is None
+
     def test_status_writes_never_persist_derived_axis(self):
         """Node status writes strip attachable-volumes: the axis is
         derived at read time (CSINode overlay, else default), so a
@@ -456,6 +472,42 @@ class TestKubeConversions:
         assert len(rec.events) == 1 and rec.events[0].count == 2
         rec.publish(Ref(), "FailedScheduling", "no capacity", type=WARNING)
         assert len(rec.events) == 2 and rec.events[1].message == "no capacity"
+
+    def test_event_dedupe_survives_wide_ticks(self):
+        """Dedupe is identity-keyed, not a tail scan: a tick publishing
+        hundreds of distinct pod events must still coalesce each with its
+        own previous occurrence on the next tick (not grow unbounded)."""
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.events import Recorder, WARNING
+
+        rec = Recorder(clock=FakeClock(100.0))
+
+        def ref(i):
+            class R:
+                KIND = "Pod"
+                name = f"p{i}"
+            return R()
+
+        for _tick in range(3):
+            for i in range(200):
+                rec.publish(ref(i), "FailedScheduling", "waiting", type=WARNING)
+        assert len(rec.events) == 200
+        assert all(e.count == 3 for e in rec.events)
+
+    def test_event_list_capped(self):
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.events import Recorder
+
+        clock = FakeClock(100.0)
+        rec = Recorder(clock=clock, dedupe_window=0.0)
+        for i in range(rec.MAX_EVENTS + 100):
+            clock.step(1.0)
+
+            class R:
+                KIND = "Pod"
+                name = f"p{i}"
+            rec.publish(R(), "X", "m")
+        assert len(rec.events) <= rec.MAX_EVENTS
 
     def test_node_without_attach_keys_gets_default_budget(self):
         # CSI limits live on CSINode objects, not node status: a real
