@@ -249,6 +249,11 @@ class PodClassSet:
     # [C, K] bool open-restriction mask (merged multi-pool solves only;
     # None = open anywhere compat allows). See ffd.SolveInputs.open_allowed.
     open_allowed: np.ndarray = None
+    # [C, K] bool join-restriction mask ANDed into compat (merged
+    # multi-pool solves with per-pool TAINTS only; None = no restriction).
+    # Encodes the oracle's _try_group toleration gate: a class may join a
+    # group only on columns of pools whose taints it tolerates.
+    join_allowed: np.ndarray = None
 
 
 def soft_zone_tsc(pod: Pod):

@@ -82,6 +82,12 @@ class SolveInputs(NamedTuple):
     # _open_group pool-order preference) while joining any admitted
     # pool's in-flight groups.
     open_allowed: jax.Array
+    # [C, K] bool ANDed into compat (so it gates JOINS and opens alike).
+    # All-true except merged multi-pool solves with per-pool taints, where
+    # a class's columns are restricted to pools whose taints it tolerates
+    # (the oracle's _try_group toleration gate; groups are single-pool by
+    # construction, so a column gate IS a group gate).
+    join_allowed: jax.Array
 
 
 class SolveOutputs(NamedTuple):
@@ -238,7 +244,7 @@ def _ffd_body(
     K = inp.cap.shape[0]
     Z = inp.tzone.shape[1]
     CTn = inp.tcap.shape[1]
-    compat = _device_compat(inp, word_offsets, words)             # [C, K]
+    compat = _device_compat(inp, word_offsets, words) & inp.join_allowed  # [C, K]
     # fresh nodes reserve the pool's daemonset overhead: every fit count
     # (in-scan and fresh) sees the reduced capacity. Padding rows clip to
     # zero so they stay unusable.
@@ -683,6 +689,13 @@ def _open_allowed(classes: PodClassSet, k_pad: int) -> np.ndarray:
     return oa
 
 
+def _join_allowed(classes: PodClassSet, k_pad: int) -> np.ndarray:
+    ja = getattr(classes, "join_allowed", None)
+    if ja is None:
+        return np.ones((classes.c_pad, k_pad), dtype=bool)
+    return ja
+
+
 def make_inputs_staged(staged: StagedCatalog, classes: PodClassSet) -> SolveInputs:
     """SolveInputs over a pre-staged device catalog; class-side leaves stay
     host numpy so the jit dispatch streams them asynchronously."""
@@ -697,6 +710,7 @@ def make_inputs_staged(staged: StagedCatalog, classes: PodClassSet) -> SolveInpu
         acap=classes.acap, schedulable=classes.schedulable,
         node_overhead=classes.node_overhead,
         open_allowed=_open_allowed(classes, int(staged.cap.shape[0])),
+        join_allowed=_join_allowed(classes, int(staged.cap.shape[0])),
     )
 
 
@@ -723,5 +737,6 @@ def make_inputs(catalog: CatalogTensors, classes: PodClassSet) -> Tuple[SolveInp
         schedulable=jnp.asarray(classes.schedulable),
         node_overhead=jnp.asarray(classes.node_overhead),
         open_allowed=jnp.asarray(_open_allowed(classes, catalog.k_pad)),
+        join_allowed=jnp.asarray(_join_allowed(classes, catalog.k_pad)),
     )
     return inp, offsets, words

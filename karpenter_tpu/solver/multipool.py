@@ -23,11 +23,16 @@ MERGED-CATALOG formulation that rides the existing FFD kernel:
   mask seeds gmask single-pool; joins only narrow), and decode attributes
   the group to that pool, emitting the ORIGINAL instance types.
 
+Per-pool daemonset overhead bakes into each column's allocatable
+(build_merged below), and per-pool TAINTS gate joins through
+ffd.SolveInputs.join_allowed (a [C, K] mask ANDed into compat: the
+oracle's _try_group toleration gate, sound because groups are
+single-pool by construction) -- both stay on device.
+
 Scope carve-outs (service._try_solve_merged routes to the oracle): pools
 with limits (per-pool usage accounting is not in the scan), minValues
-pools (the class-level partition handles those separately), unequal
-per-pool daemonset overhead (node_overhead is one vector per solve), and
-spread classes (already oracle-routed for multi-pool by supports()).
+pools (the class-level partition handles those separately), and spread
+classes (already oracle-routed for multi-pool by supports()).
 """
 from __future__ import annotations
 
@@ -41,11 +46,26 @@ from karpenter_tpu.scheduling import tolerates_all
 
 
 def build_merged(
-    pools: Sequence[NodePool], catalogs: Dict[str, list]
+    pools: Sequence[NodePool], catalogs: Dict[str, list], overheads: Sequence = (),
 ) -> Tuple[List[InstanceType], List[InstanceType], np.ndarray]:
     """(merged_items, original_items, col_pools). Pools must arrive in
     weight-descending order (the oracle's iteration order); column order
-    follows it, so per-pool column ranges are contiguous."""
+    follows it, so per-pool column ranges are contiguous.
+
+    `overheads` (one Resources per pool, same order) is each pool's
+    daemonset reserve: it ADDS to the column's overhead, so the column's
+    allocatable -- what the kernel's capacity tensor is built from --
+    already reflects the pool the column belongs to. This is how the
+    merged solve supports UNEQUAL per-pool overhead with one [R] global
+    node_overhead vector (left at zero): the oracle's per-group
+    `requested + ovh(group.nodepool) <= allocatable` is algebraically the
+    same check."""
+    if overheads and len(overheads) != len(pools):
+        # a partial list would silently zero the reserve for trailing
+        # pools and overstate their columns' allocatable
+        raise ValueError(
+            f"build_merged: {len(overheads)} overheads for {len(pools)} pools"
+        )
     merged: List[InstanceType] = []
     originals: List[InstanceType] = []
     col_pools: List[int] = []
@@ -53,6 +73,7 @@ def build_merged(
         preqs = pool.requirements()
         zreq = preqs.get(wk.ZONE_LABEL)
         creq = preqs.get(wk.CAPACITY_TYPE_LABEL)
+        ovh = overheads[pi] if overheads else None
         for it in catalogs.get(pool.name, []):
             if not it.requirements.compatible(preqs):
                 continue  # the pool's requirements exclude this type
@@ -69,7 +90,7 @@ def build_merged(
                     name=f"{pool.name}/{it.name}",
                     requirements=it.requirements.copy().add(*preqs),
                     capacity=it.capacity,
-                    overhead=it.overhead,
+                    overhead=it.overhead + ovh if ovh is not None else it.overhead,
                     offerings=offerings,
                     info=it.info,
                 )
@@ -98,6 +119,29 @@ def admitted_pools(pc, pools: Sequence[NodePool]) -> List[int]:
             continue
         out.append(pi)
     return out
+
+
+def join_allowed_mask(
+    classes, pools: Sequence[NodePool], col_pools: np.ndarray,
+    c_pad: int, k_pad: int,
+) -> np.ndarray:
+    """[C_pad, K_pad] bool: columns class c may use AT ALL (ANDed into the
+    kernel's compat, so it gates joins and opens alike): columns of pools
+    whose taints the class representative tolerates. Mirrors the oracle's
+    _try_group `tolerates_all(pod.tolerations, group.taints)` -- a merged
+    group's surviving columns stay within one pool, so a column gate IS
+    the group gate. Padding rows/columns stay True (compat gates them)."""
+    mask = np.ones((c_pad, k_pad), dtype=bool)
+    k_real = col_pools.shape[0]
+    for pi, pool in enumerate(pools):
+        if not pool.template.taints:
+            continue
+        cols = np.zeros((k_pad,), dtype=bool)
+        cols[:k_real] = col_pools == pi
+        for c, pc in enumerate(classes):
+            if not tolerates_all(pc.pods[0].tolerations, pool.template.taints):
+                mask[c, cols] = False
+    return mask
 
 
 def open_allowed_mask(

@@ -312,6 +312,11 @@ class SolverServer:
                 "open_allowed",
                 np.ones((t["req"].shape[0], entry.staged.cap.shape[0]), dtype=bool),
             ),
+            # ones preserves clients without per-pool-taints gating
+            join_allowed=t.get(
+                "join_allowed",
+                np.ones((t["req"].shape[0], entry.staged.cap.shape[0]), dtype=bool),
+            ),
         )
         return entry, inp
 
@@ -465,6 +470,9 @@ class SolverClient:
         ] + (
             [("open_allowed", class_set.open_allowed)]
             if getattr(class_set, "open_allowed", None) is not None else []
+        ) + (
+            [("join_allowed", class_set.join_allowed)]
+            if getattr(class_set, "join_allowed", None) is not None else []
         )
 
     def _solve_op(self, op_header: dict, seqnum: str, catalog, class_set):

@@ -564,9 +564,11 @@ class TPUSolver:
     def _try_solve_merged(self, scheduler, pods, base_classes):
         """Overlapping-compat multi-pool batch on device via the merged
         catalog, or None when a carve-out applies (the caller falls back
-        to the oracle). Carve-outs: pool limits, minValues pools, unequal
-        per-pool daemonset overhead. Spread classes never reach here
-        (supports() routes multi-pool spread to the oracle first)."""
+        to the oracle). Carve-outs: pool limits, minValues pools. Spread
+        classes never reach here (supports() routes multi-pool spread to
+        the oracle first). Per-pool daemonset overhead bakes into the
+        merged columns' allocatable; per-pool taints gate joins through
+        SolveInputs.join_allowed -- neither routes to the oracle."""
         from karpenter_tpu.solver import multipool
 
         pools = scheduler.nodepools  # weight-descending (oracle order)
@@ -579,26 +581,21 @@ class TPUSolver:
         overheads = [
             scheduler.daemon_overhead.get(p.name) or Resources() for p in pools
         ]
-        vecs = [encode.scale_vector(o.to_vector()) for o in overheads]
-        if any(not np.array_equal(vecs[0], v) for v in vecs[1:]):
-            return None
-        # per-pool taints would need per-COLUMN toleration gating (the
-        # oracle's join check tolerates the GROUP's pool taints); with
-        # identical taints the global schedulable flag covers it
-        taints0 = [(t.key, t.value, t.effect) for t in pools[0].template.taints]
-        if any(
-            [(t.key, t.value, t.effect) for t in p.template.taints] != taints0
-            for p in pools[1:]
-        ):
-            return None
-        # cache keyed by per-pool catalog identity + requirement hashes;
-        # the entry RETAINS the catalog lists and re-checks identity on hit
-        # (the same id()-reuse hazard _catalog documents: a freed list's
-        # address can be recycled by the 12-hourly refresh)
+        # cache keyed by per-pool catalog identity + requirement hashes +
+        # overhead/taint signatures (both bake into the merged columns /
+        # the entry's pool tuple); the entry RETAINS the catalog lists and
+        # re-checks identity on hit (the same id()-reuse hazard _catalog
+        # documents: a freed list's address can be recycled by the
+        # 12-hourly refresh)
         cat_lists = tuple(scheduler.instance_types.get(p.name) for p in pools)
         key = (
             tuple(id(cl) for cl in cat_lists),
             tuple(p.requirements().stable_hash() for p in pools),
+            tuple(encode.scale_vector(o.to_vector()).tobytes() for o in overheads),
+            tuple(
+                tuple((t.key, t.value, t.effect) for t in p.template.taints)
+                for p in pools
+            ),
         )
         cached = self._merged_cache.get(key)
         if cached is not None and all(
@@ -607,7 +604,7 @@ class TPUSolver:
             _, merged_items, originals, col_pools = cached
         else:
             merged_items, originals, col_pools = multipool.build_merged(
-                pools, scheduler.instance_types
+                pools, scheduler.instance_types, overheads=overheads
             )
             if not merged_items:
                 return None
@@ -629,14 +626,16 @@ class TPUSolver:
                 "overlapping multi-pool batch on device via merged catalog",
                 pools=[p.name for p in pools], columns=len(merged_items),
             )
+        # the virtual pool carries NO taints and NO overhead: toleration
+        # gates per COLUMN via join_allowed (built in solve()'s merged
+        # branch from entry.pools), and each column's allocatable already
+        # carries its own pool's daemonset reserve (build_merged)
         virtual = _MergedVirtualPool("__merged__")
-        virtual.template.taints = list(pools[0].template.taints)
         res_solve = self.solve(
             virtual, merged_items, list(pods),
             existing_nodes=scheduler.existing,
             zones=sorted(scheduler.zones),
             classes=classes,
-            daemon_overhead=overheads[0],
         )
         result.new_groups.extend(res_solve.new_groups)
         result.existing_assignments.update(res_solve.existing_assignments)
@@ -778,6 +777,15 @@ class TPUSolver:
             ]
             class_set.open_allowed, open_pool_idx = multipool.open_allowed_mask(
                 classes, admitted_all, entry.col_pools, compat_h, fits_one_h,
+                class_set.c_pad, catalog.k_pad,
+            )
+            # per-pool TAINTS gate joins per column (the oracle's
+            # _try_group toleration check against the group's pool; sound
+            # because merged groups are single-pool by construction). The
+            # merged virtual pool carries no taints, so this mask is the
+            # ONLY toleration gate on this path.
+            class_set.join_allowed = multipool.join_allowed_mask(
+                classes, entry.pools, entry.col_pools,
                 class_set.c_pad, catalog.k_pad,
             )
             if self.objective == "price":

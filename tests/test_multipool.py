@@ -45,12 +45,14 @@ def mk_pools(arm_weight=10, amd_weight=1):
     return arm, amd
 
 
-def run_both(items, pods, pools, device_must_hold=False, monkeypatch=None):
+def run_both(items, pods, pools, device_must_hold=False, monkeypatch=None,
+             daemon_overhead=None):
     zones = {o.zone for it in items for o in it.available_offerings()}
     catalogs = {p.name: items for p in pools}
 
     def mk():
-        return Scheduler(nodepools=list(pools), instance_types=catalogs, zones=zones)
+        return Scheduler(nodepools=list(pools), instance_types=catalogs, zones=zones,
+                         daemon_overhead=daemon_overhead)
 
     oracle = mk().schedule(list(pods))
     sched = mk()
@@ -206,6 +208,81 @@ class TestMergedMultiPool:
                     assert zreq is not None and zreq.matches("us-central-1b")
                     assert not zreq.matches("us-central-1a")
 
+    def test_per_pool_taints_gate_joins_on_device(self, catalog_items, monkeypatch):
+        """Round 4: UNEQUAL per-pool taints stay on device. The tainted
+        high-weight pool admits only tolerating classes; non-tolerating
+        pods must neither open there nor JOIN its in-flight groups
+        (SolveInputs.join_allowed: the oracle's _try_group toleration
+        gate), exactly as the oracle decides."""
+        from karpenter_tpu.scheduling import Taint, Toleration
+
+        arm, amd = mk_pools(arm_weight=10, amd_weight=1)
+        arm.template.taints = [Taint("dedicated", "NoSchedule", "arm")]
+        pools = [arm, amd]
+        tol = [Toleration(key="dedicated", operator="Exists")]
+        # tolerating bigs OPEN arm groups with headroom...
+        big = [
+            Pod(f"big{i}", requests=Resources({"cpu": "3", "memory": "6Gi"}),
+                tolerations=tol)
+            for i in range(3)
+        ]
+        # ...then non-tolerating smalls arrive: in-flight arm capacity is
+        # forbidden to them, so they must open amd instead
+        joiners = [small(f"join{i}") for i in range(4)]
+        oracle, device = run_both(
+            catalog_items, big + joiners, pools,
+            device_must_hold=True, monkeypatch=monkeypatch,
+        )
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+        assert by_pool_signature(oracle) == by_pool_signature(device)
+        for result in (oracle, device):
+            for g in result.new_groups:
+                if g.nodepool.name == "arm":
+                    assert all(p.metadata.name.startswith("big") for p in g.pods)
+                else:
+                    assert all(p.metadata.name.startswith("join") for p in g.pods)
+        # tolerating pods still join across the boundary: a tolerating
+        # joiner lands on the arm in-flight groups
+        tol_joiners = [small(f"tj{i}", tolerations=tol) for i in range(2)]
+        oracle2, device2 = run_both(
+            catalog_items, big + tol_joiners, pools,
+            device_must_hold=True, monkeypatch=monkeypatch,
+        )
+        assert by_pool_signature(oracle2) == by_pool_signature(device2)
+        assert any(
+            g.nodepool.name == "arm" and any(p.metadata.name.startswith("tj") for p in g.pods)
+            for g in device2.new_groups
+        ), "tolerating pods must join the arm in-flight groups"
+
+    def test_per_pool_daemon_overhead_on_device(self, catalog_items, monkeypatch):
+        """Round 4: UNEQUAL per-pool daemonset overhead stays on device --
+        each merged column's allocatable carries its own pool's reserve
+        (multipool.build_merged), matching the oracle's per-group
+        requested + ovh(pool) <= allocatable check."""
+        arm, amd = mk_pools(arm_weight=10, amd_weight=1)
+        pools = [arm, amd]
+        overhead = {
+            "arm": Resources({"cpu": "2", "memory": "4Gi"}),
+            "amd": Resources({"cpu": "100m", "memory": "128Mi"}),
+        }
+        pods = [small(f"p{i}") for i in range(10)] + [
+            Pod(f"w{i}", requests=Resources({"cpu": "3", "memory": "6Gi"}))
+            for i in range(3)
+        ]
+        oracle, device = run_both(
+            catalog_items, pods, pools,
+            device_must_hold=True, monkeypatch=monkeypatch,
+            daemon_overhead=overhead,
+        )
+        assert set(oracle.unschedulable) == set(device.unschedulable) == set()
+        assert by_pool_signature(oracle) == by_pool_signature(device)
+        # the reserve really bit: every arm group leaves >= 2 cpu headroom
+        # on its smallest surviving type
+        for g in device.new_groups:
+            if g.nodepool.name == "arm":
+                it = min(g.instance_types, key=lambda x: x.capacity.get("cpu"))
+                assert (g.requested + overhead["arm"]).fits(it.allocatable())
+
     def test_pool_limits_still_fall_back(self, catalog_items, monkeypatch):
         """Carve-out: a pool with limits routes the batch to the oracle."""
         arm, amd = mk_pools()
@@ -231,12 +308,33 @@ class TestMergedMultiPool:
     @pytest.mark.parametrize("seed", range(6))
     def test_randomized_overlap_differential(self, catalog_items, seed):
         """Mixed overlapping batches: exact equality (no spread here, so no
-        carve-outs apply) across pools, selectors, and tolerations."""
+        carve-outs apply) across pools, selectors, tolerations, per-pool
+        taints (round 4: join_allowed gating), and per-pool daemonset
+        overhead (round 4: baked column allocatable)."""
+        from karpenter_tpu.scheduling import Taint, Toleration
+
         rng = np.random.default_rng(4200 + seed)
         arm, amd = mk_pools(
             arm_weight=int(rng.integers(1, 20)), amd_weight=int(rng.integers(1, 20))
         )
         pools = [arm, amd]
+        tainted = rng.random() < 0.5
+        if tainted:
+            # taint one pool (sometimes both, differently)
+            arm.template.taints = [Taint("dedicated", "NoSchedule", "arm")]
+            if rng.random() < 0.3:
+                amd.template.taints = [Taint("team", "NoSchedule", "a")]
+        daemon_overhead = None
+        if rng.random() < 0.4:
+            daemon_overhead = {
+                "arm": Resources.from_base_units(
+                    {"cpu": float(rng.choice([0, 500, 2000])),
+                     "memory": float(rng.choice([0, 512, 2048])) * 2**20}
+                ),
+                "amd": Resources.from_base_units(
+                    {"cpu": float(rng.choice([0, 250, 1000]))}
+                ),
+            }
         pods = []
         for t in range(int(rng.integers(2, 7))):
             cpu_m = int(rng.choice([250, 500, 1000, 2000, 3000]))
@@ -251,6 +349,11 @@ class TestMergedMultiPool:
                 )
             elif u < 0.55:
                 selector[wk.CAPACITY_TYPE_LABEL] = "on-demand"
+            tolerations = []
+            if tainted and rng.random() < 0.5:
+                tolerations.append(Toleration(key="dedicated", operator="Exists"))
+                if rng.random() < 0.5:
+                    tolerations.append(Toleration(key="team", operator="Exists"))
             for i in range(int(rng.integers(1, 6))):
                 pods.append(
                     Pod(
@@ -259,9 +362,12 @@ class TestMergedMultiPool:
                             {"cpu": float(cpu_m), "memory": float(mem_mi) * 2**20}
                         ),
                         node_selector=selector,
+                        tolerations=tolerations,
                     )
                 )
-        oracle, device = run_both(catalog_items, pods, pools)
+        oracle, device = run_both(
+            catalog_items, pods, pools, daemon_overhead=daemon_overhead
+        )
         assert set(oracle.unschedulable) == set(device.unschedulable), f"seed {seed}"
         assert by_pool_signature(oracle) == by_pool_signature(device), f"seed {seed}"
 
