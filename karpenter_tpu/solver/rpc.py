@@ -251,7 +251,13 @@ class SolverServer:
         op = header.get("op")
         try:
             if op == "ping":
-                _send_frame(sock, {"ok": True})
+                # features lets a NEWER client decide whether semantics it
+                # depends on exist server-side: an older server omits the
+                # field (or errors on a future op), and the client falls
+                # back -- e.g. taint-gated merged batches to the oracle
+                # (service._try_solve_merged) rather than silently packing
+                # without the join_allowed gate
+                _send_frame(sock, {"ok": True, "features": ["join_allowed"]})
             elif op == "stage":
                 self._op_stage(sock, header, tensors)
             elif op == "solve":
@@ -384,6 +390,7 @@ class SolverClient:
         self._server_hostname = server_hostname or (host if host else None)
         self._sock: Optional[socket.socket] = None
         self._staged_seqnums: set = set()
+        self._features: Optional[frozenset] = None  # per-connection, lazy
         # one reentrant lock serializes the socket AND the staging set: the
         # protocol is strictly request/response on one connection, so a
         # whole roundtrip (and the stage-then-solve sequence inside
@@ -421,6 +428,20 @@ class SolverClient:
             if self._sock is not None:
                 self._sock.close()
                 self._sock = None
+            self._features = None  # the replacement server may differ
+
+    def features(self) -> frozenset:
+        """Server feature set, probed once per connection via ping (an
+        older server omits the field -> empty set). Callers that DEPEND on
+        a semantic the server may lack check here and fall back -- e.g.
+        taint-gated merged batches go to the oracle when 'join_allowed' is
+        absent, because an old server would silently drop the mask and
+        pack pods into pools whose taints they do not tolerate."""
+        with self._lock:
+            if self._features is None:
+                header, _ = self._roundtrip({"op": "ping"})
+                self._features = frozenset(header.get("features", ()))
+            return self._features
 
     def _roundtrip(self, header, tensors=()):
         with self._lock:

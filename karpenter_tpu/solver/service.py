@@ -581,6 +581,17 @@ class TPUSolver:
         overheads = [
             scheduler.daemon_overhead.get(p.name) or Resources() for p in pools
         ]
+        if any(p.template.taints for p in pools) and self.client is not None:
+            # the taint gate rides SolveInputs.join_allowed; an OLDER
+            # sidecar drops unknown tensors silently (no error to degrade
+            # on), which would pack pods into pools whose taints they do
+            # not tolerate -- so taint-carrying merged batches require the
+            # server to advertise the feature, else oracle
+            try:
+                if "join_allowed" not in self.client.features():
+                    return None
+            except (ConnectionError, OSError):
+                return None
         # cache keyed by per-pool catalog identity + requirement hashes +
         # overhead/taint signatures (both bake into the merged columns /
         # the entry's pool tuple); the entry RETAINS the catalog lists and
@@ -783,11 +794,14 @@ class TPUSolver:
             # _try_group toleration check against the group's pool; sound
             # because merged groups are single-pool by construction). The
             # merged virtual pool carries no taints, so this mask is the
-            # ONLY toleration gate on this path.
-            class_set.join_allowed = multipool.join_allowed_mask(
-                classes, entry.pools, entry.col_pools,
-                class_set.c_pad, catalog.k_pad,
-            )
+            # ONLY toleration gate on this path. Untainted pools ship no
+            # mask at all: None lets the kernel/server default (all-true)
+            # apply without paying a [C, K] tensor on the wire.
+            if any(p.template.taints for p in entry.pools):
+                class_set.join_allowed = multipool.join_allowed_mask(
+                    classes, entry.pools, entry.col_pools,
+                    class_set.c_pad, catalog.k_pad,
+                )
             if self.objective == "price":
                 # envelope unification under each class's OPENING pool --
                 # the SAME choice the open mask encodes

@@ -79,6 +79,46 @@ class TestProtocol:
     def test_ping(self, client):
         assert client.ping() is True
 
+    def test_features_advertised_and_cached(self, client):
+        """ping carries the server's feature set; the client probes once
+        per connection and taint-gated merged batches depend on
+        'join_allowed' being present (service._try_solve_merged)."""
+        assert "join_allowed" in client.features()
+        assert client.features() is client.features()  # cached
+        client.close()
+        assert client._features is None  # reconnect re-probes
+
+    def test_taint_gated_merged_falls_back_without_feature(self, catalog_items):
+        """Version skew: an old sidecar silently drops join_allowed, so a
+        tainted merged batch must route to the ORACLE when the server does
+        not advertise the feature -- not pack taint-blind."""
+        from karpenter_tpu.apis import NodePool, Pod, labels as wk
+        from karpenter_tpu.scheduling import Operator as Op, Requirement, Resources, Taint
+        from karpenter_tpu.solver.oracle import Scheduler
+        from karpenter_tpu.solver.service import TPUSolver
+
+        arm = NodePool("arm", weight=10,
+                       requirements=[Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"])])
+        arm.template.taints = [Taint("dedicated", "NoSchedule", "arm")]
+        amd = NodePool("amd", weight=1,
+                       requirements=[Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])])
+        pods = [Pod(f"p{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}))
+                for i in range(4)]
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=[arm, amd],
+            instance_types={"arm": catalog_items, "amd": catalog_items},
+            zones=zones,
+        )
+
+        class OldServerClient:
+            def features(self):
+                return frozenset()
+
+        solver = TPUSolver(g_max=64)
+        solver.client = OldServerClient()
+        assert solver._try_solve_merged(sched, pods, None) is None
+
     def test_unknown_op_is_an_error_frame(self, server):
         from karpenter_tpu.solver.rpc import _recv_frame, _send_frame
 

@@ -31,6 +31,8 @@ from karpenter_tpu.utils import parse_instance_id
 
 
 def check_invariants(op):
+    from karpenter_tpu.apis.storage import VolumeIndex
+
     nodes = {n.metadata.name: n for n in op.cluster.list(Node)}
     claims = op.cluster.list(NodeClaim)
     # bound pods point at live nodes
@@ -40,10 +42,22 @@ def check_invariants(op):
     # provider ids unique across claims
     pids = [c.provider_id for c in claims if c.provider_id]
     assert len(pids) == len(set(pids)), "duplicate provider ids across claims"
-    # node usage within allocatable
+    # node usage within allocatable -- INCLUDING the attachable-volumes
+    # axis (node_usage charges bound pods' claim attachments)
     for name, node in nodes.items():
         used = op.cluster.node_usage(name)
         assert used.fits(node.allocatable), f"node {name} over-committed: {used}"
+    # volume topology holds: a bound pod with a zone-bound claim sits in
+    # that zone
+    vol_index = VolumeIndex.from_cluster(op.cluster)
+    for p in op.cluster.list(Pod):
+        if p.node_name and p.volume_claims:
+            _, zone, blocked = vol_index.lookup(p)
+            assert blocked is None, f"bound pod {p.metadata.name}: {blocked}"
+            if zone is not None:
+                assert nodes[p.node_name].zone == zone, (
+                    f"pod {p.metadata.name} in {nodes[p.node_name].zone}, volume in {zone}"
+                )
 
 
 def spot_msg(iid):
@@ -66,13 +80,38 @@ def test_soak_mixed_event_stream(seed):
     sizes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi")]
 
     for round_i in range(12):
-        event = rng.choice(["burst", "shrink", "interrupt", "kill", "degrade", "age"])
+        event = rng.choice(
+            ["burst", "stateful", "shrink", "interrupt", "kill", "degrade", "age"]
+        )
         if event == "burst":
             n = int(rng.integers(3, 20))
             for _ in range(n):
                 cpu, mem = sizes[int(rng.integers(0, len(sizes)))]
                 op.cluster.create(
                     Pod(f"soak-{seed}-{pod_seq}", requests=Resources({"cpu": cpu, "memory": mem}))
+                )
+                pod_seq += 1
+        elif event == "stateful":
+            # StatefulSet shape: per-replica WFFC claims, several volumes
+            # each -- attach limits + first-consumer binding churn with
+            # everything else
+            from karpenter_tpu.apis.storage import PersistentVolumeClaim
+
+            n = int(rng.integers(2, 8))
+            vols = int(rng.integers(1, 5))
+            for _ in range(n):
+                claims = []
+                for v in range(vols):
+                    cname = f"data-{seed}-{pod_seq}-{v}"
+                    op.cluster.create(PersistentVolumeClaim(cname))
+                    claims.append(cname)
+                cpu, mem = sizes[int(rng.integers(0, len(sizes)))]
+                op.cluster.create(
+                    Pod(
+                        f"soak-{seed}-{pod_seq}",
+                        requests=Resources({"cpu": cpu, "memory": mem}),
+                        volume_claims=tuple(claims),
+                    )
                 )
                 pod_seq += 1
         elif event == "shrink":
@@ -127,3 +166,9 @@ def test_soak_mixed_event_stream(seed):
     for inst in op.cloud.describe_instances():
         if inst.state == "running":
             assert inst.provider_id in claimed, f"orphan instance {inst.id}"
+    # no orphaned CSINodes past the lifecycle sweep
+    from karpenter_tpu.apis.storage import CSINode
+
+    node_names = {n.metadata.name for n in op.cluster.list(Node)}
+    for c in op.cluster.list(CSINode):
+        assert c.metadata.name in node_names, f"orphan CSINode {c.metadata.name}"
