@@ -418,6 +418,44 @@ class TestOperatorOverFakeApiserver:
             cl.stop()
             srv.stop()
 
+    def test_stateful_flow_over_the_wire(self):
+        """Storage end-to-end on the REAL bus: a WFFC claim binds to the
+        landing zone via the annotation merge-patch (PVC spec untouched),
+        a zone-bound claim pins provisioning, attach usage rides
+        node_usage over HTTP."""
+        from karpenter_tpu.apis import PersistentVolumeClaim, StorageClass
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.scheduling import resources as res
+
+        srv = FakeApiServer().start()
+        try:
+            clock = FakeClock(100_000.0)
+            cl = KubeCluster(KubeClient(KubeConfig(server=srv.url)), clock=clock)
+            op = Operator(cluster=cl, clock=clock)
+            op.cluster.create(TPUNodeClass("default"))
+            op.cluster.create(NodePool("default"))
+            op.cluster.create(StorageClass("standard"))
+            op.cluster.create(PersistentVolumeClaim("data-0", storage_class_name="standard"))
+            pod = Pod("web-0", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                      volume_claims=("data-0",))
+            op.cluster.create(pod)
+            op.settle(max_ticks=40)
+            bound = op.cluster.get(Pod, "web-0")
+            assert bound.node_name, "stateful pod must schedule over the real bus"
+            node = next(n for n in op.cluster.list(Node) if n.metadata.name == bound.node_name)
+            claim = op.cluster.get(PersistentVolumeClaim, "data-0")
+            assert claim.bound_zone == node.zone
+            # the zone write went through the annotation merge-patch: the
+            # server-side spec is untouched and still apiserver-valid
+            raw = cl.client.get("/api/v1/namespaces/default/persistentvolumeclaims/data-0")
+            assert raw["spec"]["accessModes"], "spec must survive the zone write"
+            assert raw["metadata"]["annotations"]["storage.karpenter.tpu/bound-zone"] == node.zone
+            assert op.cluster.node_usage(bound.node_name).get(res.ATTACHABLE_VOLUMES) == 1.0
+        finally:
+            cl.stop()
+            srv.stop()
+
 
 # -- live apiserver smoke ----------------------------------------------------
 

@@ -280,6 +280,38 @@ class TestStorageE2E:
         # 50 attachments exceed any single type's budget (max 39)
         assert len(env.cluster.list(Node)) >= 2
 
+    def test_drift_replacement_stays_in_volume_zone(self, env):
+        """Full disruption-controller flow: the node hosting a zone-bound
+        volume pod drifts; the replacement simulation re-resolves the
+        claim, so the pod's new capacity lands in the SAME zone."""
+        from karpenter_tpu.providers.instancetype import gen_catalog
+
+        zone = gen_catalog.ZONE_NAMES[2]
+        env.cluster.create(PersistentVolumeClaim("data-0", bound_zone=zone))
+        pod = mk_pod("web-0", claims=("data-0",))
+        env.cluster.create(pod)
+        env.settle()
+        assert pod.node_name
+        # drift the nodeclass
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.user_data = "#!/bin/bash\necho changed"
+        env.cluster.update(nc)
+        env.nodeclass_controller.reconcile_all()
+        env.clock.step(6 * 60.0)
+        decisions = env.disruption.reconcile()
+        assert decisions and decisions[0][1] == "Drifted"
+        # drain + resettle: the pod rebinds in the volume's zone
+        for _ in range(12):
+            env.termination.reconcile_all()
+            env.tick()
+            env.clock.step(3.0)
+            if pod.node_name and not pod.pending:
+                break
+        env.settle()
+        assert pod.node_name, "pod must reschedule after drift"
+        node = next(n for n in env.cluster.list(Node) if n.metadata.name == pod.node_name)
+        assert node.zone == zone, f"replacement in {node.zone}, volume in {zone}"
+
     def test_zonal_volume_keeps_consolidation_in_zone(self, env):
         """A pod whose volume is bound to one zone cannot be simulated onto
         capacity pinned to another: the rescheduling simulation must fail,
