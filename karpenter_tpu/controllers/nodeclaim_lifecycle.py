@@ -1,0 +1,89 @@
+"""Standalone NodeClaim launcher: claims are a launch API, not just a
+provisioner artifact.
+
+The reference core's nodeclaim lifecycle controller launches ANY pending
+NodeClaim resource -- users create claims directly for static capacity
+(a claim with its own requirements + nodeclass ref, no NodePool
+involved), and the same machinery drives registration/initialization
+afterwards. In this framework the provisioner launches the claims IT
+creates synchronously inside its own reconcile, so any claim that is
+still unlaunched when this controller runs is a standalone one (or a
+leftover the provisioner chose to delete -- it never leaves unlaunched
+claims behind). Launching reuses the exact provider path
+(CloudProvider.create resolves everything from the claim itself) under
+the SAME launch_window + worker-pool rendezvous the provisioner uses, so
+static capacity gets real fleet batching, ICE handling, and the kwok
+lifecycle's registration flow.
+
+Failures stay level-triggered: a claim whose nodeclass is not ready or
+whose capacity is unavailable retries next tick, with a Warning event
+(deduped by the recorder window) instead of silent stalling.
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+from karpenter_tpu.apis import NodeClaim, labels as wk
+from karpenter_tpu.apis.nodeclass import HASH_ANNOTATION, HASH_VERSION, HASH_VERSION_ANNOTATION, TPUNodeClass
+from karpenter_tpu import metrics
+from karpenter_tpu.errors import CloudError
+from karpenter_tpu.logging import get_logger
+
+
+class NodeClaimLifecycleController:
+    log = get_logger("nodeclaim.lifecycle")
+
+    # same fan-out as the provisioner's launch wave (SURVEY §2.4 row 1)
+    MAX_CONCURRENT_LAUNCHES = 10
+
+    def __init__(self, cluster, cloud_provider, recorder=None):
+        self.cluster = cluster
+        self.cloud_provider = cloud_provider
+        self.recorder = recorder
+
+    def reconcile_all(self) -> int:
+        pending = [
+            c for c in self.cluster.list(NodeClaim)
+            if not c.launched() and not c.deleting
+        ]
+        if not pending:
+            return 0
+
+        def launch_one(claim):
+            try:
+                self.cloud_provider.create(claim)
+                return None
+            except CloudError as e:
+                return e
+
+        if len(pending) == 1:
+            outcomes = [launch_one(pending[0])]
+        else:
+            expected = min(len(pending), self.MAX_CONCURRENT_LAUNCHES)
+            with self.cloud_provider.launch_window(expected):
+                with ThreadPoolExecutor(max_workers=self.MAX_CONCURRENT_LAUNCHES) as pool:
+                    outcomes = list(pool.map(launch_one, pending))
+        launched = 0
+        for claim, err in zip(pending, outcomes):
+            if err is not None:
+                if self.recorder is not None:
+                    self.recorder.publish(claim, "LaunchFailed", str(err), type="Warning")
+                continue
+            # stamp the nodeclass static hash so drift detection covers
+            # static capacity exactly as it covers provisioned capacity
+            # (the provisioner stamps the same pair in _to_nodeclaim)
+            nodeclass = self.cluster.try_get(TPUNodeClass, claim.node_class_ref.name)
+            if nodeclass is not None and HASH_ANNOTATION not in claim.metadata.annotations:
+                claim.metadata.annotations[HASH_ANNOTATION] = nodeclass.static_hash()
+                claim.metadata.annotations[HASH_VERSION_ANNOTATION] = HASH_VERSION
+            self.cluster.update(claim)
+            launched += 1
+            metrics.NODECLAIMS_CREATED.inc(
+                nodepool=claim.metadata.labels.get(wk.NODEPOOL_LABEL, "<standalone>")
+            )
+            self.log.info(
+                "launched standalone nodeclaim",
+                nodeclaim=claim.metadata.name,
+                provider_id=claim.provider_id,
+            )
+        return launched

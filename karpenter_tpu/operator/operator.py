@@ -16,6 +16,7 @@ from karpenter_tpu.cloudprovider import CloudProvider
 from karpenter_tpu.controllers.disruption import DisruptionController
 from karpenter_tpu.controllers.garbagecollection import GarbageCollectionController
 from karpenter_tpu.controllers.interruption import InterruptionController
+from karpenter_tpu.controllers.nodeclaim_lifecycle import NodeClaimLifecycleController
 from karpenter_tpu.controllers.nodeclass import NodeClassController
 from karpenter_tpu.batcher.batcher import BatchOptions
 from karpenter_tpu.batcher.cloud import CloudBatchers
@@ -144,6 +145,9 @@ class Operator:
         self.provisioner = Provisioner(
             self.cluster, self.cloud_provider, solver=solver, recorder=self.recorder
         )
+        self.nodeclaim_lifecycle = NodeClaimLifecycleController(
+            self.cluster, self.cloud_provider, recorder=self.recorder
+        )
         self.binder = PodBinder(self.cluster)
         self.lifecycle = NodeLifecycle(self.cluster, self.cloud)
         self.termination = TerminationController(self.cluster, self.cloud_provider)
@@ -203,6 +207,7 @@ class Operator:
         self.interruption.reconcile()
         self.repair.reconcile()
         self.provisioner.reconcile()
+        self.nodeclaim_lifecycle.reconcile_all()
         self.lifecycle.step()
         self.binder.reconcile()
         self.tagging.reconcile_all()

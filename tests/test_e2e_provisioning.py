@@ -243,6 +243,55 @@ class TestTopologyAndAffinity:
         assert len(node_names) == 3  # pairwise separation
 
 
+class TestStandaloneNodeClaim:
+    """Claims are a launch API, not just a provisioner artifact: a
+    user-created NodeClaim (static capacity, no NodePool) launches,
+    registers, and serves pods -- the core's nodeclaim lifecycle
+    (controllers/nodeclaim_lifecycle.py)."""
+
+    def _claim(self, name="static-0"):
+        from karpenter_tpu.apis.nodepool import NodeClassRef
+
+        return NodeClaim(
+            name,
+            requirements=[
+                Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"]),
+                Requirement(wk.LABEL_INSTANCE_CATEGORY, Op.IN, ["c"]),
+            ],
+            node_class_ref=NodeClassRef(name="default"),
+        )
+
+    def test_standalone_claim_launches_and_serves_pods(self, env):
+        env.tick()  # resolve the nodeclass first
+        env.cluster.create(self._claim())
+        for _ in range(10):  # settle() exits on no-pending-pods; tick past
+            env.tick()       # the registration/initialization delays
+            env.clock.step(5.0)
+        claim = env.cluster.get(NodeClaim, "static-0")
+        assert claim.launched() and claim.registered()
+        nodes = env.cluster.list(Node)
+        assert len(nodes) == 1 and nodes[0].metadata.labels[wk.ARCH_LABEL] == "amd64"
+        # the static capacity serves a pending pod without provisioning more
+        pod = make_pods(1)[0]
+        env.cluster.create(pod)
+        env.settle()
+        assert pod.node_name == nodes[0].metadata.name
+        assert len(env.cluster.list(Node)) == 1
+
+    def test_unready_nodeclass_retries_with_event(self, env):
+        # claim created BEFORE the nodeclass resolves: LaunchFailed event,
+        # level-triggered retry succeeds once status lands
+        env.cluster.create(self._claim("static-1"))
+        env.provisioner.reconcile()  # no nodeclass status yet
+        env.nodeclaim_lifecycle.reconcile_all()
+        evs = env.recorder.with_reason("LaunchFailed")
+        assert evs and evs[0].name == "static-1"
+        for _ in range(6):
+            env.tick()
+            env.clock.step(5.0)
+        assert env.cluster.get(NodeClaim, "static-1").launched()
+
+
 class TestNodeClassLifecycle:
     def test_nodeclass_resolves_status(self, env):
         env.tick()
