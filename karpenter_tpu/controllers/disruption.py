@@ -62,7 +62,10 @@ REASON_UNDERUTILIZED = "Underutilized"
 class Candidate:
     claim: NodeClaim
     node: Node
-    nodepool: NodePool
+    # None for a STANDALONE claim (no NodePool): eligible for the
+    # claim-level reasons (expiration, drift) but not the pool-policy
+    # reasons (emptiness, consolidation), as in the core
+    nodepool: Optional[NodePool]
     pods: List[Pod]
     price: float
     disruption_cost: float
@@ -146,8 +149,8 @@ class DisruptionController:
             )
             pool_name = claim.nodepool_name
             pool = self.cluster.try_get(NodePool, pool_name) if pool_name else None
-            if pool is None:
-                continue
+            if pool_name and pool is None:
+                continue  # pool-owned claim whose pool is mid-delete
             pods = self.cluster.pods_on_node(node.metadata.name)
             out.append(
                 Candidate(
@@ -162,7 +165,9 @@ class DisruptionController:
             )
         return out
 
-    def _budget_allows(self, pool: NodePool, reason: str, disrupting: Dict[str, int], totals: Dict[str, int]) -> bool:
+    def _budget_allows(self, pool: Optional[NodePool], reason: str, disrupting: Dict[str, int], totals: Dict[str, int]) -> bool:
+        if pool is None:
+            return True  # standalone claims carry no pool budgets
         total = totals.get(pool.name, 0)
         current = disrupting.get(pool.name, 0)
         now = self.cluster.clock.now()
@@ -412,6 +417,7 @@ class DisruptionController:
                 for c in candidates
                 if not c.do_not_disrupt
                 and c.claim.metadata.name not in [n for n, _ in self.last_decisions]
+                and c.nodepool is not None  # pool-policy reasons only
                 and now - c.claim.metadata.creation_timestamp
                 >= max(MIN_NODE_LIFETIME, c.nodepool.disruption.consolidate_after)
             ),
@@ -648,6 +654,14 @@ class DisruptionController:
         return price < c.price
 
     def _drift_reason(self, c: Candidate) -> Optional[str]:
+        if c.nodepool is None:
+            # standalone claim: only the cloud-side drift kinds apply
+            # (incl. the nodeclass static hash the lifecycle controller
+            # stamps); there is no pool to drift against
+            try:
+                return self.cloud_provider.is_drifted(c.claim)
+            except CloudError:
+                return None
         # nodepool static drift via stamped hash
         pool_hash = c.claim.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION)
         if pool_hash is not None and pool_hash != c.nodepool.static_hash():
@@ -758,7 +772,8 @@ class DisruptionController:
 
         self.cluster.delete(NodeClaim, c.claim.metadata.name)
         self._pass_disrupted.append(c.node.metadata.name)
-        disrupting[c.nodepool.name] = disrupting.get(c.nodepool.name, 0) + 1
+        pool_name = c.nodepool.name if c.nodepool is not None else "<standalone>"
+        disrupting[pool_name] = disrupting.get(pool_name, 0) + 1
         self.last_decisions.append((c.claim.metadata.name, reason))
         metrics.DISRUPTION_DECISIONS.inc(reason=reason)
         if self.recorder is not None:
@@ -771,7 +786,7 @@ class DisruptionController:
         self.log.info(
             "disrupting node",
             nodeclaim=c.claim.metadata.name,
-            nodepool=c.nodepool.name,
+            nodepool=pool_name,
             reason=reason,
             pods=len(c.pods),
         )

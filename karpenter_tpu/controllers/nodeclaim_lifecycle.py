@@ -21,12 +21,9 @@ whose capacity is unavailable retries next tick, with a Warning event
 """
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
-
 from karpenter_tpu.apis import NodeClaim, labels as wk
 from karpenter_tpu.apis.nodeclass import HASH_ANNOTATION, HASH_VERSION, HASH_VERSION_ANNOTATION, TPUNodeClass
 from karpenter_tpu import metrics
-from karpenter_tpu.errors import CloudError
 from karpenter_tpu.logging import get_logger
 
 
@@ -42,27 +39,15 @@ class NodeClaimLifecycleController:
         self.recorder = recorder
 
     def reconcile_all(self) -> int:
+        from karpenter_tpu.controllers.provisioner import launch_all
+
         pending = [
             c for c in self.cluster.list(NodeClaim)
             if not c.launched() and not c.deleting
         ]
         if not pending:
             return 0
-
-        def launch_one(claim):
-            try:
-                self.cloud_provider.create(claim)
-                return None
-            except CloudError as e:
-                return e
-
-        if len(pending) == 1:
-            outcomes = [launch_one(pending[0])]
-        else:
-            expected = min(len(pending), self.MAX_CONCURRENT_LAUNCHES)
-            with self.cloud_provider.launch_window(expected):
-                with ThreadPoolExecutor(max_workers=self.MAX_CONCURRENT_LAUNCHES) as pool:
-                    outcomes = list(pool.map(launch_one, pending))
+        outcomes = launch_all(self.cloud_provider, pending, self.MAX_CONCURRENT_LAUNCHES)
         launched = 0
         for claim, err in zip(pending, outcomes):
             if err is not None:

@@ -28,6 +28,30 @@ from karpenter_tpu.solver.oracle import ExistingNode, NewNodeGroup, Scheduler, S
 MAX_TYPES_PER_CLAIM = 60  # mirror of the launch truncation for claim size
 
 
+def launch_all(cloud_provider, claims, max_workers: int):
+    """Shared cloud-launch fan-out: returns one outcome (None | CloudError)
+    per claim, in order. The launch-window expectation announces the wave
+    size to the fleet batcher so identical requests rendezvous into one
+    merged fleet call; it is capped at the worker-pool size because only
+    that many calls can be in flight at once, and an expectation the pool
+    cannot satisfy would stall every wave on the batcher's idle timeout
+    (pkg/batcher/createfleet.go:36-46). Used by the provisioner AND the
+    standalone nodeclaim lifecycle -- one copy of the protocol."""
+    def launch_one(claim):
+        try:
+            cloud_provider.create(claim)
+            return None
+        except CloudError as e:
+            return e
+
+    if len(claims) == 1:
+        return [launch_one(claims[0])]
+    expected = min(len(claims), max_workers)
+    with cloud_provider.launch_window(expected):
+        with ThreadPoolExecutor(max_workers=max_workers) as pool:
+            return list(pool.map(launch_one, claims))
+
+
 class _PodRef:
     """Event-target shim: unschedulable reasons are keyed by pod NAME in
     SchedulingResult (the pod object may be an effective volume copy)."""
@@ -201,23 +225,9 @@ class Provisioner:
             claim = self._to_nodeclaim(group)
             self.cluster.create(claim)
             claims.append(claim)
-
-        def launch_one(claim):
-            # cloud call only -- cluster mutations stay on the caller thread
-            self.cloud_provider.create(claim)
-
-        if len(claims) == 1:
-            outcomes = [self._try_launch(launch_one, claims[0])]
-        else:
-            # the launch fan-out announces its size to the fleet batcher so
-            # identical requests rendezvous into one merged fleet call; the
-            # expectation is capped at the worker-pool size -- only that many
-            # calls can be in flight at once, and an expectation the pool
-            # cannot satisfy would stall every wave on the idle timeout
-            expected = min(len(claims), self.MAX_CONCURRENT_LAUNCHES)
-            with self.cloud_provider.launch_window(expected):
-                with ThreadPoolExecutor(max_workers=self.MAX_CONCURRENT_LAUNCHES) as pool:
-                    outcomes = list(pool.map(lambda c: self._try_launch(launch_one, c), claims))
+        # cloud calls fan out via the shared protocol (launch_all above);
+        # cluster mutations stay on this thread
+        outcomes = launch_all(self.cloud_provider, claims, self.MAX_CONCURRENT_LAUNCHES)
         for group, claim, err in zip(groups, claims, outcomes):
             if err is None:
                 self.cluster.update(claim)
@@ -229,14 +239,6 @@ class Provisioner:
                     result.unschedulable[pod.metadata.name] = str(err)
                 claim.metadata.finalizers = []
                 self.cluster.delete(NodeClaim, claim.metadata.name)
-
-    @staticmethod
-    def _try_launch(fn, claim):
-        try:
-            fn(claim)
-            return None
-        except CloudError as e:
-            return e
 
     def _to_nodeclaim(self, group: NewNodeGroup) -> NodeClaim:
         pool = group.nodepool

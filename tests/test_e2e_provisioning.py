@@ -291,6 +291,36 @@ class TestStandaloneNodeClaim:
             env.clock.step(5.0)
         assert env.cluster.get(NodeClaim, "static-1").launched()
 
+    def test_standalone_claim_expires(self, env):
+        env.tick()
+        claim = self._claim("static-2")
+        claim.expire_after = 600.0
+        env.cluster.create(claim)
+        for _ in range(10):
+            env.tick()
+            env.clock.step(5.0)
+        assert env.cluster.get(NodeClaim, "static-2").registered()
+        env.clock.step(700.0)
+        decisions = env.disruption.reconcile()
+        assert ("static-2", "Expired") in decisions
+
+    def test_standalone_claim_drifts_on_nodeclass_change(self, env):
+        """The lifecycle controller stamps the nodeclass static hash at
+        launch, so static capacity drifts when the nodeclass changes --
+        the same coverage pool-owned claims get."""
+        env.tick()
+        env.cluster.create(self._claim("static-3"))
+        for _ in range(10):
+            env.tick()
+            env.clock.step(5.0)
+        nc = env.cluster.get(TPUNodeClass, "default")
+        nc.user_data = "#!/bin/bash\necho changed"
+        env.cluster.update(nc)
+        env.nodeclass_controller.reconcile_all()
+        env.clock.step(6 * 60.0)
+        decisions = env.disruption.reconcile()
+        assert ("static-3", "Drifted") in decisions
+
 
 class TestNodeClassLifecycle:
     def test_nodeclass_resolves_status(self, env):
