@@ -100,6 +100,25 @@ def build_merged(
     return merged, originals, np.array(col_pools, dtype=np.int32)
 
 
+def first_compat_pool(pc, pools: Sequence[NodePool]) -> int:
+    """Index of the first (highest-weight) pool whose requirements are
+    compatible with the class, or -1. TOLERATION IS NOT CONSIDERED: this
+    mirrors the oracle's `_zone_choice` pool selection exactly (it derives
+    spread domains from the first requirements-compatible pool's catalog,
+    oracle.py), which is where this helper is used -- spread-domain
+    restriction on the merged path must diverge from the oracle in
+    neither direction, including for pods that do not tolerate their
+    first-compatible pool."""
+    from karpenter_tpu.solver.oracle import _ALLOW_UNDEFINED
+
+    for pi, pool in enumerate(pools):
+        if pool.requirements().compatible(
+            pc.requirements, allow_undefined=_ALLOW_UNDEFINED
+        ):
+            return pi
+    return -1
+
+
 def admitted_pools(pc, pools: Sequence[NodePool]) -> List[int]:
     """Pool indices (weight order) whose OPEN-admission gate the class
     passes: the oracle's _open_group checks pool-reqs compatibility under

@@ -225,7 +225,8 @@ class TPUSolver:
 
     # -- routing ------------------------------------------------------------
     @staticmethod
-    def supports(scheduler: Scheduler, pods: Sequence[Pod], classes=None) -> bool:
+    def supports(scheduler: Scheduler, pods: Sequence[Pod], classes=None,
+                 overlap: Optional[bool] = None) -> bool:
         from karpenter_tpu.solver import spread
 
         # routing features live on the classes: spread constraints are part
@@ -290,16 +291,28 @@ class TPUSolver:
         if any_spread:
             # hostname spread and multi-constraint pods take the oracle;
             # zone spread (incl. existing nodes: counts seed from the
-            # scheduler's topology state) stays on device. Spread + several
-            # pools would need cross-pool count carry -- oracle. Spread
-            # mixed with other zone-narrowing classes STAYS on device with
-            # an accepted deviation: which mixed group a spread pod shares
+            # scheduler's topology state) stays on device. Spread mixed
+            # with other zone-narrowing classes STAYS on device with an
+            # accepted deviation: which mixed group a spread pod shares
             # with plain pods (and hence total group count, by one in
             # either direction) can differ from the sequential oracle,
             # while unschedulable sets, plain-class packing, and
             # per-(selector, zone) distributions stay identical -- the
             # contract solver/spread.py documents and the fuzz enforces.
-            if not spread.spread_eligible(reps) or len(scheduler.nodepools) > 1:
+            if not spread.spread_eligible(reps):
+                return False
+            if overlap is None:
+                overlap = len(scheduler.nodepools) > 1 and TPUSolver._pools_overlap(
+                    scheduler.nodepools, pods, classes=classes
+                )
+            if len(scheduler.nodepools) > 1 and not overlap:
+                # DISJOINT multi-pool spread would need cross-pool count
+                # carry on the pool-sequential path -- oracle. OVERLAPPING
+                # pools take the merged-catalog solve (round 4, second
+                # pass), whose single joint catalog gives the spread split
+                # one zone/count view across every pool -- the cross-pool
+                # carry falls out of the merge, under the same deviation
+                # contract as single-pool mixed spread.
                 return False
         return True
 
@@ -407,7 +420,9 @@ class TPUSolver:
         # the first pool's solve; per-pool requirement merges are ~60 cheap
         # class-level copies (encode.with_extra_requirements)
         base_classes = encode.group_pods(pods)
-        if not self.supports(scheduler, pods, classes=base_classes):
+        pools = scheduler.nodepools
+        overlap = len(pools) > 1 and self._pools_overlap(pools, pods, classes=base_classes)
+        if not self.supports(scheduler, pods, classes=base_classes, overlap=overlap):
             # the fallback must pack with THIS solver's objective -- callers
             # construct the Scheduler without one, and a mixed-objective
             # pass would break device/oracle differential equivalence
@@ -420,8 +435,7 @@ class TPUSolver:
         # oracle's per-pod pool iteration collapses to this because every
         # pod of a class routes identically; existing capacity is
         # pool-agnostic and packed in the first round only)
-        pools = scheduler.nodepools
-        if len(pools) > 1 and self._pools_overlap(pools, pods, classes=base_classes):
+        if overlap:
             # a class compatible with SEVERAL pools can join another
             # class's open group across the pool boundary in the oracle's
             # first-fit order (in-flight capacity beats weight preference,
@@ -564,11 +578,11 @@ class TPUSolver:
     def _try_solve_merged(self, scheduler, pods, base_classes):
         """Overlapping-compat multi-pool batch on device via the merged
         catalog, or None when a carve-out applies (the caller falls back
-        to the oracle). Carve-outs: pool limits, minValues pools. Spread
-        classes never reach here (supports() routes multi-pool spread to
-        the oracle first). Per-pool daemonset overhead bakes into the
-        merged columns' allocatable; per-pool taints gate joins through
-        SolveInputs.join_allowed -- neither routes to the oracle."""
+        to the oracle). Carve-outs: pool limits, minValues pools. Per-pool
+        daemonset overhead bakes into the merged columns' allocatable;
+        per-pool taints gate joins through SolveInputs.join_allowed; zone
+        SPREAD classes ride the split pass against the joint catalog
+        (seeded) -- none of those route to the oracle."""
         from karpenter_tpu.solver import multipool
 
         pools = scheduler.nodepools  # weight-descending (oracle order)
@@ -646,6 +660,11 @@ class TPUSolver:
             virtual, merged_items, list(pods),
             existing_nodes=scheduler.existing,
             zones=sorted(scheduler.zones),
+            # zone-spread classes run through the SAME split pass as the
+            # single-pool path, against the joint merged catalog (one
+            # zone/count view across pools = the cross-pool count carry);
+            # live-pod counts seed exactly as there
+            spread_seeds=self._spread_seeds(scheduler),
             classes=classes,
         )
         result.new_groups.extend(res_solve.new_groups)
@@ -720,12 +739,37 @@ class TPUSolver:
             or spread_mod.soft_zone_tsc(pc.pods[0]) is not None
             for pc in classes
         ):
-            catalog0 = self._catalog(instance_types).tensors
+            entry0 = self._catalog(instance_types)
+            catalog0 = entry0.tensors
             pre_set = encode.encode_classes(
                 classes, catalog0, pool_taints=list(pool.template.taints),
                 c_pad=_bucket(len(classes), self.c_pad_min),
             )
             compat = encode.compat_matrix(catalog0, pre_set)[: len(classes)]
+            if entry0.col_pools is not None:
+                # merged multi-pool: the oracle derives a spread pod's
+                # zone DOMAINS from its FIRST requirements-compatible
+                # pool's catalog only (oracle._zone_choice; toleration
+                # deliberately not consulted there). Restricting each
+                # spread class's columns to that pool before the split
+                # keeps domains identical -- the joint catalog would
+                # otherwise admit zones only other pools cover (or only a
+                # non-tolerated pool covers), shifting distributions or
+                # stranding pinned pods relative to the oracle.
+                from karpenter_tpu.solver import multipool
+
+                k_real0 = entry0.col_pools.shape[0]
+                for c, pc in enumerate(classes):
+                    if (
+                        spread_mod.hard_zone_tsc(pc.pods[0]) is None
+                        and spread_mod.soft_zone_tsc(pc.pods[0]) is None
+                    ):
+                        continue
+                    pi = multipool.first_compat_pool(pc, entry0.pools)
+                    colmask = np.zeros((compat.shape[1],), dtype=bool)
+                    if pi >= 0:
+                        colmask[:k_real0] = entry0.col_pools == pi
+                    compat[c] &= colmask
             cap0 = catalog0.cap
             if overhead_vec is not None:
                 cap0 = np.maximum(cap0 - overhead_vec[None, :], np.float32(0.0))

@@ -45,10 +45,32 @@ def mk_pools(arm_weight=10, amd_weight=1):
     return arm, amd
 
 
+def zone_filtered(items, zones_subset):
+    """Pool-specific catalog: the same types with offerings restricted to a
+    zone subset (models per-pool subnet/zone coverage differences)."""
+    from karpenter_tpu.providers.instancetype.types import InstanceType
+
+    out = []
+    for it in items:
+        offerings = [o for o in it.offerings if o.zone in zones_subset]
+        if any(o.available for o in offerings):
+            out.append(
+                InstanceType(
+                    name=it.name, requirements=it.requirements,
+                    capacity=it.capacity, overhead=it.overhead,
+                    offerings=offerings, info=it.info,
+                )
+            )
+    return out
+
+
 def run_both(items, pods, pools, device_must_hold=False, monkeypatch=None,
-             daemon_overhead=None):
-    zones = {o.zone for it in items for o in it.available_offerings()}
-    catalogs = {p.name: items for p in pools}
+             daemon_overhead=None, catalogs=None):
+    if catalogs is None:
+        catalogs = {p.name: items for p in pools}
+    zones = {
+        o.zone for cat in catalogs.values() for it in cat for o in it.available_offerings()
+    }
 
     def mk():
         return Scheduler(nodepools=list(pools), instance_types=catalogs, zones=zones,
@@ -336,6 +358,8 @@ class TestMergedMultiPool:
                 ),
             }
         pods = []
+        use_spread = rng.random() < 0.35
+        has_spread = False
         for t in range(int(rng.integers(2, 7))):
             cpu_m = int(rng.choice([250, 500, 1000, 2000, 3000]))
             mem_mi = int(rng.choice([512, 1024, 2048, 4096]))
@@ -354,22 +378,226 @@ class TestMergedMultiPool:
                 tolerations.append(Toleration(key="dedicated", operator="Exists"))
                 if rng.random() < 0.5:
                     tolerations.append(Toleration(key="team", operator="Exists"))
+            spread = []
+            if use_spread and rng.random() < 0.4 and not selector:
+                # zone spread on the merged path (round 4, second pass):
+                # the deviation contract replaces exact signatures below
+                from karpenter_tpu.apis.pod import TopologySpreadConstraint
+
+                has_spread = True
+                spread = [
+                    TopologySpreadConstraint(
+                        max_skew=int(rng.choice([1, 2])),
+                        topology_key=wk.ZONE_LABEL,
+                        label_selector={"app": f"w{t}"},
+                        when_unsatisfiable=(
+                            "ScheduleAnyway" if rng.random() < 0.3 else "DoNotSchedule"
+                        ),
+                    )
+                ]
             for i in range(int(rng.integers(1, 6))):
                 pods.append(
                     Pod(
-                        f"f{seed}-{t}-{i}",
+                        f"w{t}-f{seed}-{i}",
                         requests=Resources.from_base_units(
                             {"cpu": float(cpu_m), "memory": float(mem_mi) * 2**20}
                         ),
                         node_selector=selector,
                         tolerations=tolerations,
+                        labels={"app": f"w{t}"},
+                        topology_spread=spread,
                     )
                 )
+        catalogs = None
+        if rng.random() < 0.3:
+            # per-pool zone coverage differences: spread domains must
+            # follow each class's first requirements-compatible pool's
+            # catalog, not the joint one (round-4 review)
+            from karpenter_tpu.providers.instancetype import gen_catalog
+
+            n_zones = int(rng.integers(2, 4))
+            subset = set(rng.choice(gen_catalog.ZONE_NAMES, size=n_zones, replace=False))
+            narrow = "arm" if rng.random() < 0.5 else "amd"
+            catalogs = {
+                "arm": zone_filtered(catalog_items, subset) if narrow == "arm" else catalog_items,
+                "amd": zone_filtered(catalog_items, subset) if narrow == "amd" else catalog_items,
+            }
         oracle, device = run_both(
-            catalog_items, pods, pools, daemon_overhead=daemon_overhead
+            catalog_items, pods, pools, daemon_overhead=daemon_overhead,
+            catalogs=catalogs,
         )
         assert set(oracle.unschedulable) == set(device.unschedulable), f"seed {seed}"
-        assert by_pool_signature(oracle) == by_pool_signature(device), f"seed {seed}"
+        if not has_spread:
+            assert by_pool_signature(oracle) == by_pool_signature(device), f"seed {seed}"
+        else:
+            # the single-pool spread deviation contract, on the merged
+            # path: distributions + plain-class packing exact, group
+            # count within one per spread selector
+            assert spread_zone_distribution(oracle) == spread_zone_distribution(device), f"seed {seed}"
+            o_plain = sorted(
+                tuple(sorted(p.metadata.name for p in g.pods if not p.topology_spread))
+                for g in oracle.new_groups
+            )
+            d_plain = sorted(
+                tuple(sorted(p.metadata.name for p in g.pods if not p.topology_spread))
+                for g in device.new_groups
+            )
+            assert o_plain == d_plain, f"seed {seed}: plain packing diverged"
+            n_sel = len({
+                tuple(sorted(t.label_selector.items()))
+                for p in pods for t in p.topology_spread
+            })
+            assert abs(len(oracle.new_groups) - len(device.new_groups)) <= max(1, n_sel), f"seed {seed}"
+
+
+def spread_zone_distribution(result):
+    """(selector, zone set) -> spread-pod count: the exact quantity
+    topology spread constrains (the single-pool fuzz's contract helper,
+    test_solver.py)."""
+    from collections import Counter
+
+    from karpenter_tpu.solver.spread import hard_zone_tsc, soft_zone_tsc
+
+    out = Counter()
+    for g in result.new_groups:
+        zreq = g.requirements.get(wk.ZONE_LABEL)
+        zone = (
+            tuple(sorted(zreq.values))
+            if zreq is not None and not zreq.complement
+            else ("any",)
+        )
+        for p in g.pods:
+            if hard_zone_tsc(p) is not None or soft_zone_tsc(p) is not None:
+                out[(p.metadata.name.split("-")[0], zone)] += 1
+    return out
+
+
+class TestMergedMultiPoolSpread:
+    """Round 4 (second pass): zone topology spread on the merged multi-pool
+    device path. The joint catalog gives the spread split ONE zone/count
+    view across pools -- the cross-pool count carry. Same deviation
+    contract as single-pool mixed spread: unschedulable sets, plain-class
+    packing, and per-(selector, zone) distributions are EXACT; which mixed
+    group a spread pod shares (and the group count by a bounded amount)
+    may differ from the sequential oracle."""
+
+    def _contract(self, oracle, device, bound=1):
+        assert set(oracle.unschedulable) == set(device.unschedulable)
+        assert spread_zone_distribution(oracle) == spread_zone_distribution(device)
+        o_plain = sorted(
+            tuple(sorted(p.metadata.name for p in g.pods if not p.topology_spread))
+            for g in oracle.new_groups
+        )
+        d_plain = sorted(
+            tuple(sorted(p.metadata.name for p in g.pods if not p.topology_spread))
+            for g in device.new_groups
+        )
+        assert o_plain == d_plain, "plain-class packing must stay exact"
+        assert abs(len(oracle.new_groups) - len(device.new_groups)) <= bound
+
+    def test_spread_balances_zones_on_merged_path(self, catalog_items, monkeypatch):
+        from karpenter_tpu.apis.pod import TopologySpreadConstraint
+
+        pools = mk_pools(arm_weight=10, amd_weight=1)
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"}
+        )
+        pods = [
+            Pod(f"web-{i}", requests=Resources({"cpu": "3", "memory": "6Gi"}),
+                labels={"app": "web"}, topology_spread=[tsc])
+            for i in range(7)
+        ] + [small(f"plain-{i}") for i in range(5)]
+        oracle, device = run_both(
+            catalog_items, pods, pools, device_must_hold=True, monkeypatch=monkeypatch
+        )
+        self._contract(oracle, device)
+        # the distribution is genuinely balanced (max skew 1 over 4 zones)
+        sizes = sorted(n for _, n in spread_zone_distribution(device).items())
+        assert max(sizes) - min(sizes) <= 1
+
+    def test_spread_with_pool_pinned_mix(self, catalog_items, monkeypatch):
+        """Spread pods overlap both pools while pinned pods anchor groups
+        in the LOW-weight pool: the joint split must still balance zones
+        while cross-pool joins happen."""
+        from karpenter_tpu.apis.pod import TopologySpreadConstraint
+
+        pools = mk_pools(arm_weight=10, amd_weight=1)
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "db"}
+        )
+        pods = [
+            Pod(f"db-{i}", requests=Resources({"cpu": "2", "memory": "4Gi"}),
+                labels={"app": "db"}, topology_spread=[tsc])
+            for i in range(6)
+        ] + [
+            Pod(f"pin-{i}", requests=Resources({"cpu": "3", "memory": "6Gi"}),
+                node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(2)
+        ]
+        oracle, device = run_both(
+            catalog_items, pods, pools, device_must_hold=True, monkeypatch=monkeypatch
+        )
+        self._contract(oracle, device)
+
+    def test_domains_follow_first_compat_pool_zone_coverage(self, catalog_items, monkeypatch):
+        """Per-pool catalogs with DIFFERENT zone coverage: the oracle
+        derives spread domains from the first requirements-compatible
+        pool's catalog only (oracle._zone_choice), so a both-compat
+        spread class must distribute over the HIGH-weight pool's two
+        zones -- not the joint catalog's four -- on both paths."""
+        from karpenter_tpu.apis.pod import TopologySpreadConstraint
+        from karpenter_tpu.providers.instancetype import gen_catalog
+
+        pools = mk_pools(arm_weight=10, amd_weight=1)
+        arm_zones = set(gen_catalog.ZONE_NAMES[:2])
+        catalogs = {
+            "arm": zone_filtered(catalog_items, arm_zones),
+            "amd": catalog_items,
+        }
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"}
+        )
+        pods = [
+            Pod(f"web-{i}", requests=Resources({"cpu": "3", "memory": "6Gi"}),
+                labels={"app": "web"}, topology_spread=[tsc])
+            for i in range(6)
+        ]
+        oracle, device = run_both(
+            catalog_items, pods, pools, device_must_hold=True,
+            monkeypatch=monkeypatch, catalogs=catalogs,
+        )
+        self._contract(oracle, device)
+        dist = spread_zone_distribution(device)
+        zones_used = {z for (_, zs) in dist for z in zs}
+        assert zones_used <= arm_zones, (
+            f"domains leaked beyond the first-compat pool: {zones_used}"
+        )
+        assert sorted(dist.values()) == [3, 3]
+
+    def test_disjoint_multi_pool_spread_still_oracle(self, catalog_items):
+        """NON-overlapping pools + spread keep the oracle: the
+        pool-sequential device path has no cross-pool count carry."""
+        from karpenter_tpu.apis.pod import TopologySpreadConstraint
+        from karpenter_tpu.solver.service import TPUSolver
+
+        pools = mk_pools()
+        tsc = TopologySpreadConstraint(
+            max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"}
+        )
+        # every pod pool-pinned -> no overlap
+        pods = [
+            Pod(f"web-{i}", requests=Resources({"cpu": "1", "memory": "1Gi"}),
+                labels={"app": "web"}, topology_spread=[tsc],
+                node_selector={wk.ARCH_LABEL: "arm64"})
+            for i in range(4)
+        ]
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=list(pools),
+            instance_types={p.name: catalog_items for p in pools},
+            zones=zones,
+        )
+        assert not TPUSolver.supports(sched, pods)
 
 
 class TestSteadyStateMultiPool:
