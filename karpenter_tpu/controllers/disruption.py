@@ -108,6 +108,9 @@ class DisruptionController:
         self._pass_catalogs: Optional[Dict[str, list]] = None
         self._pass_pdb_guard = None
         self._pass_daemon_overhead: Optional[Dict[str, Resources]] = None
+        # per-pass claim/class snapshot for volume lowering (built once in
+        # _reconcile; helpers called directly, e.g. from tests, build fresh)
+        self._pass_vol_index = None
         # (budget id, minute) -> bool; bounded, cleared on overflow
         self._budget_active_memo: Dict[tuple, bool] = {}
 
@@ -226,11 +229,16 @@ class DisruptionController:
         return False
 
     # -- simulation ---------------------------------------------------------
-    def _other_nodes(self, excluded: Sequence[str]) -> List[ExistingNode]:
+    def _vol_index(self):
         from karpenter_tpu.apis.storage import VolumeIndex
 
+        if self._pass_vol_index is not None:
+            return self._pass_vol_index
+        return VolumeIndex.from_cluster(self.cluster)
+
+    def _other_nodes(self, excluded: Sequence[str]) -> List[ExistingNode]:
         out = []
-        vol_index = VolumeIndex.from_cluster(self.cluster)
+        vol_index = self._vol_index()
         for node in self.cluster.list(Node):
             if node.metadata.name in excluded or node.deleting or node.unschedulable or not node.ready:
                 continue
@@ -268,7 +276,7 @@ class DisruptionController:
     def _simulate(self, candidates: Sequence[Candidate], allow_new_node: bool):
         """Can every pod on the candidate set reschedule elsewhere (plus at
         most one new node when allow_new_node)? Returns (ok, new_groups)."""
-        from karpenter_tpu.apis.storage import VolumeIndex, effective_pods
+        from karpenter_tpu.apis.storage import effective_pods
 
         excluded = [c.node.metadata.name for c in candidates] + list(self._pass_disrupted)
         pods = self._in_flight_pods() + [
@@ -277,7 +285,7 @@ class DisruptionController:
         # volume-backed pods re-simulate with their attach counts and
         # bound-zone pins (claims are bound by now: the pod ran), so
         # consolidation never plans a move a zonal volume forbids
-        pods, vol_blocked = effective_pods(pods, VolumeIndex.from_cluster(self.cluster))
+        pods, vol_blocked = effective_pods(pods, self._vol_index())
         if vol_blocked:
             return False, []
         nodepools, pass_catalogs = self._pool_context()
@@ -354,8 +362,11 @@ class DisruptionController:
         return pools, catalogs
 
     def _reconcile(self, max_disruptions: int) -> List[Tuple[str, str]]:
+        from karpenter_tpu.apis.storage import VolumeIndex
+
         self.last_decisions = []
         self._pass_disrupted = []
+        self._pass_vol_index = VolumeIndex.from_cluster(self.cluster)
         self._pass_pools, self._pass_catalogs = None, None
         self._pass_pdb_guard = None
         self._pass_daemon_overhead = None
@@ -545,14 +556,22 @@ class DisruptionController:
         loops judge prefixes themselves)."""
         if self.evaluator is None or len(remaining) < 2:
             return None
+        from karpenter_tpu.apis.storage import effective_pods
         from karpenter_tpu.solver.consolidate import device_eligible
 
-        resched = {
-            c.claim.metadata.name: [p for p in c.pods if p.reschedulable()]
-            for c in remaining
-        }
-        in_flight = self._in_flight_pods()
-        if not all(
+        # same volume lowering as _device_verdicts: raw claim-carrying
+        # pods would under-state attach demand in the prefix repacks
+        vol_index = self._vol_index()
+        resched = {}
+        for c in remaining:
+            eff, blocked = effective_pods(
+                [p for p in c.pods if p.reschedulable()], vol_index
+            )
+            if blocked:
+                return None
+            resched[c.claim.metadata.name] = eff
+        in_flight, if_blocked = effective_pods(self._in_flight_pods(), vol_index)
+        if if_blocked or not all(
             device_eligible(resched[c.claim.metadata.name]) for c in remaining
         ) or not device_eligible(in_flight):
             return None
@@ -616,10 +635,18 @@ class DisruptionController:
         from the result and take the oracle path."""
         if self.evaluator is None or not consolidatable:
             return {}
+        from karpenter_tpu.apis.storage import effective_pods
         from karpenter_tpu.solver.consolidate import device_eligible
 
-        in_flight = self._in_flight_pods()
-        if in_flight and not device_eligible(in_flight):
+        # volume-backed pods evaluate as their RESOLVED scheduling copies
+        # (attach counts on the volume axis, bound zones as selector pins
+        # -- apis/storage): the raw objects would under-state demand and
+        # let can_delete overcommit surviving nodes' attach budgets.
+        # Survivor headroom already counts attachments (_other_nodes ->
+        # node_usage), so both sides of the repack see the same axis.
+        vol_index = self._vol_index()
+        in_flight, if_blocked = effective_pods(self._in_flight_pods(), vol_index)
+        if if_blocked or (in_flight and not device_eligible(in_flight)):
             # in-flight pods carry stateful constraints the evaluator does
             # not model; every remaining candidate takes the oracle path
             return {}
@@ -627,8 +654,9 @@ class DisruptionController:
         sets = []
         for c in consolidatable:
             resched = [p for p in c.pods if p.reschedulable()]
-            if not resched or not device_eligible(resched):
-                continue
+            resched, blocked = effective_pods(resched, vol_index)
+            if blocked or not resched or not device_eligible(resched):
+                continue  # unresolvable claims etc.: the oracle path decides
             eligible.append(c)
             # in-flight pods repack jointly with the candidate's: the
             # verdict only says can_delete when BOTH fit the survivors

@@ -185,35 +185,42 @@ class TestTenThousandPodTier:
             )
         solver = TPUSolver(g_max=512)
         solver.solve(pool, items, pods)  # compile + warm caches
-        t0 = time.perf_counter()
-        result = solver.solve(pool, items, pods)
-        warm_s = time.perf_counter() - t0
+        # min-of-3: single-shot wall time on a shared CI host flakes on
+        # transient scheduling bursts (observed >10x spikes mid-suite);
+        # the MINIMUM is robust to noise while keeping the bound tight
+        # enough to catch a 3x decode/solve regression (VERDICT weak #8)
+        warm_s = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            result = solver.solve(pool, items, pods)
+            warm_s = min(warm_s, time.perf_counter() - t0)
         placed = sum(len(g.pods) for g in result.new_groups)
         assert placed + len(result.unschedulable) == 10_000
         assert placed == 10_000, f"{len(result.unschedulable)} unschedulable"
-        # calibrated guard (round 4): measured ~0.07s warm on the dev CPU
-        # host; 0.8s = ~10x headroom for a slower CI host while still
-        # failing on a 3x decode/solve regression (the pre-r4 5s bound
-        # caught only order-of-magnitude breaks, VERDICT weak #8)
-        assert warm_s < 0.8, f"10k-pod warm solve took {warm_s:.2f}s"
-        # cold grouping guard: fresh pods, nothing memoized
-        fresh = []
-        for i in range(10_000):
-            cpu, mem = sizes[int(rng.integers(0, len(sizes)))]
-            fresh.append(
-                Pod(
-                    f"f{i}",
-                    requests=Resources.from_base_units(
-                        {res.CPU: float(cpu), res.MEMORY: float(mem) * 2**20}
-                    ),
+        # calibrated guard (round 4): measured ~0.07s warm on the dev host
+        assert warm_s < 0.8, f"10k-pod warm solve took {warm_s:.2f}s (min of 3)"
+        # cold grouping guard: fresh pods, nothing memoized -- min over 3
+        # INDEPENDENT fresh sets (cold pods cannot repeat, so each round
+        # builds its own), same noise strategy and 3x-regression
+        # calibration as the warm bound (measured ~0.08s)
+        cold_s = float("inf")
+        for r in range(3):
+            fresh = []
+            for i in range(10_000):
+                cpu, mem = sizes[int(rng.integers(0, len(sizes)))]
+                fresh.append(
+                    Pod(
+                        f"f{r}-{i}",
+                        requests=Resources.from_base_units(
+                            {res.CPU: float(cpu), res.MEMORY: float(mem) * 2**20}
+                        ),
+                    )
                 )
-            )
-        t0 = time.perf_counter()
-        result = solver.solve(pool, items, fresh)
-        cold_s = time.perf_counter() - t0
-        assert sum(len(g.pods) for g in result.new_groups) == 10_000
-        # measured ~0.08s cold; same 3x-regression calibration as warm
-        assert cold_s < 1.2, f"10k-pod cold solve took {cold_s:.2f}s"
+            t0 = time.perf_counter()
+            result = solver.solve(pool, items, fresh)
+            cold_s = min(cold_s, time.perf_counter() - t0)
+            assert sum(len(g.pods) for g in result.new_groups) == 10_000
+        assert cold_s < 1.2, f"10k-pod cold solve took {cold_s:.2f}s (min of 3)"
         # volume-resolution guard (round 4): effective_pods must stay an
         # identity pass for claimless pods and O(claims) for the rest --
         # 10k pods with 1k volume-backed resolves in low single-digit ms
@@ -230,9 +237,11 @@ class TestTenThousandPodTier:
             for i in range(1_000)
         ]
         idx = VolumeIndex(claims)
-        t0 = time.perf_counter()
-        eff, blocked = effective_pods(mixed, idx)
-        resolve_s = time.perf_counter() - t0
+        resolve_s = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            eff, blocked = effective_pods(mixed, idx)
+            resolve_s = min(resolve_s, time.perf_counter() - t0)
         assert len(eff) == 10_000 and not blocked
         assert all(a is b for a, b in zip(eff[:9_000], mixed[:9_000])), "identity pass lost"
-        assert resolve_s < 0.2, f"10k-pod volume resolution took {resolve_s:.3f}s"
+        assert resolve_s < 0.2, f"10k-pod volume resolution took {resolve_s:.3f}s (min of 3)"

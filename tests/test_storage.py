@@ -343,6 +343,73 @@ class TestStorageE2E:
         assert node_b.metadata.name  # the hosting node remains
 
 
+class TestConsolidationAttachBudgets:
+    def test_device_verdicts_respect_attach_budgets(self):
+        """The batched consolidation evaluator judges volume-backed pods as
+        their RESOLVED copies: two nodes whose pods fit each other on cpu
+        but NOT on the attach axis must not consolidate (a raw-pod verdict
+        would say can_delete and overcommit the survivor)."""
+        from karpenter_tpu.apis import NodeClaim
+        from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
+
+        clock = FakeClock(start=10_000.0)
+        op = Operator(clock=clock, consolidation_evaluator=ConsolidationEvaluator())
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        for i in range(2):
+            for j in range(20):
+                op.cluster.create(PersistentVolumeClaim(f"d{i}-{j}"))
+        # 20 attachments per pod: no catalog type attaches 40, so the pods
+        # MUST land on separate nodes, and neither node can absorb the
+        # other's pod afterwards
+        for i in range(2):
+            op.cluster.create(
+                mk_pod(f"vol-{i}", claims=tuple(f"d{i}-{j}" for j in range(20)))
+            )
+        op.settle(max_ticks=40)
+        assert not op.cluster.pending_pods()
+        assert len(op.cluster.list(Node)) == 2, "attach limits must split the pods"
+        for c in op.cluster.list(NodeClaim):
+            c.metadata.creation_timestamp -= 3600
+        decisions = op.disruption.reconcile()
+        assert decisions == [], f"attach-infeasible consolidation acted: {decisions}"
+
+    def test_attach_feasible_consolidation_still_acts(self):
+        """The volume lowering must not over-block: a light volume pod
+        stranded on its own node (its blocker pod left) MUST consolidate
+        onto the surviving node whose attach budget admits it."""
+        from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
+
+        clock = FakeClock(start=10_000.0)
+        op = Operator(clock=clock, consolidation_evaluator=ConsolidationEvaluator())
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(PersistentVolumeClaim("lv-0"))
+        op.cluster.create(PersistentVolumeClaim("lv-1"))
+        op.cluster.create(mk_pod("vol-a", claims=("lv-0",)))
+        op.settle(max_ticks=40)
+        # a cpu-filler forces a SECOND node for the next volume pod
+        node_a = op.cluster.list(Node)[0]
+        filler = Pod("filler", requests=node_a.allocatable
+                     - Resources({"cpu": "300m", "memory": "1Gi"})
+                     - op.cluster.node_usage(node_a.metadata.name))
+        op.cluster.create(filler)
+        op.cluster.create(mk_pod("vol-b", claims=("lv-1",)))
+        op.settle(max_ticks=40)
+        assert not op.cluster.pending_pods()
+        if len(op.cluster.list(Node)) < 2:
+            pytest.skip("pods packed onto one node; nothing to consolidate")
+        # the blocker leaves: vol-b's node is now consolidatable, and its
+        # single attachment fits the first node's budget
+        filler.metadata.finalizers = []
+        op.cluster.delete(Pod, "filler")
+        for c in op.cluster.list(NodeClaim):
+            c.metadata.creation_timestamp -= 3600
+        decisions = op.disruption.reconcile()
+        assert decisions, "attach-feasible consolidation must act"
+        assert all(r in ("Underutilized", "Empty") for _, r in decisions)
+
+
 class TestKubeConversions:
     def test_pvc_round_trip(self):
         from karpenter_tpu.kube import convert
