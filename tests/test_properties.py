@@ -1,0 +1,260 @@
+"""Property-based tests (hypothesis) over the scheduling algebra.
+
+The reference leans on large hand-enumerated suites for its requirements/
+resources vocabulary (e.g. the scheduling packages' table tests); here the
+same invariants are checked as PROPERTIES over randomized inputs -- the
+laws the solver's correctness arguments rest on:
+
+- quantity parse/format round-trips,
+- Resources vector arithmetic and fit monotonicity,
+- Requirements narrowing monotonicity and label self-compatibility,
+- toleration algebra,
+- and the packed-bitset device compat mirroring the Python algebra on
+  randomized constraint sets (the encode layer's core contract).
+
+Examples are bounded so the tier stays in the always-on suite.
+"""
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")  # confine the blast radius
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from karpenter_tpu.apis import Pod, labels as wk
+from karpenter_tpu.scheduling import (
+    Operator as Op,
+    Requirement,
+    Requirements,
+    Resources,
+    Taint,
+    Toleration,
+    tolerates_all,
+)
+from karpenter_tpu.scheduling import resources as res
+
+# derandomized: CI determinism beats marginal novelty per run -- these are
+# timeless invariants, and the fuzz tiers already provide fresh randomness
+SETTINGS = dict(deadline=None, max_examples=80, derandomize=True)
+
+# small closed label vocabulary so generated requirements overlap often
+KEYS = ["arch", "zone", "team", "tier"]
+VALUES = ["a", "b", "c", "d"]
+
+labels_st = st.dictionaries(st.sampled_from(KEYS), st.sampled_from(VALUES), max_size=4)
+
+
+def req_st():
+    return st.builds(
+        lambda k, vs, comp: Requirement(
+            k, Op.NOT_IN if comp else Op.IN, sorted(set(vs))
+        ),
+        st.sampled_from(KEYS),
+        st.lists(st.sampled_from(VALUES), min_size=1, max_size=3),
+        st.booleans(),
+    )
+
+
+class TestQuantityRoundTrip:
+    @settings(**SETTINGS)
+    @given(st.integers(min_value=0, max_value=10**15))
+    def test_memory_bytes_round_trip(self, n):
+        s = res.format_quantity(float(n), res.MEMORY)
+        assert res.parse_quantity(s, res.MEMORY) == float(n)
+
+    @settings(**SETTINGS)
+    @given(st.integers(min_value=0, max_value=10**9))
+    def test_cpu_millis_round_trip(self, m):
+        s = res.format_quantity(float(m), res.CPU)
+        assert res.parse_quantity(s, res.CPU) == float(m)
+
+
+class TestResourcesAlgebra:
+    @staticmethod
+    def _mk(d):
+        return Resources.from_base_units({k: float(v) for k, v in d.items()})
+
+    vec_st = st.dictionaries(
+        st.sampled_from(list(res.RESOURCE_AXES)),
+        st.integers(min_value=0, max_value=10**6),
+        max_size=len(res.RESOURCE_AXES),
+    )
+
+    @settings(**SETTINGS)
+    @given(vec_st, vec_st)
+    def test_add_sub_round_trip(self, a, b):
+        ra, rb = self._mk(a), self._mk(b)
+        back = (ra + rb) - rb
+        for axis in res.RESOURCE_AXES:
+            assert back.get(axis) == ra.get(axis)
+
+    @settings(**SETTINGS)
+    @given(vec_st, vec_st, vec_st)
+    def test_fits_is_monotone(self, a, b, cap):
+        ra, rb, rc = self._mk(a), self._mk(b), self._mk(cap)
+        if (ra + rb).fits(rc):
+            assert ra.fits(rc) and rb.fits(rc)
+
+    @settings(**SETTINGS)
+    @given(vec_st)
+    def test_to_vector_is_lossless(self, a):
+        ra = self._mk(a)
+        vec = ra.to_vector()
+        for axis, i in res.AXIS_INDEX.items():
+            assert vec[i] == ra.get(axis)
+
+
+class TestRequirementsAlgebra:
+    @settings(**SETTINGS)
+    @given(labels_st)
+    def test_labels_self_compatible(self, lab):
+        reqs = Requirements.from_labels(lab)
+        assert reqs.compatible(Requirements.from_labels(lab)) is True
+        assert reqs.labels() == lab
+
+    @settings(**SETTINGS)
+    @given(labels_st, req_st())
+    def test_narrowing_is_monotone(self, lab, extra):
+        """Anything compatible with R+extra is compatible with R: adding a
+        requirement can only narrow (the join-gate soundness argument)."""
+        base = Requirements.from_labels(lab)
+        narrowed = base.copy().add(extra)
+        probe = Requirements.from_labels(lab)
+        if narrowed.compatible(probe):
+            assert base.compatible(probe)
+
+    @settings(**SETTINGS)
+    @given(st.lists(req_st(), max_size=3), labels_st)
+    def test_compatible_agrees_with_label_witnesses(self, reqs, lab):
+        """Compatibility with a concrete label set must agree with
+        per-requirement matching: labels are the ground-truth witnesses
+        the algebra abstracts (matches_labels is the oracle here)."""
+        a = Requirements(reqs)
+        probe = Requirements.from_labels(lab)
+        if a.compatible(probe):
+            # every requirement whose key the labels pin must admit it
+            for r in reqs:
+                if r.key in lab:
+                    assert r.matches(lab[r.key]), (r, lab)
+
+    @settings(**SETTINGS)
+    @given(st.lists(req_st(), max_size=4))
+    def test_stable_hash_is_order_insensitive(self, reqs):
+        import random
+
+        a = Requirements(reqs)
+        shuffled = list(reqs)
+        random.Random(0).shuffle(shuffled)
+        b = Requirements(shuffled)
+        assert a.stable_hash() == b.stable_hash()
+
+
+class TestTolerationAlgebra:
+    taint_st = st.builds(
+        lambda k, e, v: Taint(k, e, v),
+        st.sampled_from(KEYS),
+        st.sampled_from(["NoSchedule", "NoExecute", "PreferNoSchedule"]),
+        st.sampled_from(VALUES),
+    )
+
+    @settings(**SETTINGS)
+    @given(st.lists(taint_st, max_size=3))
+    def test_empty_exists_toleration_tolerates_everything(self, taints):
+        assert tolerates_all([Toleration(operator="Exists")], taints)
+
+    @settings(**SETTINGS)
+    @given(st.lists(taint_st, max_size=3))
+    def test_no_tolerations_iff_no_blocking_taints(self, taints):
+        ok = tolerates_all([], taints)
+        assert ok == (not any(t.blocking() for t in taints))
+
+    @settings(**SETTINGS)
+    @given(taint_st)
+    def test_exact_toleration_tolerates_its_taint(self, taint):
+        tol = Toleration(key=taint.key, operator="Equal", value=taint.value, effect=taint.effect)
+        assert tolerates_all([tol], [taint])
+
+
+@pytest.fixture(scope="module")
+def small_catalog():
+    from karpenter_tpu.apis import TPUNodeClass
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in gen_catalog.ZONES},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    items = prov.list(nc)
+    from karpenter_tpu.solver import encode
+
+    sub = items[::9]  # ~70 types: enough vocabulary, cheap per example
+    return sub, encode.encode_catalog(sub)  # encode ONCE, not per example
+
+
+class TestDeviceCompatMirrorsAlgebra:
+    """The packed-bitset compat (encode.compat_matrix) must agree with the
+    Python requirements algebra item by item for every expressible
+    constraint -- the device kernel's core correctness contract."""
+
+    wk_req_st = st.one_of(
+        st.builds(
+            lambda vs: Requirement(wk.ARCH_LABEL, Op.IN, sorted(set(vs))),
+            st.lists(st.sampled_from(["amd64", "arm64"]), min_size=1, max_size=2),
+        ),
+        st.builds(
+            lambda vs, comp: Requirement(
+                wk.LABEL_INSTANCE_CATEGORY, Op.NOT_IN if comp else Op.IN, sorted(set(vs))
+            ),
+            st.lists(st.sampled_from(["c", "m", "r", "g", "t"]), min_size=1, max_size=3),
+            st.booleans(),
+        ),
+        st.builds(
+            lambda lo: Requirement(wk.LABEL_INSTANCE_CPU, Op.GT, [str(lo)]),
+            st.sampled_from([1, 2, 4, 8, 16, 32]),
+        ),
+        st.builds(
+            lambda hi: Requirement(wk.LABEL_INSTANCE_MEMORY, Op.LT, [str(hi)]),
+            st.sampled_from([4096, 16384, 65536, 262144]),
+        ),
+        st.builds(
+            lambda vs: Requirement(wk.LABEL_INSTANCE_SIZE, Op.IN, sorted(set(vs))),
+            st.lists(
+                st.sampled_from(["large", "xlarge", "2xlarge", "4xlarge", "metal"]),
+                min_size=1, max_size=3,
+            ),
+        ),
+    )
+
+    @settings(deadline=None, max_examples=25, derandomize=True)
+    @given(reqs=st.lists(wk_req_st, min_size=0, max_size=3))
+    def test_compat_matrix_matches_python_algebra(self, reqs, small_catalog):
+        from karpenter_tpu.solver import encode
+
+        items, catalog = small_catalog
+        pod = Pod("prop", requests=Resources({"cpu": "100m"}), node_affinity_terms=[reqs])
+        classes = encode.group_pods([pod])
+        class_set = encode.encode_classes(classes, catalog)
+        compat = encode.compat_matrix(catalog, class_set)[0, : catalog.k_real]
+        want = np.array(
+            [it.requirements.compatible(classes[0].requirements) for it in items],
+            dtype=bool,
+        )
+        assert np.array_equal(compat, want), (
+            f"device compat diverged for {reqs}: "
+            f"{[(it.name, bool(c), bool(w)) for it, c, w in zip(items, compat, want) if c != w][:5]}"
+        )
