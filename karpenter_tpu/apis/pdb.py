@@ -55,11 +55,14 @@ class PodDisruptionBudget(APIObject):
         return all(labels.get(k) == v for k, v in self.selector.items())
 
     def allowed_disruptions(self, total: int, healthy: int) -> int:
-        """disruptionsAllowed given the current matching-pod counts."""
+        """disruptionsAllowed given the current matching-pod counts.
+        Never exceeds `healthy`: an allowance above the live pod count is
+        meaningless (property-found edge: maxUnavailable > 0 with zero
+        matching pods must report 0, not the raw budget)."""
         if self.max_unavailable is not None:
             budget = _resolve(self.max_unavailable, total)
-            return max(0, budget - (total - healthy))
+            return min(healthy, max(0, budget - (total - healthy)))
         if self.min_available is not None:
             need = _resolve(self.min_available, total)
-            return max(0, healthy - need)
+            return min(healthy, max(0, healthy - need))
         return max(0, healthy)  # no constraint declared

@@ -20,7 +20,8 @@ import pytest
 hypothesis = pytest.importorskip("hypothesis")  # confine the blast radius
 from hypothesis import given, settings, strategies as st  # noqa: E402
 
-from karpenter_tpu.apis import Pod, labels as wk
+from karpenter_tpu.apis import Pod, PodDisruptionBudget, labels as wk
+from karpenter_tpu.apis.nodepool import Budget
 from karpenter_tpu.scheduling import (
     Operator as Op,
     Requirement,
@@ -258,3 +259,76 @@ class TestDeviceCompatMirrorsAlgebra:
             f"device compat diverged for {reqs}: "
             f"{[(it.name, bool(c), bool(w)) for it, c, w in zip(items, compat, want) if c != w][:5]}"
         )
+
+
+class TestPDBAllowanceLaws:
+    count_st = st.integers(min_value=0, max_value=500)
+    value_st = st.one_of(
+        st.integers(min_value=0, max_value=500),
+        st.builds(lambda p: f"{p}%", st.integers(min_value=0, max_value=100)),
+    )
+
+    @settings(**SETTINGS)
+    @given(total=count_st, healthy=count_st, v=value_st, use_min=st.booleans())
+    def test_allowed_is_bounded_by_healthy(self, total, healthy, v, use_min):
+        healthy = min(healthy, total)
+        pdb = PodDisruptionBudget(
+            "p",
+            min_available=v if use_min else None,
+            max_unavailable=None if use_min else v,
+        )
+        allowed = pdb.allowed_disruptions(total, healthy)
+        assert 0 <= allowed <= healthy
+
+    @settings(**SETTINGS)
+    @given(total=count_st, h1=count_st, h2=count_st, v=value_st, use_min=st.booleans())
+    def test_allowed_is_monotone_in_health(self, total, h1, h2, v, use_min):
+        """More healthy pods can never reduce the disruption allowance."""
+        h1, h2 = sorted((min(h1, total), min(h2, total)))
+        pdb = PodDisruptionBudget(
+            "p",
+            min_available=v if use_min else None,
+            max_unavailable=None if use_min else v,
+        )
+        assert pdb.allowed_disruptions(total, h1) <= pdb.allowed_disruptions(total, h2)
+
+    @settings(**SETTINGS)
+    @given(total=st.integers(min_value=1, max_value=500))
+    def test_extremes(self, total):
+        # maxUnavailable 0 freezes; minAvailable 100% freezes; and with
+        # everything healthy, maxUnavailable 100% frees every pod
+        assert PodDisruptionBudget("a", max_unavailable=0).allowed_disruptions(total, total) == 0
+        assert PodDisruptionBudget("b", min_available="100%").allowed_disruptions(total, total) == 0
+        assert PodDisruptionBudget("c", max_unavailable="100%").allowed_disruptions(total, total) == total
+
+
+class TestNodePoolBudgetLaws:
+    @settings(**SETTINGS)
+    @given(
+        total=st.integers(min_value=0, max_value=1000),
+        v=st.one_of(
+            st.integers(min_value=0, max_value=100),
+            st.builds(lambda p: f"{p}%", st.integers(min_value=0, max_value=100)),
+        ),
+    )
+    def test_allowed_bounds_and_percentage_ceiling(self, total, v):
+        b = Budget(nodes=str(v))
+        allowed = b.allowed(total)
+        assert allowed >= 0
+        if isinstance(v, str) and v != "0%" and total >= 1:
+            # percentages scale UP (documented intstr semantics): a
+            # nonzero share of a nonempty pool always permits one
+            assert allowed >= 1
+
+    @settings(**SETTINGS)
+    @given(now=st.floats(min_value=0, max_value=4e9, allow_nan=False))
+    def test_scheduleless_budget_always_active(self, now):
+        assert Budget(nodes="10%").active(now) is True
+
+    @settings(**SETTINGS)
+    @given(now=st.floats(min_value=0, max_value=4e9, allow_nan=False))
+    def test_malformed_or_durationless_schedules_fail_closed(self, now):
+        # schedule without duration, and a malformed schedule with one:
+        # both must CONSTRAIN (a maintenance freeze must not silently lift)
+        assert Budget(nodes="0", schedule="0 9 * * *").active(now) is True
+        assert Budget(nodes="0", schedule="not a cron", duration=3600.0).active(now) is True
