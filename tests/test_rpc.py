@@ -186,6 +186,49 @@ class TestRemoteDifferential:
         )
         assert sig(local) == sig(remote)
 
+    def test_merged_taints_over_the_wire(self, client, catalog_items):
+        """join_allowed rides the RPC frames: a tainted merged multi-pool
+        batch solved through the sidecar must match the in-process device
+        decision exactly (the feature-negotiated path, round 4)."""
+        from karpenter_tpu.apis import NodePool, Pod, labels as wk
+        from karpenter_tpu.scheduling import Operator as Op, Requirement, Taint, Toleration
+        from karpenter_tpu.solver.oracle import Scheduler
+
+        arm = NodePool("arm", weight=10,
+                       requirements=[Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"])])
+        arm.template.taints = [Taint("dedicated", "NoSchedule", "arm")]
+        amd = NodePool("amd", weight=1,
+                       requirements=[Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])])
+        tol = [Toleration(key="dedicated", operator="Exists")]
+        pods = [
+            Pod(f"t{i}", requests=Resources({"cpu": "3", "memory": "6Gi"}), tolerations=tol)
+            for i in range(2)
+        ] + [
+            Pod(f"n{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}))
+            for i in range(3)
+        ]
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+
+        def mk():
+            return Scheduler(
+                nodepools=[arm, amd],
+                instance_types={"arm": catalog_items, "amd": catalog_items},
+                zones=zones,
+            )
+
+        assert "join_allowed" in client.features()
+        local = TPUSolver(g_max=128).schedule(mk(), list(pods))
+        remote = TPUSolver(g_max=128, client=client).schedule(mk(), list(pods))
+        sig = lambda r: sorted(
+            (g.nodepool.name, tuple(sorted(p.metadata.name for p in g.pods)))
+            for g in r.new_groups
+        )
+        assert sig(local) == sig(remote)
+        assert set(local.unschedulable) == set(remote.unschedulable) == set()
+        for g in remote.new_groups:
+            if g.nodepool.name == "arm":
+                assert all(p.metadata.name.startswith("t") for p in g.pods)
+
     def test_staging_is_reused_across_solves(self, client, catalog_items):
         solver = TPUSolver(g_max=64, client=client)
         pool = NodePool("default")
