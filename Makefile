@@ -21,7 +21,7 @@ benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
 	$(PY) bench.py --profile
 
 e2e:  ## scale + end-to-end suites only
-	$(PYTEST) tests/test_scale.py tests/test_e2e_provisioning.py -q
+	$(PYTEST) tests/test_scale.py tests/test_e2e_provisioning.py tests/test_storage.py tests/test_soak.py -q
 
 run:  ## controller loop over the kwok rig
 	$(PY) -m karpenter_tpu --max-ticks 50 --tick-interval 0.2 --metrics-dump

@@ -9,9 +9,10 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
-from karpenter_tpu.apis import NodeClaim, labels as wk
+from karpenter_tpu.apis import NodeClaim, NodePool, labels as wk
 from karpenter_tpu import metrics
 from karpenter_tpu.kwok.cluster import Cluster
+from karpenter_tpu.scheduling import Resources
 from karpenter_tpu.logging import get_logger
 
 INSTANCE_INFO = metrics.REGISTRY.gauge(
@@ -79,7 +80,29 @@ class MetricsController:
             )
         self._series = live
         self._sweep_conditions()
+        self._aggregate_pool_status()
         return len(live)
+
+    def _aggregate_pool_status(self) -> None:
+        """NodePool.status.resources: aggregate capacity of the pool's
+        launched claims (the core's nodepool counter controller --
+        `kubectl get nodepool` shows it; limits are judged against live
+        usage elsewhere, this is the observability surface). DELETING
+        claims still count: a draining instance holds real (billed)
+        capacity until it is actually gone, and INSTANCE_INFO above uses
+        the same membership. Updated only on change so steady state
+        writes nothing."""
+        totals: Dict[str, Resources] = {}
+        for claim in self.cluster.list(NodeClaim):
+            pool_name = claim.nodepool_name
+            if not pool_name or not claim.launched():
+                continue
+            totals[pool_name] = totals.get(pool_name, Resources()) + claim.capacity
+        for pool in self.cluster.list(NodePool):
+            want = totals.get(pool.metadata.name, Resources())
+            if pool.status_resources != want:
+                pool.status_resources = want
+                self.cluster.update(pool)
 
     def _sweep_conditions(self) -> None:
         """Aggregate every object's status conditions into the bounded

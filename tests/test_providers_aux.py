@@ -284,6 +284,35 @@ class TestMetricsController:
             == 1.0
         )
 
+    def test_nodepool_status_resources_aggregate(self, clock):
+        """NodePool.status.resources tracks the aggregate capacity of the
+        pool's launched claims (the core's nodepool counter controller)
+        and drains back to zero with the fleet."""
+        from karpenter_tpu.scheduling import resources as res
+
+        op = Operator(clock=clock)
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(Pod("p-1", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        op.settle()
+        claims = op.cluster.list(NodeClaim)
+        assert claims
+        pool = op.cluster.get(NodePool, "default")
+        want = Resources()
+        for c in claims:
+            want = want + c.capacity
+        assert pool.status_resources == want
+        assert pool.status_resources.get(res.CPU) > 0
+        # fleet drains -> aggregate returns to zero
+        for p in op.cluster.list(Pod):
+            p.metadata.finalizers = []
+            op.cluster.delete(Pod, p.metadata.name)
+        op.clock.step(600)
+        for _ in range(20):
+            op.tick()
+            op.clock.step(10.0)
+        assert op.cluster.get(NodePool, "default").status_resources == Resources()
+
 
 class TestE2EStillTagsClaims:
     def test_per_claim_tags_applied_post_registration(self, clock):
