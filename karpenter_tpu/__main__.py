@@ -135,9 +135,28 @@ def main(argv=None) -> int:
         help="use the pod serviceaccount to reach the apiserver",
     )
     parser.add_argument("--tick-interval", type=float, default=1.0, help="seconds between sweeps")
+    parser.add_argument(
+        "--health-port", type=int, default=8081,
+        help="liveness/readiness/metrics HTTP port (0 disables)",
+    )
     parser.add_argument("--max-ticks", type=int, default=0, help="stop after N sweeps (0 = run forever)")
     parser.add_argument("--metrics-dump", action="store_true", help="print Prometheus metrics on exit")
     args = parser.parse_args(argv)
+
+    # health endpoints come up BEFORE the operator graph builds: a slow
+    # or wedged cold start (catalog hydration, a hung cloud call) must
+    # answer liveness 200 (readiness stays 503 until the first sweep) --
+    # no listener at all reads as probe failure and restart-loops the pod
+    health = None
+    if args.health_port:
+        from karpenter_tpu.operator.health import HealthServer
+
+        # the stall window scales with the configured sweep cadence: a
+        # long --tick-interval is a HEALTHY quiet loop, not a wedge
+        health = HealthServer(
+            port=args.health_port,
+            stall_after=max(300.0, 5 * args.tick_interval),
+        ).start()
 
     op = build_operator(args)
     # latency GC policy: the provider graph and (if enabled) the jax
@@ -169,10 +188,14 @@ def main(argv=None) -> int:
     op.watch_pods()   # pod arrivals wake the loop through the batch window
     while not stop["flag"]:
         op.tick()
+        if health is not None:
+            health.beat()
         ticks += 1
         if args.max_ticks and ticks >= args.max_ticks:
             break
         op.wait_for_work(args.tick_interval)
+    if health is not None:
+        health.stop()
 
     if args.metrics_dump:
         from karpenter_tpu import metrics

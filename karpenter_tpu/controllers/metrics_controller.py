@@ -98,11 +98,17 @@ class MetricsController:
             if not pool_name or not claim.launched():
                 continue
             totals[pool_name] = totals.get(pool_name, Resources()) + claim.capacity
+        from karpenter_tpu.kwok.cluster import Conflict
+
         for pool in self.cluster.list(NodePool):
             want = totals.get(pool.metadata.name, Resources())
             if pool.status_resources != want:
                 pool.status_resources = want
-                self.cluster.update(pool)
+                try:
+                    self.cluster.update(pool)
+                except Conflict:
+                    pass  # stale read vs a concurrent writer: next sweep retries
+
 
     def _sweep_conditions(self) -> None:
         """Aggregate every object's status conditions into the bounded

@@ -38,7 +38,11 @@ class Counter(_Metric):
         return self._values.get(key, 0.0)
 
     def collect(self):
-        for key, v in self._values.items():
+        # snapshot under the lock: /metrics scrapes from the health
+        # server's handler thread while controllers mutate concurrently
+        with self._lock:
+            items = list(self._values.items())
+        for key, v in items:
             yield key, v, "counter"
 
 
@@ -66,7 +70,9 @@ class Gauge(_Metric):
             self._values.pop(key, None)
 
     def collect(self):
-        for key, v in self._values.items():
+        with self._lock:
+            items = list(self._values.items())
+        for key, v in items:
             yield key, v, "gauge"
 
 
@@ -102,7 +108,9 @@ class Histogram(_Metric):
         return samples[idx]
 
     def collect(self):
-        for key, total in self._totals.items():
+        with self._lock:
+            items = list(self._totals.items())
+        for key, total in items:
             yield key, total, "histogram"
 
 

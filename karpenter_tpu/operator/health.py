@@ -1,0 +1,100 @@
+"""Liveness/readiness endpoints for the deployed controller.
+
+The reference inherits /healthz+pprof from its core operator manager
+(SURVEY §5: controller-runtime health probes; the chart wires kubelet
+probes to them). The equivalent here is a tiny stdlib HTTP server the
+binary starts next to the run loop:
+
+- `/healthz` (liveness): 200 while the tick loop is making progress --
+  the last completed sweep finished within `stall_after` seconds; 503
+  when the loop is wedged (a hung cloud call, a deadlock), which is
+  exactly when kubelet should restart the pod. Until the FIRST tick
+  completes it reports 200 (startup is the readiness probe's business;
+  killing a pod mid-cold-start would loop it forever).
+- `/readyz` (readiness): 200 once at least one full sweep has completed
+  -- caches hydrated enough to act on watches.
+- `/metrics`: the Prometheus registry, so the deployed pod scrapes
+  without a separate wiring path.
+
+The heartbeat is a plain float timestamp written by the run loop after
+every completed tick; reads are lock-free (float stores are atomic in
+CPython).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from karpenter_tpu.logging import get_logger
+
+
+class HealthServer:
+    log = get_logger("health")
+
+    def __init__(self, port: int = 8081, stall_after: float = 300.0):
+        self.port = port
+        self.stall_after = stall_after
+        self._last_tick: float = 0.0   # 0 = no tick completed yet
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- heartbeat (called by the run loop) ---------------------------------
+    def beat(self) -> None:
+        self._last_tick = time.monotonic()
+
+    # -- probe logic --------------------------------------------------------
+    def alive(self) -> bool:
+        last = self._last_tick
+        return last == 0.0 or (time.monotonic() - last) < self.stall_after
+
+    def ready(self) -> bool:
+        return self._last_tick != 0.0
+
+    # -- server -------------------------------------------------------------
+    def start(self) -> "HealthServer":
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: str, ctype: str = "text/plain"):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    if outer.alive():
+                        self._send(200, "ok")
+                    else:
+                        self._send(503, "tick loop stalled")
+                elif self.path == "/readyz":
+                    if outer.ready():
+                        self._send(200, "ok")
+                    else:
+                        self._send(503, "no sweep completed yet")
+                elif self.path == "/metrics":
+                    from karpenter_tpu import metrics
+
+                    self._send(200, metrics.REGISTRY.expose())
+                else:
+                    self._send(404, "not found")
+
+        self._server = ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._server.server_address[1]  # resolved when port=0
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        self.log.info("health endpoints up", port=self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -57,6 +57,21 @@ class TestDeployManifests:
                 f"ClusterRole missing {group or 'core'}/{info.plural}"
             )
 
+    def test_probes_point_at_served_endpoints(self):
+        """The shipped probes must reference paths the health server
+        actually serves on the port the binary defaults to."""
+        docs = _load("controller.yaml")
+        dep = next(d for d in docs if d["kind"] == "Deployment")
+        controller = next(
+            c for c in dep["spec"]["template"]["spec"]["containers"]
+            if c["name"] == "controller"
+        )
+        assert controller["livenessProbe"]["httpGet"]["path"] == "/healthz"
+        assert controller["readinessProbe"]["httpGet"]["path"] == "/readyz"
+        port_name = controller["livenessProbe"]["httpGet"]["port"]
+        named = {p["name"]: p["containerPort"] for p in controller["ports"]}
+        assert named[port_name] == 8081  # the binary's --health-port default
+
     def test_subresource_grants_present(self):
         docs = _load("rbac.yaml")
         role = next(d for d in docs if d["kind"] == "ClusterRole")
@@ -64,3 +79,54 @@ class TestDeployManifests:
         for sub in ("pods/binding", "nodes/status", "nodeclaims/status",
                     "nodepools/status", "tpunodeclasses/status"):
             assert sub in resources, sub
+
+
+class TestHealthServer:
+    def test_liveness_readiness_and_metrics(self):
+        import urllib.request
+
+        from karpenter_tpu.operator.health import HealthServer
+
+        hs = HealthServer(port=0, stall_after=300.0).start()
+        try:
+            base = f"http://127.0.0.1:{hs.port}"
+
+            def get(path):
+                try:
+                    with urllib.request.urlopen(f"{base}{path}") as r:
+                        return r.status, r.read().decode()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read().decode()
+
+            # before any tick: alive (startup is readiness's business),
+            # not ready
+            assert get("/healthz")[0] == 200
+            assert get("/readyz")[0] == 503
+            hs.beat()
+            assert get("/readyz")[0] == 200
+            code, body = get("/metrics")
+            assert code == 200 and "karpenter" in body
+            assert get("/nope")[0] == 404
+        finally:
+            hs.stop()
+
+    def test_stalled_loop_fails_liveness(self):
+        import urllib.request
+
+        from karpenter_tpu.operator.health import HealthServer
+
+        hs = HealthServer(port=0, stall_after=0.05).start()
+        try:
+            hs.beat()
+            import time
+
+            time.sleep(0.15)  # the loop "wedges" past stall_after
+
+            try:
+                with urllib.request.urlopen(f"http://127.0.0.1:{hs.port}/healthz") as r:
+                    code = r.status
+            except urllib.error.HTTPError as e:
+                code = e.code
+            assert code == 503
+        finally:
+            hs.stop()
