@@ -56,6 +56,15 @@ def build_operator(args):
         # controller must come up and serve)
         backend, err = probe_jax_backend(timeout_s=60, attempts=1)
         if backend is None:
+            # pin the platform via the ENV before the first jax import:
+            # a sitecustomize hook may have re-pinned JAX_PLATFORMS to
+            # the remote-accelerator plugin, whose INIT AT IMPORT TIME
+            # hangs on a dead tunnel -- the exact wedge the probe just
+            # detected (jax.config.update alone is too late to stop the
+            # plugin's import-time work)
+            import os as _os
+
+            _os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
 
             jax.config.update("jax_platforms", "cpu")
@@ -187,9 +196,13 @@ def main(argv=None) -> int:
     ticks = 0
     op.watch_pods()   # pod arrivals wake the loop through the batch window
     while not stop["flag"]:
-        op.tick()
+        swept = op.tick()
         if health is not None:
-            health.beat()
+            # the LOOP beat proves the process turns (leader or standby:
+            # liveness); the SWEEP beat only on a real sweep (readiness)
+            health.beat_loop()
+            if swept:
+                health.beat_sweep()
         ticks += 1
         if args.max_ticks and ticks >= args.max_ticks:
             break

@@ -136,22 +136,33 @@ class Registry:
             return m
 
     def expose(self) -> str:
-        """Prometheus text exposition."""
+        """Prometheus text exposition. The registry map is snapshotted
+        under the registry lock: the health server scrapes from a handler
+        thread while cold-start imports still register metrics."""
         out = []
-        for name, m in sorted(self._metrics.items()):
+        with self._lock:
+            metrics_snapshot = sorted(self._metrics.items())
+        for name, m in metrics_snapshot:
             out.append(f"# HELP {name} {m.help}")
             if isinstance(m, Histogram):
                 out.append(f"# TYPE {name} histogram")
-                for key, total in m._totals.items():
+                # snapshot ALL three maps under the metric lock: scrapes
+                # run on the health server's handler thread while
+                # controllers observe() concurrently
+                with m._lock:
+                    totals = list(m._totals.items())
+                    counts = {k: list(v) for k, v in m._counts.items()}
+                    sums = dict(m._sums)
+                for key, total in totals:
                     lbl = _labels_str(m.label_names, key)
                     cum = 0
                     for i, b in enumerate(m.buckets):
-                        cum = m._counts[key][i]
+                        cum = counts[key][i]
                         le = _labels_str(m.label_names + ("le",), key + (repr(b),))
                         out.append(f"{name}_bucket{le} {cum}")
                     inf = _labels_str(m.label_names + ("le",), key + ("+Inf",))
                     out.append(f"{name}_bucket{inf} {total}")
-                    out.append(f"{name}_sum{lbl} {m._sums[key]}")
+                    out.append(f"{name}_sum{lbl} {sums[key]}")
                     out.append(f"{name}_count{lbl} {total}")
             else:
                 kind = "counter" if isinstance(m, Counter) else "gauge"

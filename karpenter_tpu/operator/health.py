@@ -3,22 +3,28 @@
 The reference inherits /healthz+pprof from its core operator manager
 (SURVEY §5: controller-runtime health probes; the chart wires kubelet
 probes to them). The equivalent here is a tiny stdlib HTTP server the
-binary starts next to the run loop:
+binary starts next to the run loop, with TWO heartbeats so leader
+election composes correctly:
 
-- `/healthz` (liveness): 200 while the tick loop is making progress --
-  the last completed sweep finished within `stall_after` seconds; 503
-  when the loop is wedged (a hung cloud call, a deadlock), which is
-  exactly when kubelet should restart the pod. Until the FIRST tick
-  completes it reports 200 (startup is the readiness probe's business;
-  killing a pod mid-cold-start would loop it forever).
-- `/readyz` (readiness): 200 once at least one full sweep has completed
-  -- caches hydrated enough to act on watches.
-- `/metrics`: the Prometheus registry, so the deployed pod scrapes
-  without a separate wiring path.
+- `beat_loop()` fires every run-loop iteration, leader or standby:
+  it proves the PROCESS is turning.
+- `beat_sweep()` fires only when a full controller sweep ran (the
+  elected leader): it proves the replica is SERVING.
 
-The heartbeat is a plain float timestamp written by the run loop after
-every completed tick; reads are lock-free (float stores are atomic in
-CPython).
+Probes:
+
+- `/healthz` (liveness): 503 when the run loop has not turned within
+  `stall_after` seconds -- a wedged loop (hung cloud call, deadlock) or
+  a cold start stuck past `startup_grace` before the loop ever began.
+  A healthy STANDBY keeps beating the loop and stays 200 forever.
+- `/readyz` (readiness): 200 while a full sweep completed within
+  `stall_after` -- standbys and demoted ex-leaders report 503 (not
+  serving), which is endpoint semantics, not a restart signal.
+- `/metrics`: the Prometheus registry.
+- `/debug/stacks`: every thread's stack (loopback-only).
+
+Heartbeats are plain float timestamps; reads are lock-free (float
+stores are atomic in CPython).
 """
 from __future__ import annotations
 
@@ -33,24 +39,41 @@ from karpenter_tpu.logging import get_logger
 class HealthServer:
     log = get_logger("health")
 
-    def __init__(self, port: int = 8081, stall_after: float = 300.0):
+    def __init__(
+        self, port: int = 8081, stall_after: float = 300.0,
+        startup_grace: float = 600.0,
+    ):
         self.port = port
         self.stall_after = stall_after
-        self._last_tick: float = 0.0   # 0 = no tick completed yet
+        self.startup_grace = startup_grace
+        self._started_at = time.monotonic()
+        self._last_loop: float = 0.0   # 0 = run loop has not turned yet
+        self._last_sweep: float = 0.0  # 0 = no full sweep completed yet
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
-    # -- heartbeat (called by the run loop) ---------------------------------
-    def beat(self) -> None:
-        self._last_tick = time.monotonic()
+    # -- heartbeats (called by the run loop) --------------------------------
+    def beat_loop(self) -> None:
+        self._last_loop = time.monotonic()
+
+    def beat_sweep(self) -> None:
+        self._last_sweep = time.monotonic()
 
     # -- probe logic --------------------------------------------------------
     def alive(self) -> bool:
-        last = self._last_tick
-        return last == 0.0 or (time.monotonic() - last) < self.stall_after
+        now = time.monotonic()
+        last = self._last_loop
+        if last == 0.0:
+            # cold start: alive until the startup grace runs out, so a
+            # build that NEVER reaches the loop still gets restarted
+            # (no separate startupProbe needed -- one that targeted
+            # readiness would kill healthy standbys)
+            return (now - self._started_at) < self.startup_grace
+        return (now - last) < self.stall_after
 
     def ready(self) -> bool:
-        return self._last_tick != 0.0
+        last = self._last_sweep
+        return last != 0.0 and (time.monotonic() - last) < self.stall_after
 
     # -- server -------------------------------------------------------------
     def start(self) -> "HealthServer":
@@ -73,16 +96,37 @@ class HealthServer:
                     if outer.alive():
                         self._send(200, "ok")
                     else:
-                        self._send(503, "tick loop stalled")
+                        self._send(503, "run loop stalled (or startup grace exceeded)")
                 elif self.path == "/readyz":
                     if outer.ready():
                         self._send(200, "ok")
                     else:
-                        self._send(503, "no sweep completed yet")
+                        self._send(503, "no recent sweep (standby or not started)")
                 elif self.path == "/metrics":
                     from karpenter_tpu import metrics
 
                     self._send(200, metrics.REGISTRY.expose())
+                elif self.path == "/debug/stacks":
+                    # the pprof-goroutine analogue (the reference gets
+                    # /debug/pprof from its operator manager): every
+                    # thread's current stack, for diagnosing exactly the
+                    # wedge /healthz reports. LOOPBACK ONLY -- stack
+                    # traces are an information-disclosure surface, and
+                    # `kubectl port-forward`/`exec` reach loopback while
+                    # arbitrary pod-network peers do not
+                    if self.client_address[0] not in ("127.0.0.1", "::1"):
+                        self._send(403, "debug endpoints are loopback-only")
+                        return
+                    import sys
+                    import traceback
+
+                    frames = sys._current_frames()
+                    names = {t.ident: t.name for t in threading.enumerate()}
+                    out = []
+                    for ident, frame in frames.items():
+                        out.append(f"--- thread {names.get(ident, ident)} ({ident}) ---")
+                        out.extend(l.rstrip() for l in traceback.format_stack(frame))
+                    self._send(200, "\n".join(out) + "\n")
                 else:
                     self._send(404, "not found")
 

@@ -192,12 +192,14 @@ class Operator:
             self.elector.on_elected.append(self.launch_templates.hydrate)
 
     # -- convenience loop for tests/rig -------------------------------------
-    def tick(self) -> None:
-        """One controller-manager sweep. Order mirrors the reconcile flow:
+    def tick(self) -> bool:
+        """One controller-manager sweep; True when it actually ran (False
+        on a standby replica, so callers like the health heartbeat only
+        count REAL sweeps). Order mirrors the reconcile flow:
         status resolution -> events -> provisioning -> node lifecycle ->
         binding -> post-launch bookkeeping -> drain/teardown -> GC."""
         if self.elector is not None and not self.elector.tick():
-            return  # standby replica: watch-only until the lease is won
+            return False  # standby replica: watch-only until the lease is won
         self.nodeclass_controller.reconcile_all()
         self.instance_type_refresh.reconcile()
         self.pricing_refresh.reconcile()
@@ -216,6 +218,7 @@ class Operator:
         self.termination.reconcile_all()
         self.garbage_collection.reconcile()
         self.metrics_controller.reconcile_all()
+        return True
 
     def settle(self, max_ticks: int = 20, step_seconds: float = 3.0) -> int:
         """Tick until no pending pods or budget exhausted; returns ticks."""

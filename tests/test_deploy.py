@@ -98,14 +98,19 @@ class TestHealthServer:
                 except urllib.error.HTTPError as e:
                     return e.code, e.read().decode()
 
-            # before any tick: alive (startup is readiness's business),
-            # not ready
+            # cold start: alive (within startup grace), not ready
             assert get("/healthz")[0] == 200
             assert get("/readyz")[0] == 503
-            hs.beat()
+            # a STANDBY beats the loop but never sweeps: alive, not ready
+            hs.beat_loop()
+            assert get("/healthz")[0] == 200
+            assert get("/readyz")[0] == 503
+            hs.beat_sweep()
             assert get("/readyz")[0] == 200
             code, body = get("/metrics")
             assert code == 200 and "karpenter" in body
+            code, body = get("/debug/stacks")
+            assert code == 200 and "--- thread" in body and "MainThread" in body
             assert get("/nope")[0] == 404
         finally:
             hs.stop()
@@ -117,7 +122,8 @@ class TestHealthServer:
 
         hs = HealthServer(port=0, stall_after=0.05).start()
         try:
-            hs.beat()
+            hs.beat_loop()
+            hs.beat_sweep()
             import time
 
             time.sleep(0.15)  # the loop "wedges" past stall_after
