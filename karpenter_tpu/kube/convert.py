@@ -222,6 +222,13 @@ def conditions_from_manifest(obj: APIObject, conds: List[dict]) -> None:
             obj.status_conditions.set_false(c["type"], c.get("reason", ""), c.get("message", ""))
         else:
             obj.status_conditions.set_unknown(c["type"], c.get("reason", ""), c.get("message", ""))
+        # keep the WIRE transition time: set_* stamps now(), and a
+        # read-modify-write cycle re-stamping every condition would
+        # advance apiserver lastTransitionTime on every node touch
+        if c.get("lastTransitionTime"):
+            cond = obj.status_conditions.get(c["type"])
+            if cond is not None:
+                cond.last_transition_time = parse_time(c["lastTransitionTime"])
 
 
 # -- NodePool ----------------------------------------------------------------
@@ -786,9 +793,17 @@ def node_to_manifest(n: Node) -> dict:
         "status": {
             "capacity": _node_status_map(n.capacity),
             "allocatable": _node_status_map(n.allocatable),
-            "conditions": [
-                {"type": "Ready", "status": "True" if n.ready else "False"}
-            ],
+            # the FULL condition set rides the wire: auto-repair reads
+            # impairment conditions (Ready/AcceleratedHardwareReady,
+            # cloudprovider.repair_policies) off the node, and dropping
+            # them here would blind it on a real bus. The kubelet-style
+            # Ready condition is synthesized from n.ready only when no
+            # explicit Ready condition exists.
+            "conditions": conditions_to_manifest(n) + (
+                []
+                if any(c.type == "Ready" for c in n.status_conditions.all())
+                else [{"type": "Ready", "status": "True" if n.ready else "False"}]
+            ),
         },
     }
 
@@ -841,6 +856,15 @@ def node_from_manifest(m: dict) -> Node:
     )
     meta_from_manifest(n, m)
     n.unschedulable = bool(spec.get("unschedulable", False))
+    # the SYNTHESIZED Ready condition (node_to_manifest emits it with NO
+    # reason key when no explicit Ready condition exists) stays out of
+    # status_conditions -- n.ready carries it; every real condition
+    # (always serialized WITH a reason key) round-trips, including
+    # explicit Ready ones the repair policies read
+    conditions_from_manifest(
+        n,
+        [c for c in status.get("conditions", ()) if c.get("type") != "Ready" or "reason" in c],
+    )
     n.ready = any(
         c.get("type") == "Ready" and c.get("status") == "True"
         for c in status.get("conditions", ())

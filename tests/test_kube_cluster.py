@@ -418,6 +418,32 @@ class TestOperatorOverFakeApiserver:
             cl.stop()
             srv.stop()
 
+    def test_impairment_conditions_survive_the_wire(self):
+        """Auto-repair reads impairment conditions off the Node: the FULL
+        condition set must round-trip the bus, or repair is blind in kube
+        mode (Ready is synthesized only when absent)."""
+        from karpenter_tpu.cache.ttl import FakeClock
+
+        srv = FakeApiServer().start()
+        try:
+            clock = FakeClock(100_000.0)
+            cl = KubeCluster(KubeClient(KubeConfig(server=srv.url)), clock=clock)
+            n = Node("sick", capacity=Resources({"cpu": "4", "memory": "8Gi"}))
+            n.ready = True
+            cl.create(n)
+            got = cl.get(Node, "sick")
+            got.ready = False
+            got.status_conditions.set_false("AcceleratedHardwareReady", "InstanceImpaired")
+            cl.update(got)
+            back = cl.get(Node, "sick")
+            cond = back.status_conditions.get("AcceleratedHardwareReady")
+            assert cond is not None and cond.status == "False", "repair signal lost on the bus"
+            assert cond.reason == "InstanceImpaired"
+            assert not back.ready
+        finally:
+            cl.stop()
+            srv.stop()
+
     def test_stateful_flow_over_the_wire(self):
         """Storage end-to-end on the REAL bus: a WFFC claim binds to the
         landing zone via the annotation merge-patch (PVC spec untouched),
