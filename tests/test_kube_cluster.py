@@ -444,6 +444,47 @@ class TestOperatorOverFakeApiserver:
             cl.stop()
             srv.stop()
 
+    def test_auto_repair_over_the_wire(self):
+        """Full repair flow on the real bus: degrade the instance, the
+        lifecycle surfaces the impairment condition THROUGH the wire, the
+        repair controller tolerates then replaces the claim."""
+        from karpenter_tpu.apis import NodeClaim
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.operator import Operator
+
+        srv = FakeApiServer().start()
+        try:
+            clock = FakeClock(100_000.0)
+            cl = KubeCluster(KubeClient(KubeConfig(server=srv.url)), clock=clock)
+            op = Operator(cluster=cl, clock=clock)
+            op.cluster.create(TPUNodeClass("default"))
+            op.cluster.create(NodePool("default"))
+            op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+            op.settle(max_ticks=40)
+            inst = [i for i in op.cloud.describe_instances() if i.state == "running"][0]
+            victim = next(
+                c.metadata.name for c in op.cluster.list(NodeClaim)
+                if c.provider_id == inst.provider_id
+            )
+            op.cloud.degrade_instance(inst.id)
+            op.tick()  # lifecycle propagates the impairment onto the bus
+            node = next(n for n in op.cluster.list(Node) if n.provider_id == inst.provider_id)
+            assert any(
+                c.status == "False" for c in node.status_conditions.all()
+            ), "impairment condition must survive the wire"
+            op.tick()  # repair observes (toleration window starts)
+            clock.step(31 * 60.0)
+            for _ in range(12):
+                op.tick()
+                clock.step(5.0)
+            live = {c.metadata.name: c.deleting for c in op.cluster.list(NodeClaim)}
+            assert victim not in live or live[victim], (
+                f"impaired claim must be repaired: {live}"
+            )
+        finally:
+            cl.stop()
+            srv.stop()
+
     def test_stateful_flow_over_the_wire(self):
         """Storage end-to-end on the REAL bus: a WFFC claim binds to the
         landing zone via the annotation merge-patch (PVC spec untouched),
