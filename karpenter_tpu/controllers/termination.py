@@ -32,9 +32,10 @@ SYSTEM_CRITICAL_PRIORITY = 2_000_000_000
 class TerminationController:
     log = get_logger("termination")
 
-    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider):
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, recorder=None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
+        self.recorder = recorder  # optional events.Recorder
         self._drain_started: dict = {}
 
     def reconcile_all(self) -> None:
@@ -118,4 +119,8 @@ class TerminationController:
             self.cluster.delete(Node, node.metadata.name)
         self.cluster.remove_finalizer(claim, TERMINATION_FINALIZER)
         self._drain_started.pop(claim.metadata.name, None)
+        if self.recorder is not None:
+            # the core publishes a terminated event per claim through its
+            # events.Recorder at the end of the drain flow
+            self.recorder.publish(claim, "Terminated", "drained and deleted")
         self.log.info("terminated node", nodeclaim=claim.metadata.name)

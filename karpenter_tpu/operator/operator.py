@@ -150,7 +150,7 @@ class Operator:
         )
         self.binder = PodBinder(self.cluster)
         self.lifecycle = NodeLifecycle(self.cluster, self.cloud)
-        self.termination = TerminationController(self.cluster, self.cloud_provider)
+        self.termination = TerminationController(self.cluster, self.cloud_provider, recorder=self.recorder)
         self.disruption = DisruptionController(
             self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates,
             evaluator=consolidation_evaluator, recorder=self.recorder,

@@ -35,7 +35,7 @@ def env():
     op.cluster.create(NodePool("default"))
     op.disruption = DisruptionController(op.cluster, op.cloud_provider, op.pricing,
                                          op.options.feature_gates, recorder=op.recorder)
-    op.termination = TerminationController(op.cluster, op.cloud_provider)
+    op.termination = TerminationController(op.cluster, op.cloud_provider, recorder=op.recorder)
     return op
 
 
@@ -75,6 +75,8 @@ class TestEmptiness:
         assert not env.cluster.list(Node)
         assert not env.cluster.list(NodeClaim)
         assert all(i.state == "terminated" for i in env.cloud.describe_instances())
+        # ...and the drain's end surfaces as a Terminated event
+        assert env.recorder.with_reason("Terminated")
 
     def test_young_empty_node_kept(self, env):
         pod = Pod("p0", requests=Resources({"cpu": "1"}))
