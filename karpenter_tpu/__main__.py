@@ -12,10 +12,8 @@ plane -- runs self-contained). Flags mirror pkg/operator/options/options.go.
 from __future__ import annotations
 
 import argparse
-import json
 import signal
 import sys
-import time
 
 
 def build_operator(args):
