@@ -194,10 +194,13 @@ class TestReplacementSearch:
         assert v.replace_od_price >= v.replace_price  # spot can only be cheaper
 
 
-def build_overprovisioned(clock_start=100_000.0, evaluator=None, pools=None):
+def build_overprovisioned(clock_start=100_000.0, evaluator=None, pools=None,
+                          volumes=False):
     """Two nodes left holding one small pod each (the big pods that forced
     two nodes are deleted): the classic deletion-consolidation setup the
-    reference scale tests use. Pass `pools` for a multi-pool variant."""
+    reference scale tests use. Pass `pools` for a multi-pool variant;
+    `volumes=True` gives each surviving pod a bound claim (the device
+    evaluator must judge the RESOLVED demand, apis/storage)."""
     clock = FakeClock(clock_start)
     op = Operator(clock=clock, consolidation_evaluator=evaluator)
     op.cluster.create(TPUNodeClass("default"))
@@ -206,7 +209,14 @@ def build_overprovisioned(clock_start=100_000.0, evaluator=None, pools=None):
     for i in range(2):
         op.cluster.create(Pod(f"big{i}", requests=Resources({"cpu": "3", "memory": "4Gi"})))
         op.settle(max_ticks=30)
-        op.cluster.create(Pod(f"small{i}", requests=Resources({"cpu": "600m", "memory": "512Mi"})))
+        claims = ()
+        if volumes:
+            from karpenter_tpu.apis.storage import PersistentVolumeClaim
+
+            op.cluster.create(PersistentVolumeClaim(f"pv{i}"))
+            claims = (f"pv{i}",)
+        op.cluster.create(Pod(f"small{i}", requests=Resources({"cpu": "600m", "memory": "512Mi"}),
+                              volume_claims=claims))
         op.settle(max_ticks=30)
     assert not op.cluster.pending_pods()
     for i in range(2):
@@ -243,6 +253,35 @@ class TestControllerEquivalence:
         d_device = device.disruption.reconcile(max_disruptions=5)
         assert d_plain, "scenario should produce a consolidation decision"
         assert logical(plain, d_plain) == logical(device, d_device)
+
+    def test_same_decisions_with_volume_backed_pods(self):
+        """Volume-carrying survivors: both paths judge the RESOLVED demand
+        (attach counts + bound zones), so decisions still agree -- and a
+        consolidated pod's zonal volume is honored by the move."""
+        plain = build_overprovisioned(volumes=True)
+        device = build_overprovisioned(evaluator=ConsolidationEvaluator(), volumes=True)
+        if len(plain.cluster.list(NodeClaim)) < 2:
+            pytest.skip("pods packed onto one node; nothing to consolidate")
+        for op in (plain, device):
+            op.clock.step(MIN_NODE_LIFETIME + 60)
+        d_plain = plain.disruption.reconcile(max_disruptions=5)
+        d_device = device.disruption.reconcile(max_disruptions=5)
+        reasons = lambda ds: sorted(r for _, r in ds)
+        assert reasons(d_plain) == reasons(d_device)
+        # after the drain settles, every volume pod sits in its claim's zone
+        for op in (plain, device):
+            for _ in range(10):
+                op.tick()
+                op.clock.step(3.0)
+            from karpenter_tpu.apis.storage import PersistentVolumeClaim, VolumeIndex
+
+            idx = VolumeIndex.from_cluster(op.cluster)
+            nodes = {n.metadata.name: n for n in op.cluster.list(Node)}
+            for p in op.cluster.list(Pod):
+                if p.volume_claims and p.node_name:
+                    _, zone, _ = idx.lookup(p)
+                    if zone is not None:
+                        assert nodes[p.node_name].zone == zone
 
     def test_same_decisions_across_overlapping_pools(self):
         """Multi-pool parity: the device evaluator's verdicts and the
