@@ -87,7 +87,9 @@ def test_soak_over_the_wire_bus():
         pod_seq = 0
         sizes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi")]
         for round_i in range(6):
-            event = rng.choice(["burst", "stateful", "shrink", "kill", "age"])
+            event = rng.choice(
+                ["burst", "stateful", "shrink", "kill", "interrupt", "age"]
+            )
             if event == "burst":
                 for _ in range(int(rng.integers(2, 8))):
                     cpu, mem = sizes[int(rng.integers(0, len(sizes)))]
@@ -116,6 +118,14 @@ def test_soak_over_the_wire_bus():
                 insts = [i for i in op.cloud.describe_instances() if i.state == "running"]
                 if insts:
                     op.cloud.kill_instance(insts[int(rng.integers(0, len(insts)))].id)
+            elif event == "interrupt":
+                claims = [
+                    c for c in op.cluster.list(NodeClaim)
+                    if c.provider_id and not c.deleting
+                ]
+                if claims:
+                    victim = claims[int(rng.integers(0, len(claims)))]
+                    op.cloud.send(spot_msg(parse_instance_id(victim.provider_id)))
             elif event == "age":
                 clock.step(400.0)
             for _ in range(40):
