@@ -171,6 +171,25 @@ class TestAttachLimits:
             limit = it.capacity.get(res.ATTACHABLE_VOLUMES)
             assert 8 <= limit <= 40
 
+    def test_attach_limit_curve(self):
+        """The deterministic curve: 28 slots through 64 vcpus, 40 above;
+        NICs consume shared slots; the floor is 8."""
+        from dataclasses import replace
+
+        from karpenter_tpu.providers.instancetype import gen_catalog
+        from karpenter_tpu.providers.instancetype.types import volume_attach_limit
+
+        base = next(i for i in gen_catalog.generate_instance_types() if not i.bare_metal)
+        small = replace(base, vcpu=64, max_network_interfaces=3)
+        assert volume_attach_limit(small) == 28 - 3 - 1
+        big = replace(base, vcpu=65, max_network_interfaces=3)
+        assert volume_attach_limit(big) == 40 - 3 - 1
+        nic_heavy = replace(base, vcpu=8, max_network_interfaces=25)
+        assert volume_attach_limit(nic_heavy) == 8  # floor
+        # monotone in vcpu tier, antitone in NIC count
+        assert volume_attach_limit(big) > volume_attach_limit(small)
+        assert volume_attach_limit(nic_heavy) <= volume_attach_limit(small)
+
     def test_volume_fanout_differential(self, catalog_items):
         """Attach-heavy pods must fan out across nodes, identically on the
         oracle and the device path -- the axis rides the same vector fit."""
