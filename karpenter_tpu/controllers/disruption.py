@@ -111,6 +111,8 @@ class DisruptionController:
         # per-pass claim/class snapshot for volume lowering (built once in
         # _reconcile; helpers called directly, e.g. from tests, build fresh)
         self._pass_vol_index = None
+        # pods whose simulation exclusion was already logged this pass
+        self._pass_blocked_logged: set = set()
         # (budget id, minute) -> bool; bounded, cleared on overflow
         self._budget_active_memo: Dict[tuple, bool] = {}
 
@@ -236,6 +238,25 @@ class DisruptionController:
             return self._pass_vol_index
         return VolumeIndex.from_cluster(self.cluster)
 
+    def _effective_in_flight(self, vol_index) -> List[Pod]:
+        """Resolved in-flight pods (see _in_flight_pods). Vol-blocked ones
+        are DROPPED, not vetoes: they are unschedulable with or without
+        the disruption under evaluation, and letting one frozen PVC
+        freeze consolidation cluster-wide starves every other candidate
+        (ADVICE round 4). Each drop is logged once per pass so the
+        exclusion is operator-visible."""
+        from karpenter_tpu.apis.storage import effective_pods
+
+        pods, blocked = effective_pods(self._in_flight_pods(), vol_index)
+        for name, reason in blocked.items():
+            if name not in self._pass_blocked_logged:
+                self._pass_blocked_logged.add(name)
+                self.log.warning(
+                    "in-flight pod excluded from disruption simulation",
+                    pod=name, reason=reason,
+                )
+        return pods
+
     def _other_nodes(self, excluded: Sequence[str]) -> List[ExistingNode]:
         out = []
         vol_index = self._vol_index()
@@ -279,15 +300,22 @@ class DisruptionController:
         from karpenter_tpu.apis.storage import effective_pods
 
         excluded = [c.node.metadata.name for c in candidates] + list(self._pass_disrupted)
-        pods = self._in_flight_pods() + [
-            p for c in candidates for p in c.pods if p.reschedulable()
-        ]
         # volume-backed pods re-simulate with their attach counts and
         # bound-zone pins (claims are bound by now: the pod ran), so
-        # consolidation never plans a move a zonal volume forbids
-        pods, vol_blocked = effective_pods(pods, self._vol_index())
+        # consolidation never plans a move a zonal volume forbids. A
+        # vol-blocked pod VETOES only when it runs on a candidate: evicting
+        # it would strand a pod that cannot rebind. In-flight pods from
+        # nodes disrupted earlier this pass are dropped instead of vetoing
+        # -- they are unschedulable with or without this disruption, and
+        # letting one frozen PVC freeze all consolidation cluster-wide
+        # starves every other candidate (ADVICE round 4).
+        vol_index = self._vol_index()
+        in_flight = self._effective_in_flight(vol_index)
+        own = [p for c in candidates for p in c.pods if p.reschedulable()]
+        own, vol_blocked = effective_pods(own, vol_index)
         if vol_blocked:
             return False, []
+        pods = in_flight + own
         nodepools, pass_catalogs = self._pool_context()
         catalogs: Dict[str, list] = {}
         zones: set = set()
@@ -329,6 +357,11 @@ class DisruptionController:
             self._pass_pools, self._pass_catalogs = None, None
             self._pass_pdb_guard = None
             self._pass_daemon_overhead = None
+            # drop the claim snapshot too: helpers called between passes
+            # (tests, ad-hoc verdicts) must see the live cluster, not the
+            # last pass's volume world
+            self._pass_vol_index = None
+            self._pass_blocked_logged = set()
             metrics.DISRUPTION_EVAL_DURATION.observe(_time.perf_counter() - t0)
 
     def _daemon_overhead(self, pools) -> Dict[str, "Resources"]:
@@ -366,6 +399,7 @@ class DisruptionController:
 
         self.last_decisions = []
         self._pass_disrupted = []
+        self._pass_blocked_logged = set()
         self._pass_vol_index = VolumeIndex.from_cluster(self.cluster)
         self._pass_pools, self._pass_catalogs = None, None
         self._pass_pdb_guard = None
@@ -570,8 +604,10 @@ class DisruptionController:
             if blocked:
                 return None
             resched[c.claim.metadata.name] = eff
-        in_flight, if_blocked = effective_pods(self._in_flight_pods(), vol_index)
-        if if_blocked or not all(
+        # vol-blocked in-flight pods are dropped, same as _simulate: they
+        # must not push every candidate onto the oracle path either
+        in_flight = self._effective_in_flight(vol_index)
+        if not all(
             device_eligible(resched[c.claim.metadata.name]) for c in remaining
         ) or not device_eligible(in_flight):
             return None
@@ -645,8 +681,10 @@ class DisruptionController:
         # Survivor headroom already counts attachments (_other_nodes ->
         # node_usage), so both sides of the repack see the same axis.
         vol_index = self._vol_index()
-        in_flight, if_blocked = effective_pods(self._in_flight_pods(), vol_index)
-        if if_blocked or (in_flight and not device_eligible(in_flight)):
+        # vol-blocked in-flight pods are dropped (logged), same as
+        # _simulate: one frozen PVC must not disable the fast path
+        in_flight = self._effective_in_flight(vol_index)
+        if in_flight and not device_eligible(in_flight):
             # in-flight pods carry stateful constraints the evaluator does
             # not model; every remaining candidate takes the oracle path
             return {}

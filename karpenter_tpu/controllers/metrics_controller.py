@@ -98,7 +98,8 @@ class MetricsController:
             if not pool_name or not claim.launched():
                 continue
             totals[pool_name] = totals.get(pool_name, Resources()) + claim.capacity
-        from karpenter_tpu.kwok.cluster import Conflict
+        from karpenter_tpu.kube.client import ApiError, NotFound as HttpNotFound
+        from karpenter_tpu.kwok.cluster import Conflict, NotFound
 
         for pool in self.cluster.list(NodePool):
             want = totals.get(pool.metadata.name, Resources())
@@ -106,8 +107,14 @@ class MetricsController:
                 pool.status_resources = want
                 try:
                     self.cluster.update(pool)
-                except Conflict:
-                    pass  # stale read vs a concurrent writer: next sweep retries
+                except (Conflict, NotFound, HttpNotFound):
+                    pass  # stale read vs a concurrent writer/deleter: next sweep retries
+                except (ApiError, OSError) as e:  # kube mode: a racing delete
+                    # or apiserver hiccup (HTTP error or transport failure --
+                    # socket/ssl errors are OSErrors) must not abort the whole
+                    # operator tick (ADVICE round 4); the sweep is idempotent
+                    # next tick
+                    self.log.warning("pool status update failed", error=str(e))
 
 
     def _sweep_conditions(self) -> None:

@@ -814,9 +814,12 @@ def node_to_manifest(n: Node) -> dict:
 # the node's real CSINode driver count when one exists; this constant
 # covers nodes with no CSINode (or no driver reporting a count), where
 # leaving the axis at 0 would make every claim-carrying pod unfittable.
-# 24 is at/below every curve value providers/instancetype/types.
-# volume_attach_limit produces, so the assumption only ever under-packs.
-DEFAULT_NODE_ATTACH_LIMIT = 24.0
+# 8 is the FLOOR of providers/instancetype/types.volume_attach_limit
+# (max(8, slots - nics - 1)), so the assumption only ever under-packs:
+# NIC-rich mid-size shapes bottom out at 8, and assuming more than a
+# node can actually attach would over-pack volume-backed pods onto it
+# (ADVICE round 4).
+DEFAULT_NODE_ATTACH_LIMIT = 8.0
 
 
 def node_resources_from_map(m: Optional[Dict[str, str]]) -> Resources:

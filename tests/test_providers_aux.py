@@ -313,6 +313,31 @@ class TestMetricsController:
             op.clock.step(10.0)
         assert op.cluster.get(NodePool, "default").status_resources == Resources()
 
+    @pytest.mark.parametrize("exc_factory", [
+        lambda: __import__("karpenter_tpu.kwok.cluster", fromlist=["NotFound"]).NotFound("gone"),
+        lambda: __import__("karpenter_tpu.kube.client", fromlist=["ApiError"]).ApiError(500, "boom"),
+    ])
+    def test_pool_status_sweep_survives_racing_delete(self, clock, exc_factory, monkeypatch):
+        """A NodePool deleted between the sweep's list and its update (or a
+        kube-mode apiserver error) must not abort the operator tick
+        (ADVICE round 4): the sweep is idempotent next tick."""
+        op = Operator(clock=clock)
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(Pod("p-1", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        op.settle()
+        real_update = op.cluster.update
+
+        def racing_update(obj):
+            if isinstance(obj, NodePool):
+                raise exc_factory()
+            return real_update(obj)
+
+        monkeypatch.setattr(op.cluster, "update", racing_update)
+        # force a dirty aggregate so the sweep actually writes
+        op.cluster.get(NodePool, "default").status_resources = Resources()
+        op.metrics_controller.reconcile_all()  # must not raise
+
 
 class TestE2EStillTagsClaims:
     def test_per_claim_tags_applied_post_registration(self, clock):

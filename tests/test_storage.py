@@ -428,6 +428,51 @@ class TestConsolidationAttachBudgets:
         assert decisions, "attach-feasible consolidation must act"
         assert all(r in ("Underutilized", "Empty") for _, r in decisions)
 
+    def test_vol_blocked_in_flight_pod_does_not_veto(self):
+        """A reschedulable pod stranded mid-pass on an already-disrupted
+        node whose PVC is MISSING is unschedulable with or without the
+        next disruption; it must be dropped from later candidates'
+        simulations, not veto them — one frozen claim must not freeze
+        consolidation cluster-wide (ADVICE round 4)."""
+        clock = FakeClock(start=10_000.0)
+        op = Operator(clock=clock)
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(mk_pod("web-0"))
+        op.settle(max_ticks=30)
+        assert not op.cluster.pending_pods()
+        stuck = mk_pod("stuck", claims=("ghost",))
+        stuck.node_name = "node-gone"
+        op.cluster.create(stuck)
+        ctrl = op.disruption
+        ctrl._pass_disrupted = ["node-gone"]
+        try:
+            cands = ctrl._candidates()
+            assert cands
+            ok, _groups = ctrl._simulate(cands[:1], allow_new_node=True)
+        finally:
+            ctrl._pass_disrupted = []
+        assert ok, "vol-blocked in-flight pod must not veto other candidates"
+
+    def test_candidates_own_vol_blocked_pod_still_vetoes(self):
+        """The veto survives where it is load-bearing: evicting a node
+        whose OWN pod cannot re-resolve its volume would strand the pod."""
+        clock = FakeClock(start=10_000.0)
+        op = Operator(clock=clock)
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(PersistentVolumeClaim("data-0"))
+        op.cluster.create(mk_pod("web-0", claims=("data-0",)))
+        op.settle(max_ticks=30)
+        assert not op.cluster.pending_pods()
+        # the claim disappears out from under the running pod
+        op.cluster.delete(PersistentVolumeClaim, "data-0")
+        ctrl = op.disruption
+        cands = ctrl._candidates()
+        assert cands
+        ok, _groups = ctrl._simulate(cands[:1], allow_new_node=True)
+        assert not ok, "candidate's own vol-blocked pod must veto its disruption"
+
 
 class TestKubeConversions:
     def test_pvc_round_trip(self):
