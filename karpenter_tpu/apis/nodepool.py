@@ -230,4 +230,4 @@ class NodePool(APIObject):
     def within_limits(self, usage: Resources) -> bool:
         if self.limits is None:
             return True
-        return usage.fits(self.limits)
+        return usage.within(self.limits)

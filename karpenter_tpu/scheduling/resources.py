@@ -207,6 +207,15 @@ class Resources:
         """True iff every requested axis is satisfiable within `capacity`."""
         return all(v <= capacity.get(k) + 1e-9 for k, v in self._v.items())
 
+    def within(self, limits: "Resources") -> bool:
+        """True iff every axis NAMED BY `limits` is at or under it. Axes
+        absent from limits are UNCONSTRAINED -- NodePool-limits semantics
+        (the reference caps only the resources the operator lists,
+        `nodepool.spec.limits`). fits() is the wrong shape for that check:
+        a cpu-only limit would read every other axis as capacity 0 and
+        refuse all capacity (round-5 finding)."""
+        return all(self._v.get(k, 0.0) <= v + 1e-9 for k, v in limits._v.items())
+
     def any_negative(self) -> bool:
         return any(v < -1e-9 for v in self._v.values())
 

@@ -216,10 +216,12 @@ class PodClass:
     # objective): -1 = use the in-scan leftover; spread sub-classes pin 1
     env_count: int = -1
     # OR of routing-relevant constraint bits over EVERY signature that
-    # merged into this class (affinity terms are deliberately NOT part of
-    # _class_key -- the oracle's price envelope wants an affinity follower
-    # to share its anchor's class -- so the class representative alone
-    # cannot answer "does anyone here carry affinity?"; these bits can)
+    # merged into this class. The TERMS themselves are not in _class_key
+    # (pods with different affinity targets but one shape still share a
+    # class -- the oracle reads each pod's own terms at placement), but
+    # oracle_suffix_rank IS: plain pods never merge behind a constrained
+    # representative, so these bits answer "does anyone here carry
+    # affinity?" exactly for the whole class (round 5)
     has_affinity: bool = False
     multi_node_affinity: bool = False
     has_preferences: bool = False
@@ -314,14 +316,36 @@ def _spread_sig(pod: Pod) -> tuple:
     return sig
 
 
+def oracle_suffix_rank(pod: Pod) -> int:
+    """1 for pods the device kernels cannot place -- pod (anti-)affinity,
+    OR-of-node-affinity-terms, preferences -- the ORACLE-SUFFIX partition;
+    0 for everything else. The rank LEADS the canonical sort, so every
+    suffix pod schedules after every plain pod. That makes the class-level
+    carve-out (device solves the plain prefix, the oracle continues with
+    the suffix over the device's open state) order-equivalent to one full
+    oracle pass over the whole batch (round 5): by the time a suffix pod
+    places, the full pass and the split pass have built the same world.
+    Scheduling constrained pods after their potential co-location targets
+    also strictly helps required-affinity feasibility (the targets exist
+    by then), replacing most uses of the self-match bootstrap rule."""
+    return int(
+        bool(pod.affinity_terms)
+        or len(pod.node_affinity_terms) > 1
+        or bool(pod.preferred_node_affinity_terms)
+        or bool(pod.preferred_affinity_terms)
+    )
+
+
 def pod_sort_key(pod: Pod) -> tuple:
-    """The canonical scheduling order: dominant resource descending, then a
-    pool-independent class signature as the tie-break. BOTH the oracle's
-    per-pod loop and group_pods' class order sort by this key, so pods of
-    equal size but different classes are processed in the same relative
-    order on both paths -- shared spread counts then evolve identically."""
+    """The canonical scheduling order: oracle-suffix pods last, then
+    dominant resource descending, then a pool-independent class signature
+    as the tie-break. BOTH the oracle's per-pod loop and group_pods' class
+    order sort by this key, so pods of equal size but different classes
+    are processed in the same relative order on both paths -- shared
+    spread counts then evolve identically."""
     reqs = pod.scheduling_requirements()[0]
     return (
+        oracle_suffix_rank(pod),
         -pod.requests.get(res.CPU),
         -pod.requests.get(res.MEMORY),
         # full request vector: classes may differ only in another axis
@@ -335,6 +359,13 @@ def pod_sort_key(pod: Pod) -> tuple:
 
 def _class_key(pod: Pod, reqs: Requirements) -> tuple:
     return (
+        # suffix rank in the key: a class never mixes plain and
+        # oracle-suffix pods, so the carve-out partitions EXACTLY along
+        # class boundaries. Price envelopes deliberately IGNORE the rank
+        # (oracle._env_key strips element 0) so a follower still shares
+        # its anchor's envelope; the carve is blocked on such collisions
+        # (service._aff_partition_blocked)
+        oracle_suffix_rank(pod),
         tuple(np.asarray(scale_vector(
             (pod.requests + _one_pod()).to_vector()), dtype=np.float64)),
         reqs.stable_hash(),

@@ -607,9 +607,16 @@ class Scheduler:
         memo_key = (pool.name, pod.grouping_signature())
         key = self._env_key_memo.get(memo_key)
         if key is None:
-            # group_pods orientation: pod requirements + pool extras
+            # group_pods orientation: pod requirements + pool extras. The
+            # suffix rank (_class_key[0]) is STRIPPED: an affinity follower
+            # shares its anchor's price envelope even though it can no
+            # longer share its class -- the envelope sizes the anchor's
+            # group for the followers too. The device/oracle split stays
+            # sound because supports() BLOCKS the carve whenever a suffix
+            # pod's rank-stripped key collides with a device class
+            # (_aff_partition_blocked key-collision check).
             merged = pod.scheduling_requirements()[0].copy().add(*pool.requirements())
-            key = self._env_key_memo[memo_key] = (pool.name, _enc._class_key(pod, merged))
+            key = self._env_key_memo[memo_key] = (pool.name, _enc._class_key(pod, merged)[1:])
         return key
 
     def _note_placed(self, pod: Pod) -> None:
@@ -815,7 +822,7 @@ class Scheduler:
             if pool.limits is not None:
                 usage = self.usage.get(pool.name, Resources())
                 smallest = min(candidates, key=lambda it: it.capacity.get(res.CPU))
-                if not (usage + smallest.capacity).fits(pool.limits):
+                if not (usage + smallest.capacity).within(pool.limits):
                     last_reason = f"nodepool {pool.name} limits exceeded"
                     continue
                 self.usage[pool.name] = usage + smallest.capacity
@@ -838,10 +845,17 @@ class Scheduler:
         return last_reason
 
     # -- entry point --------------------------------------------------------
-    def schedule(self, pods: Sequence[Pod]) -> SchedulingResult:
-        result = SchedulingResult()
+    def schedule(
+        self, pods: Sequence[Pod], seed_result: Optional[SchedulingResult] = None
+    ) -> SchedulingResult:
+        # seed_result: continue a pass over an already-built result -- the
+        # oracle-suffix carve (service._oracle_suffix) hands the device
+        # pass's open groups here so suffix pods can JOIN them exactly as
+        # one full pass would; placements land in the shared result
+        result = seed_result if seed_result is not None else SchedulingResult()
         # canonical order shared with the batch solver (encode.pod_sort_key):
-        # dominant size descending, pool-independent class-signature tie-break
+        # suffix rank, then dominant size descending, pool-independent
+        # class-signature tie-break
         from karpenter_tpu.solver.encode import pod_sort_key
 
         ordered = sorted(pods, key=pod_sort_key)

@@ -6,14 +6,17 @@ in-process version of the reference's out-of-process seam (SURVEY.md
 section 2.4 maps the cloud-RPC boundary to a gRPC solver service; the
 request/response here is already tensor-shaped for that move).
 
-Scope routing (round 4): the batch path covers existing-node packing,
+Scope routing (round 5): the batch path covers existing-node packing,
 zone topology spread (hard and soft), several nodepools (disjoint via
 pool-sequential solves, overlapping via the merged-catalog solve in
-solver/multipool.py), and class-level minValues partitioning. What still
-falls back to the authoritative Python oracle: pod (anti-)affinity and
-weighted preferences (per-pod relaxation ladders), hostname spread,
-multi-term node affinity, and the documented carve-outs
-(docs/parity.md).
+solver/multipool.py), class-level minValues partitioning (oracle prefix),
+and class-level affinity/preference partitioning (oracle SUFFIX: those
+pods sort last in the canonical order, the device solves the plain
+prefix, and the oracle continues the same pass over the device's state
+-- _oracle_suffix). What still routes the WHOLE batch to the
+authoritative Python oracle: hostname spread, coupled partitions
+(_aff_partition_blocked / _mv_partition_blocked), and the documented
+carve-outs (docs/parity.md).
 """
 from __future__ import annotations
 
@@ -107,6 +110,8 @@ class TPUSolver:
         self._seq_prefix = uuid.uuid4().hex[:12]
         self._seq_counter = 0
         self._warmed_pads: set = set()
+        # routing observability for the last schedule() batch
+        self.last_route = {"device_pods": 0, "oracle_pods": 0, "path": "none"}
         # merged multi-pool catalog lists, keyed by (per-pool catalog ids,
         # per-pool requirement hashes); bounded (catalogs refresh 12-hourly)
         self._merged_cache: Dict[tuple, tuple] = {}
@@ -268,12 +273,35 @@ class TPUSolver:
             rest = [pc for pc in classes if id(pc) not in mv_ids]
             if not rest or TPUSolver._mv_partition_blocked(scheduler, mv_classes, rest):
                 return False
+        # oracle-suffix partition (round 5): affinity/preference classes no
+        # longer route the whole batch to the oracle. They sort LAST in the
+        # canonical order (encode.oracle_suffix_rank), so "device solves the
+        # plain classes, the oracle continues with the suffix over the
+        # device's state" is order-equivalent to one full oracle pass --
+        # provided the partitions cannot interact through labels or shared
+        # spread selectors (_aff_partition_blocked), there is no minValues
+        # prefix in the same batch (three-way state threading not
+        # implemented), and no multi-pool overlap (the merged-catalog solve
+        # does not model the suffix hand-off).
+        aff_classes = TPUSolver._suffix_classes(classes)
+        device_classes = classes
+        if aff_classes:
+            aff_ids = {id(pc) for pc in aff_classes}
+            device_classes = [pc for pc in classes if id(pc) not in aff_ids]
+            if not device_classes or mv_classes:
+                return False
+            if overlap is None:
+                overlap = len(scheduler.nodepools) > 1 and TPUSolver._pools_overlap(
+                    scheduler.nodepools, pods, classes=classes
+                )
+            if overlap:
+                return False
+            if TPUSolver._aff_partition_blocked(scheduler, aff_classes, device_classes):
+                return False
         reps = []
         any_spread = False
         any_soft = False
-        for pc in classes:
-            if pc.has_affinity or pc.multi_node_affinity or pc.has_preferences:
-                return False
+        for pc in device_classes:
             p = pc.pods[0]
             reps.append(p)
             if any(r.min_values is not None for r in pc.requirements):
@@ -386,6 +414,92 @@ class TPUSolver:
         return bool(spread_keys(mv_classes) & spread_keys(rest))
 
     @staticmethod
+    def _suffix_classes(classes) -> list:
+        """The oracle-suffix partition: classes whose pods the device
+        kernels cannot place (the class-level mirror of
+        encode.oracle_suffix_rank -- _class_key embeds the rank, so the
+        flags are uniform across a class)."""
+        return [
+            pc for pc in classes
+            if pc.has_affinity or pc.multi_node_affinity or pc.has_preferences
+        ]
+
+    @staticmethod
+    def _aff_partition_blocked(scheduler: Scheduler, aff_classes, rest) -> bool:
+        """True when the oracle-suffix partition could interact with the
+        device partition through any channel other than the sequenced
+        state hand-off, so the split would not equal one full oracle pass:
+
+        - LABEL COUPLING: a suffix pod's (anti-)affinity or preferred
+          (anti-)affinity selector matches some device-partition pod's
+          labels. The suffix pass deliberately does not ingest the device
+          pods' labels (50k dict copies would eat the latency budget);
+          blocking on any possible match is what makes that sound.
+        - shared topology-spread selector: spread counts are global per
+          constraint selector, and the suffix would need the device
+          pass's counts (same condition as the minValues split).
+
+        - shared price envelope: _env_key strips the suffix rank so an
+          affinity follower still shares its ANCHOR's envelope (the
+          anchor's group is sized for its followers); when a suffix pod's
+          rank-stripped key coincides with a device class under some
+          pool's merge, the two sides share envelope state and the split
+          would diverge -- blocked.
+        - pool LIMITS on any pool: the oracle charges a group's smallest
+          candidate at OPEN time (pre-join), while the device decode's
+          guard charges the smallest FINAL survivor -- re-deriving the
+          oracle's open-time charge from decoded groups is not possible,
+          so a seeded suffix could spuriously hit (or miss) a limit the
+          full pass would not (round-5 review finding) -- blocked.
+
+        Existing nodes need NO blocking here, unlike the minValues
+        prefix: the suffix runs AFTER the device pass in the canonical
+        order (encode.oracle_suffix_rank leads pod_sort_key), over the
+        device pass's booked node capacity (_oracle_suffix seeds it)."""
+        if any(p.limits is not None for p in scheduler.nodepools):
+            return True
+        selectors: Dict[tuple, dict] = {}
+        for pc in aff_classes:
+            for p in pc.pods:
+                for t in p.affinity_terms:
+                    selectors[tuple(sorted(t.label_selector.items()))] = t.label_selector
+                for _, t in p.preferred_affinity_terms:
+                    selectors[tuple(sorted(t.label_selector.items()))] = t.label_selector
+        if selectors:
+            sels = list(selectors.values())
+            for pc in rest:
+                for p in pc.pods:
+                    labels = p.metadata.labels
+                    for s in sels:
+                        if all(labels.get(k) == v for k, v in s.items()):
+                            return True
+
+        def spread_keys(side) -> set:
+            return {
+                (t.topology_key, tuple(sorted(t.label_selector.items())))
+                for pc in side
+                for t in pc.pods[0].topology_spread
+            }
+
+        if spread_keys(aff_classes) & spread_keys(rest):
+            return True
+
+        from karpenter_tpu.solver.encode import _class_key
+
+        def merged_keys(side, extra) -> set:
+            out = set()
+            for pc in side:
+                reqs = pc.requirements.copy().add(*extra) if extra else pc.requirements
+                out.add(_class_key(pc.pods[0], reqs)[1:])
+            return out
+
+        for pool in scheduler.nodepools:
+            extra = list(pool.requirements())
+            if merged_keys(aff_classes, extra) & merged_keys(rest, extra):
+                return True
+        return False
+
+    @staticmethod
     def _pools_overlap(pools: Sequence[NodePool], pods: Sequence[Pod], classes=None) -> bool:
         """True when some pod class is compatible with more than one pool
         (the oracle's _open_group gate, per class instead of per pod)."""
@@ -421,6 +535,10 @@ class TPUSolver:
         # class-level copies (encode.with_extra_requirements)
         base_classes = encode.group_pods(pods)
         pools = scheduler.nodepools
+        # routing observability: how many pods of the last batch ran on
+        # which path (the carve fuzz asserts the device fraction; the
+        # route log lines quote it)
+        self.last_route = {"device_pods": len(pods), "oracle_pods": 0, "path": "device"}
         overlap = len(pools) > 1 and self._pools_overlap(pools, pods, classes=base_classes)
         if not self.supports(scheduler, pods, classes=base_classes, overlap=overlap):
             # the fallback must pack with THIS solver's objective -- callers
@@ -429,6 +547,7 @@ class TPUSolver:
             if self._route_monitor.has_changed("route", "oracle"):
                 self.log.info("routing to oracle", pods=len(pods), reason="unsupported constraints")
             scheduler.objective = self.objective
+            self.last_route = {"device_pods": 0, "oracle_pods": len(pods), "path": "oracle"}
             return scheduler.schedule(pods)
         # pools in weight order, first-feasible-pool-wins: each pool's batch
         # solve takes the previous pool's unschedulable leftovers (the
@@ -444,9 +563,33 @@ class TPUSolver:
             # oracle remains the fallback for the carve-outs.
             merged = self._try_solve_merged(scheduler, pods, base_classes)
             if merged is not None:
+                self.last_route = {"device_pods": len(pods), "oracle_pods": 0, "path": "merged"}
                 return merged
             scheduler.objective = self.objective
+            self.last_route = {"device_pods": 0, "oracle_pods": len(pods), "path": "oracle"}
             return scheduler.schedule(pods)
+        # oracle-suffix split (round 5): affinity/preference classes sort
+        # last in the canonical order, so the device solves the plain
+        # prefix and the oracle CONTINUES the same pass over the suffix
+        # (_oracle_suffix seeds the device pass's bookings). supports()
+        # verified the partitions cannot otherwise interact
+        # (_aff_partition_blocked) and that no minValues prefix coexists.
+        aff_pods: List[Pod] = []
+        aff_classes = self._suffix_classes(base_classes)
+        if aff_classes:
+            aff_ids = {id(pc) for pc in aff_classes}
+            aff_pods = [p for pc in aff_classes for p in pc.pods]
+            base_classes = [pc for pc in base_classes if id(pc) not in aff_ids]
+            pods = [p for pc in base_classes for p in pc.pods]
+            self.last_route = {
+                "device_pods": len(pods), "oracle_pods": len(aff_pods),
+                "path": "device+suffix",
+            }
+            if self._route_monitor.has_changed("route_aff", len(aff_pods)):
+                self.log.info(
+                    "affinity/preference suffix to oracle, prefix on device",
+                    oracle_pods=len(aff_pods), device_pods=len(pods),
+                )
         # minValues class-level split (round 4): supports() has already
         # verified the partition is uncoupled (no shared existing node, no
         # shared spread selector; overlap was gated above), so the
@@ -460,6 +603,10 @@ class TPUSolver:
             mv_pods = [p for pc in mv_classes for p in pc.pods]
             base_classes = [pc for pc in base_classes if id(pc) not in mv_ids]
             pods = [p for pc in base_classes for p in pc.pods]
+            self.last_route = {
+                "device_pods": len(pods), "oracle_pods": len(mv_pods),
+                "path": "prefix+device",
+            }
             if self._route_monitor.has_changed("route_mv", len(mv_pods)):
                 self.log.info(
                     "minValues classes to oracle, remainder on device",
@@ -504,7 +651,41 @@ class TPUSolver:
             # each round's leftovers, which must not clobber the oracle
             # partition's entries
             result.unschedulable.update(mv_result.unschedulable)
+        if aff_pods:
+            self._oracle_suffix(scheduler, aff_pods, pods, result)
         return result
+
+    def _oracle_suffix(
+        self, scheduler: Scheduler, aff_pods: List[Pod],
+        device_pods: Sequence[Pod], result: SchedulingResult,
+    ) -> None:
+        """Continue the canonical pass on the oracle for the suffix
+        partition (affinity/preference pods). Seeds the scheduler with
+        everything the device pass booked, then schedules the suffix INTO
+        the shared result, so suffix pods join device-opened groups, pack
+        onto the device pass's remaining existing capacity, and respect
+        pool limits exactly as one full oracle pass would.
+
+        The device pass's pod LABELS are deliberately not ingested:
+        supports() blocked the split unless no suffix selector can match
+        them (_aff_partition_blocked), which keeps this hand-off O(result)
+        instead of O(50k label dicts)."""
+        # existing-node bookings: _pack_existing records assignments but
+        # does not mutate node.used (the oracle's _try_existing does) --
+        # apply them so the suffix sees post-prefix remaining capacity.
+        # Pool limits need no hand-off: supports() BLOCKS the carve when
+        # any pool carries limits (open-time vs final-survivor charge
+        # divergence -- see _aff_partition_blocked).
+        if result.existing_assignments:
+            by_name = {p.metadata.name: p for p in device_pods}
+            nodes = {n.name: n for n in scheduler.existing}
+            one_pod = Resources.from_base_units({res.PODS: 1})
+            for pod_name, node_name in result.existing_assignments.items():
+                p, node = by_name.get(pod_name), nodes.get(node_name)
+                if p is not None and node is not None:
+                    node.used = node.used + p.requests + one_pod
+        scheduler.objective = self.objective
+        scheduler.schedule(aff_pods, seed_result=result)
 
     @staticmethod
     def _unify_envelopes(classes, class_set, pool_of) -> None:
@@ -708,6 +889,12 @@ class TPUSolver:
                 "TPUSolver.solve: pods carry out-of-scope spread constraints "
                 "(hostname or multiple hard constraints); call schedule() so "
                 "routing can fall back to the oracle"
+            )
+        if self._suffix_classes(classes):
+            raise ValueError(
+                "TPUSolver.solve: pods carry (anti-)affinity or preference "
+                "terms the device kernels do not model; call schedule() so "
+                "routing can carve them to the oracle suffix"
             )
         result = SchedulingResult()
 
@@ -1120,7 +1307,7 @@ class TPUSolver:
                 # nodepool limits (host-side guard, mirroring the oracle)
                 if limited:
                     smallest = min(group_types, key=lambda it: it.capacity.get(res.CPU))
-                    if not (usage + smallest.capacity).fits(pool.limits):
+                    if not (usage + smallest.capacity).within(pool.limits):
                         for p in group_pods:
                             result.unschedulable[p.metadata.name] = f"nodepool {pool.name} limits exceeded"
                         continue

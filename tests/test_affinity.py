@@ -4,8 +4,11 @@ VERDICT round 2, item 5: required positive affinity must co-locate, a
 violating placement must be rejected, and anti-affinity must be SYMMETRIC
 (a resident pod's anti-affinity repels newcomers that match its selector).
 Reference behavior: the core scheduling algebra (SURVEY.md section 2.3);
-routing sends every affinity-carrying pod to this oracle
-(solver/service.py TPUSolver.supports, solver/consolidate.device_eligible).
+routing carves affinity-carrying classes to this oracle as the SUFFIX of
+the canonical pass (round 5, solver/service.py TPUSolver._oracle_suffix)
+or, when the partitions could couple, sends the whole batch here
+(TPUSolver.supports / _aff_partition_blocked;
+solver/consolidate.device_eligible for disruption verdicts).
 """
 import pytest
 
@@ -209,13 +212,14 @@ class TestAntiAffinity:
 
 
 class TestRoutingOnMergedClasses:
-    """Round-3 review finding: affinity terms are not part of the canonical
-    class key (the oracle's price envelope wants a follower to share its
-    anchor's class), so grouping can merge an affinity pod into a plain
-    pod's class. Routing must still see the affinity bit and take the
-    oracle -- the flags are OR'd onto the class (encode.PodClass)."""
+    """Round 5: the canonical class key embeds oracle_suffix_rank, so a
+    constrained pod can no longer merge behind a plain representative --
+    the partitions align exactly with class boundaries. Routing then
+    carves the constrained classes to the oracle SUFFIX, UNLESS the two
+    sides could couple (label targets, spread selectors, or a shared
+    rank-stripped envelope key -- service._aff_partition_blocked)."""
 
-    def test_merged_affinity_class_routes_to_oracle(self, catalog_items):
+    def test_same_shape_classes_no_longer_merge_and_block_the_carve(self, catalog_items):
         from karpenter_tpu.solver import encode
         from karpenter_tpu.solver.service import TPUSolver
 
@@ -225,15 +229,19 @@ class TestRoutingOnMergedClasses:
             affinity_terms=affinity({"app": "x"}, anti=True),
         )
         classes = encode.group_pods([plain, anti])
-        # identical size/selector/tolerations: one merged class, fronted by
-        # the plain pod -- exactly the hole the flags exist to cover
-        merged = [pc for pc in classes if len(pc.pods) == 2]
-        assert merged and merged[0].pods[0] is plain
-        assert merged[0].has_affinity
+        # the rank keeps them apart even at identical size/selector/
+        # tolerations...
+        assert len(classes) == 2
+        assert [pc.has_affinity for pc in classes] == [False, True]
+        # ...and the plain class sorts FIRST (suffix rank leads the order)
+        assert classes[0].pods[0] is plain
         _, sched = mk_sched(catalog_items)
+        # same shape means a shared rank-stripped envelope key: the carve
+        # is blocked and the whole batch takes one oracle pass, which
+        # preserves the follower-shares-anchor-envelope behavior
         assert not TPUSolver.supports(sched, [plain, anti])
 
-    def test_merged_multi_term_node_affinity_routes_to_oracle(self, catalog_items):
+    def test_multi_term_node_affinity_same_shape_blocks_the_carve(self, catalog_items):
         from karpenter_tpu.scheduling import Operator, Requirement
         from karpenter_tpu.solver import encode
         from karpenter_tpu.solver.service import TPUSolver
@@ -249,7 +257,38 @@ class TestRoutingOnMergedClasses:
         classes = encode.group_pods([plain, multi])
         assert any(pc.multi_node_affinity for pc in classes)
         _, sched = mk_sched(catalog_items)
-        assert not TPUSolver.supports(sched, [plain, multi])
+        # DIFFERENT requirements (the multi pod's class carries its first
+        # term's zone pin): no envelope collision, no label coupling --
+        # the carve is allowed and supports() now says True
+        assert TPUSolver.supports(sched, [plain, multi])
+
+    def test_distinct_shape_affinity_carves_to_suffix(self, catalog_items):
+        """The payoff case: an affinity pod of a DIFFERENT shape whose
+        selector targets only its own partition rides the suffix; the
+        split result equals one full oracle pass exactly."""
+        from karpenter_tpu.solver.service import TPUSolver
+
+        web = small("web", labels={"app": "web"})
+        follower = Pod(
+            "follower",
+            requests=Resources({"cpu": "250m", "memory": "512Mi"}),
+            labels={"tier": "cache"},
+            affinity_terms=affinity({"tier": "cache"}),
+        )
+        _, sched = mk_sched(catalog_items)
+        assert TPUSolver.supports(sched, [web, follower])
+        solver = TPUSolver(g_max=64)
+        _, sched2 = mk_sched(catalog_items)
+        split = solver.schedule(sched2, [web, follower])
+        _, sched3 = mk_sched(catalog_items)
+        full = sched3.schedule([web, follower])
+        assert not split.unschedulable and not full.unschedulable
+        sig = lambda r: sorted(
+            (sorted(p.metadata.name for p in g.pods),
+             sorted(it.name for it in g.instance_types))
+            for g in r.new_groups
+        )
+        assert sig(split) == sig(full)
 
 
 class TestSpecTokenSafety:
@@ -497,3 +536,212 @@ class TestPreferenceRelaxation:
         # required affinity) spec
         for p in pods:
             assert p._group_sig is not None and p._group_sig[2] == ()
+
+
+class TestAffinityCarveFuzz:
+    """Round-5 differential tier for the oracle-suffix carve
+    (VERDICT r4 item 2): batches with a few percent affinity/preference
+    pods must (a) keep the plain majority on the device path and (b)
+    produce EXACTLY the full oracle's result -- the carve is an execution
+    strategy, not a semantic fork."""
+
+    @staticmethod
+    def _mixed_batch(catalog_items, seed, n_plain_templates=8, replicas=6):
+        import numpy as np
+
+        from karpenter_tpu.scheduling import Toleration
+
+        rng = np.random.default_rng(77_000 + seed)
+        zones = sorted({o.zone for it in catalog_items for o in it.available_offerings()})
+        pods = []
+        # plain majority: cpu values drawn from a set DISJOINT from the
+        # affinity templates' below, so rank-stripped class keys can never
+        # collide and the carve is guaranteed (the blocked case has its
+        # own test)
+        for t in range(n_plain_templates):
+            cpu_m = int(rng.choice([100, 250, 500, 1000, 2000, 3000]))
+            mem_mi = int(rng.choice([128, 512, 1024, 4096]))
+            selector = {}
+            u = rng.random()
+            if u < 0.2:
+                selector[wk.ZONE_LABEL] = zones[int(rng.integers(0, len(zones)))]
+            elif u < 0.3:
+                selector[wk.CAPACITY_TYPE_LABEL] = "on-demand"
+            tolerations = []
+            if rng.random() < 0.15:
+                tolerations.append(Toleration(key="dedicated", operator="Exists"))
+            for i in range(int(rng.integers(2, replicas + 2))):
+                pods.append(Pod(
+                    f"c{seed}-p{t}-{i}",
+                    requests=Resources.from_base_units(
+                        {"cpu": float(cpu_m), "memory": float(mem_mi) * 2**20}),
+                    node_selector=selector,
+                    tolerations=tolerations,
+                    labels={"app": f"plain-{t}"},
+                ))
+        # constrained minority (~2-8% of the batch): anchors + followers +
+        # anti-affinity + preferences, selectors targeting ONLY labels the
+        # constrained partition carries
+        n_aff = max(1, len(pods) // int(rng.integers(12, 40)))
+        aff_cpus = [150.0, 350.0, 650.0]
+        for a in range(n_aff):
+            kind = int(rng.integers(0, 4))
+            cpu = float(aff_cpus[a % len(aff_cpus)])
+            reqs = Resources.from_base_units({"cpu": cpu, "memory": 256.0 * 2**20})
+            tier = f"aff-{a % 3}"
+            if kind == 0:      # anchor+its own label; follower affinity to tier
+                pods.append(Pod(
+                    f"c{seed}-a{a}", requests=reqs, labels={"tier": tier},
+                    affinity_terms=[PodAffinityTerm(
+                        label_selector={"tier": tier}, topology_key=wk.HOSTNAME_LABEL)],
+                ))
+            elif kind == 1:    # zone anti-affinity within the minority
+                pods.append(Pod(
+                    f"c{seed}-a{a}", requests=reqs, labels={"tier": tier},
+                    affinity_terms=[PodAffinityTerm(
+                        label_selector={"tier": tier}, topology_key=wk.ZONE_LABEL,
+                        anti=True)],
+                ))
+            elif kind == 2:    # weighted zone preference (relaxation ladder)
+                from karpenter_tpu.scheduling import Operator as Op, Requirement
+
+                pods.append(Pod(
+                    f"c{seed}-a{a}", requests=reqs, labels={"tier": tier},
+                    preferred_node_affinity_terms=[
+                        (10, [Requirement(wk.ZONE_LABEL, Op.IN,
+                                          [zones[a % len(zones)]])])],
+                ))
+            else:              # OR-of-terms node affinity
+                from karpenter_tpu.scheduling import Operator as Op, Requirement
+
+                pods.append(Pod(
+                    f"c{seed}-a{a}", requests=reqs, labels={"tier": tier},
+                    node_affinity_terms=[
+                        [Requirement(wk.ZONE_LABEL, Op.IN, [zones[0]])],
+                        [Requirement(wk.ZONE_LABEL, Op.IN, [zones[-1]])],
+                    ],
+                ))
+        return pods
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_split_matches_full_oracle_exactly(self, catalog_items, seed):
+        from karpenter_tpu.solver.service import TPUSolver
+
+        pods = self._mixed_batch(catalog_items, seed)
+        solver = TPUSolver(g_max=256)
+        _, sched_split = mk_sched(catalog_items)
+        split = solver.schedule(sched_split, list(pods))
+        assert solver.last_route["path"] == "device+suffix", solver.last_route
+        total = solver.last_route["device_pods"] + solver.last_route["oracle_pods"]
+        assert solver.last_route["device_pods"] >= 0.9 * total, solver.last_route
+        _, sched_full = mk_sched(catalog_items)
+        full = sched_full.schedule(list(pods))
+        assert set(split.unschedulable) == set(full.unschedulable), f"seed {seed}"
+        assert _aff_sig(split) == _aff_sig(full), f"seed {seed}"
+
+    def test_pool_limits_block_the_carve(self, catalog_items):
+        """The oracle charges a group's smallest candidate at OPEN time;
+        the device guard charges the smallest FINAL survivor. The charges
+        can differ, so limits force the whole batch onto one oracle pass
+        (round-5 review finding)."""
+        from karpenter_tpu.solver.service import TPUSolver
+
+        pool = NodePool("default", limits=Resources({"cpu": "2000"}))
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=[pool], instance_types={"default": catalog_items},
+            zones=zones,
+        )
+        pods = [small(f"w-{i}") for i in range(4)] + [Pod(
+            "aff", requests=Resources({"cpu": "250m", "memory": "512Mi"}),
+            labels={"t": "x"},
+            affinity_terms=affinity({"t": "x"}),
+        )]
+        solver = TPUSolver(g_max=64)
+        result = solver.schedule(sched, pods)
+        assert solver.last_route["path"] == "oracle", solver.last_route
+        assert not result.unschedulable
+
+    def test_label_coupling_blocks_the_carve(self, catalog_items):
+        """A follower whose selector matches PLAIN pods' labels must push
+        the whole batch onto one oracle pass (the suffix never sees the
+        device pods' labels, so carving would mis-schedule it)."""
+        from karpenter_tpu.solver.service import TPUSolver
+
+        web = [small(f"web-{i}", labels={"app": "web"}) for i in range(4)]
+        follower = Pod(
+            "follower",
+            requests=Resources({"cpu": "250m", "memory": "512Mi"}),
+            affinity_terms=affinity({"app": "web"}),
+        )
+        solver = TPUSolver(g_max=64)
+        _, sched = mk_sched(catalog_items)
+        result = solver.schedule(sched, web + [follower])
+        assert solver.last_route["path"] == "oracle", solver.last_route
+        assert not result.unschedulable
+        # and the oracle co-located the follower with a web pod
+        g_follower = next(g for g in result.new_groups
+                          if any(p.metadata.name == "follower" for p in g.pods))
+        assert any(p.metadata.name.startswith("web") for p in g_follower.pods)
+
+
+def _aff_sig(result):
+    """Packing signature incl. surviving types (envelope equality)."""
+    return sorted(
+        (tuple(sorted(p.metadata.name for p in g.pods)),
+         tuple(sorted(it.name for it in g.instance_types)))
+        for g in result.new_groups
+    )
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("KARPENTER_TPU_FUZZ_EXTENDED"),
+    reason="extended differential sweep: set KARPENTER_TPU_FUZZ_EXTENDED=1",
+)
+class TestAffinityCarveFuzzExtended:
+    """Wider carve sweep behind make fuzz-extended, with existing nodes in
+    the mix (the suffix packs onto the device pass's remaining capacity)."""
+
+    @pytest.mark.parametrize("seed", range(8, 40))
+    def test_sweep(self, catalog_items, seed):
+        TestAffinityCarveFuzz().test_split_matches_full_oracle_exactly(
+            catalog_items, seed)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_with_existing_nodes(self, catalog_items, seed):
+        import copy
+
+        import numpy as np
+
+        from karpenter_tpu.scheduling import resources as res
+        from karpenter_tpu.solver.service import TPUSolver
+
+        rng = np.random.default_rng(88_000 + seed)
+        zones = sorted({o.zone for it in catalog_items for o in it.available_offerings()})
+        pods = TestAffinityCarveFuzz._mixed_batch(catalog_items, 500 + seed)
+        existing = []
+        for ni in range(int(rng.integers(1, 4))):
+            existing.append(ExistingNode(
+                name=f"e{seed}-n{ni}",
+                labels={wk.ZONE_LABEL: zones[int(rng.integers(0, len(zones)))],
+                        wk.ARCH_LABEL: "amd64"},
+                allocatable=Resources.from_base_units(
+                    {res.CPU: 4000.0, res.MEMORY: 8.0 * 2**30, res.PODS: 20}),
+            ))
+
+        def mk(items):
+            pool = NodePool("default")
+            return Scheduler(
+                nodepools=[pool], instance_types={pool.name: items},
+                existing_nodes=copy.deepcopy(existing), zones=set(zones),
+            )
+
+        solver = TPUSolver(g_max=256)
+        split = solver.schedule(mk(catalog_items), list(pods))
+        assert solver.last_route["path"] == "device+suffix", solver.last_route
+        full = mk(catalog_items).schedule(list(pods))
+        assert set(split.unschedulable) == set(full.unschedulable), f"seed {seed}"
+        from collections import Counter
+        assert Counter(split.existing_assignments.items()) == Counter(
+            full.existing_assignments.items()), f"seed {seed}"
+        assert _aff_sig(split) == _aff_sig(full), f"seed {seed}"

@@ -53,6 +53,17 @@ class TestResources:
         assert not d.any_negative()
         assert (b - a).any_negative()
 
+    def test_within_constrains_only_named_axes(self):
+        """NodePool-limits semantics: axes absent from the limit are
+        unconstrained (fits() would read them as capacity 0 and refuse
+        everything -- round-5 finding)."""
+        usage = Resources({"cpu": "10", "memory": "20Gi", "pods": 30})
+        assert usage.within(Resources({"cpu": "16"}))
+        assert not usage.within(Resources({"cpu": "8"}))
+        assert usage.within(Resources({"cpu": "16", "memory": "32Gi"}))
+        assert not usage.within(Resources({"memory": "16Gi"}))
+        assert usage.within(Resources({}))
+
     def test_vectorize(self):
         r = Resources({"cpu": "2", "memory": "4Gi", "pods": 3})
         v = r.to_vector()

@@ -341,6 +341,64 @@ class TestSolveGuards:
             solver.solve(NodePool("default"), [], [pod])
 
 
+class TestNodePoolLimits:
+    """spec.limits enforcement (reference: nodepool resource limits gate
+    group opens). A limit names only the axes it caps -- Resources.within
+    -- and both paths refuse the open that would exceed it."""
+
+    def test_cpu_only_limit_caps_fleet_on_both_paths(self, catalog_items):
+        from karpenter_tpu.apis import NodePool, Pod
+        from karpenter_tpu.scheduling import Resources
+        from karpenter_tpu.scheduling import resources as res
+        from karpenter_tpu.solver.service import TPUSolver
+
+        max_cpu = max(it.capacity.get(res.CPU) for it in catalog_items)
+        # one pod per node (0.6x the fattest type's cpu), limit admits
+        # exactly one node: first open fits, second must refuse
+        pod_cpu = 0.6 * max_cpu
+        pods = [
+            Pod(f"big-{i}",
+                requests=Resources.from_base_units(
+                    {res.CPU: pod_cpu, res.MEMORY: 1.0 * 2**30}))
+            for i in range(2)
+        ]
+        pool = NodePool("default", limits=Resources.from_base_units(
+            {res.CPU: 1.01 * max_cpu}))
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+
+        def mk():
+            return Scheduler(
+                nodepools=[pool], instance_types={pool.name: catalog_items},
+                zones=set(zones),
+            )
+
+        oracle = mk().schedule(list(pods))
+        solver = TPUSolver(g_max=64)
+        device = solver.schedule(mk(), list(pods))
+        for r in (oracle, device):
+            assert len(r.new_groups) == 1, r.new_groups
+            assert len(r.unschedulable) == 1
+            assert "limits exceeded" in next(iter(r.unschedulable.values()))
+        assert set(oracle.unschedulable) == set(device.unschedulable)
+        assert _signature(oracle) == _signature(device)
+
+    def test_generous_limit_is_inert(self, catalog_items):
+        from karpenter_tpu.apis import NodePool, Pod
+        from karpenter_tpu.scheduling import Resources
+        from karpenter_tpu.solver.service import TPUSolver
+
+        pool = NodePool("default", limits=Resources({"cpu": "100000"}))
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=[pool], instance_types={pool.name: catalog_items},
+            zones=set(zones),
+        )
+        pods = [Pod(f"p-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}))
+                for i in range(4)]
+        result = TPUSolver(g_max=64).schedule(sched, pods)
+        assert not result.unschedulable
+
+
 class TestDifferentialFuzz:
     """Broad randomized differential sweep through the FULL routing entry
     point: selectors, capacity-type pins, zone pins, tolerations, existing
