@@ -138,6 +138,7 @@ class InstanceTypeProvider:
                     it.capacity = Resources.from_base_units(
                         {**{k: v for k, v in it.capacity.items()}, res.MEMORY: mem}
                     )
+                    it._alloc_cache = None  # capacity changed: drop the memo
                     break
         self._cache.set(key, items)
         from karpenter_tpu import metrics

@@ -64,9 +64,17 @@ class InstanceType:
     overhead: Resources
     offerings: List[Offering] = field(default_factory=list)
     info: Optional[InstanceTypeInfo] = None
+    _alloc_cache: Optional[Resources] = field(
+        default=None, init=False, repr=False, compare=False)
 
     def allocatable(self) -> Resources:
-        return self.capacity - self.overhead
+        # memoized: the oracle's fit checks call this per (pod, node try)
+        # -- thousands of times per tick -- and capacity/overhead are
+        # immutable once the Resolver builds the type
+        a = self._alloc_cache
+        if a is None:
+            a = self._alloc_cache = self.capacity - self.overhead
+        return a
 
     def available_offerings(self) -> List[Offering]:
         return [o for o in self.offerings if o.available]

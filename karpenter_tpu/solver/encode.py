@@ -443,9 +443,11 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
             if pc is None:
                 requested = scale_vector((pod.requests + _one_pod()).to_vector()).astype(np.float32)
                 pc = groups[key] = PodClass(pods=[], requests=requested, requirements=reqs, key=key)
-            # routing bits OR over every signature the class absorbs, so a
-            # lone affinity pod merged behind a plain representative still
-            # routes the whole batch to the oracle (TPUSolver.supports)
+            # routing bits OR over every signature the class absorbs.
+            # oracle_suffix_rank in the class key means a constrained pod
+            # can never merge behind a PLAIN representative; the bits are
+            # uniform per class and the carve partitions along class
+            # boundaries (TPUSolver._suffix_classes)
             if pod.affinity_terms:
                 pc.has_affinity = True
             if len(pod.node_affinity_terms) > 1:

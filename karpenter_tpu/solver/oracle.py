@@ -210,6 +210,27 @@ class Scheduler:
         self._zone_pods: Dict[str, List[Dict[str, str]]] = {}
         self._anti_in: Dict[Tuple[str, str], List[Dict[str, str]]] = {}
         self._all_labels: List[Dict[str, str]] = []
+        # label-pair indexes (round 5): affinity checks at 50k scale must
+        # not scan every placed pod's labels per group try. Single-key
+        # equality selectors (the overwhelmingly common shape) resolve in
+        # O(1) against these; multi-key selectors narrow to the first
+        # pair's bucket and verify the full selector there.
+        #   _kv_labels   (k, v) -> label dicts of every placed pod with it
+        #   _loc_kv      (location, k, v) -> count at that node/group
+        #   _zone_kv     (zone, k, v) -> count in that zone
+        #   _loc_groups  (k, v) -> open groups hosting a matching pod (for
+        #                candidate pruning in _attempt_placement)
+        self._kv_labels: Dict[Tuple[str, str], List[Dict[str, str]]] = {}
+        self._loc_kv: Dict[Tuple[str, str, str], int] = {}
+        self._zone_kv: Dict[Tuple[str, str, str], int] = {}
+        self._loc_groups: Dict[Tuple[str, str], List] = {}
+        self._open_seq_next = 0
+        # per-type scaled capacity + offering tuples for _price_open_filter
+        # (immutable for this Scheduler's snapshot lifetime)
+        self._type_stats_memo: Dict[int, tuple] = {}
+        # per-group axis-wise max allocatable (an upper bound -- see
+        # _try_group's precheck; never invalidated, survivors only shrink)
+        self._gmax_cache: Dict[int, Resources] = {}
         node_labels = {n.name: n.labels for n in self.existing}
         for node, pods in pods_by_node.items():
             self._labels_on[node] = [dict(p.metadata.labels) for p in pods]
@@ -217,9 +238,19 @@ class Scheduler:
             for p in pods:
                 labels = dict(p.metadata.labels)
                 self._all_labels.append(labels)
+                self._index_labels(labels, node, zone)
                 if zone:
                     self._zone_pods.setdefault(zone, []).append(labels)
                 self._record_anti_terms(p, node, zone)
+
+    def _index_labels(self, labels: Dict[str, str], location: str, zone: Optional[str]) -> None:
+        for k, v in labels.items():
+            self._kv_labels.setdefault((k, v), []).append(labels)
+            lk = (location, k, v)
+            self._loc_kv[lk] = self._loc_kv.get(lk, 0) + 1
+            if zone:
+                zk = (zone, k, v)
+                self._zone_kv[zk] = self._zone_kv.get(zk, 0) + 1
 
     # -- constraint checks --------------------------------------------------
     @staticmethod
@@ -240,7 +271,28 @@ class Scheduler:
                 )
 
     def _any_match(self, selector: Dict[str, str]) -> bool:
-        return any(self._match(labels, selector) for labels in self._all_labels)
+        if not selector:
+            return bool(self._all_labels)
+        # narrow to the first pair's bucket; verify the full selector there
+        k, v = next(iter(selector.items()))
+        bucket = self._kv_labels.get((k, v))
+        if not bucket:
+            return False
+        if len(selector) == 1:
+            return True
+        return any(self._match(labels, selector) for labels in bucket)
+
+    def _domain_has_match(self, domain: str, selector: Dict[str, str],
+                          counts: Dict, fallback: List[Dict[str, str]]) -> bool:
+        """Does `domain` (a location or zone) host a pod matching
+        `selector`? O(1) for single-key selectors via `counts`; multi-key
+        selectors verify against the domain's label list `fallback`."""
+        if not selector:
+            return bool(fallback)
+        if len(selector) == 1:
+            k, v = next(iter(selector.items()))
+            return counts.get((domain, k, v), 0) > 0
+        return any(self._match(l, selector) for l in fallback)
 
     def _affinity_ok(self, pod: Pod, location: str, domain_labels: Dict[str, str]) -> bool:
         """All required pod-(anti-)affinity terms of `pod` admit placing it
@@ -255,18 +307,20 @@ class Scheduler:
         for term in pod.affinity_terms:
             sel = term.label_selector
             if term.topology_key == wk.HOSTNAME_LABEL:
-                dom = self._labels_on.get(location, [])
+                has = self._domain_has_match(
+                    location, sel, self._loc_kv, self._labels_on.get(location, []))
             elif term.topology_key == wk.ZONE_LABEL:
-                dom = self._zone_pods.get(zone, []) if zone else []
+                has = zone is not None and self._domain_has_match(
+                    zone, sel, self._zone_kv, self._zone_pods.get(zone, []))
             else:
-                dom = []
+                has = False
             if term.anti:
-                if any(self._match(l, sel) for l in dom):
+                if has:
                     return False
                 # own anti-term also applies to itself landing in a domain
                 # already holding a match -- covered above; nothing else
             else:
-                if any(self._match(l, sel) for l in dom):
+                if has:
                     continue
                 # bootstrap: no matching pod anywhere -> self-match admits
                 if not self._any_match(sel) and self._match(labels, sel):
@@ -295,7 +349,10 @@ class Scheduler:
             if term.topology_key != wk.ZONE_LABEL:
                 continue
             sel = term.label_selector
-            matching = {z for z, pods in self._zone_pods.items() if any(self._match(l, sel) for l in pods)}
+            matching = {
+                z for z in self._zone_pods
+                if self._domain_has_match(z, sel, self._zone_kv, self._zone_pods[z])
+            }
             if term.anti:
                 if matching:
                     out = out.copy()
@@ -395,7 +452,8 @@ class Scheduler:
             return domains
         return set(self.topology.count(tsc).keys())
 
-    def _record_placement(self, pod: Pod, location: str, domain_labels: Dict[str, str]) -> None:
+    def _record_placement(self, pod: Pod, location: str, domain_labels: Dict[str, str],
+                          group=None) -> None:
         # a landed placement can move topology counts: pinned-zone memos
         # computed against the previous counts are now stale
         self._zone_choice_memo.clear()
@@ -403,6 +461,16 @@ class Scheduler:
         self._labels_on.setdefault(location, []).append(labels)
         self._all_labels.append(labels)
         zone = domain_labels.get(wk.ZONE_LABEL)
+        self._index_labels(labels, location, zone)
+        if group is not None:
+            # candidate-pruning buckets: a positive hostname-affinity pod
+            # only ever joins a group already hosting a match
+            # (_attempt_placement), so groups index by resident label pair
+            for kv in labels.items():
+                bucket = self._loc_groups.setdefault(kv, [])
+                if not bucket or bucket[-1] is not group:
+                    if group not in bucket:
+                        bucket.append(group)
         if zone:
             self._zone_pods.setdefault(zone, []).append(labels)
         self._record_anti_terms(pod, location, zone)
@@ -565,6 +633,16 @@ class Scheduler:
             return False
         if not self._affinity_ok(pod, id(group), group.requirements.labels()):
             return False
+        # capacity upper-bound precheck: if even the roomiest type the
+        # group has EVER had cannot hold the new total, no survivor can --
+        # reject before the merge/narrow/survivor-scan cost. Sound because
+        # survivor lists only shrink and per-type allocatable is fixed, so
+        # a stale cached max stays an upper bound (round 5: suffix pods
+        # probing tightly packed device groups made this the hot reject).
+        requested = group.add_requested(pod)
+        effective = requested + self._ovh(group.nodepool)
+        if not effective.fits(self._group_max_alloc(group)):
+            return False
         merged = group.requirements.copy().add(*pod_reqs)
         # zone topology spread narrows the merged requirements; the chosen
         # zone is computed pool-wide (pod+pool), not from this group's
@@ -581,8 +659,12 @@ class Scheduler:
         narrowed = self._affinity_narrow(pod, narrowed)
         if narrowed is None:
             return False
-        requested = group.add_requested(pod)
-        effective = requested + self._ovh(group.nodepool)
+        # NOTE: an "empty In" requirement here is NOT provably dead -- the
+        # algebra deliberately conflates DoesNotExist (matches absent
+        # labels) with an emptied intersection (requirements.py matches()),
+        # so a fast-reject on that shape would break DoesNotExist pool
+        # templates (round-5 review finding, with repro). The survivor
+        # scan below is the authority.
         survivors = [
             it
             for it in group.instance_types
@@ -598,8 +680,20 @@ class Scheduler:
         group.instance_types = survivors
         group.pods.append(pod)
         group.requested = requested
-        self._record_placement(pod, id(group), narrowed.labels())
+        self._record_placement(pod, id(group), narrowed.labels(), group=group)
         return True
+
+    def _group_max_alloc(self, group: NewNodeGroup) -> Resources:
+        key = id(group)
+        r = self._gmax_cache.get(key)
+        if r is None:
+            vals: Dict[str, float] = {}
+            for it in group.instance_types:
+                for k, v in it.allocatable().items():
+                    if v > vals.get(k, 0.0):
+                        vals[k] = v
+            r = self._gmax_cache[key] = Resources.from_base_units(vals)
+        return r
 
     def _env_key(self, pod: Pod, pool: NodePool) -> tuple:
         from karpenter_tpu.solver import encode as _enc
@@ -667,9 +761,23 @@ class Scheduler:
         zreq = narrowed.get(wk.ZONE_LABEL)
         creq = narrowed.get(wk.CAPACITY_TYPE_LABEL)
         inf32 = _np.float32(_np.inf)
+        # per-type immutable inputs memoized per Scheduler (the filter runs
+        # per distinct env key; re-deriving 600+ scaled capacity vectors
+        # and offering tuples each time dominated suffix opens -- round 5)
+        memo = self._type_stats_memo
         stats = []
         for it in candidates:
-            cap32 = _enc.scale_vector(it.allocatable().to_vector()).astype(_np.float32)
+            pre = memo.get(id(it))
+            if pre is None:
+                cap_base = _enc.scale_vector(
+                    it.allocatable().to_vector()).astype(_np.float32)
+                offers = tuple(
+                    (o.zone, o.capacity_type, _np.float32(o.price),
+                     o.capacity_type == wk.CAPACITY_TYPE_RESERVED)
+                    for o in it.offerings if o.available
+                )
+                pre = memo[id(it)] = (cap_base, offers)
+            cap32, offers = pre
             if ovh32 is not None:
                 # fresh nodes reserve the pool's daemonset overhead before
                 # workload pods pack (the device subtracts the same scaled
@@ -679,18 +787,15 @@ class Scheduler:
             price = inf32
             has_reserved = False
             zone_ok = cap_ok = False
-            for o in it.offerings:
-                if not o.available:
-                    continue
-                z_m = zreq is None or zreq.matches(o.zone)
-                c_m = creq is None or creq.matches(o.capacity_type)
+            for zone, captype, p32, reserved in offers:
+                z_m = zreq is None or zreq.matches(zone)
+                c_m = creq is None or creq.matches(captype)
                 zone_ok = zone_ok or z_m
                 cap_ok = cap_ok or c_m
                 if z_m and c_m:
-                    p32 = _np.float32(o.price)
                     if p32 < price:
                         price = p32
-                    if o.capacity_type == wk.CAPACITY_TYPE_RESERVED:
+                    if reserved:
                         has_reserved = True
             # the device's fresh_row is the SEPARABLE availability join
             # (admitted zone exists AND admitted captype exists, over
@@ -840,7 +945,9 @@ class Scheduler:
                 requested=requested,
             )
             result.new_groups.append(group)
-            self._record_placement(pod, id(group), narrowed.labels())
+            group._open_seq = self._open_seq_next
+            self._open_seq_next += 1
+            self._record_placement(pod, id(group), narrowed.labels(), group=group)
             return None
         return last_reason
 
@@ -853,6 +960,12 @@ class Scheduler:
         # pass's open groups here so suffix pods can JOIN them exactly as
         # one full pass would; placements land in the shared result
         result = seed_result if seed_result is not None else SchedulingResult()
+        # group-open sequence numbers: candidate pruning
+        # (_candidate_groups) must preserve the first-fit order of
+        # result.new_groups even when candidates come from label buckets
+        for i, g in enumerate(result.new_groups):
+            g._open_seq = i
+        self._open_seq_next = len(result.new_groups)
         # canonical order shared with the batch solver (encode.pod_sort_key):
         # suffix rank, then dominant size descending, pool-independent
         # class-signature tie-break
@@ -927,14 +1040,46 @@ class Scheduler:
             pod.affinity_terms = original_aff
         return placed, reasons
 
+    def _candidate_groups(self, pod: Pod, result: SchedulingResult) -> List[NewNodeGroup]:
+        """Groups worth trying for a pod with affinity terms. A positive
+        HOSTNAME term admits only groups already hosting a match (unless
+        the bootstrap self-match rule applies), so the scan narrows from
+        every open group to the term's label bucket -- the difference
+        between O(groups) and O(matches) per follower pod at 50k scale.
+        SOUNDNESS: the bucket is a superset filter (keyed by the
+        selector's first pair); _try_group still runs the full
+        _affinity_ok, and the first-fit order is preserved via the
+        groups' open sequence numbers."""
+        best = None
+        for term in pod.affinity_terms:
+            if term.anti or term.topology_key != wk.HOSTNAME_LABEL:
+                continue
+            sel = term.label_selector
+            if not sel:
+                continue
+            if not self._any_match(sel):
+                if self._match(pod.metadata.labels, sel):
+                    continue  # bootstrap: the term passes at any location
+                return []     # unsatisfiable at every open group
+            bucket = self._loc_groups.get(next(iter(sel.items())), [])
+            if best is None or len(bucket) < len(best):
+                best = bucket
+        if best is None:
+            return result.new_groups
+        return sorted(best, key=lambda g: g._open_seq)
+
     def _attempt_placement(self, pod: Pod, result: SchedulingResult):
         """One full placement attempt under the pod's CURRENT constraints:
         existing nodes, then open groups, then a fresh group. Side effects
         only on success. Returns (placed, reasons)."""
         if self._try_existing(pod, result):
             return True, []
+        groups = (
+            self._candidate_groups(pod, result) if pod.affinity_terms
+            else result.new_groups
+        )
         for pod_reqs in pod.scheduling_requirements():
-            for group in result.new_groups:
+            for group in groups:
                 if self._try_group(pod, group, pod_reqs):
                     return True, []
         reasons = []

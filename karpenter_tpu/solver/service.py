@@ -39,6 +39,17 @@ from karpenter_tpu.utils import gc_paused
 _bucket = encode.bucket
 
 
+def _spread_keys(classes) -> set:
+    """Topology-spread identity per class representative -- spread counts
+    are global per (topology key, selector), so two partitions sharing a
+    key would need shared state (both partition guards check this)."""
+    return {
+        (t.topology_key, tuple(sorted(t.label_selector.items())))
+        for pc in classes
+        for t in pc.pods[0].topology_spread
+    }
+
+
 class _CatalogEntry(NamedTuple):
     """One catalog's immutable staged snapshot (see TPUSolver._catalog)."""
 
@@ -404,14 +415,7 @@ class TPUSolver:
             ):
                 return True
 
-        def spread_keys(side) -> set:
-            return {
-                (t.topology_key, tuple(sorted(t.label_selector.items())))
-                for pc in side
-                for t in pc.pods[0].topology_spread
-            }
-
-        return bool(spread_keys(mv_classes) & spread_keys(rest))
+        return bool(_spread_keys(mv_classes) & _spread_keys(rest))
 
     @staticmethod
     def _suffix_classes(classes) -> list:
@@ -466,22 +470,30 @@ class TPUSolver:
                 for _, t in p.preferred_affinity_terms:
                     selectors[tuple(sorted(t.label_selector.items()))] = t.label_selector
         if selectors:
-            sels = list(selectors.values())
+            # single-pair selectors (the common shape) check as one set
+            # lookup per label pair -- the 50k-pod scan must stay a few ms
+            single: set = set()
+            multi: List[dict] = []
+            blocked_all = False
+            for key, s in selectors.items():
+                if not s:
+                    blocked_all = True  # empty selector matches every pod
+                elif len(s) == 1:
+                    single.add(key[0])
+                else:
+                    multi.append(s)
+            if blocked_all:
+                return True
             for pc in rest:
                 for p in pc.pods:
                     labels = p.metadata.labels
-                    for s in sels:
+                    if single and any(kv in single for kv in labels.items()):
+                        return True
+                    for s in multi:
                         if all(labels.get(k) == v for k, v in s.items()):
                             return True
 
-        def spread_keys(side) -> set:
-            return {
-                (t.topology_key, tuple(sorted(t.label_selector.items())))
-                for pc in side
-                for t in pc.pods[0].topology_spread
-            }
-
-        if spread_keys(aff_classes) & spread_keys(rest):
+        if _spread_keys(aff_classes) & _spread_keys(rest):
             return True
 
         from karpenter_tpu.solver.encode import _class_key

@@ -124,7 +124,9 @@ def probe_jax_backend(
         except Exception as e:  # noqa: BLE001 - diagnostic path must not raise
             err = repr(e)
         if i < attempts - 1:
-            time.sleep(3 * (i + 1))
+            # capped: with many-attempt patient probing (bench round 5)
+            # the sleep must not come to dominate the budget
+            time.sleep(min(3 * (i + 1), 45))
     return None, err
 
 

@@ -382,6 +382,37 @@ class TestNodePoolLimits:
         assert set(oracle.unschedulable) == set(device.unschedulable)
         assert _signature(oracle) == _signature(device)
 
+    def test_does_not_exist_pool_requirement_still_packs(self, catalog_items):
+        """DoesNotExist is represented as an empty In (requirements.py) --
+        the exact shape an emptied intersection takes. A fast-reject on
+        that shape broke group joins under DoesNotExist pool templates
+        (round-5 review regression): pods must still PACK, not fan out
+        one per node, and both paths must agree."""
+        from karpenter_tpu.apis import NodePool, Pod
+        from karpenter_tpu.scheduling import Operator as Op, Requirement, Resources
+        from karpenter_tpu.solver.service import TPUSolver
+
+        pool = NodePool(
+            "default",
+            requirements=[Requirement("example.com/gpu", Op.DOES_NOT_EXIST)],
+        )
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+
+        def mk():
+            return Scheduler(
+                nodepools=[pool], instance_types={pool.name: catalog_items},
+                zones=set(zones),
+            )
+
+        pods = [Pod(f"p-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}))
+                for i in range(4)]
+        oracle = mk().schedule(list(pods))
+        assert not oracle.unschedulable
+        assert len(oracle.new_groups) == 1, "pods must pack into one group"
+        device = TPUSolver(g_max=64).schedule(mk(), list(pods))
+        assert set(oracle.unschedulable) == set(device.unschedulable)
+        assert _signature(oracle) == _signature(device)
+
     def test_generous_limit_is_inert(self, catalog_items):
         from karpenter_tpu.apis import NodePool, Pod
         from karpenter_tpu.scheduling import Resources
