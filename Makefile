@@ -36,8 +36,9 @@ docs-check:  ## fail if generated docs / CRD manifests are stale
 	$(PY) hack/crd_gen.py --check
 	$(PY) hack/kompat.py --check
 
-verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun)
+verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun + 2-process mesh)
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8, n_processes=2)"
 
 benchmark-interruption:  ## interruption-queue tier at 100/1k/5k(/15k) messages
 	KARPENTER_TPU_PERF=1 KARPENTER_TPU_BENCH_FULL=1 $(PYTEST) tests/test_interruption_bench.py -q -s

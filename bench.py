@@ -320,9 +320,10 @@ def _rpc_loopback_p50(pool, items, workloads, iters: int) -> float:
 
     d = tempfile.mkdtemp(prefix="bench_rpc_")
     path = os.path.join(d, "solver.sock")
-    srv = rpc.SolverServer(path=path).start()
+    srv = None
     client = None
     try:
+        srv = rpc.SolverServer(path=path).start()
         client = rpc.SolverClient(path=path)
         s = TPUSolver(g_max=G_MAX, client=client)
         s.solve(pool, items, workloads[0])  # stage catalog + warm the path
@@ -335,7 +336,8 @@ def _rpc_loopback_p50(pool, items, workloads, iters: int) -> float:
     finally:
         if client is not None:
             client.close()
-        srv.stop()
+        if srv is not None:
+            srv.stop()
         shutil.rmtree(d, ignore_errors=True)
 
 

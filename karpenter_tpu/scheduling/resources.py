@@ -138,6 +138,15 @@ class Resources:
         r._v = {k: float(v) for k, v in values.items() if v != 0.0}
         return r
 
+    @classmethod
+    def from_vector(cls, vec) -> "Resources":
+        """Dense RESOURCE_AXES vector -> Resources, skipping the dict
+        round-trip (the decode hot loop builds one per opened group)."""
+        r = cls.__new__(cls)
+        r._sig = None
+        r._v = {k: v for k, v in zip(RESOURCE_AXES, vec) if v != 0.0}
+        return r
+
     def sig(self) -> tuple:
         """Canonical content tuple, memoized. Resources are immutable after
         construction, and pods of one workload template share one Resources

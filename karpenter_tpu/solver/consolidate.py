@@ -46,7 +46,9 @@ from karpenter_tpu.solver import encode
 from karpenter_tpu.solver.encode import CatalogTensors
 from karpenter_tpu.solver.oracle import ExistingNode
 
-_INF = jnp.float32(jnp.inf)
+# numpy scalar, NOT jnp: a module-level jnp constant would initialize the
+# XLA backend at import (see solver/ffd.py _INF)
+_INF = np.float32(np.inf)
 
 _bucket = encode.bucket
 

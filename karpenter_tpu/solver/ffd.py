@@ -51,7 +51,11 @@ import jax.numpy as jnp
 from karpenter_tpu.solver import encode
 from karpenter_tpu.solver.encode import CatalogTensors, PodClassSet
 
-_INF = jnp.float32(jnp.inf)
+# numpy scalar, NOT jnp: a module-level jnp constant initializes the XLA
+# backend at import, which breaks jax.distributed.initialize() in
+# multi-process workers (it must run before any backend init). Inside jit
+# the two trace identically (weak float32 scalar).
+_INF = np.float32(np.inf)
 
 
 class SolveInputs(NamedTuple):

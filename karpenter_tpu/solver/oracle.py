@@ -224,6 +224,7 @@ class Scheduler:
         self._loc_kv: Dict[Tuple[str, str, str], int] = {}
         self._zone_kv: Dict[Tuple[str, str, str], int] = {}
         self._loc_groups: Dict[Tuple[str, str], List] = {}
+        self._loc_groups_seen: Dict[Tuple[str, str], set] = {}
         self._open_seq_next = 0
         # per-type scaled capacity + offering tuples for _price_open_filter
         # (immutable for this Scheduler's snapshot lifetime)
@@ -465,12 +466,14 @@ class Scheduler:
         if group is not None:
             # candidate-pruning buckets: a positive hostname-affinity pod
             # only ever joins a group already hosting a match
-            # (_attempt_placement), so groups index by resident label pair
+            # (_attempt_placement), so groups index by resident label pair.
+            # Membership via a companion id-set: a list scan here would be
+            # O(groups) per placed label pair (round-5 review)
             for kv in labels.items():
-                bucket = self._loc_groups.setdefault(kv, [])
-                if not bucket or bucket[-1] is not group:
-                    if group not in bucket:
-                        bucket.append(group)
+                seen = self._loc_groups_seen.setdefault(kv, set())
+                if id(group) not in seen:
+                    seen.add(id(group))
+                    self._loc_groups.setdefault(kv, []).append(group)
         if zone:
             self._zone_pods.setdefault(zone, []).append(labels)
         self._record_anti_terms(pod, location, zone)

@@ -1276,9 +1276,7 @@ class TPUSolver:
                         # phase 1 or earlier groups of this class
                         off = int(pair_off[j])
                         group_pods.extend(pc.pods[off : off + int(pair_take[j])])
-                requested = Resources.from_base_units(
-                    dict(zip(res.RESOURCE_AXES, group_req_vecs[g].tolist()))
-                )
+                requested = Resources.from_vector(group_req_vecs[g].tolist())
                 mask_key = gmask_real[g].tobytes()
                 group_types = survivors_memo.get(mask_key)
                 if group_types is None:

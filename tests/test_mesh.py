@@ -1,6 +1,8 @@
 """Mesh-sharding tests on the virtual 8-device CPU mesh: the sharded
 lowerings must produce bit-identical results to the single-device kernels
 (GSPMD only changes placement, never semantics)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -180,3 +182,35 @@ class TestMultiHostMesh:
 
         monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
         assert init_distributed() is False
+
+    def test_init_distributed_half_configured_fails(self, monkeypatch):
+        from karpenter_tpu.parallel.mesh import init_distributed
+
+        monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "127.0.0.1:1")
+        monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+        monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+        with pytest.raises(RuntimeError, match="JAX_NUM_PROCESSES"):
+            init_distributed()
+
+
+@pytest.mark.skipif(
+    not os.environ.get("KARPENTER_TPU_MP_DRYRUN"),
+    reason="multi-process mesh dryrun (spawns jax.distributed workers): "
+    "set KARPENTER_TPU_MP_DRYRUN=1 (also run by make verify-entry)",
+)
+class TestMultiProcessMesh:
+    """The round-5 multi-process data path: solve + repack over a mesh
+    that is NOT fully addressable from any one process. Validates the
+    per-process shard construction (_put_multiprocess) and the device
+    all-gather fetch (_fetch_multiprocess) are bit-identical to the
+    single-process solve -- VERDICT r4 item 3's done-criterion."""
+
+    def test_two_process_bit_identity(self):
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8, n_processes=2)
+
+    def test_four_process_bit_identity(self):
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8, n_processes=4)
