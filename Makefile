@@ -31,10 +31,11 @@ docs:  ## regenerate generated docs + CRD manifests + compatibility matrix
 	$(PY) hack/crd_gen.py
 	$(PY) hack/kompat.py
 
-docs-check:  ## fail if generated docs / CRD manifests are stale
+docs-check:  ## fail if generated docs / CRD manifests / README perf headline are stale
 	$(PY) hack/metrics_gen.py --check
 	$(PY) hack/crd_gen.py --check
 	$(PY) hack/kompat.py --check
+	$(PY) hack/perf_check.py --check
 
 verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun + 2-process mesh)
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -1,0 +1,82 @@
+"""README performance-headline currency gate (VERDICT r4, weak #2/item 8).
+
+The README's Performance section quotes four numbers from the committed
+TPU capture of record (BENCH_TPU_CAPTURE.json): cold p99, cold p50, the
+tunnel RTT, and the tunnel-free compute sum. Rounds 3-4 showed the
+headline drifting to a superseded (better) capture; this check makes that
+failure mode mechanical: `make docs-check` fails whenever the README's
+quoted values differ from the capture file.
+
+Usage: python hack/perf_check.py --check
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+# one anchored pattern per quoted sentence: a stray "p50 X ms" elsewhere
+# in the README must not satisfy (or confuse) the gate
+HEADLINE = re.compile(
+    r"\*\*cold p99 ([0-9.]+) ms / p50 ([0-9.]+) ms\*\* wall clock, of which a\s+"
+    r"flat \*\*([0-9.]+) ms\*\* is",
+)
+COMPUTE = re.compile(r"the tunnel-free compute sum is \*\*([0-9.]+) ms\*\*")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--check", action="store_true", required=True,
+                   help="verify README quotes match BENCH_TPU_CAPTURE.json")
+    p.parse_args(argv)
+
+    try:
+        readme = (ROOT / "README.md").read_text()
+        cap = json.loads((ROOT / "BENCH_TPU_CAPTURE.json").read_text())
+        want = {
+            "cold p99": round(float(cap["value"]), 1),
+            "cold p50": round(float(cap["p50_ms"]), 1),
+            "tunnel RTT": round(float(cap["tunnel_rtt_ms"]), 1),
+            "compute sum": round(float(cap["compute_sum_ms"]), 1),
+        }
+    except (OSError, json.JSONDecodeError, KeyError, ValueError) as e:
+        print(f"perf_check: cannot load capture/README: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 1
+
+    errors = []
+    m = HEADLINE.search(readme)
+    if m is None:
+        errors.append("README is missing the 'cold p99 X ms / p50 Y ms ... flat Z ms'"
+                      " headline sentence")
+        got = {}
+    else:
+        got = {
+            "cold p99": round(float(m.group(1)), 1),
+            "cold p50": round(float(m.group(2)), 1),
+            "tunnel RTT": round(float(m.group(3)), 1),
+        }
+    mc = COMPUTE.search(readme)
+    if mc is None:
+        errors.append("README is missing the 'tunnel-free compute sum is **X ms**' quote")
+    else:
+        got["compute sum"] = round(float(mc.group(1)), 1)
+    for name, value in got.items():
+        if abs(value - want[name]) > 0.05:
+            errors.append(
+                f"README quotes {name} = {value} ms but BENCH_TPU_CAPTURE.json "
+                f"says {want[name]} ms -- update the Performance section"
+            )
+    if errors:
+        print("\n".join(errors), file=sys.stderr)
+        return 1
+    print("README performance headline matches BENCH_TPU_CAPTURE.json")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
