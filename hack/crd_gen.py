@@ -24,6 +24,33 @@ OUT_DIR = os.path.join(os.path.dirname(__file__), "..", "karpenter_tpu", "apis",
 GROUP_PROVIDER = "karpenter.tpu"
 GROUP_CORE = "karpenter.sh"
 
+# shared constraint vocabulary (reference: controller-gen kubebuilder
+# markers in pkg/apis/crds/karpenter.sh_nodepools.yaml). The name/value
+# patterns come FROM the Python admission module so the two enforcement
+# points share one source (tests/test_crd_parity.py executes both).
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from karpenter_tpu.apis.validation import (  # noqa: E402
+    LABEL_VALUE,
+    MAX_KEY_LENGTH,
+    MAX_LABEL_VALUE_LENGTH,
+    MAX_NODEPOOL_WEIGHT,
+    QUALIFIED_NAME,
+)
+
+# fractional units admitted (the serializer emits "0.5s" for sub-second
+# consolidation windows; the reference's integer-only pattern predates
+# fractional durations)
+DURATION = r"^([0-9]+(\.[0-9]+)?(s|m|h))+$"
+DURATION_OR_NEVER = r"^(([0-9]+(\.[0-9]+)?(s|m|h))+|Never)$"
+QUANTITY = (
+    r"^(\+|-)?(([0-9]+(\.[0-9]*)?)|(\.[0-9]+))"
+    r"(([KMGTPE]i)|[numkMGTPE]|([eE](\+|-)?(([0-9]+(\.[0-9]*)?)|(\.[0-9]+))))?$"
+)
+CRON = (
+    r"^(@(annually|yearly|monthly|weekly|daily|midnight|hourly))"
+    r"|((.+)\s(.+)\s(.+)\s(.+)\s(.+))$"
+)
+
 
 def selector_term_schema(with_name: bool = False, with_alias: bool = False) -> dict:
     # every term kind supports name matching (SelectorTerm.matches); the
@@ -280,26 +307,36 @@ def nodeclass_crd() -> dict:
     )
 
 
-def requirement_schema() -> dict:
+def requirement_schema(restrict_nodepool_key: bool = True) -> dict:
+    # the nodepool-identity key is restricted in NODEPOOL templates only:
+    # NodeClaims legitimately carry it (the claim is bound to its pool;
+    # ref karpenter.sh_nodeclaims.yaml:137 explicitly allows it)
+    key_schema = {
+        "type": "string",
+        "maxLength": MAX_KEY_LENGTH,
+        "pattern": QUALIFIED_NAME,
+    }
+    if restrict_nodepool_key:
+        key_schema["x-kubernetes-validations"] = [
+            {
+                "message": "requirement key karpenter.sh/nodepool is restricted",
+                "rule": "self != 'karpenter.sh/nodepool'",
+            }
+        ]
     return {
         "type": "object",
         "required": ["key", "operator"],
         "properties": {
-            "key": {
-                "type": "string",
-                "maxLength": 316,
-                "x-kubernetes-validations": [
-                    {
-                        "message": "requirement key karpenter.sh/nodepool is restricted",
-                        "rule": "self != 'karpenter.sh/nodepool'",
-                    }
-                ],
-            },
+            "key": key_schema,
             "operator": {
                 "type": "string",
                 "enum": ["In", "NotIn", "Exists", "DoesNotExist", "Gt", "Lt"],
             },
-            "values": {"type": "array", "items": {"type": "string"}, "maxItems": 50},
+            "values": {
+                "type": "array",
+                "items": {"type": "string", "maxLength": MAX_LABEL_VALUE_LENGTH, "pattern": LABEL_VALUE},
+                "maxItems": 50,
+            },
             "minValues": {"type": "integer", "minimum": 1, "maximum": 50},
         },
         "x-kubernetes-validations": [
@@ -316,8 +353,8 @@ def taint_schema() -> dict:
         "type": "object",
         "required": ["key", "effect"],
         "properties": {
-            "key": {"type": "string", "minLength": 1},
-            "value": {"type": "string"},
+            "key": {"type": "string", "minLength": 1, "pattern": QUALIFIED_NAME},
+            "value": {"type": "string", "maxLength": MAX_LABEL_VALUE_LENGTH, "pattern": LABEL_VALUE},
             "effect": {"type": "string", "enum": ["NoSchedule", "PreferNoSchedule", "NoExecute"]},
         },
     }
@@ -327,10 +364,10 @@ def nodepool_crd() -> dict:
     spec = {
         "type": "object",
         "properties": {
-            "weight": {"type": "integer", "format": "int32", "minimum": 0, "maximum": 10000},
+            "weight": {"type": "integer", "format": "int32", "minimum": 1, "maximum": MAX_NODEPOOL_WEIGHT},
             "limits": {
                 "type": "object",
-                "additionalProperties": {"type": "string"},
+                "additionalProperties": {"type": "string", "pattern": QUANTITY},
                 "x-kubernetes-validations": [
                     {"message": "limits may not be negative", "rule": "self.all(x, !self[x].startsWith('-'))"}
                 ],
@@ -342,12 +379,18 @@ def nodepool_crd() -> dict:
                         "type": "string",
                         "enum": ["WhenEmpty", "WhenEmptyOrUnderutilized"],
                     },
-                    "consolidateAfter": {"type": "string"},
+                    "consolidateAfter": {"type": "string", "pattern": DURATION_OR_NEVER},
                     "budgets": {
                         "type": "array",
                         "maxItems": 50,
                         "items": {
                             "type": "object",
+                            "x-kubernetes-validations": [
+                                {
+                                    "message": "'schedule' must be set with 'duration'",
+                                    "rule": "has(self.schedule) == has(self.duration)",
+                                }
+                            ],
                             "properties": {
                                 "nodes": {
                                     "type": "string",
@@ -360,8 +403,8 @@ def nodepool_crd() -> dict:
                                         "enum": ["Underutilized", "Empty", "Drifted", "Expired"],
                                     },
                                 },
-                                "schedule": {"type": "string"},
-                                "duration": {"type": "string"},
+                                "schedule": {"type": "string", "pattern": CRON},
+                                "duration": {"type": "string", "pattern": DURATION},
                             },
                         },
                     },
@@ -391,8 +434,8 @@ def nodepool_crd() -> dict:
                             "requirements": {"type": "array", "items": requirement_schema()},
                             "taints": {"type": "array", "items": taint_schema()},
                             "startupTaints": {"type": "array", "items": taint_schema()},
-                            "expireAfter": {"type": "string"},
-                            "terminationGracePeriod": {"type": "string"},
+                            "expireAfter": {"type": "string", "pattern": DURATION_OR_NEVER},
+                            "terminationGracePeriod": {"type": "string", "pattern": DURATION},
                         },
                     },
                 },
@@ -420,6 +463,8 @@ def nodepool_crd() -> dict:
             {"jsonPath": '.status.conditions[?(@.type=="Ready")].status', "name": "Ready", "type": "string"},
             {"jsonPath": ".metadata.creationTimestamp", "name": "Age", "type": "date"},
             {"jsonPath": ".spec.weight", "name": "Weight", "priority": 1, "type": "integer"},
+            {"jsonPath": ".status.resources.cpu", "name": "CPU", "priority": 1, "type": "string"},
+            {"jsonPath": ".status.resources.memory", "name": "Memory", "priority": 1, "type": "string"},
         ],
     )
 
@@ -436,7 +481,10 @@ def nodeclaim_crd() -> dict:
                     "name": {"type": "string"},
                 },
             },
-            "requirements": {"type": "array", "items": requirement_schema()},
+            "requirements": {
+                "type": "array",
+                "items": requirement_schema(restrict_nodepool_key=False),
+            },
             "taints": {"type": "array", "items": taint_schema()},
             "startupTaints": {"type": "array", "items": taint_schema()},
             "resources": {
@@ -445,8 +493,8 @@ def nodeclaim_crd() -> dict:
                     "requests": {"type": "object", "additionalProperties": {"type": "string"}},
                 },
             },
-            "expireAfter": {"type": "string"},
-            "terminationGracePeriod": {"type": "string"},
+            "expireAfter": {"type": "string", "pattern": DURATION_OR_NEVER},
+            "terminationGracePeriod": {"type": "string", "pattern": DURATION},
         },
         "x-kubernetes-validations": [
             {"message": "spec is immutable", "rule": "self == oldSelf"}
@@ -478,6 +526,9 @@ def nodeclaim_crd() -> dict:
             {"jsonPath": ".status.nodeName", "name": "Node", "type": "string"},
             {"jsonPath": '.status.conditions[?(@.type=="Ready")].status', "name": "Ready", "type": "string"},
             {"jsonPath": ".metadata.creationTimestamp", "name": "Age", "type": "date"},
+            {"jsonPath": ".status.providerID", "name": "ID", "priority": 1, "type": "string"},
+            {"jsonPath": '.metadata.labels.karpenter\\.sh/nodepool', "name": "NodePool", "priority": 1, "type": "string"},
+            {"jsonPath": ".spec.nodeClassRef.name", "name": "NodeClass", "priority": 1, "type": "string"},
         ],
     )
 

@@ -46,6 +46,21 @@ RESTRICTED_TAG_PATTERNS = (
 
 _ALIAS_RE = re.compile(r"^[a-zA-Z0-9]+@.+$")
 
+# shared constraint vocabulary: the CRD generator (hack/crd_gen.py)
+# imports THESE patterns into the YAML schemas, so the Python admission
+# and the manifests cannot drift on them (single source; the parity test
+# tests/test_crd_parity.py executes both sides against one corpus)
+QUALIFIED_NAME = (
+    r"^([a-z0-9]([-a-z0-9]*[a-z0-9])?(\.[a-z0-9]([-a-z0-9]*[a-z0-9])?)*(\/))?"
+    r"([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9]$"
+)
+LABEL_VALUE = r"^(([A-Za-z0-9][-A-Za-z0-9_.]*)?[A-Za-z0-9])?$"
+_QUALIFIED_NAME_RE = re.compile(QUALIFIED_NAME)
+_LABEL_VALUE_RE = re.compile(LABEL_VALUE)
+MAX_KEY_LENGTH = 316
+MAX_LABEL_VALUE_LENGTH = 63
+MAX_NODEPOOL_WEIGHT = 100
+
 
 # karpenter.sh nodepool budgets.nodes CEL shape (0-100% cap is the
 # reference's rule; PDB percents are NOT capped -- see _PDB_VALUE_RE)
@@ -272,7 +287,8 @@ def validate_nodeclass(nc) -> List[Violation]:
     return out
 
 
-def _check_requirements(reqs, path: str, out: List[Violation]) -> None:
+def _check_requirements(reqs, path: str, out: List[Violation],
+                        restrict_nodepool_key: bool = True) -> None:
     """Requirement objects normalize operators at construction (invalid
     operators and malformed Gt/Lt raise there, the CEL operator-enum and
     single-integer-value rules); what admission still owns is the key
@@ -284,6 +300,17 @@ def _check_requirements(reqs, path: str, out: List[Violation]) -> None:
         key = getattr(r, "key", "")
         if not key:
             out.append(Violation(rpath, "requirement key may not be empty"))
+        elif len(key) > MAX_KEY_LENGTH:
+            out.append(Violation(f"{rpath}.key", f"may not be longer than {MAX_KEY_LENGTH}"))
+        elif not _QUALIFIED_NAME_RE.fullmatch(key):
+            out.append(Violation(f"{rpath}.key", "must be a qualified name"))
+        for j, v in enumerate(sorted(getattr(r, "values", ()) or ())):
+            if len(v) > MAX_LABEL_VALUE_LENGTH:
+                out.append(Violation(
+                    f"{rpath}.values[{j}]",
+                    f"may not be longer than {MAX_LABEL_VALUE_LENGTH}"))
+            elif not _LABEL_VALUE_RE.fullmatch(v):
+                out.append(Violation(f"{rpath}.values[{j}]", "must be a valid label value"))
         mv = getattr(r, "min_values", None)
         if mv is not None:
             # ref CRD: minValues 1..50, meaningful only for the operators
@@ -306,16 +333,43 @@ def _check_requirements(reqs, path: str, out: List[Violation]) -> None:
                         "may only be set with the In or Exists operators",
                     )
                 )
-        if key == wk.NODEPOOL_LABEL:
+        if restrict_nodepool_key and key == wk.NODEPOOL_LABEL:
+            # NODEPOOL templates only: a NodeClaim legitimately carries the
+            # identity of the pool it is bound to (ref nodeclaims CRD
+            # explicitly allows it)
             out.append(Violation(rpath, f"requirement key {key!r} is restricted"))
+
+
+def _check_taints(taints, path: str, out: List[Violation]) -> None:
+    for i, t in enumerate(taints):
+        if t.effect and t.effect not in VALID_TAINT_EFFECTS:
+            out.append(Violation(
+                f"{path}[{i}].effect", f"must be one of {list(VALID_TAINT_EFFECTS)}"))
+        key = getattr(t, "key", "")
+        if not key:
+            out.append(Violation(f"{path}[{i}].key", "taint key may not be empty"))
+        elif not _QUALIFIED_NAME_RE.fullmatch(key):
+            out.append(Violation(f"{path}[{i}].key", "must be a qualified name"))
+        value = getattr(t, "value", "") or ""
+        if len(value) > MAX_LABEL_VALUE_LENGTH:
+            out.append(Violation(
+                f"{path}[{i}].value",
+                f"may not be longer than {MAX_LABEL_VALUE_LENGTH}"))
+        elif value and not _LABEL_VALUE_RE.fullmatch(value):
+            out.append(Violation(f"{path}[{i}].value", "must be a valid label value"))
 
 
 def validate_nodepool(pool) -> List[Violation]:
     """NodePool admission invariants (karpenter.sh_nodepools.yaml)."""
     out: List[Violation] = []
-    # ref CRD: weight 1..10000 when set (0 = unset here)
-    if not (0 <= pool.weight <= 10_000):
-        out.append(Violation("spec.weight", "must be between 0 and 10000"))
+    # ref CRD: weight 1..100 when set (0 = unset here; the manifest
+    # serializer omits weight 0, keeping the two enforcement points
+    # aligned on the boundary)
+    if not (0 <= pool.weight <= MAX_NODEPOOL_WEIGHT):
+        out.append(Violation(
+            "spec.weight",
+            f"must be at most {MAX_NODEPOOL_WEIGHT} (and at least 1 when "
+            "serialized; 0 means unset and is omitted from the manifest)"))
     if pool.limits is not None:
         for key, value in pool.limits.items():
             if value < 0:
@@ -357,18 +411,8 @@ def validate_nodepool(pool) -> List[Violation]:
             out.append(
                 Violation(f"spec.disruption.budgets[{i}].duration", "must be positive")
             )
-    for field_name, taints in (
-        ("taints", pool.template.taints),
-        ("startupTaints", pool.template.startup_taints),
-    ):
-        for i, t in enumerate(taints):
-            if t.effect and t.effect not in VALID_TAINT_EFFECTS:
-                out.append(
-                    Violation(
-                        f"spec.template.{field_name}[{i}].effect",
-                        f"must be one of {list(VALID_TAINT_EFFECTS)}",
-                    )
-                )
+    _check_taints(pool.template.taints, "spec.template.taints", out)
+    _check_taints(pool.template.startup_taints, "spec.template.startupTaints", out)
     _check_requirements(pool.template.requirements, "spec.template.requirements", out)
     return out
 
@@ -376,12 +420,10 @@ def validate_nodepool(pool) -> List[Violation]:
 def validate_nodeclaim(claim) -> List[Violation]:
     """NodeClaim admission invariants (karpenter.sh_nodeclaims.yaml)."""
     out: List[Violation] = []
-    for field_name, taints in (("taints", claim.taints), ("startupTaints", claim.startup_taints)):
-        for i, t in enumerate(taints):
-            if t.effect and t.effect not in VALID_TAINT_EFFECTS:
-                out.append(
-                    Violation(f"spec.{field_name}[{i}].effect", f"must be one of {list(VALID_TAINT_EFFECTS)}")
-                )
+    _check_taints(claim.taints, "spec.taints", out)
+    _check_taints(claim.startup_taints, "spec.startupTaints", out)
+    _check_requirements(claim.requirements, "spec.requirements", out,
+                        restrict_nodepool_key=False)
     if claim.expire_after is not None and claim.expire_after < 0:
         out.append(Violation("spec.expireAfter", "may not be negative"))
     if claim.termination_grace_period is not None and claim.termination_grace_period < 0:

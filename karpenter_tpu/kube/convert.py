@@ -250,7 +250,6 @@ def nodepool_to_manifest(p: NodePool) -> dict:
     if t.termination_grace_period is not None:
         tmpl_spec["terminationGracePeriod"] = format_duration(t.termination_grace_period)
     spec: dict = {
-        "weight": p.weight,
         "disruption": {
             "consolidationPolicy": p.disruption.consolidation_policy,
             "consolidateAfter": format_duration(p.disruption.consolidate_after) or "0s",
@@ -273,6 +272,9 @@ def nodepool_to_manifest(p: NodePool) -> dict:
             "spec": tmpl_spec,
         },
     }
+    if p.weight:
+        # 0 = unset: the CRD bounds weight to 1..100 when present
+        spec["weight"] = p.weight
     if p.limits is not None:
         spec["limits"] = resources_to_map(p.limits)
     return {

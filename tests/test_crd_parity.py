@@ -1,0 +1,334 @@
+"""CRD YAML <-> Python admission parity (VERDICT r4, missing #4 / item 5).
+
+The shipped `x-kubernetes-validations` rules and structural constraints
+are EXECUTED here via the mini-CEL evaluator (apis/celmini.py) + schema
+walker (apis/celcheck.py) against the same fixture corpus the Python
+admission (apis/validation.py) judges, through the real kube manifest
+conversion (kube/convert.py) -- the exact shape a real apiserver would
+see. The gate has three teeth:
+
+1. agreement: every fixture is accepted by BOTH enforcement points or
+   rejected by BOTH;
+2. coverage: every distinct CEL rule in the shipped YAML is flipped to
+   "reject" by at least one fixture -- adding a rule to the generator
+   without a fixture here fails the suite (the docs-check-style gate);
+3. the CRD manifests themselves are valid YAML with v1 schemas.
+
+Reference analogue: pkg/apis/v1/ec2nodeclass_validation_cel_test.go
+(1,245 LoC envtest against a real apiserver).
+"""
+from __future__ import annotations
+
+import copy
+import glob
+import os
+
+import pytest
+import yaml
+
+from karpenter_tpu.apis import (
+    Budget,
+    NodeClaim,
+    NodePool,
+    TPUNodeClass,
+)
+from karpenter_tpu.apis import celcheck, validation
+from karpenter_tpu.apis.nodeclass import SelectorTerm
+from karpenter_tpu.kube import convert
+from karpenter_tpu.scheduling import Operator as Op, Requirement, Resources, Taint
+
+CRD_DIR = os.path.join(os.path.dirname(__file__), "..", "karpenter_tpu", "apis", "crds")
+
+
+def load_crds():
+    out = {}
+    for f in glob.glob(os.path.join(CRD_DIR, "*.yaml")):
+        crd = yaml.safe_load(open(f))
+        out[crd["spec"]["names"]["kind"]] = crd
+    return out
+
+
+CRDS = load_crds()
+
+
+def cel_failures(kind: str, manifest: dict, old: dict = None):
+    return celcheck.validate_manifest(CRDS[kind], manifest, old)
+
+
+# -- fixture corpus ----------------------------------------------------------
+# Each entry: (name, kind, build() -> API object or manifest-mutator).
+# `obj` fixtures run through convert.*_to_manifest -> CEL, and through
+# validation.validate_* -> Python, asserting agreement. `manifest`
+# fixtures mutate the serialized form directly (shapes the typed model
+# cannot express) and assert CEL rejects; the Python side judges the
+# round-tripped object where conversion is possible.
+
+
+def valid_pool() -> NodePool:
+    return NodePool(
+        "good",
+        requirements=[Requirement("topology.kubernetes.io/zone", Op.IN, ["us-central-1a"])],
+        limits=Resources({"cpu": "100"}),
+        weight=10,
+    )
+
+
+def valid_claim() -> NodeClaim:
+    c = NodeClaim("good-claim")
+    return c
+
+
+def valid_nodeclass() -> TPUNodeClass:
+    return TPUNodeClass("good-nc")
+
+
+POOL_MUTATIONS = [
+    # (name, mutate(obj), expect_reject)
+    ("valid", lambda p: None, False),
+    ("default weight (0 = unset, omitted from the manifest)",
+     lambda p: setattr(p, "weight", 0), False),
+    ("empty taint key", lambda p: p.template.taints.append(
+        Taint(key="", effect="NoSchedule")), True),
+    ("weight over 100", lambda p: setattr(p, "weight", 101), True),
+    ("negative limit", lambda p: setattr(p, "limits", Resources.from_base_units({"cpu": -5.0})), True),
+    ("restricted requirement key", lambda p: p.template.requirements.append(
+        Requirement("karpenter.sh/nodepool", Op.IN, ["x"])), True),
+    ("bad requirement key charset", lambda p: p.template.requirements.append(
+        Requirement("bad key!", Op.IN, ["x"])), True),
+    ("requirement key too long", lambda p: p.template.requirements.append(
+        Requirement("k" * 317, Op.IN, ["x"])), True),
+    ("requirement value bad", lambda p: p.template.requirements.append(
+        Requirement("example.com/ok", Op.IN, ["-bad-"])), True),
+    ("taint bad effect is unrepresentable; bad value", lambda p: p.template.taints.append(
+        Taint(key="dedicated", value="-x-", effect="NoSchedule")), True),
+    ("budget nodes over 100%", lambda p: setattr(
+        p.disruption, "budgets", [Budget(nodes="150%")]), True),
+    ("budget schedule without duration", lambda p: setattr(
+        p.disruption, "budgets", [Budget(nodes="1", schedule="0 9 * * 1")]), True),
+    ("budget ok", lambda p: setattr(
+        p.disruption, "budgets",
+        [Budget(nodes="15%", schedule="0 9 * * 1", duration=3600.0)]), False),
+    ("minValues out of range", lambda p: p.template.requirements.append(
+        Requirement("example.com/ok", Op.IN, ["a", "b"], min_values=51)), True),
+    ("minValues ok", lambda p: p.template.requirements.append(
+        Requirement("example.com/ok", Op.IN, ["a", "b"], min_values=2)), False),
+]
+
+
+class TestNodePoolParity:
+    @pytest.mark.parametrize("name,mutate,reject", POOL_MUTATIONS,
+                             ids=[m[0] for m in POOL_MUTATIONS])
+    def test_both_sides_agree(self, name, mutate, reject):
+        pool = valid_pool()
+        mutate(pool)
+        py = validation.validate_nodepool(pool)
+        manifest = convert.nodepool_to_manifest(pool)
+        cel = cel_failures("NodePool", manifest)
+        assert bool(py) == reject, f"python: {[str(v) for v in py]}"
+        assert bool(cel) == reject, f"cel: {cel}"
+
+
+class TestManifestOnlyShapes:
+    """Shapes the typed model cannot produce but a hand-written manifest
+    can: the CRD must still reject them (a real apiserver would; the
+    serializer never emits them, so Python-side acceptance is
+    unreachable in the kwok rig)."""
+
+    def test_explicit_zero_weight_rejected_by_schema(self):
+        m = convert.nodepool_to_manifest(valid_pool())
+        m["spec"]["weight"] = 0
+        fails = cel_failures("NodePool", m)
+        assert any("weight" in p for p, _ in fails), fails
+
+    def test_type_mismatch_reports_not_crashes(self):
+        """A type-mismatched value under a CEL rule must produce failure
+        entries (structural + rule error), never a raw traceback."""
+        m = convert.nodeclass_to_manifest(valid_nodeclass())
+        m["spec"]["imageSelectorTerms"] = [{"alias": 5}]
+        fails = cel_failures("TPUNodeClass", m)
+        assert any("expected string" in msg for _, msg in fails), fails
+
+
+class TestNodeClaimParity:
+    def test_valid_claim_admitted_by_both(self):
+        claim = valid_claim()
+        py = validation.validate_nodeclaim(claim)
+        cel = cel_failures("NodeClaim", convert.nodeclaim_to_manifest(claim))
+        assert not py and not cel, (py, cel)
+
+    def test_spec_immutable_transition_rule(self):
+        claim = valid_claim()
+        m_old = convert.nodeclaim_to_manifest(claim)
+        m_new = copy.deepcopy(m_old)
+        # create: transition rule does not fire
+        assert not cel_failures("NodeClaim", m_new, old=None)
+        # no-op update: passes
+        assert not cel_failures("NodeClaim", m_new, old=m_old)
+        # spec change on update: rejected (the kwok store enforces the
+        # same via its immutability check on update)
+        m_new["spec"]["expireAfter"] = "12h"
+        fails = cel_failures("NodeClaim", m_new, old=m_old)
+        assert any("immutable" in msg for _, msg in fails), fails
+
+    def test_nodepool_key_allowed_on_claims_by_both(self):
+        """The nodepool-identity key is restricted in NODEPOOL templates
+        only; a NodeClaim is bound to its pool and carries it (ref
+        nodeclaims CRD explicitly allows it)."""
+        claim = valid_claim()
+        claim.requirements.add(Requirement("karpenter.sh/nodepool", Op.IN, ["default"]))
+        py = validation.validate_nodeclaim(claim)
+        cel = cel_failures("NodeClaim", convert.nodeclaim_to_manifest(claim))
+        assert not py and not cel, (py, cel)
+
+    def test_bad_requirement_key_rejected_by_both(self):
+        claim = valid_claim()
+        claim.requirements.add(Requirement("bad key!", Op.IN, ["x"]))
+        py = validation.validate_nodeclaim(claim)
+        cel = cel_failures("NodeClaim", convert.nodeclaim_to_manifest(claim))
+        assert py and cel, (py, cel)
+
+    def test_bad_taint_value_rejected_by_both(self):
+        claim = valid_claim()
+        claim.taints = [Taint(key="dedicated", value="bad value", effect="NoSchedule")]
+        py = validation.validate_nodeclaim(claim)
+        cel = cel_failures("NodeClaim", convert.nodeclaim_to_manifest(claim))
+        assert py and cel
+
+
+NODECLASS_MANIFEST_MUTATIONS = [
+    ("valid", lambda m: None, False),
+    ("role and instanceProfile together", lambda m: m["spec"].update(
+        {"role": "r", "instanceProfile": "p"}), True),
+    ("neither role nor instanceProfile", lambda m: m["spec"].pop("role", None) or
+        m["spec"].pop("instanceProfile", None), True),
+    ("empty selector term", lambda m: m["spec"].__setitem__(
+        "subnetSelectorTerms", [{}]), True),
+    ("id exclusive with tags", lambda m: m["spec"].__setitem__(
+        "subnetSelectorTerms", [{"id": "sn-1", "tags": {"a": "b"}}]), True),
+    ("empty tag value", lambda m: m["spec"].__setitem__(
+        "subnetSelectorTerms", [{"tags": {"a": ""}}]), True),
+    ("alias bad family", lambda m: m["spec"].__setitem__(
+        "imageSelectorTerms", [{"alias": "exotic@v1"}]), True),
+    ("alias exclusive with second term", lambda m: m["spec"].__setitem__(
+        "imageSelectorTerms", [{"alias": "standard@v1"}, {"id": "img-1"}]), True),
+    ("alias ok", lambda m: m["spec"].__setitem__(
+        "imageSelectorTerms", [{"alias": "standard@v1"}]), False),
+    ("restricted tag", lambda m: m["spec"].__setitem__(
+        "tags", {"karpenter.sh/nodepool": "x"}), True),
+    ("cluster tag prefix", lambda m: m["spec"].__setitem__(
+        "tags", {"kubernetes.io/cluster/foo": "owned"}), True),
+    ("kubeReserved bad key", lambda m: m["spec"].__setitem__(
+        "kubelet", {"kubeReserved": {"gpu": "1"}}), True),
+    ("kubeReserved negative", lambda m: m["spec"].__setitem__(
+        "kubelet", {"kubeReserved": {"cpu": "-1"}}), True),
+    ("evictionSoft without grace", lambda m: m["spec"].__setitem__(
+        "kubelet", {"evictionSoft": {"memory.available": "5%"}}), True),
+    ("evictionSoft with grace ok", lambda m: m["spec"].__setitem__(
+        "kubelet", {"evictionSoft": {"memory.available": "5%"},
+                    "evictionSoftGracePeriod": {"memory.available": "1m30s"}}), False),
+    ("eviction bad signal", lambda m: m["spec"].__setitem__(
+        "kubelet", {"evictionHard": {"cpu.available": "5%"}}), True),
+    ("eviction percentage over 100", lambda m: m["spec"].__setitem__(
+        "kubelet", {"evictionHard": {"memory.available": "150%"}}), True),
+    ("alias bad format", lambda m: m["spec"].__setitem__(
+        "imageSelectorTerms", [{"alias": "noatsign"}]), True),
+    ("alias exclusive within term", lambda m: m["spec"].__setitem__(
+        "imageSelectorTerms", [{"alias": "standard@v1", "id": "img-1"}]), True),
+    ("empty image term", lambda m: m["spec"].__setitem__(
+        "imageSelectorTerms", [{}]), True),
+    ("empty securitygroup term", lambda m: m["spec"].__setitem__(
+        "securityGroupSelectorTerms", [{}]), True),
+    ("grace without evictionSoft", lambda m: m["spec"].__setitem__(
+        "kubelet", {"evictionSoftGracePeriod": {"memory.available": "1m"}}), True),
+    ("zero grace period", lambda m: m["spec"].__setitem__(
+        "kubelet", {"evictionSoft": {"memory.available": "5%"},
+                    "evictionSoftGracePeriod": {"memory.available": "0s"}}), True),
+    ("empty role", lambda m: m["spec"].__setitem__("role", ""), True),
+    ("nodeclaim tag restricted", lambda m: m["spec"].__setitem__(
+        "tags", {"karpenter.sh/nodeclaim": "x"}), True),
+]
+
+
+class TestNodeClassParity:
+    @pytest.mark.parametrize("name,mutate,reject", NODECLASS_MANIFEST_MUTATIONS,
+                             ids=[m[0] for m in NODECLASS_MANIFEST_MUTATIONS])
+    def test_both_sides_agree(self, name, mutate, reject):
+        nc = valid_nodeclass()
+        manifest = convert.nodeclass_to_manifest(nc)
+        mutate(manifest)
+        cel = cel_failures("TPUNodeClass", manifest)
+        assert bool(cel) == reject, f"cel: {cel}"
+        # python side judges the round-tripped object (the kwok admission
+        # path); conversion is total for these shapes
+        obj = convert.nodeclass_from_manifest(manifest)
+        py = validation.validate_nodeclass(obj)
+        assert bool(py) == reject, f"python: {[str(v) for v in py]}"
+
+
+class TestRuleCoverage:
+    """The gate: every distinct CEL rule shipped in the YAML must be
+    flipped to 'reject' by at least one fixture above. A new rule added
+    to hack/crd_gen.py without a corpus entry fails here."""
+
+    def _all_rules(self):
+        rules = {}
+        def walk(n):
+            if isinstance(n, dict):
+                for r in n.get("x-kubernetes-validations", []) or []:
+                    rules.setdefault(r["rule"], r.get("message", ""))
+                for v in n.values():
+                    walk(v)
+            elif isinstance(n, list):
+                for v in n:
+                    walk(v)
+        for crd in CRDS.values():
+            walk(crd)
+        return rules
+
+    def _triggered_messages(self):
+        seen = set()
+
+        def collect(fails):
+            for _, msg in fails:
+                seen.add(msg.split(" (rule error")[0])
+
+        for name, mutate, reject in POOL_MUTATIONS:
+            if not reject:
+                continue
+            pool = valid_pool()
+            mutate(pool)
+            collect(cel_failures("NodePool", convert.nodepool_to_manifest(pool)))
+        for name, mutate, reject in NODECLASS_MANIFEST_MUTATIONS:
+            if not reject:
+                continue
+            manifest = convert.nodeclass_to_manifest(valid_nodeclass())
+            mutate(manifest)
+            collect(cel_failures("TPUNodeClass", manifest))
+        # claim fixtures
+        claim = valid_claim()
+        m_old = convert.nodeclaim_to_manifest(claim)
+        m_new = copy.deepcopy(m_old)
+        m_new["spec"]["expireAfter"] = "12h"
+        collect(cel_failures("NodeClaim", m_new, old=m_old))
+        pool = valid_pool()
+        pool.template.requirements.append(Requirement("karpenter.sh/nodepool", Op.IN, ["x"]))
+        collect(cel_failures("NodePool", convert.nodepool_to_manifest(pool)))
+        return seen
+
+    def test_every_shipped_rule_is_exercised(self):
+        rules = self._all_rules()
+        triggered = self._triggered_messages()
+        # rules are identified by message (what celcheck reports); every
+        # distinct message must appear in some fixture's failure set
+        missing = sorted(
+            f"{msg!r} <- {rule}" for rule, msg in rules.items() if msg not in triggered
+        )
+        # Gt/Lt single-integer rule: the typed Requirement constructor
+        # rejects the malformed shape before a manifest can exist, so the
+        # rule is exercised directly against the schema subtree instead
+        from karpenter_tpu.apis import celmini
+
+        gt_rule = next(r for r in rules if "self.operator in" in r)
+        assert celmini.evaluate(gt_rule, {"operator": "Gt", "values": ["1", "2"]}) is False
+        missing = [m for m in missing if "Gt/Lt" not in m]
+        assert not missing, "shipped CEL rules with no rejecting fixture:\n" + "\n".join(missing)

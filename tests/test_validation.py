@@ -163,8 +163,10 @@ class TestKubelet:
 class TestNodePoolRules:
     def test_weight_bounds(self):
         p = NodePool("a")
-        p.weight = 20_000
-        assert any("10000" in str(v) for v in validate_nodepool(p))
+        p.weight = 101
+        assert any("100" in str(v) for v in validate_nodepool(p))
+        p.weight = 100
+        assert not validate_nodepool(p)
 
     def test_budget_pattern(self):
         p = NodePool("a")
@@ -396,7 +398,7 @@ class TestAdmissionRuleMatrix:
             assert any(needle in str(v) for v in vs), [str(v) for v in vs]
 
         cases = [
-            ("weight range", lambda p: setattr(p, "weight", 10_001), "10000"),
+            ("weight range", lambda p: setattr(p, "weight", 101), "100"),
             ("negative limits", lambda p: setattr(p, "limits", Resources.from_base_units({"cpu": -5.0})), "negative"),
             ("consolidateAfter", lambda p: setattr(p.disruption, "consolidate_after", -1.0), "negative"),
             ("budget nodes pattern", lambda p: setattr(p.disruption, "budgets", [Budget(nodes="150%")]), "percentage"),
