@@ -23,6 +23,9 @@ benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
 e2e:  ## scale + end-to-end suites only
 	$(PYTEST) tests/test_scale.py tests/test_e2e_provisioning.py tests/test_storage.py tests/test_soak.py -q
 
+e2e-50k:  ## 50k-pod FULL-loop tier (provision -> launch -> register -> bind; minutes)
+	KARPENTER_TPU_E2E_50K=1 $(PYTEST) tests/test_scale.py -k FiftyThousand -q -s
+
 run:  ## controller loop over the kwok rig
 	$(PY) -m karpenter_tpu --max-ticks 50 --tick-interval 0.2 --metrics-dump
 
@@ -36,6 +39,7 @@ docs-check:  ## fail if generated docs / CRD manifests / README perf headline ar
 	$(PY) hack/crd_gen.py --check
 	$(PY) hack/kompat.py --check
 	$(PY) hack/perf_check.py --check
+	$(PY) hack/deploy_gen.py --check
 
 verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun + 2-process mesh)
 	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

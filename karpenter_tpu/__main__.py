@@ -79,11 +79,31 @@ def build_operator(args):
         import os as _os
 
         sock = _os.environ.get("KARPENTER_TPU_SOLVER_SOCKET", "")
+        addr = _os.environ.get("KARPENTER_TPU_SOLVER_ADDR", "")
         client = None
         if sock:
             from karpenter_tpu.solver.rpc import SolverClient
 
             client = SolverClient(path=sock)
+        elif addr:
+            # TCP sidecar (deploy/values.yaml solver.tcp): the shared
+            # token rides $KARPENTER_TPU_SOLVER_TOKEN on both ends; TLS
+            # verifies the solver against $KARPENTER_TPU_SOLVER_TLS_CA
+            # (the cert's SAN must cover
+            # $KARPENTER_TPU_SOLVER_TLS_SERVERNAME, default the host)
+            from karpenter_tpu.solver.rpc import SolverClient
+
+            host, _, port = addr.rpartition(":")
+            ctx = None
+            ca = _os.environ.get("KARPENTER_TPU_SOLVER_TLS_CA", "")
+            if ca:
+                import ssl
+
+                ctx = ssl.create_default_context(cafile=ca)
+            client = SolverClient(
+                host or "127.0.0.1", int(port), ssl_context=ctx,
+                server_hostname=_os.environ.get("KARPENTER_TPU_SOLVER_TLS_SERVERNAME") or None,
+            )
         solver = TPUSolver(auto_warm=client is None, client=client)
         evaluator = ConsolidationEvaluator()
     cluster = None

@@ -9,6 +9,7 @@ simulation as virtual nodes so repeated ticks don't double-provision.
 from __future__ import annotations
 
 from concurrent.futures import ThreadPoolExecutor
+from itertools import chain
 from typing import Dict, List, Optional
 
 import time
@@ -73,6 +74,13 @@ class Provisioner:
         self.solver = solver  # optional TPU solver; None = oracle
         self.recorder = recorder  # optional events.Recorder
         self.last_result: Optional[SchedulingResult] = None
+        # pod name -> claim name from the last scheduling decisions: the
+        # binder tries the DECIDED node first instead of re-searching the
+        # whole fleet per pod (round 5: the generic scan was O(pods x
+        # nodes) per tick at 50k scale). Purely a fast path -- every hint
+        # is re-validated by the same fit/affinity/spread checks, and a
+        # failed hint falls back to the full scan.
+        self._assignment_hints: Dict[str, str] = {}
 
     # -- snapshot -----------------------------------------------------------
     def _existing_nodes(self) -> List[ExistingNode]:
@@ -185,6 +193,8 @@ class Provisioner:
         metrics.SCHEDULING_DURATION.observe(time.perf_counter() - t0)
         metrics.IGNORED_PODS.set(len(result.unschedulable))
         self._publish_unschedulable(result)
+        # existing-node decisions hint the binder directly (node names)
+        self._assignment_hints.update(result.existing_assignments)
         if result.new_groups or result.unschedulable:
             self.log.info(
                 "scheduling decision",
@@ -232,6 +242,8 @@ class Provisioner:
             if err is None:
                 self.cluster.update(claim)
                 metrics.NODECLAIMS_CREATED.inc(nodepool=group.nodepool.name)
+                for pod in group.pods:
+                    self._assignment_hints[pod.metadata.name] = claim.metadata.name
             else:
                 # ICE already recorded by the instance provider; drop the
                 # claim so the next tick re-simulates around it
@@ -283,8 +295,14 @@ class PodBinder:
     ready compatible nodes, first fit (the reference relies on the real
     kube-scheduler for this; the kwok rig needs it in-process)."""
 
-    def __init__(self, cluster: Cluster):
+    def __init__(self, cluster: Cluster, assignment_hints: Optional[Dict[str, str]] = None):
         self.cluster = cluster
+        # shared with the Provisioner (operator wiring): pod name -> node/
+        # claim name from the scheduling decision; see Provisioner's
+        # _assignment_hints docstring
+        self._assignment_hints: Dict[str, str] = (
+            assignment_hints if assignment_hints is not None else {}
+        )
 
     def reconcile(self) -> int:
         from karpenter_tpu.apis.storage import VolumeIndex
@@ -302,6 +320,14 @@ class PodBinder:
         # built once per reconcile: node_usage consults it for bound pods'
         # attachments in the per-(pod, node) loop below
         vol_index = VolumeIndex.from_cluster(self.cluster)
+        # incremental usage accounting (round 5): calling node_usage per
+        # (pod, candidate node) try re-summed every bound pod's requests
+        # -- quadratic at 50k pods (the full-loop E2E spent >80% of its
+        # wall there). ONE snapshot per reconcile, O(1) add per bind.
+        usage: Dict[str, Resources] = {
+            n.metadata.name: self.cluster.node_usage(n.metadata.name, vol_index)
+            for n in nodes
+        }
         for pod in self.cluster.pending_pods():
             needed = pod.requests + Resources.from_base_units({res.PODS: 1})
             vol_zone = None
@@ -349,14 +375,26 @@ class PodBinder:
             }
             chosen = None
             chosen_key = None
-            for node in nodes:
+            # decision-hint fast path: try the node the scheduling decision
+            # assigned FIRST (claim names double as kwok node names). Only
+            # for pods with no scoring pass -- scored pods must still see
+            # every candidate. A hint that fails any check falls through
+            # to the full scan below.
+            hinted = (
+                node_by_name.get(self._assignment_hints.get(pod.metadata.name, ""))
+                if soft is None and not prefs and not soft_host else None
+            )
+            # chain, not list-concat: copying the full node list per hinted
+            # pod would cost O(nodes) allocations at 50k scale
+            candidates = nodes if hinted is None else chain((hinted,), nodes)
+            for node in candidates:
                 if not tolerates_all(pod.tolerations, node.taints):
                     continue
                 if not any(alt.matches_labels(node.metadata.labels) for alt in pod.scheduling_requirements()):
                     continue
                 if vol_zone is not None and node.metadata.labels.get(wk.ZONE_LABEL) != vol_zone:
                     continue
-                used = self.cluster.node_usage(node.metadata.name, vol_index)
+                used = usage[node.metadata.name]
                 if not (used + needed).fits(node.allocatable):
                     continue
                 if not self._anti_affinity_ok(pod, node):
@@ -386,6 +424,8 @@ class PodBinder:
             if chosen is None:
                 continue
             self.cluster.bind_pod(pod, chosen)
+            usage[chosen.metadata.name] = usage[chosen.metadata.name] + needed
+            self._assignment_hints.pop(pod.metadata.name, None)
             if pod.volume_claims:
                 # first-consumer binding: the landing zone binds the pod's
                 # still-unbound WaitForFirstConsumer claims (the PV
@@ -416,6 +456,16 @@ class PodBinder:
             bound += 1
         if bound:
             metrics.PODS_BOUND.inc(bound)
+        # stale-hint purge: keep only hints for pods that are still
+        # pending (bounded by the pending set; a vanished pod's hint
+        # would otherwise live forever). IN PLACE: this dict is shared
+        # with the Provisioner by reference (operator wiring) -- a
+        # reassignment would sever it and silently kill the fast path
+        # (round-5 review finding).
+        if self._assignment_hints:
+            pending = {p.metadata.name for p in self.cluster.pending_pods()}
+            for stale in [k for k in self._assignment_hints if k not in pending]:
+                del self._assignment_hints[stale]
         metrics.NODES_READY.set(float(len(nodes)))
         return bound
 

@@ -148,7 +148,9 @@ class Operator:
         self.nodeclaim_lifecycle = NodeClaimLifecycleController(
             self.cluster, self.cloud_provider, recorder=self.recorder
         )
-        self.binder = PodBinder(self.cluster)
+        self.binder = PodBinder(
+            self.cluster, assignment_hints=self.provisioner._assignment_hints
+        )
         self.lifecycle = NodeLifecycle(self.cluster, self.cloud)
         self.termination = TerminationController(self.cluster, self.cloud_provider, recorder=self.recorder)
         self.disruption = DisruptionController(

@@ -344,16 +344,55 @@ class TPUSolver:
                 overlap = len(scheduler.nodepools) > 1 and TPUSolver._pools_overlap(
                     scheduler.nodepools, pods, classes=classes
                 )
-            if len(scheduler.nodepools) > 1 and not overlap:
-                # DISJOINT multi-pool spread would need cross-pool count
-                # carry on the pool-sequential path -- oracle. OVERLAPPING
-                # pools take the merged-catalog solve (round 4, second
-                # pass), whose single joint catalog gives the spread split
-                # one zone/count view across every pool -- the cross-pool
-                # carry falls out of the merge, under the same deviation
-                # contract as single-pool mixed spread.
+            if (
+                len(scheduler.nodepools) > 1 and not overlap
+                and TPUSolver._spread_spans_pools(scheduler, device_classes)
+            ):
+                # DISJOINT multi-pool spread stays on device UNLESS one
+                # spread SELECTOR's classes route to different pools
+                # (round 5): per-selector counts are then truly cross-pool
+                # state, and min-count placement over heterogeneous
+                # domains is order-sensitive -- the pool-sequential pass
+                # cannot reproduce the oracle's interleaved order, so that
+                # shape takes the oracle. Pool-LOCAL selectors (each
+                # workload spreads within the one pool that admits it, the
+                # overwhelmingly common shape) need no cross-pool carry at
+                # all; their counts seed per round from the scheduler's
+                # topology state. OVERLAPPING pools take the merged-catalog
+                # solve (round 4), whose single joint catalog gives the
+                # split one zone/count view across every pool.
                 return False
         return True
+
+    @staticmethod
+    def _spread_spans_pools(scheduler: Scheduler, classes) -> bool:
+        """True when one topology-spread selector's classes are admitted
+        by DIFFERENT pools (disjoint-pool context): the selector's zone
+        counts would then be cross-pool state the pool-sequential solve
+        cannot thread in the oracle's interleaved order."""
+        from karpenter_tpu.solver.oracle import _ALLOW_UNDEFINED
+
+        pool_reqs = [p.requirements() for p in scheduler.nodepools]
+        owner: Dict[tuple, int] = {}
+        for pc in classes:
+            rep = pc.pods[0]
+            if not rep.topology_spread:
+                continue
+            pi = next(
+                (
+                    i for i, reqs in enumerate(pool_reqs)
+                    if reqs.compatible(pc.requirements, allow_undefined=_ALLOW_UNDEFINED)
+                ),
+                -1,
+            )
+            if pi < 0:
+                continue  # admitted nowhere: unschedulable either way
+            for t in rep.topology_spread:
+                key = (t.topology_key, tuple(sorted(t.label_selector.items())))
+                prev = owner.setdefault(key, pi)
+                if prev != pi:
+                    return True
+        return False
 
     @staticmethod
     def _mv_classes(scheduler: Scheduler, classes) -> list:
@@ -644,7 +683,10 @@ class TPUSolver:
                 nodepool_usage=scheduler.usage.get(pool.name),
                 existing_nodes=existing,
                 zones=sorted(scheduler.zones),
-                spread_seeds=self._spread_seeds(scheduler) if i == 0 else None,
+                # seeds every round (round 5): a pool-local spread class
+                # may only be admitted by a LATER pool in the weight
+                # order, and its counts must still seed from live pods
+                spread_seeds=self._spread_seeds(scheduler),
                 classes=base_classes if i == 0 else None,
                 daemon_overhead=scheduler.daemon_overhead.get(pool.name),
             )

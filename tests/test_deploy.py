@@ -6,7 +6,8 @@ import os
 
 import yaml
 
-DEPLOY = os.path.join(os.path.dirname(__file__), "..", "deploy")
+REPO = os.path.join(os.path.dirname(__file__), "..")
+DEPLOY = os.path.join(REPO, "deploy")
 
 
 def _load(name):
@@ -136,3 +137,78 @@ class TestHealthServer:
             assert code == 503
         finally:
             hs.stop()
+
+
+class TestDeployRendering:
+    """deploy/controller.yaml is RENDERED from deploy/values.yaml
+    (hack/deploy_gen.py, the chart-values analogue -- VERDICT r4 item 10);
+    make docs-check fails when it goes stale."""
+
+    def _gen(self):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "deploy_gen", os.path.join(REPO, "hack", "deploy_gen.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_rendered_manifest_is_current(self):
+        gen = self._gen()
+        with open(os.path.join(REPO, "deploy", "controller.yaml")) as f:
+            assert f.read() == gen.render(gen.load_values())
+
+    def test_feature_gates_and_image_parameterize(self):
+        gen = self._gen()
+        v = gen.load_values()
+        v["image"] = "registry.example/ktpu:v9"
+        v["featureGates"] = {"SpotToSpotConsolidation": True, "Exp": False}
+        m = yaml.safe_load(gen.render(v))
+        ctr = m["spec"]["template"]["spec"]["containers"][0]
+        assert ctr["image"] == "registry.example/ktpu:v9"
+        assert "--feature-gates=Exp=false,SpotToSpotConsolidation=true" in ctr["args"]
+
+    def test_tcp_mode_requires_token_and_wires_secret(self):
+        import pytest as _pytest
+
+        gen = self._gen()
+        v = gen.load_values()
+        v["solver"]["tcp"] = {"address": "0.0.0.0:7733"}
+        with _pytest.raises(SystemExit, match="tokenSecret"):
+            gen.render(v)
+        v["solver"]["tcp"]["tokenSecret"] = "solver-token"
+        m = yaml.safe_load(gen.render(v))
+        spec = m["spec"]["template"]["spec"]
+        ctr, solver = spec["containers"]
+        env = {e["name"]: e for e in ctr["env"]}
+        assert env["KARPENTER_TPU_SOLVER_ADDR"]["value"] == "127.0.0.1:7733"
+        assert env["KARPENTER_TPU_SOLVER_TOKEN"]["valueFrom"]["secretKeyRef"]["name"] == "solver-token"
+        assert "--host=0.0.0.0" in solver["args"] and "--port=7733" in solver["args"]
+        # no socket volume in TCP mode
+        assert all(vol["name"] != "solver-socket" for vol in spec["volumes"])
+
+    def test_tls_wires_both_ends(self):
+        gen = self._gen()
+        v = gen.load_values()
+        v["solver"]["tcp"] = {
+            "address": "0.0.0.0:7733", "tokenSecret": "t", "tlsSecret": "solver-tls",
+        }
+        m = yaml.safe_load(gen.render(v))
+        ctr, solver = m["spec"]["template"]["spec"]["containers"]
+        assert "--tls-cert=/tls/tls.crt" in solver["args"]
+        assert any(vm["mountPath"] == "/tls" for vm in solver["volumeMounts"])
+        # the CONTROLLER side must be able to actually connect: CA env +
+        # servername + the secret mounted (round-5 review finding)
+        env = {e["name"]: e.get("value") for e in ctr["env"]}
+        assert env.get("KARPENTER_TPU_SOLVER_TLS_CA") == "/tls/ca.crt"
+        assert env.get("KARPENTER_TPU_SOLVER_TLS_SERVERNAME") == "karpenter-tpu-solver"
+        assert any(vm["mountPath"] == "/tls" for vm in ctr["volumeMounts"])
+
+    def test_health_port_reaches_the_process(self):
+        gen = self._gen()
+        v = gen.load_values()
+        v["healthPort"] = 9090
+        m = yaml.safe_load(gen.render(v))
+        ctr = m["spec"]["template"]["spec"]["containers"][0]
+        assert "--health-port=9090" in ctr["args"]
+        assert ctr["ports"][0]["containerPort"] == 9090

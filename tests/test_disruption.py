@@ -297,9 +297,9 @@ class TestPassStaleness:
                 requests=Resources.from_base_units({res.CPU: pcpu, res.MEMORY: 256 * 2**20}),
                 annotations=annotations,
             )
-            env.cluster.create(p)
             p.node_name = name
             p.phase = "Running"
+            env.cluster.create(p)
         return claim
 
     @pytest.mark.parametrize("use_evaluator", [False, True])
@@ -396,9 +396,9 @@ class TestMultiNodeReplacement:
                 ),
                 node_selector={wk.CAPACITY_TYPE_LABEL: wk.CAPACITY_TYPE_ON_DEMAND},
             )
-            env.cluster.create(p)
             p.node_name = name
             p.phase = "Running"
+            env.cluster.create(p)
         return claim
 
     def _env(self, use_evaluator):

@@ -579,9 +579,11 @@ class TestMergedMultiPoolSpread:
         )
         assert sorted(dist.values()) == [3, 3]
 
-    def test_disjoint_multi_pool_spread_still_oracle(self, catalog_items):
-        """NON-overlapping pools + spread keep the oracle: the
-        pool-sequential device path has no cross-pool count carry."""
+    def test_disjoint_multi_pool_spread_routing(self, catalog_items):
+        """Round 5 narrowed the disjoint-pool spread carve-out: a selector
+        whose classes all route to ONE pool (pool-local) stays on device;
+        a selector SPANNING pools still takes the oracle (its counts are
+        order-sensitive cross-pool state)."""
         from karpenter_tpu.apis.pod import TopologySpreadConstraint
         from karpenter_tpu.solver.service import TPUSolver
 
@@ -589,20 +591,31 @@ class TestMergedMultiPoolSpread:
         tsc = TopologySpreadConstraint(
             max_skew=1, topology_key=wk.ZONE_LABEL, label_selector={"app": "web"}
         )
-        # every pod pool-pinned -> no overlap
-        pods = [
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+
+        def mk_sched():
+            return Scheduler(
+                nodepools=list(pools),
+                instance_types={p.name: catalog_items for p in pools},
+                zones=zones,
+            )
+
+        # pool-LOCAL: every spread pod pinned to one pool -> device
+        local = [
             Pod(f"web-{i}", requests=Resources({"cpu": "1", "memory": "1Gi"}),
                 labels={"app": "web"}, topology_spread=[tsc],
                 node_selector={wk.ARCH_LABEL: "arm64"})
             for i in range(4)
         ]
-        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
-        sched = Scheduler(
-            nodepools=list(pools),
-            instance_types={p.name: catalog_items for p in pools},
-            zones=zones,
-        )
-        assert not TPUSolver.supports(sched, pods)
+        assert TPUSolver.supports(mk_sched(), local)
+        # SPANNING: same selector split across both pools -> oracle
+        spanning = local[:2] + [
+            Pod(f"web-x{i}", requests=Resources({"cpu": "1", "memory": "1Gi"}),
+                labels={"app": "web"}, topology_spread=[tsc],
+                node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(2)
+        ]
+        assert not TPUSolver.supports(mk_sched(), spanning)
 
 
 class TestSteadyStateMultiPool:

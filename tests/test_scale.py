@@ -21,10 +21,10 @@ from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
 from karpenter_tpu.solver.service import TPUSolver
 
 
-def fresh_env(solver=True, evaluator=True):
+def fresh_env(solver=True, evaluator=True, g_max=512):
     op = Operator(
         clock=FakeClock(100_000.0),
-        solver=TPUSolver(g_max=512) if solver else None,
+        solver=TPUSolver(g_max=g_max) if solver else None,
         consolidation_evaluator=ConsolidationEvaluator() if evaluator else None,
     )
     op.cluster.create(TPUNodeClass("default"))
@@ -245,3 +245,91 @@ class TestTenThousandPodTier:
         assert len(eff) == 10_000 and not blocked
         assert all(a is b for a, b in zip(eff[:9_000], mixed[:9_000])), "identity pass lost"
         assert resolve_s < 0.2, f"10k-pod volume resolution took {resolve_s:.3f}s (min of 3)"
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("KARPENTER_TPU_E2E_50K"),
+    reason="50k-pod full-loop E2E (minutes of kwok churn): set KARPENTER_TPU_E2E_50K=1 "
+    "(make e2e-50k)",
+)
+class TestFiftyThousandPodFullLoop:
+    """VERDICT r4 item 6: the 50k-pod scale previously existed only on the
+    solver bench path; this tier drives it through the WHOLE controller
+    loop -- provisioner -> NodeClaims -> fleet launch -> node registration
+    -> binding -- on the kwok rig, like the reference's 500-node/4k-pod
+    suites (test/suites/scale/provisioning_test.go:86-122) but at the
+    framework's own headline magnitude."""
+
+    def test_full_loop_50k(self):
+        import bench
+
+        # g_max sized like the bench: the 50k price-objective decision
+        # opens ~620 groups; 512 would overflow the first tick and force
+        # incremental refill onto partial nodes (pricier, slower)
+        op = fresh_env(g_max=1024)
+        op.tick()  # hydrate nodeclass/catalog
+        zones = [z.name for z in op.cloud.describe_zones()]
+        pods = bench.synth_pods(np.random.default_rng(11), zones, 50_000, salt=1)
+        for p in pods:
+            op.cluster.create(p)
+
+        t0 = time.perf_counter()
+        ticks = op.settle(max_ticks=60)
+        wall = time.perf_counter() - t0
+        assert not op.cluster.pending_pods(), (
+            f"{len(op.cluster.pending_pods())} pods still pending after {ticks} ticks"
+        )
+        bound = sum(1 for p in op.cluster.list(Pod) if p.node_name)
+        assert bound == 50_000, f"only {bound} pods bound"
+        nodes = op.cluster.list(Node)
+        claims = op.cluster.list(NodeClaim)
+        assert len(nodes) == len(claims)
+        # every claim launched real (fake-cloud) capacity and registered
+        assert all(c.launched() for c in claims)
+
+        # fleet price vs the ORACLE: the sequential reference implementation
+        # solving the same pending set must produce the same total price --
+        # the full loop must not distort the scheduling decision
+        pool = op.cluster.get(NodePool, "default")
+        items = op.cloud_provider.get_instance_types(pool)
+        from karpenter_tpu.solver.oracle import Scheduler
+
+        sched = Scheduler(
+            nodepools=[pool], instance_types={pool.name: items},
+            zones={o.zone for it in items for o in it.available_offerings()},
+        )
+        t1 = time.perf_counter()
+        oracle = sched.schedule(
+            bench.synth_pods(np.random.default_rng(11), zones, 50_000, salt=1))
+        oracle_s = time.perf_counter() - t1
+        oracle_price = sum(g.instance_types[0].cheapest_price() for g in oracle.new_groups)
+        fleet_price = 0.0
+        by_name = {it.name: it for it in items}
+        for c in claims:
+            it = by_name.get(c.instance_type)
+            if it is not None:
+                fleet_price += it.cheapest_price()
+        # the launched fleet prices within a whisker of the oracle's
+        # decision: the fleet picker may choose an equally-priced
+        # different type inside a claim's 60-type flexibility set, so
+        # exact type-for-type equality is not the contract -- total
+        # fleet cost is
+        assert fleet_price <= oracle_price * 1.02 + 1e-6, (
+            f"fleet ${fleet_price:.2f}/h vs oracle ${oracle_price:.2f}/h"
+        )
+        assert fleet_price >= oracle_price * 0.9, (
+            f"fleet ${fleet_price:.2f}/h suspiciously below oracle "
+            f"${oracle_price:.2f}/h -- price accounting broken?"
+        )
+
+        # calibrated wall bound: measured 10.7s over 3 ticks on the dev
+        # host (docs/performance.md); ~5x headroom for CI noise
+        assert wall < _FULL_LOOP_BOUND_S, (
+            f"50k full loop took {wall:.1f}s (ticks={ticks}, oracle alone {oracle_s:.1f}s)"
+        )
+        print(f"\n50k full loop: {wall:.1f}s over {ticks} ticks, "
+              f"{len(nodes)} nodes, fleet ${fleet_price:.2f}/h "
+              f"(oracle ${oracle_price:.2f}/h in {oracle_s:.1f}s)")
+
+
+_FULL_LOOP_BOUND_S = 60.0

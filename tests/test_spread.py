@@ -882,3 +882,114 @@ class TestSpreadEndToEnd:
         )
         # pods spread across all 4 zones
         assert len(set(node_zones)) == 4
+
+
+class TestDisjointPoolSpread:
+    """Round 5 (VERDICT r4 item 9): disjoint multi-pool batches with
+    POOL-LOCAL spread selectors stay on device -- each workload spreads
+    within the one pool that admits it, so no cross-pool count state
+    exists. A selector spanning pools still takes the oracle."""
+
+    def _pools(self):
+        from karpenter_tpu.scheduling import Requirement, Operator as Op
+
+        arm = NodePool("arm")
+        arm.weight = 10
+        arm.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"])]
+        amd = NodePool("amd")
+        amd.weight = 1
+        amd.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])]
+        return arm, amd
+
+    def test_pool_local_spread_stays_on_device_and_matches(self, catalog_items):
+        arm, amd = self._pools()
+        pods = [
+            spread_pod(f"a{i}", "500m", "1Gi", app="arm-web",
+                       node_selector={wk.ARCH_LABEL: "arm64"})
+            for i in range(7)
+        ] + [
+            spread_pod(f"x{i}", "500m", "1Gi", app="amd-web",
+                       node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(5)
+        ] + [
+            Pod(f"plain{i}", requests=Resources({"cpu": "250m", "memory": "512Mi"}),
+                node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(4)
+        ]
+        oracle, device = run_both_scheduled(catalog_items, pods, pools=[arm, amd])
+        solver = TPUSolver(g_max=256)
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=[arm, amd],
+            instance_types={"arm": catalog_items, "amd": catalog_items},
+            zones=zones,
+        )
+        device2 = solver.schedule(sched, list(pods))
+        assert solver.last_route["path"] == "device", solver.last_route
+        assert set(oracle.unschedulable) == set(device2.unschedulable)
+        assert zone_distribution_spread_only(oracle) == zone_distribution_spread_only(device2)
+
+    def test_spanning_selector_takes_oracle(self, catalog_items):
+        arm, amd = self._pools()
+        # ONE selector (app=web) spans both pools: cross-pool count state
+        pods = [
+            spread_pod(f"a{i}", "500m", "1Gi", app="web",
+                       node_selector={wk.ARCH_LABEL: "arm64"})
+            for i in range(3)
+        ] + [
+            spread_pod(f"x{i}", "500m", "1Gi", app="web",
+                       node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(3)
+        ]
+        solver = TPUSolver(g_max=256)
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+        sched = Scheduler(
+            nodepools=[arm, amd],
+            instance_types={"arm": catalog_items, "amd": catalog_items},
+            zones=zones,
+        )
+        result = solver.schedule(sched, list(pods))
+        assert solver.last_route["path"] == "oracle", solver.last_route
+        assert not result.unschedulable
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_disjoint_pool_local_spread(self, catalog_items, seed):
+        import numpy as np
+
+        rng = np.random.default_rng(3300 + seed)
+        arm, amd = self._pools()
+        pods = []
+        for t in range(int(rng.integers(2, 6))):
+            arch = "arm64" if rng.random() < 0.5 else "amd64"
+            n = int(rng.integers(2, 8))
+            cpu = ["250m", "500m", "1"][int(rng.integers(0, 3))]
+            if rng.random() < 0.6:
+                for i in range(n):
+                    pods.append(spread_pod(
+                        f"s{seed}-{t}-{i}", cpu, "1Gi", app=f"w{t}",
+                        max_skew=int(rng.choice([1, 2])),
+                        node_selector={wk.ARCH_LABEL: arch}))
+            else:
+                for i in range(n):
+                    pods.append(Pod(
+                        f"p{seed}-{t}-{i}",
+                        requests=Resources({"cpu": cpu, "memory": "1Gi"}),
+                        node_selector={wk.ARCH_LABEL: arch}))
+        oracle, device = run_both_scheduled(catalog_items, pods, pools=[arm, amd])
+        assert set(oracle.unschedulable) == set(device.unschedulable), f"seed {seed}"
+        assert zone_distribution_spread_only(oracle) == zone_distribution_spread_only(device), f"seed {seed}"
+
+
+def zone_distribution_spread_only(result):
+    """(app label, zone) -> pod count over spread-constrained pods: the
+    exact quantity the spread contract constrains across pools."""
+    from collections import Counter
+
+    out = Counter()
+    for g in result.new_groups:
+        zreq = g.requirements.get(wk.ZONE_LABEL)
+        zone = tuple(sorted(zreq.values)) if zreq is not None and not zreq.complement else ("any",)
+        for p in g.pods:
+            if p.topology_spread:
+                out[(p.metadata.labels.get("app"), zone)] += 1
+    return out
