@@ -67,20 +67,63 @@ def render_standard(
           {" ".join(_kubelet_args(nodeclass.kubelet, max_pods))}
         """
     )
-    parts = []
+    parts: List[tuple] = []
     if nodeclass.user_data:
-        parts.append(nodeclass.user_data)
-    parts.append(script)
+        mime_parts = _unpack_mime(nodeclass.user_data)
+        if mime_parts is not None:
+            # the user supplied a MIME archive of their own: LIFT its
+            # parts into the merged archive -- ALL part headers ride
+            # along (Content-Transfer-Encoding etc.; dropping them would
+            # corrupt base64-encoded parts) -- instead of nesting the
+            # whole document as one opaque shell part. The reference's
+            # mime merge does the same (bootstrap/mime/mime.go: parts
+            # concatenate, custom first).
+            parts.extend(mime_parts)
+        else:
+            parts.append((_SHELL_HEADERS, nodeclass.user_data))
+    parts.append((_SHELL_HEADERS, script))
     if len(parts) == 1:
-        return parts[0]
+        return parts[0][1]
     # RFC 2046: parts delimited by "--" + boundary, terminated by
     # "--" + boundary + "--" (reference merges userdata the same way,
     # bootstrap/mime/mime.go:121)
     body = [f'MIME-Version: 1.0\nContent-Type: multipart/mixed; boundary="{MIME_BOUNDARY}"\n']
-    for p in parts:
-        body.append(f'--{MIME_BOUNDARY}\nContent-Type: text/x-shellscript; charset="us-ascii"\n\n{p}')
+    for headers, p in parts:
+        body.append(f"--{MIME_BOUNDARY}\n{headers}\n\n{p}")
     body.append(f"--{MIME_BOUNDARY}--")
     return "\n".join(body)
+
+
+_SHELL_HEADERS = 'Content-Type: text/x-shellscript; charset="us-ascii"'
+
+
+def _unpack_mime(user_data: str):
+    """If `user_data` is itself a multipart MIME document, return its
+    [(header block, body)] parts in order; otherwise None. Detection is
+    header-based (a multipart content type before the first blank line),
+    so a shell script mentioning MIME in a comment stays opaque. The
+    header block carries EVERY part header verbatim (a part lacking
+    Content-Type gets MIME's text/plain default, never an executable
+    type); the body stays in its original transfer encoding, which the
+    preserved headers describe."""
+    import email
+
+    head = user_data.split("\n\n", 1)[0].lower()
+    if "content-type:" not in head or "multipart/" not in head:
+        return None
+    msg = email.message_from_string(user_data)
+    if not msg.is_multipart():
+        return None
+    out = []
+    for part in msg.walk():
+        if part.is_multipart():
+            continue
+        items = list(part.items())
+        if not any(k.lower() == "content-type" for k, _ in items):
+            items.insert(0, ("Content-Type", "text/plain"))
+        headers = "\n".join(f"{k}: {v}" for k, v in items)
+        out.append((headers, part.get_payload()))
+    return out
 
 
 def render_declarative(

@@ -167,3 +167,101 @@ class TestStructuralLaws:
                 "Immutable", cluster_name="c", endpoint="e", ca_bundle="b",
                 nodeclass=nc, labels={}, taints=[], max_pods=None,
             )
+
+
+MIME_USERDATA = (
+    'MIME-Version: 1.0\n'
+    'Content-Type: multipart/mixed; boundary="USERB"\n'
+    '\n'
+    '--USERB\n'
+    'Content-Type: text/x-shellscript; charset="us-ascii"\n'
+    '\n'
+    '#!/bin/bash\necho user-part-one\n'
+    '--USERB\n'
+    'Content-Type: text/cloud-config; charset="us-ascii"\n'
+    '\n'
+    'packages:\n  - htop\n'
+    '--USERB--\n'
+)
+
+
+class TestMimeUserdataMerge:
+    """VERDICT r4 item 7: userdata merge semantics per family. A user-
+    supplied MIME archive must have its parts LIFTED into the merged
+    archive (content types preserved, custom first), not nested as one
+    opaque shell part -- the reference's mime merge contract."""
+
+    @pytest.mark.parametrize("family", ["Standard", "Minimal"])
+    def test_user_mime_parts_lifted(self, family):
+        nc = TPUNodeClass("m")
+        nc.image_family = family
+        nc.user_data = MIME_USERDATA
+        out = bootstrap.render(
+            family, cluster_name="c", endpoint="e", ca_bundle="b",
+            nodeclass=nc, labels={}, taints=[], max_pods=10,
+        )
+        # three parts: the user's two + the generated bootstrap script
+        assert out.count("--BOUNDARY\n") == 3
+        assert "text/cloud-config" in out
+        assert "user-part-one" in out and "packages:" in out
+        # no nested multipart: the user's own boundary must not survive
+        assert "USERB" not in out
+        # custom parts come FIRST
+        assert out.index("user-part-one") < out.index("bootstrap-node")
+
+    def test_shell_script_mentioning_mime_stays_opaque(self):
+        nc = TPUNodeClass("m")
+        nc.user_data = "#!/bin/bash\n# Content-Type: multipart/mixed haha\necho hi\n"
+        out = bootstrap.render(
+            "Standard", cluster_name="c", endpoint="e", ca_bundle="b",
+            nodeclass=nc, labels={}, taints=[], max_pods=10,
+        )
+        assert out.count("--BOUNDARY\n") == 2  # user script + generated
+
+    @pytest.mark.parametrize("family", ["Standard", "Minimal"])
+    def test_mime_userdata_golden(self, family):
+        nc = TPUNodeClass("golden")
+        nc.image_family = family
+        nc.user_data = MIME_USERDATA
+        out = bootstrap.render(
+            family, cluster_name="golden-cluster",
+            endpoint="https://10.0.0.1:443", ca_bundle="Q0EtZGF0YQ==",
+            nodeclass=nc, labels={"team": "ml"}, taints=[], max_pods=110,
+        )
+        path = os.path.join(GOLDEN_DIR, f"{family.lower()}_mime_userdata.txt")
+        if UPDATE:
+            with open(path, "w") as f:
+                f.write(out)
+            pytest.skip("golden updated")
+        assert os.path.exists(path), f"missing golden {path}"
+        with open(path) as f:
+            assert out == f.read()
+
+    def test_transfer_encoding_and_default_type_preserved(self):
+        """Round-5 review: part headers beyond Content-Type must ride
+        along (base64 parts stay decodable) and a header-less part gets
+        MIME's text/plain default, never an executable type."""
+        import base64
+
+        encoded = base64.b64encode(b"#!/bin/bash\necho encoded\n").decode()
+        nc = TPUNodeClass("m")
+        nc.user_data = (
+            'MIME-Version: 1.0\n'
+            'Content-Type: multipart/mixed; boundary="USERB"\n\n'
+            '--USERB\n'
+            'Content-Type: text/x-shellscript; charset="us-ascii"\n'
+            'Content-Transfer-Encoding: base64\n\n'
+            f'{encoded}\n'
+            '--USERB\n'
+            'X-Custom: note\n\n'
+            'just some notes\n'
+            '--USERB--\n'
+        )
+        out = bootstrap.render(
+            "Standard", cluster_name="c", endpoint="e", ca_bundle="b",
+            nodeclass=nc, labels={}, taints=[], max_pods=10,
+        )
+        assert "Content-Transfer-Encoding: base64" in out
+        assert encoded in out  # body NOT re-encoded or decoded
+        assert "Content-Type: text/plain\nX-Custom: note" in out
+        assert "just some notes" in out
