@@ -36,6 +36,21 @@ class PodAffinityTerm:
     anti: bool = False
 
 
+# spec-token intern table (utils.InternTable: monotone ids, safe clears):
+# the raw token is a nested tuple whose hash the 50k-pod grouping loop
+# would otherwise recompute on EVERY dict probe (measured ~2.5 ms/tick at
+# 50k); interning at CONSTRUCTION -- watch-ingestion time, off the
+# scheduling-latency path -- makes the hot-loop key a trivially-hashed
+# int. Content-equal tuples intern to the same int, so token semantics
+# (equality == shared spec) are unchanged. After an overflow clear, a
+# live pod KEEPS its old int and still takes the token path; safety rests
+# solely on the monotone counter never reusing ids (round-5 review).
+from karpenter_tpu.utils import InternTable as _InternTable
+
+_SPEC_TOKENS = _InternTable()
+_intern_spec_token = _SPEC_TOKENS.intern
+
+
 class Pod(APIObject):
     KIND = "Pod"
 
@@ -104,7 +119,7 @@ class Pod(APIObject):
         # (solver/encode.group_pods); pod specs are immutable post-creation
         # in k8s, so computing once is sound
         self._group_sig: Optional[tuple] = None
-        self._sig_id: Optional[tuple] = None  # (intern generation, small int)
+        self._sig_id: Optional[int] = None  # interned signature id (monotone)
         # shared-spec grouping token: ReplicaSet replicas share their spec,
         # and callers decoding watch events intern the spec objects once per
         # template -- so pods constructed from the SAME argument objects are
@@ -149,12 +164,12 @@ class Pod(APIObject):
             # identity while the object it names is alive (CPython reuses
             # freed addresses)
             self._spec_refs = (requests, node_selector, tolerations)
-            self._spec_token = (
+            self._spec_token = _intern_spec_token((
                 id(requests), id(node_selector), id(tolerations),
                 tuple(sorted(node_selector.items())) if node_selector else (),
                 tuple((t.key, t.operator, t.value, t.effect) for t in tolerations)
                 if tolerations else (),
-            )
+            ))
 
     def grouping_signature(self) -> tuple:
         """A cheap structural signature over every spec field that affects

@@ -380,26 +380,18 @@ def _one_pod():
     return Resources.from_base_units({res.PODS: 1})
 
 
-# global signature intern table: structural signature -> small int. Interned
-# ids let the per-call grouping loop hash a machine int instead of re-hashing
-# a deep nested tuple for every one of 50k pods. Bounded by generation: if
-# the table ever grows past the cap (a pathological churn of distinct pod
-# shapes) it is cleared and the generation bumped, which invalidates every
-# pod's memoized (gen, id) pair -- they simply re-intern.
-_SIG_INTERN: Dict[tuple, int] = {}
-_SIG_GEN: int = 0
-_SIG_INTERN_MAX = 1 << 18
+# global signature intern table (utils.InternTable, same design as the
+# pod spec-token table): structural signature -> small monotone int, so
+# the per-call grouping loop hashes a machine int instead of re-hashing a
+# deep nested tuple for every one of 50k pods. Monotone ids make a
+# generation counter unnecessary: an id from before an overflow clear can
+# never collide with one from after, and a stale memo merely re-interns
+# (splitting, never merging, lookup groups -- classes still converge via
+# _class_key).
+from karpenter_tpu.utils import InternTable as _InternTable
 
-
-def _intern_sig(sig: tuple) -> tuple:
-    global _SIG_GEN
-    sid = _SIG_INTERN.get(sig)
-    if sid is None:
-        if len(_SIG_INTERN) >= _SIG_INTERN_MAX:
-            _SIG_INTERN.clear()
-            _SIG_GEN += 1
-        sid = _SIG_INTERN[sig] = len(_SIG_INTERN)
-    return (_SIG_GEN, sid)
+_SIGS = _InternTable()
+_intern_sig = _SIGS.intern
 
 
 def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] = None) -> List[PodClass]:
@@ -431,7 +423,7 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
 
     def classify(pod: Pod) -> PodClass:
         sid = pod._sig_id
-        if sid is None or sid[0] != _SIG_GEN:
+        if sid is None:
             sid = pod._sig_id = _intern_sig(pod.grouping_signature())
         pc = id_get(sid)
         if pc is None:

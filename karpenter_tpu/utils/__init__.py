@@ -8,6 +8,34 @@ from typing import Dict, Mapping
 _PROVIDER_ID_RE = re.compile(r"^tpu:///(?P<zone>[^/]+)/(?P<id>[^/]+)$")
 
 
+class InternTable:
+    """Bounded tuple->small-int intern table for hot dict keys: nested
+    tuples re-hash on every probe (tuples do not cache their hash), so the
+    50k-pod grouping loops intern them ONCE -- at construction or first
+    sight, off the latency path -- and probe with trivially-hashed ints.
+
+    The counter is MONOTONE across clears, so an id handed out before an
+    overflow clear can never collide with one handed out after; stale
+    holders simply re-intern to fresh ids, which can only SPLIT lookup
+    groups, never merge them (both users converge through content-keyed
+    maps downstream). One design, two instances: Pod spec tokens
+    (apis/pod.py) and grouping signatures (solver/encode.py)."""
+
+    def __init__(self, cap: int = 1 << 18):
+        self._table: Dict[tuple, int] = {}
+        self._next = 1
+        self._cap = cap
+
+    def intern(self, key: tuple) -> int:
+        v = self._table.get(key)
+        if v is None:
+            if len(self._table) >= self._cap:
+                self._table.clear()
+            v = self._table[key] = self._next
+            self._next += 1
+        return v
+
+
 def parse_instance_id(provider_id: str) -> str:
     """providerID ("tpu:///zone/i-abc") -> instance id (reference:
     ParseInstanceID regex over aws:///...)."""
