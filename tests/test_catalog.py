@@ -2,6 +2,7 @@
 
 Modeled on the reference suites for pkg/providers/instancetype and
 pkg/providers/pricing (SURVEY.md section 4 tier 1)."""
+import os
 import pytest
 
 from karpenter_tpu.apis import TPUNodeClass, labels as wk
@@ -446,3 +447,100 @@ class TestCapacityModel:
         for axis in (res.CPU, res.MEMORY):
             assert alloc.get(axis) < it.capacity.get(axis)
             assert alloc.get(axis) > 0
+
+
+class TestCatalogImport:
+    """The real-data acquisition path (VERDICT r4 missing #3):
+    hack/catalog_import.py converts a describe-instance-types dump +
+    price maps into an importable document, and
+    $KARPENTER_TPU_CATALOG_JSON swaps it in for every consumer."""
+
+    FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+    def _imported_doc(self, tmp_path, with_prices=True):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "catalog_import",
+            os.path.join(os.path.dirname(__file__), "..", "hack", "catalog_import.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = str(tmp_path / "imported.json")
+        argv = ["--types", os.path.join(self.FIXTURES, "describe_instance_types_sample.json"),
+                "-o", out]
+        if with_prices:
+            argv += ["--prices", os.path.join(self.FIXTURES, "prices_sample.json")]
+        assert mod.main(argv) == 0
+        return out
+
+    def test_convert_preserves_real_shapes(self, tmp_path):
+        import json as _json
+
+        out = self._imported_doc(tmp_path)
+        doc = _json.loads(open(out).read())
+        by_name = {t["name"]: t for t in doc["types"]}
+        m5l = by_name["m5.large"]
+        assert (m5l["vcpu"], m5l["memory_mib"]) == (2, 8192)
+        assert (m5l["max_network_interfaces"], m5l["ipv4_per_interface"]) == (3, 10)
+        assert by_name["c6g.large"]["arch"] == "arm64"
+        assert by_name["c6g.large"]["cpu_manufacturer"] == "arm-native"
+        assert by_name["t3.medium"]["burstable"] is True
+        g4 = by_name["g4dn.xlarge"]
+        assert (g4["gpu_name"], g4["gpu_count"], g4["gpu_memory_mib"]) == ("T4", 1, 16384)
+        assert g4["local_nvme_gib"] == 125
+        assert doc["onDemandPrices"]["m5.large"] == 0.096
+
+    def test_import_without_prices_still_prices_gpus(self, tmp_path, monkeypatch):
+        """The synthetic fallback must handle REAL device names it has
+        never seen (round-5 review: GPU_PRICE['T4'] crashed)."""
+        out = self._imported_doc(tmp_path, with_prices=False)
+        from karpenter_tpu.providers.instancetype import gen_catalog
+
+        monkeypatch.setenv(gen_catalog.CATALOG_ENV, out)
+        gen_catalog._imported.cache_clear()
+        try:
+            g4 = next(i for i in gen_catalog.generate_instance_types()
+                      if i.name == "g4dn.xlarge")
+            od = gen_catalog.on_demand_price(g4)
+            assert 0 < od < 10
+            assert 0 < gen_catalog.spot_price(g4, "us-central-1a") < od
+        finally:
+            gen_catalog._imported.cache_clear()
+
+    def test_env_swaps_catalog_and_prices_end_to_end(self, tmp_path, monkeypatch):
+        """With the env set, the kwok rig schedules against the REAL
+        shapes and prices: a 3500m-cpu pod cannot fit any 2-vCPU shape,
+        so the price objective picks m5.xlarge -- the cheapest real shape
+        with 4 vCPUs -- and the pricing provider reports the imported
+        numbers."""
+        out = self._imported_doc(tmp_path)
+        from karpenter_tpu.providers.instancetype import gen_catalog
+
+        monkeypatch.setenv(gen_catalog.CATALOG_ENV, out)
+        gen_catalog._imported.cache_clear()
+        try:
+            infos = gen_catalog.generate_instance_types()
+            assert sorted(i.name for i in infos)[:2] == ["c5.large", "c6g.large"]
+            m5l = next(i for i in infos if i.name == "m5.large")
+            assert gen_catalog.on_demand_price(m5l) == 0.096
+            assert gen_catalog.spot_price(m5l, "us-central-1a") == 0.035
+            # un-imported zone falls back to the deterministic model
+            assert 0 < gen_catalog.spot_price(m5l, "us-central-1d") < 0.096
+
+            from karpenter_tpu.cache.ttl import FakeClock
+            from karpenter_tpu.operator import Operator
+            from karpenter_tpu.apis import NodePool, TPUNodeClass, Pod, Node
+            from karpenter_tpu.scheduling import Resources
+
+            op = Operator(clock=FakeClock(10_000.0))
+            op.cluster.create(TPUNodeClass("default"))
+            op.cluster.create(NodePool("default"))
+            op.cluster.create(Pod("p0", requests=Resources({"cpu": "3500m", "memory": "3Gi"})))
+            op.settle(max_ticks=30)
+            assert not op.cluster.pending_pods()
+            node = op.cluster.list(Node)[0]
+            from karpenter_tpu.apis import labels as wk
+
+            assert node.metadata.labels[wk.INSTANCE_TYPE_LABEL] == "m5.xlarge"
+        finally:
+            gen_catalog._imported.cache_clear()
