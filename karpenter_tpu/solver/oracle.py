@@ -232,6 +232,9 @@ class Scheduler:
         # per-group axis-wise max allocatable (an upper bound -- see
         # _try_group's precheck; never invalidated, survivors only shrink)
         self._gmax_cache: Dict[int, Resources] = {}
+        # (group id, requests sig) pairs the capacity upper bound has
+        # permanently rejected (see _try_group)
+        self._cap_reject: set = set()
         node_labels = {n.name: n.labels for n in self.existing}
         for node, pods in pods_by_node.items():
             self._labels_on[node] = [dict(p.metadata.labels) for p in pods]
@@ -630,6 +633,15 @@ class Scheduler:
         return out
 
     def _try_group(self, pod: Pod, group: NewNodeGroup, pod_reqs: Requirements) -> bool:
+        # negative capacity memo: once a group rejects THIS request shape
+        # on the capacity upper bound, it rejects it forever (requested
+        # only grows, the survivor set only shrinks) -- consecutive
+        # same-shaped pods scanning a packed fleet skip in O(1) instead of
+        # re-paying the checks below (round 5: suffix anchors scanning
+        # ~600 full device groups dominated the mixed-batch tick)
+        cap_key = (id(group), pod.requests.sig())
+        if cap_key in self._cap_reject:
+            return False
         if not tolerates_all(pod.tolerations, group.taints):
             return False
         if not group.requirements.compatible(pod_reqs, allow_undefined=None):
@@ -645,6 +657,7 @@ class Scheduler:
         requested = group.add_requested(pod)
         effective = requested + self._ovh(group.nodepool)
         if not effective.fits(self._group_max_alloc(group)):
+            self._cap_reject.add(cap_key)
             return False
         merged = group.requirements.copy().add(*pod_reqs)
         # zone topology spread narrows the merged requirements; the chosen
@@ -1074,7 +1087,10 @@ class Scheduler:
     def _attempt_placement(self, pod: Pod, result: SchedulingResult):
         """One full placement attempt under the pod's CURRENT constraints:
         existing nodes, then open groups, then a fresh group. Side effects
-        only on success. Returns (placed, reasons)."""
+        only on success -- except the monotone negative-capacity memo
+        (_cap_reject), which failed joins may append to; it stays sound
+        because group capacity never grows back. Returns (placed,
+        reasons)."""
         if self._try_existing(pod, result):
             return True, []
         groups = (
