@@ -23,7 +23,7 @@ benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
 e2e:  ## scale + end-to-end suites only
 	$(PYTEST) tests/test_scale.py tests/test_e2e_provisioning.py tests/test_storage.py tests/test_soak.py -q
 
-e2e-50k:  ## 50k-pod FULL-loop tier (loop settles ~11s; ~6 min total incl. the sequential-oracle price comparison)
+e2e-50k:  ## 50k-pod FULL-loop tier (loop settles ~11s; ~40s total incl. the sequential-oracle price comparison)
 	KARPENTER_TPU_E2E_50K=1 $(PYTEST) tests/test_scale.py -k FiftyThousand -q -s
 
 run:  ## controller loop over the kwok rig

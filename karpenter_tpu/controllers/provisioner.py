@@ -63,6 +63,11 @@ class _PodRef:
         self.name = name
 
 TERMINATION_FINALIZER = "karpenter.sh/termination"
+# virtual-capacity pseudo-node prefix: launched-but-not-ready claims join
+# the scheduling snapshot under this name (never a real node; k8s node
+# names cannot contain '/'). Shared by the snapshot construction and the
+# binder-hint strip below.
+INFLIGHT_PREFIX = "inflight/"
 
 
 class Provisioner:
@@ -118,7 +123,7 @@ class Provisioner:
             labels.update(claim.requirements.labels())
             out.append(
                 ExistingNode(
-                    name=f"inflight/{claim.metadata.name}",
+                    name=INFLIGHT_PREFIX + claim.metadata.name,
                     labels=labels,
                     allocatable=claim.allocatable,
                     taints=list(claim.taints),  # startup taints excluded: they lift before pods land
@@ -193,8 +198,18 @@ class Provisioner:
         metrics.SCHEDULING_DURATION.observe(time.perf_counter() - t0)
         metrics.IGNORED_PODS.set(len(result.unschedulable))
         self._publish_unschedulable(result)
-        # existing-node decisions hint the binder directly (node names)
-        self._assignment_hints.update(result.existing_assignments)
+        # existing-node decisions hint the binder directly (node names).
+        # A still-pending pod re-decided onto IN-FLIGHT virtual capacity
+        # ("inflight/<claim>") hints to the claim name itself -- that is
+        # the node name it will register under; hinting the pseudo-name
+        # verbatim would overwrite a good hint with one that never
+        # resolves and push every such pod onto the full binder scan
+        # (round-5 regression: a one-tick readiness lag made 50k binds
+        # quadratic again).
+        for pod_name, node_name in result.existing_assignments.items():
+            if node_name.startswith(INFLIGHT_PREFIX):
+                node_name = node_name[len(INFLIGHT_PREFIX):]
+            self._assignment_hints[pod_name] = node_name
         if result.new_groups or result.unschedulable:
             self.log.info(
                 "scheduling decision",
