@@ -289,17 +289,19 @@ class TPUSolver:
         # canonical order (encode.oracle_suffix_rank), so "device solves the
         # plain classes, the oracle continues with the suffix over the
         # device's state" is order-equivalent to one full oracle pass --
-        # provided the partitions cannot interact through labels or shared
-        # spread selectors (_aff_partition_blocked), there is no minValues
-        # prefix in the same batch (three-way state threading not
-        # implemented), and no multi-pool overlap (the merged-catalog solve
-        # does not model the suffix hand-off).
+        # provided the partitions cannot interact through labels, shared
+        # spread selectors, or shared envelope keys (_aff_partition_blocked
+        # -- checked against EVERY non-suffix class, so a coexisting
+        # minValues prefix is covered too: prefix -> device -> suffix runs
+        # as three uncoupled phases of one canonical pass), and there is no
+        # multi-pool overlap (the merged-catalog solve does not model the
+        # suffix hand-off).
         aff_classes = TPUSolver._suffix_classes(classes)
         device_classes = classes
         if aff_classes:
             aff_ids = {id(pc) for pc in aff_classes}
             device_classes = [pc for pc in classes if id(pc) not in aff_ids]
-            if not device_classes or mv_classes:
+            if not device_classes:
                 return False
             if overlap is None:
                 overlap = len(scheduler.nodepools) > 1 and TPUSolver._pools_overlap(
@@ -623,8 +625,10 @@ class TPUSolver:
         # last in the canonical order, so the device solves the plain
         # prefix and the oracle CONTINUES the same pass over the suffix
         # (_oracle_suffix seeds the device pass's bookings). supports()
-        # verified the partitions cannot otherwise interact
-        # (_aff_partition_blocked) and that no minValues prefix coexists.
+        # verified the suffix cannot interact with ANY other partition --
+        # plain or minValues prefix -- through labels, spread selectors,
+        # or envelope keys (_aff_partition_blocked), so all three phases
+        # compose as one canonical pass.
         aff_pods: List[Pod] = []
         aff_classes = self._suffix_classes(base_classes)
         if aff_classes:
@@ -655,8 +659,9 @@ class TPUSolver:
             base_classes = [pc for pc in base_classes if id(pc) not in mv_ids]
             pods = [p for pc in base_classes for p in pc.pods]
             self.last_route = {
-                "device_pods": len(pods), "oracle_pods": len(mv_pods),
-                "path": "prefix+device",
+                "device_pods": len(pods),
+                "oracle_pods": len(mv_pods) + len(aff_pods),
+                "path": "prefix+device+suffix" if aff_pods else "prefix+device",
             }
             if self._route_monitor.has_changed("route_mv", len(mv_pods)):
                 self.log.info(
@@ -666,11 +671,18 @@ class TPUSolver:
             scheduler.objective = self.objective
             mv_result = scheduler.schedule(mv_pods)
         result = SchedulingResult()
+        device_assignments: Dict[str, str] = {}
         if mv_result is not None:
             result.new_groups.extend(mv_result.new_groups)
             result.existing_assignments.update(mv_result.existing_assignments)
             if not pods:
                 result.unschedulable.update(mv_result.unschedulable)
+                if aff_pods:
+                    # mv prefix + aff suffix with no plain middle: the
+                    # suffix still runs (the oracle prefix already mutated
+                    # node.used for its own bookings, so nothing to seed)
+                    self._oracle_suffix(scheduler, aff_pods, [], result,
+                                        device_assignments)
                 return result
         pods_left: List[Pod] = list(pods)
         for i, pool in enumerate(pools):
@@ -692,6 +704,7 @@ class TPUSolver:
             )
             result.new_groups.extend(res.new_groups)
             result.existing_assignments.update(res.existing_assignments)
+            device_assignments.update(res.existing_assignments)
             by_name = {p.metadata.name: p for p in pods_left}
             result.unschedulable = res.unschedulable
             pods_left = [by_name[n] for n in res.unschedulable if n in by_name]
@@ -706,12 +719,13 @@ class TPUSolver:
             # partition's entries
             result.unschedulable.update(mv_result.unschedulable)
         if aff_pods:
-            self._oracle_suffix(scheduler, aff_pods, pods, result)
+            self._oracle_suffix(scheduler, aff_pods, pods, result, device_assignments)
         return result
 
     def _oracle_suffix(
         self, scheduler: Scheduler, aff_pods: List[Pod],
         device_pods: Sequence[Pod], result: SchedulingResult,
+        device_assignments: Dict[str, str],
     ) -> None:
         """Continue the canonical pass on the oracle for the suffix
         partition (affinity/preference pods). Seeds the scheduler with
@@ -726,18 +740,29 @@ class TPUSolver:
         instead of O(50k label dicts)."""
         # existing-node bookings: _pack_existing records assignments but
         # does not mutate node.used (the oracle's _try_existing does) --
-        # apply them so the suffix sees post-prefix remaining capacity.
-        # Pool limits need no hand-off: supports() BLOCKS the carve when
-        # any pool carries limits (open-time vs final-survivor charge
-        # divergence -- see _aff_partition_blocked).
-        if result.existing_assignments:
+        # apply the DEVICE rounds' assignments so the suffix sees
+        # post-prefix remaining capacity. A minValues prefix's assignments
+        # are excluded: the oracle pass already mutated node.used for
+        # those, and re-applying them would double-count. Pool limits
+        # need no hand-off: supports() BLOCKS the carve when any pool
+        # carries limits (open-time vs final-survivor charge divergence
+        # -- see _aff_partition_blocked).
+        assignments = device_assignments
+        if assignments:
             by_name = {p.metadata.name: p for p in device_pods}
             nodes = {n.name: n for n in scheduler.existing}
             one_pod = Resources.from_base_units({res.PODS: 1})
-            for pod_name, node_name in result.existing_assignments.items():
+            for pod_name, node_name in assignments.items():
                 p, node = by_name.get(pod_name), nodes.get(node_name)
                 if p is not None and node is not None:
                     node.used = node.used + p.requests + one_pod
+        # a minValues prefix may have lazily computed per-pool envelope
+        # totals over ITS pods only; the suffix must size its envelopes
+        # over its own pods, so force a fresh lazy computation. No
+        # sharing is lost because _aff_partition_blocked refused the
+        # carve if any suffix pod's rank-STRIPPED key (the form _env_key
+        # actually uses) collided with another partition's.
+        scheduler._env_totals = {}
         scheduler.objective = self.objective
         scheduler.schedule(aff_pods, seed_result=result)
 

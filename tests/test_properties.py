@@ -332,3 +332,33 @@ class TestNodePoolBudgetLaws:
         # both must CONSTRAIN (a maintenance freeze must not silently lift)
         assert Budget(nodes="0", schedule="0 9 * * *").active(now) is True
         assert Budget(nodes="0", schedule="not a cron", duration=3600.0).active(now) is True
+
+
+class TestInternTable:
+    """utils.InternTable invariants both hot paths lean on (round 5:
+    pod spec tokens + grouping signatures)."""
+
+    @settings(max_examples=200, deadline=None)
+    @given(st.lists(st.tuples(st.integers(0, 30), st.text(max_size=4)), max_size=40))
+    def test_content_equality_iff_same_id(self, keys):
+        from karpenter_tpu.utils import InternTable
+
+        t = InternTable()
+        ids = [t.intern(tuple(k)) for k in keys]
+        for i, a in enumerate(keys):
+            for j, b in enumerate(keys):
+                assert (ids[i] == ids[j]) == (tuple(a) == tuple(b))
+
+    def test_monotone_across_overflow_clears(self):
+        """Ids handed out before a clear can NEVER collide with ids after
+        it -- the soundness claim the grouping loops rely on."""
+        from karpenter_tpu.utils import InternTable
+
+        t = InternTable(cap=8)
+        before = {t.intern(("k", i)) for i in range(8)}  # fills to cap
+        after = {t.intern(("other", i)) for i in range(20)}  # forces clears
+        assert not (before & after)
+        # and a key re-interned after a clear gets a FRESH id (split, not
+        # merged -- callers converge through content-keyed maps)
+        again = t.intern(("k", 0))
+        assert again not in before

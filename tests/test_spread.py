@@ -993,3 +993,71 @@ def zone_distribution_spread_only(result):
             if p.topology_spread:
                 out[(p.metadata.labels.get("app"), zone)] += 1
     return out
+
+
+class TestPrefixDeviceSuffix:
+    """Round 5: a minValues ORACLE PREFIX, a device middle, and an
+    affinity ORACLE SUFFIX coexist as three uncoupled phases of one
+    canonical pass -- the last batch-global routing cliff."""
+
+    def test_three_phase_split_matches_full_oracle(self, catalog_items):
+        from karpenter_tpu.apis.pod import PodAffinityTerm
+        from karpenter_tpu.scheduling import Operator as Op, Requirement
+
+        mv = NodePool("arm-flex")
+        mv.weight = 10
+        mv.template.requirements = [
+            Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"]),
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Op.EXISTS, min_values=2),
+        ]
+        plain = NodePool("amd")
+        plain.weight = 1
+        plain.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])]
+        pods = [
+            Pod(f"graviton{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "arm64"})
+            for i in range(3)
+        ] + [
+            Pod(f"x86-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "amd64"})
+            for i in range(5)
+        ] + [
+            # suffix: a self-affine ring on the amd pool, distinct shape
+            Pod(f"ring-{i}", requests=Resources({"cpu": "350m", "memory": "512Mi"}),
+                labels={"tier": "ring"},
+                node_selector={wk.ARCH_LABEL: "amd64"},
+                affinity_terms=[PodAffinityTerm(label_selector={"tier": "ring"})])
+            for i in range(2)
+        ]
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+
+        def mk():
+            return Scheduler(
+                nodepools=[mv, plain],
+                instance_types={"arm-flex": catalog_items, "amd": catalog_items},
+                zones=zones,
+            )
+
+        solver = TPUSolver(g_max=256)
+        assert TPUSolver.supports(mk(), pods), (
+            "mv prefix + aff suffix must no longer route the whole batch to the oracle"
+        )
+        split = solver.schedule(mk(), list(pods))
+        assert solver.last_route["path"] == "prefix+device+suffix", solver.last_route
+        full = mk().schedule(list(pods))
+        assert set(split.unschedulable) == set(full.unschedulable) == set()
+
+        def sig(result):
+            return sorted(
+                (tuple(sorted(p.metadata.name for p in g.pods)),
+                 tuple(sorted(it.name for it in g.instance_types)))
+                for g in result.new_groups
+            )
+
+        assert sig(split) == sig(full)
+        # the ring landed together
+        ring_groups = [
+            i for i, g in enumerate(split.new_groups)
+            if any(p.metadata.name.startswith("ring") for p in g.pods)
+        ]
+        assert len(set(ring_groups)) == 1
