@@ -976,6 +976,11 @@ class Scheduler:
         # pass's open groups here so suffix pods can JOIN them exactly as
         # one full pass would; placements land in the shared result
         result = seed_result if seed_result is not None else SchedulingResult()
+        # per-call envelope totals: they are lazily computed from
+        # _sched_pods (rebound just below), so a SECOND schedule() call on
+        # one Scheduler -- the three-phase split, retries, test reuse --
+        # must not inherit totals sized for the previous call's pods
+        self._env_totals = {}
         # group-open sequence numbers: candidate pruning
         # (_candidate_groups) must preserve the first-fit order of
         # result.new_groups even when candidates come from label buckets

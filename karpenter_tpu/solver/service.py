@@ -756,13 +756,11 @@ class TPUSolver:
                 p, node = by_name.get(pod_name), nodes.get(node_name)
                 if p is not None and node is not None:
                     node.used = node.used + p.requests + one_pod
-        # a minValues prefix may have lazily computed per-pool envelope
-        # totals over ITS pods only; the suffix must size its envelopes
-        # over its own pods, so force a fresh lazy computation. No
-        # sharing is lost because _aff_partition_blocked refused the
-        # carve if any suffix pod's rank-STRIPPED key (the form _env_key
-        # actually uses) collided with another partition's.
-        scheduler._env_totals = {}
+        # envelope totals reset per schedule() call (oracle.py): the
+        # suffix sizes its envelopes over its own pods. No sharing is
+        # lost because _aff_partition_blocked refused the carve if any
+        # suffix pod's rank-STRIPPED key (the form _env_key actually
+        # uses) collided with another partition's.
         scheduler.objective = self.objective
         scheduler.schedule(aff_pods, seed_result=result)
 

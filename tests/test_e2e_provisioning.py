@@ -501,3 +501,42 @@ class TestDaemonSetOverheadE2E:
         env.cluster.delete(DaemonSet, "cni")
         env.settle(max_ticks=10)
         assert not whale.pending, "with the daemonset gone the whale fits again"
+
+
+class TestBinderHints:
+    """Round-5 binder fast path: the scheduling decision's pod->claim
+    assignments are consumed as validated binding hints, and a re-decide
+    onto in-flight virtual capacity must not destroy them (the
+    'inflight/<claim>' pseudo-name regression made 50k binds quadratic)."""
+
+    def test_hints_survive_inflight_redecide(self):
+        from karpenter_tpu.apis import Node, Pod
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.controllers.provisioner import INFLIGHT_PREFIX
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.scheduling import Resources
+
+        op = Operator(clock=FakeClock(100_000.0))
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.tick()
+        for i in range(6):
+            op.cluster.create(Pod(f"w{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        # tick 1: decide + launch; tick 2 (no clock step, so nodes are not
+        # ready yet): the provisioner RE-decides the still-pending pods
+        # onto in-flight virtual capacity
+        op.tick()
+        op.tick()
+        hints = op.provisioner._assignment_hints
+        assert hints, "decision hints must exist while pods are pending"
+        assert op.binder._assignment_hints is hints, "binder must share the dict"
+        assert not any(v.startswith(INFLIGHT_PREFIX) for v in hints.values()), (
+            f"re-decide left unresolvable pseudo-node hints: {hints}"
+        )
+        # once nodes are ready, every pod binds to its HINTED node
+        op.settle(max_ticks=20)
+        assert not op.cluster.pending_pods()
+        names = {n.metadata.name for n in op.cluster.list(Node)}
+        for p in op.cluster.list(Pod):
+            assert p.node_name in names
+        assert not hints, "hints are consumed/purged after binding"
