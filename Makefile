@@ -48,8 +48,8 @@ verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun +
 benchmark-interruption:  ## interruption-queue tier at 100/1k/5k(/15k) messages
 	KARPENTER_TPU_PERF=1 KARPENTER_TPU_BENCH_FULL=1 $(PYTEST) tests/test_interruption_bench.py -q -s
 
-fuzz-extended:  ## 179-seed differential sweep (101 mixed-constraint + 40 multi-pool + 38 affinity-carve; device vs oracle)
-	KARPENTER_TPU_FUZZ_EXTENDED=1 $(PYTEST) tests/test_solver.py tests/test_multipool.py tests/test_affinity.py -k Extended -q
+fuzz-extended:  ## 191-seed differential sweep (101 mixed-constraint + 40 multi-pool + 38 affinity-carve + 12 three-phase; device vs oracle)
+	KARPENTER_TPU_FUZZ_EXTENDED=1 $(PYTEST) tests/test_solver.py tests/test_multipool.py tests/test_affinity.py tests/test_spread.py -k Extended -q
 
 benchmark-consolidation:  ## consolidation decision-rate tier on the kwok rig
 	KARPENTER_TPU_PERF=1 $(PYTEST) tests/test_consolidation_bench.py -q -s

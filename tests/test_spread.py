@@ -1,5 +1,6 @@
 """Zone topology-spread differential tests: the host carry pass + batched
 FFD (solver/spread.py + service.py) against the oracle's per-pod loop."""
+import os
 import numpy as np
 import pytest
 
@@ -1061,3 +1062,86 @@ class TestPrefixDeviceSuffix:
             if any(p.metadata.name.startswith("ring") for p in g.pods)
         ]
         assert len(set(ring_groups)) == 1
+
+
+@pytest.mark.skipif(
+    not os.environ.get("KARPENTER_TPU_FUZZ_EXTENDED"),
+    reason="extended differential sweep: set KARPENTER_TPU_FUZZ_EXTENDED=1",
+)
+class TestThreePhaseFuzzExtended:
+    """Randomized mv-prefix + plain-middle + affinity-suffix batches: the
+    split must equal one full oracle pass exactly whenever routing takes
+    the three-phase path (and still match when it falls back)."""
+
+    @pytest.mark.parametrize("seed", range(12))
+    def test_sweep(self, catalog_items, seed):
+        import numpy as np
+
+        from karpenter_tpu.apis.pod import PodAffinityTerm
+        from karpenter_tpu.scheduling import Operator as Op, Requirement
+
+        rng = np.random.default_rng(5600 + seed)
+        mv = NodePool("arm-flex")
+        mv.weight = 10
+        mv.template.requirements = [
+            Requirement(wk.ARCH_LABEL, Op.IN, ["arm64"]),
+            Requirement(wk.LABEL_INSTANCE_FAMILY, Op.EXISTS,
+                        min_values=int(rng.integers(2, 4))),
+        ]
+        plain = NodePool("amd")
+        plain.weight = 1
+        plain.template.requirements = [Requirement(wk.ARCH_LABEL, Op.IN, ["amd64"])]
+        pods = []
+        for i in range(int(rng.integers(0, 5))):
+            pods.append(Pod(
+                f"g{seed}-{i}",
+                requests=Resources({"cpu": ["500m", "1"][int(rng.integers(0, 2))],
+                                    "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "arm64"}))
+        for i in range(int(rng.integers(2, 9))):
+            pods.append(Pod(
+                f"p{seed}-{i}",
+                requests=Resources({"cpu": ["250m", "500m", "2"][int(rng.integers(0, 3))],
+                                    "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "amd64"}))
+        for a in range(int(rng.integers(1, 4))):
+            tier = f"t{seed}-{a % 2}"
+            anti = bool(rng.integers(0, 2))
+            pods.append(Pod(
+                f"a{seed}-{a}",
+                requests=Resources({"cpu": "350m", "memory": "512Mi"}),
+                labels={"tier": tier},
+                node_selector={wk.ARCH_LABEL: "amd64"},
+                affinity_terms=[PodAffinityTerm(
+                    label_selector={"tier": tier},
+                    topology_key=wk.ZONE_LABEL if anti else wk.HOSTNAME_LABEL,
+                    anti=anti)]))
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+
+        def mk():
+            return Scheduler(
+                nodepools=[mv, plain],
+                instance_types={"arm-flex": catalog_items, "amd": catalog_items},
+                zones=zones,
+            )
+
+        solver = TPUSolver(g_max=256)
+        split = solver.schedule(mk(), list(pods))
+        # the sweep must not degenerate into oracle-vs-oracle: every seed
+        # carries an affinity suffix and a device-eligible middle, so the
+        # split path is the expected route (a mv prefix may or may not be
+        # present depending on the draw)
+        assert solver.last_route["path"] in ("device+suffix", "prefix+device+suffix"), (
+            f"seed {seed} fell back: {solver.last_route}"
+        )
+        full = mk().schedule(list(pods))
+        assert set(split.unschedulable) == set(full.unschedulable), f"seed {seed}"
+
+        def sig(result):
+            return sorted(
+                (tuple(sorted(p.metadata.name for p in g.pods)),
+                 tuple(sorted(it.name for it in g.instance_types)))
+                for g in result.new_groups
+            )
+
+        assert sig(split) == sig(full), f"seed {seed} route={solver.last_route}"
