@@ -865,7 +865,7 @@ def main() -> None:
                       file=sys.stderr)
             result, events, why = _run_child(
                 force_cpu=True, profile=profile,
-                budget_s=_env_f("BENCH_CPU_BUDGET_S", 1200),
+                budget_s=_env_f("BENCH_CPU_BUDGET_S", 2000),
                 stall_s=_env_f("BENCH_STALL_S", 360),
             )
             out = result if result is not None else _assemble_partial(events, why)

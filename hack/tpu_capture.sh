@@ -16,7 +16,7 @@ assert float((x * 2).sum()) == 56.0
 print('BACKEND=' + jax.default_backend())
 " >> "$LOG" 2>&1; then
     echo "[capture] tunnel up, running bench $(date -u +%H:%M:%S)" >> "$LOG"
-    if timeout 2400 python bench.py --profile > "$OUT.tmp" 2>> "$LOG"; then
+    if timeout 4200 python bench.py --profile > "$OUT.tmp" 2>> "$LOG"; then
       if ! grep -q '"platform": "cpu"' "$OUT.tmp" && grep -q '"platform"' "$OUT.tmp" \
          && ! grep -q '"degraded"' "$OUT.tmp" && ! grep -q '"partial"' "$OUT.tmp"; then
         mv "$OUT.tmp" "$OUT"
