@@ -215,3 +215,30 @@ class TestBootstrapFamilies:
             "--system-reserved=memory=200Mi", "--cluster-dns=10.0.0.10",
         ):
             assert needle in out, out
+
+
+class TestFleetNotFoundRetry:
+    """The END-TO-END stale-template path (instance/provider.py): a
+    launch template deleted cloud-side after caching makes the fleet call
+    fail LT-NotFound; the instance provider invalidates THAT launch's
+    template names, the launchtemplate provider recreates them, and the
+    retried fleet call launches -- all inside one provisioning tick."""
+
+    def test_provisioning_survives_deleted_template(self, env):
+        from karpenter_tpu.apis import Pod
+        from karpenter_tpu.scheduling import Resources
+
+        hydrated(env)  # nodeclass ready; catalog resolvable
+        # prime the template cache via a first successful launch
+        env.cluster.create(Pod("warm", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        env.settle(max_ticks=30)
+        assert not env.cluster.pending_pods()
+        # delete EVERY cloud-side template out from under the cache
+        for lt in list(env.cloud._launch_templates.values()):
+            env.cloud.delete_launch_template(lt.name)
+        recreates_before = env.cloud.calls.get("create_launch_template", 0)
+        env.cluster.create(Pod("after", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        env.settle(max_ticks=30)
+        assert not env.cluster.pending_pods(), "retry-once must recover the launch"
+        assert env.cloud.calls.get("create_launch_template", 0) > recreates_before
+        assert sum(1 for p in env.cluster.list(Pod) if p.node_name) == 2
