@@ -16,11 +16,12 @@ assert float((x * 2).sum()) == 56.0
 print('BACKEND=' + jax.default_backend())
 " >> "$LOG" 2>&1; then
     echo "[capture] tunnel up, running bench $(date -u +%H:%M:%S)" >> "$LOG"
-    # the wrapper just probed: keep bench's own probe SHORT so a tunnel
-    # that drops between the two fails fast and the loop re-probes,
-    # instead of burning the whole 4200s window inside bench's patient
-    # (driver-oriented) 2h default
-    if timeout 4200 env BENCH_PROBE_BUDGET_S=300 python bench.py --profile > "$OUT.tmp" 2>> "$LOG"; then
+    # the wrapper just probed: keep bench's own probe AND its CPU
+    # fallback SHORT so a tunnel that drops between the two fails fast
+    # and the loop re-probes, instead of burning the 4200s window inside
+    # bench's patient (driver-oriented) defaults -- the loop has no use
+    # for a CPU result anyway (the grep below rejects it)
+    if timeout 4200 env BENCH_PROBE_BUDGET_S=300 BENCH_CPU_BUDGET_S=120 python bench.py --profile > "$OUT.tmp" 2>> "$LOG"; then
       if ! grep -q '"platform": "cpu"' "$OUT.tmp" && grep -q '"platform"' "$OUT.tmp" \
          && ! grep -q '"degraded"' "$OUT.tmp" && ! grep -q '"partial"' "$OUT.tmp"; then
         mv "$OUT.tmp" "$OUT"

@@ -107,6 +107,8 @@ class Cluster(RelationalQueries):
     )
 
     POD_NODE_INDEX = "spec.nodeName"
+    NODE_PROVIDER_INDEX = "spec.providerID"
+    CLAIM_PROVIDER_INDEX = "status.providerID"
 
     def __init__(self, clock: Optional[Clock] = None):
         self.clock = clock or Clock()
@@ -125,9 +127,27 @@ class Cluster(RelationalQueries):
         # go through create/update/delete (bind_pod/unbind_pods do), which
         # is the informer contract by_index already documents.
         self.add_field_index(Pod, self.POD_NODE_INDEX, lambda p: p.node_name or None)
+        # providerID indexes: node<->claim correlation ran as linear scans
+        # per call -- O(claims x nodes) per controller tick at fleet scale
+        self.add_field_index(Node, self.NODE_PROVIDER_INDEX,
+                             lambda n: n.provider_id or None)
+        self.add_field_index(NodeClaim, self.CLAIM_PROVIDER_INDEX,
+                             lambda c: c.provider_id or None)
 
     def pods_on_node(self, node_name: str) -> List[Pod]:  # type: ignore[override]
         return self.by_index(Pod, self.POD_NODE_INDEX, node_name)
+
+    def nodeclaim_for_node(self, node: Node) -> Optional[NodeClaim]:  # type: ignore[override]
+        if not node.provider_id:
+            return None
+        hits = self.by_index(NodeClaim, self.CLAIM_PROVIDER_INDEX, node.provider_id)
+        return hits[0] if hits else None
+
+    def node_for_nodeclaim(self, claim: NodeClaim) -> Optional[Node]:  # type: ignore[override]
+        if not claim.provider_id:
+            return None
+        hits = self.by_index(Node, self.NODE_PROVIDER_INDEX, claim.provider_id)
+        return hits[0] if hits else None
 
     # -- watch --------------------------------------------------------------
     def on_event(self, handler: EventHandler) -> None:
