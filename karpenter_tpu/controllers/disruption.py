@@ -260,16 +260,23 @@ class DisruptionController:
     def _other_nodes(self, excluded: Sequence[str]) -> List[ExistingNode]:
         out = []
         vol_index = self._vol_index()
-        for node in self.cluster.list(Node):
-            if node.metadata.name in excluded or node.deleting or node.unschedulable or not node.ready:
-                continue
+        live = [
+            n for n in self.cluster.list(Node)
+            if n.metadata.name not in excluded
+            and not n.deleting and not n.unschedulable and n.ready
+        ]
+        # ONE pod pass for every node's usage (node_usage per node is
+        # O(all pods) per call on index-less stores -- round 5)
+        usage_map = self.cluster.node_usage_map(
+            [n.metadata.name for n in live], vol_index)
+        for node in live:
             out.append(
                 ExistingNode(
                     name=node.metadata.name,
                     labels=dict(node.metadata.labels),
                     allocatable=node.allocatable,
                     taints=list(node.taints),
-                    used=self.cluster.node_usage(node.metadata.name, vol_index),
+                    used=usage_map[node.metadata.name],
                 )
             )
         return out

@@ -93,16 +93,20 @@ class Provisioner:
 
         out = []
         vol_index = VolumeIndex.from_cluster(self.cluster)
-        for node in self.cluster.list(Node):
-            if node.deleting or node.unschedulable or not node.ready:
-                continue
+        live = [
+            n for n in self.cluster.list(Node)
+            if not n.deleting and not n.unschedulable and n.ready
+        ]
+        usage = self.cluster.node_usage_map(
+            [n.metadata.name for n in live], vol_index)
+        for node in live:
             out.append(
                 ExistingNode(
                     name=node.metadata.name,
                     labels=dict(node.metadata.labels),
                     allocatable=node.allocatable,
                     taints=list(node.taints),
-                    used=self.cluster.node_usage(node.metadata.name, vol_index),
+                    used=usage[node.metadata.name],
                 )
             )
         # launched-but-not-YET-ready claims are virtual capacity
@@ -339,10 +343,8 @@ class PodBinder:
         # (pod, candidate node) try re-summed every bound pod's requests
         # -- quadratic at 50k pods (the full-loop E2E spent >80% of its
         # wall there). ONE snapshot per reconcile, O(1) add per bind.
-        usage: Dict[str, Resources] = {
-            n.metadata.name: self.cluster.node_usage(n.metadata.name, vol_index)
-            for n in nodes
-        }
+        usage: Dict[str, Resources] = self.cluster.node_usage_map(
+            [n.metadata.name for n in nodes], vol_index)
         for pod in self.cluster.pending_pods():
             needed = pod.requests + Resources.from_base_units({res.PODS: 1})
             vol_zone = None

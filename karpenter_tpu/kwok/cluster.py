@@ -66,27 +66,36 @@ class RelationalQueries:
         return None
 
     def node_usage(self, node_name: str, vol_index=None) -> Resources:
+        """One node's usage; delegates to node_usage_map so exactly ONE
+        copy of the accounting formula exists (a drifted duplicate --
+        usage omitting the PODS axis -- was a round-5 bug)."""
+        return self.node_usage_map([node_name], vol_index)[node_name]
+
+    def node_usage_map(self, node_names, vol_index=None) -> Dict[str, Resources]:
+        """Usage for MANY nodes in ONE pod pass (the per-node form is
+        O(all pods) per call on stores without a pod index -- kube's
+        TTL-cached list -- which made per-tick snapshots O(nodes x pods)
+        at fleet scale, round 5). THE accounting formula lives here:
+        each bound pod charges its requests plus ONE slot on the pods
+        axis (the solver, oracle, and binder all charge PODS:1 per
+        placement), and claim-carrying pods charge their resolved volume
+        attachments (apis/storage; hot callers pass a prebuilt index)."""
         from karpenter_tpu.apis.storage import PersistentVolumeClaim, pod_volume_requests, VolumeIndex
         from karpenter_tpu.scheduling import resources as res
 
-        total = Resources()
-        for p in self.pods_on_node(node_name):
-            # each bound pod occupies one slot on the pods axis -- the
-            # solver, oracle, and binder all charge PODS:1 per placement;
-            # usage omitting it let kwok nodes exceed their pod capacity
-            # (round-5 finding)
-            total = total + p.requests + Resources.from_base_units({res.PODS: 1})
+        out: Dict[str, Resources] = {n: Resources() for n in node_names}
+        one_pod = Resources.from_base_units({res.PODS: 1})
+        for p in self.list(Pod):
+            total = out.get(p.node_name)
+            if total is None:
+                continue
+            total = total + p.requests + one_pod
             if p.volume_claims:
-                # bound pods charge their claim attachments to the node
-                # (apis/storage): pod.requests never carries the volume
-                # axis on the RAW object -- resolution is external.
-                # Per-reconcile callers (binder, existing-node snapshots)
-                # pass a prebuilt index; building one per call would put
-                # an O(claims) list scan in the bind inner loop.
                 if vol_index is None:
                     vol_index = VolumeIndex(self.list(PersistentVolumeClaim))
                 total = total + pod_volume_requests(p, vol_index)
-        return total
+            out[p.node_name] = total
+        return out
 
     def nodepool_usage(self, nodepool_name: str) -> Resources:
         from karpenter_tpu.apis import labels as wk

@@ -704,3 +704,30 @@ class TestKubeConversions:
         assert r.get("cpu") == 8000.0
         assert r.get(res.ATTACHABLE_VOLUMES) == 25.0  # smallest driver wins
         assert "hugepages-2Mi" not in r.keys()
+
+
+class TestNodeUsageMap:
+    def test_bulk_map_equals_per_node_with_volumes(self):
+        """node_usage delegates to node_usage_map; this pins the bulk
+        path's accounting (PODS slot + volume attachments) against a
+        cluster with claim-carrying pods (round-5 review)."""
+        from karpenter_tpu.apis.storage import VolumeIndex
+
+        clock = FakeClock(start=10_000.0)
+        op = Operator(clock=clock)
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        for i in range(3):
+            op.cluster.create(PersistentVolumeClaim(f"d{i}"))
+        op.cluster.create(mk_pod("plain", cpu="300m"))
+        op.cluster.create(mk_pod("vol", claims=("d0", "d1", "d2")))
+        op.settle(max_ticks=30)
+        assert not op.cluster.pending_pods()
+        vol_index = VolumeIndex.from_cluster(op.cluster)
+        names = [n.metadata.name for n in op.cluster.list(Node)]
+        bulk = op.cluster.node_usage_map(names, vol_index)
+        for name in names:
+            assert bulk[name] == op.cluster.node_usage(name, vol_index)
+        total = sum((bulk[n] for n in names), Resources())
+        assert total.get(res.PODS) == 2
+        assert total.get(res.ATTACHABLE_VOLUMES) == 3
