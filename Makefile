@@ -17,14 +17,24 @@ deflake:  ## shuffled test order (fresh seed per round), repeated (race hunting)
 		PYTEST_SHUFFLE_SEED=$$seed $(PYTEST) tests/ -q -p no:cacheprovider -o addopts= --maxfail=1 || exit 1; \
 	done
 
+# gated tiers stamp TIERS_LAST_RUN.json (hack/tier_stamp.py): tier name,
+# git sha, pass/fail, timestamp -- machine-readable proof the
+# skipped-by-default tiers actually ran against this tree. The stamp
+# itself is best-effort (|| true): bookkeeping must never fail (or pass)
+# a tier the tests decided otherwise.
+define STAMP
+&& ($(PY) hack/tier_stamp.py $(1) --ok || true) || { $(PY) hack/tier_stamp.py $(1) --failed || true; exit 1; }
+endef
+
 benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
-	$(PY) bench.py --profile
+	$(PY) bench.py --profile > bench_last.json; rc=$$?; cat bench_last.json; \
+	$(PY) hack/tier_stamp.py benchmark --from-bench bench_last.json || true; exit $$rc
 
 e2e:  ## scale + end-to-end suites only
 	$(PYTEST) tests/test_scale.py tests/test_e2e_provisioning.py tests/test_storage.py tests/test_soak.py -q
 
 e2e-50k:  ## 50k-pod FULL-loop tier (loop settles ~11s; ~40s total incl. the sequential-oracle price comparison)
-	KARPENTER_TPU_E2E_50K=1 $(PYTEST) tests/test_scale.py -k FiftyThousand -q -s
+	KARPENTER_TPU_E2E_50K=1 $(PYTEST) tests/test_scale.py -k FiftyThousand -q -s $(call STAMP,e2e-50k)
 
 run:  ## controller loop over the kwok rig
 	$(PY) -m karpenter_tpu --max-ticks 50 --tick-interval 0.2 --metrics-dump
@@ -42,14 +52,15 @@ docs-check:  ## fail if generated docs / CRD manifests / README perf headline ar
 	$(PY) hack/deploy_gen.py --check
 
 verify-entry:  ## driver entry points (single-chip compile + multi-chip dryrun + 2-process mesh)
-	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
-	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8, n_processes=2)"
+	($(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)" \
+	 && $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8, n_processes=2)") \
+	 $(call STAMP,verify-entry)
 
 benchmark-interruption:  ## interruption-queue tier at 100/1k/5k(/15k) messages
-	KARPENTER_TPU_PERF=1 KARPENTER_TPU_BENCH_FULL=1 $(PYTEST) tests/test_interruption_bench.py -q -s
+	KARPENTER_TPU_PERF=1 KARPENTER_TPU_BENCH_FULL=1 $(PYTEST) tests/test_interruption_bench.py -q -s $(call STAMP,benchmark-interruption)
 
 fuzz-extended:  ## 191-seed differential sweep (101 mixed-constraint + 40 multi-pool + 38 affinity-carve + 12 three-phase; device vs oracle)
-	KARPENTER_TPU_FUZZ_EXTENDED=1 $(PYTEST) tests/test_solver.py tests/test_multipool.py tests/test_affinity.py tests/test_spread.py -k Extended -q
+	KARPENTER_TPU_FUZZ_EXTENDED=1 $(PYTEST) tests/test_solver.py tests/test_multipool.py tests/test_affinity.py tests/test_spread.py -k Extended -q $(call STAMP,fuzz-extended)
 
 benchmark-consolidation:  ## consolidation decision-rate tier on the kwok rig
-	KARPENTER_TPU_PERF=1 $(PYTEST) tests/test_consolidation_bench.py -q -s
+	KARPENTER_TPU_PERF=1 $(PYTEST) tests/test_consolidation_bench.py -q -s $(call STAMP,benchmark-consolidation)

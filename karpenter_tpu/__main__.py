@@ -25,6 +25,7 @@ def build_operator(args):
         vm_memory_overhead_percent=args.vm_memory_overhead_percent,
         reserved_nics=args.reserved_nics,
         isolated_network=args.isolated_network,
+        pipelined_scheduling=getattr(args, "pipelined_scheduling", True),
     )
     # feature gates merge over the defaults (reference: the core's
     # --feature-gates flag, checked e.g. at cmd/controller/main.go:45-47)
@@ -152,6 +153,12 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--tpu-solver", action=argparse.BooleanOptionalAction, default=True,
         help="route scheduling + consolidation decisions through the accelerator",
+    )
+    parser.add_argument(
+        "--pipelined-scheduling", action=argparse.BooleanOptionalAction, default=True,
+        help="double-buffer the provisioner tick under sustained load (the "
+        "device solve overlaps the rest of the sweep; --no-pipelined-scheduling "
+        "pins the synchronous dispatch+barrier path)",
     )
     parser.add_argument(
         "--kubeconfig", default="",

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Generic, Hashable, List, Optional, Sequence, Tuple, TypeVar
@@ -148,7 +149,13 @@ class Batcher(Generic[T, U]):
                 break
             try:
                 return fut.result(timeout=self.options.idle_seconds)
-            except TimeoutError:
+            except (TimeoutError, FutureTimeoutError):
+                # BOTH spellings: Future.result raises
+                # concurrent.futures.TimeoutError, which is only an alias
+                # of the builtin TimeoutError from Python 3.11 -- on 3.10
+                # the bare except missed it and the straggler timeout
+                # escaped the rendezvous loop, killing the whole launch
+                # fan-out instead of force-flushing the window
                 self.flush(force=True)
         return fut.result()
 

@@ -73,7 +73,10 @@ INFLIGHT_PREFIX = "inflight/"
 class Provisioner:
     log = get_logger("provisioner")
 
-    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, solver=None, recorder=None):
+    def __init__(
+        self, cluster: Cluster, cloud_provider: CloudProvider, solver=None,
+        recorder=None, pipeline: Optional[bool] = None,
+    ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.solver = solver  # optional TPU solver; None = oracle
@@ -86,6 +89,22 @@ class Provisioner:
         # is re-validated by the same fit/affinity/spread checks, and a
         # failed hint falls back to the full scan.
         self._assignment_hints: Dict[str, str] = {}
+        # double-buffered tick (the pipelined PRODUCTION path): under
+        # SUSTAINED load the solver's device dispatch for tick N stays in
+        # flight across the rest of the controller sweep, and tick N+1
+        # drains it FIRST (launching its claims), then snapshots and
+        # dispatches the next batch -- so the device round trip overlaps
+        # everything between two provisioner reconciles instead of
+        # blocking inside one. Drain-before-snapshot keeps every solve's
+        # input consistent (no two in-flight batches can double-book
+        # existing capacity), which is what makes each batch's decision
+        # bit-identical to a synchronous solve of the same snapshot.
+        # The pipeline engages only from the SECOND consecutive tick with
+        # pending pods (cold ticks run the synchronous path: a single
+        # burst still gets its decision the same tick).
+        self.pipeline = pipeline if pipeline is not None else True
+        self._inflight = None        # (ticket, vol_blocked, host_s, n_pods)
+        self._sustained = False
 
     # -- snapshot -----------------------------------------------------------
     def _existing_nodes(self) -> List[ExistingNode]:
@@ -149,11 +168,17 @@ class Provisioner:
     def reconcile(self) -> SchedulingResult:
         from karpenter_tpu.apis.storage import VolumeIndex, effective_pods
 
+        # pipeline barrier FIRST: the decision dispatched last tick lands
+        # and its claims launch before this tick snapshots, so the new
+        # snapshot sees that capacity in flight (drain-before-snapshot --
+        # see __init__) and no two batches ever overlap
+        prev = self._drain_pipeline()
         pods = self.cluster.pending_pods()
         result = SchedulingResult()
         if not pods:
-            self.last_result = result
-            return result
+            self._sustained = False
+            self.last_result = prev if prev is not None else result
+            return self.last_result
         # lower volume claims into solver vocabulary (attach counts on the
         # attachable-volumes axis, bound zones as selector pins); pods
         # whose claims cannot resolve are unschedulable this tick
@@ -162,6 +187,7 @@ class Provisioner:
         pods, vol_blocked = effective_pods(pods, VolumeIndex.from_cluster(self.cluster))
         result.unschedulable.update(vol_blocked)
         if not pods:
+            self._sustained = False
             metrics.IGNORED_PODS.set(len(result.unschedulable))
             self._publish_unschedulable(result)
             self.last_result = result
@@ -194,12 +220,60 @@ class Provisioner:
             daemon_overhead=overhead_by_pool(self.cluster.list(DaemonSet), nodepools),
         )
         t0 = time.perf_counter()
-        if self.solver is not None:
-            result = self.solver.schedule(scheduler, pods)
+        sustained = self._sustained
+        self._sustained = True
+        if (
+            self.pipeline and sustained and self.solver is not None
+            and hasattr(self.solver, "schedule_begin")
+        ):
+            # sustained load: dispatch this batch and let the device round
+            # trip ride under the rest of the sweep; the barrier lands at
+            # the top of the next reconcile. Batches that route off the
+            # plain device path come back already completed (nothing in
+            # flight to overlap) and apply immediately below.
+            ticket = self.solver.schedule_begin(scheduler, pods)
+            if not ticket.completed:
+                metrics.SOLVER_PIPELINE_TICKS.inc(mode="pipelined")
+                self._inflight = (
+                    ticket, vol_blocked, time.perf_counter() - t0, len(pods)
+                )
+                self.last_result = prev if prev is not None else result
+                return self.last_result
+            decision = ticket.done
+        elif self.solver is not None:
+            decision = self.solver.schedule(scheduler, pods)
         else:
-            result = scheduler.schedule(pods)
+            decision = scheduler.schedule(pods)
+        metrics.SOLVER_PIPELINE_TICKS.inc(mode="synchronous")
+        return self._apply_decision(
+            decision, vol_blocked, time.perf_counter() - t0, len(pods)
+        )
+
+    def _drain_pipeline(self) -> Optional[SchedulingResult]:
+        """The explicit pipeline barrier: complete the decision dispatched
+        last tick (fetch + decode via the solver's schedule_finish, which
+        handles mid-flight catalog changes and wire degrades) and launch
+        its claims. Returns None when nothing was in flight."""
+        infl = self._inflight
+        if infl is None:
+            return None
+        self._inflight = None
+        ticket, vol_blocked, host_s, n_pods = infl
+        t0 = time.perf_counter()
+        decision = self.solver.schedule_finish(ticket)
+        # decision latency = host stages at dispatch + the barrier's own
+        # work; the deliberate overlap dwell between ticks is not decision
+        # time (the fetch was streaming through it)
+        return self._apply_decision(
+            decision, vol_blocked, host_s + (time.perf_counter() - t0), n_pods
+        )
+
+    def _apply_decision(
+        self, result: SchedulingResult, vol_blocked: Dict[str, str],
+        duration_s: float, n_pods: int,
+    ) -> SchedulingResult:
         result.unschedulable.update(vol_blocked)
-        metrics.SCHEDULING_DURATION.observe(time.perf_counter() - t0)
+        metrics.SCHEDULING_DURATION.observe(duration_s)
         metrics.IGNORED_PODS.set(len(result.unschedulable))
         self._publish_unschedulable(result)
         # existing-node decisions hint the binder directly (node names).
@@ -217,7 +291,7 @@ class Provisioner:
         if result.new_groups or result.unschedulable:
             self.log.info(
                 "scheduling decision",
-                pods=len(pods),
+                pods=n_pods,
                 new_groups=len(result.new_groups),
                 bound_existing=len(result.existing_assignments),
                 unschedulable=len(result.unschedulable),

@@ -226,6 +226,16 @@ GARBAGE_COLLECTED = REGISTRY.counter(
 PODS_BOUND = REGISTRY.counter(
     "karpenter_pods_bound_total", "Pods bound to nodes by the kwok binder",
 )
+SOLVER_PIPELINE_TICKS = REGISTRY.counter(
+    "karpenter_scheduler_pipeline_ticks_total",
+    "Scheduling decisions by execution mode of the provisioner tick",
+    labels=("mode",),  # pipelined | synchronous
+)
+SOLVER_PIPELINE_FALLBACKS = REGISTRY.counter(
+    "karpenter_scheduler_pipeline_fallbacks_total",
+    "Pipelined solves that fell back to the synchronous path mid-flight",
+    labels=("reason",),  # catalog-changed | stale-seqnum | rpc-degraded
+)
 NODES_READY = REGISTRY.gauge(
     "karpenter_nodes_ready_count", "Ready nodes in the cluster",
 )

@@ -71,6 +71,11 @@ class Options:
     isolated_network: bool = False
     batch_max_duration: float = 1.0
     batch_idle_duration: float = 0.035
+    # double-buffered provisioner tick (controllers/provisioner.py): under
+    # sustained load the device solve stays in flight across the sweep and
+    # the next tick drains it -- the production default; False pins every
+    # tick to the synchronous dispatch+barrier path
+    pipelined_scheduling: bool = True
     feature_gates: dict = field(default_factory=lambda: {"ReservedCapacity": True, "SpotToSpotConsolidation": False})
 
 
@@ -143,7 +148,8 @@ class Operator:
             instance_profiles=self.instance_profiles,
         )
         self.provisioner = Provisioner(
-            self.cluster, self.cloud_provider, solver=solver, recorder=self.recorder
+            self.cluster, self.cloud_provider, solver=solver, recorder=self.recorder,
+            pipeline=self.options.pipelined_scheduling,
         )
         self.nodeclaim_lifecycle = NodeClaimLifecycleController(
             self.cluster, self.cloud_provider, recorder=self.recorder

@@ -251,7 +251,10 @@ def render_toml(
     (the reference merges Bottlerocket userdata the same way,
     pkg/providers/amifamily/bootstrap/bottlerocket.go; a textual prepend
     would make duplicate tables a TOML parse error, ADVICE round 1)."""
-    import tomllib
+    try:
+        import tomllib
+    except ModuleNotFoundError:  # py3.10: tomllib landed in 3.11
+        import tomli as tomllib  # same API -- tomllib was vendored from tomli
 
     user_tree: Dict = {}
     if nodeclass.user_data:

@@ -371,6 +371,25 @@ class SolverServer:
 
 # -- client ------------------------------------------------------------------
 
+class StaleSeqnumError(RuntimeError):
+    """The sidecar does not know the staged-catalog seqnum an ASYNC solve
+    named: it restarted or evicted the catalog while the request was in
+    flight. The pipelined path surfaces this instead of silently
+    re-staging (a restage cannot be spliced in front of a frame that has
+    already streamed); the caller decides -- TPUSolver._finish_remote
+    falls back to the synchronous op, which restages and retries."""
+
+
+class _PendingReply:
+    """One in-flight request's reply slot. `outcome` is filled by the FIFO
+    drain: ("ok", header, tensors) or ("err", exception)."""
+
+    __slots__ = ("outcome",)
+
+    def __init__(self):
+        self.outcome = None
+
+
 class SolverClient:
     """Drop-in backend for TPUSolver-shaped solves over the wire. Maintains
     one persistent connection; `solve_classes` mirrors the tensor half of
@@ -396,6 +415,16 @@ class SolverClient:
         # whole roundtrip (and the stage-then-solve sequence inside
         # solve_classes) must be atomic across threads
         self._lock = threading.RLock()
+        # request-pipelining FIFO (begin_solve_compact): replies come back
+        # in request order on the one stream, so each dispatched frame's
+        # reply slot queues here until a drain claims it
+        from collections import deque
+
+        self._pending: "deque[_PendingReply]" = deque()
+        # one solve computing + one frame streaming behind it -- the depth
+        # at which the RTT fully overlaps compute; anything deeper only
+        # buffers latency (and decisions) without adding overlap
+        self.MAX_INFLIGHT = 2
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
@@ -425,10 +454,107 @@ class SolverClient:
 
     def close(self) -> None:
         with self._lock:
+            # replies can no longer arrive on this stream: fail their slots
+            # so a later finish_solve_compact raises instead of hanging
+            for h in self._pending:
+                if h.outcome is None:
+                    h.outcome = ("err", ConnectionError("connection closed with reply in flight"))
+            self._pending.clear()
             if self._sock is not None:
                 self._sock.close()
                 self._sock = None
             self._features = None  # the replacement server may differ
+
+    # -- request pipelining (the async solve path) ---------------------------
+    def _drain_pending(self, target: Optional[_PendingReply] = None) -> None:
+        """Receive outstanding replies in FIFO order (all of them, or up to
+        and including `target`). MUST run before any synchronous roundtrip
+        so a pipelined reply is never misattributed to a later request.
+        Caller holds the lock."""
+        while self._pending:
+            head = self._pending[0]
+            if head.outcome is None:
+                try:
+                    header, tensors = _recv_frame(self._sock)
+                    head.outcome = ("ok", header, tensors)
+                except (ConnectionError, OSError) as e:
+                    # the stream is unrecoverable mid-pipeline: every
+                    # outstanding reply is lost with it
+                    for h in self._pending:
+                        if h.outcome is None:
+                            h.outcome = ("err", e)
+                    self._pending.clear()
+                    self.close()
+                    return
+            done = self._pending.popleft()
+            if target is not None and done is target:
+                return
+
+    def begin_solve_compact(
+        self, seqnum: str, catalog: encode.CatalogTensors, class_set: encode.PodClassSet,
+        g_max: int = 1024, nnz_max: int = 0, objective: str = "price",
+    ) -> _PendingReply:
+        """Dispatch a compact solve WITHOUT waiting for the reply: the
+        request frame streams to the sidecar while it may still be
+        computing a prior in-flight solve (request pipelining on the
+        strict request/response framing -- replies return in request
+        order). At most MAX_INFLIGHT (2: one computing, one streaming)
+        may be outstanding; a deeper dispatch raises rather than silently
+        buffering stale decisions. Claim the reply with
+        finish_solve_compact. Unlike the synchronous op, an unknown
+        seqnum surfaces as StaleSeqnumError -- no silent restage."""
+        if not nnz_max:
+            nnz_max = ffd.nnz_budget(class_set.c_pad, g_max)
+        header = {
+            "op": "solve_compact", "seqnum": seqnum, "g_max": g_max,
+            "nnz_max": nnz_max, "objective": objective,
+        }
+        with self._lock:
+            if len(self._pending) >= self.MAX_INFLIGHT:
+                raise RuntimeError(
+                    f"solve pipeline full: {len(self._pending)} requests already in flight"
+                )
+            if seqnum not in self._staged_seqnums:
+                # staging is a synchronous roundtrip: the pipe must be
+                # clear first or the stage reply would interleave
+                self._drain_pending()
+                self.stage_catalog(seqnum, catalog)
+            sock = self._conn()
+            try:
+                _send_frame(sock, header, self._class_tensors(class_set))
+            except (ConnectionError, OSError):
+                # a PARTIAL frame may be on the wire: the stream is
+                # desynchronized, and a later synchronous fallback would
+                # write its frame into the torn one's remainder -- close
+                # so that fallback reconnects onto a clean stream
+                self.close()
+                raise
+            handle = _PendingReply()
+            self._pending.append(handle)
+            return handle
+
+    def finish_solve_compact(self, handle: _PendingReply) -> ffd.CompactDecision:
+        """Claim a begin_solve_compact reply (blocking until it arrives).
+        Raises StaleSeqnumError on unknown-seqnum, ConnectionError when
+        the stream died with the reply in flight."""
+        with self._lock:
+            if handle.outcome is None:
+                self._drain_pending(target=handle)
+            if handle.outcome is None:
+                raise ConnectionError("reply lost: not in the pipeline FIFO")
+        kind, *rest = handle.outcome
+        if kind == "err":
+            raise rest[0]
+        header, out = rest
+        if not header.get("ok"):
+            err = str(header.get("error", ""))
+            if err == "unknown-seqnum":
+                raise StaleSeqnumError(err)
+            raise RuntimeError(f"solve failed: {err}")
+        fields = {n: out[n] for n in ffd.CompactDecision._fields}
+        fields["nnz"] = fields["nnz"].reshape(())
+        fields["n_open"] = fields["n_open"].reshape(())
+        return ffd.CompactDecision(**fields)
 
     def features(self) -> frozenset:
         """Server feature set, probed once per connection via ping (an
@@ -445,6 +571,9 @@ class SolverClient:
 
     def _roundtrip(self, header, tensors=()):
         with self._lock:
+            # pipelined replies still on the stream MUST drain first, or
+            # this request would read an earlier solve's reply as its own
+            self._drain_pending()
             sock = self._conn()
             try:
                 _send_frame(sock, header, tensors)

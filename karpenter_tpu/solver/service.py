@@ -26,6 +26,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from karpenter_tpu import metrics
 from karpenter_tpu.apis import NodePool, Pod, labels as wk
 from karpenter_tpu.logging import ChangeMonitor, get_logger
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resources
@@ -77,6 +78,38 @@ class _MergedVirtualPool(NodePool):
 
     def requirements(self):
         return Requirements()
+
+
+class _PendingSolve:
+    """One batch solve split at the device/wire dispatch boundary.
+
+    `solve_begin` runs every host stage (spread split, existing-node
+    pre-pass, grouping, encoding) and dispatches the device FFD (in-process:
+    the fused buffer with its async D2H copy already streaming; remote: the
+    solve frame already on the wire). `solve_finish` is the explicit
+    BARRIER: it fetches, expands, decodes -- and falls back to a fresh
+    synchronous solve when the staged catalog was re-encoded mid-flight
+    (seqnum change) or the wire path degraded.
+
+    Tickets for paths with nothing in flight (oracle-routed batches, empty
+    catalogs, everything placed on existing capacity) are COMPLETED at
+    begin time and carry the final result."""
+
+    __slots__ = (
+        "done", "pool", "entry", "class_set", "result", "placed_existing",
+        "nodepool_usage", "buf", "inp", "nnz_max", "rpc_handle", "barrier",
+        "call_args", "call_kwargs",
+    )
+
+    def __init__(self, done: Optional[SchedulingResult] = None):
+        self.done = done
+        self.rpc_handle = None
+        self.buf = None
+        self.inp = None
+
+    @property
+    def completed(self) -> bool:
+        return self.done is not None
 
 
 class TPUSolver:
@@ -722,6 +755,55 @@ class TPUSolver:
             self._oracle_suffix(scheduler, aff_pods, pods, result, device_assignments)
         return result
 
+    # -- pipelined entry point (Provisioner double-buffered tick) -----------
+    def schedule_begin(self, scheduler: Scheduler, pods: Sequence[Pod]) -> "_PendingSolve":
+        """The dispatch half of schedule() for the pipelined provisioner
+        tick: host stages run and the device FFD is dispatched, but the
+        fetch/decode barrier is deferred to schedule_finish -- so the
+        caller can overlap the result fetch with other work (the next
+        tick's host stages, the rest of the controller sweep).
+
+        Only the production hot shape pipelines: ONE nodepool, batch fully
+        on the device path (no oracle suffix, no minValues prefix, no
+        overlapping pools). Everything else completes synchronously inside
+        this call via schedule() -- those paths either run on the oracle
+        (nothing in flight to overlap) or need sequenced multi-phase state
+        hand-offs that a deferred barrier would split."""
+        base_classes = encode.group_pods(pods)
+        pools = scheduler.nodepools
+        overlap = len(pools) > 1 and self._pools_overlap(pools, pods, classes=base_classes)
+        items = scheduler.instance_types.get(pools[0].name, []) if pools else []
+        pipelinable = (
+            len(pools) == 1
+            and bool(items)
+            and self.supports(scheduler, pods, classes=base_classes, overlap=overlap)
+            and not self._suffix_classes(base_classes)
+            and not self._mv_classes(scheduler, base_classes)
+        )
+        if not pipelinable:
+            return _PendingSolve(done=self.schedule(scheduler, pods))
+        pool = pools[0]
+        self.last_route = {"device_pods": len(pods), "oracle_pods": 0, "path": "device"}
+        return self.solve_begin(
+            pool, items, list(pods),
+            nodepool_usage=scheduler.usage.get(pool.name),
+            existing_nodes=scheduler.existing,
+            zones=sorted(scheduler.zones),
+            spread_seeds=self._spread_seeds(scheduler),
+            classes=base_classes,
+            daemon_overhead=scheduler.daemon_overhead.get(pool.name),
+        )
+
+    def schedule_finish(self, pending: "_PendingSolve") -> SchedulingResult:
+        """The barrier half of schedule_begin (see solve_finish for the
+        mid-flight fallback semantics). No post-loop leftover pass is
+        needed: schedule_begin pipelines only the single-pool shape with a
+        non-empty catalog, where solve() itself accounts every pod as a
+        placement, an existing assignment, or an unschedulable entry."""
+        if pending.done is not None:
+            return pending.done
+        return self.solve_finish(pending)
+
     def _oracle_suffix(
         self, scheduler: Scheduler, aff_pods: List[Pod],
         device_pods: Sequence[Pod], result: SchedulingResult,
@@ -943,7 +1025,45 @@ class TPUSolver:
         classes: Optional[List] = None,
         daemon_overhead: Optional[Resources] = None,
     ) -> SchedulingResult:
+        """The synchronous solve: dispatch + barrier in one call. This IS
+        the pipelined path run back-to-back (solve_begin/solve_finish are
+        the production tick's two halves), so the two are bit-identical by
+        construction; the barrier check is skipped because nothing can
+        re-encode the catalog between the adjacent halves of one call."""
+        return self.solve_finish(
+            self.solve_begin(
+                pool, instance_types, pods,
+                nodepool_usage=nodepool_usage, existing_nodes=existing_nodes,
+                zones=zones, spread_seeds=spread_seeds, classes=classes,
+                daemon_overhead=daemon_overhead, _barrier=False,
+            )
+        )
+
+    def solve_begin(
+        self,
+        pool: NodePool,
+        instance_types: Sequence,
+        pods: Sequence[Pod],
+        nodepool_usage: Optional[Resources] = None,
+        existing_nodes: Sequence = (),
+        zones: Sequence[str] = (),
+        spread_seeds: Optional[Dict] = None,
+        classes: Optional[List] = None,
+        daemon_overhead: Optional[Resources] = None,
+        _barrier: bool = True,
+    ) -> "_PendingSolve":
         from karpenter_tpu.solver import spread as spread_mod
+
+        # snapshot of the call for the barrier's synchronous re-solve: the
+        # host phases below never mutate their inputs (_pack_existing
+        # records assignments without touching node.used), so re-running
+        # from these args is exactly the synchronous path at finish time
+        call_args = (pool, instance_types, list(pods))
+        call_kwargs = dict(
+            nodepool_usage=nodepool_usage, existing_nodes=existing_nodes,
+            zones=zones, spread_seeds=spread_seeds, classes=classes,
+            daemon_overhead=daemon_overhead,
+        )
 
         pool_reqs = pool.requirements()
         # per-fresh-node daemonset reserve (apis/daemonset), scaled to the
@@ -997,7 +1117,7 @@ class TPUSolver:
                     kept.append(pc)
             classes = kept
             if not classes:
-                return result
+                return _PendingSolve(done=result)
         if instance_types and any(
             spread_mod.hard_zone_tsc(pc.pods[0]) is not None
             or spread_mod.soft_zone_tsc(pc.pods[0]) is not None
@@ -1047,7 +1167,7 @@ class TPUSolver:
             classes = split.classes
             result.unschedulable.update(split.unschedulable)
             if not classes:
-                return result
+                return _PendingSolve(done=result)
 
         # phase 1 (device): pack onto existing capacity first, exactly as the
         # oracle tries existing nodes before opening groups -- the same
@@ -1058,12 +1178,12 @@ class TPUSolver:
 
         remaining = int(sum(len(pc.pods) for pc in classes) - placed_existing.sum())
         if remaining == 0:
-            return result
+            return _PendingSolve(done=result)
         if not instance_types:
             for c, pc in enumerate(classes):
                 for p in pc.pods[int(placed_existing[c]):]:
                     result.unschedulable[p.metadata.name] = "no instance types for nodepool"
-            return result
+            return _PendingSolve(done=result)
 
         # phase 2 (device): batched FFD over the leftovers
         entry = self._catalog(instance_types)
@@ -1139,32 +1259,30 @@ class TPUSolver:
                 "class-count bucket was not precompiled; this tick compiles",
                 c_pad=class_set.c_pad, classes=len(classes),
             )
-        dense = None
+        pending = _PendingSolve()
+        pending.pool = pool
+        pending.entry = entry
+        pending.class_set = class_set
+        pending.result = result
+        pending.placed_existing = placed_existing
+        pending.nodepool_usage = nodepool_usage
+        pending.barrier = _barrier
+        pending.call_args = call_args
+        pending.call_kwargs = call_kwargs
         if self.client is not None:
-            # compact over the wire too: this seam exists for the TPU-VM
-            # topology where the link IS the bandwidth-poor hop
+            # async wire dispatch: the solve frame streams to the sidecar
+            # now and the reply is claimed at the barrier -- the ~RTT
+            # overlaps whatever the caller does between begin and finish
+            # (the next tick's host stages in the pipelined provisioner).
+            # A dispatch-time failure leaves rpc_handle None; the barrier
+            # then runs the synchronous wire ladder (reconnect + restage).
             try:
-                dec = self.client.solve_classes_compact(
-                    seqnum, catalog, class_set, g_max=self.g_max, objective=self.objective,
+                pending.rpc_handle = self.client.begin_solve_compact(
+                    seqnum, catalog, class_set, g_max=self.g_max,
+                    objective=self.objective,
                 )
-                dense = ffd.expand_compact(
-                    dec, class_set.c_pad, self.g_max, catalog.k_pad, encode.Z_PAD, encode.CT
-                )
-            except RuntimeError as e:
-                if "unknown op" not in str(e):
-                    raise
-                # version skew: an older sidecar without solve_compact must
-                # not take scheduling down -- degrade to the dense op
-                dense = None
-            if dense is None:
-                # sparse budget overflow: dense refetch over the wire
-                out = self.client.solve_classes(
-                    seqnum, catalog, class_set, g_max=self.g_max, objective=self.objective
-                )
-                dense = (
-                    np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
-                    np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
-                )
+            except (ConnectionError, OSError):
+                pending.rpc_handle = None
         else:
             inp = ffd.make_inputs_staged(staged, class_set)
             # fused compact decision: the whole result in ONE ~140 KB u32
@@ -1180,21 +1298,131 @@ class TPUSolver:
                 objective=self.objective,
             )
             buf.copy_to_host_async()
+            pending.buf = buf
+            pending.inp = inp
+            pending.nnz_max = nnz_max
+        return pending
+
+    def _entry_current(self, entry: "_CatalogEntry") -> bool:
+        """True while `entry` is still THE staged snapshot for its catalog
+        list: same list object, same seqnum. False means the entry was
+        LRU-evicted and re-encoded between dispatch and barrier -- the
+        in-flight decision is against a superseded staging and the barrier
+        falls back to a fresh synchronous solve."""
+        with self._lock:
+            cur = self._catalog_cache.get(id(entry.catalog_list))
+            return (
+                cur is not None
+                and cur.catalog_list is entry.catalog_list
+                and cur.seqnum == entry.seqnum
+            )
+
+    def solve_finish(self, pending: "_PendingSolve") -> SchedulingResult:
+        """The pipeline barrier: fetch the dispatched decision, expand,
+        decode. Falls back to a fresh synchronous solve when the staged
+        catalog changed seqnum mid-flight; wire failures degrade through
+        the same ladder the synchronous path uses (reconnect, restage,
+        dense op), so the result is bit-identical either way."""
+        if pending.done is not None:
+            return pending.done
+        entry, class_set = pending.entry, pending.class_set
+        if pending.barrier and not self._entry_current(entry):
+            # catalog re-encoded between dispatch and barrier: the staged
+            # tensors this decision ran against are superseded. Discard
+            # and re-solve synchronously -- exactly what the synchronous
+            # path would compute now (host phases are pure, see
+            # solve_begin's snapshot).
+            if self._route_monitor.has_changed("pipeline_stale", entry.seqnum):
+                self.log.info(
+                    "pipelined solve discarded: catalog re-staged mid-flight",
+                    seqnum=entry.seqnum,
+                )
+            metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="catalog-changed")
+            return self.solve(*pending.call_args, **pending.call_kwargs)
+        if self.client is not None:
+            dense = self._finish_remote(pending)
+        else:
             dense = ffd.expand_fused(
-                np.asarray(buf), class_set.c_pad, self.g_max, catalog.k_pad,
-                encode.Z_PAD, encode.CT, nnz_max,
+                np.asarray(pending.buf), class_set.c_pad, self.g_max,
+                entry.tensors.k_pad, encode.Z_PAD, encode.CT, pending.nnz_max,
             )
             if dense is None:
                 # sparse budget overflow (placements not near-diagonal):
                 # refetch the dense decision -- correctness over latency
                 dense = ffd.solve_dense_tuple(
-                    inp, g_max=self.g_max, word_offsets=offsets, words=words,
-                    objective=self.objective,
+                    pending.inp, g_max=self.g_max, word_offsets=entry.offsets,
+                    words=entry.words, objective=self.objective,
                 )
         return self._decode(
-            pool, entry, class_set, dense, nodepool_usage,
-            result=result, class_offset=placed_existing,
+            pending.pool, entry, class_set, dense, pending.nodepool_usage,
+            result=pending.result, class_offset=pending.placed_existing,
         )
+
+    def _finish_remote(self, pending: "_PendingSolve"):
+        """Claim (or re-run) the wire solve and return the dense decode
+        tuple. Degrade ladder, in order: the pipelined reply; the
+        synchronous compact op (covers reconnects and sidecar restarts --
+        it restages on unknown-seqnum); the dense op (old sidecars without
+        solve_compact, and sparse-budget overflow)."""
+        from karpenter_tpu.solver import rpc as rpc_mod
+
+        entry, class_set = pending.entry, pending.class_set
+        catalog, seqnum = entry.tensors, entry.seqnum
+        dec = None
+        if pending.rpc_handle is not None:
+            try:
+                dec = self.client.finish_solve_compact(pending.rpc_handle)
+            except rpc_mod.StaleSeqnumError:
+                # sidecar restarted / evicted the catalog while the frame
+                # was in flight: the async path rejects rather than
+                # silently restaging mid-pipeline; the synchronous op
+                # below restages and retries
+                metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="stale-seqnum")
+                dec = None
+            except (ConnectionError, OSError):
+                metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="rpc-degraded")
+                dec = None
+            except RuntimeError as e:
+                if "unknown op" not in str(e):
+                    raise
+                # version skew: an old sidecar without solve_compact must
+                # not crash every sustained tick -- drop to the ladder
+                # below, whose dense op it does speak
+                metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="rpc-degraded")
+                dec = None
+        dense = None
+        overflow = False
+        if dec is not None:
+            dense = ffd.expand_compact(
+                dec, class_set.c_pad, self.g_max, catalog.k_pad, encode.Z_PAD, encode.CT
+            )
+            overflow = dense is None
+        if dense is None and not overflow:
+            # compact over the wire too: this seam exists for the TPU-VM
+            # topology where the link IS the bandwidth-poor hop
+            try:
+                dec = self.client.solve_classes_compact(
+                    seqnum, catalog, class_set, g_max=self.g_max, objective=self.objective,
+                )
+                dense = ffd.expand_compact(
+                    dec, class_set.c_pad, self.g_max, catalog.k_pad, encode.Z_PAD, encode.CT
+                )
+            except RuntimeError as e:
+                if "unknown op" not in str(e):
+                    raise
+                # version skew: an older sidecar without solve_compact must
+                # not take scheduling down -- degrade to the dense op
+                dense = None
+        if dense is None:
+            # sparse budget overflow / no compact op: dense refetch
+            out = self.client.solve_classes(
+                seqnum, catalog, class_set, g_max=self.g_max, objective=self.objective
+            )
+            dense = (
+                np.asarray(out.take), np.asarray(out.unplaced), int(out.n_open),
+                np.asarray(out.gmask), np.asarray(out.gzone), np.asarray(out.gcap),
+            )
+        return dense
 
     def _pack_existing(self, classes, existing_nodes, result: SchedulingResult) -> np.ndarray:
         """First-fit pods onto live/in-flight nodes on device; fills

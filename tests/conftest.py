@@ -20,6 +20,18 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# py3.10 compat: tomllib landed in the stdlib in 3.11; the container ships
+# tomli (the library tomllib was vendored from, same API). Alias it so the
+# bootstrap suites' `import tomllib` works on both.
+try:
+    import tomllib  # noqa: F401
+except ModuleNotFoundError:
+    import sys as _sys
+
+    import tomli as _tomli
+
+    _sys.modules["tomllib"] = _tomli
+
 
 def pytest_collection_modifyitems(config, items):
     """Deterministic test-order shuffling for race/ordering-dependency

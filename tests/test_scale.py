@@ -163,14 +163,29 @@ class TestInterruptionThroughput:
 
 
 class TestTenThousandPodTier:
-    """VERDICT round 2, weak #6: a 10k-pod CI tier with a loose host-CPU
-    latency guard, so the once-per-round TPU bench is not the only thing
-    protecting the performance premise. The guard is deliberately slack
-    (CI machines vary); its job is catching order-of-magnitude regressions
-    (e.g. a lost cache, an accidental per-pod hot loop)."""
+    """VERDICT round 2, weak #6: a 10k-pod CI tier guarding the latency
+    premise between hardware runs. Round 6 tightens the regression
+    threshold from the old 3x-calibrated absolute bounds to 1.5x a
+    COMMITTED reference number (hack/perf_reference.json) -- 3x was loose
+    enough to silently lose an entire round's host-stage wins between TPU
+    windows. min-of-3 keeps the guard robust to CI scheduling bursts; the
+    committed references carry their own calibration headroom."""
+
+    @staticmethod
+    def _reference():
+        import pathlib
+
+        ref = json.loads(
+            (pathlib.Path(__file__).resolve().parent.parent / "hack" / "perf_reference.json")
+            .read_text()
+        )["ten_k_tier"]
+        factor = ref["regression_factor"]
+        return ref, factor
 
     def test_ten_k_pods_decision_latency_guard(self):
         from karpenter_tpu.solver.service import TPUSolver
+
+        ref, factor = self._reference()
 
         op = fresh_env()
         op.tick()  # hydrate the nodeclass so the catalog resolves
@@ -194,7 +209,8 @@ class TestTenThousandPodTier:
         # min-of-3: single-shot wall time on a shared CI host flakes on
         # transient scheduling bursts (observed >10x spikes mid-suite);
         # the MINIMUM is robust to noise while keeping the bound tight
-        # enough to catch a 3x decode/solve regression (VERDICT weak #8)
+        # enough to catch a 1.5x decode/solve regression vs the committed
+        # reference (hack/perf_reference.json)
         warm_s = float("inf")
         for _ in range(3):
             t0 = time.perf_counter()
@@ -203,12 +219,15 @@ class TestTenThousandPodTier:
         placed = sum(len(g.pods) for g in result.new_groups)
         assert placed + len(result.unschedulable) == 10_000
         assert placed == 10_000, f"{len(result.unschedulable)} unschedulable"
-        # calibrated guard (round 4): measured ~0.07s warm on the dev host
-        assert warm_s < 0.8, f"10k-pod warm solve took {warm_s:.2f}s (min of 3)"
+        warm_bound = factor * ref["warm_solve_s"]
+        assert warm_s < warm_bound, (
+            f"10k-pod warm solve took {warm_s:.2f}s (min of 3), "
+            f"> {factor}x the committed reference {ref['warm_solve_s']}s"
+        )
         # cold grouping guard: fresh pods, nothing memoized -- min over 3
         # INDEPENDENT fresh sets (cold pods cannot repeat, so each round
-        # builds its own), same noise strategy and 3x-regression
-        # calibration as the warm bound (measured ~0.08s)
+        # builds its own), same noise strategy and the same 1.5x-vs-
+        # committed-reference calibration as the warm bound
         cold_s = float("inf")
         for r in range(3):
             fresh = []
@@ -226,7 +245,11 @@ class TestTenThousandPodTier:
             result = solver.solve(pool, items, fresh)
             cold_s = min(cold_s, time.perf_counter() - t0)
             assert sum(len(g.pods) for g in result.new_groups) == 10_000
-        assert cold_s < 1.2, f"10k-pod cold solve took {cold_s:.2f}s (min of 3)"
+        cold_bound = factor * ref["cold_solve_s"]
+        assert cold_s < cold_bound, (
+            f"10k-pod cold solve took {cold_s:.2f}s (min of 3), "
+            f"> {factor}x the committed reference {ref['cold_solve_s']}s"
+        )
         # volume-resolution guard (round 4): effective_pods must stay an
         # identity pass for claimless pods and O(claims) for the rest --
         # 10k pods with 1k volume-backed resolves in low single-digit ms
@@ -250,7 +273,11 @@ class TestTenThousandPodTier:
             resolve_s = min(resolve_s, time.perf_counter() - t0)
         assert len(eff) == 10_000 and not blocked
         assert all(a is b for a, b in zip(eff[:9_000], mixed[:9_000])), "identity pass lost"
-        assert resolve_s < 0.2, f"10k-pod volume resolution took {resolve_s:.3f}s (min of 3)"
+        resolve_bound = factor * ref["volume_resolve_s"]
+        assert resolve_s < resolve_bound, (
+            f"10k-pod volume resolution took {resolve_s:.3f}s (min of 3), "
+            f"> {factor}x the committed reference {ref['volume_resolve_s']}s"
+        )
 
 
 @pytest.mark.skipif(
