@@ -5,10 +5,14 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry
+.PHONY: test deflake benchmark benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
+
+ci:  ## the CI gate: generated-docs drift (metrics registry vs docs/metrics.md, CRDs, compat matrix) THEN the test suites
+	$(MAKE) docs-check
+	$(MAKE) test
 
 deflake:  ## shuffled test order (fresh seed per round), repeated (race hunting)
 	@for i in 1 2 3 4 5; do \

@@ -26,6 +26,9 @@ def build_operator(args):
         reserved_nics=args.reserved_nics,
         isolated_network=args.isolated_network,
         pipelined_scheduling=getattr(args, "pipelined_scheduling", True),
+        tracing=getattr(args, "tracing", True),
+        tracing_sample=getattr(args, "trace_sample", 0.2),
+        tracing_slow_ms=getattr(args, "trace_slow_ms", 1000.0),
     )
     # feature gates merge over the defaults (reference: the core's
     # --feature-gates flag, checked e.g. at cmd/controller/main.go:45-47)
@@ -175,6 +178,24 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--max-ticks", type=int, default=0, help="stop after N sweeps (0 = run forever)")
     parser.add_argument("--metrics-dump", action="store_true", help="print Prometheus metrics on exit")
+    parser.add_argument(
+        "--tracing", action=argparse.BooleanOptionalAction, default=True,
+        help="scheduling-tick span tracing + slow-tick flight recorder "
+        "(/debug/traces); sampled -- see --trace-sample",
+    )
+    parser.add_argument(
+        "--trace-sample", type=float, default=0.2,
+        help="fraction of sweeps feeding the per-span stats/metrics volume "
+        "(the flight recorder judges EVERY sweep regardless; default 0.2)",
+    )
+    parser.add_argument(
+        "--trace-slow-ms", type=float, default=1000.0,
+        help="flight-recorder threshold: retain span trees for sweeps slower than this",
+    )
+    parser.add_argument(
+        "--trace-dump", action="store_true",
+        help="print the slow-tick flight recorder (JSON span trees) on exit",
+    )
     args = parser.parse_args(argv)
 
     # health endpoints come up BEFORE the operator graph builds: a slow
@@ -239,6 +260,10 @@ def main(argv=None) -> int:
         from karpenter_tpu import metrics
 
         print(metrics.REGISTRY.expose())
+    if args.trace_dump:
+        from karpenter_tpu import tracing
+
+        print(tracing.dump_json(indent=2))
     return 0
 
 

@@ -182,24 +182,32 @@ class Batcher(Generic[T, U]):
         return len(due)
 
     def _execute(self, bucket: _Bucket) -> None:
-        from karpenter_tpu import metrics
+        from karpenter_tpu import metrics, tracing
 
         self.batches_executed += 1
         self.items_executed += len(bucket.items)
         self.batch_sizes.append(len(bucket.items))
+        window_s = max(0.0, bucket.last_at - bucket.first_at)
         metrics.BATCH_SIZE.observe(len(bucket.items), api=self.name)
-        metrics.BATCH_WINDOW.observe(max(0.0, bucket.last_at - bucket.first_at), api=self.name)
-        try:
-            results = self.exec_batch(bucket.items)
-            if len(results) != len(bucket.items):
-                raise RuntimeError(
-                    f"batch executor returned {len(results)} results for {len(bucket.items)} items"
-                )
-            for fut, res in zip(bucket.futures, results):
-                fut.set_result(res)
-        except Exception as e:  # noqa: BLE001 -- error fans out to waiters
-            for fut in bucket.futures:
-                fut.set_exception(e)
+        metrics.BATCH_WINDOW.observe(window_s, api=self.name)
+        # the coalescing window itself is already over by the time the
+        # batch executes; the span times the merged backend call and
+        # carries the window it coalesced as an attribute
+        with tracing.span(
+            "batch", api=self.name, items=len(bucket.items),
+            window_ms=round(window_s * 1e3, 3),
+        ):
+            try:
+                results = self.exec_batch(bucket.items)
+                if len(results) != len(bucket.items):
+                    raise RuntimeError(
+                        f"batch executor returned {len(results)} results for {len(bucket.items)} items"
+                    )
+                for fut, res in zip(bucket.futures, results):
+                    fut.set_result(res)
+            except Exception as e:  # noqa: BLE001 -- error fans out to waiters
+                for fut in bucket.futures:
+                    fut.set_exception(e)
 
     def _run(self) -> None:
         while not self._stop.wait(self.options.idle_seconds / 2):

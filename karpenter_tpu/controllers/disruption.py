@@ -355,11 +355,12 @@ class DisruptionController:
         """One disruption pass; returns [(claim, reason)] acted on."""
         import time as _time
 
-        from karpenter_tpu import metrics
+        from karpenter_tpu import metrics, tracing
 
         t0 = _time.perf_counter()
         try:
-            return self._reconcile(max_disruptions)
+            with tracing.span("disruption"):
+                return self._reconcile(max_disruptions)
         finally:
             self._pass_pools, self._pass_catalogs = None, None
             self._pass_pdb_guard = None

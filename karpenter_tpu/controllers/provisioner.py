@@ -15,7 +15,7 @@ from typing import Dict, List, Optional
 import time
 
 from karpenter_tpu.apis import NodeClaim, NodePool, Node, labels as wk
-from karpenter_tpu import events, metrics
+from karpenter_tpu import events, metrics, tracing
 from karpenter_tpu.logging import get_logger
 from karpenter_tpu.apis.nodeclass import HASH_ANNOTATION, HASH_VERSION, HASH_VERSION_ANNOTATION, TPUNodeClass
 from karpenter_tpu.apis.objects import generate_name
@@ -38,12 +38,18 @@ def launch_all(cloud_provider, claims, max_workers: int):
     cannot satisfy would stall every wave on the batcher's idle timeout
     (pkg/batcher/createfleet.go:36-46). Used by the provisioner AND the
     standalone nodeclaim lifecycle -- one copy of the protocol."""
+    # fan-out workers inherit the dispatching thread's span context, so
+    # the coalesced fleet calls' batcher spans land under the tick's
+    # launch span instead of vanishing on the pool threads
+    parent_span = tracing.TRACER.current()
+
     def launch_one(claim):
-        try:
-            cloud_provider.create(claim)
-            return None
-        except CloudError as e:
-            return e
+        with tracing.TRACER.attach(parent_span):
+            try:
+                cloud_provider.create(claim)
+                return None
+            except CloudError as e:
+                return e
 
     if len(claims) == 1:
         return [launch_one(claims[0])]
@@ -103,7 +109,10 @@ class Provisioner:
         # pending pods (cold ticks run the synchronous path: a single
         # burst still gets its decision the same tick).
         self.pipeline = pipeline if pipeline is not None else True
-        self._inflight = None        # (ticket, vol_blocked, host_s, n_pods)
+        # (ticket, vol_blocked, host_s, n_pods, dispatched_at) -- the
+        # dispatch timestamp feeds the overlap-fraction attribution at
+        # the next tick's drain barrier
+        self._inflight = None
         self._sustained = False
 
     # -- snapshot -----------------------------------------------------------
@@ -166,6 +175,10 @@ class Provisioner:
 
     # -- reconcile ----------------------------------------------------------
     def reconcile(self) -> SchedulingResult:
+        with tracing.span("provisioner"):
+            return self._reconcile()
+
+    def _reconcile(self) -> SchedulingResult:
         from karpenter_tpu.apis.storage import VolumeIndex, effective_pods
 
         # pipeline barrier FIRST: the decision dispatched last tick lands
@@ -192,33 +205,35 @@ class Provisioner:
             self._publish_unschedulable(result)
             self.last_result = result
             return result
-        nodepools = [p for p in self.cluster.list(NodePool) if not p.deleting]
-        catalogs: Dict[str, List] = {}
-        zones = set()
-        for pool in nodepools:
-            try:
-                items = self.cloud_provider.get_instance_types(pool)
-            except CloudError:
-                items = []
-            catalogs[pool.name] = items
-            for it in items:
-                for o in it.available_offerings():
-                    zones.add(o.zone)
-        from karpenter_tpu.apis import DaemonSet
-        from karpenter_tpu.apis.daemonset import overhead_by_pool
+        with tracing.span("snapshot") as snap_sp:
+            nodepools = [p for p in self.cluster.list(NodePool) if not p.deleting]
+            catalogs: Dict[str, List] = {}
+            zones = set()
+            for pool in nodepools:
+                try:
+                    items = self.cloud_provider.get_instance_types(pool)
+                except CloudError:
+                    items = []
+                catalogs[pool.name] = items
+                for it in items:
+                    for o in it.available_offerings():
+                        zones.add(o.zone)
+            from karpenter_tpu.apis import DaemonSet
+            from karpenter_tpu.apis.daemonset import overhead_by_pool
 
-        scheduler = Scheduler(
-            nodepools=nodepools,
-            instance_types=catalogs,
-            existing_nodes=self._existing_nodes(),
-            pods_by_node=self._pods_by_node(),
-            nodepool_usage={p.name: self.cluster.nodepool_usage(p.name) for p in nodepools},
-            zones=zones,
-            # fresh nodes reserve the daemonsets that will land on them
-            # (apis/daemonset; the reference core sizes simulated nodes
-            # the same way)
-            daemon_overhead=overhead_by_pool(self.cluster.list(DaemonSet), nodepools),
-        )
+            scheduler = Scheduler(
+                nodepools=nodepools,
+                instance_types=catalogs,
+                existing_nodes=self._existing_nodes(),
+                pods_by_node=self._pods_by_node(),
+                nodepool_usage={p.name: self.cluster.nodepool_usage(p.name) for p in nodepools},
+                zones=zones,
+                # fresh nodes reserve the daemonsets that will land on them
+                # (apis/daemonset; the reference core sizes simulated nodes
+                # the same way)
+                daemon_overhead=overhead_by_pool(self.cluster.list(DaemonSet), nodepools),
+            )
+            snap_sp.set(pods=len(pods), nodepools=len(nodepools))
         t0 = time.perf_counter()
         sustained = self._sustained
         self._sustained = True
@@ -231,19 +246,24 @@ class Provisioner:
             # the top of the next reconcile. Batches that route off the
             # plain device path come back already completed (nothing in
             # flight to overlap) and apply immediately below.
-            ticket = self.solver.schedule_begin(scheduler, pods)
+            with tracing.span("dispatch", mode="pipelined") as disp_sp:
+                ticket = self.solver.schedule_begin(scheduler, pods)
+                disp_sp.set(completed_at_begin=ticket.completed)
             if not ticket.completed:
                 metrics.SOLVER_PIPELINE_TICKS.inc(mode="pipelined")
                 self._inflight = (
-                    ticket, vol_blocked, time.perf_counter() - t0, len(pods)
+                    ticket, vol_blocked, time.perf_counter() - t0, len(pods),
+                    time.perf_counter(),
                 )
                 self.last_result = prev if prev is not None else result
                 return self.last_result
             decision = ticket.done
         elif self.solver is not None:
-            decision = self.solver.schedule(scheduler, pods)
+            with tracing.span("dispatch", mode="synchronous"):
+                decision = self.solver.schedule(scheduler, pods)
         else:
-            decision = scheduler.schedule(pods)
+            with tracing.span("dispatch", mode="oracle"):
+                decision = scheduler.schedule(pods)
         metrics.SOLVER_PIPELINE_TICKS.inc(mode="synchronous")
         return self._apply_decision(
             decision, vol_blocked, time.perf_counter() - t0, len(pods)
@@ -258,15 +278,32 @@ class Provisioner:
         if infl is None:
             return None
         self._inflight = None
-        ticket, vol_blocked, host_s, n_pods = infl
-        t0 = time.perf_counter()
-        decision = self.solver.schedule_finish(ticket)
-        # decision latency = host stages at dispatch + the barrier's own
-        # work; the deliberate overlap dwell between ticks is not decision
-        # time (the fetch was streaming through it)
-        return self._apply_decision(
-            decision, vol_blocked, host_s + (time.perf_counter() - t0), n_pods
-        )
+        ticket, vol_blocked, host_s, n_pods, dispatched_at = infl
+        with tracing.span("drain", pods=n_pods) as sp:
+            t0 = time.perf_counter()
+            decision = self.solver.schedule_finish(ticket)
+            barrier_s = time.perf_counter() - t0
+            # overlap fraction: how much of the decision's device+wire
+            # round trip was HIDDEN under the sweep between dispatch and
+            # this barrier. hidden = dwell between dispatch return and the
+            # barrier (the fetch streamed through it); barrier = the wait
+            # this tick actually paid. 1.0 = the device time cost the
+            # controller nothing; -> 0 = the pipeline hid nothing.
+            hidden_s = max(0.0, t0 - dispatched_at)
+            round_trip = hidden_s + barrier_s
+            overlap = hidden_s / round_trip if round_trip > 0 else 1.0
+            metrics.PIPELINE_OVERLAP.observe(overlap)
+            sp.set(
+                overlap_fraction=round(overlap, 4),
+                hidden_ms=round(hidden_s * 1e3, 3),
+                barrier_ms=round(barrier_s * 1e3, 3),
+            )
+            # decision latency = host stages at dispatch + the barrier's own
+            # work; the deliberate overlap dwell between ticks is not decision
+            # time (the fetch was streaming through it)
+            return self._apply_decision(
+                decision, vol_blocked, host_s + barrier_s, n_pods
+            )
 
     def _apply_decision(
         self, result: SchedulingResult, vol_blocked: Dict[str, str],
@@ -323,6 +360,10 @@ class Provisioner:
         groups = result.new_groups
         if not groups:
             return
+        with tracing.span("launch", groups=len(groups)):
+            self._launch_groups(result, groups)
+
+    def _launch_groups(self, result: SchedulingResult, groups) -> None:
         claims = []
         for group in groups:
             claim = self._to_nodeclaim(group)
@@ -398,6 +439,12 @@ class PodBinder:
         )
 
     def reconcile(self) -> int:
+        with tracing.span("bind") as sp:
+            bound = self._reconcile()
+            sp.set(bound=bound)
+            return bound
+
+    def _reconcile(self) -> int:
         from karpenter_tpu.apis.storage import VolumeIndex
         from karpenter_tpu.scheduling import tolerates_all
 

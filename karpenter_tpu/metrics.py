@@ -101,9 +101,16 @@ class Histogram(_Metric):
 
     def percentile(self, q: float, **labels) -> float:
         key = tuple(labels.get(l, "") for l in self.label_names)
-        samples = sorted(self._samples.get(key, []))
+        # snapshot under the metric lock: observe() appends to and HALVES
+        # this list from controller threads while a scrape-side caller
+        # computes percentiles -- the same scrape-vs-mutate hazard the
+        # collect()/expose() snapshots guard against (sorting the live
+        # list could read a mid-halving state and misreport the tail)
+        with self._lock:
+            samples = list(self._samples.get(key, ()))
         if not samples:
             return math.nan
+        samples.sort()
         idx = min(len(samples) - 1, max(0, math.ceil(q / 100.0 * len(samples)) - 1))
         return samples[idx]
 
@@ -158,7 +165,7 @@ class Registry:
                     cum = 0
                     for i, b in enumerate(m.buckets):
                         cum = counts[key][i]
-                        le = _labels_str(m.label_names + ("le",), key + (repr(b),))
+                        le = _labels_str(m.label_names + ("le",), key + (_canonical_float(b),))
                         out.append(f"{name}_bucket{le} {cum}")
                     inf = _labels_str(m.label_names + ("le",), key + ("+Inf",))
                     out.append(f"{name}_bucket{inf} {total}")
@@ -172,10 +179,27 @@ class Registry:
         return "\n".join(out) + "\n"
 
 
+def _canonical_float(b) -> str:
+    """Canonical exposition float for `le` bucket bounds (%g-style, the
+    form every Prometheus client library emits) -- repr() would leak
+    Python spellings like `1e-05` vs `0.1` inconsistencies across types."""
+    return f"{float(b):g}"
+
+
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-exposition escaping for label values: backslash,
+    double quote, and newline must be escaped or a value like a nodepool
+    name containing `"` emits invalid exposition text the scraper rejects
+    (the whole page, not just the series)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _labels_str(names, values) -> str:
     if not names:
         return ""
-    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    inner = ",".join(
+        f'{n}="{_escape_label_value(str(v))}"' for n, v in zip(names, values)
+    )
     return "{" + inner + "}"
 
 
@@ -238,4 +262,18 @@ SOLVER_PIPELINE_FALLBACKS = REGISTRY.counter(
 )
 NODES_READY = REGISTRY.gauge(
     "karpenter_nodes_ready_count", "Ready nodes in the cluster",
+)
+PIPELINE_OVERLAP = REGISTRY.histogram(
+    "karpenter_scheduler_pipeline_overlap_fraction",
+    "Fraction of a pipelined solve's device+wire round trip hidden under "
+    "the controller sweep (1.0 = fully overlapped)",
+    buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0),
+)
+TRACE_SPANS = REGISTRY.counter(
+    "karpenter_tracing_spans_total",
+    "Completed trace spans by span name", labels=("name",),
+)
+TRACE_SLOW_TICKS = REGISTRY.counter(
+    "karpenter_tracing_slow_ticks_total",
+    "Root span trees retained by the slow-tick flight recorder",
 )

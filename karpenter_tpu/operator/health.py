@@ -22,6 +22,8 @@ Probes:
   serving), which is endpoint semantics, not a restart signal.
 - `/metrics`: the Prometheus registry.
 - `/debug/stacks`: every thread's stack (loopback-only).
+- `/debug/traces`: the slow-tick flight recorder's span trees as JSON
+  (loopback-only; see karpenter_tpu/tracing.py and docs/observability.md).
 
 Heartbeats are plain float timestamps; reads are lock-free (float
 stores are atomic in CPython).
@@ -91,6 +93,17 @@ class HealthServer:
                 self.end_headers()
                 self.wfile.write(data)
 
+            def _loopback_only(self) -> bool:
+                """ONE guard for every /debug endpoint: stack traces and
+                span attributes are an information-disclosure surface, and
+                `kubectl port-forward`/`exec` reach loopback while
+                arbitrary pod-network peers do not. Sends the 403 itself
+                when the peer is not local."""
+                if self.client_address[0] in ("127.0.0.1", "::1"):
+                    return True
+                self._send(403, "debug endpoints are loopback-only")
+                return False
+
             def do_GET(self):
                 if self.path == "/healthz":
                     if outer.alive():
@@ -106,16 +119,23 @@ class HealthServer:
                     from karpenter_tpu import metrics
 
                     self._send(200, metrics.REGISTRY.expose())
+                elif self.path == "/debug/traces":
+                    # slow-tick flight recorder (karpenter_tpu/tracing.py):
+                    # the last N span trees whose sweep exceeded the slow
+                    # threshold, plus the worst-ever tree
+                    if not self._loopback_only():
+                        return
+                    from karpenter_tpu import tracing
+
+                    self._send(
+                        200, tracing.dump_json(indent=2), ctype="application/json"
+                    )
                 elif self.path == "/debug/stacks":
                     # the pprof-goroutine analogue (the reference gets
                     # /debug/pprof from its operator manager): every
                     # thread's current stack, for diagnosing exactly the
-                    # wedge /healthz reports. LOOPBACK ONLY -- stack
-                    # traces are an information-disclosure surface, and
-                    # `kubectl port-forward`/`exec` reach loopback while
-                    # arbitrary pod-network peers do not
-                    if self.client_address[0] not in ("127.0.0.1", "::1"):
-                        self._send(403, "debug endpoints are loopback-only")
+                    # wedge /healthz reports
+                    if not self._loopback_only():
                         return
                     import sys
                     import traceback

@@ -76,6 +76,17 @@ class Options:
     # the next tick drains it -- the production default; False pins every
     # tick to the synchronous dispatch+barrier path
     pipelined_scheduling: bool = True
+    # scheduling-tick tracing (karpenter_tpu/tracing.py): span trees per
+    # sweep + the slow-tick flight recorder behind /debug/traces. Default
+    # ON with sampling -- the no-op path is one attribute check per span
+    # site, and overhead at full sampling measures <2% (bench.py
+    # tracing_overhead_pct), so sampled-on is safe as a default.
+    tracing: bool = True
+    tracing_sample: float = 0.2
+    # flight-recorder knobs: retain span trees whose root (one full sweep)
+    # ran longer than tracing_slow_ms, up to tracing_capacity trees
+    tracing_slow_ms: float = 1000.0
+    tracing_capacity: int = 32
     feature_gates: dict = field(default_factory=lambda: {"ReservedCapacity": True, "SpotToSpotConsolidation": False})
 
 
@@ -92,6 +103,22 @@ class Operator:
     ):
         self.clock = clock or Clock()
         self.options = options or Options()
+        # the process-global tracer mirrors the metrics registry: one
+        # sampled span tree per sweep, slow trees retained by the flight
+        # recorder (served at /debug/traces). Tracer config is PROCESS
+        # policy, not per-operator state: the last-constructed Operator's
+        # Options win (same as the one /metrics registry), and stopping
+        # an operator does not restore prior settings -- tests that need
+        # specific tracer state configure TRACER explicitly after
+        # building their Operator.
+        from karpenter_tpu import tracing
+
+        tracing.TRACER.configure(
+            enabled=self.options.tracing,
+            sample=self.options.tracing_sample,
+            slow_ms=self.options.tracing_slow_ms,
+            capacity=self.options.tracing_capacity,
+        )
         self.cloud = cloud or FakeCloud(clock=self.clock)
         # the coordination bus: the in-memory store by default; pass a
         # karpenter_tpu.kube.KubeCluster to run against a real apiserver
@@ -208,24 +235,32 @@ class Operator:
         binding -> post-launch bookkeeping -> drain/teardown -> GC."""
         if self.elector is not None and not self.elector.tick():
             return False  # standby replica: watch-only until the lease is won
-        self.nodeclass_controller.reconcile_all()
-        self.instance_type_refresh.reconcile()
-        self.pricing_refresh.reconcile()
-        self.version_controller.reconcile()
-        self.capacity_type_controller.reconcile_all()
-        self.reservation_expiration.reconcile_all()
-        self.interruption.reconcile()
-        self.repair.reconcile()
-        self.provisioner.reconcile()
-        self.nodeclaim_lifecycle.reconcile_all()
-        self.lifecycle.step()
-        self.binder.reconcile()
-        self.tagging.reconcile_all()
-        self.discovered_capacity.reconcile_all()
-        self.disruption.reconcile()
-        self.termination.reconcile_all()
-        self.garbage_collection.reconcile()
-        self.metrics_controller.reconcile_all()
+        from karpenter_tpu import tracing
+
+        # the sweep is the trace ROOT: every controller's spans (the
+        # provisioner's drain/snapshot/dispatch/launch, the binder's bind,
+        # the disruption pass, batcher windows, solver + wire stages) nest
+        # under one "tick" tree, and the flight recorder judges slowness
+        # against the whole sweep
+        with tracing.trace("tick"):
+            self.nodeclass_controller.reconcile_all()
+            self.instance_type_refresh.reconcile()
+            self.pricing_refresh.reconcile()
+            self.version_controller.reconcile()
+            self.capacity_type_controller.reconcile_all()
+            self.reservation_expiration.reconcile_all()
+            self.interruption.reconcile()
+            self.repair.reconcile()
+            self.provisioner.reconcile()
+            self.nodeclaim_lifecycle.reconcile_all()
+            self.lifecycle.step()
+            self.binder.reconcile()
+            self.tagging.reconcile_all()
+            self.discovered_capacity.reconcile_all()
+            self.disruption.reconcile()
+            self.termination.reconcile_all()
+            self.garbage_collection.reconcile()
+            self.metrics_controller.reconcile_all()
         return True
 
     def settle(self, max_ticks: int = 20, step_seconds: float = 3.0) -> int:

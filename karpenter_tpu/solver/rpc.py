@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from karpenter_tpu import tracing
 from karpenter_tpu.solver import encode, ffd
 
 TOKEN_ENV = "KARPENTER_TPU_SOLVER_TOKEN"
@@ -249,6 +250,12 @@ class SolverServer:
     # -- ops ----------------------------------------------------------------
     def _dispatch(self, sock, header: dict, tensors: Dict[str, np.ndarray]) -> None:
         op = header.get("op")
+        # trace propagation (tracing.py): a request carrying a "trace"
+        # context gets its server-side stages timed and ECHOED in the
+        # reply header, so the client can graft them into the dispatching
+        # tick's span tree; untraced requests pay nothing and the reply
+        # is byte-identical to the pre-tracing protocol
+        wt = tracing.WireTrace(header.get("trace"))
         try:
             if op == "ping":
                 # features lets a NEWER client decide whether semantics it
@@ -257,13 +264,13 @@ class SolverServer:
                 # back -- e.g. taint-gated merged batches to the oracle
                 # (service._try_solve_merged) rather than silently packing
                 # without the join_allowed gate
-                _send_frame(sock, {"ok": True, "features": ["join_allowed"]})
+                _send_frame(sock, {"ok": True, "features": ["join_allowed", "trace_echo"]})
             elif op == "stage":
                 self._op_stage(sock, header, tensors)
             elif op == "solve":
-                self._op_solve(sock, header, tensors)
+                self._op_solve(sock, header, tensors, wt)
             elif op == "solve_compact":
-                self._op_solve_compact(sock, header, tensors)
+                self._op_solve_compact(sock, header, tensors, wt)
             else:
                 _send_frame(sock, {"ok": False, "error": f"unknown op {op!r}"})
         except Exception as e:  # noqa: BLE001 -- errors cross the wire
@@ -326,45 +333,64 @@ class SolverServer:
         )
         return entry, inp
 
-    def _op_solve(self, sock, header: dict, t: Dict[str, np.ndarray]) -> None:
+    def _op_solve(self, sock, header: dict, t: Dict[str, np.ndarray],
+                  wt: Optional[tracing.WireTrace] = None) -> None:
         import jax
 
+        wt = wt or tracing.WireTrace(None)
         hit = self._staged_inputs(sock, header, t)
         if hit is None:
             return
         entry, inp = hit
-        out = ffd.ffd_solve(
-            inp, g_max=int(header["g_max"]),
-            word_offsets=entry.offsets, words=entry.words,
-            objective=str(header.get("objective", "price")),
-        )
-        arrays = jax.device_get(tuple(out))
+        with wt.stage("device", op="solve"):
+            out = ffd.ffd_solve(
+                inp, g_max=int(header["g_max"]),
+                word_offsets=entry.offsets, words=entry.words,
+                objective=str(header.get("objective", "price")),
+            )
+            if wt.ctx is not None:
+                # jit dispatch is ASYNC: without a barrier the XLA compute
+                # would block inside device_get and the echo would claim
+                # device~=0, fetch=everything. Traced requests sync here so
+                # the stages attribute honestly; untraced requests keep
+                # the overlapped dispatch->fetch path untouched.
+                jax.block_until_ready(out)
+        with wt.stage("fetch"):
+            arrays = jax.device_get(tuple(out))
         names = ffd.SolveOutputs._fields
         _send_frame(
-            sock, {"ok": True},
+            sock, {"ok": True, **wt.echo()},
             [(n, np.asarray(a)) for n, a in zip(names, arrays)],
         )
 
-    def _op_solve_compact(self, sock, header: dict, t: Dict[str, np.ndarray]) -> None:
+    def _op_solve_compact(self, sock, header: dict, t: Dict[str, np.ndarray],
+                          wt: Optional[tracing.WireTrace] = None) -> None:
         """The wire-efficient solve: the decision returns as a
         CompactDecision (~50 KB) instead of the dense SolveOutputs
         (~1.5 MB) -- this boundary exists for the TPU-VM topology where the
         link is exactly the bandwidth-poor hop the compact layout is for."""
         import jax
 
+        wt = wt or tracing.WireTrace(None)
         hit = self._staged_inputs(sock, header, t)
         if hit is None:
             return
         entry, inp = hit
-        dec = ffd.ffd_solve_compact(
-            inp, g_max=int(header["g_max"]), nnz_max=int(header["nnz_max"]),
-            word_offsets=entry.offsets, words=entry.words,
-            objective=str(header.get("objective", "price")),
-        )
-        arrays = jax.device_get(tuple(dec))
+        with wt.stage("device", op="solve_compact"):
+            dec = ffd.ffd_solve_compact(
+                inp, g_max=int(header["g_max"]), nnz_max=int(header["nnz_max"]),
+                word_offsets=entry.offsets, words=entry.words,
+                objective=str(header.get("objective", "price")),
+            )
+            if wt.ctx is not None:
+                # see _op_solve: sync traced requests so XLA compute lands
+                # in "device", not "fetch"
+                jax.block_until_ready(dec)
+        with wt.stage("fetch"):
+            arrays = jax.device_get(tuple(dec))
         names = ffd.CompactDecision._fields
         _send_frame(
-            sock, {"ok": True},
+            sock, {"ok": True, **wt.echo()},
             [(n, np.atleast_1d(np.asarray(a))) for n, a in zip(names, arrays)],
         )
 
@@ -509,6 +535,13 @@ class SolverClient:
             "op": "solve_compact", "seqnum": seqnum, "g_max": g_max,
             "nnz_max": nnz_max, "objective": objective,
         }
+        # trace-id propagation: the DISPATCHING tick's context rides the
+        # request header; the server echoes it (plus its stage timings)
+        # in the reply, so the claim side can graft the stages even when
+        # the reply is drained a tick later under a different trace
+        ctx = tracing.TRACER.inject()
+        if ctx is not None:
+            header["trace"] = ctx
         with self._lock:
             if len(self._pending) >= self.MAX_INFLIGHT:
                 raise RuntimeError(
@@ -551,6 +584,10 @@ class SolverClient:
             if err == "unknown-seqnum":
                 raise StaleSeqnumError(err)
             raise RuntimeError(f"solve failed: {err}")
+        # graft the echoed server-side stage spans under the span covering
+        # this claim (the solver's "wire" span); the echo's trace context
+        # links back to the dispatching tick when that differs
+        tracing.TRACER.graft(header)
         fields = {n: out[n] for n in ffd.CompactDecision._fields}
         fields["nnz"] = fields["nnz"].reshape(())
         fields["n_open"] = fields["n_open"].reshape(())
@@ -627,6 +664,9 @@ class SolverClient:
 
     def _solve_op(self, op_header: dict, seqnum: str, catalog, class_set):
         """Shared stage-if-needed + solve + unknown-seqnum retry."""
+        ctx = tracing.TRACER.inject()
+        if ctx is not None:
+            op_header = dict(op_header, trace=ctx)
         with self._lock:  # atomic stage-then-solve (reentrant)
             if seqnum not in self._staged_seqnums:
                 self.stage_catalog(seqnum, catalog)
@@ -639,6 +679,7 @@ class SolverClient:
                     resp, out = self._roundtrip(op_header, tensors)
                 if not resp.get("ok"):
                     raise RuntimeError(f"solve failed: {resp.get('error')}")
+            tracing.TRACER.graft(resp)
             return out
 
     def solve_classes(

@@ -26,7 +26,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from karpenter_tpu import metrics
+from karpenter_tpu import metrics, tracing
 from karpenter_tpu.apis import NodePool, Pod, labels as wk
 from karpenter_tpu.logging import ChangeMonitor, get_logger
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resources
@@ -1123,58 +1123,60 @@ class TPUSolver:
             or spread_mod.soft_zone_tsc(pc.pods[0]) is not None
             for pc in classes
         ):
-            entry0 = self._catalog(instance_types)
-            catalog0 = entry0.tensors
-            pre_set = encode.encode_classes(
-                classes, catalog0, pool_taints=list(pool.template.taints),
-                c_pad=_bucket(len(classes), self.c_pad_min),
-            )
-            compat = encode.compat_matrix(catalog0, pre_set)[: len(classes)]
-            if entry0.col_pools is not None:
-                # merged multi-pool: the oracle derives a spread pod's
-                # zone DOMAINS from its FIRST requirements-compatible
-                # pool's catalog only (oracle._zone_choice; toleration
-                # deliberately not consulted there). Restricting each
-                # spread class's columns to that pool before the split
-                # keeps domains identical -- the joint catalog would
-                # otherwise admit zones only other pools cover (or only a
-                # non-tolerated pool covers), shifting distributions or
-                # stranding pinned pods relative to the oracle.
-                from karpenter_tpu.solver import multipool
+            with tracing.span("spread"):
+                entry0 = self._catalog(instance_types)
+                catalog0 = entry0.tensors
+                pre_set = encode.encode_classes(
+                    classes, catalog0, pool_taints=list(pool.template.taints),
+                    c_pad=_bucket(len(classes), self.c_pad_min),
+                )
+                compat = encode.compat_matrix(catalog0, pre_set)[: len(classes)]
+                if entry0.col_pools is not None:
+                    # merged multi-pool: the oracle derives a spread pod's
+                    # zone DOMAINS from its FIRST requirements-compatible
+                    # pool's catalog only (oracle._zone_choice; toleration
+                    # deliberately not consulted there). Restricting each
+                    # spread class's columns to that pool before the split
+                    # keeps domains identical -- the joint catalog would
+                    # otherwise admit zones only other pools cover (or only a
+                    # non-tolerated pool covers), shifting distributions or
+                    # stranding pinned pods relative to the oracle.
+                    from karpenter_tpu.solver import multipool
 
-                k_real0 = entry0.col_pools.shape[0]
-                for c, pc in enumerate(classes):
-                    if (
-                        spread_mod.hard_zone_tsc(pc.pods[0]) is None
-                        and spread_mod.soft_zone_tsc(pc.pods[0]) is None
-                    ):
-                        continue
-                    pi = multipool.first_compat_pool(pc, entry0.pools)
-                    colmask = np.zeros((compat.shape[1],), dtype=bool)
-                    if pi >= 0:
-                        colmask[:k_real0] = entry0.col_pools == pi
-                    compat[c] &= colmask
-            cap0 = catalog0.cap
-            if overhead_vec is not None:
-                cap0 = np.maximum(cap0 - overhead_vec[None, :], np.float32(0.0))
-            fits_one = np.all(
-                cap0[None, :, :] >= pre_set.req[: len(classes), None, :], axis=-1
-            )
-            split = spread_mod.split_zone_spread(
-                classes, catalog0, list(zones) or list(catalog0.zones), compat, fits_one,
-                seed_counts=spread_seeds, node_overhead=overhead_vec,
-            )
-            classes = split.classes
-            result.unschedulable.update(split.unschedulable)
-            if not classes:
-                return _PendingSolve(done=result)
+                    k_real0 = entry0.col_pools.shape[0]
+                    for c, pc in enumerate(classes):
+                        if (
+                            spread_mod.hard_zone_tsc(pc.pods[0]) is None
+                            and spread_mod.soft_zone_tsc(pc.pods[0]) is None
+                        ):
+                            continue
+                        pi = multipool.first_compat_pool(pc, entry0.pools)
+                        colmask = np.zeros((compat.shape[1],), dtype=bool)
+                        if pi >= 0:
+                            colmask[:k_real0] = entry0.col_pools == pi
+                        compat[c] &= colmask
+                cap0 = catalog0.cap
+                if overhead_vec is not None:
+                    cap0 = np.maximum(cap0 - overhead_vec[None, :], np.float32(0.0))
+                fits_one = np.all(
+                    cap0[None, :, :] >= pre_set.req[: len(classes), None, :], axis=-1
+                )
+                split = spread_mod.split_zone_spread(
+                    classes, catalog0, list(zones) or list(catalog0.zones), compat, fits_one,
+                    seed_counts=spread_seeds, node_overhead=overhead_vec,
+                )
+                classes = split.classes
+                result.unschedulable.update(split.unschedulable)
+                if not classes:
+                    return _PendingSolve(done=result)
 
         # phase 1 (device): pack onto existing capacity first, exactly as the
         # oracle tries existing nodes before opening groups -- the same
         # repack kernel the consolidation evaluator uses (consolidate.py)
         placed_existing = np.zeros((len(classes),), dtype=np.int64)
         if existing_nodes:
-            placed_existing = self._pack_existing(classes, existing_nodes, result)
+            with tracing.span("pack_existing", nodes=len(existing_nodes)):
+                placed_existing = self._pack_existing(classes, existing_nodes, result)
 
         remaining = int(sum(len(pc.pods) for pc in classes) - placed_existing.sum())
         if remaining == 0:
@@ -1186,17 +1188,19 @@ class TPUSolver:
             return _PendingSolve(done=result)
 
         # phase 2 (device): batched FFD over the leftovers
-        entry = self._catalog(instance_types)
-        catalog, staged, offsets, words, seqnum = (
-            entry.tensors, entry.staged, entry.offsets, entry.words, entry.seqnum
-        )
-        class_set = encode.encode_classes(
-            classes,
-            catalog,
-            pool_taints=list(pool.template.taints),
-            c_pad=_bucket(len(classes), self.c_pad_min),
-            node_overhead=overhead_vec,
-        )
+        with tracing.span("encode", classes=len(classes)) as enc_sp:
+            entry = self._catalog(instance_types)
+            catalog, staged, offsets, words, seqnum = (
+                entry.tensors, entry.staged, entry.offsets, entry.words, entry.seqnum
+            )
+            class_set = encode.encode_classes(
+                classes,
+                catalog,
+                pool_taints=list(pool.template.taints),
+                c_pad=_bucket(len(classes), self.c_pad_min),
+                node_overhead=overhead_vec,
+            )
+            enc_sp.set(c_pad=class_set.c_pad)
         if entry.col_pools is not None:
             # merged multi-pool dispatch: opening is restricted to each
             # class's first feasible pool in weight order (the oracle's
@@ -1276,28 +1280,31 @@ class TPUSolver:
             # (the next tick's host stages in the pipelined provisioner).
             # A dispatch-time failure leaves rpc_handle None; the barrier
             # then runs the synchronous wire ladder (reconnect + restage).
-            try:
-                pending.rpc_handle = self.client.begin_solve_compact(
-                    seqnum, catalog, class_set, g_max=self.g_max,
+            with tracing.span("wire_dispatch") as wd_sp:
+                try:
+                    pending.rpc_handle = self.client.begin_solve_compact(
+                        seqnum, catalog, class_set, g_max=self.g_max,
+                        objective=self.objective,
+                    )
+                except (ConnectionError, OSError) as e:
+                    wd_sp.set(dispatch_error=f"{type(e).__name__}: {e}"[:200])
+                    pending.rpc_handle = None
+        else:
+            with tracing.span("dispatch_device"):
+                inp = ffd.make_inputs_staged(staged, class_set)
+                # fused compact decision: the whole result in ONE ~140 KB u32
+                # buffer instead of 7 arrays (the tunnel serializes per-array
+                # copies at ~5 ms each), fetched with ONE async copy issued at
+                # dispatch time -- a synchronous fetch costs ~64 ms RTT flat,
+                # but a copy enqueued now streams back as soon as the result
+                # exists and the later read drains in <1 ms
+                nnz_max = ffd.nnz_budget(class_set.c_pad, self.g_max)
+                buf = ffd.ffd_solve_fused(
+                    inp, g_max=self.g_max, nnz_max=nnz_max,
+                    word_offsets=offsets, words=words,
                     objective=self.objective,
                 )
-            except (ConnectionError, OSError):
-                pending.rpc_handle = None
-        else:
-            inp = ffd.make_inputs_staged(staged, class_set)
-            # fused compact decision: the whole result in ONE ~140 KB u32
-            # buffer instead of 7 arrays (the tunnel serializes per-array
-            # copies at ~5 ms each), fetched with ONE async copy issued at
-            # dispatch time -- a synchronous fetch costs ~64 ms RTT flat,
-            # but a copy enqueued now streams back as soon as the result
-            # exists and the later read drains in <1 ms
-            nnz_max = ffd.nnz_budget(class_set.c_pad, self.g_max)
-            buf = ffd.ffd_solve_fused(
-                inp, g_max=self.g_max, nnz_max=nnz_max,
-                word_offsets=offsets, words=words,
-                objective=self.objective,
-            )
-            buf.copy_to_host_async()
+                buf.copy_to_host_async()
             pending.buf = buf
             pending.inp = inp
             pending.nnz_max = nnz_max
@@ -1338,25 +1345,36 @@ class TPUSolver:
                     seqnum=entry.seqnum,
                 )
             metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="catalog-changed")
+            # the fallback reason lands on the span already covering this
+            # barrier (the provisioner's "drain"), so the re-solve's spans
+            # stay in the SAME tree instead of orphaning a half-trace
+            tracing.annotate(fallback="catalog-changed")
             return self.solve(*pending.call_args, **pending.call_kwargs)
         if self.client is not None:
-            dense = self._finish_remote(pending)
+            with tracing.span("wire"):
+                # the echoed server-side stages ("device", "fetch") graft
+                # under this span when the reply carries them (rpc.py)
+                dense = self._finish_remote(pending)
         else:
+            with tracing.span("device"):
+                host_buf = np.asarray(pending.buf)
             dense = ffd.expand_fused(
-                np.asarray(pending.buf), class_set.c_pad, self.g_max,
+                host_buf, class_set.c_pad, self.g_max,
                 entry.tensors.k_pad, encode.Z_PAD, encode.CT, pending.nnz_max,
             )
             if dense is None:
                 # sparse budget overflow (placements not near-diagonal):
                 # refetch the dense decision -- correctness over latency
-                dense = ffd.solve_dense_tuple(
-                    pending.inp, g_max=self.g_max, word_offsets=entry.offsets,
-                    words=entry.words, objective=self.objective,
-                )
-        return self._decode(
-            pending.pool, entry, class_set, dense, pending.nodepool_usage,
-            result=pending.result, class_offset=pending.placed_existing,
-        )
+                with tracing.span("device", refetch="dense"):
+                    dense = ffd.solve_dense_tuple(
+                        pending.inp, g_max=self.g_max, word_offsets=entry.offsets,
+                        words=entry.words, objective=self.objective,
+                    )
+        with tracing.span("decode"):
+            return self._decode(
+                pending.pool, entry, class_set, dense, pending.nodepool_usage,
+                result=pending.result, class_offset=pending.placed_existing,
+            )
 
     def _finish_remote(self, pending: "_PendingSolve"):
         """Claim (or re-run) the wire solve and return the dense decode
@@ -1378,9 +1396,11 @@ class TPUSolver:
                 # silently restaging mid-pipeline; the synchronous op
                 # below restages and retries
                 metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="stale-seqnum")
+                tracing.annotate(fallback="stale-seqnum")
                 dec = None
             except (ConnectionError, OSError):
                 metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="rpc-degraded")
+                tracing.annotate(fallback="rpc-degraded")
                 dec = None
             except RuntimeError as e:
                 if "unknown op" not in str(e):
@@ -1389,6 +1409,7 @@ class TPUSolver:
                 # not crash every sustained tick -- drop to the ladder
                 # below, whose dense op it does speak
                 metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="rpc-degraded")
+                tracing.annotate(fallback="rpc-degraded")
                 dec = None
         dense = None
         overflow = False
@@ -1415,6 +1436,7 @@ class TPUSolver:
                 dense = None
         if dense is None:
             # sparse budget overflow / no compact op: dense refetch
+            tracing.annotate(wire_path="dense")
             out = self.client.solve_classes(
                 seqnum, catalog, class_set, g_max=self.g_max, objective=self.objective
             )

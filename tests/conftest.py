@@ -44,6 +44,19 @@ def pytest_collection_modifyitems(config, items):
         random.Random(int(seed)).shuffle(items)
 
 
+def find_span(tree: dict, name: str):
+    """First node named `name` in a dumped span tree (depth-first), or
+    None -- shared by the tracing/pipeline/rpc suites so the tree shape
+    is interpreted in ONE place."""
+    if tree.get("name") == name:
+        return tree
+    for c in tree.get("children", ()):
+        hit = find_span(c, name)
+        if hit is not None:
+            return hit
+    return None
+
+
 def spot_interruption_body(iid: str) -> str:
     """Canonical EventBridge-shaped spot-interruption payload, shared by
     the resilience, soak, and interruption-bench suites so the literal

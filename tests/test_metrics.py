@@ -1,0 +1,105 @@
+"""Metrics registry tests: Prometheus exposition escaping, canonical `le`
+floats, the percentile()-vs-observe() race, and the generated-doc drift
+gate (docs/metrics.md must match the live registry -- the tier-1 twin of
+`hack/metrics_gen.py --check`)."""
+import importlib.util
+import math
+import pathlib
+import threading
+
+from karpenter_tpu.metrics import Registry, _canonical_float, _labels_str
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+class TestExpositionEscaping:
+    def test_label_values_escape_quote_backslash_newline(self):
+        # a nodepool name with any of these would otherwise emit invalid
+        # exposition text the scraper rejects wholesale
+        out = _labels_str(("nodepool",), ('a"b\\c\nd',))
+        assert out == '{nodepool="a\\"b\\\\c\\nd"}'
+        assert "\n" not in out
+
+    def test_expose_round_trips_hostile_label(self):
+        reg = Registry()
+        g = reg.gauge("test_hostile_gauge", "h", labels=("np",))
+        g.set(1.0, np='pool"with\\meta\nchars')
+        text = reg.expose()
+        line = next(l for l in text.splitlines() if l.startswith("test_hostile_gauge{"))
+        # one physical line, escaped per the exposition format
+        assert line == 'test_hostile_gauge{np="pool\\"with\\\\meta\\nchars"} 1.0'
+
+    def test_le_buckets_are_canonical_floats(self):
+        reg = Registry()
+        h = reg.histogram("test_le_hist", "h", buckets=(0.001, 1, 2.5))
+        h.observe(0.5)
+        text = reg.expose()
+        assert 'le="0.001"' in text
+        assert 'le="1"' in text       # not repr-style "1" vs "1.0" drift
+        assert 'le="2.5"' in text
+        assert 'le="+Inf"' in text
+
+    def test_canonical_float_forms(self):
+        assert _canonical_float(1) == "1"
+        assert _canonical_float(0.001) == "0.001"
+        assert _canonical_float(2.5) == "2.5"
+        assert _canonical_float(10.0) == "10"
+
+
+class TestHistogramPercentile:
+    def test_percentile_values(self):
+        reg = Registry()
+        h = reg.histogram("test_pct", "h")
+        for v in range(1, 101):
+            h.observe(float(v))
+        assert h.percentile(50) == 50.0
+        assert h.percentile(99) == 99.0
+        assert math.isnan(reg.histogram("test_pct_empty", "h").percentile(50))
+
+    def test_percentile_concurrent_with_observe(self):
+        """The scrape-vs-mutate hazard: observe() appends to and HALVES
+        the sample list from controller threads while percentile() reads
+        it. The snapshot-under-lock fix must keep every concurrent read
+        well-formed (no IndexError/ValueError, result inside the observed
+        range)."""
+        reg = Registry()
+        h = reg.histogram("test_pct_race", "h")
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            v = 0
+            while not stop.is_set():
+                v += 1
+                h.observe(float(v % 1000))
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            for _ in range(300):
+                p = h.percentile(99)
+                if not math.isnan(p):
+                    if not (0.0 <= p <= 1000.0):
+                        errors.append(p)
+        finally:
+            stop.set()
+            t.join(timeout=5)
+        assert not errors
+
+
+class TestGeneratedDocDrift:
+    def test_metrics_doc_matches_registry(self):
+        """docs/metrics.md is generated from the live registry; a new
+        metric family registered without regenerating the doc must fail
+        tier-1, not drift silently (the CI gate `make docs-check` runs
+        the same comparison)."""
+        spec = importlib.util.spec_from_file_location(
+            "metrics_gen", ROOT / "hack" / "metrics_gen.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        doc = (ROOT / "docs" / "metrics.md").read_text()
+        assert doc == mod.render(), (
+            "docs/metrics.md is stale relative to the metric registry; "
+            "run `python hack/metrics_gen.py`"
+        )

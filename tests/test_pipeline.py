@@ -313,6 +313,178 @@ class TestMidFlightTransitions:
             srv.stop()
 
 
+from tests.conftest import find_span as _find_span  # noqa: E402
+
+
+class TestPipelinedTracing:
+    """Observability satellite: a pipelined solve that falls back
+    mid-flight (catalog-changed, stale-seqnum, rpc-degraded) must still
+    produce ONE coherent span tree with the fallback reason as a span
+    attribute -- never an orphaned half-trace."""
+
+    @staticmethod
+    def _tracing_on():
+        from karpenter_tpu import tracing
+
+        tracing.TRACER.configure(enabled=True, sample=1.0, slow_ms=1e12)
+        tracing.TRACER.reset()
+        return tracing
+
+    @staticmethod
+    def _tracing_off():
+        from karpenter_tpu import tracing
+
+        tracing.TRACER.configure(enabled=False)
+        tracing.TRACER.reset()
+
+    def test_catalog_changed_fallback_annotates_the_barrier_span(self, catalog_items):
+        pool = NodePool("default")
+        zones = _zones(catalog_items)
+        pods = _random_batch(zones, 31)
+        solver = TPUSolver(g_max=256)
+        tracing = self._tracing_on()
+        try:
+            with tracing.TRACER.trace("tick-A"):
+                ticket = solver.solve_begin(pool, catalog_items, list(pods))
+            with solver._lock:
+                solver._catalog_cache.pop(id(catalog_items))
+            with tracing.TRACER.trace("tick-B") as b:
+                with tracing.TRACER.span("drain"):
+                    solver.solve_finish(ticket)
+            tree = b.to_dict()
+            drain = _find_span(tree, "drain")
+            assert drain["attributes"]["fallback"] == "catalog-changed"
+            # the re-solve's spans nest under the SAME tree (one trace id
+            # throughout), not a fork
+            assert _find_span(drain, "encode") is not None
+            assert _find_span(drain, "decode") is not None
+        finally:
+            self._tracing_off()
+
+    def test_stale_seqnum_fallback_one_coherent_tree(self, catalog_items):
+        """Sidecar forgets the catalog mid-flight: the ladder's restage +
+        retry must land in the claiming tick's tree, with the reason on
+        the wire span."""
+        from karpenter_tpu.solver.rpc import SolverClient, SolverServer
+
+        srv = SolverServer("127.0.0.1", 0, insecure_tcp=True).start()
+        client = SolverClient(*srv.address)
+        client.token = None
+        tracing = self._tracing_on()
+        try:
+            pool = NodePool("default")
+            zones = _zones(catalog_items)
+            solver = TPUSolver(g_max=128, client=client)
+            solver.solve(pool, catalog_items, _random_batch(zones, 3, n_templates=3))
+            with srv._lock:
+                srv._staged.clear()
+            pods = _random_batch(zones, 32)
+            with tracing.TRACER.trace("tick-A") as a:
+                ticket = solver.solve_begin(pool, catalog_items, list(pods))
+            assert not ticket.completed
+            with tracing.TRACER.trace("tick-B") as b:
+                with tracing.TRACER.span("drain"):
+                    solver.solve_finish(ticket)
+            tree = b.to_dict()
+            wire = _find_span(tree, "wire")
+            assert wire["attributes"]["fallback"] == "stale-seqnum"
+            # the retry's server stages grafted into the SAME tree
+            dev = _find_span(wire, "device")
+            assert dev is not None and dev["trace_id"] == b.trace_id
+            assert _find_span(tree, "decode") is not None
+            # nothing grafted into the dispatch tick's tree as an orphan
+            assert _find_span(a.to_dict(), "device") is None
+        finally:
+            self._tracing_off()
+            client.close()
+            srv.stop()
+
+    def test_connection_loss_fallback_one_coherent_tree(self, catalog_items):
+        import socket as socket_mod
+
+        from karpenter_tpu.solver.rpc import SolverClient, SolverServer
+
+        srv = SolverServer("127.0.0.1", 0, insecure_tcp=True).start()
+        client = SolverClient(*srv.address)
+        client.token = None
+        tracing = self._tracing_on()
+        try:
+            pool = NodePool("default")
+            zones = _zones(catalog_items)
+            solver = TPUSolver(g_max=128, client=client)
+            solver.solve(pool, catalog_items, _random_batch(zones, 4, n_templates=3))
+            pods = _random_batch(zones, 33)
+            with tracing.TRACER.trace("tick-A"):
+                ticket = solver.solve_begin(pool, catalog_items, list(pods))
+            assert not ticket.completed
+            client._sock.shutdown(socket_mod.SHUT_RDWR)
+            with tracing.TRACER.trace("tick-B") as b:
+                with tracing.TRACER.span("drain"):
+                    solver.solve_finish(ticket)
+            wire = _find_span(b.to_dict(), "wire")
+            assert wire["attributes"]["fallback"] == "rpc-degraded"
+            dev = _find_span(wire, "device")
+            assert dev is not None and dev["trace_id"] == b.trace_id
+        finally:
+            self._tracing_off()
+            client.close()
+            srv.stop()
+
+    def test_double_buffered_rig_records_overlap_fraction(self):
+        """The provisioner's pipelined tick records the overlap fraction
+        (device time hidden under the sweep) as both a drain-span
+        attribute and the karpenter_scheduler_pipeline_overlap_fraction
+        histogram."""
+        import math
+
+        from karpenter_tpu import metrics
+        from karpenter_tpu.apis import NodeClaim  # noqa: F401 (rig warm)
+        from karpenter_tpu.operator import Operator, Options
+
+        op = Operator(
+            clock=FakeClock(50_000.0),
+            solver=TPUSolver(g_max=256),
+            options=Options(
+                pipelined_scheduling=True, tracing=True,
+                tracing_sample=1.0, tracing_slow_ms=0.0,
+            ),
+        )
+        from karpenter_tpu import tracing
+
+        tracing.TRACER.reset()
+        try:
+            op.cluster.create(TPUNodeClass("default"))
+            op.cluster.create(NodePool("default"))
+            overlap_before = metrics.PIPELINE_OVERLAP._totals.get((), 0)
+            engaged = False
+            for tick in range(6):
+                for i in range(40):
+                    op.cluster.create(Pod(
+                        f"tr{tick}-{i}",
+                        requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                    ))
+                op.tick()
+                engaged = engaged or op.provisioner._inflight is not None
+                op.clock.step(3.0)
+            op.settle(max_ticks=30)
+            assert engaged, "pipeline never engaged"
+            assert metrics.PIPELINE_OVERLAP._totals.get((), 0) > overlap_before
+            assert not math.isnan(metrics.PIPELINE_OVERLAP.percentile(50))
+            # some recorded sweep tree carries the drain span with the
+            # overlap attribution
+            dump = tracing.TRACER.recorder.dump()
+            drains = [
+                _find_span(t, "drain") for t in dump["slow"]
+                if _find_span(t, "drain") is not None
+            ]
+            assert drains, "no recorded tree contains a drain span"
+            assert any(
+                "overlap_fraction" in d["attributes"] for d in drains
+            )
+        finally:
+            self._tracing_off()
+
+
 class TestProvisionerDoubleBuffer:
     """The double-buffered tick on the kwok rig: sustained arrivals engage
     the pipeline (decision dispatched one tick, drained + launched the

@@ -1,7 +1,9 @@
 """Solver RPC boundary tests: framing, staging contract, differential
-equivalence remote-vs-in-process, and the full provisioner loop running
-against the sidecar (SURVEY.md section 2.4's deployment seam)."""
+equivalence remote-vs-in-process, trace-id propagation, and the full
+provisioner loop running against the sidecar (SURVEY.md section 2.4's
+deployment seam)."""
 import json
+from contextlib import contextmanager
 
 import numpy as np
 import pytest
@@ -394,6 +396,103 @@ class TestRequestPipelining:
         c.close()
         with pytest.raises(ConnectionError):
             c.finish_solve_compact(h)
+
+
+class TestTracePropagation:
+    """Trace-id propagation across the wire (the observability PR): the
+    client injects the dispatching tick's context, the server echoes its
+    stage timings, and the client grafts them into the live span tree --
+    including when the reply is claimed a tick after its dispatch."""
+
+    @staticmethod
+    def _encoded(catalog_items, pods):
+        pool = NodePool("default")
+        catalog = encode.encode_catalog(catalog_items)
+        classes = encode.group_pods(pods, extra_requirements=pool.requirements())
+        cs = encode.encode_classes(classes, catalog, c_pad=encode.bucket(len(classes), 16))
+        return catalog, cs
+
+    @staticmethod
+    def _find(tree, name):
+        from tests.conftest import find_span
+
+        return find_span(tree, name)
+
+    @contextmanager
+    def _tracing(self):
+        from karpenter_tpu import tracing
+
+        prev = (tracing.TRACER.enabled, tracing.TRACER.sample,
+                tracing.TRACER.recorder.slow_ms)
+        tracing.TRACER.configure(enabled=True, sample=1.0, slow_ms=1e12)
+        tracing.TRACER.reset()
+        try:
+            yield tracing
+        finally:
+            tracing.TRACER.configure(enabled=prev[0], sample=prev[1],
+                                     slow_ms=prev[2])
+            tracing.TRACER.reset()
+
+    def test_server_advertises_trace_echo(self, client):
+        assert "trace_echo" in client.features()
+
+    def test_sync_solve_grafts_server_stages(self, client, catalog_items):
+        catalog, cs = self._encoded(catalog_items, make_pods(5))
+        with self._tracing() as tracing:
+            with tracing.TRACER.trace("tick") as root:
+                with tracing.TRACER.span("wire"):
+                    client.solve_classes_compact("trace-sync", catalog, cs, g_max=32)
+            tree = root.to_dict()
+            wire = self._find(tree, "wire")
+            dev = self._find(wire, "device")
+            fetch = self._find(wire, "fetch")
+            assert dev is not None and fetch is not None
+            assert dev["attributes"]["remote"] is True
+            # same-trace graft: no origin link needed
+            assert "origin_trace_id" not in dev["attributes"]
+            assert dev["trace_id"] == root.trace_id
+            # grafted stages feed the per-stage stats (the bench breakdown)
+            assert tracing.TRACER.stats()["device"]["count"] >= 1
+
+    def test_pipelined_reply_claimed_later_links_origin(self, client, catalog_items):
+        """The 2-in-flight shape: dispatched under tick A's trace, claimed
+        under tick B's -- the grafted server stages land in B's tree with
+        an explicit origin link back to A (no orphaned half-trace)."""
+        catalog, cs = self._encoded(catalog_items, make_pods(6))
+        with self._tracing() as tracing:
+            with tracing.TRACER.trace("tick-A") as a:
+                h = client.begin_solve_compact("trace-pipe", catalog, cs, g_max=32)
+            with tracing.TRACER.trace("tick-B") as b:
+                with tracing.TRACER.span("drain"):
+                    client.finish_solve_compact(h)
+            dev = self._find(b.to_dict(), "device")
+            assert dev is not None
+            assert dev["attributes"]["origin_trace_id"] == a.trace_id
+            assert dev["attributes"]["origin_span_id"] == a.span_id
+            # B's tree is coherent: the graft hangs under B's drain span
+            assert self._find(self._find(b.to_dict(), "drain"), "device") is not None
+
+    def test_untraced_request_gets_untraced_reply(self, server, client, catalog_items):
+        """No trace context on the request -> the reply header is
+        byte-compatible with the pre-tracing protocol (no echo fields)."""
+        from karpenter_tpu.solver import ffd
+        from karpenter_tpu.solver.rpc import _recv_frame, _send_frame
+
+        catalog, cs = self._encoded(catalog_items, make_pods(4))
+        # stage through the normal client (shared server-side LRU) ...
+        client.stage_catalog("trace-untraced", catalog)
+        # ... then a raw solve frame WITHOUT a trace header
+        sock = authed_raw_socket(server)
+        _send_frame(
+            sock,
+            {"op": "solve_compact", "seqnum": "trace-untraced", "g_max": 32,
+             "nnz_max": ffd.nnz_budget(cs.c_pad, 32)},
+            SolverClient._class_tensors(cs),
+        )
+        header, _ = _recv_frame(sock)
+        sock.close()
+        assert header["ok"] is True
+        assert "spans" not in header and "trace" not in header
 
 
 class TestRPCSecurity:

@@ -1,0 +1,345 @@
+"""Tracing subsystem tests (karpenter_tpu/tracing.py): span trees,
+thread-local nesting, sampling, the zero-cost disabled path, the slow-tick
+flight recorder, wire-echo grafting, the /debug/traces route, and the
+operator sweep's span tree over the kwok rig."""
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import tracing
+
+
+@pytest.fixture()
+def tracer():
+    """A private tracer per test: the process-global TRACER is left alone
+    (operator tests configure it deliberately)."""
+    return tracing.Tracer(enabled=True, sample=1.0, slow_ms=1e12)
+
+
+from tests.conftest import find_span as find  # noqa: E402
+
+
+class TestSpanTrees:
+    def test_nesting_attaches_to_thread_local_current(self, tracer):
+        with tracer.trace("root") as root:
+            with tracer.span("a") as a:
+                with tracer.span("b"):
+                    pass
+            with tracer.span("c"):
+                pass
+        assert [c.name for c in root.children] == ["a", "c"]
+        assert [c.name for c in a.children] == ["b"]
+        assert root.trace_id == a.trace_id
+        assert a.parent_id == root.span_id
+        assert root.end is not None and root.end >= root.start
+
+    def test_span_without_active_trace_is_noop(self, tracer):
+        sp = tracer.span("orphan")
+        assert sp is tracing.NOOP
+        with sp:  # usable as a context manager, records nothing
+            sp.set(x=1)
+        assert tracer.stats() == {}
+
+    def test_disabled_trace_is_noop_and_free_of_children(self, tracer):
+        tracer.configure(enabled=False)
+        with tracer.trace("root") as root:
+            with tracer.span("child"):
+                pass
+        assert root is tracing.NOOP
+        assert tracer.stats() == {}
+
+    def test_sampling_gates_stats_not_the_tree(self, tracer):
+        """Tail-biased sampling: an unsampled tick still BUILDS its tree
+        (so the flight recorder can judge it) but feeds no stats/metrics
+        volume; a sampled tick feeds both."""
+        tracer.configure(sample=0.5, rng=lambda: 0.9)
+        with tracer.trace("t") as sp:
+            with tracer.span("child"):
+                pass
+        assert isinstance(sp, tracing.Span) and sp.sampled is False
+        assert [c.name for c in sp.children] == ["child"]
+        assert tracer.stats() == {}  # unsampled: no stats volume
+        tracer.configure(rng=lambda: 0.1)
+        with tracer.trace("t") as sp:
+            assert sp.sampled is True
+        assert "t" in tracer.stats()
+
+    def test_unsampled_slow_tick_still_hits_the_flight_recorder(self):
+        """The point of tail-biased retention: a slow tick must never be
+        invisible to /debug/traces because of an unlucky sample draw."""
+        ticks = iter([0.0, 10.0])
+        tracer = tracing.Tracer(enabled=True, sample=0.0, slow_ms=100.0,
+                                clock=lambda: next(ticks), rng=lambda: 0.99)
+        with tracer.trace("slow-unsampled"):
+            pass
+        dump = tracer.recorder.dump()
+        assert [t["name"] for t in dump["slow"]] == ["slow-unsampled"]
+        assert dump["worst"]["name"] == "slow-unsampled"
+        assert tracer.stats() == {}  # stats volume still gated by sampling
+
+    def test_nested_trace_becomes_child(self, tracer):
+        """A trace() under an active trace (e.g. a helper that also roots)
+        attaches as a child instead of forking a second tree."""
+        with tracer.trace("outer") as outer:
+            with tracer.trace("inner") as inner:
+                pass
+        assert inner in outer.children
+        assert inner.trace_id == outer.trace_id
+
+    def test_injectable_clock_and_durations(self):
+        ticks = iter([10.0, 11.0, 14.0, 20.0])
+        tracer = tracing.Tracer(enabled=True, sample=1.0, clock=lambda: next(ticks))
+        with tracer.trace("root") as root:
+            with tracer.span("child") as child:
+                pass
+        assert child.start == 11.0 and child.end == 14.0
+        assert root.to_dict()["duration_ms"] == 10_000.0
+        assert find(root.to_dict(), "child")["start_ms"] == 1000.0
+
+    def test_exception_lands_as_error_attribute(self, tracer):
+        with pytest.raises(ValueError):
+            with tracer.trace("root") as root:
+                raise ValueError("boom")
+        assert "ValueError: boom" in root.attributes["error"]
+
+    def test_thread_local_isolation(self, tracer):
+        """A span started on another thread must not attach to this
+        thread's trace (each thread has its own current-span context)."""
+        got = []
+
+        def other():
+            got.append(tracer.span("cross-thread"))
+
+        with tracer.trace("root") as root:
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+        assert got == [tracing.NOOP]
+        assert root.children == []
+
+    def test_annotate_sets_attrs_on_current(self, tracer):
+        with tracer.trace("root") as root:
+            tracer.annotate(fallback="stale-seqnum")
+        assert root.attributes["fallback"] == "stale-seqnum"
+        tracer.annotate(ignored=True)  # no current span: no-op
+
+
+class TestFlightRecorder:
+    def test_slow_threshold_and_worst_ever(self):
+        ticks = iter([0.0, 0.010, 100.0, 100.5, 200.0, 200.020])
+        tracer = tracing.Tracer(enabled=True, sample=1.0, slow_ms=100.0,
+                                clock=lambda: next(ticks))
+        with tracer.trace("fast"):
+            pass  # 10ms: below threshold
+        with tracer.trace("slow"):
+            pass  # 500ms: retained
+        with tracer.trace("fast2"):
+            pass  # 20ms: below threshold, not the worst
+        dump = tracer.recorder.dump()
+        assert [t["name"] for t in dump["slow"]] == ["slow"]
+        assert dump["worst"]["name"] == "slow"
+        assert dump["threshold_ms"] == 100.0
+
+    def test_worst_kept_even_under_threshold(self):
+        ticks = iter([0.0, 0.010])
+        tracer = tracing.Tracer(enabled=True, sample=1.0, slow_ms=1e12,
+                                clock=lambda: next(ticks))
+        with tracer.trace("only"):
+            pass
+        dump = tracer.recorder.dump()
+        assert dump["slow"] == []
+        assert dump["worst"]["name"] == "only"  # worst-ever, threshold or not
+
+    def test_ring_capacity(self):
+        t = [0.0]
+
+        def clock():
+            t[0] += 1.0
+            return t[0]
+
+        tracer = tracing.Tracer(enabled=True, sample=1.0, slow_ms=0.0,
+                                capacity=3, clock=clock)
+        for i in range(5):
+            with tracer.trace(f"t{i}"):
+                pass
+        dump = tracer.recorder.dump()
+        assert [x["name"] for x in dump["slow"]] == ["t2", "t3", "t4"]
+
+    def test_reset_clears(self, tracer):
+        tracer.configure(slow_ms=0.0)
+        with tracer.trace("t"):
+            pass
+        tracer.reset()
+        assert tracer.recorder.dump()["worst"] is None
+        assert tracer.stats() == {}
+
+
+class TestStats:
+    def test_per_name_percentiles(self):
+        ticks = iter(x for pair in [(0.0, 0.010), (0.0, 0.020), (0.0, 0.030)]
+                     for x in pair)
+        tracer = tracing.Tracer(enabled=True, sample=1.0, slow_ms=1e12,
+                                clock=lambda: next(ticks))
+        for _ in range(3):
+            with tracer.trace("solve"):
+                pass
+        st = tracer.stats()["solve"]
+        assert st["count"] == 3
+        assert st["p50_ms"] == 20.0
+        assert st["p99_ms"] == 30.0
+
+
+class TestWireEcho:
+    def test_wiretrace_stages_and_echo(self):
+        t = [0.0]
+
+        def clock():
+            t[0] += 0.005
+            return t[0]
+
+        wt = tracing.WireTrace({"trace_id": "T1", "span_id": "S1"}, clock=clock)
+        with wt.stage("device", op="solve_compact"):
+            pass
+        with wt.stage("fetch"):
+            pass
+        echo = wt.echo()
+        assert echo["trace"] == {"trace_id": "T1", "span_id": "S1"}
+        assert [s["name"] for s in echo["spans"]] == ["device", "fetch"]
+        assert echo["spans"][0]["attrs"] == {"op": "solve_compact"}
+        assert echo["spans"][0]["dur_ms"] == 5.0
+
+    def test_wiretrace_without_context_is_silent(self):
+        wt = tracing.WireTrace(None)
+        with wt.stage("device"):
+            pass
+        assert wt.echo() == {}
+
+    def test_graft_same_trace(self, tracer):
+        with tracer.trace("tick") as root:
+            with tracer.span("wire") as wire:
+                tracer.graft({
+                    "trace": {"trace_id": root.trace_id, "span_id": wire.span_id},
+                    "spans": [{"name": "device", "start_ms": 1.0, "dur_ms": 2.0}],
+                })
+        dev = find(root.to_dict(), "device")
+        assert dev is not None
+        assert dev["attributes"]["remote"] is True
+        assert "origin_trace_id" not in dev["attributes"]
+        assert "device" in tracer.stats()  # grafted stages feed the stats
+
+    def test_graft_links_origin_trace_when_claimed_later(self, tracer):
+        """The pipelined shape: dispatched under trace A, reply claimed
+        under trace B -- the grafted spans must link back to A."""
+        with tracer.trace("tick-A") as a:
+            origin = {"trace_id": a.trace_id, "span_id": a.span_id}
+        with tracer.trace("tick-B") as b:
+            with tracer.span("drain"):
+                tracer.graft({
+                    "trace": origin,
+                    "spans": [{"name": "device", "start_ms": 0.0, "dur_ms": 1.0}],
+                })
+        dev = find(b.to_dict(), "device")
+        assert dev["attributes"]["origin_trace_id"] == a.trace_id
+        assert dev["attributes"]["origin_span_id"] == a.span_id
+
+    def test_graft_tolerates_malformed_echo(self, tracer):
+        with tracer.trace("tick") as root:
+            tracer.graft({"spans": [{"nope": 1}, {"name": "ok", "dur_ms": "x"}]})
+            tracer.graft({"spans": None})
+            tracer.graft({})
+        assert root.children == []
+
+
+class TestDebugTracesRoute:
+    def test_health_route_serves_flight_recorder(self):
+        from karpenter_tpu.operator.health import HealthServer
+
+        prev = (tracing.TRACER.enabled, tracing.TRACER.sample,
+                tracing.TRACER.recorder.slow_ms)
+        srv = HealthServer(port=0).start()
+        try:
+            tracing.TRACER.configure(enabled=True, sample=1.0, slow_ms=0.0)
+            tracing.TRACER.reset()
+            with tracing.TRACER.trace("tick"):
+                with tracing.TRACER.span("snapshot"):
+                    pass
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/traces", timeout=10
+            ).read()
+            doc = json.loads(body)
+            assert doc["worst"]["name"] == "tick"
+            assert [c["name"] for c in doc["worst"]["children"]] == ["snapshot"]
+            assert doc["slow"] and doc["slow"][-1]["name"] == "tick"
+        finally:
+            srv.stop()
+            tracing.TRACER.configure(
+                enabled=prev[0], sample=prev[1], slow_ms=prev[2]
+            )
+            tracing.TRACER.reset()
+
+
+class TestBatcherSpan:
+    def test_batch_execution_span_carries_window(self):
+        from karpenter_tpu.batcher.batcher import Batcher
+
+        prev = (tracing.TRACER.enabled, tracing.TRACER.sample)
+        tracing.TRACER.configure(enabled=True, sample=1.0, slow_ms=1e12)
+        try:
+            b = Batcher(lambda items: [i * 2 for i in items], name="test-api")
+            with tracing.TRACER.trace("tick") as root:
+                f = b.add(21)
+                b.flush(force=True)
+            assert f.result() == 42
+            batch = find(root.to_dict(), "batch")
+            assert batch is not None
+            assert batch["attributes"]["api"] == "test-api"
+            assert batch["attributes"]["items"] == 1
+            assert "window_ms" in batch["attributes"]
+        finally:
+            tracing.TRACER.configure(enabled=prev[0], sample=prev[1])
+            tracing.TRACER.reset()
+
+
+class TestOperatorSweepTree:
+    def test_tick_tree_contains_controller_spans(self):
+        """One operator sweep over the kwok rig (oracle decision path: no
+        solver import needed) produces a single tree rooted at `tick`
+        with the provisioner's snapshot/dispatch, the binder's bind, and
+        the disruption pass -- and the flight recorder serves it."""
+        from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.operator import Operator, Options
+        from karpenter_tpu.scheduling import Resources
+
+        op = Operator(
+            clock=FakeClock(1_000.0),
+            options=Options(tracing=True, tracing_sample=1.0, tracing_slow_ms=0.0),
+        )
+        try:
+            tracing.TRACER.reset()
+            op.cluster.create(TPUNodeClass("default"))
+            op.cluster.create(NodePool("default"))
+            op.tick()  # hydrate
+            for i in range(8):
+                op.cluster.create(
+                    Pod(f"p{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}))
+                )
+            op.tick()
+            dump = tracing.TRACER.recorder.dump()
+            tree = dump["slow"][-1]
+            assert tree["name"] == "tick"
+            for name in ("provisioner", "snapshot", "dispatch", "launch",
+                         "bind", "disruption", "batch"):
+                assert find(tree, name) is not None, f"missing span {name}"
+            # the whole sweep is ONE tree: every span shares the root's id
+            def trace_ids(node):
+                yield node["trace_id"]
+                for c in node.get("children", ()):
+                    yield from trace_ids(c)
+
+            assert set(trace_ids(tree)) == {tree["trace_id"]}
+        finally:
+            tracing.TRACER.configure(enabled=False)
+            tracing.TRACER.reset()
