@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci
+.PHONY: test deflake benchmark benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -33,6 +33,9 @@ endef
 benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
 	$(PY) bench.py --profile > bench_last.json; rc=$$?; cat bench_last.json; \
 	$(PY) hack/tier_stamp.py benchmark --from-bench bench_last.json || true; exit $$rc
+
+chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count (full-length schedule stays behind -m slow)
+	KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py -q -m 'not slow' $(call STAMP,chaos)
 
 e2e:  ## scale + end-to-end suites only
 	$(PYTEST) tests/test_scale.py tests/test_e2e_provisioning.py tests/test_storage.py tests/test_soak.py -q

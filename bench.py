@@ -540,6 +540,62 @@ def _tracing_overhead(solver, pool, items, workloads, iters: int) -> dict:
     }
 
 
+def _breaker_degraded(pool, items, zones, rng, iters: int) -> dict:
+    """Degraded-mode stage (robustness PR): the sidecar is DOWN and the
+    circuit breaker OPEN -- a scheduling tick must complete via the
+    in-process CPU fallback with NO connect stall. Measures the trip cost
+    (the K bounded-connect-failure ticks that open the breaker) and the
+    breaker-open tick p50/p99 at a 2k-pod tier (the <100 ms acceptance
+    scale; the 50k CPU tick is bounded separately by the degraded SLO in
+    docs/operations.md)."""
+    import shutil
+    import tempfile
+
+    from karpenter_tpu.solver.breaker import CircuitBreaker
+    from karpenter_tpu.solver.rpc import SolverClient
+    from karpenter_tpu.solver.service import TPUSolver
+
+    n_pods = min(N_PODS, 2_000)
+    workloads = [synth_pods(rng, zones, n_pods, salt=90_000 + i) for i in range(3)]
+    d = tempfile.mkdtemp(prefix="bench_breaker_")
+    try:
+        dead = os.path.join(d, "no-sidecar.sock")  # nothing ever listens here
+        client = SolverClient(path=dead, timeout=5.0, connect_timeout=0.2)
+        # probe backoff pushed past the measurement window: the stage
+        # measures the OPEN state, not a recovery race
+        breaker = CircuitBreaker(failure_threshold=2, backoff_base=3600.0)
+        # g_max sized to the tier, as a 2k-pod deployment's solver would
+        # be: the FFD scan cost is driven by group slots x catalog, and
+        # carrying the 50k tier's 1024 slots into a 2k measurement would
+        # measure a misconfiguration, not the degraded path
+        s = TPUSolver(g_max=128, client=client, breaker=breaker)
+        trip_ms = []
+        guard = 0
+        while breaker.state != "open" and guard < 6:
+            t0 = time.perf_counter()
+            s.solve(pool, items, workloads[guard % len(workloads)])
+            trip_ms.append((time.perf_counter() - t0) * 1e3)
+            guard += 1
+        # one warm solve: the open path dispatches the fused in-process
+        # program, whose one-off compile must not land in the percentile
+        s.solve(pool, items, workloads[0])
+        times = []
+        for i in range(iters):
+            t0 = time.perf_counter()
+            s.solve(pool, items, workloads[i % len(workloads)])
+            times.append((time.perf_counter() - t0) * 1e3)
+        return {
+            "breaker_open_tick_p50_ms": round(float(np.percentile(times, 50)), 2),
+            "breaker_open_tick_p99_ms": round(float(np.percentile(times, 99)), 2),
+            "breaker_open_tick_pods": n_pods,
+            "breaker_trip_ticks_ms": [round(x, 1) for x in trip_ms],
+            "breaker_state": breaker.state,
+            "breaker_trips": breaker.trips,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _tunnel_rtt_ms(n: int = 5) -> float:
     """Median cost of synchronously fetching a fresh 32-byte device array:
     the tunnel's flat per-round-trip tax (~0 on a local chip)."""
@@ -746,6 +802,16 @@ def run(profile: bool, progress=lambda ev: None):
         except Exception as e:  # noqa: BLE001
             secondary["tracing_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
         progress({"ev": "phase", "name": "tracing_overhead"})
+        # degraded-mode stage (robustness PR): sidecar down + breaker open
+        # -> breaker_open_tick_p99_ms proves the tick completes on the CPU
+        # fallback with no connect stall
+        try:
+            secondary.update(_breaker_degraded(
+                pool, items, zones, rng,
+                iters=8 if backend != "cpu" else 4))
+        except Exception as e:  # noqa: BLE001
+            secondary["breaker_degraded_error"] = f"{type(e).__name__}: {e}"[:200]
+        progress({"ev": "phase", "name": "breaker_degraded"})
 
     # decompose the wall-clock number into tunnel overhead vs compute.
     # Under axon the chip sits behind a network tunnel whose EVERY

@@ -84,11 +84,15 @@ def build_operator(args):
 
         sock = _os.environ.get("KARPENTER_TPU_SOLVER_SOCKET", "")
         addr = _os.environ.get("KARPENTER_TPU_SOLVER_ADDR", "")
+        solver_timeouts = dict(
+            timeout=getattr(args, "solver_timeout", 30.0),
+            connect_timeout=getattr(args, "solver_connect_timeout", 1.0),
+        )
         client = None
         if sock:
             from karpenter_tpu.solver.rpc import SolverClient
 
-            client = SolverClient(path=sock)
+            client = SolverClient(path=sock, **solver_timeouts)
         elif addr:
             # TCP sidecar (deploy/values.yaml solver.tcp): the shared
             # token rides $KARPENTER_TPU_SOLVER_TOKEN on both ends; TLS
@@ -107,8 +111,23 @@ def build_operator(args):
             client = SolverClient(
                 host or "127.0.0.1", int(port), ssl_context=ctx,
                 server_hostname=_os.environ.get("KARPENTER_TPU_SOLVER_TLS_SERVERNAME") or None,
+                **solver_timeouts,
             )
-        solver = TPUSolver(auto_warm=client is None, client=client)
+        breaker = None
+        if client is not None:
+            # wire circuit breaker (solver/breaker.py): K consecutive RPC
+            # failures open it and solves short-circuit to the in-process
+            # CPU path; a background jittered-backoff probe re-tests the
+            # sidecar and re-promotion restages the catalog
+            from karpenter_tpu.solver.breaker import CircuitBreaker
+
+            breaker = CircuitBreaker(
+                failure_threshold=getattr(args, "breaker_failures", 3),
+                backoff_base=getattr(args, "breaker_backoff", 0.5),
+                backoff_max=getattr(args, "breaker_backoff_max", 30.0),
+                auto_probe=True,
+            )
+        solver = TPUSolver(auto_warm=client is None, client=client, breaker=breaker)
         evaluator = ConsolidationEvaluator()
     cluster = None
     if getattr(args, "kubeconfig", None) or getattr(args, "in_cluster", False):
@@ -164,6 +183,38 @@ def main(argv=None) -> int:
         "pins the synchronous dispatch+barrier path)",
     )
     parser.add_argument(
+        "--solver-timeout", type=float, default=30.0,
+        help="per-solve READ budget on the solver wire (seconds)",
+    )
+    parser.add_argument(
+        "--solver-connect-timeout", type=float, default=1.0,
+        help="solver-wire connection-establishment budget: connect + TLS + "
+        "auth (seconds; split from --solver-timeout so a dead sidecar "
+        "fails a degraded tick in ~1s, not the solve budget)",
+    )
+    parser.add_argument(
+        "--breaker-failures", type=int, default=3,
+        help="consecutive solver-wire failures that OPEN the circuit "
+        "breaker (solves then fall back to the in-process CPU path "
+        "instantly until a probe re-promotes)",
+    )
+    parser.add_argument(
+        "--breaker-backoff", type=float, default=0.5,
+        help="initial half-open probe backoff (seconds; doubles per failed "
+        "probe with 0-50%% jitter)",
+    )
+    parser.add_argument(
+        "--breaker-backoff-max", type=float, default=30.0,
+        help="half-open probe backoff cap (seconds)",
+    )
+    parser.add_argument(
+        "--failpoints", default="",
+        help="arm fault-injection sites for game-day drills, e.g. "
+        "'rpc.server.dispatch=latency(0.05):p=0.3;instance.launch="
+        "error(InsufficientCapacityError):times=5' (also via "
+        "$KARPENTER_TPU_FAILPOINTS; see karpenter_tpu/failpoints.py)",
+    )
+    parser.add_argument(
         "--kubeconfig", default="",
         help="run against a REAL apiserver via this kubeconfig (apply apis/crds/*.yaml first)",
     )
@@ -198,6 +249,13 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
+    if args.failpoints:
+        # arm BEFORE the operator graph builds so cold-start paths
+        # (catalog hydration, first connects) are injectable too
+        from karpenter_tpu.failpoints import FAILPOINTS
+
+        FAILPOINTS.arm_spec(args.failpoints)
+
     # health endpoints come up BEFORE the operator graph builds: a slow
     # or wedged cold start (catalog hydration, a hung cloud call) must
     # answer liveness 200 (readiness stays 503 until the first sweep) --
@@ -214,6 +272,12 @@ def main(argv=None) -> int:
         ).start()
 
     op = build_operator(args)
+    if health is not None:
+        breaker = getattr(op.solver, "breaker", None)
+        if breaker is not None:
+            # /healthz carries the breaker state; /debug/breaker serves the
+            # full document (loopback-only)
+            health.breaker_info = breaker.describe
     # latency GC policy: the provider graph and (if enabled) the jax
     # runtime are now the long-lived baseline; freeze it and stop gen2
     # collections from landing inside scheduling ticks

@@ -198,6 +198,12 @@ class Batcher(Generic[T, U]):
             window_ms=round(window_s * 1e3, 3),
         ):
             try:
+                # chaos site: an injected error fans out to every waiter in
+                # the batch (the same path a backend failure takes); latency
+                # models a slow cloud call holding the merged batch
+                from karpenter_tpu import failpoints
+
+                failpoints.eval("batcher.exec")
                 results = self.exec_batch(bucket.items)
                 if len(results) != len(bucket.items):
                     raise RuntimeError(

@@ -23,24 +23,36 @@ class UnavailableOfferings:
         self._capacity_types = TTLCache(ttl, clock)
         self._zonal = TTLCache(ttl, clock)
         self._lock = threading.Lock()
-        self.seq_num = 0
+        self._seq = 0
 
-    def _bump(self) -> None:
+    @property
+    def seq_num(self) -> int:
+        """Monotonic change counter, read under the SAME lock the marks
+        bump it under: catalog cache keys fold this in, and a key must
+        never pair a seqnum with a cache view from a different moment."""
         with self._lock:
-            self.seq_num += 1
+            return self._seq
 
     # -- marking ------------------------------------------------------------
+    # mark-and-bump is ATOMIC (one lock acquisition around both): with the
+    # old two-step (unlocked set, then locked bump) a concurrent reader
+    # could observe the bumped seqnum paired with the pre-mark cache view
+    # -- computing a FRESH catalog key over STALE availability, which the
+    # key would then cache until the next unrelated bump.
     def mark_unavailable(self, instance_type: str, zone: str, capacity_type: str, reason: str = "") -> None:
-        self._offerings.set((instance_type, zone, capacity_type), reason or True)
-        self._bump()
+        with self._lock:
+            self._offerings.set((instance_type, zone, capacity_type), reason or True)
+            self._seq += 1
 
     def mark_capacity_type_unavailable(self, capacity_type: str) -> None:
-        self._capacity_types.set(capacity_type, True)
-        self._bump()
+        with self._lock:
+            self._capacity_types.set(capacity_type, True)
+            self._seq += 1
 
     def mark_az_unavailable(self, zone: str, capacity_type: str) -> None:
-        self._zonal.set((zone, capacity_type), True)
-        self._bump()
+        with self._lock:
+            self._zonal.set((zone, capacity_type), True)
+            self._seq += 1
 
     # -- queries ------------------------------------------------------------
     def is_unavailable(self, instance_type: str, zone: str, capacity_type: str) -> bool:
@@ -51,7 +63,8 @@ class UnavailableOfferings:
         return self._offerings.get((instance_type, zone, capacity_type))[1]
 
     def flush(self) -> None:
-        self._offerings.flush()
-        self._capacity_types.flush()
-        self._zonal.flush()
-        self._bump()
+        with self._lock:
+            self._offerings.flush()
+            self._capacity_types.flush()
+            self._zonal.flush()
+            self._seq += 1

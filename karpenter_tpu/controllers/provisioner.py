@@ -50,6 +50,11 @@ def launch_all(cloud_provider, claims, max_workers: int):
                 return None
             except CloudError as e:
                 return e
+            except Exception as e:  # noqa: BLE001
+                # a failure OUTSIDE the cloud-error taxonomy (a batcher
+                # executor bug, an injected fault) must cost its one claim,
+                # not escape the pool.map and kill the whole launch fan-out
+                return CloudError(f"{type(e).__name__}: {e}")
 
     if len(claims) == 1:
         return [launch_one(claims[0])]
@@ -240,6 +245,12 @@ class Provisioner:
         if (
             self.pipeline and sustained and self.solver is not None
             and hasattr(self.solver, "schedule_begin")
+            # degraded wire (solver breaker open): tick SYNCHRONOUSLY.
+            # The CPU fallback leaves nothing remote in flight to overlap,
+            # and a synchronous tick applies its decision immediately --
+            # no decision rides a barrier into a tick that may degrade
+            # differently (solver/breaker.py)
+            and getattr(self.solver, "wire_healthy", lambda: True)()
         ):
             # sustained load: dispatch this batch and let the device round
             # trip ride under the rest of the sweep; the barrier lands at

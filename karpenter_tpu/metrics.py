@@ -258,7 +258,7 @@ SOLVER_PIPELINE_TICKS = REGISTRY.counter(
 SOLVER_PIPELINE_FALLBACKS = REGISTRY.counter(
     "karpenter_scheduler_pipeline_fallbacks_total",
     "Pipelined solves that fell back to the synchronous path mid-flight",
-    labels=("reason",),  # catalog-changed | stale-seqnum | rpc-degraded
+    labels=("reason",),  # catalog-changed | stale-seqnum | rpc-degraded | rpc-down
 )
 NODES_READY = REGISTRY.gauge(
     "karpenter_nodes_ready_count", "Ready nodes in the cluster",
@@ -276,4 +276,28 @@ TRACE_SPANS = REGISTRY.counter(
 TRACE_SLOW_TICKS = REGISTRY.counter(
     "karpenter_tracing_slow_ticks_total",
     "Root span trees retained by the slow-tick flight recorder",
+)
+# solver-wire circuit breaker (solver/breaker.py)
+BREAKER_STATE = REGISTRY.gauge(
+    "karpenter_scheduler_breaker_state",
+    "Solver wire circuit-breaker state (1 on the active state's series)",
+    labels=("state",),  # closed | open | half-open
+)
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "karpenter_scheduler_breaker_transitions_total",
+    "Solver wire circuit-breaker state transitions", labels=("to",),
+)
+BREAKER_SHORT_CIRCUITS = REGISTRY.counter(
+    "karpenter_scheduler_breaker_short_circuits_total",
+    "Solves that skipped the solver wire because the breaker was open "
+    "(served by the in-process host backend with no connect stall)",
+)
+BREAKER_PROBES = REGISTRY.counter(
+    "karpenter_scheduler_breaker_probes_total",
+    "Half-open sidecar probes by outcome", labels=("outcome",),  # success | failure
+)
+# failpoint framework (karpenter_tpu/failpoints.py)
+FAILPOINT_FIRES = REGISTRY.counter(
+    "karpenter_failpoints_fired_total",
+    "Fault injections fired by armed failpoints", labels=("site", "action"),
 )

@@ -24,6 +24,10 @@ Probes:
 - `/debug/stacks`: every thread's stack (loopback-only).
 - `/debug/traces`: the slow-tick flight recorder's span trees as JSON
   (loopback-only; see karpenter_tpu/tracing.py and docs/observability.md).
+- `/debug/breaker`: the solver-wire circuit breaker's state document
+  (loopback-only; solver/breaker.py). /healthz also carries the breaker
+  state in its body -- an OPEN breaker is a degraded-but-alive condition
+  (CPU fallback serving), never a liveness failure.
 
 Heartbeats are plain float timestamps; reads are lock-free (float
 stores are atomic in CPython).
@@ -48,6 +52,10 @@ class HealthServer:
         self.port = port
         self.stall_after = stall_after
         self.startup_grace = startup_grace
+        # optional () -> dict with the solver-wire breaker's state
+        # (CircuitBreaker.describe); wired by the binary after the
+        # operator graph builds. None = no wire configured.
+        self.breaker_info = None
         self._started_at = time.monotonic()
         self._last_loop: float = 0.0   # 0 = run loop has not turned yet
         self._last_sweep: float = 0.0  # 0 = no full sweep completed yet
@@ -76,6 +84,15 @@ class HealthServer:
     def ready(self) -> bool:
         last = self._last_sweep
         return last != 0.0 and (time.monotonic() - last) < self.stall_after
+
+    def _breaker_doc(self) -> Optional[dict]:
+        fn = self.breaker_info
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 -- a probe must never 500 on this
+            return None
 
     # -- server -------------------------------------------------------------
     def start(self) -> "HealthServer":
@@ -106,10 +123,20 @@ class HealthServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    if outer.alive():
-                        self._send(200, "ok")
-                    else:
-                        self._send(503, "run loop stalled (or startup grace exceeded)")
+                    # alive() evaluated ONCE: body and status must agree
+                    # even when the stall window flips mid-request
+                    alive = outer.alive()
+                    body = (
+                        "ok" if alive
+                        else "run loop stalled (or startup grace exceeded)"
+                    )
+                    # breaker state rides the liveness body: an OPEN
+                    # breaker means degraded (CPU fallback serving), not
+                    # dead -- the status code never changes for it
+                    doc = outer._breaker_doc()
+                    if doc is not None:
+                        body += f"\nsolver-wire-breaker: {doc.get('state', 'unknown')}"
+                    self._send(200 if alive else 503, body)
                 elif self.path == "/readyz":
                     if outer.ready():
                         self._send(200, "ok")
@@ -119,6 +146,19 @@ class HealthServer:
                     from karpenter_tpu import metrics
 
                     self._send(200, metrics.REGISTRY.expose())
+                elif self.path == "/debug/breaker":
+                    # solver-wire circuit breaker (solver/breaker.py):
+                    # state, consecutive failures, backoff, probe history
+                    if not self._loopback_only():
+                        return
+                    import json
+
+                    doc = outer._breaker_doc()
+                    self._send(
+                        200,
+                        json.dumps(doc if doc is not None else {"configured": False}, indent=2),
+                        ctype="application/json",
+                    )
                 elif self.path == "/debug/traces":
                     # slow-tick flight recorder (karpenter_tpu/tracing.py):
                     # the last N span trees whose sweep exceeded the slow

@@ -120,6 +120,10 @@ class Operator:
             capacity=self.options.tracing_capacity,
         )
         self.cloud = cloud or FakeCloud(clock=self.clock)
+        # the decision plane handle, kept for observability wiring: the
+        # binary points /healthz + /debug/breaker at
+        # solver.breaker.describe when the wire topology is configured
+        self.solver = solver
         # the coordination bus: the in-memory store by default; pass a
         # karpenter_tpu.kube.KubeCluster to run against a real apiserver
         # (the reference's kwok topology: real bus, emulated cloud)

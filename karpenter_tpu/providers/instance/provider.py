@@ -56,9 +56,26 @@ class InstanceProvider:
         # to talk to the API directly
         self.batchers = batchers
 
+    @staticmethod
+    def _cloud_seam(fn, *args):
+        """Every batched cloud call crosses here: a failure OUTSIDE the
+        CloudError taxonomy (a batcher executor fault fanning to its
+        waiters, an emulator bug) is wrapped so callers' existing
+        CloudError handling applies instead of the raw exception killing a
+        whole controller sweep. KeyError passes through untouched -- it is
+        the stale-launch-template signal _launch's retry contract needs."""
+        from karpenter_tpu.errors import CloudError
+
+        try:
+            return fn(*args)
+        except (CloudError, KeyError):
+            raise
+        except Exception as e:  # noqa: BLE001
+            raise CloudError(f"{type(e).__name__}: {e}") from e
+
     def _create_fleet(self, request: FleetRequest):
         if self.batchers is not None:
-            return self.batchers.create_fleet.call(request)
+            return self._cloud_seam(self.batchers.create_fleet.call, request)
         return self.compute_api.create_fleet(request)
 
     def launch_window(self, expected: int):
@@ -72,12 +89,12 @@ class InstanceProvider:
 
     def _describe(self, ids: Sequence[str]):
         if self.batchers is not None:
-            return self.batchers.describe_instances.call(ids)
+            return self._cloud_seam(self.batchers.describe_instances.call, ids)
         return self.compute_api.describe_instances(ids)
 
     def _terminate(self, ids: Sequence[str]):
         if self.batchers is not None:
-            return self.batchers.terminate_instances.call(ids)
+            return self._cloud_seam(self.batchers.terminate_instances.call, ids)
         return self.compute_api.terminate_instances(ids)
 
     # -- create -------------------------------------------------------------
@@ -228,6 +245,13 @@ class InstanceProvider:
                 wk.LABEL_NODECLASS: nodeclass.name,
             },
         )
+        # chaos site: error(InsufficientCapacityError) here is an ICE storm
+        # (every launch refused until the failpoint's budget drains); the
+        # provisioner marks the claim's pods unschedulable and re-simulates
+        # around it next tick -- the chaos soak asserts convergence after
+        from karpenter_tpu import failpoints
+
+        failpoints.eval("instance.launch")
         try:
             result = self._create_fleet(request)
         except KeyError as e:

@@ -43,14 +43,20 @@ import socket
 import socketserver
 import struct
 import threading
+import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from karpenter_tpu import tracing
+from karpenter_tpu import failpoints, tracing
 from karpenter_tpu.solver import encode, ffd
 
 TOKEN_ENV = "KARPENTER_TPU_SOLVER_TOKEN"
+
+# connection ESTABLISHMENT budget (TCP/UNIX connect + TLS handshake +
+# auth), split from the solve/read budget: a dead sidecar must fail a
+# degraded tick in ~1s, not eat the whole 30s solve budget per call
+DEFAULT_CONNECT_TIMEOUT = 1.0
 
 
 def default_socket_path() -> str:
@@ -79,15 +85,29 @@ MAX_FRAME = 256 * 1024 * 1024
 # -- framing -----------------------------------------------------------------
 
 def _send_frame(sock: socket.socket, header: dict, tensors: Sequence[Tuple[str, np.ndarray]] = ()) -> None:
+    failpoints.eval("rpc.send")
     header = dict(header)
     header["tensors"] = [
         {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)} for name, a in tensors
     ]
+    payload = [np.ascontiguousarray(a).tobytes() for _, a in tensors]
+    if payload:
+        # payload integrity: one crc32 over the concatenated tensor bytes.
+        # A flipped bit in a decision tensor would otherwise decode into a
+        # silently WRONG placement; with the checksum it surfaces as a
+        # ConnectionError and the caller degrades through the ladder to a
+        # recomputed (correct) decision. Old peers ignore the extra header
+        # field; frames from old peers simply skip the check.
+        crc = 0
+        for p in payload:
+            crc = zlib.crc32(p, crc)
+        header["crc"] = crc
     hb = json.dumps(header).encode()
-    parts = [_LEN.pack(len(hb)), hb]
-    for _, a in tensors:
-        parts.append(np.ascontiguousarray(a).tobytes())
-    sock.sendall(b"".join(parts))
+    data = b"".join([_LEN.pack(len(hb)), hb] + payload)
+    # chaos site: deterministic single-byte corruption past the length
+    # prefix (failpoints.py); the receiver's JSON/CRC checks must detect it
+    data = failpoints.corrupt("rpc.frame.corrupt", data)
+    sock.sendall(data)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -103,26 +123,44 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_frame(
     sock: socket.socket, limit: int = MAX_FRAME
 ) -> Tuple[dict, Dict[str, np.ndarray]]:
+    failpoints.eval("rpc.recv")
     (hlen,) = _LEN.unpack(_recv_exact(sock, 4))
     if hlen > limit:
         raise ConnectionError(f"oversized header ({hlen} bytes)")
-    header = json.loads(_recv_exact(sock, hlen))
+    # a corrupted frame must surface as a CONNECTION error, not a stray
+    # JSONDecodeError/TypeError escaping into the solve: the stream is
+    # desynchronized either way, and ConnectionError is what every caller
+    # (reconnect ladders, the breaker) already handles
+    try:
+        header = json.loads(_recv_exact(sock, hlen))
+        if not isinstance(header, dict):
+            raise ValueError("frame header is not an object")
+    except ValueError as e:
+        raise ConnectionError(f"corrupt frame header: {e}") from None
     tensors: Dict[str, np.ndarray] = {}
     total = 0
-    for spec in header.get("tensors", ()):
-        dtype = np.dtype(spec["dtype"])
-        shape = [int(s) for s in spec["shape"]]
-        if any(s < 0 for s in shape):
-            raise ConnectionError(f"negative dimension in {spec}")
-        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
-        nbytes = count * dtype.itemsize
-        total += nbytes
-        # bound the payload BEFORE allocating: a hostile header must not be
-        # able to make the sidecar allocate unbounded buffers
-        if nbytes > limit or total > limit:
-            raise ConnectionError(f"oversized tensor payload ({total} bytes)")
-        raw = _recv_exact(sock, nbytes)
-        tensors[spec["name"]] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+    crc = 0
+    try:
+        for spec in header.get("tensors", ()):
+            dtype = np.dtype(spec["dtype"])
+            shape = [int(s) for s in spec["shape"]]
+            if any(s < 0 for s in shape):
+                raise ConnectionError(f"negative dimension in {spec}")
+            count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            nbytes = count * dtype.itemsize
+            total += nbytes
+            # bound the payload BEFORE allocating: a hostile header must not be
+            # able to make the sidecar allocate unbounded buffers
+            if nbytes > limit or total > limit:
+                raise ConnectionError(f"oversized tensor payload ({total} bytes)")
+            raw = _recv_exact(sock, nbytes)
+            crc = zlib.crc32(raw, crc)
+            tensors[spec["name"]] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+    except (TypeError, ValueError, KeyError) as e:
+        raise ConnectionError(f"corrupt tensor spec: {e}") from None
+    want = header.get("crc")
+    if want is not None and tensors and crc != int(want):
+        raise ConnectionError("frame payload crc mismatch")
     return header, tensors
 
 
@@ -148,9 +186,14 @@ class SolverServer:
         self, host: str = "127.0.0.1", port: int = 0, *,
         path: Optional[str] = None, token: Optional[str] = None,
         insecure_tcp: bool = False, ssl_context=None,
+        handshake_timeout: float = 30.0,
     ):
         self._staged: Dict[str, _StagedEntry] = {}
         self._lock = threading.Lock()
+        # TLS-handshake budget (was a hardcoded 30s): a peer stalling the
+        # handshake holds one daemon thread, never the accept loop, but the
+        # bound should still be an operator decision
+        self._handshake_timeout = handshake_timeout
         self._token = token if token is not None else os.environ.get(TOKEN_ENV)
         # an empty token is UNSET, not a guessable one-value secret: it
         # must neither satisfy the TCP guard nor be compared against
@@ -177,12 +220,16 @@ class SolverServer:
                         # handshake in THIS per-connection thread, never in
                         # the accept loop (a stalled handshake must not
                         # wedge the server), and bounded by a timeout
-                        self.request.settimeout(30.0)
+                        self.request.settimeout(outer._handshake_timeout)
                         self.request = ssl_context.wrap_socket(
                             self.request, server_side=True
                         )
                         self.request.settimeout(None)
                     while True:
+                        # chaos site: a connection-drop here closes the
+                        # stream mid-conversation (the handler's except
+                        # path), the wedge/kill shapes the chaos soak arms
+                        failpoints.eval("rpc.server.conn")
                         header, tensors = _recv_frame(
                             self.request,
                             limit=MAX_FRAME if authed else 4096,
@@ -257,6 +304,10 @@ class SolverServer:
         # is byte-identical to the pre-tracing protocol
         wt = tracing.WireTrace(header.get("trace"))
         try:
+            # chaos site INSIDE the try: an injected error crosses the wire
+            # as an error frame (an erroring solver); injected latency
+            # models a wedged solver holding the reply
+            failpoints.eval("rpc.server.dispatch")
             if op == "ping":
                 # features lets a NEWER client decide whether semantics it
                 # depends on exist server-side: an older server omits the
@@ -426,10 +477,16 @@ class SolverClient:
         timeout: float = 30.0, *, path: Optional[str] = None,
         token: Optional[str] = None, ssl_context=None,
         server_hostname: Optional[str] = None,
+        connect_timeout: float = DEFAULT_CONNECT_TIMEOUT,
     ):
         self.addr = (host, port) if path is None else None
         self.path = path
+        # timeout = the per-solve READ budget; connect_timeout bounds
+        # connection establishment (connect + TLS + auth). They were one
+        # knob before, which made a dead sidecar cost the full solve
+        # budget per reconnect attempt instead of ~1s.
         self.timeout = timeout
+        self.connect_timeout = connect_timeout
         self.token = (token if token is not None else os.environ.get(TOKEN_ENV)) or None
         self._ssl_context = ssl_context
         self._server_hostname = server_hostname or (host if host else None)
@@ -454,12 +511,16 @@ class SolverClient:
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
+            failpoints.eval("rpc.client.connect")
+            # the WHOLE establishment sequence (connect, TLS handshake,
+            # auth roundtrip) runs under connect_timeout; only then does
+            # the socket get the long per-solve read budget
             if self.path is not None:
                 sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                sock.settimeout(self.timeout)
+                sock.settimeout(self.connect_timeout)
                 sock.connect(self.path)
             else:
-                sock = socket.create_connection(self.addr, timeout=self.timeout)
+                sock = socket.create_connection(self.addr, timeout=self.connect_timeout)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 if self._ssl_context is not None:
                     sock = self._ssl_context.wrap_socket(
@@ -467,15 +528,19 @@ class SolverClient:
                     )
             self._sock = sock
             self._staged_seqnums.clear()
-            if self.token:
-                # prove the shared token before any op (the server closes
-                # unauthenticated connections on the first non-auth frame)
-                _send_frame(sock, {"op": "auth", "token": self.token})
-                header, _ = _recv_frame(sock)
-                if not header.get("ok"):
-                    sock.close()
-                    self._sock = None
-                    raise ConnectionError("solver auth rejected")
+            try:
+                if self.token:
+                    # prove the shared token before any op (the server closes
+                    # unauthenticated connections on the first non-auth frame)
+                    _send_frame(sock, {"op": "auth", "token": self.token})
+                    header, _ = _recv_frame(sock)
+                    if not header.get("ok"):
+                        raise ConnectionError("solver auth rejected")
+            except (ConnectionError, OSError):
+                sock.close()
+                self._sock = None
+                raise
+            sock.settimeout(self.timeout)
         return self._sock
 
     def close(self) -> None:
@@ -490,6 +555,12 @@ class SolverClient:
                 self._sock.close()
                 self._sock = None
             self._features = None  # the replacement server may differ
+            # eager, not on-reconnect: between close() and the next _conn()
+            # a begin_solve_compact checks membership BEFORE connecting, and
+            # a stale hit would skip the re-stage the replacement sidecar
+            # needs (the breaker's promotion hook relies on this to gate
+            # re-promotion on a catalog re-stage)
+            self._staged_seqnums.clear()
 
     # -- request pipelining (the async solve path) ---------------------------
     def _drain_pending(self, target: Optional[_PendingReply] = None) -> None:
@@ -737,6 +808,10 @@ def serve_main(argv=None) -> int:
     )
     parser.add_argument("--tls-cert", default=None)
     parser.add_argument("--tls-key", default=None)
+    parser.add_argument(
+        "--handshake-timeout", type=float, default=30.0,
+        help="TLS-handshake budget per connection (seconds)",
+    )
     args = parser.parse_args(argv)
 
     token = None
@@ -753,6 +828,7 @@ def serve_main(argv=None) -> int:
         server = SolverServer(
             args.host, args.port, token=token,
             insecure_tcp=args.insecure, ssl_context=ctx,
+            handshake_timeout=args.handshake_timeout,
         ).start()
         print(
             f"solver service listening on {server.address[0]}:{server.address[1]}",

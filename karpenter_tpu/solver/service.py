@@ -26,7 +26,7 @@ from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
-from karpenter_tpu import metrics, tracing
+from karpenter_tpu import failpoints, metrics, tracing
 from karpenter_tpu.apis import NodePool, Pod, labels as wk
 from karpenter_tpu.logging import ChangeMonitor, get_logger
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resources
@@ -117,7 +117,7 @@ class TPUSolver:
 
     def __init__(
         self, g_max: int = 1024, c_pad_min: int = 16, client=None,
-        objective: str = "price", auto_warm: bool = False,
+        objective: str = "price", auto_warm: bool = False, breaker=None,
     ):
         # auto_warm: precompile every class-count bucket in a background
         # thread whenever a new catalog is staged (see warm()); opt-in so
@@ -138,6 +138,29 @@ class TPUSolver:
         # (the SURVEY.md section 2.4 deployment seam); encode/decode and the
         # existing-node pre-pass stay host-side either way
         self.client = client
+        # solver-wire circuit breaker (solver/breaker.py): K consecutive
+        # wire failures open it, after which solve/solve_finish skip the
+        # wire ENTIRELY (no connect stall) and run the same kernels on the
+        # in-process host backend; a successful half-open probe plus a
+        # catalog re-stage gates re-promotion. Default-on for remote mode;
+        # pass breaker=False to disable, or a configured CircuitBreaker to
+        # tune thresholds/backoff (the binary does -- __main__.py flags).
+        if breaker is None and client is not None:
+            from karpenter_tpu.solver.breaker import CircuitBreaker
+
+            # auto_probe: the default breaker must be self-recovering --
+            # an embedder that never calls maybe_probe() would otherwise
+            # stay degraded forever after one transient outage. The probe
+            # thread only spawns on the first trip; deterministic tests
+            # pass their own breaker (auto_probe=False) and drive
+            # probe_now() explicitly.
+            breaker = CircuitBreaker(auto_probe=True)
+        self.breaker = breaker if breaker else None
+        if self.breaker is not None:
+            if self.breaker._probe is None:
+                self.breaker._probe = self._probe_sidecar
+            if self.breaker._on_promote is None:
+                self.breaker._on_promote = self._on_wire_restored
         # catalog entries keyed by list identity, LRU-capped: one solver
         # serves several nodepools whose catalogs alternate within a tick;
         # a single-slot cache would re-encode + re-stage (~200 ms) on every
@@ -212,6 +235,76 @@ class TPUSolver:
 
     def catalog_tensors(self, instance_types: Sequence) -> CatalogTensors:
         return self._catalog(instance_types).tensors
+
+    # -- wire health (solver/breaker.py) -------------------------------------
+    def wire_healthy(self) -> bool:
+        """True while the solve path needs no degraded handling: either
+        there is no wire (in-process mode) or the breaker is closed. The
+        provisioner gates the double-buffered tick on this, so the
+        controller keeps ticking SYNCHRONOUSLY while the breaker is open
+        (nothing remote in flight to overlap)."""
+        return self.client is None or self.breaker is None or self.breaker.allow()
+
+    def _probe_sidecar(self) -> bool:
+        """The breaker's half-open probe: one bounded ping on a THROWAWAY
+        connection. Bounded end to end: establishment by connect_timeout
+        and the ping reply by a few seconds -- never the 30s solve budget,
+        so a WEDGED sidecar (accepts, never replies) fails the probe fast
+        instead of pinning the half-open state. The throwaway client also
+        keeps the probe off the real client's lock; on success the
+        promotion hook drops the real connection anyway, so the first
+        post-promotion solve reconnects fresh."""
+        if self.client is None:
+            return False
+        from karpenter_tpu.solver import rpc as rpc_mod
+
+        c = self.client
+        probe = None
+        try:
+            probe = rpc_mod.SolverClient(
+                c.addr[0] if c.addr else None, c.addr[1] if c.addr else None,
+                timeout=max(2.0, 2.0 * c.connect_timeout), path=c.path,
+                token=c.token, ssl_context=c._ssl_context,
+                server_hostname=c._server_hostname,
+                connect_timeout=c.connect_timeout,
+            )
+            return bool(probe.ping())
+        except Exception:  # noqa: BLE001 -- any wire failure = not recovered
+            return False
+        finally:
+            if probe is not None:
+                probe.close()
+
+    def _on_wire_restored(self) -> None:
+        """Re-promotion gate: drop the (stale) connection so the first
+        post-promotion solve reconnects, re-auths, and RE-STAGES the
+        catalog (close() clears the per-connection staged-seqnum set) --
+        the device path never resumes against a restarted sidecar's empty
+        staging."""
+        try:
+            self.client.close()
+        except Exception:  # noqa: BLE001 -- closing a dead socket is best-effort
+            pass
+
+    def _local_staged(self, entry: "_CatalogEntry") -> "_CatalogEntry":
+        """The entry with HOST-backend staged tensors: remote-mode entries
+        stage on the sidecar only (staged=None), but the breaker-open and
+        wire-dead fallbacks solve in process against the SAME catalog
+        snapshot. Memoized back into the cache under the same seqnum so
+        repeated degraded ticks stage once."""
+        if entry.staged is not None:
+            return entry
+        staged, offsets, words = ffd.stage_catalog(entry.tensors)
+        entry2 = entry._replace(staged=staged, offsets=offsets, words=words)
+        with self._lock:
+            cur = self._catalog_cache.get(id(entry.catalog_list))
+            if (
+                cur is not None
+                and cur.catalog_list is entry.catalog_list
+                and cur.seqnum == entry.seqnum
+            ):
+                self._catalog_cache[id(entry.catalog_list)] = entry2
+        return entry2
 
     def _bg_warm(self, entry: "_CatalogEntry") -> None:
         try:
@@ -940,12 +1033,23 @@ class TPUSolver:
             # sidecar drops unknown tensors silently (no error to degrade
             # on), which would pack pods into pools whose taints they do
             # not tolerate -- so taint-carrying merged batches require the
-            # server to advertise the feature, else oracle
-            try:
-                if "join_allowed" not in self.client.features():
+            # server to advertise the feature, else oracle. With the
+            # breaker OPEN the wire is never touched here (a feature ping
+            # is exactly the connect stall the breaker exists to prevent)
+            # AND the decision must not bet on the solve staying local: a
+            # concurrent probe promotion could flip the dispatch back onto
+            # the wire mid-call. So the gate decides from the connection's
+            # CACHED feature set only -- unknown or missing -> oracle.
+            if self.wire_healthy():
+                try:
+                    if "join_allowed" not in self.client.features():
+                        return None
+                except (ConnectionError, OSError):
                     return None
-            except (ConnectionError, OSError):
-                return None
+            else:
+                cached = getattr(self.client, "_features", None)
+                if cached is None or "join_allowed" not in cached:
+                    return None
         # cache keyed by per-pool catalog identity + requirement hashes +
         # overhead/taint signatures (both bake into the merged columns /
         # the entry's pool tuple); the entry RETAINS the catalog lists and
@@ -1053,6 +1157,10 @@ class TPUSolver:
         _barrier: bool = True,
     ) -> "_PendingSolve":
         from karpenter_tpu.solver import spread as spread_mod
+
+        # chaos site for the dispatch half of the pipelined tick
+        # (latency = a slow host stage; error = a dispatch-time crash)
+        failpoints.eval("solver.solve_begin")
 
         # snapshot of the call for the barrier's synchronous re-solve: the
         # host phases below never mutate their inputs (_pack_existing
@@ -1263,6 +1371,23 @@ class TPUSolver:
                 "class-count bucket was not precompiled; this tick compiles",
                 c_pad=class_set.c_pad, classes=len(classes),
             )
+        wire = self.client is not None
+        if wire and self.breaker is not None and not self.breaker.allow():
+            # breaker OPEN (or half-open): skip the wire BEFORE any socket
+            # work -- the instant-fallback contract. The same catalog
+            # snapshot stages on the host backend (bit-identical kernels,
+            # so decisions match the wire path exactly) and the solve runs
+            # through the in-process dispatch below.
+            wire = False
+            metrics.BREAKER_SHORT_CIRCUITS.inc()
+            tracing.annotate(fallback="breaker-open")
+            if self._route_monitor.has_changed("breaker_open", entry.seqnum):
+                self.log.warning(
+                    "solver wire breaker open; solving on in-process host backend",
+                    seqnum=entry.seqnum, breaker=self.breaker.state,
+                )
+            entry = self._local_staged(entry)
+            staged, offsets, words = entry.staged, entry.offsets, entry.words
         pending = _PendingSolve()
         pending.pool = pool
         pending.entry = entry
@@ -1273,7 +1398,7 @@ class TPUSolver:
         pending.barrier = _barrier
         pending.call_args = call_args
         pending.call_kwargs = call_kwargs
-        if self.client is not None:
+        if wire:
             # async wire dispatch: the solve frame streams to the sidecar
             # now and the reply is claimed at the barrier -- the ~RTT
             # overlaps whatever the caller does between begin and finish
@@ -1286,7 +1411,11 @@ class TPUSolver:
                         seqnum, catalog, class_set, g_max=self.g_max,
                         objective=self.objective,
                     )
-                except (ConnectionError, OSError) as e:
+                except (ConnectionError, OSError, RuntimeError) as e:
+                    # RuntimeError covers an ERRORING sidecar at dispatch
+                    # time (a failed stage op, a full pipeline): the tick
+                    # must not die here -- the barrier's ladder (and its
+                    # CPU fallback) owns degradation
                     wd_sp.set(dispatch_error=f"{type(e).__name__}: {e}"[:200])
                     pending.rpc_handle = None
         else:
@@ -1332,6 +1461,8 @@ class TPUSolver:
         dense op), so the result is bit-identical either way."""
         if pending.done is not None:
             return pending.done
+        # chaos site for the barrier half (latency = a slow claim)
+        failpoints.eval("solver.solve_finish")
         entry, class_set = pending.entry, pending.class_set
         if pending.barrier and not self._entry_current(entry):
             # catalog re-encoded between dispatch and barrier: the staged
@@ -1350,7 +1481,10 @@ class TPUSolver:
             # stay in the SAME tree instead of orphaning a half-trace
             tracing.annotate(fallback="catalog-changed")
             return self.solve(*pending.call_args, **pending.call_kwargs)
-        if self.client is not None:
+        if self.client is not None and pending.buf is None:
+            # the wire path: either a pipelined reply to claim or the
+            # synchronous ladder. A breaker-open dispatch set pending.buf
+            # (the in-process fallback) and takes the device branch below.
             with tracing.span("wire"):
                 # the echoed server-side stages ("device", "fetch") graft
                 # under this span when the reply carries them (rpc.py)
@@ -1377,8 +1511,49 @@ class TPUSolver:
             )
 
     def _finish_remote(self, pending: "_PendingSolve"):
-        """Claim (or re-run) the wire solve and return the dense decode
-        tuple. Degrade ladder, in order: the pipelined reply; the
+        """Claim (or re-run) the wire solve with circuit-breaker
+        accounting. The wire ladder (_finish_remote_wire) handles partial
+        degradation; when the WHOLE ladder fails -- sidecar dead, wedged,
+        or erroring -- the tick must neither die nor stall, so the solve
+        re-runs on the in-process host backend (same kernels, identical
+        decision) and the failure counts toward opening the breaker.
+        Outcomes are counted per FINISH, not per rung: "K consecutive
+        wire-failed solves" is the trip condition operators reason about."""
+        try:
+            dense = self._finish_remote_wire(pending)
+        except (ConnectionError, OSError, RuntimeError) as e:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="rpc-down")
+            tracing.annotate(fallback="rpc-down")
+            if self._route_monitor.has_changed("wire_down", type(e).__name__):
+                self.log.warning(
+                    "solver wire ladder failed; solving on in-process host backend",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                    breaker=self.breaker.state if self.breaker is not None else "none",
+                )
+            with tracing.span("device", fallback="rpc-down"):
+                dense = self._solve_local_dense(pending)
+        else:
+            if self.breaker is not None:
+                self.breaker.record_success()
+        return dense
+
+    def _solve_local_dense(self, pending: "_PendingSolve"):
+        """The CPU fallback's compute: the dense solve on locally staged
+        tensors of the SAME catalog snapshot the wire dispatch encoded
+        against -- the decision is bit-identical to what the sidecar would
+        have returned."""
+        entry = self._local_staged(pending.entry)
+        pending.entry = entry
+        inp = ffd.make_inputs_staged(entry.staged, pending.class_set)
+        return ffd.solve_dense_tuple(
+            inp, g_max=self.g_max, word_offsets=entry.offsets,
+            words=entry.words, objective=self.objective,
+        )
+
+    def _finish_remote_wire(self, pending: "_PendingSolve"):
+        """The wire degrade ladder, in order: the pipelined reply; the
         synchronous compact op (covers reconnects and sidecar restarts --
         it restages on unknown-seqnum); the dense op (old sidecars without
         solve_compact, and sparse-budget overflow)."""

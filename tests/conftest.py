@@ -33,6 +33,13 @@ except ModuleNotFoundError:
     _sys.modules["tomllib"] = _tomli
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running schedules (full chaos soak); deselected by tier-1's -m 'not slow'",
+    )
+
+
 def pytest_collection_modifyitems(config, items):
     """Deterministic test-order shuffling for race/ordering-dependency
     hunting: `make deflake` exports PYTEST_SHUFFLE_SEED with a fresh seed
@@ -42,6 +49,21 @@ def pytest_collection_modifyitems(config, items):
         import random
 
         random.Random(int(seed)).shuffle(items)
+
+
+import pytest
+
+
+@pytest.fixture()
+def failpoints():
+    """The process-global failpoint registry, guaranteed disarmed before
+    AND after the test (an armed site leaking across tests would inject
+    faults into unrelated suites)."""
+    from karpenter_tpu.failpoints import FAILPOINTS
+
+    FAILPOINTS.reset()
+    yield FAILPOINTS
+    FAILPOINTS.reset()
 
 
 def find_span(tree: dict, name: str):

@@ -1,0 +1,315 @@
+"""Circuit-breaker tests: state machine, jittered backoff, supervised
+recovery (probe + catalog re-stage), TPUSolver integration (instant CPU
+fallback with identical decisions), and the provisioner's synchronous
+ticking while the breaker is open."""
+import time
+
+import pytest
+
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from karpenter_tpu.solver.rpc import SolverClient, SolverServer
+from karpenter_tpu.solver.service import TPUSolver
+
+
+def _signature(result):
+    return (
+        sorted((len(g.pods), g.instance_types[0].name) for g in result.new_groups),
+        sorted(result.unschedulable),
+        sorted(result.existing_assignments.items()),
+    )
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in cloud.describe_zones()},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [
+        SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()
+    ]
+    return prov.list(nc)
+
+
+def make_pods(n, cpu="500m", mem="1Gi"):
+    return [Pod(f"p{i}", requests=Resources({"cpu": cpu, "memory": mem})) for i in range(n)]
+
+
+class TestStateMachine:
+    def test_trips_after_k_consecutive_failures(self):
+        b = CircuitBreaker(failure_threshold=3, rng=lambda: 0.0)
+        assert b.allow()
+        assert b.record_failure() is False
+        assert b.record_failure() is False
+        assert b.record_failure() is True
+        assert b.state == OPEN and not b.allow()
+        assert b.trips == 1
+
+    def test_success_resets_the_consecutive_count(self):
+        b = CircuitBreaker(failure_threshold=2, rng=lambda: 0.0)
+        b.record_failure()
+        b.record_success()
+        assert b.record_failure() is False, "success must reset the streak"
+        assert b.state == CLOSED
+
+    def test_probe_failure_doubles_backoff_with_jitter_cap(self):
+        clk = FakeClock(100.0)
+        b = CircuitBreaker(
+            failure_threshold=1, backoff_base=1.0, backoff_max=4.0,
+            probe=lambda: False, clock=clk.now, rng=lambda: 0.5,
+        )
+        b.record_failure()
+        d = b.describe()
+        # jitter factor with rng=0.5 is 1.25
+        assert d["next_probe_in_s"] == pytest.approx(1.25)
+        clk.step(2.0)
+        assert b.maybe_probe() is False
+        assert b.describe()["backoff_s"] == pytest.approx(2.0)
+        clk.step(10.0)
+        b.maybe_probe()
+        clk.step(10.0)
+        b.maybe_probe()
+        assert b.describe()["backoff_s"] == pytest.approx(4.0), "capped"
+        assert b.probes_failed == 3
+
+    def test_probe_not_due_does_not_run(self):
+        clk = FakeClock(0.0)
+        calls = []
+        b = CircuitBreaker(
+            failure_threshold=1, backoff_base=10.0,
+            probe=lambda: calls.append(1) or True, clock=clk.now, rng=lambda: 0.0,
+        )
+        b.record_failure()
+        assert b.maybe_probe() is False and not calls
+        clk.step(11.0)
+        assert b.maybe_probe() is True and len(calls) == 1
+        assert b.state == CLOSED
+
+    def test_promotion_runs_on_promote_before_traffic_reenters(self):
+        order = []
+        b = CircuitBreaker(
+            failure_threshold=1, probe=lambda: True,
+            on_promote=lambda: order.append(("promote", b.allow())),
+            rng=lambda: 0.0,
+        )
+        b.record_failure()
+        assert b.probe_now() is True
+        # the re-stage hook observed allow() still False: no solve can race
+        # onto the wire before the stale connection is dropped
+        assert order == [("promote", False)]
+        assert b.allow() and b.promotions == 1
+
+    def test_describe_fields(self):
+        b = CircuitBreaker(failure_threshold=2, rng=lambda: 0.0)
+        d = b.describe()
+        assert d["state"] == CLOSED and d["open_for_s"] is None
+        b.record_failure()
+        b.record_failure()
+        d = b.describe()
+        assert d["state"] == OPEN
+        assert d["consecutive_failures"] == 2
+        assert d["next_probe_in_s"] is not None
+
+    def test_half_open_rejects_regular_traffic(self):
+        import threading
+
+        started = threading.Event()
+        release = threading.Event()
+
+        def probe():
+            started.set()
+            release.wait(timeout=5.0)
+            return True
+
+        b = CircuitBreaker(failure_threshold=1, probe=probe, rng=lambda: 0.0,
+                           backoff_base=0.0)
+        b.record_failure()
+        t = threading.Thread(target=b.probe_now, daemon=True)
+        t.start()
+        assert started.wait(timeout=5.0)
+        assert b.state == HALF_OPEN and not b.allow()
+        release.set()
+        t.join(timeout=5.0)
+        assert b.state == CLOSED
+
+
+class TestSolverIntegration:
+    def test_dead_sidecar_degrades_then_short_circuits(self, catalog_items, failpoints):
+        """The acceptance shape: sidecar down -> the first K ticks pay the
+        bounded connect failure and fall back to the CPU path; the breaker
+        opens; subsequent ticks never touch the socket and complete fast.
+        Decisions are identical throughout."""
+        from karpenter_tpu import metrics
+
+        pool = NodePool("default")
+        pods = make_pods(12)
+        ref = TPUSolver(g_max=64)
+        want = _signature(ref.solve(pool, catalog_items, list(pods)))
+
+        client = SolverClient(path="/tmp/karpenter-breaker-test-no-such.sock",
+                              connect_timeout=0.2)
+        breaker = CircuitBreaker(failure_threshold=2, backoff_base=1000.0)
+        s = TPUSolver(g_max=64, client=client, breaker=breaker)
+        # count connect ATTEMPTS without changing behavior (latency 0)
+        failpoints.arm("rpc.client.connect", "latency", "0")
+
+        assert _signature(s.solve(pool, catalog_items, list(pods))) == want
+        assert breaker.state == CLOSED
+        assert _signature(s.solve(pool, catalog_items, list(pods))) == want
+        assert breaker.state == OPEN
+        attempts_before = failpoints.hits("rpc.client.connect")
+        t0 = time.perf_counter()
+        assert _signature(s.solve(pool, catalog_items, list(pods))) == want
+        wall = time.perf_counter() - t0
+        assert failpoints.hits("rpc.client.connect") == attempts_before, (
+            "an open breaker must not attempt any connection"
+        )
+        assert wall < 2.0, f"breaker-open tick stalled: {wall:.2f}s"
+        assert metrics.BREAKER_SHORT_CIRCUITS.value() >= 1
+
+    def test_supervised_recovery_restages_and_repromotes(self, catalog_items, tmp_path):
+        """Sidecar comes back: probe_now() promotes, the promotion hook
+        drops the connection, and the next solve re-stages on the fresh
+        sidecar and returns over the wire -- identical decisions before,
+        during, and after the outage."""
+        pool = NodePool("default")
+        pods = make_pods(9)
+        ref = TPUSolver(g_max=64)
+        want = _signature(ref.solve(pool, catalog_items, list(pods)))
+
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        try:
+            client = SolverClient(path=path, connect_timeout=0.3)
+            breaker = CircuitBreaker(failure_threshold=1, backoff_base=1000.0)
+            s = TPUSolver(g_max=64, client=client, breaker=breaker)
+            assert _signature(s.solve(pool, catalog_items, list(pods))) == want
+            assert client._staged_seqnums, "healthy path staged on the sidecar"
+
+            # outage: kill the sidecar. stop() only closes the LISTENER
+            # (handler threads are daemons); a real process death also
+            # severs the live connection, which close() models here.
+            srv.stop()
+            client.close()
+            assert _signature(s.solve(pool, catalog_items, list(pods))) == want
+            assert breaker.state == OPEN
+            assert breaker.probe_now() is False, "probe against a dead sidecar fails"
+            assert breaker.state == OPEN
+
+            # recovery: a NEW sidecar process on the same path
+            srv = SolverServer(path=path).start()
+            assert breaker.probe_now() is True
+            assert breaker.state == CLOSED
+            assert not client._staged_seqnums, (
+                "promotion must clear staging so the fresh sidecar re-stages"
+            )
+            assert _signature(s.solve(pool, catalog_items, list(pods))) == want
+            assert client._staged_seqnums, "post-promotion solve re-staged over the wire"
+            assert breaker.state == CLOSED
+        finally:
+            srv.stop()
+
+    def test_wire_healthy_gates_the_pipelined_tick(self, catalog_items):
+        """The provisioner keeps ticking SYNCHRONOUSLY while the breaker
+        is open: wire_healthy() is False, so the double-buffered dispatch
+        never engages and every decision applies in its own tick."""
+        from karpenter_tpu.operator import Operator
+
+        client = SolverClient(path="/tmp/karpenter-breaker-test-no-such.sock",
+                              connect_timeout=0.2)
+        breaker = CircuitBreaker(failure_threshold=1, backoff_base=1000.0)
+        s = TPUSolver(g_max=64, client=client, breaker=breaker)
+        assert s.wire_healthy()
+        op = Operator(clock=FakeClock(1.0), solver=s)
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        for i in range(8):
+            op.cluster.create(Pod(f"w{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        op.settle(max_ticks=20)
+        assert not op.cluster.pending_pods(), "degraded rig still provisions"
+        assert breaker.state == OPEN
+        assert not s.wire_healthy()
+        assert op.provisioner._inflight is None, (
+            "breaker open -> no pipelined dispatch may be left in flight"
+        )
+
+    def test_health_endpoints_expose_breaker_state(self):
+        """/debug/breaker serves the full state document (loopback-only)
+        and /healthz carries the state line without changing liveness."""
+        import json
+        import urllib.request
+
+        from karpenter_tpu.operator.health import HealthServer
+
+        b = CircuitBreaker(failure_threshold=1, backoff_base=1000.0, rng=lambda: 0.0)
+        srv = HealthServer(port=0).start()
+        srv.breaker_info = b.describe
+        try:
+            srv.beat_loop()
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/breaker", timeout=10).read())
+            assert doc["state"] == CLOSED
+            b.record_failure()
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/breaker", timeout=10).read())
+            assert doc["state"] == OPEN and doc["trips"] == 1
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=10).read().decode()
+            assert "solver-wire-breaker: open" in body, (
+                "an open breaker is degraded-but-ALIVE: state in the body, status 200"
+            )
+        finally:
+            srv.stop()
+
+    def test_debug_breaker_without_wire_reports_unconfigured(self):
+        import json
+        import urllib.request
+
+        from karpenter_tpu.operator.health import HealthServer
+
+        srv = HealthServer(port=0).start()
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/breaker", timeout=10).read())
+            assert doc == {"configured": False}
+        finally:
+            srv.stop()
+
+    def test_breaker_false_disables(self):
+        s = TPUSolver(g_max=64, client=object(), breaker=False)
+        assert s.breaker is None
+        assert s.wire_healthy()
+
+    def test_default_breaker_is_self_recovering(self):
+        """A TPUSolver-created breaker must carry its own probe driver
+        (auto_probe): an embedder that never calls maybe_probe() would
+        otherwise stay on the CPU path forever after one transient
+        outage."""
+        s = TPUSolver(g_max=64, client=object())
+        assert s.breaker is not None
+        assert s.breaker.auto_probe is True
+        assert s.breaker._probe is not None and s.breaker._on_promote is not None
+
+    def test_in_process_solver_has_no_breaker(self):
+        s = TPUSolver(g_max=64)
+        assert s.breaker is None and s.wire_healthy()

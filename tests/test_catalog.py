@@ -131,6 +131,29 @@ class TestOfferings:
         ]
         assert marked and not marked[0].available
 
+    def test_ice_ttl_expiry_restores_the_offering(self, provider, nodeclass, clock):
+        """The scheduler routes around an ICE'd offering for the ICE TTL
+        only: a FakeClock advance past it (and past the catalog cache TTL)
+        rebuilds the list with the offering AVAILABLE again."""
+        from karpenter_tpu.cache import INSTANCE_TYPES_AND_OFFERINGS_TTL
+        from karpenter_tpu.cache.unavailable_offerings import DEFAULT_ICE_TTL
+
+        items = {it.name: it for it in provider.list(nodeclass)}
+        target = items["m5.large"].offerings[0]
+        provider.unavailable.mark_unavailable("m5.large", target.zone, target.capacity_type)
+        marked = {it.name: it for it in provider.list(nodeclass)}
+        assert not [
+            o for o in marked["m5.large"].offerings
+            if o.zone == target.zone and o.capacity_type == target.capacity_type
+        ][0].available
+        clock.step(max(DEFAULT_ICE_TTL, INSTANCE_TYPES_AND_OFFERINGS_TTL) + 1.0)
+        restored = {it.name: it for it in provider.list(nodeclass)}
+        back = [
+            o for o in restored["m5.large"].offerings
+            if o.zone == target.zone and o.capacity_type == target.capacity_type
+        ]
+        assert back and back[0].available, "offering must return after the ICE TTL"
+
     def test_reserved_injected_fresh_with_price_floor(self, provider, nodeclass):
         nodeclass.status_capacity_reservations = [
             CapacityReservationStatus(
@@ -290,6 +313,53 @@ class TestICECache:
         assert not ice.is_unavailable("m5.large", "z1", "spot")
         ice.mark_unavailable("x", "y", "spot")
         assert ice.seq_num > seq
+
+    def test_each_subcache_expires_independently(self, clock):
+        """Every mark family ('per offering', 'per capacity type', 'per
+        (zone, capacity type)') clears on its OWN TTL under a FakeClock
+        advance -- only the mark path was covered before."""
+        ice = UnavailableOfferings(clock, ttl=60.0)
+        ice.mark_unavailable("m5.large", "z1", "spot")
+        clock.step(30.0)
+        ice.mark_capacity_type_unavailable("spot")
+        ice.mark_az_unavailable("z2", "on-demand")
+        clock.step(31.0)  # first mark past its TTL, later marks still live
+        assert ice.is_unavailable("m5.large", "z1", "spot"), "capacity-type mark still holds"
+        assert ice.is_unavailable("c5.large", "z2", "on-demand")
+        clock.step(30.0)  # everything expired
+        assert not ice.is_unavailable("m5.large", "z1", "spot")
+        assert not ice.is_unavailable("c5.large", "z2", "on-demand")
+
+    def test_mark_and_seqnum_are_atomic(self, clock):
+        """The mark and its seqnum bump happen under ONE lock acquisition:
+        a reader that observes a bumped seqnum must also observe the mark
+        (catalog cache keys fold the seqnum in; a fresh key over a stale
+        view would cache wrong availability until the next bump)."""
+        import threading
+
+        ice = UnavailableOfferings(clock, ttl=3600.0)
+        violations = []
+        stop = threading.Event()
+
+        def reader():
+            last_seq = ice.seq_num
+            while not stop.is_set():
+                seq = ice.seq_num
+                if seq > last_seq:
+                    # seq covers marks 1..seq: every marked key <= seq-1
+                    # must already be visible
+                    for k in range(seq):
+                        if not ice.is_unavailable(f"t{k}", "z", "spot"):
+                            violations.append((seq, k))
+                    last_seq = seq
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        for k in range(200):
+            ice.mark_unavailable(f"t{k}", "z", "spot")
+        stop.set()
+        t.join(timeout=5.0)
+        assert not violations, f"seqnum observed before its mark: {violations[:3]}"
 
 
 class TestEvictionThresholds:

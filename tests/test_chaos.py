@@ -1,0 +1,281 @@
+"""Chaos soak: seeded fault schedules against the production topology.
+
+The kwok rig runs the REAL deployed shape -- pipelined provisioner tick,
+solver behind the RPC sidecar on a UNIX socket, circuit breaker armed --
+while a seeded schedule injects faults through the failpoint framework
+(karpenter_tpu/failpoints.py): sidecar death mid-flight, connection drops,
+corrupted reply frames, wire latency, erroring dispatches, launch ICE
+storms, batcher failures. Three invariants hold for EVERY seed:
+
+1. no pod lost or double-launched: every pod converges to exactly one
+   bound node, provider ids stay unique, usage fits allocatable, and no
+   orphan instance survives the final GC drain;
+2. sync and pipelined decisions stay bit-identical under mid-flight
+   faults (the differential family below);
+3. the scheduler converges after every fault clears -- with the breaker
+   re-promoted through the supervised probe when it opened.
+
+Each round additionally asserts its failpoint's fire count: a fault
+schedule whose faults never actually fired proves nothing.
+
+`KARPENTER_TPU_CHAOS_SEEDS` bounds the seed count (default 20, the
+acceptance floor; `make chaos` runs exactly that). The full-length
+schedule (more rounds per seed) stays behind `-m slow`.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Pod, TPUNodeClass
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.failpoints import FAILPOINTS
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver.breaker import CLOSED, CircuitBreaker
+from karpenter_tpu.solver.rpc import SolverClient, SolverServer
+from karpenter_tpu.solver.service import TPUSolver
+from tests.test_soak import check_invariants
+
+N_SEEDS = int(os.environ.get("KARPENTER_TPU_CHAOS_SEEDS", "20"))
+
+# fault name -> (site, arm thunk). Budgets are finite so every fault
+# self-clears; "sidecar_dead" is the exception (unbounded, cleared by the
+# schedule + supervised probe).
+FAULTS = {
+    "conn_drop": ("rpc.server.conn", lambda: FAILPOINTS.arm(
+        "rpc.server.conn", "error", "ConnectionError", times=2)),
+    "corrupt_frame": ("rpc.frame.corrupt", lambda: FAILPOINTS.arm(
+        "rpc.frame.corrupt", "corrupt", times=2)),
+    "wire_latency": ("rpc.server.dispatch", lambda: FAILPOINTS.arm(
+        "rpc.server.dispatch", "latency", "0.02", times=4)),
+    "server_error": ("rpc.server.dispatch", lambda: FAILPOINTS.arm(
+        "rpc.server.dispatch", "error", "RuntimeError", times=2)),
+    "ice_storm": ("instance.launch", lambda: FAILPOINTS.arm(
+        "instance.launch", "error", "InsufficientCapacityError", times=2)),
+    "batch_error": ("batcher.exec", lambda: FAILPOINTS.arm(
+        "batcher.exec", "error", "RuntimeError", times=1)),
+    "sidecar_dead": ("rpc.client.connect", lambda: FAILPOINTS.arm(
+        "rpc.client.connect", "error", "ConnectionError")),
+}
+SIZES = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi")]
+
+
+def _rig(tmp_path):
+    path = str(tmp_path / "solver.sock")
+    srv = SolverServer(path=path).start()
+    client = SolverClient(path=path, timeout=10.0, connect_timeout=0.25)
+    breaker = CircuitBreaker(failure_threshold=2, backoff_base=1000.0)
+    solver = TPUSolver(g_max=64, client=client, breaker=breaker)
+    op = Operator(clock=FakeClock(50_000.0), solver=solver)
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    return srv, client, breaker, op
+
+
+def _burst(op, rng, seed, start, n):
+    for i in range(n):
+        cpu, mem = SIZES[int(rng.integers(0, len(SIZES)))]
+        op.cluster.create(
+            Pod(f"chaos-{seed}-{start + i}", requests=Resources({"cpu": cpu, "memory": mem}))
+        )
+    return start + n
+
+
+def _settle(op, max_ticks=40):
+    for _ in range(max_ticks):
+        op.tick()
+        check_invariants(op)
+        if not op.cluster.pending_pods():
+            return True
+        op.clock.step(3.0)
+    return False
+
+
+def _drive_chaos_schedule(tmp_path, seed, rounds):
+    rng = np.random.default_rng(1000 + seed)
+    srv, client, breaker, op = _rig(tmp_path)
+    solver = op.solver
+    pod_seq = 0
+    fault_names = sorted(FAULTS)
+    try:
+        for round_i in range(rounds):
+            fault = fault_names[int(rng.integers(0, len(fault_names)))]
+            site, arm = FAULTS[fault]
+            arm()
+            if fault == "sidecar_dead":
+                # a kill also severs the live connection mid-flight: a
+                # dispatched pipelined solve loses its reply and the next
+                # drain must degrade through the ladder to the CPU path
+                client.close()
+            pod_seq = _burst(op, rng, seed, pod_seq, int(rng.integers(3, 9)))
+            # drive ticks WITH the fault armed so it bites mid-flight; if
+            # the round's workload never reached the armed site (e.g. every
+            # pod fit existing capacity, so no launch fired), feed it more
+            # work -- the fired-count assertion below is the acceptance
+            # criterion that each scheduled fault actually happened
+            for _ in range(4):
+                for _ in range(3):
+                    op.tick()
+                    check_invariants(op)
+                    op.clock.step(3.0)
+                if FAILPOINTS.fires(site) > 0:
+                    break
+                pod_seq = _burst(op, rng, seed, pod_seq, int(rng.integers(2, 5)))
+            fired = FAILPOINTS.fires(site)
+            assert fired >= 1, f"seed {seed} round {round_i}: fault {fault} never fired"
+            if fault == "sidecar_dead":
+                FAILPOINTS.disarm(site)
+                # supervised recovery: the sidecar is back; the probe must
+                # promote and gate the wire path on a catalog re-stage
+                assert breaker.probe_now() is True, "probe against restored sidecar"
+                assert breaker.state == CLOSED
+            if breaker.state != CLOSED:
+                # a transient fault tripped the breaker; the fault budget
+                # is drained, so the probe must re-promote
+                assert breaker.probe_now() is True, (
+                    f"seed {seed} round {round_i}: breaker stuck open after {fault}"
+                )
+            assert _settle(op), (
+                f"seed {seed} round {round_i}: never converged after {fault}"
+            )
+            FAILPOINTS.reset()
+        assert solver.wire_healthy(), "every schedule ends re-promoted"
+        # end-state invariants: no orphan instance survives the GC drain,
+        # provider ids stay unique (no double-launch), every pod bound
+        for _ in range(10):
+            op.tick()
+            op.clock.step(10.0)
+        check_invariants(op)
+        for p in op.cluster.list(Pod):
+            assert p.node_name, f"pod {p.metadata.name} lost (never bound)"
+        claimed = {c.provider_id for c in op.cluster.list(NodeClaim) if c.provider_id}
+        for inst in op.cloud.describe_instances():
+            if inst.state == "running":
+                assert inst.provider_id in claimed, f"orphan instance {inst.id}"
+    finally:
+        FAILPOINTS.reset()
+        breaker.stop()
+        client.close()
+        srv.stop()
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_chaos_fault_schedule(seed, failpoints, tmp_path):
+    _drive_chaos_schedule(tmp_path, seed, rounds=3)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(5))
+def test_chaos_fault_schedule_full_length(seed, failpoints, tmp_path):
+    """The long soak: the same schedule machinery at 8 rounds per seed
+    (every fault shape is near-certain to occur per seed)."""
+    _drive_chaos_schedule(tmp_path, seed, rounds=8)
+
+
+# -- invariant 2: sync == pipelined decisions under mid-flight faults --------
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in cloud.describe_zones()},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [
+        SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()
+    ]
+    return prov.list(nc)
+
+
+def _signature(result):
+    return (
+        sorted(
+            (len(g.pods), g.instance_types[0].name, tuple(sorted(p.metadata.name for p in g.pods)))
+            for g in result.new_groups
+        ),
+        sorted(result.unschedulable),
+        sorted(result.existing_assignments.items()),
+    )
+
+
+MIDFLIGHT_FAULTS = {
+    "none": None,
+    "corrupt_frame": ("rpc.frame.corrupt", lambda: FAILPOINTS.arm(
+        "rpc.frame.corrupt", "corrupt", times=1)),
+    "server_error": ("rpc.server.dispatch", lambda: FAILPOINTS.arm(
+        "rpc.server.dispatch", "error", "RuntimeError", times=1)),
+    "conn_drop": ("rpc.server.conn", lambda: FAILPOINTS.arm(
+        "rpc.server.conn", "error", "ConnectionError", times=1)),
+    "sever_mid_flight": ("rpc.client.connect", lambda: FAILPOINTS.arm(
+        "rpc.client.connect", "error", "ConnectionError")),
+}
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_chaos_sync_equals_pipelined(seed, failpoints, catalog_items, tmp_path):
+    """Invariant 2 of the chaos contract: whatever fault lands between the
+    pipelined dispatch and its barrier, the decision the barrier returns is
+    bit-identical to a clean synchronous in-process solve of the same
+    inputs (the ladder degrades, never diverges)."""
+    rng = np.random.default_rng(7000 + seed)
+    pool = NodePool("default")
+    path = str(tmp_path / "solver.sock")
+    srv = SolverServer(path=path).start()
+    client = SolverClient(path=path, timeout=10.0, connect_timeout=0.25)
+    solver = TPUSolver(g_max=64, client=client,
+                       breaker=CircuitBreaker(failure_threshold=2, backoff_base=1000.0))
+    ref = TPUSolver(g_max=64)
+    fault_names = sorted(MIDFLIGHT_FAULTS)
+    try:
+        for i in range(4):
+            n = int(rng.integers(4, 14))
+            cpus = ["250m", "500m", "1", "2"]
+            pods = [
+                Pod(f"d-{seed}-{i}-{j}",
+                    requests=Resources({"cpu": cpus[int(rng.integers(0, 4))], "memory": "1Gi"}))
+                for j in range(n)
+            ]
+            fault = fault_names[int(rng.integers(0, len(fault_names)))]
+            spec = MIDFLIGHT_FAULTS[fault]
+            sever = fault == "sever_mid_flight"
+            if spec is not None and not sever:
+                spec[1]()
+            pending = solver.solve_begin(pool, catalog_items, list(pods))
+            if sever:
+                # the reply is in flight: kill the connection under it and
+                # refuse reconnects, so the barrier must take the CPU path
+                spec[1]()
+                client.close()
+            got = solver.solve_finish(pending)
+            if spec is not None:
+                assert FAILPOINTS.fires(spec[0]) >= 1, f"{fault} never fired"
+            want = ref.solve(pool, catalog_items, list(pods))
+            assert _signature(got) == _signature(want), (
+                f"seed {seed} iter {i}: decision diverged under {fault}"
+            )
+            FAILPOINTS.reset()
+            if solver.breaker.state != CLOSED:
+                assert solver.breaker.probe_now() is True
+    finally:
+        FAILPOINTS.reset()
+        solver.breaker.stop()
+        client.close()
+        srv.stop()

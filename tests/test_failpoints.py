@@ -1,0 +1,217 @@
+"""Failpoint framework unit tests: arming grammar, firing discipline
+(times/after/p), determinism, byte corruption, env arming, and the RPC
+frame-integrity sites the chaos soak relies on."""
+import socket
+import time
+
+import pytest
+
+from karpenter_tpu.failpoints import ENV, SEED_ENV, FailpointRegistry
+from karpenter_tpu.solver.rpc import _recv_frame, _send_frame
+
+
+class TestFiringDiscipline:
+    def test_error_raises_and_counts(self, failpoints):
+        failpoints.arm("a.b", "error", "RuntimeError", times=2)
+        for _ in range(2):
+            with pytest.raises(RuntimeError, match="failpoint a.b"):
+                failpoints.eval("a.b")
+        failpoints.eval("a.b")  # budget drained: passes through
+        assert failpoints.fires("a.b") == 2
+        assert failpoints.hits("a.b") == 3
+
+    def test_default_exception_is_connection_error(self, failpoints):
+        failpoints.arm("a.c", "error")
+        with pytest.raises(ConnectionError):
+            failpoints.eval("a.c")
+
+    def test_cloud_error_taxonomy_resolves(self, failpoints):
+        from karpenter_tpu.errors import InsufficientCapacityError
+
+        failpoints.arm("launch", "error", "InsufficientCapacityError")
+        with pytest.raises(InsufficientCapacityError):
+            failpoints.eval("launch")
+
+    def test_after_skips_leading_evaluations(self, failpoints):
+        failpoints.arm("warm", "error", "RuntimeError", after=2)
+        failpoints.eval("warm")
+        failpoints.eval("warm")
+        with pytest.raises(RuntimeError):
+            failpoints.eval("warm")
+        assert failpoints.fires("warm") == 1
+
+    def test_kill_after_passes_then_fires_forever(self, failpoints):
+        failpoints.arm("sidecar", "kill_after", "3")
+        for _ in range(3):
+            failpoints.eval("sidecar")
+        for _ in range(4):
+            with pytest.raises(ConnectionError):
+                failpoints.eval("sidecar")
+        assert failpoints.fires("sidecar") == 4
+
+    def test_latency_sleeps(self, failpoints):
+        failpoints.arm("slow", "latency", "0.05", times=1)
+        t0 = time.perf_counter()
+        failpoints.eval("slow")
+        assert time.perf_counter() - t0 >= 0.045
+        failpoints.eval("slow")  # drained: no sleep
+
+    def test_unarmed_site_is_a_noop(self, failpoints):
+        failpoints.eval("never.armed")
+        assert failpoints.hits("never.armed") == 0
+
+    def test_kind_mismatch_is_inert_but_loud(self, failpoints):
+        """corrupt armed at a control-flow site (or error at a byte-stream
+        site) can never fire; it must stay inert at runtime but warn so a
+        misarmed drill is not a silent no-op."""
+        failpoints.arm("flow.site", "corrupt")
+        failpoints.eval("flow.site")  # no crash, no fire
+        assert failpoints.fires("flow.site") == 0
+        assert "flow.site" in failpoints._kind_warned
+        failpoints.arm("stream.site", "error")
+        data = b"\x00\x00\x00\x01x" * 4
+        assert failpoints.corrupt("stream.site", data) == data
+        assert failpoints.fires("stream.site") == 0
+        assert "stream.site" in failpoints._kind_warned
+
+    def test_disarm_and_reset(self, failpoints):
+        failpoints.arm("x", "error")
+        failpoints.disarm("x")
+        failpoints.eval("x")
+        failpoints.arm("y", "error")
+        failpoints.reset()
+        assert not failpoints.armed
+        failpoints.eval("y")
+
+
+class TestDeterminism:
+    def test_probability_sequence_replays_per_seed(self):
+        def outcomes(seed):
+            reg = FailpointRegistry(seed=seed)
+            reg.arm("p.site", "error", "RuntimeError", p=0.5)
+            out = []
+            for _ in range(32):
+                try:
+                    reg.eval("p.site")
+                    out.append(0)
+                except RuntimeError:
+                    out.append(1)
+            return out
+
+        a, b, c = outcomes(7), outcomes(7), outcomes(8)
+        assert a == b, "same seed must replay bit-identically"
+        assert a != c, "different seeds must differ"
+        assert 0 < sum(a) < 32, "p=0.5 should fire some but not all"
+
+    def test_corrupt_positions_replay_per_seed(self):
+        data = bytes(range(64)) * 4
+
+        def corruptions(seed):
+            reg = FailpointRegistry(seed=seed)
+            reg.arm("c.site", "corrupt", times=4)
+            return [reg.corrupt("c.site", data) for _ in range(4)]
+
+        assert corruptions(3) == corruptions(3)
+        got = corruptions(3)[0]
+        assert got != data and len(got) == len(data)
+        # the length prefix is never touched (corruption must be DETECTED
+        # by the frame's own integrity checks, not turn into a hang)
+        assert got[:4] == data[:4]
+
+
+class TestSpecGrammar:
+    def test_arm_spec_full_grammar(self, failpoints):
+        failpoints.arm_spec(
+            "a=error(RuntimeError):times=1;b=latency(0.001);c=corrupt:p=0.5;d=kill_after(2)"
+        )
+        assert failpoints.get("a").action == "error"
+        assert failpoints.get("a").times == 1
+        assert failpoints.get("b").arg == "0.001"
+        assert failpoints.get("c").p == 0.5
+        d = failpoints.get("d")
+        assert d.action == "error" and d.after == 2 and d.times is None
+
+    @pytest.mark.parametrize("bad", ["nosep", "a=", "=error", "a=error:bogus=1", "a=frobnicate"])
+    def test_malformed_specs_fail_loudly(self, failpoints, bad):
+        with pytest.raises(ValueError):
+            failpoints.arm_spec(bad)
+
+    def test_env_arming_with_seed(self):
+        reg = FailpointRegistry()
+        reg.arm_from_env({ENV: "e.site=error(RuntimeError):times=1", SEED_ENV: "42"})
+        assert reg.seed == 42
+        with pytest.raises(RuntimeError):
+            reg.eval("e.site")
+
+    def test_empty_env_is_a_noop(self):
+        reg = FailpointRegistry()
+        reg.arm_from_env({})
+        assert not reg.armed
+
+
+class TestFrameIntegrity:
+    """The RPC sites that make injected corruption DETECTABLE: the crc32
+    payload checksum and the corrupt-header -> ConnectionError hardening."""
+
+    def _frame_roundtrip(self, mutate=None):
+        import numpy as np
+
+        a, b = socket.socketpair()
+        try:
+            import io
+
+            buf = io.BytesIO()
+
+            class _Sink:
+                def sendall(self, data):
+                    buf.write(data)
+
+            _send_frame(_Sink(), {"op": "test"}, [("t", np.arange(64, dtype=np.float32))])
+            data = bytearray(buf.getvalue())
+            if mutate is not None:
+                mutate(data)
+            a.sendall(bytes(data))
+            a.shutdown(socket.SHUT_WR)
+            return _recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_clean_frame_roundtrips_with_crc(self):
+        import numpy as np
+
+        header, tensors = self._frame_roundtrip()
+        assert "crc" in header
+        np.testing.assert_array_equal(tensors["t"], np.arange(64, dtype=np.float32))
+
+    def test_payload_flip_detected_by_crc(self):
+        def flip_last(data):
+            data[-1] ^= 0xFF
+
+        with pytest.raises(ConnectionError, match="crc mismatch"):
+            self._frame_roundtrip(flip_last)
+
+    def test_header_flip_detected_as_connection_error(self):
+        def flip_header(data):
+            data[6] ^= 0xFF  # inside the JSON header
+
+        with pytest.raises(ConnectionError):
+            self._frame_roundtrip(flip_header)
+
+    def test_corrupt_failpoint_self_heals_via_reconnect(self, failpoints):
+        """One corrupted request frame on a live server: the client's
+        roundtrip retry (close + reconnect + resend) recovers once the
+        failpoint's budget drains -- corruption is a transient, not an
+        outage."""
+        from karpenter_tpu.solver.rpc import SolverClient, SolverServer
+
+        srv = SolverServer(token="t").start()
+        client = SolverClient(*srv.address, token="t")
+        try:
+            assert client.ping() is True  # clean connection established
+            failpoints.arm("rpc.frame.corrupt", "corrupt", times=1)
+            assert client.ping() is True  # corrupted once, retried clean
+            assert failpoints.fires("rpc.frame.corrupt") == 1
+        finally:
+            client.close()
+            srv.stop()

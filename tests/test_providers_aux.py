@@ -217,6 +217,61 @@ class TestCloudBatchers:
         assert cloud.describe_instances([iid])[0].state == "terminated"
 
 
+class TestCapacityReservationUnavailableExpiry:
+    """The `_unavailable` transient-exhaustion marks ('zero it until
+    refresh') and the launch/terminate deltas must EXPIRE when the
+    describe cache refreshes under a FakeClock advance -- before this only
+    the mark path was covered."""
+
+    def _provider(self, clock):
+        from karpenter_tpu.cloud.types import CapacityReservationInfo
+        from karpenter_tpu.kwok.cloud import FakeCloud
+        from karpenter_tpu.providers.capacityreservation import CapacityReservationProvider
+
+        cloud = FakeCloud(clock=clock)
+        cloud.add_capacity_reservation(
+            CapacityReservationInfo(
+                id="cr-1", instance_type="m5.large", zone="zone-a",
+                total_count=4, available_count=4,
+            )
+        )
+        return CapacityReservationProvider(cloud, clock)
+
+    def test_unavailable_mark_clears_on_ttl_refresh(self, clock):
+        from karpenter_tpu.cache import CAPACITY_RESERVATION_TTL
+
+        prov = self._provider(clock)
+        described = prov.list()[0].available_count
+        prov.mark_unavailable("cr-1")
+        assert prov.available_count("cr-1", described) == 0
+        seq = prov.seq_num
+        # still inside the TTL: the exhaustion mark holds (the cached
+        # describe would otherwise re-oversubscribe immediately)
+        clock.step(CAPACITY_RESERVATION_TTL / 2)
+        prov.list()
+        assert prov.available_count("cr-1", described) == 0
+        # past the TTL: the fresh describe supersedes the transient mark
+        clock.step(CAPACITY_RESERVATION_TTL)
+        fresh = prov.list()[0].available_count
+        assert prov.available_count("cr-1", fresh) == fresh > 0
+        assert prov.seq_num == seq, "refresh clears marks without a seq bump"
+
+    def test_launch_deltas_clear_on_ttl_refresh(self, clock):
+        from karpenter_tpu.cache import CAPACITY_RESERVATION_TTL
+
+        prov = self._provider(clock)
+        described = prov.list()[0].available_count
+        prov.mark_launched("cr-1")
+        prov.mark_launched("cr-1")
+        assert prov.available_count("cr-1", described) == described - 2
+        prov.mark_terminated("cr-1")
+        assert prov.available_count("cr-1", described) == described - 1
+        clock.step(CAPACITY_RESERVATION_TTL + 1.0)
+        fresh = prov.list()[0].available_count
+        # fresh counts supersede the in-memory adjustments
+        assert prov.available_count("cr-1", fresh) == fresh
+
+
 class TestCapacityBlockExpiration:
     def test_expiring_block_drains_claims_ahead_of_cliff(self, clock):
         op = Operator(clock=clock)
