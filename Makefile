@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos
+.PHONY: test deflake benchmark benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos sim-corpus
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -36,6 +36,9 @@ benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
 
 chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count (full-length schedule stays behind -m slow)
 	KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py -q -m 'not slow' $(call STAMP,chaos)
+
+sim-corpus:  ## differential-replay the committed scenario corpus (host vs wire vs pipelined, golden digests); shrinks any failing trace into sim-artifacts/
+	$(PY) -m karpenter_tpu sim corpus --dir tests/golden/scenarios --artifacts sim-artifacts $(call STAMP,sim-corpus)
 
 e2e:  ## scale + end-to-end suites only
 	$(PYTEST) tests/test_scale.py tests/test_e2e_provisioning.py tests/test_storage.py tests/test_soak.py -q

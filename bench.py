@@ -596,6 +596,35 @@ def _breaker_degraded(pool, items, zones, rng, iters: int) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _sim_scenario() -> dict:
+    """Scenario-replay stage (sim subsystem): the medium diurnal scenario
+    -- sustained sinusoidal arrivals, then a 30% pod churn -- replayed
+    through the full operator stack on the in-process backend under
+    FakeClock. The headline is replay THROUGHPUT (operator sweeps per
+    wall-second, the capacity planning number for how fast policy changes
+    can be judged against a scenario corpus) plus the fleet KPIs the
+    scenario produces (cost-per-pod-hour, pending-latency p99, churn)."""
+    from karpenter_tpu.sim.replay import replay
+    from karpenter_tpu.sim.scenario import DEFAULT_SEED, build_scenario
+
+    events = build_scenario("diurnal-medium", seed=DEFAULT_SEED)
+    t0 = time.perf_counter()
+    result = replay(events, backend="host", seed=DEFAULT_SEED)
+    wall_s = time.perf_counter() - t0
+    return {
+        "sim_replay_ticks_per_s": round(result.ticks / wall_s, 2) if wall_s else 0.0,
+        "sim_replay_wall_s": round(wall_s, 2),
+        "sim_replay_ticks": result.ticks,
+        "sim_replay_events": result.events_applied,
+        "sim_scenario": "diurnal-medium",
+        "sim_decision_digest": result.digest[:16],
+        "sim_cost_per_pod_hour": result.kpis["cost_per_pod_hour"],
+        "sim_pending_latency_p99_s": result.kpis["pending_latency_p99_s"],
+        "sim_node_churn": result.kpis["node_churn"],
+        "sim_pods": result.kpis["pods_total"],
+    }
+
+
 def _tunnel_rtt_ms(n: int = 5) -> float:
     """Median cost of synchronously fetching a fresh 32-byte device array:
     the tunnel's flat per-round-trip tax (~0 on a local chip)."""
@@ -812,6 +841,13 @@ def run(profile: bool, progress=lambda ev: None):
         except Exception as e:  # noqa: BLE001
             secondary["breaker_degraded_error"] = f"{type(e).__name__}: {e}"[:200]
         progress({"ev": "phase", "name": "breaker_degraded"})
+        # scenario-replay stage (sim subsystem): ticks/s through the full
+        # operator stack on the medium diurnal scenario + its fleet KPIs
+        try:
+            secondary.update(_sim_scenario())
+        except Exception as e:  # noqa: BLE001
+            secondary["sim_replay_error"] = f"{type(e).__name__}: {e}"[:200]
+        progress({"ev": "phase", "name": "sim_scenario"})
 
     # decompose the wall-clock number into tunnel overhead vs compute.
     # Under axon the chip sits behind a network tunnel whose EVERY

@@ -8,6 +8,8 @@ plane -- runs self-contained). Flags mirror pkg/operator/options/options.go.
 
     python -m karpenter_tpu --help
     python -m karpenter_tpu --max-ticks 50 --tick-interval 0.1
+    python -m karpenter_tpu --sim-record trace.jsonl --max-ticks 50
+    python -m karpenter_tpu sim replay --differential trace.jsonl
 """
 from __future__ import annotations
 
@@ -29,6 +31,7 @@ def build_operator(args):
         tracing=getattr(args, "tracing", True),
         tracing_sample=getattr(args, "trace_sample", 0.2),
         tracing_slow_ms=getattr(args, "trace_slow_ms", 1000.0),
+        seed=getattr(args, "seed", None),
     )
     # feature gates merge over the defaults (reference: the core's
     # --feature-gates flag, checked e.g. at cmd/controller/main.go:45-47)
@@ -121,11 +124,20 @@ def build_operator(args):
             # sidecar and re-promotion restages the catalog
             from karpenter_tpu.solver.breaker import CircuitBreaker
 
+            breaker_kw = {}
+            if getattr(args, "seed", None) is not None:
+                # seed discipline: the backoff jitter joins the Options.seed
+                # derivation chain (the breaker takes an injected rng, so
+                # the seed is applied where the breaker is built)
+                from karpenter_tpu.seeding import seeded_rng
+
+                breaker_kw["rng"] = seeded_rng("breaker", args.seed).random
             breaker = CircuitBreaker(
                 failure_threshold=getattr(args, "breaker_failures", 3),
                 backoff_base=getattr(args, "breaker_backoff", 0.5),
                 backoff_max=getattr(args, "breaker_backoff_max", 30.0),
                 auto_probe=True,
+                **breaker_kw,
             )
         solver = TPUSolver(auto_warm=client is None, client=client, breaker=breaker)
         evaluator = ConsolidationEvaluator()
@@ -155,6 +167,14 @@ def build_operator(args):
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "sim":
+        # the simulation subsystem has its own verb-style CLI (generate /
+        # replay / shrink / corpus) -- see karpenter_tpu/sim/cli.py
+        from karpenter_tpu.sim.cli import main as sim_main
+
+        return sim_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="karpenter-tpu", description="TPU-native node provisioning controller (kwok rig)"
     )
@@ -247,6 +267,18 @@ def main(argv=None) -> int:
         "--trace-dump", action="store_true",
         help="print the slow-tick flight recorder (JSON span trees) on exit",
     )
+    parser.add_argument(
+        "--seed", type=int, default=None,
+        help="determinism root: every RNG on the replay path (object-name "
+        "suffixes, failpoint schedules, trace sampling, breaker jitter) "
+        "derives from this one seed (karpenter_tpu/sim/)",
+    )
+    parser.add_argument(
+        "--sim-record", default="", metavar="PATH",
+        help="capture this run as a replayable JSONL trace at the cluster/"
+        "cloud seam (pod arrivals/deletes, kills, interruptions, ICE, "
+        "pricing, clock advances); replay with `sim replay PATH`",
+    )
     args = parser.parse_args(argv)
 
     if args.failpoints:
@@ -254,6 +286,11 @@ def main(argv=None) -> int:
         # (catalog hydration, first connects) are injectable too
         from karpenter_tpu.failpoints import FAILPOINTS
 
+        # seed FIRST: a Failpoint captures the registry seed at arm time,
+        # so arming before the Operator's seed fan-out would build the
+        # fault schedule from the default seed and break --seed replays
+        if args.seed is not None:
+            FAILPOINTS.seed = args.seed
         FAILPOINTS.arm_spec(args.failpoints)
 
     # health endpoints come up BEFORE the operator graph builds: a slow
@@ -303,10 +340,22 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
 
+    recorder = None
+    if args.sim_record:
+        # capture hook at the cluster/cloud seam (sim subsystem): external
+        # events become a replayable trace, dumped on exit
+        from karpenter_tpu.sim.trace import TraceRecorder
+
+        recorder = TraceRecorder(
+            op.cluster, op.clock, scenario="recorded", seed=args.seed
+        ).attach(op.cloud if not kube_mode else None)
+
     ticks = 0
     op.watch_pods()   # pod arrivals wake the loop through the batch window
     while not stop["flag"]:
         swept = op.tick()
+        if recorder is not None and swept:
+            recorder.record_tick()
         if health is not None:
             # the LOOP beat proves the process turns (leader or standby:
             # liveness); the SWEEP beat only on a real sweep (readiness)
@@ -319,6 +368,9 @@ def main(argv=None) -> int:
         op.wait_for_work(args.tick_interval)
     if health is not None:
         health.stop()
+    if recorder is not None:
+        n = recorder.dump(args.sim_record)
+        print(f"sim trace: {n} events -> {args.sim_record}", file=sys.stderr)
 
     if args.metrics_dump:
         from karpenter_tpu import metrics

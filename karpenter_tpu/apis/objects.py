@@ -143,5 +143,26 @@ class Lease(APIObject):
         self.renew_deadline = renew_deadline
 
 
+# seedable name generation (seed discipline, sim subsystem): generated
+# object names (NodeClaim suffixes, and through them kwok node names) are
+# part of the scheduler's observable decision stream. Under a seed --
+# Operator(Options(seed=...)) calls seed_object_names -- suffixes come
+# from a dedicated deterministic RNG drawn once per claim on the single
+# reconcile thread, so two replays of one trace emit byte-identical
+# decision logs. Unseeded (production default) stays uuid4.
+_name_rng = None
+
+
+def seed_object_names(seed: Optional[int]) -> None:
+    if seed is None:
+        globals()["_name_rng"] = None
+    else:
+        import random
+
+        globals()["_name_rng"] = random.Random(f"object-names:{seed}")
+
+
 def generate_name(prefix: str) -> str:
+    if _name_rng is not None:
+        return f"{prefix}{_name_rng.getrandbits(32):08x}"
     return f"{prefix}{uuid.uuid4().hex[:8]}"

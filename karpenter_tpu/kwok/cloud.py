@@ -153,6 +153,22 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
         self.calls: Dict[str, int] = {}
         # injectable per-API errors: api name -> list of exceptions to raise
         self.inject_errors: Dict[str, List[Exception]] = {}
+        # chaos observers (sim/trace.TraceRecorder): callbacks fired on
+        # external mutations of the emulated cloud -- kills, interruption
+        # sends, capacity-pool edits, price overrides -- so a live or
+        # chaos run can be captured as a replayable trace at this seam
+        self.chaos_observers: List = []
+        # price overrides: instance type -> multiplicative factor applied
+        # over the static catalog prices (sim `price` events; the pricing
+        # provider picks the change up on its next refresh)
+        self._price_factors: Dict[str, float] = {}
+
+    def _notify_chaos(self, kind: str, **detail) -> None:
+        for obs in list(self.chaos_observers):
+            try:
+                obs(kind, detail)
+            except Exception:  # noqa: BLE001 -- observers must never fault the cloud
+                pass
 
     # -- plumbing -----------------------------------------------------------
     def _now(self) -> float:
@@ -206,6 +222,10 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
         """Exhaustible capacity pool; emulates ICE when drained."""
         with self._lock:
             self._capacity_pools[(instance_type, zone, capacity_type)] = count
+        self._notify_chaos(
+            "set_capacity", instance_type=instance_type, zone=zone,
+            capacity_type=capacity_type, count=count,
+        )
 
     def _pool_take(self, instance_type: str, zone: str, capacity_type: str) -> bool:
         key = (instance_type, zone, capacity_type)
@@ -236,14 +256,30 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
                 if r.id == reservation_id and r.available_count < r.total_count:
                     r.available_count += 1
 
+    def set_price_factor(self, instance_type: str, factor: float) -> None:
+        """Multiplicative price override over the static catalog tables
+        (sim `price` events: spot-market swings, list-price changes). Both
+        the pricing APIs and the fleet's lowest-price ranking honor it."""
+        with self._lock:
+            if factor == 1.0:
+                self._price_factors.pop(instance_type, None)
+            else:
+                self._price_factors[instance_type] = float(factor)
+        self._notify_chaos(
+            "set_price_factor", instance_type=instance_type, factor=factor,
+        )
+
+    def _price_factor(self, instance_type: str) -> float:
+        return self._price_factors.get(instance_type, 1.0)
+
     def _score(self, instance_type: str, capacity_type: str, zone: str) -> float:
         """Lowest-price strategy (kwok/strategy/strategy.go:28-60)."""
         info = self._types_by_name.get(instance_type)
         if info is None:
             return float("inf")
         if capacity_type == wk.CAPACITY_TYPE_SPOT:
-            return gen_catalog.spot_price(info, zone)
-        return gen_catalog.on_demand_price(info)
+            return gen_catalog.spot_price(info, zone) * self._price_factor(instance_type)
+        return gen_catalog.on_demand_price(info) * self._price_factor(instance_type)
 
     def create_fleet(self, request: FleetRequest) -> FleetResult:
         self._enter("create_fleet")
@@ -384,13 +420,16 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
         for t in self._types:
             if "spot" in t.supported_usage_classes:
                 for z in t.zones:
-                    out[(t.name, z)] = gen_catalog.spot_price(t, z)
+                    out[(t.name, z)] = gen_catalog.spot_price(t, z) * self._price_factor(t.name)
         return out
 
     # -- PricingAPI ---------------------------------------------------------
     def on_demand_prices(self) -> Dict[str, float]:
         self._enter("on_demand_prices")
-        return {t.name: gen_catalog.on_demand_price(t) for t in self._types}
+        return {
+            t.name: gen_catalog.on_demand_price(t) * self._price_factor(t.name)
+            for t in self._types
+        }
 
     # -- QueueAPI -----------------------------------------------------------
     def queue_url(self) -> str:
@@ -400,6 +439,16 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
         with self._lock:
             mid = f"msg-{next(self._id_seq):08x}"
             self._queue.append(QueueMessage(id=mid, receipt=mid, body=body))
+        if self.chaos_observers:
+            # capture seam: an interruption message entering the queue is
+            # an external event worth a trace line (best-effort: only the
+            # EventBridge detail.instance-id shape is replayable)
+            try:
+                iid = json.loads(body).get("detail", {}).get("instance-id")
+            except Exception:  # noqa: BLE001
+                iid = None
+            if iid:
+                self._notify_chaos("interruption", instance_id=iid)
 
     def receive(self, max_messages: int = 10) -> List[QueueMessage]:
         self._enter("receive")
@@ -462,7 +511,8 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
             if inst is None or inst.state == "terminated":
                 return False
             inst.state = "terminated"
-            return True
+        self._notify_chaos("kill_instance", instance_id=instance_id)
+        return True
 
     def degrade_instance(self, instance_id: str, condition: str = "Ready") -> bool:
         """Leave the instance RUNNING but unhealthy: its Node reports

@@ -301,3 +301,21 @@ FAILPOINT_FIRES = REGISTRY.counter(
     "karpenter_failpoints_fired_total",
     "Fault injections fired by armed failpoints", labels=("site", "action"),
 )
+# scenario simulation & trace replay (karpenter_tpu/sim/)
+SIM_EVENTS = REGISTRY.counter(
+    "karpenter_sim_replay_events_total",
+    "Trace events applied by the replay engine, by event kind", labels=("ev",),
+)
+SIM_TICKS = REGISTRY.counter(
+    "karpenter_sim_replay_ticks_total",
+    "Operator sweeps driven by the replay engine, by backend", labels=("backend",),
+)
+SIM_DIVERGENCES = REGISTRY.counter(
+    "karpenter_sim_divergences_total",
+    "Differential-replay divergences (placements/digest mismatches or "
+    "invariant violations)", labels=("kind",),
+)
+SIM_SHRINK_ROUNDS = REGISTRY.counter(
+    "karpenter_sim_shrink_rounds_total",
+    "Delta-debugging reduction attempts run by the trace shrinker",
+)

@@ -87,6 +87,16 @@ class Options:
     # ran longer than tracing_slow_ms, up to tracing_capacity trees
     tracing_slow_ms: float = 1000.0
     tracing_capacity: int = 32
+    # determinism root (sim subsystem): when set, EVERY RNG on the replay
+    # path derives from this one seed -- generated object names (NodeClaim
+    # suffixes -> kwok node names), the failpoint registry's per-site
+    # schedules, and the trace sampler. The breaker's backoff jitter is
+    # seeded by whoever constructs the breaker (__main__/sim.replay pass a
+    # seed-derived rng). The kwok lifecycle, batcher, and spread tie-breaks
+    # are RNG-free by construction (audited: tests/test_sim.py asserts two
+    # replays of one trace produce byte-identical decision logs). None
+    # (production default) leaves names on uuid4.
+    seed: Optional[int] = None
     feature_gates: dict = field(default_factory=lambda: {"ReservedCapacity": True, "SpotToSpotConsolidation": False})
 
 
@@ -119,6 +129,16 @@ class Operator:
             slow_ms=self.options.tracing_slow_ms,
             capacity=self.options.tracing_capacity,
         )
+        if self.options.seed is not None:
+            # seed discipline (Options.seed): one seed fans out to every
+            # process-global RNG a replay can observe (karpenter_tpu/
+            # seeding.py owns the list). Like the tracer config above,
+            # PROCESS policy -- the last seeded Operator wins, which is
+            # exactly what sequential replay runs need (each run re-seeds
+            # before its first tick).
+            from karpenter_tpu import seeding
+
+            seeding.apply(self.options.seed)
         self.cloud = cloud or FakeCloud(clock=self.clock)
         # the decision plane handle, kept for observability wiring: the
         # binary points /healthz + /debug/breaker at

@@ -1,0 +1,64 @@
+"""Seed discipline: the one fan-out from Options.seed to process RNGs.
+
+Every RNG a trace replay can observe derives from one seed through this
+module, each consumer under its own label so streams never alias:
+generated object names (NodeClaim suffixes -> kwok node names), the
+failpoint registry's per-site schedules, the trace sampler, and the
+solver-wire breaker's backoff jitter (whose rng is injected where the
+breaker is constructed -- `seeded_rng("breaker", seed)`).
+
+`snapshot()`/`restore()` bracket the fan-out for embedders that build
+seeded worlds inside a longer-lived process (the sim replay engine, bench
+stages): the field list lives HERE, next to `apply()`, so the next RNG
+added to the fan-out cannot silently escape the restore path.
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+def seeded_rng(label: str, seed: int) -> random.Random:
+    """A dedicated RNG stream for one consumer of the seed chain. The
+    label is part of the derivation: the binary and the replay engine
+    must use the SAME label for the same consumer or a recorded run and
+    its replay stop sharing one seed chain."""
+    return random.Random(f"{label}:{seed}")
+
+
+def apply(seed: Optional[int]) -> None:
+    """Fan one seed out to every process-global RNG on the replay path
+    (None restores the production defaults where they exist). Process
+    policy, like the tracer config: the last caller wins."""
+    from karpenter_tpu import tracing
+    from karpenter_tpu.apis.objects import seed_object_names
+    from karpenter_tpu.failpoints import FAILPOINTS
+
+    seed_object_names(seed)
+    if seed is not None:
+        FAILPOINTS.seed = seed
+        tracing.TRACER.configure(rng=seeded_rng("tracing", seed).random)
+
+
+def snapshot() -> tuple:
+    """Capture every global `apply()` mutates (plus the tracer's
+    enabled/sample, which seeded embedders also reconfigure)."""
+    from karpenter_tpu import tracing
+    from karpenter_tpu.apis import objects
+    from karpenter_tpu.failpoints import FAILPOINTS
+
+    return (
+        objects._name_rng, FAILPOINTS.seed,
+        tracing.TRACER._rng, tracing.TRACER.enabled, tracing.TRACER.sample,
+    )
+
+
+def restore(token: tuple) -> None:
+    from karpenter_tpu import tracing
+    from karpenter_tpu.apis import objects
+    from karpenter_tpu.failpoints import FAILPOINTS
+
+    name_rng, fp_seed, t_rng, t_enabled, t_sample = token
+    objects._name_rng = name_rng
+    FAILPOINTS.seed = fp_seed
+    tracing.TRACER.configure(enabled=t_enabled, sample=t_sample, rng=t_rng)

@@ -1,0 +1,247 @@
+"""`python -m karpenter_tpu sim ...`: the simulation subsystem's CLI.
+
+    sim generate diurnal-small -o trace.jsonl     # compile one scenario
+    sim generate --all -o tests/golden/scenarios  # regenerate the corpus
+    sim replay trace.jsonl --backend host         # one backend + KPIs
+    sim replay --differential trace.jsonl         # host vs wire vs pipelined
+    sim shrink trace.jsonl -o sim-artifacts       # minimize a failing trace
+    sim corpus                                    # replay the committed
+                                                  # corpus differentially,
+                                                  # verify golden digests,
+                                                  # shrink any failure
+
+Every command prints exactly one JSON line on stdout (the bench/CI
+contract) and returns a nonzero exit code on divergence or invariant
+violation. Recording a live run is the binary's job:
+`python -m karpenter_tpu --sim-record out.jsonl`.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _trace_seed(events: List[dict], override: Optional[int]) -> int:
+    if override is not None:
+        return override
+    for ev in events:
+        if ev.get("ev") == "header" and "seed" in ev:
+            return int(ev["seed"])
+    return 0
+
+
+def _cmd_generate(args) -> int:
+    from karpenter_tpu.sim.scenario import (
+        CORPUS_SCENARIOS, DEFAULT_SEED, STANDARD_SCENARIOS, build_scenario,
+    )
+    from karpenter_tpu.sim.trace import write_trace
+
+    seed = args.seed if args.seed is not None else DEFAULT_SEED
+    names = (
+        list(CORPUS_SCENARIOS) if args.all
+        else [args.scenario] if args.scenario
+        else None
+    )
+    if not names:
+        print(json.dumps({"error": "name a scenario or pass --all",
+                          "scenarios": sorted(STANDARD_SCENARIOS)}))
+        return 2
+    written = {}
+    for name in names:
+        events = build_scenario(name, seed=seed)
+        if args.all or (args.out and os.path.isdir(args.out)):
+            out = os.path.join(args.out or ".", f"{name}.jsonl")
+        else:
+            out = args.out or f"{name}.jsonl"
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        written[name] = {"path": out, "events": write_trace(out, events)}
+    print(json.dumps({"generated": written}, sort_keys=True))
+    return 0
+
+
+def _cmd_replay(args) -> int:
+    from karpenter_tpu.sim.replay import (
+        InvariantViolation, differential, replay,
+    )
+    from karpenter_tpu.sim.trace import read_trace
+
+    events = read_trace(args.trace)
+    seed = _trace_seed(events, args.seed)
+    if args.differential:
+        res = differential(events, seed=seed)
+        out = {
+            "trace": args.trace, "mode": "differential", "seed": seed,
+            "ok": res.ok,
+            "digests": {b: r.digest for b, r in res.results.items()},
+            "ticks": {b: r.ticks for b, r in res.results.items()},
+            "kpis": {b: r.kpis for b, r in res.results.items()},
+            "divergences": [
+                {"kind": d.kind, "backends": list(d.backends), "detail": d.detail}
+                for d in res.divergences
+            ],
+            "errors": res.errors,
+        }
+        print(json.dumps(out, sort_keys=True))
+        return 0 if res.ok else 1
+    try:
+        r = replay(events, backend=args.backend, seed=seed)
+    except InvariantViolation as e:
+        print(json.dumps({
+            "trace": args.trace, "backend": args.backend, "seed": seed,
+            "ok": False, "invariant_violation": str(e),
+        }, sort_keys=True))
+        return 1
+    if args.log_out:
+        with open(args.log_out, "w") as f:
+            f.write("\n".join(r.decision_log) + "\n")
+    print(json.dumps({
+        "trace": args.trace, "backend": args.backend, "seed": seed,
+        "ok": True, "digest": r.digest, "ticks": r.ticks,
+        "events_applied": r.events_applied, "kpis": r.kpis,
+    }, sort_keys=True))
+    return 0
+
+
+def _cmd_shrink(args) -> int:
+    from karpenter_tpu.sim.shrink import (
+        differential_failing, invariant_failing, shrink_to_repro,
+    )
+    from karpenter_tpu.sim.trace import read_trace
+
+    events = read_trace(args.trace)
+    seed = _trace_seed(events, args.seed)
+    failing = (
+        differential_failing(seed) if args.mode == "differential"
+        else invariant_failing(args.backend, seed)
+    )
+    name = os.path.splitext(os.path.basename(args.trace))[0]
+    path = shrink_to_repro(events, failing, args.out_dir, name,
+                           max_probes=args.max_probes)
+    if path is None:
+        print(json.dumps({"trace": args.trace, "shrunk": None,
+                          "note": "trace does not fail; nothing to shrink"},
+                         sort_keys=True))
+        return 1
+    print(json.dumps({
+        "trace": args.trace, "shrunk": path,
+        "original_events": len(events), "shrunk_events": len(read_trace(path)),
+    }, sort_keys=True))
+    return 0
+
+
+def _cmd_corpus(args) -> int:
+    """Replay every committed scenario differentially, verify the golden
+    host-backend digests, and shrink+archive any failure. The CI gate."""
+    from karpenter_tpu.sim.replay import differential
+    from karpenter_tpu.sim.shrink import differential_failing, shrink_to_repro
+    from karpenter_tpu.sim.trace import read_trace
+
+    traces = sorted(
+        p for p in glob.glob(os.path.join(args.dir, "*.jsonl"))
+        if not p.endswith("-shrunk.jsonl")
+    )
+    digest_path = os.path.join(args.dir, "digests.json")
+    golden = {}
+    if os.path.exists(digest_path):
+        with open(digest_path) as f:
+            golden = json.load(f)
+    report = {}
+    new_digests = {}
+    rc = 0
+    for path in traces:
+        name = os.path.splitext(os.path.basename(path))[0]
+        events = read_trace(path)
+        seed = _trace_seed(events, None)
+        res = differential(events, seed=seed)
+        host_digest = res.results["host"].digest if "host" in res.results else None
+        entry = {
+            "ok": res.ok,
+            "digest": host_digest,
+            "divergences": [
+                {"kind": d.kind, "backends": list(d.backends), "detail": d.detail}
+                for d in res.divergences
+            ],
+        }
+        new_digests[name] = host_digest
+        if not res.ok:
+            rc = 1
+            entry["shrunk"] = shrink_to_repro(
+                events, differential_failing(seed), args.artifacts, name)
+        elif not args.update_digests and golden.get(name) not in (None, host_digest):
+            rc = 1
+            entry["ok"] = False
+            entry["golden_digest"] = golden.get(name)
+            entry["note"] = "decision digest drifted from golden"
+        report[name] = entry
+    if args.update_digests:
+        if rc != 0:
+            # never pin a diverging run's digest (or null from a failed
+            # backend) as the new golden -- fix the divergence first
+            print(json.dumps({
+                "corpus": report, "ok": False,
+                "error": "refusing --update-digests: corpus run diverged",
+            }, sort_keys=True))
+            return 1
+        with open(digest_path, "w") as f:
+            json.dump(new_digests, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps({"corpus": report, "ok": rc == 0}, sort_keys=True))
+    return rc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="karpenter-tpu sim",
+        description="deterministic scenario simulation & trace replay",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    gen = sub.add_parser("generate", help="compile a scenario to a JSONL trace")
+    gen.add_argument("scenario", nargs="?")
+    gen.add_argument("--all", action="store_true",
+                     help="generate the whole committed-corpus set")
+    gen.add_argument("--seed", type=int, default=None)
+    gen.add_argument("-o", "--out", default=None,
+                     help="output file (or directory with --all)")
+    gen.set_defaults(fn=_cmd_generate)
+
+    rep = sub.add_parser("replay", help="replay a trace through the operator stack")
+    rep.add_argument("trace")
+    rep.add_argument("--backend", choices=("host", "wire", "pipelined"),
+                     default="host")
+    rep.add_argument("--differential", action="store_true",
+                     help="replay through host+wire+pipelined and compare")
+    rep.add_argument("--seed", type=int, default=None,
+                     help="override the trace header's seed")
+    rep.add_argument("--log-out", default="",
+                     help="write the decision log to this file")
+    rep.set_defaults(fn=_cmd_replay)
+
+    shr = sub.add_parser("shrink", help="delta-debug a failing trace to a minimal repro")
+    shr.add_argument("trace")
+    shr.add_argument("--mode", choices=("differential", "invariant"),
+                     default="differential")
+    shr.add_argument("--backend", choices=("host", "wire", "pipelined"),
+                     default="host", help="backend for --mode invariant")
+    shr.add_argument("--seed", type=int, default=None)
+    shr.add_argument("--max-probes", type=int, default=2_000)
+    shr.add_argument("-o", "--out-dir", default="sim-artifacts")
+    shr.set_defaults(fn=_cmd_shrink)
+
+    cor = sub.add_parser("corpus", help="differential-replay the committed corpus")
+    cor.add_argument("--dir", default="tests/golden/scenarios")
+    cor.add_argument("--artifacts", default="sim-artifacts")
+    cor.add_argument("--update-digests", action="store_true",
+                     help="rewrite digests.json from this run")
+    cor.set_defaults(fn=_cmd_corpus)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

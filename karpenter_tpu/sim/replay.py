@@ -1,0 +1,506 @@
+"""Replay engine: drive the real operator stack from a trace.
+
+Each replay builds a FRESH production-shaped world -- FakeCloud,
+in-memory cluster, the full controller sweep -- under FakeClock, seeded
+through `Options(seed=...)` so every run of the same trace is
+bit-identical, then applies the trace's events in order. One canonical
+decision line is logged per tick (events applied, claims created/removed,
+nodes appearing with their realized instance type/zone/capacity-type,
+binds/unbinds, pending count); the sha256 of the log is the run's
+decision digest, the value the golden corpus pins.
+
+Three backends exercise the three production decision paths:
+
+    host      -- TPUSolver in-process (the breaker's CPU-fallback path),
+                 synchronous tick
+    wire      -- TPUSolver behind the RPC sidecar on a UNIX socket,
+                 synchronous tick
+    pipelined -- the sidecar plus the double-buffered provisioner tick
+                 (the deployed default)
+
+Differential mode replays one trace through all three and asserts
+bit-identical final placements (pod -> node/instance-type/zone/capacity),
+plus identical decision digests for the two synchronous backends (the
+pipelined tick legally shifts decisions one tick later, so its per-tick
+log differs; its placements must not).
+
+The chaos invariants hold every tick: bound pods point at live nodes, no
+two claims share a provider id, usage fits allocatable; and at the end of
+the drain phase: no pod lost, no orphan instance. A violation raises
+InvariantViolation -- the shrinker minimizes the trace that caused it.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_tpu.sim.trace import pod_from_spec, validate_event
+
+BACKENDS = ("host", "wire", "pipelined")
+
+DEFAULT_TICK_SECONDS = 3.0
+MAX_SETTLE_TICKS = 80
+DRAIN_TICKS = 10
+DRAIN_STEP_SECONDS = 10.0
+
+
+class InvariantViolation(AssertionError):
+    def __init__(self, message: str, tick: int = -1):
+        super().__init__(f"tick {tick}: {message}" if tick >= 0 else message)
+        self.tick = tick
+
+
+@dataclass
+class DifferentialDivergence:
+    kind: str          # "digest" | "placements" | "invariant"
+    backends: Tuple[str, str]
+    detail: str
+
+
+@dataclass
+class ReplayResult:
+    backend: str
+    seed: int
+    decision_log: List[str]
+    placements: Dict[str, dict]      # pod -> {node, instance_type, zone, capacity_type}
+    kpis: dict
+    ticks: int
+    events_applied: int
+
+    @property
+    def digest(self) -> str:
+        return hashlib.sha256(
+            "\n".join(self.decision_log).encode()
+        ).hexdigest()
+
+
+def _percentile(samples: List[float], q: float) -> float:
+    """Nearest-rank percentile, the same formula as metrics.Histogram
+    .percentile (ceil, not round: round(+0.5) overshoots one rank exactly
+    when q*n/100 lands on an integer -- p50 of 2 samples must be s[0])."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, math.ceil(q / 100.0 * len(s)) - 1))
+    return s[idx]
+
+
+class _Engine:
+    def __init__(self, backend: str, seed: int, tmpdir: Optional[str] = None):
+        if backend not in BACKENDS:
+            raise ValueError(f"unknown backend {backend!r} (want one of {BACKENDS})")
+        self.backend = backend
+        self.seed = seed
+        self._tmpdir = tmpdir
+        self._own_tmpdir = None
+        self._server = None
+        self._client = None
+        self._breaker = None
+        self._global_snapshot = None
+        self.op = None
+
+    # -- world construction --------------------------------------------------
+    def build(self):
+        from karpenter_tpu import seeding
+        from karpenter_tpu.apis import NodePool, TPUNodeClass
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.operator import Operator, Options
+        from karpenter_tpu.solver.breaker import CircuitBreaker
+        from karpenter_tpu.solver.service import TPUSolver
+
+        # the Operator's seed fan-out mutates PROCESS-GLOBAL policy (name
+        # RNG, failpoint seed, tracer config); snapshot it so close()
+        # restores the embedding process -- bench stages and test suites
+        # running after a replay must not inherit seeded determinism
+        self._global_snapshot = seeding.snapshot()
+        options = Options(
+            seed=self.seed,
+            pipelined_scheduling=(self.backend == "pipelined"),
+            interruption_queue="interruption-queue",
+            tracing=False,
+        )
+        breaker_rng = seeding.seeded_rng("breaker", self.seed).random
+        if self.backend == "host":
+            solver = TPUSolver(g_max=64)
+        else:
+            from karpenter_tpu.solver.rpc import SolverClient, SolverServer
+
+            if self._tmpdir is None:
+                self._own_tmpdir = tempfile.TemporaryDirectory(prefix="karpenter-sim-")
+                self._tmpdir = self._own_tmpdir.name
+            sock = os.path.join(self._tmpdir, f"solver-{self.backend}.sock")
+            self._server = SolverServer(path=sock).start()
+            self._client = SolverClient(path=sock, timeout=30.0, connect_timeout=0.5)
+            self._breaker = CircuitBreaker(
+                failure_threshold=2, backoff_base=1000.0, rng=breaker_rng
+            )
+            solver = TPUSolver(g_max=64, client=self._client, breaker=self._breaker)
+        self.op = Operator(clock=FakeClock(100_000.0), solver=solver, options=options)
+        self.op.cluster.create(TPUNodeClass("default"))
+        self.op.cluster.create(NodePool("default"))
+        return self.op
+
+    def close(self):
+        if self._breaker is not None:
+            self._breaker.stop()
+        if self._client is not None:
+            self._client.close()
+        if self._server is not None:
+            self._server.stop()
+        if self._own_tmpdir is not None:
+            self._own_tmpdir.cleanup()
+        if self._global_snapshot is not None:
+            from karpenter_tpu import seeding
+
+            seeding.restore(self._global_snapshot)
+            self._global_snapshot = None
+
+    # -- replay --------------------------------------------------------------
+    def run(self, events: List[dict]) -> ReplayResult:
+        from karpenter_tpu import metrics
+        from karpenter_tpu.apis import Node, NodeClaim, Pod, labels as wk
+        from karpenter_tpu.utils import parse_instance_id
+
+        op = self.op if self.op is not None else self.build()
+        cluster, cloud, clock = op.cluster, op.cloud, op.clock
+
+        tick_seconds = DEFAULT_TICK_SECONDS
+        log: List[str] = []
+        tick_i = 0
+        applied = 0
+        pending_events: List[dict] = []
+
+        # KPI accumulators
+        created_at: Dict[str, float] = {}
+        latencies: List[float] = []
+        fleet_cost = 0.0
+        pod_hours = 0.0
+        churn = 0
+        nodes_peak = 0
+        deleted_pods: set = set()
+
+        # per-tick diff state
+        prev_pod_node: Dict[str, str] = {}
+        prev_claims: set = set()
+        prev_nodes: set = set()
+
+        def node_price(node) -> float:
+            itype = node.metadata.labels.get(wk.INSTANCE_TYPE_LABEL, "")
+            zone = node.metadata.labels.get(wk.ZONE_LABEL, "")
+            ct = node.metadata.labels.get(wk.CAPACITY_TYPE_LABEL, "")
+            if ct == wk.CAPACITY_TYPE_SPOT:
+                p, ok = op.pricing.spot_price(itype, zone)
+            else:
+                p, ok = op.pricing.on_demand_price(itype)
+            return p if ok else 0.0
+
+        def check_tick_invariants():
+            nodes = {n.metadata.name: n for n in cluster.list(Node)}
+            for p in cluster.list(Pod):
+                if p.node_name and p.node_name not in nodes:
+                    raise InvariantViolation(
+                        f"pod {p.metadata.name} bound to ghost node {p.node_name}",
+                        tick_i,
+                    )
+            pids = [c.provider_id for c in cluster.list(NodeClaim) if c.provider_id]
+            if len(pids) != len(set(pids)):
+                raise InvariantViolation("duplicate provider ids (double launch)", tick_i)
+            if nodes:
+                usage = cluster.node_usage_map(list(nodes))
+                for name, node in nodes.items():
+                    if not usage[name].fits(node.allocatable):
+                        raise InvariantViolation(f"node {name} over-committed", tick_i)
+
+        def do_tick(dt: float):
+            nonlocal tick_i, fleet_cost, pod_hours, churn, nodes_peak
+            nonlocal prev_pod_node, prev_claims, prev_nodes
+            clock.step(dt)
+            op.tick()
+            metrics.SIM_TICKS.inc(backend=self.backend)
+            # KPI integration over this tick's dt
+            nodes = cluster.list(Node)
+            fleet_cost += sum(node_price(n) for n in nodes) * dt / 3600.0
+            bound = [p for p in cluster.list(Pod) if p.node_name]
+            pod_hours += len(bound) * dt / 3600.0
+            nodes_peak = max(nodes_peak, len(nodes))
+            # decision-log diff
+            pod_node = {p.metadata.name: p.node_name for p in cluster.list(Pod)}
+            claims = {c.metadata.name for c in cluster.list(NodeClaim)}
+            node_names = {n.metadata.name for n in nodes}
+            binds = sorted(
+                f"{p}->{n}" for p, n in pod_node.items()
+                if n and prev_pod_node.get(p, "") != n
+            )
+            unbinds = sorted(
+                p for p, n in prev_pod_node.items()
+                if n and not pod_node.get(p, "")
+            )
+            nodes_add = sorted(
+                "{}:{}:{}:{}".format(
+                    n.metadata.name,
+                    n.metadata.labels.get(wk.INSTANCE_TYPE_LABEL, "?"),
+                    n.metadata.labels.get(wk.ZONE_LABEL, "?"),
+                    n.metadata.labels.get(wk.CAPACITY_TYPE_LABEL, "?"),
+                )
+                for n in nodes if n.metadata.name not in prev_nodes
+            )
+            nodes_gone = sorted(prev_nodes - node_names)
+            churn += len(nodes_add) + len(nodes_gone)
+            for b in binds:
+                pod = b.split("->", 1)[0]
+                if pod in created_at:
+                    latencies.append(clock.now() - created_at.pop(pod))
+            line = {
+                "i": tick_i,
+                "t": round(clock.now(), 3),
+                "events": [
+                    {k: v for k, v in ev.items() if k != "node"}
+                    for ev in pending_events
+                ],
+                "claims+": sorted(claims - prev_claims),
+                "claims-": sorted(prev_claims - claims),
+                "nodes+": nodes_add,
+                "nodes-": nodes_gone,
+                "binds": binds,
+                "unbinds": unbinds,
+                "pending": len(cluster.pending_pods()),
+            }
+            log.append(json.dumps(line, sort_keys=True, separators=(",", ":")))
+            pending_events.clear()
+            prev_pod_node, prev_claims, prev_nodes = pod_node, claims, node_names
+            check_tick_invariants()
+            tick_i += 1
+
+        def pick_node(pick: int):
+            from karpenter_tpu.sim.trace import ranked_victims
+
+            ranked = ranked_victims(cluster)
+            return ranked[pick % len(ranked)] if ranked else None
+
+        def apply(ev: dict):
+            nonlocal tick_seconds
+            kind = ev["ev"]
+            metrics.SIM_EVENTS.inc(ev=kind)
+            if kind == "header":
+                tick_seconds = float(ev.get("tick_seconds", tick_seconds))
+                return
+            if kind == "advance":
+                do_tick(float(ev["dt"]))
+                return
+            pending_events.append(ev)
+            if kind == "pod_add":
+                pod = pod_from_spec(ev["pod"])
+                cluster.create(pod)
+                created_at[pod.metadata.name] = clock.now()
+            elif kind == "pod_delete":
+                # only count a delete that hit a live pod: a no-op delete
+                # (unknown name, or sorted ahead of its arrival) must not
+                # inflate pods_total in the KPIs
+                if cluster.try_get(Pod, ev["name"]) is not None:
+                    created_at.pop(ev["name"], None)
+                    deleted_pods.add(ev["name"])
+                    cluster.delete(Pod, ev["name"])
+            elif kind == "kill_node":
+                node = pick_node(int(ev["pick"]))
+                if node is not None:
+                    cloud.kill_instance(parse_instance_id(node.provider_id))
+            elif kind == "interruption":
+                node = pick_node(int(ev["pick"]))
+                if node is not None:
+                    # envelope triple from the parser registry's own
+                    # constants: a drifted literal would degrade to a
+                    # no-op message and silently stop killing nodes
+                    from karpenter_tpu.controllers.interruption_messages import (
+                        DETAIL_SPOT_INTERRUPTION, SOURCE_COMPUTE,
+                    )
+
+                    iid = parse_instance_id(node.provider_id)
+                    cloud.send(json.dumps({
+                        "version": "0", "source": SOURCE_COMPUTE,
+                        "detail-type": DETAIL_SPOT_INTERRUPTION,
+                        "id": f"evt-{iid}", "region": "us-central-1",
+                        "detail": {"instance-id": iid, "instance-action": "terminate"},
+                    }))
+            elif kind == "ice":
+                cloud.set_capacity(
+                    ev["instance_type"], ev["zone"], ev["capacity_type"],
+                    int(ev["count"]),
+                )
+            elif kind == "price":
+                cloud.set_price_factor(ev["instance_type"], float(ev["factor"]))
+                op.pricing.update_on_demand_pricing()
+                op.pricing.update_spot_pricing()
+
+        for ev in events:
+            apply(validate_event(ev))
+            applied += 1
+
+        # settle: tick until the fleet converges (no pending pods, nothing
+        # mid-pipeline) or the budget is blown -- non-convergence IS the
+        # invariant violation the shrinker minimizes
+        for _ in range(MAX_SETTLE_TICKS):
+            if not cluster.pending_pods() and op.provisioner._inflight is None:
+                break
+            do_tick(tick_seconds)
+        else:
+            raise InvariantViolation(
+                f"no convergence after {MAX_SETTLE_TICKS} settle ticks "
+                f"({len(cluster.pending_pods())} pods pending)", tick_i,
+            )
+        # placements are captured AT CONVERGENCE: this is the scheduler's
+        # decision surface, the thing the differential contract pins
+        # bit-identical across backends. The drain below intentionally
+        # keeps consolidating the now-quiet fleet, and those decisions
+        # depend on node AGE -- which legally trails one tick on the
+        # pipelined backend -- so drain-phase churn is checked against the
+        # invariants (no pod lost / no orphan), not against other backends.
+        placements = self._placements()
+        # drain: long ticks so termination/GC complete. Disruption may
+        # legally evict pods DURING the drain (consolidating the fleet the
+        # scenario built), so re-settle before the end-state invariants
+        # (the chaos contract's "no pod lost / no orphan") -- a pod is
+        # only lost if it stays unbound once the fleet goes quiet.
+        for _ in range(DRAIN_TICKS):
+            do_tick(DRAIN_STEP_SECONDS)
+        for _ in range(MAX_SETTLE_TICKS):
+            if not cluster.pending_pods() and op.provisioner._inflight is None:
+                break
+            do_tick(tick_seconds)
+        else:
+            raise InvariantViolation(
+                f"no re-convergence after drain ({len(cluster.pending_pods())} "
+                "pods pending)", tick_i,
+            )
+        for p in cluster.list(Pod):
+            if not p.node_name:
+                raise InvariantViolation(
+                    f"pod {p.metadata.name} lost (never bound)", tick_i)
+        claimed = {c.provider_id for c in cluster.list(NodeClaim) if c.provider_id}
+        for inst in cloud.describe_instances():
+            if inst.state == "running" and inst.provider_id not in claimed:
+                raise InvariantViolation(f"orphan instance {inst.id}", tick_i)
+
+        n_final = len(cluster.list(Pod))
+        kpis = {
+            "cost_per_pod_hour": round(fleet_cost / pod_hours, 6) if pod_hours else 0.0,
+            "fleet_cost_total": round(fleet_cost, 6),
+            "pod_hours": round(pod_hours, 4),
+            "pending_latency_p50_s": round(_percentile(latencies, 50), 3),
+            "pending_latency_p99_s": round(_percentile(latencies, 99), 3),
+            "node_churn": churn,
+            "nodes_peak": nodes_peak,
+            "pods_total": n_final + len(deleted_pods),
+            "pods_bound_final": n_final,
+            "sim_seconds": round(clock.now() - 100_000.0, 3),
+        }
+        return ReplayResult(
+            backend=self.backend, seed=self.seed, decision_log=log,
+            placements=placements, kpis=kpis, ticks=tick_i,
+            events_applied=applied,
+        )
+
+    def _placements(self) -> Dict[str, dict]:
+        from karpenter_tpu.apis import Pod, labels as wk
+
+        return {
+            p.metadata.name: {
+                "node": p.node_name,
+                "instance_type": self._node_label(p.node_name, wk.INSTANCE_TYPE_LABEL),
+                "zone": self._node_label(p.node_name, wk.ZONE_LABEL),
+                "capacity_type": self._node_label(p.node_name, wk.CAPACITY_TYPE_LABEL),
+            }
+            for p in self.op.cluster.list(Pod)
+        }
+
+    def _node_label(self, node_name: str, label: str) -> str:
+        from karpenter_tpu.apis import Node
+
+        node = self.op.cluster.try_get(Node, node_name)
+        return node.metadata.labels.get(label, "?") if node is not None else "?"
+
+
+def replay(events: List[dict], backend: str = "host", seed: int = 0,
+           tmpdir: Optional[str] = None) -> ReplayResult:
+    """Replay `events` on one backend; raises InvariantViolation when the
+    chaos contract breaks. Builds and tears down a fresh world."""
+    engine = _Engine(backend, seed, tmpdir)
+    try:
+        engine.build()
+        return engine.run(events)
+    finally:
+        engine.close()
+
+
+@dataclass
+class DifferentialResult:
+    results: Dict[str, ReplayResult] = field(default_factory=dict)
+    divergences: List[DifferentialDivergence] = field(default_factory=list)
+    errors: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.divergences and not self.errors
+
+
+def differential(events: List[dict], seed: int = 0,
+                 backends: Tuple[str, ...] = BACKENDS,
+                 tmpdir: Optional[str] = None) -> DifferentialResult:
+    """Replay one trace through every backend and compare:
+
+    - final placements must be bit-identical everywhere (the decision
+      contract: host FFD fallback, the wire sidecar, and the pipelined
+      tick are three routes to ONE decision function);
+    - decision digests must match between the synchronous backends (the
+      pipelined tick may shift decisions a tick later, so only its
+      placements are compared).
+
+    An InvariantViolation inside any backend is reported as a divergence
+    of kind "invariant" rather than raised, so the caller (and the
+    shrinker) sees the whole comparison.
+    """
+    from karpenter_tpu import metrics
+
+    out = DifferentialResult()
+    for b in backends:
+        try:
+            out.results[b] = replay(events, backend=b, seed=seed, tmpdir=tmpdir)
+        except InvariantViolation as e:
+            out.errors[b] = str(e)
+            out.divergences.append(
+                DifferentialDivergence("invariant", (b, b), str(e)))
+            metrics.SIM_DIVERGENCES.inc(kind="invariant")
+    done = [b for b in backends if b in out.results]
+    sync_done = [b for b in done if b != "pipelined"]
+    for a, b in zip(sync_done, sync_done[1:]):
+        ra, rb = out.results[a], out.results[b]
+        if ra.digest != rb.digest:
+            detail = _first_log_diff(ra.decision_log, rb.decision_log)
+            out.divergences.append(DifferentialDivergence("digest", (a, b), detail))
+            metrics.SIM_DIVERGENCES.inc(kind="digest")
+    for a, b in zip(done, done[1:]):
+        ra, rb = out.results[a], out.results[b]
+        if ra.placements != rb.placements:
+            detail = _first_placement_diff(ra.placements, rb.placements)
+            out.divergences.append(
+                DifferentialDivergence("placements", (a, b), detail))
+            metrics.SIM_DIVERGENCES.inc(kind="placements")
+    return out
+
+
+def _first_log_diff(a: List[str], b: List[str]) -> str:
+    for i, (la, lb) in enumerate(zip(a, b)):
+        if la != lb:
+            return f"line {i}: {la} != {lb}"
+    return f"log lengths differ: {len(a)} vs {len(b)}"
+
+
+def _first_placement_diff(a: Dict[str, dict], b: Dict[str, dict]) -> str:
+    for pod in sorted(set(a) | set(b)):
+        if a.get(pod) != b.get(pod):
+            return f"pod {pod}: {a.get(pod)} != {b.get(pod)}"
+    return "placements differ"

@@ -1,0 +1,280 @@
+"""Scenario DSL: seeded, composable workload generators that compile to traces.
+
+A scenario is a set of generator calls placed on one sim-time timeline;
+`build()` quantizes the timeline into ticks and emits a flat JSONL-able
+event list (every gap becomes `advance` events, so replay reproduces the
+cadence exactly). All randomness flows from the scenario seed through one
+numpy Generator, so a scenario name + seed IS the trace -- the committed
+corpus under tests/golden/scenarios/ can always be regenerated with
+`python -m karpenter_tpu sim generate --all`.
+
+Generators (composable; each returns self for chaining):
+
+    poisson_arrivals   -- memoryless pod arrivals at a fixed rate
+    diurnal            -- sinusoidal rate ramp (the day/night traffic shape)
+    spread_burst       -- one burst of zone-topology-spread pods
+    binpack_adversarial-- sizes just over half/third of common node shapes
+                          (worst case for FFD-family packers)
+    interruption_wave  -- a volley of spot-interruption messages
+    ice_storm          -- exhaust capacity pools, then restore them
+    price_shock        -- multiplicative price moves on named types
+    pod_churn          -- delete a fraction of previously generated pods
+
+Chaos events (interruptions, kills) are scheduled into QUIET windows --
+the generators leave a settle gap after arrivals -- because the pipelined
+backend legally trails the synchronous ones by one tick while load is
+sustained; firing chaos mid-burst would make victim picks diverge between
+backends by construction, not by bug (sim/replay docstring).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_tpu.apis.labels import ZONE_LABEL
+from karpenter_tpu.sim.trace import TRACE_VERSION
+
+# (cpu, memory) pod shapes, small enough that scenarios pack several per node
+SIZES: Tuple[Tuple[str, str], ...] = (
+    ("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi"),
+)
+
+
+class ScenarioBuilder:
+    def __init__(self, name: str, seed: int = 0, tick_seconds: float = 3.0):
+        self.name = name
+        self.seed = seed
+        self.tick_seconds = tick_seconds
+        self.rng = np.random.default_rng(seed)
+        self._timed: List[Tuple[float, int, dict]] = []  # (t, seq, event)
+        self._seq = 0
+        self._pods: List[Tuple[float, str]] = []  # (arrival t, name)
+        self._pod_i = 0
+
+    # -- primitives ----------------------------------------------------------
+    def at(self, t: float, event: dict) -> "ScenarioBuilder":
+        self._timed.append((float(t), self._seq, event))
+        self._seq += 1
+        return self
+
+    def _pod(self, t: float, cpu: str, mem: str, labels: Optional[Dict] = None,
+             spread: Optional[List[dict]] = None) -> str:
+        name = f"{self.name}-{self._pod_i}"
+        self._pod_i += 1
+        pod = {"name": name, "requests": {"cpu": cpu, "memory": mem}}
+        if labels:
+            pod["labels"] = dict(labels)
+        if spread:
+            pod["spread"] = spread
+        self.at(t, {"ev": "pod_add", "pod": pod})
+        self._pods.append((float(t), name))
+        return name
+
+    def _random_size(self) -> Tuple[str, str]:
+        return SIZES[int(self.rng.integers(0, len(SIZES)))]
+
+    # -- workload generators -------------------------------------------------
+    def poisson_arrivals(self, start: float, duration: float, rate_per_s: float,
+                         labels: Optional[Dict] = None) -> "ScenarioBuilder":
+        n = int(self.rng.poisson(rate_per_s * duration))
+        for t in sorted(self.rng.uniform(start, start + duration, n)):
+            cpu, mem = self._random_size()
+            self._pod(float(t), cpu, mem, labels)
+        return self
+
+    def diurnal(self, start: float, duration: float, base_rate: float,
+                peak_rate: float, period: Optional[float] = None) -> "ScenarioBuilder":
+        """Arrivals whose rate follows base + (peak-base) * sin^2(pi t/period):
+        the classic day/night traffic shape, one full cycle by default.
+        Implemented by thinning a Poisson stream at the peak rate."""
+        period = period or duration
+        n = int(self.rng.poisson(peak_rate * duration))
+        times = np.sort(self.rng.uniform(start, start + duration, n))
+        accept = self.rng.uniform(0.0, 1.0, n)
+        for t, u in zip(times, accept):
+            rate = base_rate + (peak_rate - base_rate) * float(
+                np.sin(np.pi * (t - start) / period) ** 2
+            )
+            if u * peak_rate <= rate:
+                cpu, mem = self._random_size()
+                self._pod(float(t), cpu, mem)
+        return self
+
+    def spread_burst(self, t: float, n: int, app: Optional[str] = None,
+                     max_skew: int = 1) -> "ScenarioBuilder":
+        app = app or f"{self.name}-spread-{self._seq}"
+        spread = [{
+            "key": ZONE_LABEL, "max_skew": max_skew,
+            "when_unsatisfiable": "DoNotSchedule", "selector": {"app": app},
+        }]
+        for _ in range(n):
+            self._pod(t, "500m", "1Gi", labels={"app": app}, spread=spread)
+        return self
+
+    def binpack_adversarial(self, t: float, n: int) -> "ScenarioBuilder":
+        """Pods sized just over 1/2 and 1/3 of the common node shapes, the
+        classic adversarial input for first-fit-decreasing packers: a
+        greedy mis-ordering strands near-half of every node."""
+        shapes = (("1100m", "2200Mi"), ("700m", "1400Mi"), ("1700m", "3400Mi"))
+        for i in range(n):
+            cpu, mem = shapes[i % len(shapes)]
+            self._pod(t, cpu, mem)
+        return self
+
+    # -- chaos generators ----------------------------------------------------
+    def interruption_wave(self, t: float, count: int) -> "ScenarioBuilder":
+        """`count` spot-interruption messages, victims picked by seeded
+        rank into the ready fleet at apply time (trace.py `pick`)."""
+        for _ in range(count):
+            self.at(t, {"ev": "interruption", "pick": int(self.rng.integers(0, 1 << 16))})
+        return self
+
+    def node_kills(self, t: float, count: int) -> "ScenarioBuilder":
+        for _ in range(count):
+            self.at(t, {"ev": "kill_node", "pick": int(self.rng.integers(0, 1 << 16))})
+        return self
+
+    def ice_storm(self, t: float, pools: List[Tuple[str, str, str]],
+                  restore_at: Optional[float] = None,
+                  restore_count: int = 1_000_000) -> "ScenarioBuilder":
+        """Exhaust the named (instance_type, zone, capacity_type) pools at
+        `t` -- launches ICE, the scheduler routes around them -- and
+        restore at `restore_at` (unrestored pools risk non-convergence,
+        which replay treats as an invariant violation)."""
+        for itype, zone, ct in pools:
+            self.at(t, {"ev": "ice", "instance_type": itype, "zone": zone,
+                        "capacity_type": ct, "count": 0})
+            if restore_at is not None:
+                self.at(restore_at, {"ev": "ice", "instance_type": itype,
+                                     "zone": zone, "capacity_type": ct,
+                                     "count": restore_count})
+        return self
+
+    def price_shock(self, t: float, instance_types: List[str],
+                    factor: float) -> "ScenarioBuilder":
+        for itype in instance_types:
+            self.at(t, {"ev": "price", "instance_type": itype, "factor": factor})
+        return self
+
+    def pod_churn(self, t: float, fraction: float) -> "ScenarioBuilder":
+        """Delete a seeded fraction of the pods that ARRIVE before `t`
+        (a delete sorting ahead of its pod's arrival would no-op at
+        replay): the workload-shrinks-behind-us shape consolidation
+        feeds on."""
+        candidates = [(at, name) for at, name in self._pods if at < t]
+        n = int(len(candidates) * fraction)
+        if not n:
+            return self
+        idx = self.rng.choice(len(candidates), size=n, replace=False)
+        for i in sorted(int(j) for j in idx):
+            self.at(t, {"ev": "pod_delete", "name": candidates[i][1]})
+            self._pods.remove(candidates[i])
+        return self
+
+    # -- compilation ---------------------------------------------------------
+    def build(self) -> List[dict]:
+        """Quantize the timeline into ticks: events land in the tick bucket
+        covering their timestamp, each bucket is followed by one `advance`
+        of the tick interval. Event order inside a bucket is (t, insertion
+        seq) -- fully deterministic."""
+        events: List[dict] = [{
+            "ev": "header", "version": TRACE_VERSION, "scenario": self.name,
+            "seed": self.seed, "tick_seconds": self.tick_seconds,
+        }]
+        if not self._timed:
+            return events
+        timed = sorted(self._timed, key=lambda x: (x[0], x[1]))
+        horizon = timed[-1][0]
+        n_ticks = int(horizon // self.tick_seconds) + 1
+        i = 0
+        for k in range(n_ticks):
+            boundary = (k + 1) * self.tick_seconds
+            while i < len(timed) and timed[i][0] < boundary:
+                events.append(timed[i][2])
+                i += 1
+            events.append({"ev": "advance", "dt": self.tick_seconds})
+        return events
+
+
+# -- the standard corpus -----------------------------------------------------
+
+def _cheap_types(n: int = 3) -> List[str]:
+    """The n cheapest on-demand types in the static catalog -- the pools
+    the lowest-price strategy hits first, so exhausting them actually
+    bites. Deterministic: the catalog pipeline is."""
+    from karpenter_tpu.providers.instancetype import gen_catalog
+
+    types = gen_catalog.generate_instance_types()
+    ranked = sorted(types, key=lambda t: (gen_catalog.on_demand_price(t), t.name))
+    return [t.name for t in ranked[:n]]
+
+
+def _scenario_diurnal_small(seed: int) -> ScenarioBuilder:
+    return ScenarioBuilder("diurnal-small", seed).diurnal(
+        start=0.0, duration=60.0, base_rate=0.1, peak_rate=0.8)
+
+
+def _scenario_diurnal_medium(seed: int) -> ScenarioBuilder:
+    b = ScenarioBuilder("diurnal-medium", seed)
+    b.diurnal(start=0.0, duration=240.0, base_rate=0.3, peak_rate=3.0)
+    b.pod_churn(t=300.0, fraction=0.3)
+    return b
+
+
+def _scenario_ice_storm(seed: int) -> ScenarioBuilder:
+    b = ScenarioBuilder("ice-storm", seed)
+    pools = []
+    for itype in _cheap_types(2):
+        for zone in ("us-central-1a", "us-central-1b", "us-central-1c",
+                     "us-central-1d"):
+            pools.append((itype, zone, "spot"))
+            pools.append((itype, zone, "on-demand"))
+    # storm FIRST, then the burst arrives into the outage; restore later
+    b.ice_storm(t=1.0, pools=pools, restore_at=45.0)
+    b.poisson_arrivals(start=3.0, duration=15.0, rate_per_s=1.0)
+    return b
+
+
+def _scenario_interruption_wave(seed: int) -> ScenarioBuilder:
+    b = ScenarioBuilder("interruption-wave", seed)
+    b.poisson_arrivals(start=0.0, duration=20.0, rate_per_s=0.8)
+    # quiet window (fleet settled, pipeline drained) before the wave
+    b.interruption_wave(t=60.0, count=3)
+    return b
+
+
+def _scenario_spread_burst(seed: int) -> ScenarioBuilder:
+    b = ScenarioBuilder("spread-burst", seed)
+    b.spread_burst(t=1.0, n=9, app="web")
+    b.spread_burst(t=20.0, n=6, app="api")
+    return b
+
+
+def _scenario_binpack_adversarial(seed: int) -> ScenarioBuilder:
+    b = ScenarioBuilder("binpack-adversarial", seed)
+    b.binpack_adversarial(t=1.0, n=18)
+    b.price_shock(t=40.0, instance_types=_cheap_types(1), factor=3.0)
+    return b
+
+
+STANDARD_SCENARIOS = {
+    "diurnal-small": _scenario_diurnal_small,
+    "diurnal-medium": _scenario_diurnal_medium,
+    "ice-storm": _scenario_ice_storm,
+    "interruption-wave": _scenario_interruption_wave,
+    "spread-burst": _scenario_spread_burst,
+    "binpack-adversarial": _scenario_binpack_adversarial,
+}
+
+# the committed corpus (tests/golden/scenarios/): small, fast, and one per
+# chaos family; diurnal-medium stays generate-on-demand (bench's stage)
+CORPUS_SCENARIOS = ("diurnal-small", "ice-storm", "interruption-wave")
+DEFAULT_SEED = 20260803
+
+
+def build_scenario(name: str, seed: int = DEFAULT_SEED) -> List[dict]:
+    if name not in STANDARD_SCENARIOS:
+        raise KeyError(
+            f"unknown scenario {name!r} (have {sorted(STANDARD_SCENARIOS)})")
+    return STANDARD_SCENARIOS[name](seed).build()
