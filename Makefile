@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos sim-corpus
+.PHONY: test deflake benchmark bench-warm benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos sim-corpus
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -33,6 +33,9 @@ endef
 benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
 	$(PY) bench.py --profile > bench_last.json; rc=$$?; cat bench_last.json; \
 	$(PY) hack/tier_stamp.py benchmark --from-bench bench_last.json || true; exit $$rc
+
+bench-warm:  ## warm steady-state delta stage only (incremental tick engine: warm_delta_tick_p50_ms, delta payload bytes, tail_ratio); one JSON line
+	$(PY) bench.py --warm-only > bench_warm_last.json; rc=$$?; cat bench_warm_last.json; exit $$rc
 
 chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count (full-length schedule stays behind -m slow)
 	KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py -q -m 'not slow' $(call STAMP,chaos)

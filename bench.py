@@ -158,13 +158,16 @@ def build_catalog_items():
     return prov.list(nc), cloud
 
 
-def synth_pods(rng: np.random.Generator, zones, n_pods: int, salt: int):
+def synth_pods(rng: np.random.Generator, zones, n_pods: int, salt: int,
+               templates: int = 0):
     """A 50k-pod pending set of REAL Pod objects (VERDICT round 1, item 2:
     host-side encoding must be inside the measurement). Spec mix modeled on
     the reference's scale-test workloads (test/suites/scale): many replicas
     over ~160 distinct deployment specs -- mostly small web pods, some
     medium services, a few large; ~20% zone-pinned, ~15% on-demand-only,
-    some arch/category constrained, some tolerating dedicated taints."""
+    some arch/category constrained, some tolerating dedicated taints.
+    `templates` overrides the template-universe size (the warm-delta stage
+    models arrival waves spanning a few dozen deployments, not all 160)."""
     from karpenter_tpu.apis import Pod, labels as wk
     from karpenter_tpu.scheduling import Resources, Toleration
     from karpenter_tpu.scheduling import resources as res
@@ -172,7 +175,7 @@ def synth_pods(rng: np.random.Generator, zones, n_pods: int, salt: int):
     cpu_choices = np.array([100, 100, 250, 250, 500, 500, 1000, 2000, 4000, 8000])
     mem_choices = np.array([128, 256, 512, 512, 1024, 2048, 4096, 8192, 16384, 32768])
 
-    T = N_SPEC_TEMPLATES
+    T = templates or N_SPEC_TEMPLATES
     sizes = rng.integers(0, len(cpu_choices), size=T)
     weights = rng.dirichlet(np.ones(T) * 0.5)
     counts = np.maximum(1, (weights * n_pods).astype(np.int64))
@@ -596,6 +599,166 @@ def _breaker_degraded(pool, items, zones, rng, iters: int) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _decision_sig(res):
+    """Canonical comparable form of one SchedulingResult (the warm-delta
+    stage's per-tick differential: delta vs full must be bit-identical)."""
+    return (
+        sorted(
+            (tuple(sorted(p.metadata.name for p in g.pods)), g.instance_types[0].name)
+            for g in res.new_groups
+        ),
+        sorted(res.existing_assignments.items()),
+        sorted(res.unschedulable.items()),
+    )
+
+
+def _warm_delta(pool, items, zones, iters: int) -> dict:
+    """Warm steady-state stage (the incremental delta-solve tentpole's
+    acceptance measurement). Models the production steady state at the
+    N_PODS tier: per tick an ARRIVAL WAVE of churn_fraction x N_PODS pods
+    lands (identical template mix each tick -- a steady workload -- plus a
+    rotating hot-template surge, the deployment actively scaling), and the
+    tick costs what changed: grouping hits the cross-tick signature memo,
+    encode hits the per-class row cache, and the wire ships only the dirty
+    class rows against the staged class epoch (solver/rpc.py solve_delta).
+
+    Three comparisons land in the JSON line:
+    - warm_delta_tick_p50_ms vs warm_full_reference_p50_ms: the steady-
+      state tick against the full re-encode tick (the whole N_PODS pending
+      set re-grouped/re-encoded/re-shipped -- what every tick cost before
+      the incremental engine). The acceptance claim: >= 2x.
+    - warm_delta_tick_p50_ms vs warm_full_tick_p50_ms: the same wave
+      through the engine with delta + incremental OFF (the engine-only
+      win, same batch both sides, decisions asserted identical per tick).
+    - payload bytes: delta rows shipped vs the full-tensor ship, same
+      shape and vs the full-tier reference. The acceptance claim: >= 5x.
+
+    The tail_ratio assertion (satellite: r05 warm p99 spikes) rides along:
+    after freeze_caches() the warm tail must stay within
+    BENCH_TAIL_RATIO_MAX (default 3.0) of the p50; the boolean lands in
+    the artifact rather than raising (the one-JSON-line contract)."""
+    import shutil
+    import tempfile
+
+    from karpenter_tpu.solver import rpc
+    from karpenter_tpu.solver.oracle import Scheduler
+    from karpenter_tpu.solver.service import TPUSolver
+
+    churn_frac = max(0.001, min(0.10, _env_f("BENCH_CHURN_FRACTION", 0.05)))
+    wave = max(8, int(N_PODS * churn_frac))
+    arrival_templates = min(N_SPEC_TEMPLATES, 40)
+    d = tempfile.mkdtemp(prefix="bench_delta_")
+    sock = os.path.join(d, "solver.sock")
+    srv = client_d = client_f = None
+
+    def sched():
+        return Scheduler(
+            nodepools=[pool], instance_types={pool.name: items}, zones=set(zones)
+        )
+
+    def wave_pods(i: int):
+        # identical template mix every tick (fixed rng seed; fresh names
+        # via salt) plus a surge on a 3-template universe whose size
+        # rotates -- so a handful of class rows are dirty per tick, the
+        # steady-state shape the delta wire exists for
+        base = synth_pods(np.random.default_rng(1234), zones, wave,
+                          salt=70_000 + i, templates=arrival_templates)
+        surge_n = 8 + (i % 3) * 7
+        surge = synth_pods(np.random.default_rng(99), zones, surge_n,
+                           salt=80_000 + i, templates=3)
+        return base + surge
+
+    try:
+        srv = rpc.SolverServer(path=sock).start()
+        client_d = rpc.SolverClient(path=sock, delta=True)
+        client_f = rpc.SolverClient(path=sock, delta=False)
+        sd = TPUSolver(g_max=G_MAX, client=client_d, incremental=True)
+        sf = TPUSolver(g_max=G_MAX, client=client_f, incremental=False)
+        # unmeasured warm ticks: compile the wave-tier shapes, establish
+        # the delta base epoch, and fill the grouping/row caches
+        for w in (wave_pods(-2), wave_pods(-1)):
+            sf.schedule(sched(), w)
+            sd.schedule(sched(), w)
+        # satellite (r05 warm p99 spikes): the staged catalog, row cache,
+        # and grouping memos are long-lived after warmup -- freeze them out
+        # of every later gen2 walk
+        sd.freeze_caches()
+
+        delta_ms, full_ms = [], []
+        payload_d, payload_f, rows_shipped, dirty_frac, modes = [], [], [], [], []
+        identical = True
+        for i in range(iters):
+            pods = wave_pods(i)
+            t0 = time.perf_counter()
+            res_f = sf.schedule(sched(), pods)
+            full_ms.append((time.perf_counter() - t0) * 1e3)
+            payload_f.append(client_f.last_delta["payload_bytes"])
+            t0 = time.perf_counter()
+            res_d = sd.schedule(sched(), pods)
+            delta_ms.append((time.perf_counter() - t0) * 1e3)
+            ld = dict(client_d.last_delta)
+            payload_d.append(ld["payload_bytes"])
+            modes.append(ld["mode"])
+            if ld["mode"] == "delta":
+                rows_shipped.append(ld["rows"])
+            dirty_frac.append(sd.last_group_stats.get("dirty_fraction", 1.0))
+            identical = identical and _decision_sig(res_d) == _decision_sig(res_f)
+        # the full re-encode reference: the whole N_PODS-tier pending set
+        # re-grouped, re-encoded, and re-shipped through the same sidecar
+        sf.schedule(sched(), synth_pods(
+            np.random.default_rng(4321), zones, N_PODS, salt=85_000))  # warm shapes
+        ref_ms = []
+        for i in range(2):
+            full_set = synth_pods(
+                np.random.default_rng(4321), zones, N_PODS, salt=85_001 + i)
+            t0 = time.perf_counter()
+            sf.schedule(sched(), full_set)
+            ref_ms.append((time.perf_counter() - t0) * 1e3)
+        ref_payload = int(client_f.last_delta["payload_bytes"])
+
+        p50 = float(np.percentile(delta_ms, 50))
+        p99 = float(np.percentile(delta_ms, 99))
+        full_p50 = float(np.percentile(full_ms, 50))
+        ref_p50 = float(np.percentile(ref_ms, 50))
+        pay_d = float(np.median(payload_d))
+        pay_f = float(np.median(payload_f))
+        tail = p99 / p50 if p50 > 0 else 0.0
+        return {
+            "warm_delta_tick_p50_ms": round(p50, 2),
+            "warm_delta_tick_p99_ms": round(p99, 2),
+            "warm_delta_iters_ms": [round(x, 1) for x in delta_ms],
+            "warm_full_tick_p50_ms": round(full_p50, 2),
+            "warm_full_reference_p50_ms": round(ref_p50, 2),
+            "warm_delta_speedup_vs_full_tier": round(ref_p50 / p50, 2) if p50 else 0.0,
+            "warm_delta_speedup_same_batch": round(full_p50 / p50, 2) if p50 else 0.0,
+            "warm_delta_payload_bytes_p50": int(pay_d),
+            "warm_full_payload_bytes_p50": int(pay_f),
+            "warm_full_reference_payload_bytes": ref_payload,
+            "warm_delta_payload_reduction_same_shape": round(pay_f / pay_d, 1) if pay_d else 0.0,
+            "warm_delta_payload_reduction_vs_full_tier": round(ref_payload / pay_d, 1) if pay_d else 0.0,
+            "warm_delta_rows_shipped_p50": (
+                int(np.median(rows_shipped)) if rows_shipped else -1
+            ),
+            "warm_delta_modes": modes,
+            "warm_delta_dirty_fraction_p50": round(float(np.median(dirty_frac)), 4),
+            "warm_delta_churn_fraction": churn_frac,
+            "warm_delta_wave_pods": wave,
+            "warm_delta_decisions_identical": identical,
+            "warm_delta_tail_ratio": round(tail, 3),
+            "warm_delta_tail_ok": bool(
+                tail <= _env_f("BENCH_TAIL_RATIO_MAX", 3.0)
+            ),
+        }
+    finally:
+        if client_d is not None:
+            client_d.close()
+        if client_f is not None:
+            client_f.close()
+        if srv is not None:
+            srv.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _sim_scenario() -> dict:
     """Scenario-replay stage (sim subsystem): the medium diurnal scenario
     -- sustained sinusoidal arrivals, then a 30% pod churn -- replayed
@@ -647,7 +810,7 @@ def _gen2_collections() -> int:
     return int(gc.get_stats()[2].get("collections", 0))
 
 
-def run(profile: bool, progress=lambda ev: None):
+def run(profile: bool, progress=lambda ev: None, warm_only: bool = False):
     import jax
 
     from karpenter_tpu.apis import NodePool
@@ -655,6 +818,17 @@ def run(profile: bool, progress=lambda ev: None):
 
     backend = jax.default_backend()
     progress({"ev": "backend", "backend": backend})
+
+    # incremental headline persistence (satellite: r05 died rc=124 with
+    # parsed null): every completed stage's fields stream out as a
+    # stage_fields event; the parent folds them into the side-file partial
+    # it rewrites after each event, so a hard `timeout -k` kill loses at
+    # most the stage in flight, never the whole run
+    acc: dict = {}
+
+    def stage_fields(fields: dict) -> None:
+        acc.update(fields)
+        progress({"ev": "stage_fields", "fields": dict(acc)})
     # degraded-CPU runs measure a solve ~6x slower than the accelerator's;
     # trim iteration counts so the fallback stays bounded for the driver
     # (the percentiles remain meaningful, just coarser)
@@ -671,6 +845,20 @@ def run(profile: bool, progress=lambda ev: None):
     progress({"ev": "phase", "name": "catalog", "secs": round(t_catalog, 2)})
 
     pool = NodePool("default")
+    if warm_only:
+        # `make bench-warm`: only the warm steady-state delta stage (plus
+        # setup) -- the fast iteration loop for the incremental engine
+        out = {
+            "metric": f"warm_delta_tick_p50_{N_PODS // 1000}k_pods",
+            "unit": "ms",
+            "mode": "warm_delta_only",
+            "platform": backend,
+        }
+        out.update(_warm_delta(pool, items, zones,
+                               iters=10 if backend != "cpu" else 8))
+        out["value"] = out.get("warm_delta_tick_p50_ms", 0.0)
+        stage_fields(out)
+        return out
     solver = TPUSolver(g_max=G_MAX)
 
     rng = np.random.default_rng(42)
@@ -769,6 +957,14 @@ def run(profile: bool, progress=lambda ev: None):
 
     p50, p99 = float(np.percentile(cold, 50)), float(np.percentile(cold, 99))
     warm_p50, warm_p99 = float(np.percentile(warm, 50)), float(np.percentile(warm, 99))
+    stage_fields({
+        "metric": f"p99_scheduling_decision_latency_{N_PODS // 1000}k_pods",
+        "value": round(p99, 2), "unit": "ms",
+        "vs_baseline": round(TARGET_MS / p99, 3) if p99 > 0 else 0.0,
+        "p50_ms": round(p50, 2), "mode": "cold_pods",
+        "warm_p50_ms": round(warm_p50, 2), "warm_p99_ms": round(warm_p99, 2),
+        "platform": backend,
+    })
 
     # fleet price of the decision under the price objective, and the same
     # workload solved with the legacy max-fit objective for the A/B
@@ -797,6 +993,18 @@ def run(profile: bool, progress=lambda ev: None):
     except Exception as e:  # noqa: BLE001 - the JSON line must always appear
         production["production_tick_error"] = f"{type(e).__name__}: {e}"[:200]
     progress({"ev": "phase", "name": "production_pipelined"})
+    stage_fields(production)
+
+    # warm steady-state delta stage (the incremental-tick tentpole's
+    # acceptance fields): always runs -- warm_delta_tick_p50_ms and the
+    # delta-payload fields are headline acceptance data, not a secondary
+    try:
+        production.update(_warm_delta(
+            pool, items, zones, iters=10 if backend != "cpu" else 8))
+    except Exception as e:  # noqa: BLE001
+        production["warm_delta_error"] = f"{type(e).__name__}: {e}"[:200]
+    progress({"ev": "phase", "name": "warm_delta"})
+    stage_fields(production)
 
     # secondary measurements -- each individually fenced so a failure can
     # never cost the headline (the JSON line must always appear)
@@ -809,6 +1017,7 @@ def run(profile: bool, progress=lambda ev: None):
         except Exception as e:  # noqa: BLE001
             secondary["rpc_loopback_error"] = f"{type(e).__name__}: {e}"[:200]
         progress({"ev": "phase", "name": "rpc_loopback"})
+        stage_fields(secondary)
         try:
             secondary.update(_mixed_affinity(
                 solver, pool, items, zones, rng,
@@ -816,6 +1025,7 @@ def run(profile: bool, progress=lambda ev: None):
         except Exception as e:  # noqa: BLE001
             secondary["mixed_affinity_error"] = f"{type(e).__name__}: {e}"[:200]
         progress({"ev": "phase", "name": "mixed_affinity"})
+        stage_fields(secondary)
         # stage-attributed tracing segment (observability PR): per-span
         # p50/p99 through the production rig topology + overlap fraction,
         # and the measured tracing tax on this tier's solve
@@ -824,6 +1034,7 @@ def run(profile: bool, progress=lambda ev: None):
         except Exception as e:  # noqa: BLE001
             secondary["trace_rig_error"] = f"{type(e).__name__}: {e}"[:200]
         progress({"ev": "phase", "name": "traced_rig"})
+        stage_fields(secondary)
         try:
             secondary.update(_tracing_overhead(
                 solver, pool, items, workloads,
@@ -831,6 +1042,7 @@ def run(profile: bool, progress=lambda ev: None):
         except Exception as e:  # noqa: BLE001
             secondary["tracing_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
         progress({"ev": "phase", "name": "tracing_overhead"})
+        stage_fields(secondary)
         # degraded-mode stage (robustness PR): sidecar down + breaker open
         # -> breaker_open_tick_p99_ms proves the tick completes on the CPU
         # fallback with no connect stall
@@ -841,6 +1053,7 @@ def run(profile: bool, progress=lambda ev: None):
         except Exception as e:  # noqa: BLE001
             secondary["breaker_degraded_error"] = f"{type(e).__name__}: {e}"[:200]
         progress({"ev": "phase", "name": "breaker_degraded"})
+        stage_fields(secondary)
         # scenario-replay stage (sim subsystem): ticks/s through the full
         # operator stack on the medium diurnal scenario + its fleet KPIs
         try:
@@ -848,6 +1061,7 @@ def run(profile: bool, progress=lambda ev: None):
         except Exception as e:  # noqa: BLE001
             secondary["sim_replay_error"] = f"{type(e).__name__}: {e}"[:200]
         progress({"ev": "phase", "name": "sim_scenario"})
+        stage_fields(secondary)
 
     # decompose the wall-clock number into tunnel overhead vs compute.
     # Under axon the chip sits behind a network tunnel whose EVERY
@@ -930,7 +1144,7 @@ def _child_main() -> None:
         # plugin via sitecustomize; the config override wins regardless
         jax.config.update("jax_platforms", "cpu")
     try:
-        out = run(profile, progress)
+        out = run(profile, progress, warm_only="--warm-only" in sys.argv)
         progress({"ev": "result", "out": out})
         print(json.dumps(out))
     except Exception as e:  # noqa: BLE001 - parent assembles a partial
@@ -944,7 +1158,40 @@ def _child_main() -> None:
 # running child and its progress path here (and main records the degrade
 # transition) so the handler can kill the child, assemble the best
 # partial WITH its claim provenance, and still print the one JSON line
-_WATCH = {"proc": None, "events_path": None, "degraded": False, "probe_error": None}
+_WATCH = {
+    "proc": None, "events_path": None, "degraded": False, "probe_error": None,
+    # incremental persistence (satellite: r05 rc=124, parsed null): the
+    # watch loop rewrites this side file (write-then-rename) with the best
+    # current partial after every progress event, so the SIGTERM handler
+    # only has to FLUSH it -- and even a straight SIGKILL leaves it on
+    # disk for post-mortem
+    "side_path": None,
+}
+
+
+def _write_side(out: dict) -> None:
+    """Atomically persist the current best partial to the side file."""
+    side = _WATCH.get("side_path")
+    if not side or out is None:
+        return
+    try:
+        tmp = side + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, side)
+    except OSError:
+        pass  # persistence is best-effort; the events path still exists
+
+
+def _read_side() -> "dict | None":
+    side = _WATCH.get("side_path")
+    if not side:
+        return None
+    try:
+        with open(side) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def _clamped_budget(env_name: str, default: float, remaining: float, reserve: float) -> float:
@@ -970,8 +1217,13 @@ def _install_sigterm_last_resort() -> None:
                 proc.kill()
             except OSError:
                 pass
-        events = _read_events(_WATCH["events_path"]) if _WATCH.get("events_path") else []
-        out = _assemble_partial(events, f"terminated by signal {signum}")
+        # fast path: the watch loop has been persisting the best partial
+        # incrementally; flushing it needs no event re-parse, so the line
+        # lands inside even a short `timeout -k` grace window
+        out = _read_side()
+        if out is None:
+            events = _read_events(_WATCH["events_path"]) if _WATCH.get("events_path") else []
+            out = _assemble_partial(events, f"terminated by signal {signum}")
         if out is None:
             out = {
                 "metric": f"p99_scheduling_decision_latency_{N_PODS // 1000}k_pods",
@@ -1030,6 +1282,8 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
     args = [sys.executable, os.path.abspath(__file__), "--child"]
     if profile:
         args.append("--profile")
+    if "--warm-only" in sys.argv:
+        args.append("--warm-only")
     proc = subprocess.Popen(
         args, stdout=subprocess.DEVNULL, stderr=None, text=True, env=env
     )
@@ -1037,6 +1291,8 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
     start = time.monotonic()
     last_size = -1
     last_change = start
+    last_side = 0.0
+    side_dirty = False
     measuring = False
     # single long operations before the first measured iteration (the
     # first XLA compile of a 50k-pod program over a cold tunnel, a slow
@@ -1057,11 +1313,21 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
         if size != last_size:
             last_size = size
             last_change = now
+            side_dirty = True
+        # persist the best current partial (write-then-rename): the
+        # SIGTERM handler flushes this file, and a hard kill still
+        # leaves it on disk. Throttled to every ~10s once measurement
+        # starts -- re-parsing the event log per iteration would make
+        # the watch loop quadratic for at most 10s less staleness.
+        if side_dirty and (not measuring or now - last_side >= 10.0):
+            events = _read_events(path)
             if not measuring:
                 measuring = any(
-                    e.get("ev") in ("cold_iter", "warm_iter")
-                    for e in _read_events(path)
+                    e.get("ev") in ("cold_iter", "warm_iter") for e in events
                 )
+            _write_side(_assemble_partial(events, "in progress"))
+            last_side = now
+            side_dirty = False
         if now - start > budget_s:
             why = f"budget exceeded ({budget_s:.0f}s)"
             proc.kill()
@@ -1090,14 +1356,33 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
 def _assemble_partial(events: list, why: str):
     """Build the best completed-accelerator partial from child progress
     events (VERDICT round 3, item 1: a mid-run tunnel loss must emit the
-    completed TPU iterations, not silently fall back to CPU)."""
+    completed TPU iterations, not silently fall back to CPU). Completed-
+    stage fields streamed via stage_fields events overlay the estimate:
+    they carry the child's own computed stats for every stage that
+    FINISHED, so a late kill loses only the stage in flight."""
     backend = next((e["backend"] for e in events if e.get("ev") == "backend"), None)
     cold = [e["ms"] for e in events if e.get("ev") == "cold_iter"]
     warm = [e["ms"] for e in events if e.get("ev") == "warm_iter"]
     gc2 = sum(e.get("gc2", 0) for e in events
               if e.get("ev") in ("cold_iter", "warm_iter"))
+    fields: dict = {}
+    for e in events:
+        if e.get("ev") == "stage_fields":
+            fields.update(e.get("fields", {}))
     sample, mode = (cold, "cold_pods_partial") if len(cold) >= 5 else (warm, "warm_partial")
     if len(sample) < 5 or backend is None:
+        if fields and backend is not None:
+            # no usable iteration stream (e.g. a warm-only run), but whole
+            # stages completed: their fields ARE the partial
+            out = {
+                "metric": f"p99_scheduling_decision_latency_{N_PODS // 1000}k_pods",
+                "value": 0.0, "unit": "ms", "vs_baseline": 0.0,
+                "partial": True, "partial_reason": why[:300],
+                "platform": backend,
+                "claim_basis": f"{'cpu' if backend == 'cpu' else 'accelerator'}_stage_fields",
+            }
+            out.update(fields)
+            return out
         return None
     arr = np.array(sample)
     p50, p99 = float(np.percentile(arr, 50)), float(np.percentile(arr, 99))
@@ -1120,6 +1405,9 @@ def _assemble_partial(events: list, why: str):
             f"_partial_{len(sample)}_iters"
         ),
     }
+    # completed-stage overlay: the child's own computed stats win over the
+    # iteration-stream estimate for every stage that finished
+    out.update(fields)
     return out
 
 
@@ -1158,6 +1446,17 @@ def main() -> None:
     # 2 h default exceeded the driver's timeout; rc 124, no line printed)
     wall_budget = _env_f("BENCH_WALL_BUDGET_S", 3300.0)
     t_wall = time.monotonic()
+
+    # incremental persistence target (satellite): overridable for tests;
+    # unique per run so a stale file can never masquerade as this run's
+    import tempfile as _tempfile
+
+    side = os.environ.get("BENCH_SIDE_PATH")
+    if not side:
+        fd, side = _tempfile.mkstemp(prefix="bench_partial_", suffix=".json")
+        os.close(fd)
+        os.unlink(side)  # the first _write_side re-creates it atomically
+    _WATCH["side_path"] = side
 
     def remaining() -> float:
         return max(0.0, wall_budget - (time.monotonic() - t_wall))
@@ -1245,6 +1544,13 @@ def main() -> None:
         }
         _attach_capture(err_out)
         print(json.dumps(err_out))
+    if not os.environ.get("BENCH_SIDE_PATH"):
+        # the run printed its line; the temp side file has served its
+        # purpose (an explicit BENCH_SIDE_PATH is left for the caller)
+        try:
+            os.unlink(_WATCH["side_path"])
+        except (OSError, TypeError):
+            pass
     sys.stdout.flush()
 
 

@@ -315,6 +315,9 @@ def main(argv=None) -> int:
             # /healthz carries the breaker state; /debug/breaker serves the
             # full document (loopback-only)
             health.breaker_info = breaker.describe
+        if hasattr(op.solver, "describe_wire"):
+            # /debug/solver: incremental-tick engine + staging LRU state
+            health.solver_info = op.solver.describe_wire
     # latency GC policy: the provider graph and (if enabled) the jax
     # runtime are now the long-lived baseline; freeze it and stop gen2
     # collections from landing inside scheduling ticks

@@ -260,6 +260,7 @@ class Provisioner:
             with tracing.span("dispatch", mode="pipelined") as disp_sp:
                 ticket = self.solver.schedule_begin(scheduler, pods)
                 disp_sp.set(completed_at_begin=ticket.completed)
+                self._annotate_group_stats(disp_sp)
             if not ticket.completed:
                 metrics.SOLVER_PIPELINE_TICKS.inc(mode="pipelined")
                 self._inflight = (
@@ -270,14 +271,29 @@ class Provisioner:
                 return self.last_result
             decision = ticket.done
         elif self.solver is not None:
-            with tracing.span("dispatch", mode="synchronous"):
+            with tracing.span("dispatch", mode="synchronous") as disp_sp:
                 decision = self.solver.schedule(scheduler, pods)
+                self._annotate_group_stats(disp_sp)
         else:
             with tracing.span("dispatch", mode="oracle"):
                 decision = scheduler.schedule(pods)
         metrics.SOLVER_PIPELINE_TICKS.inc(mode="synchronous")
         return self._apply_decision(
             decision, vol_blocked, time.perf_counter() - t0, len(pods)
+        )
+
+    def _annotate_group_stats(self, sp) -> None:
+        """Surface the solver's dirty-tracking grouping stats (incremental
+        tick engine) on the dispatch span: how much of the pending set
+        actually churned since the last tick is the number that explains
+        why a warm tick was cheap (or was not)."""
+        st = getattr(self.solver, "last_group_stats", None)
+        if not st:
+            return
+        sp.set(
+            group_classes=st.get("classes", 0),
+            group_dirty=st.get("dirty_classes", 0),
+            group_dirty_fraction=round(st.get("dirty_fraction", 1.0), 4),
         )
 
     def _drain_pipeline(self) -> Optional[SchedulingResult]:

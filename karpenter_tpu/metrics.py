@@ -258,7 +258,7 @@ SOLVER_PIPELINE_TICKS = REGISTRY.counter(
 SOLVER_PIPELINE_FALLBACKS = REGISTRY.counter(
     "karpenter_scheduler_pipeline_fallbacks_total",
     "Pipelined solves that fell back to the synchronous path mid-flight",
-    labels=("reason",),  # catalog-changed | stale-seqnum | rpc-degraded | rpc-down
+    labels=("reason",),  # catalog-changed | stale-seqnum | stale-epoch | rpc-degraded | rpc-down
 )
 NODES_READY = REGISTRY.gauge(
     "karpenter_nodes_ready_count", "Ready nodes in the cluster",
@@ -300,6 +300,43 @@ BREAKER_PROBES = REGISTRY.counter(
 FAILPOINT_FIRES = REGISTRY.counter(
     "karpenter_failpoints_fired_total",
     "Fault injections fired by armed failpoints", labels=("site", "action"),
+)
+# incremental delta-solve engine (solver/encode.IncrementalGrouper,
+# solver/rpc.py solve_delta, solver/service.py wiring)
+DELTA_SOLVES = REGISTRY.counter(
+    "karpenter_scheduler_delta_solves_total",
+    "Wire solves by class-tensor shipping mode (delta = dirty rows only "
+    "against a staged class epoch; full = whole tensor set establishing a "
+    "new epoch; bypass = delta path not applicable)",
+    labels=("mode",),  # delta | full | bypass
+)
+DELTA_ROWS_SHIPPED = REGISTRY.counter(
+    "karpenter_scheduler_delta_rows_shipped_total",
+    "Dirty class-tensor rows shipped by delta solves (full solves ship "
+    "every row and are not counted here)",
+)
+DELTA_EPOCH_RESTAGES = REGISTRY.counter(
+    "karpenter_scheduler_delta_epoch_restages_total",
+    "Delta solves that fell back to a full class-tensor restage because "
+    "the sidecar no longer knew the base class epoch (restart or eviction)",
+)
+DELTA_DIRTY_FRACTION = REGISTRY.histogram(
+    "karpenter_scheduler_delta_dirty_fraction",
+    "Fraction of pod classes dirty (appeared, vanished, or changed count) "
+    "since the previous scheduling tick's grouping",
+    buckets=(0.01, 0.02, 0.05, 0.1, 0.2, 0.3, 0.5, 0.75, 1.0),
+)
+DELTA_PAYLOAD_BYTES = REGISTRY.histogram(
+    "karpenter_scheduler_delta_payload_bytes",
+    "Class-tensor payload bytes shipped per wire solve, by shipping mode",
+    labels=("mode",),  # delta | full | bypass
+    buckets=(1024, 4096, 16384, 65536, 262144, 1048576, 4194304),
+)
+SOLVER_STAGED_EVICTIONS = REGISTRY.counter(
+    "karpenter_solver_staged_evictions_total",
+    "Sidecar staging-LRU evictions by kind (catalog seqnums, class-tensor "
+    "epochs); an eviction costs the next referencing solve a full restage",
+    labels=("kind",),  # catalog | class_epoch
 )
 # scenario simulation & trace replay (karpenter_tpu/sim/)
 SIM_EVENTS = REGISTRY.counter(

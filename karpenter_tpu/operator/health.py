@@ -56,6 +56,11 @@ class HealthServer:
         # (CircuitBreaker.describe); wired by the binary after the
         # operator graph builds. None = no wire configured.
         self.breaker_info = None
+        # optional () -> dict with the incremental-tick engine's state
+        # (TPUSolver.describe_wire: grouping churn, delta shipping mode,
+        # staged seqnums/epochs, sidecar eviction counters). Served by
+        # /debug/solver, loopback-only.
+        self.solver_info = None
         self._started_at = time.monotonic()
         self._last_loop: float = 0.0   # 0 = run loop has not turned yet
         self._last_sweep: float = 0.0  # 0 = no full sweep completed yet
@@ -121,6 +126,24 @@ class HealthServer:
                 self._send(403, "debug endpoints are loopback-only")
                 return False
 
+            def _debug_json(self, fn) -> None:
+                """Shared serving for callback-backed /debug endpoints:
+                loopback guard, never-500 evaluation, JSON body. fn may be
+                None (not configured) or raise (reported as unconfigured)."""
+                if not self._loopback_only():
+                    return
+                import json
+
+                try:
+                    doc = fn() if fn is not None else None
+                except Exception:  # noqa: BLE001 -- debug must never 500
+                    doc = None
+                self._send(
+                    200,
+                    json.dumps(doc if doc is not None else {"configured": False}, indent=2),
+                    ctype="application/json",
+                )
+
             def do_GET(self):
                 if self.path == "/healthz":
                     # alive() evaluated ONCE: body and status must agree
@@ -149,16 +172,12 @@ class HealthServer:
                 elif self.path == "/debug/breaker":
                     # solver-wire circuit breaker (solver/breaker.py):
                     # state, consecutive failures, backoff, probe history
-                    if not self._loopback_only():
-                        return
-                    import json
-
-                    doc = outer._breaker_doc()
-                    self._send(
-                        200,
-                        json.dumps(doc if doc is not None else {"configured": False}, indent=2),
-                        ctype="application/json",
-                    )
+                    self._debug_json(outer._breaker_doc)
+                elif self.path == "/debug/solver":
+                    # incremental-tick engine state (solver/service.py
+                    # describe_wire): grouping churn, delta shipping, the
+                    # staging LRUs and their eviction counters
+                    self._debug_json(outer.solver_info)
                 elif self.path == "/debug/traces":
                     # slow-tick flight recorder (karpenter_tpu/tracing.py):
                     # the last N span trees whose sweep exceeded the slow

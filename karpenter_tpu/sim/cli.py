@@ -177,6 +177,29 @@ def _cmd_corpus(args) -> int:
             entry["golden_digest"] = golden.get(name)
             entry["note"] = "decision digest drifted from golden"
         report[name] = entry
+    # delta-path gate (incremental-tick engine): one scenario re-replayed
+    # through the wire sidecar with delta class shipping + incremental
+    # grouping FORCED on; its decision digest must equal the committed
+    # host golden bit-for-bit, or the corpus gate fails
+    if traces and rc == 0:
+        from karpenter_tpu.sim.replay import InvariantViolation, replay
+
+        path = traces[0]
+        name = os.path.splitext(os.path.basename(path))[0]
+        events = read_trace(path)
+        seed = _trace_seed(events, None)
+        want = new_digests.get(name) or golden.get(name)
+        try:
+            dres = replay(events, backend="delta", seed=seed)
+            entry = {"ok": dres.digest == want, "digest": dres.digest}
+            if not entry["ok"]:
+                rc = 1
+                entry["golden_digest"] = want
+                entry["note"] = "delta-path digest diverged from golden"
+        except InvariantViolation as e:
+            rc = 1
+            entry = {"ok": False, "note": f"delta-path invariant violation: {e}"}
+        report[f"delta:{name}"] = entry
     if args.update_digests:
         if rc != 0:
             # never pin a diverging run's digest (or null from a failed
@@ -211,7 +234,7 @@ def main(argv=None) -> int:
 
     rep = sub.add_parser("replay", help="replay a trace through the operator stack")
     rep.add_argument("trace")
-    rep.add_argument("--backend", choices=("host", "wire", "pipelined"),
+    rep.add_argument("--backend", choices=("host", "wire", "pipelined", "delta"),
                      default="host")
     rep.add_argument("--differential", action="store_true",
                      help="replay through host+wire+pipelined and compare")
@@ -225,7 +248,7 @@ def main(argv=None) -> int:
     shr.add_argument("trace")
     shr.add_argument("--mode", choices=("differential", "invariant"),
                      default="differential")
-    shr.add_argument("--backend", choices=("host", "wire", "pipelined"),
+    shr.add_argument("--backend", choices=("host", "wire", "pipelined", "delta"),
                      default="host", help="backend for --mode invariant")
     shr.add_argument("--seed", type=int, default=None)
     shr.add_argument("--max-probes", type=int, default=2_000)

@@ -42,6 +42,13 @@ from typing import Dict, List, Optional, Tuple
 from karpenter_tpu.sim.trace import pod_from_spec, validate_event
 
 BACKENDS = ("host", "wire", "pipelined")
+# extra named backend accepted by replay()/the CLI (not part of the
+# default differential trio): the wire sidecar with delta class shipping
+# and incremental grouping FORCED on regardless of environment -- the
+# corpus gate replays one scenario through it and fails on any digest
+# divergence from the committed host golden (the delta path's decisions
+# must be bit-identical to a full encode).
+EXTRA_BACKENDS = ("delta",)
 
 DEFAULT_TICK_SECONDS = 3.0
 MAX_SETTLE_TICKS = 80
@@ -92,8 +99,10 @@ def _percentile(samples: List[float], q: float) -> float:
 
 class _Engine:
     def __init__(self, backend: str, seed: int, tmpdir: Optional[str] = None):
-        if backend not in BACKENDS:
-            raise ValueError(f"unknown backend {backend!r} (want one of {BACKENDS})")
+        if backend not in BACKENDS + EXTRA_BACKENDS:
+            raise ValueError(
+                f"unknown backend {backend!r} (want one of {BACKENDS + EXTRA_BACKENDS})"
+            )
         self.backend = backend
         self.seed = seed
         self._tmpdir = tmpdir
@@ -135,7 +144,14 @@ class _Engine:
                 self._tmpdir = self._own_tmpdir.name
             sock = os.path.join(self._tmpdir, f"solver-{self.backend}.sock")
             self._server = SolverServer(path=sock).start()
-            self._client = SolverClient(path=sock, timeout=30.0, connect_timeout=0.5)
+            # the delta backend forces delta class shipping on (wire and
+            # pipelined inherit the environment default, which is also on
+            # -- the trio therefore exercises the delta path in CI, and
+            # this backend pins it even under KARPENTER_TPU_DELTA=0)
+            self._client = SolverClient(
+                path=sock, timeout=30.0, connect_timeout=0.5,
+                delta=True if self.backend == "delta" else None,
+            )
             self._breaker = CircuitBreaker(
                 failure_threshold=2, backoff_base=1000.0, rng=breaker_rng
             )

@@ -256,6 +256,11 @@ class PodClassSet:
     # Encodes the oracle's _try_group toleration gate: a class may join a
     # group only on columns of pools whose taints it tolerates.
     join_allowed: np.ndarray = None
+    # [C, R] float64 EXACT base-unit per-pod request vectors (requests +
+    # one pod axis), used by the vectorized decode: group totals become one
+    # matmul instead of a per-class Python loop. Host-side only -- never
+    # shipped over the wire.
+    base_req: np.ndarray = None
 
 
 def soft_zone_tsc(pod: Pod):
@@ -476,6 +481,142 @@ def group_pods(pods: Sequence[Pod], extra_requirements: Optional[Requirements] =
     return out
 
 
+class IncrementalGrouper:
+    """Dirty-tracking grouping across scheduling ticks (the delta-solve
+    engine's host layer). group() is drop-in equivalent to group_pods(pods)
+    -- same classes, same order, same pods lists, fresh PodClass objects
+    per call (pipelined tickets own their class lists) -- but every
+    per-signature canonical computation is memoized ACROSS ticks instead
+    of per call: Requirements construction, the class key, the scaled
+    request vector, the routing flags, and the FFD sort key (a pure
+    function of class identity: every pod_sort_key component is determined
+    by the _class_key components). A warm steady-state tick's grouping
+    therefore costs one token/signature dict probe + list append per pod
+    (the same native C loop group_pods runs) plus canonical work ONLY for
+    signatures never seen before -- classification cost scales with churn,
+    not cluster size.
+
+    Routing flags are memoized PER SIGNATURE and OR'd over the signatures
+    present THIS tick (exactly group_pods' fresh semantics -- a class whose
+    affinity-carrying pods all left does not keep a stale flag).
+
+    last_stats reports the tick-over-tick churn: classes whose pod count
+    changed, appeared, or vanished since the previous call -- the
+    dirty-fraction signal the delta wire metrics and span attrs quote.
+
+    Not thread-safe; owned by the (single-threaded) scheduling tick."""
+
+    def __init__(self):
+        # sig id -> (class key, Requirements, requests f32, flags)
+        self._sig_memo: Dict[int, tuple] = {}
+        self._sort_memo: Dict[tuple, tuple] = {}   # class key -> pod_sort_key
+        self._prev_counts: Dict[tuple, int] = {}
+        self.last_stats = {
+            "pods": 0, "classes": 0, "dirty_classes": 0, "new_classes": 0,
+            "removed_classes": 0, "dirty_fraction": 1.0, "full_rebuild": True,
+        }
+
+    def reset(self) -> None:
+        self.__init__()
+
+    def group(self, pods: Sequence[Pod]) -> List[PodClass]:
+        if len(self._sig_memo) > (1 << 16):
+            # bound memo growth under signature churn: a clear only
+            # re-derives canonical keys once (ids are monotone, so a stale
+            # _sig_id can never alias -- see the _SIGS intern table)
+            self._sig_memo.clear()
+            self._sort_memo.clear()
+        first = not self._prev_counts
+        sig_memo = self._sig_memo
+        tok_to_class: Dict[int, PodClass] = {}
+        id_to_class: Dict[int, PodClass] = {}
+        groups: Dict[tuple, PodClass] = {}
+        tok_get = tok_to_class.get
+        id_get = id_to_class.get
+
+        def classify(pod: Pod) -> PodClass:
+            sid = pod._sig_id
+            if sid is None:
+                sid = pod._sig_id = _intern_sig(pod.grouping_signature())
+            pc = id_get(sid)
+            if pc is not None:
+                return pc
+            ent = sig_memo.get(sid)
+            if ent is None:
+                reqs = pod.scheduling_requirements()[0]
+                key = _class_key(pod, reqs)
+                requested = scale_vector(
+                    (pod.requests + _one_pod()).to_vector()
+                ).astype(np.float32)
+                flags = (
+                    bool(pod.affinity_terms),
+                    len(pod.node_affinity_terms) > 1,
+                    bool(pod.preferred_node_affinity_terms or pod.preferred_affinity_terms),
+                )
+                ent = sig_memo[sid] = (key, reqs, requested, flags)
+            key, reqs, requested, flags = ent
+            pc = groups.get(key)
+            if pc is None:
+                pc = groups[key] = PodClass(
+                    pods=[], requests=requested, requirements=reqs, key=key
+                )
+            if flags[0]:
+                pc.has_affinity = True
+            if flags[1]:
+                pc.multi_node_affinity = True
+            if flags[2]:
+                pc.has_preferences = True
+            id_to_class[sid] = pc
+            return pc
+
+        with gc_paused():
+            if _native_grouping is not None:
+                _native_grouping.group_by_token(pods, classify)
+            else:
+                for pod in pods:
+                    tok = pod._spec_token
+                    if tok is not None:
+                        pc = tok_get(tok)
+                        if pc is None:
+                            pc = tok_to_class[tok] = classify(pod)
+                    else:
+                        pc = classify(pod)
+                    pc.pods.append(pod)
+        sort_memo = self._sort_memo
+
+        def order_key(pc: PodClass) -> tuple:
+            k = sort_memo.get(pc.key)
+            if k is None:
+                k = sort_memo[pc.key] = pod_sort_key(pc.pods[0])
+            return k
+
+        out = list(groups.values())
+        out.sort(key=order_key)
+        prev = self._prev_counts
+        counts = {pc.key: len(pc.pods) for pc in out}
+        new = sum(1 for k in counts if k not in prev)
+        changed = sum(1 for k, n in counts.items() if k in prev and prev[k] != n)
+        removed = sum(1 for k in prev if k not in counts)
+        self._prev_counts = counts
+        n_classes = len(counts)
+        self.last_stats = {
+            "pods": len(pods),
+            "classes": n_classes,
+            "dirty_classes": new + changed,
+            "new_classes": new,
+            "removed_classes": removed,
+            # denominator = |prev UNION cur| (= cur + removed), so a full
+            # turnover reads 1.0, never above -- the histogram buckets and
+            # the span attr both promise a fraction
+            "dirty_fraction": (
+                1.0 if first
+                else (new + changed + removed) / max(1, n_classes + removed)
+            ),
+            "full_rebuild": first,
+        }
+        return out
+
+
 def with_extra_requirements(classes: Sequence[PodClass], extra: Requirements) -> List[PodClass]:
     """Re-base already-grouped classes onto a nodepool's requirements --
     the per-class equivalent of group_pods(pods, extra_requirements=...),
@@ -526,13 +667,44 @@ def _allowed_bits_for(reqs: Requirements, vocab: Vocab, dim: str, words: int) ->
     return out.astype(np.uint32)
 
 
+def _row_key(pc: PodClass, taints_sig: tuple) -> tuple:
+    """Cache key for one class's encoded tensor ROW (encode_classes
+    row_cache): the full canonical requirement content -- NOT a hash, so
+    two distinct requirement sets can never collide into one row -- plus
+    the representative's tolerations (schedulable depends on them), the
+    pool taints, and the FLOAT64-exact scaled request vector (the same
+    precision _class_key distinguishes classes at: the cached row carries
+    the exact base_req, so keying on the float32-rounded pc.requests
+    could alias two classes whose requests differ below a float32 ulp)."""
+    return (
+        tuple(sorted(
+            (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+            for r in pc.requirements
+        )),
+        tuple(
+            (t.key, t.operator, t.value, t.effect) for t in pc.pods[0].tolerations
+        ),
+        taints_sig,
+        scale_vector((pc.pods[0].requests + _one_pod()).to_vector()).tobytes(),
+    )
+
+
 def encode_classes(
     classes: Sequence[PodClass],
     catalog: CatalogTensors,
     pool_taints: Sequence[Taint] = (),
     c_pad: Optional[int] = None,
     node_overhead: Optional[np.ndarray] = None,
+    row_cache: Optional[Dict] = None,
 ) -> PodClassSet:
+    """classes -> dense solver tensors. `row_cache` (optional, scoped to
+    ONE catalog encoding -- the caller keys it per staged-catalog entry)
+    memoizes the per-class row products that are pure functions of
+    (requirements, tolerations, pool taints, requests): the packed allowed
+    bitmasks, numeric windows, zone/captype masks, schedulability, and the
+    float64 base request vector. On a warm steady-state tick only CHANGED
+    classes pay the row construction; counts and env_counts are always
+    written fresh (they change every tick and cost one store)."""
     c_real = len(classes)
     if c_pad is None:
         c_pad = max(8, ((c_real + 7) // 8) * 8)
@@ -545,29 +717,62 @@ def encode_classes(
     azone = np.zeros((c_pad, Z_PAD), dtype=bool)
     acap = np.zeros((c_pad, CT), dtype=bool)
     schedulable = np.zeros((c_pad,), dtype=bool)
+    base_req = np.zeros((c_pad, R), dtype=np.float64)
+    taints_sig = tuple((t.key, t.value, t.effect) for t in pool_taints)
+    n_zones = len(catalog.zones)
+    one = _one_pod()
     for c, pc in enumerate(classes):
         req[c] = pc.requests
         count[c] = len(pc.pods)
         env_count[c] = pc.env_count
         reqs = pc.requirements
-        for d, dim in enumerate(LABEL_DIMS):
-            allowed[d][c] = _allowed_bits_for(reqs, catalog.vocabs[d], dim, catalog.words[d])
-        for nd_i, dim in enumerate(NUMERIC_DIMS):
-            r = reqs.get(dim)
-            if r is not None:
-                if r.greater_than is not None:
-                    num_lo[c, nd_i] = r.greater_than
-                if r.less_than is not None:
-                    num_hi[c, nd_i] = r.less_than
-                # In-sets over numeric dims are handled via the bitset path
-                # when the dim is also a LABEL_DIM; pure-numeric In is rare
-        zreq = reqs.get(wk.ZONE_LABEL)
-        for z, zone in enumerate(catalog.zones):
-            azone[c, z] = zreq is None or zreq.matches(zone)
-        creq = reqs.get(wk.CAPACITY_TYPE_LABEL)
-        for name, idx in CAPTYPE_INDEX.items():
-            acap[c, idx] = creq is None or creq.matches(name)
-        schedulable[c] = tolerates_all(pc.pods[0].tolerations, pool_taints)
+        row = rkey = None
+        if row_cache is not None:
+            rkey = _row_key(pc, taints_sig)
+            row = row_cache.get(rkey)
+        if row is None:
+            arow = [
+                _allowed_bits_for(reqs, catalog.vocabs[d], dim, catalog.words[d])
+                for d, dim in enumerate(LABEL_DIMS)
+            ]
+            nlo = np.full((ND,), -np.inf, dtype=np.float32)
+            nhi = np.full((ND,), np.inf, dtype=np.float32)
+            for nd_i, dim in enumerate(NUMERIC_DIMS):
+                r = reqs.get(dim)
+                if r is not None:
+                    if r.greater_than is not None:
+                        nlo[nd_i] = r.greater_than
+                    if r.less_than is not None:
+                        nhi[nd_i] = r.less_than
+                    # In-sets over numeric dims are handled via the bitset
+                    # path when the dim is also a LABEL_DIM
+            zreq = reqs.get(wk.ZONE_LABEL)
+            az = np.array(
+                [zreq is None or zreq.matches(zone) for zone in catalog.zones],
+                dtype=bool,
+            )
+            creq = reqs.get(wk.CAPACITY_TYPE_LABEL)
+            ac = np.zeros((CT,), dtype=bool)
+            for name, idx in CAPTYPE_INDEX.items():
+                ac[idx] = creq is None or creq.matches(name)
+            sched = tolerates_all(pc.pods[0].tolerations, pool_taints)
+            brow = np.asarray(
+                (pc.pods[0].requests + one).to_vector(), dtype=np.float64
+            )
+            row = (arow, nlo, nhi, az, ac, sched, brow)
+            if row_cache is not None:
+                if len(row_cache) > 8192:
+                    row_cache.clear()  # bound growth across catalog lifetime
+                row_cache[rkey] = row
+        arow, nlo, nhi, az, ac, sched, brow = row
+        for d in range(D):
+            allowed[d][c] = arow[d]
+        num_lo[c] = nlo
+        num_hi[c] = nhi
+        azone[c, :n_zones] = az
+        acap[c] = ac
+        schedulable[c] = sched
+        base_req[c] = brow
     return PodClassSet(
         classes=list(classes), c_real=c_real, c_pad=c_pad, req=req, count=count,
         env_count=env_count, allowed=allowed, num_lo=num_lo, num_hi=num_hi,
@@ -576,6 +781,7 @@ def encode_classes(
             node_overhead.astype(np.float32)
             if node_overhead is not None else np.zeros((R,), dtype=np.float32)
         ),
+        base_req=base_req,
     )
 
 

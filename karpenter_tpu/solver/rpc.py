@@ -48,10 +48,26 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from karpenter_tpu import failpoints, tracing
+from karpenter_tpu import failpoints, metrics, tracing
 from karpenter_tpu.solver import encode, ffd
 
 TOKEN_ENV = "KARPENTER_TPU_SOLVER_TOKEN"
+# kill switch for delta class shipping (solve_delta): the client defaults
+# to delta-on whenever the server advertises the feature; "0" forces every
+# solve back to the full class-tensor ship
+DELTA_ENV = "KARPENTER_TPU_DELTA"
+
+# the per-class tensors delta shipping can patch row-wise. node_overhead
+# ([R], whole-set) always ships in full; open_allowed/join_allowed ([C, K]
+# merged-multipool masks) bypass the delta path entirely -- they dominate
+# the payload when present and the merged shape re-derives them per tick.
+PER_CLASS_TENSORS = (
+    "req", "count", "env_count", "allowed", "num_lo", "num_hi",
+    "azone", "acap", "schedulable",
+)
+# never ship a delta when more than this fraction of rows changed: the
+# row-index header plus per-row framing overtakes the dense ship
+DELTA_MAX_DIRTY_FRACTION = 0.5
 
 # connection ESTABLISHMENT budget (TCP/UNIX connect + TLS handshake +
 # auth), split from the solve/read budget: a dead sidecar must fail a
@@ -189,6 +205,14 @@ class SolverServer:
         handshake_timeout: float = 30.0,
     ):
         self._staged: Dict[str, _StagedEntry] = {}
+        # class-tensor epochs (solve_delta): epoch id -> {name: np array},
+        # the full class tensor set as of that epoch, patched row-wise by
+        # delta solves. Same bounded-LRU discipline as the catalog staging.
+        self._epochs: Dict[str, Dict[str, np.ndarray]] = {}
+        # eviction accounting (the LRUs used to evict silently): mirrored
+        # into karpenter_solver_staged_evictions_total and served by the
+        # "debug" op for the true sidecar topology
+        self._evictions = {"catalog": 0, "class_epoch": 0}
         self._lock = threading.Lock()
         # TLS-handshake budget (was a hardcoded 30s): a peer stalling the
         # handshake holds one daemon thread, never the accept loop, but the
@@ -315,13 +339,20 @@ class SolverServer:
                 # back -- e.g. taint-gated merged batches to the oracle
                 # (service._try_solve_merged) rather than silently packing
                 # without the join_allowed gate
-                _send_frame(sock, {"ok": True, "features": ["join_allowed", "trace_echo"]})
+                _send_frame(
+                    sock,
+                    {"ok": True, "features": ["join_allowed", "trace_echo", "solve_delta"]},
+                )
             elif op == "stage":
                 self._op_stage(sock, header, tensors)
             elif op == "solve":
                 self._op_solve(sock, header, tensors, wt)
             elif op == "solve_compact":
                 self._op_solve_compact(sock, header, tensors, wt)
+            elif op == "solve_delta":
+                self._op_solve_delta(sock, header, tensors, wt)
+            elif op == "debug":
+                self._op_debug(sock)
             else:
                 _send_frame(sock, {"ok": False, "error": f"unknown op {op!r}"})
         except Exception as e:  # noqa: BLE001 -- errors cross the wire
@@ -339,10 +370,95 @@ class SolverServer:
         )
         staged, offsets, words = ffd.stage_catalog(catalog)
         with self._lock:
-            if len(self._staged) >= 4:
+            if len(self._staged) >= 4 and seqnum not in self._staged:
                 self._staged.pop(next(iter(self._staged)))
+                self._evictions["catalog"] += 1
+                metrics.SOLVER_STAGED_EVICTIONS.inc(kind="catalog")
             self._staged[seqnum] = _StagedEntry(staged, offsets, words)
         _send_frame(sock, {"ok": True, "seqnum": seqnum})
+
+    def _op_debug(self, sock) -> None:
+        """Staging observability: what the LRUs hold and how often they
+        evicted (the /debug/solver endpoint surfaces this in-process; this
+        op serves the true sidecar topology where the server's counters
+        live in another process)."""
+        with self._lock:
+            doc = {
+                "ok": True,
+                "staged_seqnums": list(self._staged),
+                "class_epochs": list(self._epochs),
+                "evictions": dict(self._evictions),
+            }
+        _send_frame(sock, doc)
+
+    def _op_solve_delta(self, sock, header: dict, t: Dict[str, np.ndarray],
+                        wt: Optional[tracing.WireTrace] = None) -> None:
+        """Compact solve whose class tensors are staged server-side under a
+        class-EPOCH id, the per-tick analogue of the per-seqnum catalog
+        staging. base=None ships the full tensor set and establishes the
+        epoch; base=<epoch> ships only the dirty rows (header "rows") and
+        patches a copy of the base epoch. An unknown base is an
+        "unknown-epoch" error -- the client full-restages, mirroring the
+        unknown-seqnum contract -- so sync, pipelined, and breaker-open
+        paths all stay bit-identical to a full encode."""
+        # catalog gap first: a restarted sidecar lost BOTH stagings, and
+        # reporting the seqnum gap lets the client restage catalog + epoch
+        # in one ladder pass instead of two error roundtrips
+        with self._lock:
+            known = str(header["seqnum"]) in self._staged
+        if not known:
+            _send_frame(sock, {"ok": False, "error": "unknown-seqnum"})
+            return
+        full = self._resolve_epoch(sock, header, t)
+        if full is None:
+            return
+        self._op_solve_compact(sock, header, full, wt)
+
+    def _resolve_epoch(self, sock, header: dict, t: Dict[str, np.ndarray]):
+        """The full class tensor dict for this solve_delta request, staged
+        under header["epoch"], or None after sending the unknown-epoch
+        error. Patching happens on a private copy outside the lock; the
+        stored epoch dicts are never mutated in place (a concurrent solve
+        reading a base must see a consistent snapshot)."""
+        epoch = str(header["epoch"])
+        base = header.get("base")
+        ent = None
+        if base is not None:
+            with self._lock:
+                ent = self._epochs.get(str(base))
+                if ent is not None:
+                    # LRU touch, same discipline as the catalog staging
+                    self._epochs.pop(str(base))
+                    self._epochs[str(base)] = ent
+            if ent is None:
+                _send_frame(sock, {"ok": False, "error": "unknown-epoch"})
+                return None
+            full = {name: arr.copy() for name, arr in ent.items()}
+            rows = np.asarray([int(r) for r in header.get("rows", ())], dtype=np.int64)
+            for name, arr in t.items():
+                if name not in PER_CLASS_TENSORS:
+                    full[name] = np.array(arr)  # whole-set tensors replace
+                elif rows.size:
+                    full[name][rows] = arr
+        else:
+            # frombuffer tensors are read-only views over the frame; own
+            # writable copies so later deltas can patch them
+            full = {name: np.array(arr) for name, arr in t.items()}
+        with self._lock:
+            if base is not None:
+                # the patched base is superseded: each client chain diffs
+                # against its LAST acknowledged epoch, so the base can be
+                # referenced at most by a rare error-recovery resend (which
+                # the unknown-epoch ladder absorbs). Consuming it here
+                # keeps the LRU at one epoch per live chain and makes the
+                # eviction counter mean PRESSURE, not routine supersession.
+                self._epochs.pop(str(base), None)
+            self._epochs[epoch] = full
+            while len(self._epochs) > 4:
+                self._epochs.pop(next(iter(self._epochs)))
+                self._evictions["class_epoch"] += 1
+                metrics.SOLVER_STAGED_EVICTIONS.inc(kind="class_epoch")
+        return full
 
     def _staged_inputs(self, sock, header: dict, t: Dict[str, np.ndarray]):
         """(entry, SolveInputs) for the staged catalog named by the header's
@@ -457,14 +573,26 @@ class StaleSeqnumError(RuntimeError):
     falls back to the synchronous op, which restages and retries."""
 
 
+class StaleEpochError(StaleSeqnumError):
+    """The class-epoch analogue of StaleSeqnumError: the sidecar no longer
+    knows the base epoch a pipelined DELTA solve patched against (restart,
+    or LRU eviction of the epoch). Subclasses StaleSeqnumError so every
+    existing ladder that handles a mid-flight staging gap handles this one
+    identically: the synchronous retry full-restages the class tensors
+    (the client dropped its base on this error)."""
+
+
 class _PendingReply:
     """One in-flight request's reply slot. `outcome` is filled by the FIFO
-    drain: ("ok", header, tensors) or ("err", exception)."""
+    drain: ("ok", header, tensors) or ("err", exception). `seqnum` names
+    the staged catalog the request referenced -- the claim side drops the
+    matching delta base on staging-gap errors."""
 
-    __slots__ = ("outcome",)
+    __slots__ = ("outcome", "seqnum")
 
-    def __init__(self):
+    def __init__(self, seqnum: str = ""):
         self.outcome = None
+        self.seqnum = seqnum
 
 
 class SolverClient:
@@ -478,6 +606,7 @@ class SolverClient:
         token: Optional[str] = None, ssl_context=None,
         server_hostname: Optional[str] = None,
         connect_timeout: float = DEFAULT_CONNECT_TIMEOUT,
+        delta: Optional[bool] = None,
     ):
         self.addr = (host, port) if path is None else None
         self.path = path
@@ -493,6 +622,26 @@ class SolverClient:
         self._sock: Optional[socket.socket] = None
         self._staged_seqnums: set = set()
         self._features: Optional[frozenset] = None  # per-connection, lazy
+        # delta class shipping (the incremental-tick wire layer): when the
+        # server advertises solve_delta, compact solves stage the class
+        # tensors under a class-epoch id and subsequent solves ship only
+        # the dirty rows. Default on; delta=False or $KARPENTER_TPU_DELTA=0
+        # forces the full ship (the two are bit-identical by construction
+        # -- the server reassembles the same tensors either way).
+        if delta is None:
+            delta = os.environ.get(DELTA_ENV, "1") != "0"
+        self.delta = bool(delta)
+        # seqnum -> (epoch id, {name: array copy}): the last class tensor
+        # state the server is known to hold for that catalog. Bounded LRU;
+        # dropped eagerly on close() and on any staging-gap error.
+        self._epoch_bases: Dict[str, tuple] = {}
+        import uuid as _uuid
+
+        self._epoch_prefix = _uuid.uuid4().hex[:12]
+        self._epoch_counter = 0
+        # shipping observability for the LAST solve dispatched (read by
+        # the solver's metrics/span wiring and the bench's delta stage)
+        self.last_delta = {"mode": "bypass", "rows": -1, "payload_bytes": 0, "full_bytes": 0}
         # one reentrant lock serializes the socket AND the staging set: the
         # protocol is strictly request/response on one connection, so a
         # whole roundtrip (and the stage-then-solve sequence inside
@@ -561,6 +710,10 @@ class SolverClient:
             # needs (the breaker's promotion hook relies on this to gate
             # re-promotion on a catalog re-stage)
             self._staged_seqnums.clear()
+            # delta bases die with the connection for the same reason: the
+            # replacement sidecar holds no epochs, and a stale base would
+            # cost one unknown-epoch roundtrip per seqnum before recovering
+            self._epoch_bases.clear()
 
     # -- request pipelining (the async solve path) ---------------------------
     def _drain_pending(self, target: Optional[_PendingReply] = None) -> None:
@@ -623,9 +776,13 @@ class SolverClient:
                 # clear first or the stage reply would interleave
                 self._drain_pending()
                 self.stage_catalog(seqnum, catalog)
+            # delta class shipping: may rewrite the header into a
+            # solve_delta op and return only the dirty rows (feature-gated;
+            # full ship otherwise -- the server reassembles identically)
+            tensors = self._delta_request(seqnum, class_set, header)
             sock = self._conn()
             try:
-                _send_frame(sock, header, self._class_tensors(class_set))
+                _send_frame(sock, header, tensors)
             except (ConnectionError, OSError):
                 # a PARTIAL frame may be on the wire: the stream is
                 # desynchronized, and a later synchronous fallback would
@@ -633,7 +790,7 @@ class SolverClient:
                 # so that fallback reconnects onto a clean stream
                 self.close()
                 raise
-            handle = _PendingReply()
+            handle = _PendingReply(seqnum)
             self._pending.append(handle)
             return handle
 
@@ -652,7 +809,15 @@ class SolverClient:
         header, out = rest
         if not header.get("ok"):
             err = str(header.get("error", ""))
+            if err == "unknown-epoch":
+                # the sidecar lost the base epoch mid-flight: drop the
+                # client base so the synchronous retry ships full, and
+                # surface the gap on the StaleSeqnumError contract
+                self._drop_epoch(handle.seqnum)
+                metrics.DELTA_EPOCH_RESTAGES.inc()
+                raise StaleEpochError(err)
             if err == "unknown-seqnum":
+                self._drop_epoch(handle.seqnum)
                 raise StaleSeqnumError(err)
             raise RuntimeError(f"solve failed: {err}")
         # graft the echoed server-side stage spans under the span covering
@@ -733,23 +898,170 @@ class SolverClient:
             if getattr(class_set, "join_allowed", None) is not None else []
         )
 
+    # -- delta class shipping (the incremental-tick wire layer) ---------------
+    def _next_epoch(self) -> str:
+        self._epoch_counter += 1
+        return f"{self._epoch_prefix}-{self._epoch_counter}"
+
+    def _drop_epoch(self, seqnum: str) -> None:
+        with self._lock:
+            self._epoch_bases.pop(seqnum, None)
+
+    def _store_base(self, seqnum: str, epoch: str, named: Dict[str, np.ndarray]) -> None:
+        """Record the class tensor state the server now holds for this
+        seqnum (one copy per tensor: the caller's arrays belong to a live
+        PodClassSet). Caller holds the lock."""
+        self._epoch_bases.pop(seqnum, None)  # LRU refresh
+        self._epoch_bases[seqnum] = (
+            epoch, {n: np.array(a) for n, a in named.items()}
+        )
+        while len(self._epoch_bases) > 4:
+            self._epoch_bases.pop(next(iter(self._epoch_bases)))
+
+    def _patch_base(self, seqnum: str, epoch: str, b: Dict[str, np.ndarray],
+                    rows: np.ndarray, named: Dict[str, np.ndarray]) -> None:
+        """Advance a delta chain's stored base IN PLACE: O(dirty rows)
+        host work per tick, like everything else in the engine -- a full
+        re-copy here would spend memory bandwidth on exactly the bytes
+        the delta ship avoids. Caller holds the lock; `b` is this
+        client's private copy (never aliased into a frame)."""
+        if rows.size:
+            for name in PER_CLASS_TENSORS:
+                b[name][rows] = named[name][rows]
+        b["node_overhead"] = np.array(named["node_overhead"])
+        self._epoch_bases.pop(seqnum, None)  # LRU refresh
+        self._epoch_bases[seqnum] = (epoch, b)
+
+    def _bypass_delta(self, full_bytes: int):
+        self.last_delta = {
+            "mode": "bypass", "rows": -1,
+            "payload_bytes": full_bytes, "full_bytes": full_bytes,
+        }
+        metrics.DELTA_SOLVES.inc(mode="bypass")
+        metrics.DELTA_PAYLOAD_BYTES.observe(full_bytes, mode="bypass")
+
+    def _delta_request(self, seqnum: str, class_set: encode.PodClassSet, header: dict):
+        """The tensors to ship for one compact solve, rewriting `header`
+        into a solve_delta op when the delta path applies. Three modes
+        (last_delta["mode"], mirrored into karpenter_scheduler_delta_*):
+
+        - "delta": a base epoch for this seqnum exists with matching
+          shapes and few rows changed -- ship only the dirty rows plus
+          the epoch being patched;
+        - "full": ship everything, establishing a new epoch server-side
+          (the steady state's first tick, a shape change, or a high-churn
+          tick past DELTA_MAX_DIRTY_FRACTION);
+        - "bypass": delta not applicable (disabled, dense op, server
+          without the feature, or merged-multipool masks present).
+
+        The server reassembles the identical tensor set in every mode, so
+        the decision is bit-identical by construction (tests/test_delta.py
+        asserts it differentially). Caller holds the lock."""
+        tensors = self._class_tensors(class_set)
+        full_bytes = int(sum(a.nbytes for _, a in tensors))
+        if not self.delta or header.get("op") != "solve_compact":
+            self._bypass_delta(full_bytes)
+            return tensors
+        named = dict(tensors)
+        if "open_allowed" in named or "join_allowed" in named:
+            # merged multi-pool: the [C, K] masks dominate the payload and
+            # are re-derived per tick -- the delta path stands down
+            self._bypass_delta(full_bytes)
+            return tensors
+        try:
+            if "solve_delta" not in self.features():
+                self._bypass_delta(full_bytes)
+                return tensors
+        except (ConnectionError, OSError):
+            # let the solve's own send surface the connection state
+            self._bypass_delta(full_bytes)
+            return tensors
+        epoch = self._next_epoch()
+        base = self._epoch_bases.get(seqnum)
+        if base is not None:
+            b = base[1]
+            if set(b) == set(named) and all(
+                b[n].shape == named[n].shape and b[n].dtype == named[n].dtype
+                for n in named
+            ):
+                changed = np.zeros((named["req"].shape[0],), dtype=bool)
+                for name in PER_CLASS_TENSORS:
+                    diff = named[name] != b[name]
+                    if diff.ndim > 1:
+                        diff = diff.any(axis=tuple(range(1, diff.ndim)))
+                    changed |= diff
+                rows = np.nonzero(changed)[0]
+                if rows.size <= int(changed.size * DELTA_MAX_DIRTY_FRACTION):
+                    header["op"] = "solve_delta"
+                    header["epoch"] = epoch
+                    header["base"] = base[0]
+                    header["rows"] = [int(r) for r in rows]
+                    out = [
+                        (name, np.ascontiguousarray(named[name][rows]))
+                        for name in PER_CLASS_TENSORS
+                    ]
+                    # whole-set tensors always ship (tiny [R] vector)
+                    out.append(("node_overhead", named["node_overhead"]))
+                    self._patch_base(seqnum, epoch, b, rows, named)
+                    payload = int(sum(a.nbytes for _, a in out))
+                    self.last_delta = {
+                        "mode": "delta", "rows": int(rows.size),
+                        "payload_bytes": payload, "full_bytes": full_bytes,
+                    }
+                    metrics.DELTA_SOLVES.inc(mode="delta")
+                    metrics.DELTA_ROWS_SHIPPED.inc(int(rows.size))
+                    metrics.DELTA_PAYLOAD_BYTES.observe(payload, mode="delta")
+                    return out
+        # full ship, establishing the epoch the next tick patches
+        header["op"] = "solve_delta"
+        header["epoch"] = epoch
+        header["base"] = None
+        self._store_base(seqnum, epoch, named)
+        self.last_delta = {
+            "mode": "full", "rows": int(class_set.c_pad),
+            "payload_bytes": full_bytes, "full_bytes": full_bytes,
+        }
+        metrics.DELTA_SOLVES.inc(mode="full")
+        metrics.DELTA_PAYLOAD_BYTES.observe(full_bytes, mode="full")
+        return tensors
+
+    def debug_info(self) -> dict:
+        """The server's staging debug document (the "debug" op: staged
+        seqnums, class epochs, LRU eviction counts) -- the sidecar-topology
+        source for /debug/solver."""
+        header, _ = self._roundtrip({"op": "debug"})
+        return header
+
     def _solve_op(self, op_header: dict, seqnum: str, catalog, class_set):
-        """Shared stage-if-needed + solve + unknown-seqnum retry."""
+        """Shared stage-if-needed + solve + staging-gap retry ladder:
+        unknown-epoch drops the delta base and re-ships full; unknown-
+        seqnum re-stages the catalog and retries (the full reship also
+        re-establishes the class epoch). Each rung fires at most once."""
         ctx = tracing.TRACER.inject()
         if ctx is not None:
             op_header = dict(op_header, trace=ctx)
         with self._lock:  # atomic stage-then-solve (reentrant)
             if seqnum not in self._staged_seqnums:
                 self.stage_catalog(seqnum, catalog)
-            tensors = self._class_tensors(class_set)
-            resp, out = self._roundtrip(op_header, tensors)
+            header = dict(op_header)
+            tensors = self._delta_request(seqnum, class_set, header)
+            resp, out = self._roundtrip(header, tensors)
+            if not resp.get("ok") and resp.get("error") == "unknown-epoch":
+                self._drop_epoch(seqnum)
+                metrics.DELTA_EPOCH_RESTAGES.inc()
+                header = dict(op_header)
+                tensors = self._delta_request(seqnum, class_set, header)
+                resp, out = self._roundtrip(header, tensors)
+            if not resp.get("ok") and resp.get("error") == "unknown-seqnum":
+                # server restarted / evicted: re-stage once and retry with
+                # a full class ship (the old epoch died with the staging)
+                self._drop_epoch(seqnum)
+                self.stage_catalog(seqnum, catalog)
+                header = dict(op_header)
+                tensors = self._delta_request(seqnum, class_set, header)
+                resp, out = self._roundtrip(header, tensors)
             if not resp.get("ok"):
-                if resp.get("error") == "unknown-seqnum":
-                    # server restarted / evicted: re-stage once and retry
-                    self.stage_catalog(seqnum, catalog)
-                    resp, out = self._roundtrip(op_header, tensors)
-                if not resp.get("ok"):
-                    raise RuntimeError(f"solve failed: {resp.get('error')}")
+                raise RuntimeError(f"solve failed: {resp.get('error')}")
             tracing.TRACER.graft(resp)
             return out
 

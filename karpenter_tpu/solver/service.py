@@ -68,6 +68,12 @@ class _CatalogEntry(NamedTuple):
     col_pools: Optional[np.ndarray] = None
     pools: Optional[tuple] = None
     decode_types: Optional[np.ndarray] = None
+    # per-class encoded-row memo scoped to THIS catalog encoding
+    # (encode.encode_classes row_cache): rows are pure functions of
+    # (requirements, tolerations, pool taints, requests) against one
+    # catalog's vocabularies, so a warm steady-state tick re-encodes only
+    # the classes that changed
+    row_cache: Optional[dict] = None
 
 
 class _MergedVirtualPool(NodePool):
@@ -118,6 +124,7 @@ class TPUSolver:
     def __init__(
         self, g_max: int = 1024, c_pad_min: int = 16, client=None,
         objective: str = "price", auto_warm: bool = False, breaker=None,
+        incremental: bool = True,
     ):
         # auto_warm: precompile every class-count bucket in a background
         # thread whenever a new catalog is staged (see warm()); opt-in so
@@ -177,6 +184,14 @@ class TPUSolver:
         self._seq_prefix = uuid.uuid4().hex[:12]
         self._seq_counter = 0
         self._warmed_pads: set = set()
+        # incremental tick engine (the delta-solve tentpole): the cross-
+        # tick grouping cache (encode.IncrementalGrouper -- drop-in
+        # equivalent to group_pods with per-signature canonical work
+        # memoized across ticks). Owned by the scheduling tick; disable
+        # with incremental=False for a per-call-pure solver.
+        self.incremental = incremental
+        self._grouper = encode.IncrementalGrouper()
+        self.last_group_stats = dict(self._grouper.last_stats)
         # routing observability for the last schedule() batch
         self.last_route = {"device_pods": 0, "oracle_pods": 0, "path": "none"}
         # merged multi-pool catalog lists, keyed by (per-pool catalog ids,
@@ -221,6 +236,7 @@ class TPUSolver:
                 seqnum=f"{self._seq_prefix}-{self._seq_counter}",
                 types_by_price=np.array(list(instance_types), dtype=object)[order],
                 order=order, catalog_list=instance_types,
+                row_cache={},
             )
             self._catalog_cache[key] = entry
             while len(self._catalog_cache) > self._catalog_cache_cap:
@@ -707,12 +723,84 @@ class TPUSolver:
                 seeds[sel_key] = dict(counts)
         return seeds
 
+    # -- incremental tick engine --------------------------------------------
+    def _group(self, pods: Sequence[Pod]) -> List:
+        """The tick's grouping pass: the cross-tick dirty-tracking cache
+        when incremental mode is on (classification cost scales with
+        churn), a fresh group_pods otherwise. Either way the output is
+        identical -- tests/test_delta.py asserts it differentially."""
+        if not self.incremental:
+            return encode.group_pods(pods)
+        classes = self._grouper.group(pods)
+        st = self._grouper.last_stats
+        self.last_group_stats = st
+        if not st.get("full_rebuild"):
+            metrics.DELTA_DIRTY_FRACTION.observe(st["dirty_fraction"])
+        tracing.annotate(
+            group_classes=st["classes"],
+            group_dirty=st["dirty_classes"],
+            group_dirty_fraction=round(st["dirty_fraction"], 4),
+        )
+        return classes
+
+    def freeze_caches(self) -> None:
+        """Move the warmed long-lived caches (staged catalogs, encode row
+        caches, grouping memos, jit residency) into the GC's permanent
+        generation: after warmup these survive the process, and keeping
+        them out of every later collection's walk is what holds the warm
+        steady-state tail down (the r05 warm p99 spikes were gen2 walks
+        over exactly this graph). Call once after warmup -- freezing is
+        additive and cheap, so repeated calls are safe."""
+        import gc
+
+        gc.collect()
+        gc.freeze()
+
+    def describe_wire(self) -> dict:
+        """Delta/staging state document for /debug/solver: the grouping
+        churn stats, the last solve's shipping mode, the client's staged
+        seqnums and epoch bases, and (best-effort) the sidecar's own
+        staging/eviction counters via the debug op."""
+        doc = {
+            "incremental": self.incremental,
+            "group_stats": dict(self.last_group_stats),
+            "wire": self.client is not None,
+        }
+        c = self.client
+        if c is None:
+            return doc
+        doc["delta_enabled"] = c.delta
+        doc["last_delta"] = dict(c.last_delta)
+        with c._lock:
+            doc["staged_seqnums"] = sorted(c._staged_seqnums)
+            doc["epoch_bases"] = {sn: e for sn, (e, _) in c._epoch_bases.items()}
+            pending = len(c._pending)
+        doc["replies_in_flight"] = pending
+        # the server debug op is a synchronous roundtrip UNDER THE CLIENT
+        # LOCK: with a pipelined reply in flight it would block behind the
+        # device solve and stall the production tick for a debug scrape --
+        # skip it then (best-effort; the in-flight check is advisory, but
+        # a begin racing past it only costs one scrape a wire RTT, never
+        # correctness)
+        if self.wire_healthy() and pending == 0:
+            try:
+                server = c.debug_info()
+                doc["server"] = {
+                    k: server[k]
+                    for k in ("staged_seqnums", "class_epochs", "evictions")
+                    if k in server
+                }
+            except Exception:  # noqa: BLE001 -- debug output must never fail a probe
+                pass
+        return doc
+
     # -- entry point (Provisioner contract) ---------------------------------
     def schedule(self, scheduler: Scheduler, pods: Sequence[Pod]) -> SchedulingResult:
         # ONE grouping pass serves routing (supports, _pools_overlap) and
         # the first pool's solve; per-pool requirement merges are ~60 cheap
-        # class-level copies (encode.with_extra_requirements)
-        base_classes = encode.group_pods(pods)
+        # class-level copies (encode.with_extra_requirements). In
+        # incremental mode the pass is the cross-tick dirty-tracking cache.
+        base_classes = self._group(pods)
         pools = scheduler.nodepools
         # routing observability: how many pods of the last batch ran on
         # which path (the carve fuzz asserts the device fraction; the
@@ -862,7 +950,7 @@ class TPUSolver:
         this call via schedule() -- those paths either run on the oracle
         (nothing in flight to overlap) or need sequenced multi-phase state
         hand-offs that a deferred barrier would split."""
-        base_classes = encode.group_pods(pods)
+        base_classes = self._group(pods)
         pools = scheduler.nodepools
         overlap = len(pools) > 1 and self._pools_overlap(pools, pods, classes=base_classes)
         items = scheduler.instance_types.get(pools[0].name, []) if pools else []
@@ -1237,6 +1325,7 @@ class TPUSolver:
                 pre_set = encode.encode_classes(
                     classes, catalog0, pool_taints=list(pool.template.taints),
                     c_pad=_bucket(len(classes), self.c_pad_min),
+                    row_cache=entry0.row_cache,
                 )
                 compat = encode.compat_matrix(catalog0, pre_set)[: len(classes)]
                 if entry0.col_pools is not None:
@@ -1307,6 +1396,7 @@ class TPUSolver:
                 pool_taints=list(pool.template.taints),
                 c_pad=_bucket(len(classes), self.c_pad_min),
                 node_overhead=overhead_vec,
+                row_cache=entry.row_cache,
             )
             enc_sp.set(c_pad=class_set.c_pad)
         if entry.col_pools is not None:
@@ -1410,6 +1500,11 @@ class TPUSolver:
                     pending.rpc_handle = self.client.begin_solve_compact(
                         seqnum, catalog, class_set, g_max=self.g_max,
                         objective=self.objective,
+                    )
+                    ld = self.client.last_delta
+                    wd_sp.set(
+                        delta_mode=ld["mode"], delta_rows=ld["rows"],
+                        delta_bytes=ld["payload_bytes"], full_bytes=ld["full_bytes"],
                     )
                 except (ConnectionError, OSError, RuntimeError) as e:
                     # RuntimeError covers an ERRORING sidecar at dispatch
@@ -1565,6 +1660,14 @@ class TPUSolver:
         if pending.rpc_handle is not None:
             try:
                 dec = self.client.finish_solve_compact(pending.rpc_handle)
+            except rpc_mod.StaleEpochError:
+                # sidecar lost the class epoch a DELTA solve patched
+                # against (restart / LRU eviction): the client has dropped
+                # its base, so the synchronous op below re-ships the full
+                # class tensors and re-establishes the epoch
+                metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="stale-epoch")
+                tracing.annotate(fallback="stale-epoch")
+                dec = None
             except rpc_mod.StaleSeqnumError:
                 # sidecar restarted / evicted the catalog while the frame
                 # was in flight: the async path rejects rather than
@@ -1702,12 +1805,20 @@ class TPUSolver:
         # the dominant decode cost). The class vectors are EXACT float64
         # base units straight from the pod requests, not the float32 scaled
         # tensors, so NewNodeGroup.requested stays bit-equal to the
-        # oracle's Resources arithmetic.
+        # oracle's Resources arithmetic. base_req comes pre-built (and
+        # row-cached) from encode_classes; the per-class Python loop is the
+        # fallback for hand-assembled PodClassSets only.
         if n_open:
-            class_base = np.zeros((take_t.shape[1], encode.R), dtype=np.float64)
-            one_pod = Resources.from_base_units({res.PODS: 1})
-            for c, pc in enumerate(class_set.classes):
-                class_base[c] = (pc.pods[0].requests + one_pod).to_vector()
+            class_base = (
+                class_set.base_req[: take_t.shape[1]].astype(np.float64)
+                if getattr(class_set, "base_req", None) is not None
+                else None
+            )
+            if class_base is None:
+                class_base = np.zeros((take_t.shape[1], encode.R), dtype=np.float64)
+                one_pod = Resources.from_base_units({res.PODS: 1})
+                for c, pc in enumerate(class_set.classes):
+                    class_base[c] = (pc.pods[0].requests + one_pod).to_vector()
             group_req_vecs = take_t.astype(np.float64) @ class_base
         else:
             group_req_vecs = np.zeros((0, encode.R))
@@ -1822,11 +1933,14 @@ class TPUSolver:
                         requested=requested,
                     )
                 )
-            for c in range(class_set.c_real):
+            # unplaced pass: scan only the classes with leftovers (one
+            # nonzero over the dense vector), not every class -- decode
+            # cost scales with what the solve could not place
+            take_sums = take[: class_set.c_real].sum(axis=1)
+            for c in np.nonzero(unplaced[: class_set.c_real] > 0)[0]:
                 n_un = int(unplaced[c])
-                if n_un > 0:
-                    pc = class_set.classes[c]
-                    placed = int(class_offset[c]) + int(take[c].sum())
-                    for p in pc.pods[placed : placed + n_un]:
-                        result.unschedulable[p.metadata.name] = "no instance type fits pod requirements"
+                pc = class_set.classes[c]
+                placed = int(class_offset[c]) + int(take_sums[c])
+                for p in pc.pods[placed : placed + n_un]:
+                    result.unschedulable[p.metadata.name] = "no instance type fits pod requirements"
             return result

@@ -1,0 +1,377 @@
+"""Differential suite for the incremental delta-solve engine.
+
+The tentpole contract: every layer of the incremental tick — the
+cross-tick grouping cache (encode.IncrementalGrouper), the per-class
+encode row cache (encode_classes row_cache), and the delta class
+shipping over the wire (solver/rpc.py solve_delta) — must be
+BYTE-IDENTICAL to the full re-encode path. Property-style seeded churn
+sequences drive grouping/encode/wire differentials; the committed sim
+corpus replays through the delta backend against the golden digests.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import metrics
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass, labels as wk
+from karpenter_tpu.scheduling import Resources, Taint, Toleration
+from karpenter_tpu.solver import encode
+from karpenter_tpu.solver.rpc import SolverClient, SolverServer, StaleEpochError
+from karpenter_tpu.solver.service import TPUSolver
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden", "scenarios")
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = SolverServer(insecure_tcp=True).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    c = SolverClient(server.address[0], server.address[1], delta=True)
+    yield c
+    c.close()
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in cloud.describe_zones()},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return prov.list(nc)
+
+
+def churn_pods(rng: np.random.Generator, tick: int, n: int = 60):
+    """One tick's pending set from a small template universe: the same
+    structural classes recur across ticks while names and counts churn."""
+    shapes = [
+        ("250m", "512Mi", None, ()),
+        ("500m", "1Gi", None, ()),
+        ("1", "2Gi", {wk.CAPACITY_TYPE_LABEL: wk.CAPACITY_TYPE_ON_DEMAND}, ()),
+        ("2", "4Gi", {wk.ARCH_LABEL: "arm64"}, ()),
+        ("500m", "2Gi", None, (Toleration(key="dedicated", operator="Exists"),)),
+    ]
+    pods = []
+    for i in range(n):
+        t = int(rng.integers(0, len(shapes)))
+        cpu, mem, sel, tol = shapes[t]
+        pods.append(Pod(
+            f"churn-{tick}-{i}",
+            requests=Resources({"cpu": cpu, "memory": mem}),
+            node_selector=dict(sel) if sel else {},
+            tolerations=list(tol),
+        ))
+    return pods
+
+
+def decision_sig(res):
+    return (
+        sorted(
+            (tuple(sorted(p.metadata.name for p in g.pods)), g.instance_types[0].name)
+            for g in res.new_groups
+        ),
+        sorted(res.existing_assignments.items()),
+        sorted(res.unschedulable.items()),
+    )
+
+
+def classes_sig(classes):
+    """Everything downstream reads from a grouping result."""
+    return [
+        (
+            pc.key,
+            [p.metadata.name for p in pc.pods],
+            pc.requests.tobytes(),
+            pc.requirements.stable_hash(),
+            pc.has_affinity, pc.multi_node_affinity, pc.has_preferences,
+            pc.env_count,
+        )
+        for pc in classes
+    ]
+
+
+class TestIncrementalGrouper:
+    def test_matches_group_pods_over_seeded_churn(self):
+        rng = np.random.default_rng(7)
+        grouper = encode.IncrementalGrouper()
+        for tick in range(8):
+            n = int(rng.integers(20, 90))
+            pods = churn_pods(rng, tick, n)
+            assert classes_sig(grouper.group(pods)) == classes_sig(encode.group_pods(pods))
+
+    def test_stats_track_churn(self):
+        grouper = encode.IncrementalGrouper()
+        pods = churn_pods(np.random.default_rng(1), 0, 40)
+        grouper.group(pods)
+        assert grouper.last_stats["full_rebuild"] is True
+        # identical structural tick (fresh names, same mix): counts equal
+        grouper.group(churn_pods(np.random.default_rng(1), 1, 40))
+        st = grouper.last_stats
+        assert st["full_rebuild"] is False
+        assert st["dirty_classes"] == 0 and st["dirty_fraction"] == 0.0
+        # shifted mix: some class counts change
+        grouper.group(churn_pods(np.random.default_rng(2), 2, 47))
+        assert grouper.last_stats["dirty_fraction"] > 0.0
+
+    def test_routing_flags_follow_live_pods(self):
+        from karpenter_tpu.apis.pod import PodAffinityTerm
+
+        grouper = encode.IncrementalGrouper()
+        plain = [Pod("p0", requests=Resources({"cpu": "1", "memory": "1Gi"}))]
+        aff = [Pod(
+            "a0", requests=Resources({"cpu": "1", "memory": "1Gi"}),
+            labels={"tier": "x"},
+            affinity_terms=[PodAffinityTerm(
+                label_selector={"tier": "x"}, topology_key=wk.HOSTNAME_LABEL)],
+        )]
+        out = grouper.group(plain + aff)
+        assert [pc.has_affinity for pc in out] == [
+            pc.has_affinity for pc in encode.group_pods(plain + aff)
+        ]
+        # the affinity pod leaves: no stale suffix class survives
+        out = grouper.group(plain)
+        assert len(out) == 1 and not out[0].has_affinity
+
+    def test_fresh_podclass_objects_per_call(self):
+        """Pipelined tickets own their class lists: a later group() call
+        must never mutate a previously returned class."""
+        grouper = encode.IncrementalGrouper()
+        first = grouper.group(churn_pods(np.random.default_rng(3), 0, 30))
+        names = [[p.metadata.name for p in pc.pods] for pc in first]
+        grouper.group(churn_pods(np.random.default_rng(4), 1, 50))
+        assert names == [[p.metadata.name for p in pc.pods] for pc in first]
+
+
+class TestEncodeRowCache:
+    def _encode_pair(self, classes, catalog, cache, taints=()):
+        with_cache = encode.encode_classes(
+            classes, catalog, pool_taints=taints, row_cache=cache)
+        without = encode.encode_classes(classes, catalog, pool_taints=taints)
+        return with_cache, without
+
+    def test_cached_rows_byte_identical_over_churn(self, catalog_items):
+        catalog = encode.encode_catalog(catalog_items)
+        cache = {}
+        rng = np.random.default_rng(11)
+        taints = (Taint("dedicated", "NoSchedule", "x"),)
+        for tick in range(5):
+            classes = encode.group_pods(churn_pods(rng, tick, int(rng.integers(20, 70))))
+            a, b = self._encode_pair(classes, catalog, cache, taints=taints)
+            for name in ("req", "count", "env_count", "num_lo", "num_hi",
+                         "azone", "acap", "schedulable", "base_req"):
+                assert np.array_equal(getattr(a, name), getattr(b, name)), name
+            for d in range(len(a.allowed)):
+                assert np.array_equal(a.allowed[d], b.allowed[d])
+        assert len(cache) > 0  # the cache actually engaged
+
+    def test_distinct_requirements_never_share_a_row(self, catalog_items):
+        catalog = encode.encode_catalog(catalog_items)
+        cache = {}
+        a = Pod("a", requests=Resources({"cpu": "1", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "arm64"})
+        b = Pod("b", requests=Resources({"cpu": "1", "memory": "1Gi"}),
+                node_selector={wk.ARCH_LABEL: "amd64"})
+        classes = encode.group_pods([a, b])
+        with_cache, without = self._encode_pair(classes, catalog, cache)
+        for d in range(len(with_cache.allowed)):
+            assert np.array_equal(with_cache.allowed[d], without.allowed[d])
+        compat = encode.compat_matrix(catalog, with_cache)
+        assert not np.array_equal(compat[0], compat[1])
+
+
+class TestDeltaWire:
+    def test_full_then_delta_then_identical_decisions(self, client, catalog_items):
+        pool = NodePool("default")
+        sd = TPUSolver(g_max=64, client=client, incremental=True)
+        host = TPUSolver(g_max=64, incremental=False)
+        rng = np.random.default_rng(5)
+        pods = churn_pods(rng, 0, 50)
+        assert decision_sig(sd.solve(pool, catalog_items, list(pods))) == decision_sig(
+            host.solve(pool, catalog_items, list(pods)))
+        assert client.last_delta["mode"] == "full"
+        # small churn: a delta ship with few dirty rows, >=5x fewer bytes
+        pods2 = pods[:-4] + churn_pods(rng, 1, 4)
+        assert decision_sig(sd.solve(pool, catalog_items, list(pods2))) == decision_sig(
+            host.solve(pool, catalog_items, list(pods2)))
+        ld = client.last_delta
+        assert ld["mode"] == "delta"
+        assert 0 <= ld["rows"] <= 8
+        assert ld["payload_bytes"] < ld["full_bytes"]
+
+    def test_payload_reduction_at_realistic_class_count(self, client, catalog_items):
+        """The >=5x wire-bytes claim needs a realistic class count (the
+        tiny suites above pad to c_pad=8 where per-row framing dominates):
+        ~60 distinct classes, one dirty."""
+        pool = NodePool("default")
+        sd = TPUSolver(g_max=64, client=client)
+
+        def wave(tick, extra):
+            pods = [
+                Pod(f"w-{tick}-{i}",
+                    requests=Resources({"cpu": f"{100 + 10 * (i % 60)}m", "memory": "512Mi"}))
+                for i in range(120)
+            ]
+            pods += [
+                Pod(f"surge-{tick}-{i}",
+                    requests=Resources({"cpu": "3", "memory": "6Gi"}))
+                for i in range(extra)
+            ]
+            return pods
+
+        sd.solve(pool, catalog_items, wave(0, 2))
+        sd.solve(pool, catalog_items, wave(1, 5))
+        ld = client.last_delta
+        assert ld["mode"] == "delta"
+        assert ld["payload_bytes"] * 5 <= ld["full_bytes"]
+
+    def test_seeded_churn_differential(self, client, catalog_items):
+        """Property-style: seeded churn sequences through the delta wire
+        vs the in-process host backend -- bit-identical every tick."""
+        pool = NodePool("default")
+        sd = TPUSolver(g_max=64, client=client, incremental=True)
+        host = TPUSolver(g_max=64, incremental=False)
+        for seed in (21, 22):
+            rng = np.random.default_rng(seed)
+            for tick in range(5):
+                pods = churn_pods(rng, tick, int(rng.integers(25, 80)))
+                remote = sd.solve(pool, catalog_items, list(pods))
+                local = host.solve(pool, catalog_items, list(pods))
+                assert decision_sig(remote) == decision_sig(local), (seed, tick)
+        assert metrics.DELTA_SOLVES.value(mode="delta") > 0
+
+    def test_delta_disabled_client_ships_full(self, server, catalog_items):
+        c = SolverClient(server.address[0], server.address[1], delta=False)
+        try:
+            pool = NodePool("default")
+            s = TPUSolver(g_max=64, client=c)
+            for tick in range(2):
+                s.solve(pool, catalog_items, churn_pods(np.random.default_rng(9), tick, 30))
+            assert c.last_delta["mode"] == "bypass"
+            assert c.last_delta["payload_bytes"] == c.last_delta["full_bytes"]
+        finally:
+            c.close()
+
+    def test_epoch_loss_restages_transparently(self, server, client, catalog_items):
+        pool = NodePool("default")
+        sd = TPUSolver(g_max=64, client=client)
+        host = TPUSolver(g_max=64)
+        rng = np.random.default_rng(13)
+        pods = churn_pods(rng, 0, 40)
+        sd.solve(pool, catalog_items, list(pods))
+        # the sidecar forgets every class epoch (restart analogue)
+        with server._lock:
+            server._epochs.clear()
+        before = metrics.DELTA_EPOCH_RESTAGES.value()
+        pods2 = pods[:-3] + churn_pods(rng, 1, 3)
+        res = sd.solve(pool, catalog_items, list(pods2))
+        assert decision_sig(res) == decision_sig(host.solve(pool, catalog_items, list(pods2)))
+        assert metrics.DELTA_EPOCH_RESTAGES.value() == before + 1
+        assert client.last_delta["mode"] == "full"  # the retry re-established
+
+    def test_pipelined_stale_epoch_surfaces_then_recovers(self, server, client, catalog_items):
+        solver = TPUSolver(g_max=64, client=client)
+        entry = solver._catalog(catalog_items)
+        rng = np.random.default_rng(17)
+        classes = encode.group_pods(churn_pods(rng, 0, 30))
+        cs = encode.encode_classes(classes, entry.tensors, c_pad=32)
+        # establish the epoch, then alter one row so the next ship is a delta
+        h = client.begin_solve_compact(entry.seqnum, entry.tensors, cs, g_max=64)
+        client.finish_solve_compact(h)
+        assert client.last_delta["mode"] == "full"
+        cs2 = encode.encode_classes(classes, entry.tensors, c_pad=32)
+        cs2.count[0] += 1
+        with server._lock:
+            server._epochs.clear()
+        h2 = client.begin_solve_compact(entry.seqnum, entry.tensors, cs2, g_max=64)
+        assert client.last_delta["mode"] == "delta"
+        with pytest.raises(StaleEpochError):
+            client.finish_solve_compact(h2)
+        # the synchronous retry full-restages (the service ladder's rung)
+        dec = client.solve_classes_compact(entry.seqnum, entry.tensors, cs2, g_max=64)
+        assert int(dec.n_open) >= 0
+        assert client.last_delta["mode"] == "full"
+
+    def test_staged_catalog_eviction_counted(self, server, client, catalog_items):
+        catalog = encode.encode_catalog(catalog_items[:8])
+        before = metrics.SOLVER_STAGED_EVICTIONS.value(kind="catalog")
+        for i in range(6):
+            client.stage_catalog(f"evict-{i}", catalog)
+        assert metrics.SOLVER_STAGED_EVICTIONS.value(kind="catalog") > before
+        info = client.debug_info()
+        assert "evict-5" in info["staged_seqnums"]
+        assert info["evictions"]["catalog"] >= 1
+        # solving against an evicted seqnum restages transparently
+        classes = encode.group_pods(churn_pods(np.random.default_rng(3), 0, 10))
+        cs = encode.encode_classes(classes, catalog, c_pad=16)
+        dec = client.solve_classes_compact("evict-0", catalog, cs, g_max=32)
+        assert int(dec.n_open) >= 0
+
+    def test_class_epoch_eviction_counted(self, server, client, catalog_items):
+        """More than 4 live epoch chains force class-epoch evictions; the
+        evicted chain's next delta restages transparently."""
+        pool = NodePool("default")
+        solvers = [
+            (TPUSolver(g_max=64, client=client), None)
+        ]
+        # 5 distinct catalogs = 5 seqnums = 5 epoch chains on the server
+        before = metrics.SOLVER_STAGED_EVICTIONS.value(kind="class_epoch")
+        s = solvers[0][0]
+        for i in range(5):
+            items = catalog_items[i : i + 20]
+            s.solve(pool, items, churn_pods(np.random.default_rng(i), i, 10))
+        assert metrics.SOLVER_STAGED_EVICTIONS.value(kind="class_epoch") > before
+
+
+class TestDescribeWire:
+    def test_document_shape(self, client, catalog_items):
+        pool = NodePool("default")
+        s = TPUSolver(g_max=64, client=client)
+        s.solve(pool, catalog_items, churn_pods(np.random.default_rng(1), 0, 20))
+        doc = s.describe_wire()
+        assert doc["wire"] is True and doc["delta_enabled"] is True
+        assert "last_delta" in doc and "group_stats" in doc
+        assert "server" in doc and "evictions" in doc["server"]
+
+    def test_host_only_document(self):
+        s = TPUSolver(g_max=32)
+        doc = s.describe_wire()
+        assert doc["wire"] is False
+
+
+class TestCorpusDeltaReplay:
+    def test_delta_backend_matches_golden_digest(self):
+        """The committed sim corpus through the delta path: decision
+        digests must equal the golden host digests bit-for-bit."""
+        from karpenter_tpu.sim.replay import replay
+        from karpenter_tpu.sim.trace import read_trace
+
+        with open(os.path.join(GOLDEN_DIR, "digests.json")) as f:
+            golden = json.load(f)
+        events = read_trace(os.path.join(GOLDEN_DIR, "diurnal-small.jsonl"))
+        seed = next(e["seed"] for e in events if e.get("ev") == "header")
+        res = replay(events, backend="delta", seed=seed)
+        assert res.digest == golden["diurnal-small"]

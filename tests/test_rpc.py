@@ -86,9 +86,13 @@ class TestProtocol:
         per connection and taint-gated merged batches depend on
         'join_allowed' being present (service._try_solve_merged)."""
         assert "join_allowed" in client.features()
+        # the delta wire layer is feature-negotiated the same way: without
+        # the advert the client ships full class tensors forever
+        assert "solve_delta" in client.features()
         assert client.features() is client.features()  # cached
         client.close()
         assert client._features is None  # reconnect re-probes
+        assert client._epoch_bases == {}  # delta bases die with the connection
 
     def test_taint_gated_merged_falls_back_without_feature(self, catalog_items):
         """Version skew: an old sidecar silently drops join_allowed, so a
