@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos sim-corpus
+.PHONY: test deflake benchmark bench-warm benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos sim-corpus
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -39,6 +39,9 @@ bench-warm:  ## warm steady-state delta stage only (incremental tick engine: war
 
 chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count (full-length schedule stays behind -m slow)
 	KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py -q -m 'not slow' $(call STAMP,chaos)
+
+crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection; diverging traces ddmin-shrink into crash-artifacts/ (full-length chain soak stays behind -m slow)
+	KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
 
 sim-corpus:  ## differential-replay the committed scenario corpus (host vs wire vs pipelined, golden digests); shrinks any failing trace into sim-artifacts/
 	$(PY) -m karpenter_tpu sim corpus --dir tests/golden/scenarios --artifacts sim-artifacts $(call STAMP,sim-corpus)

@@ -759,6 +759,84 @@ def _warm_delta(pool, items, zones, iters: int) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _recovery_stage(warm_tick_p50_ms=None, iters: int = 4, k_intents: int = 16) -> dict:
+    """Crash-recovery stage (crash-consistency tentpole; ALWAYS runs):
+
+    - recovery_sweep_p50/p99_ms: wall time of one restart recovery sweep
+      replaying `k_intents` crashed launches (the real crash path: a
+      `crash.launch` failpoint kills the fan-out after the cloud mutation,
+      leaving open intents + uncommitted instances; a fresh operator over
+      the surviving world adopts them all).
+    - journal_write_pair_ms_p50: the begin+resolve cost ONE journaled
+      launch adds to a tick. Warm steady-state ticks launch nothing, so
+      their journal cost is zero by construction; this per-pair cost vs
+      warm_delta_tick_p50_ms is the conservative bound the <1% acceptance
+      rides on (journal_overhead_ok)."""
+    from karpenter_tpu.apis import NodeClaim, NodePool, TPUNodeClass
+    from karpenter_tpu.apis.objects import ProvisioningIntent
+    from karpenter_tpu.cache.ttl import FakeClock
+    from karpenter_tpu.failpoints import FAILPOINTS, OperatorCrashed
+    from karpenter_tpu.operator import Operator
+
+    sweep_ms = []
+    adopted_total = 0
+    for it in range(iters):
+        clock = FakeClock(1000.0)
+        op = Operator(clock=clock, identity="bench-crash-a")
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        for i in range(k_intents):
+            # standalone claims: exactly one launch + intent each (the
+            # journaled nodeclaim-lifecycle path), so the sweep replays
+            # precisely k_intents adoptions
+            op.cluster.create(NodeClaim(f"rec-{it}-{i}"))
+        FAILPOINTS.arm("crash.launch", "crash", times=k_intents)
+        try:
+            op.tick()
+        except OperatorCrashed:
+            pass
+        finally:
+            FAILPOINTS.disarm("crash.launch")
+        open_n = len(op.cluster.list(ProvisioningIntent))
+        clock.step(20.0)
+        op2 = Operator(cloud=op.cloud, clock=clock, cluster=op.cluster)
+        # sweep() is timed directly (not via tick); adopt the bus epoch
+        # first exactly as the elector-less first tick would
+        op2.fence.observe(op2.fence.current())
+        t0 = time.perf_counter()
+        outcomes = op2.recovery.sweep()
+        sweep_ms.append((time.perf_counter() - t0) * 1e3)
+        adopted_total += outcomes.get("adopted", 0)
+        assert open_n and not op2.cluster.list(ProvisioningIntent)
+
+    # journal write overhead: the durable begin+resolve pair per launch
+    clock = FakeClock(1000.0)
+    op = Operator(clock=clock)
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    pair_ms = []
+    for i in range(200):
+        claim = NodeClaim(f"jw-{i}")
+        op.cluster.create(claim)
+        t0 = time.perf_counter()
+        intent = op.journal.begin_launch(claim)
+        op.journal.resolve(intent, "committed")
+        pair_ms.append((time.perf_counter() - t0) * 1e3)
+
+    out = {
+        "recovery_sweep_p50_ms": round(float(np.percentile(sweep_ms, 50)), 3),
+        "recovery_sweep_p99_ms": round(float(np.percentile(sweep_ms, 99)), 3),
+        "recovery_sweep_intents": k_intents,
+        "recovery_sweep_adopted_total": adopted_total,
+        "journal_write_pair_ms_p50": round(float(np.percentile(pair_ms, 50)), 4),
+    }
+    if warm_tick_p50_ms:
+        pct = 100.0 * out["journal_write_pair_ms_p50"] / warm_tick_p50_ms
+        out["journal_write_overhead_pct_of_warm_tick"] = round(pct, 3)
+        out["journal_overhead_ok"] = bool(pct < 1.0)
+    return out
+
+
 def _sim_scenario() -> dict:
     """Scenario-replay stage (sim subsystem): the medium diurnal scenario
     -- sustained sinusoidal arrivals, then a 30% pod churn -- replayed
@@ -1004,6 +1082,19 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False):
     except Exception as e:  # noqa: BLE001
         production["warm_delta_error"] = f"{type(e).__name__}: {e}"[:200]
     progress({"ev": "phase", "name": "warm_delta"})
+    stage_fields(production)
+
+    # crash-recovery stage (crash-consistency tentpole): ALWAYS runs --
+    # recovery_sweep_p50/p99_ms + the journal write overhead vs the warm
+    # tick (<1% acceptance) are headline acceptance data, persisted via
+    # the incremental side-file like every other stage
+    try:
+        production.update(_recovery_stage(
+            warm_tick_p50_ms=production.get("warm_delta_tick_p50_ms"),
+            iters=4 if backend != "cpu" else 3))
+    except Exception as e:  # noqa: BLE001
+        production["recovery_stage_error"] = f"{type(e).__name__}: {e}"[:200]
+    progress({"ev": "phase", "name": "recovery"})
     stage_fields(production)
 
     # secondary measurements -- each individually fenced so a failure can

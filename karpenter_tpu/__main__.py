@@ -318,6 +318,9 @@ def main(argv=None) -> int:
         if hasattr(op.solver, "describe_wire"):
             # /debug/solver: incremental-tick engine + staging LRU state
             health.solver_info = op.solver.describe_wire
+        # /debug/journal: the crash-consistency intent journal (open
+        # write-ahead records + the recently-resolved ring)
+        health.journal_info = op.journal.describe
     # latency GC policy: the provider graph and (if enabled) the jax
     # runtime are now the long-lived baseline; freeze it and stop gen2
     # collections from landing inside scheduling ticks

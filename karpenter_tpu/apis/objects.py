@@ -133,14 +133,51 @@ class APIObject:
 
 class Lease(APIObject):
     """Coordination lease for leader election (the coordination.k8s.io
-    Lease analogue; see operator/election.py for the elector)."""
+    Lease analogue; see operator/election.py for the elector).
+
+    `epoch` is the fencing token (serialized as leaseTransitions over a
+    real apiserver): monotonically bumped on every change of holder (and
+    on re-acquisition of an EXPIRED lease), never on a renew. Every cloud
+    mutation is stamped with the epoch its issuer last won; the cloud
+    seam rejects mutations whose epoch trails the lease's, so a deposed
+    leader's in-flight work fails closed instead of split-braining
+    (karpenter_tpu/fencing.py)."""
 
     KIND = "Lease"
 
-    def __init__(self, name: str = "", holder: str = "", renew_deadline: float = 0.0):
+    def __init__(self, name: str = "", holder: str = "", renew_deadline: float = 0.0,
+                 epoch: int = 0):
         super().__init__(name)
         self.holder = holder
         self.renew_deadline = renew_deadline
+        self.epoch = epoch
+
+
+class ProvisioningIntent(APIObject):
+    """One durable write-ahead record at the cluster/cloud seam (the
+    crash-consistency journal, karpenter_tpu/journal.py): written to the
+    coordination bus BEFORE the cloud mutation it describes, resolved
+    (deleted) once the matching claim status committed. An intent that
+    survives an operator crash is exactly the work the restart recovery
+    sweep must replay -- and its idempotency `token`, stamped into the
+    launch as a client token and onto the instance as a tag, is what
+    makes that replay launch-at-most-once."""
+
+    KIND = "Intent"
+
+    OP_LAUNCH = "launch"
+    OP_TERMINATE = "terminate"
+
+    def __init__(self, name: str = "", op: str = OP_LAUNCH, claim_name: str = "",
+                 token: str = "", epoch: int = 0, provider_id: str = ""):
+        super().__init__(name)
+        self.op = op
+        self.claim_name = claim_name
+        self.token = token
+        self.epoch = epoch
+        # terminate intents record the doomed instance so recovery can
+        # finish the termination even after the claim object is gone
+        self.provider_id = provider_id
 
 
 # seedable name generation (seed discipline, sim subsystem): generated
@@ -166,3 +203,33 @@ def generate_name(prefix: str) -> str:
     if _name_rng is not None:
         return f"{prefix}{_name_rng.getrandbits(32):08x}"
     return f"{prefix}{uuid.uuid4().hex[:8]}"
+
+
+# THE idempotency-token key: stamped on the claim as an annotation (to
+# thread the token into the fleet call without changing the reference's
+# CloudProvider.create signature) and onto the instance as a tag (the
+# recovery sweep's correlation read). One constant -- the GC shield and
+# by_token lookup silently stop matching if two copies drift.
+INTENT_TOKEN_KEY = "karpenter.tpu/intent-token"
+
+# journal idempotency tokens (karpenter_tpu/journal.py) draw from their OWN
+# seeded stream, NOT the object-name stream above: tokens are minted per
+# launch intent, and sharing the name rng would shift every claim name a
+# replay generates -- invalidating the committed golden decision digests
+# for a change that never touches a decision. Unseeded stays uuid4.
+_token_rng = None
+
+
+def seed_intent_tokens(seed: Optional[int]) -> None:
+    if seed is None:
+        globals()["_token_rng"] = None
+    else:
+        import random
+
+        globals()["_token_rng"] = random.Random(f"intent-tokens:{seed}")
+
+
+def generate_intent_token() -> str:
+    if _token_rng is not None:
+        return f"it-{_token_rng.getrandbits(64):016x}"
+    return f"it-{uuid.uuid4().hex}"

@@ -27,13 +27,20 @@ from karpenter_tpu.cloud.types import FleetRequest, FleetResult
 
 class CloudBatchers:
     """The per-API batcher bundle the instance provider launches through
-    (reference: the ec2Batcher struct built in operator.go)."""
+    (reference: the ec2Batcher struct built in operator.go).
+
+    `fence` (optional fencing.Fence) is checked INSIDE the mutating
+    executors, after the merge window closes and immediately before the
+    cloud call: the provider-level check alone leaves a window where a
+    leader deposed while its request waits in the batching rendezvous
+    still mutates the cloud -- here the merged call fails closed and the
+    stale-epoch error fans out to every waiter."""
 
     def __init__(self, compute_api: ComputeAPI, options: Optional[BatchOptions] = None,
-                 clock: Optional[Clock] = None, background: bool = False):
-        self.create_fleet = CreateFleetBatcher(compute_api, options, clock, background)
+                 clock: Optional[Clock] = None, background: bool = False, fence=None):
+        self.create_fleet = CreateFleetBatcher(compute_api, options, clock, background, fence)
         self.describe_instances = DescribeInstancesBatcher(compute_api, options, clock, background)
-        self.terminate_instances = TerminateInstancesBatcher(compute_api, options, clock, background)
+        self.terminate_instances = TerminateInstancesBatcher(compute_api, options, clock, background, fence)
 
     def stop(self) -> None:
         for b in (self.create_fleet, self.describe_instances, self.terminate_instances):
@@ -67,8 +74,9 @@ def _fleet_key(req: FleetRequest) -> Tuple:
 
 class CreateFleetBatcher:
     def __init__(self, compute_api: ComputeAPI, options: Optional[BatchOptions] = None,
-                 clock: Optional[Clock] = None, background: bool = False):
+                 clock: Optional[Clock] = None, background: bool = False, fence=None):
         self.compute_api = compute_api
+        self.fence = fence
         self.batcher: Batcher[FleetRequest, FleetResult] = Batcher(
             self._exec, options=options, hasher=_fleet_key, clock=clock,
             background=background, name="create_fleet",
@@ -82,6 +90,17 @@ class CreateFleetBatcher:
         (hasher guarantees it); issue one fleet call for the sum and deal
         instances back one per request, reference createfleet.go:47-63."""
         total = sum(r.target_capacity for r in requests)
+        # idempotency tokens ride OUTSIDE the bucket hash so identical
+        # requests still merge; the merged call carries every waiter's
+        # tokens slot-aligned with the summed capacity (a slot without a
+        # token pads with None), and the positional instance deal below
+        # hands each waiter the instance launched -- or idempotently
+        # replayed -- for ITS token
+        tokens: List[Optional[str]] = []
+        for r in requests:
+            slot_tokens = list(r.client_tokens)[: r.target_capacity]
+            slot_tokens += [None] * (r.target_capacity - len(slot_tokens))
+            tokens.extend(slot_tokens)
         merged = FleetRequest(
             launch_template_name=requests[0].launch_template_name,
             capacity_type=requests[0].capacity_type,
@@ -89,7 +108,13 @@ class CreateFleetBatcher:
             target_capacity=total,
             tags=requests[0].tags,
             context=requests[0].context,
+            client_tokens=tuple(tokens),
         )
+        if self.fence is not None:
+            # last instant before the cloud mutation (the window closed on
+            # this thread): a deposition that landed while the batch was
+            # accumulating fails the WHOLE merged call closed
+            self.fence.check("create_fleet")
         result = self.compute_api.create_fleet(merged)
         out: List[FleetResult] = []
         cursor = 0
@@ -123,8 +148,9 @@ class DescribeInstancesBatcher:
 
 class TerminateInstancesBatcher:
     def __init__(self, compute_api: ComputeAPI, options: Optional[BatchOptions] = None,
-                 clock: Optional[Clock] = None, background: bool = False):
+                 clock: Optional[Clock] = None, background: bool = False, fence=None):
         self.compute_api = compute_api
+        self.fence = fence
         self.batcher: Batcher[Tuple[str, ...], list] = Batcher(
             self._exec, options=options, hasher=lambda ids: 0, clock=clock,
             background=background, name="terminate_instances",
@@ -134,5 +160,7 @@ class TerminateInstancesBatcher:
         return self.batcher.call(tuple(ids))
 
     def _exec(self, id_groups: Sequence[Tuple[str, ...]]) -> List[list]:
+        if self.fence is not None:
+            self.fence.check("terminate_instances")
         terminated = set(self.compute_api.terminate_instances(_union_ids(id_groups)))
         return [[i for i in ids if i in terminated] for ids in id_groups]

@@ -144,6 +144,13 @@ class FleetRequest:
     target_capacity: int = 1
     tags: Dict[str, str] = field(default_factory=dict)
     context: str = ""
+    # idempotency client tokens, one per capacity slot (the EC2 ClientToken
+    # analogue, minted by the provisioning journal): a replayed slot whose
+    # token already backs a live instance returns THAT instance instead of
+    # launching a second. Deliberately outside the batcher's bucket hash --
+    # identical requests still merge, the merged call carries the union of
+    # tokens slot-aligned (batcher/cloud.py).
+    client_tokens: Tuple[Optional[str], ...] = ()
 
 
 @dataclass

@@ -91,6 +91,24 @@ class CloudProvider:
         items = self.instance_types.list(nodeclass)
         compatible = [it for it in items if it.requirements.compatible(claim.requirements)]
         inst = self.instances.create(nodeclass, claim, compatible)
+        # crash site: the canonical crash-consistency window -- the cloud
+        # mutation has happened, the claim status commit has NOT. Without
+        # the intent journal this instance leaks until GC's grace window;
+        # with it, the restart recovery sweep adopts the instance by its
+        # intent token (controllers/recovery.py)
+        from karpenter_tpu import failpoints
+
+        failpoints.eval("crash.launch")
+        chosen = next((it for it in items if it.name == inst.instance_type), None)
+        return self._instance_to_nodeclaim(claim, inst, chosen)
+
+    def adopt(self, claim: NodeClaim, inst: CloudInstance) -> NodeClaim:
+        """Reflect an ALREADY-LAUNCHED instance into a claim whose status
+        commit was lost to a crash (the recovery sweep's repair path):
+        exactly the instanceToNodeClaim reflection create() would have
+        done, minus the launch."""
+        nodeclass = self._nodeclass_for(claim)
+        items = self.instance_types.list(nodeclass)
         chosen = next((it for it in items if it.name == inst.instance_type), None)
         return self._instance_to_nodeclaim(claim, inst, chosen)
 

@@ -33,13 +33,17 @@ class NodeClaimLifecycleController:
     # same fan-out as the provisioner's launch wave (SURVEY §2.4 row 1)
     MAX_CONCURRENT_LAUNCHES = 10
 
-    def __init__(self, cluster, cloud_provider, recorder=None):
+    def __init__(self, cluster, cloud_provider, recorder=None, journal=None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.recorder = recorder
+        # optional IntentJournal: standalone launches get the same
+        # write-ahead crash-consistency protocol as provisioned ones
+        self.journal = journal
 
     def reconcile_all(self) -> int:
         from karpenter_tpu.controllers.provisioner import launch_all
+        from karpenter_tpu.providers.instance.provider import INTENT_TOKEN_ANNOTATION
 
         pending = [
             c for c in self.cluster.list(NodeClaim)
@@ -47,12 +51,22 @@ class NodeClaimLifecycleController:
         ]
         if not pending:
             return 0
+        intents = []
+        for claim in pending:
+            intent = None
+            if self.journal is not None:
+                intent = self.journal.begin_launch(claim)
+                claim.metadata.annotations[INTENT_TOKEN_ANNOTATION] = intent.token
+            intents.append(intent)
         outcomes = launch_all(self.cloud_provider, pending, self.MAX_CONCURRENT_LAUNCHES)
         launched = 0
-        for claim, err in zip(pending, outcomes):
+        for claim, intent, err in zip(pending, intents, outcomes):
             if err is not None:
                 if self.recorder is not None:
                     self.recorder.publish(claim, "LaunchFailed", str(err), type="Warning")
+                # intent stays OPEN: unlike the provisioner the claim is
+                # not dropped (level-triggered retry next tick reuses the
+                # same intent and token, so the retry stays idempotent)
                 continue
             # stamp the nodeclass static hash so drift detection covers
             # static capacity exactly as it covers provisioned capacity
@@ -62,6 +76,8 @@ class NodeClaimLifecycleController:
                 claim.metadata.annotations[HASH_ANNOTATION] = nodeclass.static_hash()
                 claim.metadata.annotations[HASH_VERSION_ANNOTATION] = HASH_VERSION
             self.cluster.update(claim)
+            if intent is not None:
+                self.journal.resolve(intent, "committed")
             launched += 1
             metrics.NODECLAIMS_CREATED.inc(
                 nodepool=claim.metadata.labels.get(wk.NODEPOOL_LABEL, "<standalone>")

@@ -86,12 +86,16 @@ class Provisioner:
 
     def __init__(
         self, cluster: Cluster, cloud_provider: CloudProvider, solver=None,
-        recorder=None, pipeline: Optional[bool] = None,
+        recorder=None, pipeline: Optional[bool] = None, journal=None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.solver = solver  # optional TPU solver; None = oracle
         self.recorder = recorder  # optional events.Recorder
+        # optional IntentJournal (karpenter_tpu/journal.py): every launch
+        # writes a durable intent BEFORE the cloud call and resolves it
+        # after the claim status commit -- the crash-consistency protocol
+        self.journal = journal
         self.last_result: Optional[SchedulingResult] = None
         # pod name -> claim name from the last scheduling decisions: the
         # binder tries the DECIDED node first instead of re-searching the
@@ -184,8 +188,12 @@ class Provisioner:
             return self._reconcile()
 
     def _reconcile(self) -> SchedulingResult:
+        from karpenter_tpu import failpoints
         from karpenter_tpu.apis.storage import VolumeIndex, effective_pods
 
+        # crash site: the operator dies at the top of the provisioner
+        # dispatch (nothing launched yet; restart must re-simulate cleanly)
+        failpoints.eval("crash.provisioner.dispatch")
         # pipeline barrier FIRST: the decision dispatched last tick lands
         # and its claims launch before this tick snapshots, so the new
         # snapshot sees that capacity in flight (drain-before-snapshot --
@@ -391,17 +399,31 @@ class Provisioner:
             self._launch_groups(result, groups)
 
     def _launch_groups(self, result: SchedulingResult, groups) -> None:
+        from karpenter_tpu.providers.instance.provider import INTENT_TOKEN_ANNOTATION
+
         claims = []
+        intents = []
         for group in groups:
             claim = self._to_nodeclaim(group)
             self.cluster.create(claim)
+            # write-ahead intent AFTER the claim exists but BEFORE any
+            # cloud call: the durable record a restart replays, its token
+            # threaded to the fleet call via the claim annotation
+            intent = None
+            if self.journal is not None:
+                intent = self.journal.begin_launch(claim)
+                claim.metadata.annotations[INTENT_TOKEN_ANNOTATION] = intent.token
             claims.append(claim)
+            intents.append(intent)
         # cloud calls fan out via the shared protocol (launch_all above);
         # cluster mutations stay on this thread
         outcomes = launch_all(self.cloud_provider, claims, self.MAX_CONCURRENT_LAUNCHES)
-        for group, claim, err in zip(groups, claims, outcomes):
+        for group, claim, intent, err in zip(groups, claims, intents, outcomes):
             if err is None:
                 self.cluster.update(claim)
+                if intent is not None:
+                    # status committed: the intent has served its purpose
+                    self.journal.resolve(intent, "committed")
                 metrics.NODECLAIMS_CREATED.inc(nodepool=group.nodepool.name)
                 for pod in group.pods:
                     self._assignment_hints[pod.metadata.name] = claim.metadata.name
@@ -412,6 +434,12 @@ class Provisioner:
                     result.unschedulable[pod.metadata.name] = str(err)
                 claim.metadata.finalizers = []
                 self.cluster.delete(NodeClaim, claim.metadata.name)
+                # the intent stays OPEN: a CloudError does not prove no
+                # instance was minted (a post-mint failure inside the
+                # launch path, a misdealt merged batch). GC's stale-intent
+                # janitor replays it THIS sweep -- no instance found means
+                # a cheap "dropped"; a minted-but-unowned one is
+                # terminated immediately instead of leaking until grace
 
     def _to_nodeclaim(self, group: NewNodeGroup) -> NodeClaim:
         pool = group.nodepool
@@ -472,9 +500,14 @@ class PodBinder:
             return bound
 
     def _reconcile(self) -> int:
+        from karpenter_tpu import failpoints
         from karpenter_tpu.apis.storage import VolumeIndex
         from karpenter_tpu.scheduling import tolerates_all
 
+        # crash site: the operator dies before binding (claims launched and
+        # committed, pods still pending; restart must just bind, not
+        # relaunch)
+        failpoints.eval("crash.bind")
         bound = 0
         nodes = [n for n in self.cluster.list(Node) if n.ready and not n.unschedulable and not n.deleting]
         # per-(topology key, selector) domain counts, built on first use per

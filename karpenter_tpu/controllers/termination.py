@@ -32,10 +32,16 @@ SYSTEM_CRITICAL_PRIORITY = 2_000_000_000
 class TerminationController:
     log = get_logger("termination")
 
-    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, recorder=None):
+    def __init__(self, cluster: Cluster, cloud_provider: CloudProvider, recorder=None,
+                 journal=None):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.recorder = recorder  # optional events.Recorder
+        # optional IntentJournal: a durable terminate intent is written
+        # once the drain completes, BEFORE the cloud delete, so a crash
+        # between the two resumes promptly at the next recovery sweep
+        # instead of waiting for the level-triggered retry to rediscover it
+        self.journal = journal
         self._drain_started: dict = {}
 
     def reconcile_all(self) -> None:
@@ -109,15 +115,28 @@ class TerminationController:
             for p in blocked:
                 p.metadata.finalizers = []
                 self.cluster.delete(PodKind, p.metadata.name)
-        # node drained (or gone): delete the instance, then the objects
+        # node drained (or gone): delete the instance, then the objects.
+        # The terminate intent lands FIRST (write-ahead): a crash between
+        # the cloud delete and the finalizer removal leaves a record the
+        # recovery sweep resumes immediately
+        intent = None
+        if self.journal is not None and claim.provider_id:
+            intent = self.journal.begin_terminate(claim)
         try:
             self.cloud_provider.delete(claim)
         except NotFoundError:
             pass
+        # crash site: instance terminated, finalizer (and node object)
+        # still in place -- restart must finish the teardown, not relaunch
+        from karpenter_tpu import failpoints
+
+        failpoints.eval("crash.termination")
         if node is not None:
             node.metadata.finalizers = []
             self.cluster.delete(Node, node.metadata.name)
         self.cluster.remove_finalizer(claim, TERMINATION_FINALIZER)
+        if intent is not None:
+            self.journal.resolve(intent, "committed")
         self._drain_started.pop(claim.metadata.name, None)
         if self.recorder is not None:
             # the core publishes a terminated event per claim through its

@@ -6,6 +6,7 @@ from karpenter_tpu.errors.errors import (
     RateLimitedError,
     LaunchTemplateNotFoundError,
     NodeClassNotReadyError,
+    StaleFencingEpochError,
     is_not_found,
     is_rate_limited,
     is_unfulfillable_capacity,
@@ -20,6 +21,7 @@ __all__ = [
     "RateLimitedError",
     "LaunchTemplateNotFoundError",
     "NodeClassNotReadyError",
+    "StaleFencingEpochError",
     "is_not_found",
     "is_rate_limited",
     "is_unfulfillable_capacity",

@@ -59,6 +59,16 @@ class NodeClassNotReadyError(CloudError):
     code = "NodeClassNotReady"
 
 
+class StaleFencingEpochError(CloudError):
+    """A cloud mutation carried a fencing epoch older than the lease's:
+    the issuer was deposed and must fail closed (karpenter_tpu/fencing.py).
+    A CloudError so in-flight launch fan-outs take the existing error
+    path -- the claim is dropped and the NEW leader re-simulates -- instead
+    of crashing the deposed replica's sweep."""
+
+    code = "StaleFencingEpoch"
+
+
 def is_not_found(err: Exception) -> bool:
     return isinstance(err, NotFoundError) or getattr(err, "code", "") in NOT_FOUND_CODES
 

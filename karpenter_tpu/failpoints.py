@@ -27,6 +27,15 @@ Actions:
   connection-drop at stream sites).
 - ``kill_after(N)``        -- pass through N evaluations, then raise on
   every one after (a sidecar that dies mid-run and stays dead).
+- ``crash``                -- raise ``OperatorCrashed`` (a BaseException:
+  nothing on the controller paths may swallow it): the operator process
+  dies mid-tick at this site, abandoning whatever was in flight. Drivers
+  (the sim replay engine's ``crash`` event, the crash-chaos soak, a
+  game-day ``make crash-chaos`` drill) catch it at the run loop, abandon
+  the operator, and restart a fresh one over the surviving cluster/cloud
+  state -- the restart recovery path (controllers/recovery.py). Sites:
+  ``crash.provisioner.dispatch``, ``crash.launch``, ``crash.bind``,
+  ``crash.termination``, ``crash.recovery``.
 
 Modifiers (colon-separated after the action): ``times=M`` fire at most M
 times; ``after=N`` skip the first N evaluations; ``p=F`` fire with
@@ -49,6 +58,14 @@ from typing import Dict, Optional
 
 ENV = "KARPENTER_TPU_FAILPOINTS"
 SEED_ENV = "KARPENTER_TPU_FAILPOINTS_SEED"
+
+class OperatorCrashed(BaseException):
+    """The `crash` action's payload: the operator process is GONE at this
+    site. BaseException on purpose -- the controller stack's broad
+    `except Exception` seams (launch fan-out, cloud-call wrapper, batcher
+    executor) must not convert a process death into a handled cloud
+    error; only the run-loop driver that owns the operator may catch it."""
+
 
 _BUILTIN_EXC = {
     "ConnectionError": ConnectionError,
@@ -85,7 +102,7 @@ class Failpoint:
     def __init__(self, site: str, action: str, arg: Optional[str] = None, *,
                  times: Optional[int] = None, after: int = 0, p: float = 1.0,
                  seed: int = 0):
-        if action not in ("error", "latency", "corrupt", "drop", "kill_after"):
+        if action not in ("error", "latency", "corrupt", "drop", "kill_after", "crash"):
             raise ValueError(f"unknown failpoint action {action!r}")
         if action == "drop":
             action, arg = "error", (arg or "ConnectionError")
@@ -224,6 +241,8 @@ class FailpointRegistry:
         if fp.action == "latency":
             time.sleep(float(fp.arg or 0.01))
             return
+        if fp.action == "crash":
+            raise OperatorCrashed(f"failpoint {site} crashed the operator")
         raise _exception_class(fp.arg)(f"failpoint {site} injected {fp.action}")
 
     def corrupt(self, site: str, data: bytes) -> bytes:

@@ -1046,6 +1046,10 @@ def lease_to_manifest(l) -> dict:
         "spec": {
             "holderIdentity": l.holder,
             "renewTime": format_time(l.renew_deadline) if l.renew_deadline else None,
+            # the fencing epoch rides the REAL Lease field for it:
+            # leaseTransitions counts holder changes, which is exactly when
+            # the epoch bumps (operator/election.py)
+            "leaseTransitions": getattr(l, "epoch", 0),
         },
     }
 
@@ -1058,9 +1062,42 @@ def lease_from_manifest(m: dict):
         m["metadata"]["name"],
         holder=spec.get("holderIdentity", "") or "",
         renew_deadline=parse_time(spec.get("renewTime")),
+        epoch=int(spec.get("leaseTransitions") or 0),
     )
     meta_from_manifest(l, m)
     return l
+
+
+# -- ProvisioningIntent (crash-consistency journal) ---------------------------
+
+def intent_to_manifest(i) -> dict:
+    return {
+        "apiVersion": f"{GROUP_PROVIDER}/{VERSION}", "kind": "ProvisioningIntent",
+        "metadata": meta_to_manifest(i.metadata),
+        "spec": {
+            "op": i.op,
+            "claimName": i.claim_name,
+            "token": i.token,
+            "epoch": i.epoch,
+            "providerID": i.provider_id or None,
+        },
+    }
+
+
+def intent_from_manifest(m: dict):
+    from karpenter_tpu.apis.objects import ProvisioningIntent
+
+    spec = m.get("spec", {})
+    i = ProvisioningIntent(
+        m["metadata"]["name"],
+        op=spec.get("op", ProvisioningIntent.OP_LAUNCH),
+        claim_name=spec.get("claimName", ""),
+        token=spec.get("token", ""),
+        epoch=int(spec.get("epoch") or 0),
+        provider_id=spec.get("providerID") or "",
+    )
+    meta_from_manifest(i, m)
+    return i
 
 
 # -- registry ----------------------------------------------------------------
@@ -1144,4 +1181,11 @@ from karpenter_tpu.apis.objects import Lease as _Lease  # noqa: E402
 
 REGISTRY[_Lease] = KindInfo(
     _Lease, "coordination.k8s.io/v1", "leases", True, lease_to_manifest, lease_from_manifest
+)
+
+from karpenter_tpu.apis.objects import ProvisioningIntent as _Intent  # noqa: E402
+
+REGISTRY[_Intent] = KindInfo(
+    _Intent, f"{GROUP_PROVIDER}/{VERSION}", "provisioningintents", False,
+    intent_to_manifest, intent_from_manifest,
 )

@@ -53,6 +53,12 @@ from karpenter_tpu.providers.instancetype import gen_catalog
 
 ICE_CODE = "InsufficientInstanceCapacity"
 RATE_LIMIT_CODE = "RequestLimitExceeded"
+# idempotency-token tag: the journal's launch token rides onto the
+# instance so a restart can correlate a launched instance with the intent
+# whose claim status never committed (karpenter_tpu/journal.py). The key
+# itself lives in apis/objects (core, not the emulator) -- this is a
+# re-export for the suites that read instance tags.
+from karpenter_tpu.apis.objects import INTENT_TOKEN_KEY as INTENT_TOKEN_TAG  # noqa: E402
 
 
 class RateLimitError(Exception):
@@ -134,6 +140,12 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
 
         # fleet state
         self._instances: Dict[str, CloudInstance] = {}
+        # client-token idempotency (the EC2 ClientToken analogue): token ->
+        # instance id. A replayed launch slot with a known token returns
+        # the existing instance -- the cloud-side half of the journal's
+        # launch-at-most-once contract.
+        self._fleet_tokens: Dict[str, str] = {}
+        self.idempotent_hits = 0
         self._launch_templates: Dict[str, LaunchTemplateInfo] = {}
         self._instance_profiles: Dict[str, Dict] = {}
         self._queue: List[QueueMessage] = []
@@ -294,7 +306,22 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
         instances: List[CloudInstance] = []
         errors: List[FleetError] = []
         exhausted = set()
-        for _ in range(request.target_capacity):
+        for slot in range(request.target_capacity):
+            token = (
+                request.client_tokens[slot]
+                if slot < len(request.client_tokens) else None
+            )
+            if token:
+                with self._lock:
+                    existing = self._instances.get(self._fleet_tokens.get(token, ""))
+                if existing is not None and existing.state not in ("terminated",):
+                    # idempotent replay: this slot's token already backs a
+                    # live instance (a crashed operator's journal replaying
+                    # its open launch intent) -- return it, launch nothing
+                    with self._lock:
+                        self.idempotent_hits += 1
+                    instances.append(existing)
+                    continue
             placed = False
             for o in ranked:
                 key = (o.instance_type, o.zone)
@@ -328,6 +355,9 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
                     )
                     continue
                 iid = f"i-{next(self._id_seq):08x}"
+                tags = dict(request.tags)
+                if token:
+                    tags[INTENT_TOKEN_TAG] = token
                 inst = CloudInstance(
                     id=iid,
                     instance_type=o.instance_type,
@@ -337,7 +367,7 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
                     image_id=o.image_id or lt.image_id,
                     state="running",
                     launch_time=self._now(),
-                    tags=dict(request.tags),
+                    tags=tags,
                     capacity_reservation_id=o.capacity_reservation_id,
                     nic_count=lt.nic_count,
                     security_group_ids=list(lt.security_group_ids),
@@ -345,6 +375,8 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
                 with self._lock:
                     self._instances[iid] = inst
                     subnet.available_ip_count -= 1
+                    if token:
+                        self._fleet_tokens[token] = iid
                 instances.append(inst)
                 placed = True
                 break
@@ -535,6 +567,7 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
                 "capacity_pools": [[list(k), v] for k, v in self._capacity_pools.items()],
                 "subnet_ips": {s.id: s.available_ip_count for s in self._subnets},
                 "id_seq": next(self._id_seq),
+                "fleet_tokens": dict(self._fleet_tokens),
             }
         return json.dumps(doc)
 
@@ -548,3 +581,4 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
                 if s.id in doc["subnet_ips"]:
                     s.available_ip_count = doc["subnet_ips"][s.id]
             self._id_seq = itertools.count(doc["id_seq"])
+            self._fleet_tokens = dict(doc.get("fleet_tokens", {}))

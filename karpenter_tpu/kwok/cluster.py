@@ -21,7 +21,7 @@ from karpenter_tpu.apis import (
     PodDisruptionBudget, StorageClass, TPUNodeClass,
 )
 from karpenter_tpu.apis.storage import CSINode
-from karpenter_tpu.apis.objects import APIObject, Lease
+from karpenter_tpu.apis.objects import APIObject, Lease, ProvisioningIntent
 from karpenter_tpu.cache.ttl import Clock
 from karpenter_tpu.scheduling import Resources
 
@@ -111,6 +111,7 @@ class RelationalQueries:
 class Cluster(RelationalQueries):
     KINDS: Tuple[Type[APIObject], ...] = (
         Pod, Node, NodeClaim, NodePool, TPUNodeClass, Lease,
+        ProvisioningIntent,
         PodDisruptionBudget, DaemonSet, PersistentVolumeClaim, StorageClass,
         CSINode,
     )

@@ -95,7 +95,15 @@ class NodeLifecycle:
             if not claim.registered() or claim.initialized() or claim.deleting:
                 continue
             reg_time = self._registered_at.get(claim.node_name)
-            if reg_time is None or now - reg_time < self.initialize_delay:
+            if reg_time is None:
+                # a restarted operator lost the in-memory observation
+                # timestamps (they are deliberately not durable -- delays
+                # are a kubelet emulation, not cluster state): re-observe
+                # NOW so an already-registered node initializes one delay
+                # later instead of never (pre-journal this could not
+                # happen; operator restarts over live state can hit it)
+                reg_time = self._registered_at[claim.node_name] = now
+            if now - reg_time < self.initialize_delay:
                 continue
             node = self.cluster.try_get(Node, claim.node_name)
             if node is None:

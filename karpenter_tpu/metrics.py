@@ -338,6 +338,42 @@ SOLVER_STAGED_EVICTIONS = REGISTRY.counter(
     "epochs); an eviction costs the next referencing solve a full restage",
     labels=("kind",),  # catalog | class_epoch
 )
+# crash-consistency layer: write-ahead intent journal (karpenter_tpu/
+# journal.py), restart recovery sweep (controllers/recovery.py), and
+# leadership fencing (karpenter_tpu/fencing.py)
+JOURNAL_WRITES = REGISTRY.counter(
+    "karpenter_journal_writes_total",
+    "Intent-journal records by operation and lifecycle event (begin = "
+    "durable write-ahead record created; committed/adopted/... = resolved "
+    "with that outcome)",
+    labels=("op", "event"),  # op: launch | terminate
+)
+JOURNAL_OPEN = REGISTRY.gauge(
+    "karpenter_journal_open_intents",
+    "Open (unresolved) provisioning intents on the coordination bus; "
+    "nonzero at steady state means launches/terminations are in flight, "
+    "nonzero after a restart is the recovery sweep's work list",
+)
+RECOVERY_SWEEP_DURATION = REGISTRY.histogram(
+    "karpenter_recovery_sweep_duration_seconds",
+    "Duration of one restart recovery sweep (runs on every election win)",
+)
+RECOVERY_SWEEP_INTENTS = REGISTRY.counter(
+    "karpenter_recovery_sweep_intents_total",
+    "Open intents replayed by the recovery sweep, by outcome (adopted = "
+    "launched instance reflected into its uncommitted claim; "
+    "terminated_half_launch = instance without a live claim terminated "
+    "immediately; resumed_termination = interrupted terminate re-issued; "
+    "orphan_terminated = terminate intent without a claim finished; "
+    "already_committed / dropped = no cloud work needed)",
+    labels=("outcome",),
+)
+FENCING_REJECTED = REGISTRY.counter(
+    "karpenter_fencing_rejected_total",
+    "Cloud mutations refused at the cloud seam because the issuer's "
+    "fencing epoch trailed the lease's (a deposed leader failing closed)",
+    labels=("op",),  # create_fleet | terminate_instances | create_tags
+)
 # scenario simulation & trace replay (karpenter_tpu/sim/)
 SIM_EVENTS = REGISTRY.counter(
     "karpenter_sim_replay_events_total",

@@ -61,6 +61,12 @@ class HealthServer:
         # staged seqnums/epochs, sidecar eviction counters). Served by
         # /debug/solver, loopback-only.
         self.solver_info = None
+        # optional () -> dict with the intent journal's state (IntentJournal
+        # .describe: open write-ahead intents off the coordination bus plus
+        # the recently-resolved ring). Served by /debug/journal,
+        # loopback-only -- the runbook's first stop after an operator
+        # restart (docs/operations.md).
+        self.journal_info = None
         self._started_at = time.monotonic()
         self._last_loop: float = 0.0   # 0 = run loop has not turned yet
         self._last_sweep: float = 0.0  # 0 = no full sweep completed yet
@@ -178,6 +184,11 @@ class HealthServer:
                     # describe_wire): grouping churn, delta shipping, the
                     # staging LRUs and their eviction counters
                     self._debug_json(outer.solver_info)
+                elif self.path == "/debug/journal":
+                    # crash-consistency intent journal (karpenter_tpu/
+                    # journal.py): open write-ahead intents + the
+                    # recently-resolved ring
+                    self._debug_json(outer.journal_info)
                 elif self.path == "/debug/traces":
                     # slow-tick flight recorder (karpenter_tpu/tracing.py):
                     # the last N span trees whose sweep exceeded the slow

@@ -151,6 +151,17 @@ class Operator:
 
         self.recorder = Recorder(self.clock)
 
+        # crash-consistency layer: the write-ahead intent journal lives on
+        # the coordination bus (it must survive THIS process), the fence
+        # carries the leadership epoch every cloud mutation is stamped
+        # with, and the recovery sweep (constructed after the providers
+        # below) replays open intents on every election win
+        from karpenter_tpu.fencing import Fence
+        from karpenter_tpu.journal import IntentJournal
+
+        self.fence = Fence(self.cluster)
+        self.journal = IntentJournal(self.cluster, fence=self.fence)
+
         # providers, each with its dedicated caches (operator.go:126-186)
         self.unavailable = UnavailableOfferings(self.clock)
         self.pricing = PricingProvider(self.cloud, self.cloud, self.options.region)
@@ -182,12 +193,14 @@ class Operator:
                 max_seconds=self.options.batch_max_duration,
             ),
             clock=self.clock,
+            fence=self.fence,
         )
         self.instances = InstanceProvider(
             self.cloud, self.subnets, self.launch_templates, self.unavailable,
             capacity_reservations=self.capacity_reservations,
             cluster_name=self.options.cluster_name,
             batchers=self.batchers,
+            fence=self.fence,
         )
         self.cloud_provider = CloudProvider(self.cluster, self.instance_types, self.instances)
 
@@ -200,16 +213,20 @@ class Operator:
         )
         self.provisioner = Provisioner(
             self.cluster, self.cloud_provider, solver=solver, recorder=self.recorder,
-            pipeline=self.options.pipelined_scheduling,
+            pipeline=self.options.pipelined_scheduling, journal=self.journal,
         )
         self.nodeclaim_lifecycle = NodeClaimLifecycleController(
-            self.cluster, self.cloud_provider, recorder=self.recorder
+            self.cluster, self.cloud_provider, recorder=self.recorder,
+            journal=self.journal,
         )
         self.binder = PodBinder(
             self.cluster, assignment_hints=self.provisioner._assignment_hints
         )
         self.lifecycle = NodeLifecycle(self.cluster, self.cloud)
-        self.termination = TerminationController(self.cluster, self.cloud_provider, recorder=self.recorder)
+        self.termination = TerminationController(
+            self.cluster, self.cloud_provider, recorder=self.recorder,
+            journal=self.journal,
+        )
         self.disruption = DisruptionController(
             self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates,
             evaluator=consolidation_evaluator, recorder=self.recorder,
@@ -225,7 +242,9 @@ class Operator:
         self.interruption = InterruptionController(
             self.cluster, self.queue, self.unavailable, self.recorder
         )
-        self.garbage_collection = GarbageCollectionController(self.cluster, self.cloud_provider)
+        self.garbage_collection = GarbageCollectionController(
+            self.cluster, self.cloud_provider, journal=self.journal
+        )
         self.repair = NodeRepairController(self.cluster, self.cloud_provider, self.recorder)
         self.tagging = TaggingController(self.cluster, self.cloud_provider)
         self.instance_type_refresh = InstanceTypeRefreshController(self.instance_types, self.clock)
@@ -241,14 +260,39 @@ class Operator:
         )
         self.metrics_controller = MetricsController(self.cluster)
 
+        # restart recovery: replay the intent journal's open records back
+        # to a safe state -- adopt uncommitted launches, terminate
+        # half-launches, resume interrupted terminations
+        from karpenter_tpu.controllers.recovery import RecoverySweepController
+
+        self.recovery = RecoverySweepController(
+            self.cluster, self.cloud_provider, self.journal, recorder=self.recorder
+        )
+        # GC's stale-intent janitor shares the recovery replay logic
+        # (constructed above after the provider graph GC already holds)
+        self.garbage_collection.recovery = self.recovery
+
         # leader election: a single active replica runs the sweep; cache
-        # hydration fires on each election win (reference: controller-runtime
-        # election + hydration gated on op.Elected())
+        # hydration AND the recovery sweep fire on EVERY election win
+        # (reference: controller-runtime election + hydration gated on
+        # op.Elected()). Hook order matters: the fence observes the won
+        # epoch FIRST (recovery's cloud mutations must carry it), caches
+        # hydrate, then recovery replays the journal -- all before the
+        # first controller sweep of the new reign.
         from karpenter_tpu.operator.election import LeaderElector
 
         self.elector = LeaderElector(self.cluster, identity) if identity else None
         if self.elector is not None:
+            self.elector.on_elected.append(
+                lambda: self.fence.observe(self.elector.won_epoch))
             self.elector.on_elected.append(self.launch_templates.hydrate)
+            self.elector.on_elected.append(self.recovery.sweep)
+            self._recovery_pending = False
+        else:
+            # elector-less deployments (tests, the kwok rig's default
+            # single replica) still recover: one sweep before the first
+            # controller sweep covers the restart-over-shared-state case
+            self._recovery_pending = True
 
     # -- convenience loop for tests/rig -------------------------------------
     def tick(self) -> bool:
@@ -259,6 +303,19 @@ class Operator:
         binding -> post-launch bookkeeping -> drain/teardown -> GC."""
         if self.elector is not None and not self.elector.tick():
             return False  # standby replica: watch-only until the lease is won
+        if self._recovery_pending:
+            # elector-less path: the election-win hook never fires, so the
+            # journal replay runs once before the first sweep instead. The
+            # fence adopts the bus's CURRENT epoch first -- an elector-less
+            # restart over a bus that still carries an election lease
+            # (epoch >= 1) would otherwise have every cloud mutation
+            # rejected forever. Safe here by construction: without an
+            # elector there is no contention window between read and use
+            # (a later elector-ful replica bumping the epoch fences this
+            # one out exactly as intended).
+            self._recovery_pending = False
+            self.fence.observe(self.fence.current())
+            self.recovery.sweep()
         from karpenter_tpu import tracing
 
         # the sweep is the trace ROOT: every controller's spans (the

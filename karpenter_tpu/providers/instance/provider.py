@@ -31,6 +31,12 @@ MAX_INSTANCE_TYPES = 60  # reference: instance.go:60
 CLUSTER_TAG = "karpenter.tpu/cluster"
 NODECLAIM_TAG = "karpenter.sh/nodeclaim"
 NODEPOOL_TAG = wk.NODEPOOL_LABEL
+# journal idempotency token, threaded from the claim (stamped by
+# IntentJournal.begin_launch via this annotation) into the fleet call's
+# client token -- an annotation rather than a create() parameter so the
+# CloudProvider.create signature stays the reference's. ONE key shared
+# with the instance tag (apis/objects.INTENT_TOKEN_KEY).
+from karpenter_tpu.apis.objects import INTENT_TOKEN_KEY as INTENT_TOKEN_ANNOTATION  # noqa: E402
 
 
 class InstanceProvider:
@@ -43,6 +49,7 @@ class InstanceProvider:
         capacity_reservations=None,
         cluster_name: str = "kwok-cluster",
         batchers=None,
+        fence=None,
     ):
         self.compute_api = compute_api
         self.subnets = subnets
@@ -55,6 +62,12 @@ class InstanceProvider:
         # (instance.go uses ec2Batcher unconditionally); tests may pass None
         # to talk to the API directly
         self.batchers = batchers
+        # optional fencing.Fence: every MUTATING cloud call below checks it
+        # immediately before the wire, so a deposed leader's in-flight
+        # fan-out fails closed (StaleFencingEpochError) instead of
+        # split-braining against the new leader. None = unfenced (tests,
+        # single-replica deployments without election).
+        self.fence = fence
 
     @staticmethod
     def _cloud_seam(fn, *args):
@@ -74,6 +87,8 @@ class InstanceProvider:
             raise CloudError(f"{type(e).__name__}: {e}") from e
 
     def _create_fleet(self, request: FleetRequest):
+        if self.fence is not None:
+            self.fence.check("create_fleet")
         if self.batchers is not None:
             return self._cloud_seam(self.batchers.create_fleet.call, request)
         return self.compute_api.create_fleet(request)
@@ -93,6 +108,8 @@ class InstanceProvider:
         return self.compute_api.describe_instances(ids)
 
     def _terminate(self, ids: Sequence[str]):
+        if self.fence is not None:
+            self.fence.check("terminate_instances")
         if self.batchers is not None:
             return self._cloud_seam(self.batchers.terminate_instances.call, ids)
         return self.compute_api.terminate_instances(ids)
@@ -229,11 +246,17 @@ class InstanceProvider:
         overrides.sort(key=lambda o: o.priority)
         lead_template = template_of[overrides[0].instance_type]
         group_overrides = [o for o in overrides if template_of[o.instance_type] == lead_template]
+        # journal idempotency token (annotation stamped by begin_launch):
+        # rides the fleet call as a client token, OUTSIDE the batcher's
+        # merge hash, so a crash-replayed launch returns the instance the
+        # first attempt minted instead of a double
+        token = claim.metadata.annotations.get(INTENT_TOKEN_ANNOTATION)
         request = FleetRequest(
             launch_template_name=lead_template,
             capacity_type=capacity_type,
             overrides=group_overrides,
             target_capacity=1,
+            client_tokens=(token,) if token else (),
             # ownership tags only -- per-claim tags (nodeclaim name, Name)
             # are stamped post-registration by the tagging controller, which
             # keeps identical launches byte-identical so the fleet batcher
@@ -293,6 +316,17 @@ class InstanceProvider:
         """All instances owned by this cluster (GC resync tag filter)."""
         return self.compute_api.describe_instances(tag_filter={CLUSTER_TAG: self.cluster_name})
 
+    def by_token(self, token: str) -> Optional[CloudInstance]:
+        """The live instance an intent token launched, if any (the recovery
+        sweep's correlation read; the cloud stamps the token tag at
+        launch)."""
+        for inst in self.compute_api.describe_instances(
+            tag_filter={CLUSTER_TAG: self.cluster_name, INTENT_TOKEN_ANNOTATION: token}
+        ):
+            if inst.state not in ("terminated", "shutting-down"):
+                return inst
+        return None
+
     def delete(self, instance_id: str) -> None:
         inst = self._describe([instance_id])
         if not inst:
@@ -304,6 +338,8 @@ class InstanceProvider:
             self.capacity_reservations.mark_terminated(inst[0].capacity_reservation_id)
 
     def create_tags(self, instance_id: str, tags: Dict[str, str]) -> None:
+        if self.fence is not None:
+            self.fence.check("create_tags")
         try:
             self.compute_api.create_tags(instance_id, tags)
         except KeyError:

@@ -31,10 +31,11 @@ def apply(seed: Optional[int]) -> None:
     (None restores the production defaults where they exist). Process
     policy, like the tracer config: the last caller wins."""
     from karpenter_tpu import tracing
-    from karpenter_tpu.apis.objects import seed_object_names
+    from karpenter_tpu.apis.objects import seed_intent_tokens, seed_object_names
     from karpenter_tpu.failpoints import FAILPOINTS
 
     seed_object_names(seed)
+    seed_intent_tokens(seed)
     if seed is not None:
         FAILPOINTS.seed = seed
         tracing.TRACER.configure(rng=seeded_rng("tracing", seed).random)
@@ -48,7 +49,7 @@ def snapshot() -> tuple:
     from karpenter_tpu.failpoints import FAILPOINTS
 
     return (
-        objects._name_rng, FAILPOINTS.seed,
+        objects._name_rng, objects._token_rng, FAILPOINTS.seed,
         tracing.TRACER._rng, tracing.TRACER.enabled, tracing.TRACER.sample,
     )
 
@@ -58,7 +59,8 @@ def restore(token: tuple) -> None:
     from karpenter_tpu.apis import objects
     from karpenter_tpu.failpoints import FAILPOINTS
 
-    name_rng, fp_seed, t_rng, t_enabled, t_sample = token
+    name_rng, token_rng, fp_seed, t_rng, t_enabled, t_sample = token
     objects._name_rng = name_rng
+    objects._token_rng = token_rng
     FAILPOINTS.seed = fp_seed
     tracing.TRACER.configure(enabled=t_enabled, sample=t_sample, rng=t_rng)

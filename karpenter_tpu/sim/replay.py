@@ -112,6 +112,13 @@ class _Engine:
         self._breaker = None
         self._global_snapshot = None
         self.op = None
+        self._options = None
+        # operator incarnation counter: `crash`/`operator_restart` events
+        # rebuild the Operator over the surviving world under a NEW
+        # identity, so the lease must expire, the fencing epoch bumps, and
+        # the recovery sweep runs on the win -- the real restart flow
+        self._generation = 0
+        self.restarts = 0
 
     # -- world construction --------------------------------------------------
     def build(self):
@@ -133,6 +140,7 @@ class _Engine:
             interruption_queue="interruption-queue",
             tracing=False,
         )
+        self._options = options
         breaker_rng = seeding.seeded_rng("breaker", self.seed).random
         if self.backend == "host":
             solver = TPUSolver(g_max=64)
@@ -156,12 +164,56 @@ class _Engine:
                 failure_threshold=2, backoff_base=1000.0, rng=breaker_rng
             )
             solver = TPUSolver(g_max=64, client=self._client, breaker=self._breaker)
-        self.op = Operator(clock=FakeClock(100_000.0), solver=solver, options=options)
+        # identity-based election: replay runs the REAL leadership flow
+        # (lease, fencing epoch, recovery-on-win) so crash/restart events
+        # drive crash -> re-elect -> recover through the production stack
+        self.op = Operator(
+            clock=FakeClock(100_000.0), solver=solver, options=options,
+            identity=f"replay-{self.backend}-0",
+        )
         self.op.cluster.create(TPUNodeClass("default"))
         self.op.cluster.create(NodePool("default"))
         return self.op
 
+    def _restart_operator(self):
+        """Abandon the current operator (its in-flight state dies with it)
+        and build a fresh incarnation over the SAME cluster/cloud/clock --
+        the supervisor-restart a crashed controller pod gets. The solver
+        (and for wire backends the sidecar connection) survives: the
+        sidecar is a separate process that outlives controller restarts.
+
+        The minted-name and intent-token streams are preserved across the
+        rebuild: re-seeding them (Operator re-applies Options.seed) would
+        rewind into names already live on the bus -- a real restart's
+        fresh uuid4 stream cannot collide, so under a seed the stream must
+        continue instead."""
+        from karpenter_tpu.apis import objects
+        from karpenter_tpu.operator import Operator
+
+        old = self.op
+        self._generation += 1
+        self.restarts += 1
+        name_rng, token_rng = objects._name_rng, objects._token_rng
+        self.op = Operator(
+            cloud=old.cloud, clock=old.clock, options=self._options,
+            solver=old.solver, cluster=old.cluster,
+            identity=f"replay-{self.backend}-{self._generation}",
+        )
+        objects._name_rng, objects._token_rng = name_rng, token_rng
+
+    # every crash site a trace may arm (failpoints.py action table); close()
+    # disarms them so an armed-but-unfired site cannot leak into the next
+    # replay of a differential run (the registry is process-global)
+    CRASH_SITES = (
+        "crash.provisioner.dispatch", "crash.launch", "crash.bind",
+        "crash.termination", "crash.recovery",
+    )
+
     def close(self):
+        from karpenter_tpu.failpoints import FAILPOINTS
+
+        for site in self.CRASH_SITES:
+            FAILPOINTS.disarm(site)
         if self._breaker is not None:
             self._breaker.stop()
         if self._client is not None:
@@ -210,9 +262,9 @@ class _Engine:
             zone = node.metadata.labels.get(wk.ZONE_LABEL, "")
             ct = node.metadata.labels.get(wk.CAPACITY_TYPE_LABEL, "")
             if ct == wk.CAPACITY_TYPE_SPOT:
-                p, ok = op.pricing.spot_price(itype, zone)
+                p, ok = self.op.pricing.spot_price(itype, zone)
             else:
-                p, ok = op.pricing.on_demand_price(itype)
+                p, ok = self.op.pricing.on_demand_price(itype)
             return p if ok else 0.0
 
         def check_tick_invariants():
@@ -235,8 +287,20 @@ class _Engine:
         def do_tick(dt: float):
             nonlocal tick_i, fleet_cost, pod_hours, churn, nodes_peak
             nonlocal prev_pod_node, prev_claims, prev_nodes
+            from karpenter_tpu.failpoints import OperatorCrashed
+
             clock.step(dt)
-            op.tick()
+            crashed = ""
+            try:
+                self.op.tick()
+            except OperatorCrashed as e:
+                # the operator died mid-sweep at an armed crash site:
+                # abandon it (whatever was in flight stays exactly as the
+                # crash left it on the bus/cloud) and bring up the next
+                # incarnation -- which must wait out the lease, win with a
+                # bumped fencing epoch, and run the recovery sweep
+                crashed = str(e)
+                self._restart_operator()
             metrics.SIM_TICKS.inc(backend=self.backend)
             # KPI integration over this tick's dt
             nodes = cluster.list(Node)
@@ -278,6 +342,7 @@ class _Engine:
                     {k: v for k, v in ev.items() if k != "node"}
                     for ev in pending_events
                 ],
+                **({"crashed": crashed} if crashed else {}),
                 "claims+": sorted(claims - prev_claims),
                 "claims-": sorted(prev_claims - claims),
                 "nodes+": nodes_add,
@@ -349,8 +414,17 @@ class _Engine:
                 )
             elif kind == "price":
                 cloud.set_price_factor(ev["instance_type"], float(ev["factor"]))
-                op.pricing.update_on_demand_pricing()
-                op.pricing.update_spot_pricing()
+                self.op.pricing.update_on_demand_pricing()
+                self.op.pricing.update_spot_pricing()
+            elif kind == "crash":
+                # arm a one-shot crash at the named production site; the
+                # tick that reaches it dies there (do_tick restarts)
+                from karpenter_tpu.failpoints import FAILPOINTS
+
+                FAILPOINTS.arm(ev["site"], "crash", times=1)
+            elif kind == "operator_restart":
+                # clean restart between ticks (kill -9 while idle)
+                self._restart_operator()
 
         for ev in events:
             apply(validate_event(ev))
@@ -360,7 +434,7 @@ class _Engine:
         # mid-pipeline) or the budget is blown -- non-convergence IS the
         # invariant violation the shrinker minimizes
         for _ in range(MAX_SETTLE_TICKS):
-            if not cluster.pending_pods() and op.provisioner._inflight is None:
+            if not cluster.pending_pods() and self.op.provisioner._inflight is None:
                 break
             do_tick(tick_seconds)
         else:
@@ -384,7 +458,7 @@ class _Engine:
         for _ in range(DRAIN_TICKS):
             do_tick(DRAIN_STEP_SECONDS)
         for _ in range(MAX_SETTLE_TICKS):
-            if not cluster.pending_pods() and op.provisioner._inflight is None:
+            if not cluster.pending_pods() and self.op.provisioner._inflight is None:
                 break
             do_tick(tick_seconds)
         else:

@@ -135,6 +135,21 @@ class ScenarioBuilder:
             self.at(t, {"ev": "kill_node", "pick": int(self.rng.integers(0, 1 << 16))})
         return self
 
+    def operator_crash(self, t: float, site: str = "crash.launch") -> "ScenarioBuilder":
+        """Arm a one-shot crash failpoint: the next tick that reaches
+        `site` abandons the operator mid-flight and the replay engine
+        restarts it over the surviving cluster/cloud state -- the
+        crash-consistency drill (journal + recovery sweep + fencing)."""
+        self.at(t, {"ev": "crash", "site": site})
+        return self
+
+    def operator_restart(self, t: float) -> "ScenarioBuilder":
+        """Clean operator restart between ticks (kill -9 while idle):
+        nothing mid-flight, but caches are cold, the lease must be
+        re-won, and the recovery sweep runs on the win."""
+        self.at(t, {"ev": "operator_restart"})
+        return self
+
     def ice_storm(self, t: float, pools: List[Tuple[str, str, str]],
                   restore_at: Optional[float] = None,
                   restore_count: int = 1_000_000) -> "ScenarioBuilder":
@@ -258,6 +273,22 @@ def _scenario_binpack_adversarial(seed: int) -> ScenarioBuilder:
     return b
 
 
+def _scenario_crash_restart(seed: int) -> ScenarioBuilder:
+    """Crash-consistency drill: a burst arrives, the operator dies
+    mid-launch (open intents + uncommitted instances left behind), a
+    fresh one takes the lease, recovers, and serves a second burst; a
+    clean restart then lands mid-drain of an interruption. Exercised by
+    the crash soak (tests/test_crash_chaos.py), not the differential
+    corpus -- a crash's dead-standby ticks legally shift decisions."""
+    b = ScenarioBuilder("crash-restart", seed)
+    b.poisson_arrivals(start=0.0, duration=10.0, rate_per_s=0.8)
+    b.operator_crash(t=11.0, site="crash.launch")
+    b.poisson_arrivals(start=40.0, duration=8.0, rate_per_s=0.6)
+    b.interruption_wave(t=80.0, count=1)
+    b.operator_restart(t=85.0)
+    return b
+
+
 STANDARD_SCENARIOS = {
     "diurnal-small": _scenario_diurnal_small,
     "diurnal-medium": _scenario_diurnal_medium,
@@ -265,6 +296,7 @@ STANDARD_SCENARIOS = {
     "interruption-wave": _scenario_interruption_wave,
     "spread-burst": _scenario_spread_burst,
     "binpack-adversarial": _scenario_binpack_adversarial,
+    "crash-restart": _scenario_crash_restart,
 }
 
 # the committed corpus (tests/golden/scenarios/): small, fast, and one per

@@ -19,6 +19,18 @@ Event vocabulary (version 1):
     {"ev": "ice", "instance_type": t, "zone": z,
      "capacity_type": "spot", "count": 0}      # (ex|re)haust a capacity pool
     {"ev": "price", "instance_type": t, "factor": 1.5}  # pricing update
+    {"ev": "crash", "site": "crash.launch"}    # arm a one-shot crash
+                                               # failpoint; the next tick
+                                               # that reaches the site dies
+                                               # mid-flight and the engine
+                                               # restarts the operator over
+                                               # the surviving state
+    {"ev": "operator_restart"}                 # clean restart between ticks
+                                               # (kill -9 while idle):
+                                               # fresh operator, new
+                                               # identity, lease takeover
+                                               # after expiry, recovery
+                                               # sweep on the win
 
 `pick` selects a victim deterministically at APPLY time: index into the
 ready fleet ordered by node name (claim names are seed-deterministic, so
@@ -41,7 +53,7 @@ TRACE_VERSION = 1
 
 EVENT_KINDS = (
     "header", "advance", "pod_add", "pod_delete", "kill_node",
-    "interruption", "ice", "price",
+    "interruption", "ice", "price", "crash", "operator_restart",
 )
 
 
@@ -59,6 +71,8 @@ def validate_event(ev: dict, lineno: int = 0) -> dict:
         raise TraceFormatError(f"line {lineno}: advance needs numeric dt")
     if kind == "pod_add" and not isinstance(ev.get("pod"), dict):
         raise TraceFormatError(f"line {lineno}: pod_add needs a pod object")
+    if kind == "crash" and not (isinstance(ev.get("site"), str) and ev["site"]):
+        raise TraceFormatError(f"line {lineno}: crash needs a failpoint site")
     if kind == "header" and ev.get("version") != TRACE_VERSION:
         raise TraceFormatError(
             f"line {lineno}: unsupported trace version {ev.get('version')!r}"

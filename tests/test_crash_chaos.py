@@ -1,0 +1,252 @@
+"""Crash-restart chaos soak: seeded crash schedules through the real stack.
+
+The crash-consistency acceptance gate (journal + recovery sweep + fencing):
+>= 20 seeded crash schedules -- crash sites x scenario shapes, including
+crash-DURING-recovery -- each driven through the sim replay engine, which
+runs the full production operator with identity-based election and
+restarts a fresh incarnation over the surviving world whenever an armed
+`crash` failpoint fires mid-tick. Every schedule must satisfy:
+
+- no pod lost (replay's end-state check: every pod bound at convergence);
+- no instance leaked past one recovery sweep (replay's orphan check, plus
+  GC's stale-intent janitor for out-of-band deletions);
+- no double-launch: provider ids stay unique every tick, and the
+  idempotency-token assert -- no two live instances ever carry the same
+  intent token;
+- `crash`/`operator_restart` replays are byte-deterministic like every
+  other sim event (same trace + seed => identical decision digests).
+
+Old-leader fencing -- a deposed leader's in-flight cloud mutations
+rejected with a stale epoch -- is asserted by the seeded two-replica
+depose schedules below (the sim engine is single-replica by construction,
+so split-brain is driven directly).
+
+On an invariant violation the failing trace is ddmin-shrunk into
+crash-artifacts/ (uploaded by the crash-chaos CI job), mirroring the
+sim-corpus gate's repro discipline.
+"""
+import os
+
+import pytest
+
+from karpenter_tpu.failpoints import FAILPOINTS
+from karpenter_tpu.kwok.cloud import INTENT_TOKEN_TAG
+from karpenter_tpu.sim.replay import InvariantViolation, _Engine
+from karpenter_tpu.sim.scenario import ScenarioBuilder
+
+ARTIFACT_DIR = os.environ.get("KARPENTER_TPU_CRASH_ARTIFACTS", "crash-artifacts")
+
+CRASH_SITES = (
+    "crash.provisioner.dispatch",
+    "crash.launch",
+    "crash.bind",
+    "crash.termination",
+)
+SCENARIO_SHAPES = ("burst", "interrupt", "churn", "double-burst")
+
+
+def build_crash_trace(shape: str, site: str, seed: int, recovery_crash: bool = False):
+    """One seeded crash schedule: a workload shape with a crash armed at
+    `site` while the work is in flight, more work after the restart, and
+    (optionally) a second crash armed at crash.recovery so the NEXT
+    incarnation dies mid-sweep -- crash-during-recovery."""
+    b = ScenarioBuilder(f"crash-{shape}-{site.rsplit('.', 1)[-1]}", seed)
+    b.poisson_arrivals(start=0.0, duration=9.0, rate_per_s=0.9)
+    if shape == "interrupt" or site == "crash.termination":
+        # a termination must be in flight for a crash.termination site to
+        # fire at all: settle the fleet, then land the crash in the drain
+        b.interruption_wave(t=30.0, count=1)
+        b.operator_crash(t=30.5, site=site)
+        recovery_at = 31.0
+    else:
+        # mid-burst, while launches/binds are still in flight -- a crash
+        # armed after the burst settles might never reach its site
+        b.operator_crash(t=4.0, site=site)
+        recovery_at = 4.5
+    if recovery_crash:
+        b.operator_crash(t=recovery_at, site="crash.recovery")
+    if shape == "churn":
+        b.pod_churn(t=40.0, fraction=0.4)
+    if shape == "double-burst":
+        b.poisson_arrivals(start=45.0, duration=6.0, rate_per_s=0.7)
+        b.operator_restart(t=60.0)
+    else:
+        b.poisson_arrivals(start=48.0, duration=5.0, rate_per_s=0.5)
+    return b.build()
+
+
+def _assert_token_uniqueness(cloud):
+    """The idempotency-token assert: no two LIVE instances share an intent
+    token (two would mean a replayed launch minted a double)."""
+    tokens = [
+        i.tags.get(INTENT_TOKEN_TAG)
+        for i in cloud.describe_instances()
+        if i.state == "running" and i.tags.get(INTENT_TOKEN_TAG)
+    ]
+    assert len(tokens) == len(set(tokens)), f"duplicate intent tokens: {tokens}"
+
+
+def _run_schedule(events, seed):
+    engine = _Engine("host", seed)
+    try:
+        engine.build()
+        try:
+            result = engine.run(events)
+        except InvariantViolation:
+            from karpenter_tpu.sim.shrink import invariant_failing, shrink_to_repro
+
+            name = next(
+                (e.get("scenario", "crash") for e in events if e.get("ev") == "header"),
+                "crash",
+            )
+            shrink_to_repro(
+                events, invariant_failing("host", seed), ARTIFACT_DIR,
+                f"{name}-{seed}", max_probes=200,
+            )
+            raise
+        _assert_token_uniqueness(engine.op.cloud)
+        # the schedule's crash actually happened (a soak whose crashes
+        # never fired proves nothing) -- visible as crashed tick lines
+        # and as engine restarts
+        assert engine.restarts >= 1, "schedule never restarted the operator"
+        # no open intents survive convergence + drain: one recovery sweep
+        # (or GC's janitor) resolved everything the crash left behind
+        from karpenter_tpu.apis.objects import ProvisioningIntent
+
+        assert engine.op.cluster.list(ProvisioningIntent) == []
+        return result
+    finally:
+        engine.close()
+
+
+# 4 sites x 4 shapes = 16 schedules...
+@pytest.mark.parametrize("site", CRASH_SITES)
+@pytest.mark.parametrize("shape", SCENARIO_SHAPES)
+def test_crash_schedule(shape, site, failpoints):
+    seed = 9000 + 13 * CRASH_SITES.index(site) + SCENARIO_SHAPES.index(shape)
+    events = build_crash_trace(shape, site, seed)
+    _run_schedule(events, seed)
+
+
+# ...plus 4 crash-DURING-recovery schedules (the second crash lands inside
+# the next incarnation's recovery sweep, which only has work when the
+# first crash left open intents -- hence crash.launch as the base site)
+@pytest.mark.parametrize("shape", SCENARIO_SHAPES)
+def test_crash_during_recovery_schedule(shape, failpoints):
+    seed = 9100 + SCENARIO_SHAPES.index(shape)
+    events = build_crash_trace(shape, "crash.launch", seed, recovery_crash=True)
+    result = _run_schedule(events, seed)
+    # the second crash fired inside a sweep: at least two restarts
+    crash_lines = [l for l in result.decision_log if '"crashed"' in l]
+    assert len(crash_lines) >= 2, "crash-during-recovery never fired"
+
+
+# = 20 schedules total, the acceptance floor.
+
+
+@pytest.mark.parametrize("shape", ("burst", "interrupt"))
+def test_crash_replay_byte_deterministic(shape, failpoints):
+    """`crash`/`operator_restart` replays are byte-deterministic like
+    every other sim event: two runs of one schedule produce identical
+    decision digests (including the crashed tick lines)."""
+    seed = 9200 + SCENARIO_SHAPES.index(shape)
+    events = build_crash_trace(shape, "crash.launch", seed)
+    digests = []
+    for _ in range(2):
+        FAILPOINTS.reset()
+        result = _run_schedule(events, seed)
+        digests.append(result.digest)
+    assert digests[0] == digests[1], "crash replay diverged between runs"
+
+
+class TestOldLeaderFencedOut:
+    """The split-brain half of the acceptance gate, driven directly (the
+    replay engine is single-replica by construction): a deposed leader's
+    in-flight cloud mutations are rejected with a stale fencing epoch."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_deposed_launch_and_terminate_fail_closed(self, seed):
+        import numpy as np
+
+        from karpenter_tpu import metrics
+        from karpenter_tpu.apis import NodeClaim, NodePool, Pod, TPUNodeClass
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.errors import StaleFencingEpochError
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.operator.election import LEASE_DURATION
+        from karpenter_tpu.scheduling import Resources
+
+        rng = np.random.default_rng(4200 + seed)
+        clock = FakeClock(70_000.0)
+        a = Operator(clock=clock, identity=f"lead-{seed}-a")
+        a.cluster.create(TPUNodeClass("default"))
+        a.cluster.create(NodePool("default"))
+        sizes = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi")]
+        for i in range(int(rng.integers(2, 6))):
+            cpu, mem = sizes[int(rng.integers(0, len(sizes)))]
+            a.cluster.create(Pod(f"f-{seed}-{i}", requests=Resources({"cpu": cpu, "memory": mem})))
+        for _ in range(30):
+            a.tick()
+            if not a.cluster.pending_pods():
+                break
+            clock.step(3.0)
+        assert not a.cluster.pending_pods()
+        epoch_a = a.fence.epoch
+
+        b = Operator(cloud=a.cloud, clock=clock, cluster=a.cluster,
+                     identity=f"lead-{seed}-b")
+        clock.step(LEASE_DURATION + 1)
+        assert b.tick() is True
+        assert b.fence.epoch == epoch_a + 1
+
+        # the deposed leader's "in-flight" work lands now: every mutating
+        # cloud path fails closed with the stale epoch
+        rejected_before = sum(
+            metrics.FENCING_REJECTED.value(op=o)
+            for o in ("create_fleet", "terminate_instances", "create_tags")
+        )
+        stale_claim = NodeClaim(f"stale-{seed}")
+        stale_claim.node_class_ref = (
+            a.cluster.get(NodePool, "default").template.node_class_ref
+        )
+        with pytest.raises(StaleFencingEpochError):
+            a.cloud_provider.create(stale_claim)
+        victim = next(c for c in a.cluster.list(NodeClaim) if c.provider_id)
+        with pytest.raises(StaleFencingEpochError):
+            a.cloud_provider.delete(victim)
+        with pytest.raises(StaleFencingEpochError):
+            a.instances.create_tags("i-whatever", {"Name": "stale"})
+        rejected_after = sum(
+            metrics.FENCING_REJECTED.value(op=o)
+            for o in ("create_fleet", "terminate_instances", "create_tags")
+        )
+        assert rejected_after == rejected_before + 3
+        # the new leader's world is untouched by the refused mutations
+        running = [i for i in b.cloud.describe_instances() if i.state == "running"]
+        assert running, "deposed delete went through"
+        for _ in range(5):
+            b.tick()
+            clock.step(3.0)
+        assert not b.cluster.pending_pods()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(4))
+def test_crash_chain_soak_full_length(seed, failpoints):
+    """The long soak: a chain of crash/restart rounds per seed -- every
+    site fires at least once, interleaved with arrivals, churn, an
+    interruption, and a clean restart."""
+    b = ScenarioBuilder(f"crash-chain-{seed}", 9300 + seed)
+    t = 0.0
+    for round_i, site in enumerate(CRASH_SITES + ("crash.recovery",)):
+        b.poisson_arrivals(start=t, duration=6.0, rate_per_s=0.8)
+        if site == "crash.recovery":
+            b.operator_crash(t=t + 7.0, site="crash.launch")
+            b.operator_crash(t=t + 7.5, site=site)
+        else:
+            b.operator_crash(t=t + 7.0, site=site)
+        t += 45.0
+    b.interruption_wave(t=t, count=1)
+    b.operator_restart(t=t + 10.0)
+    b.pod_churn(t=t + 20.0, fraction=0.3)
+    _run_schedule(b.build(), 9300 + seed)

@@ -50,6 +50,79 @@ class TestLeaderElection:
         op.cluster.create(NodePool("default"))
         op.tick()  # must not raise
 
+    def test_hydration_fires_on_every_transition_not_just_first(self):
+        """Win -> lose -> win again: the hooks fire once per WIN (the
+        reference re-hydrates caches on every election win)."""
+        clock = FakeClock(1000.0)
+        a = Operator(clock=clock, identity="replica-a")
+        b = Operator(cloud=a.cloud, clock=clock, cluster=a.cluster,
+                     identity="replica-b")
+        fired = []
+        a.elector.on_elected.append(lambda: fired.append("a"))
+        assert a.elector.tick() is True
+        assert fired == ["a"]
+        # a stops renewing; b takes over; a observes the loss
+        clock.step(LEASE_DURATION + 1)
+        assert b.elector.tick() is True
+        assert a.elector.tick() is False
+        # b dies; a wins AGAIN -- the hook must fire again
+        clock.step(LEASE_DURATION + 1)
+        assert a.elector.tick() is True
+        assert fired == ["a", "a"]
+
+    def test_lease_conflict_loss_mid_tick(self):
+        """A 409 on the renew/acquire write mid-tick (another replica got
+        there first on the shared bus) must surface as NOT leading --
+        never raise, never split-brain."""
+        from karpenter_tpu.kwok.cluster import Conflict
+
+        clock = FakeClock(1000.0)
+        op = Operator(clock=clock, identity="replica-a")
+        assert op.elector.tick() is True
+
+        # the contender's write lands between our read and our update:
+        # emulate by making every update conflict once while a second
+        # elector takes the (expired) lease
+        b = Operator(cloud=op.cloud, clock=clock, cluster=op.cluster,
+                     identity="replica-b")
+        clock.step(LEASE_DURATION + 1)
+        real_update = op.cluster.update
+        state = {"armed": True}
+
+        def racing_update(obj, expect_version=None):
+            from karpenter_tpu.apis.objects import Lease
+
+            if state["armed"] and isinstance(obj, Lease):
+                state["armed"] = False
+                b.elector.tick()  # the contender wins the race first
+                raise Conflict("the write raced another replica (409)")
+            return real_update(obj, expect_version)
+
+        op.cluster.update = racing_update
+        try:
+            assert op.elector.tick() is False, "conflict loser must stand by"
+        finally:
+            op.cluster.update = real_update
+        assert b.elector.elected
+        # exactly one leader; the loser's epoch never advanced
+        assert b.elector.won_epoch > op.elector.won_epoch
+
+    def test_fencing_epoch_bumps_on_takeover(self):
+        """Every takeover bumps the lease's fencing epoch; the new
+        leader's Fence observes it through the on_elected hook."""
+        clock = FakeClock(1000.0)
+        a = Operator(clock=clock, identity="replica-a")
+        b = Operator(cloud=a.cloud, clock=clock, cluster=a.cluster,
+                     identity="replica-b")
+        assert a.elector.tick() is True
+        assert a.elector.won_epoch == 1 and a.fence.epoch == 1
+        clock.step(LEASE_DURATION + 1)
+        assert b.elector.tick() is True
+        assert b.elector.won_epoch == 2 and b.fence.epoch == 2
+        clock.step(LEASE_DURATION + 1)
+        assert a.elector.tick() is True
+        assert a.elector.won_epoch == 3 and a.fence.epoch == 3
+
 
 class TestBootstrapFamilies:
     def _kw(self, user_data=None):

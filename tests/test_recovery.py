@@ -1,0 +1,431 @@
+"""Crash-consistency layer: write-ahead intent journal, restart recovery
+sweep, leadership fencing (karpenter_tpu/journal.py, controllers/recovery.py,
+karpenter_tpu/fencing.py)."""
+import pytest
+
+from karpenter_tpu.apis import NodeClaim, NodePool, Pod, TPUNodeClass
+from karpenter_tpu.apis.objects import Lease, ProvisioningIntent
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.errors import StaleFencingEpochError
+from karpenter_tpu.failpoints import FAILPOINTS, OperatorCrashed
+from karpenter_tpu.kwok.cloud import INTENT_TOKEN_TAG
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.operator.election import LEASE_DURATION
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.utils import parse_instance_id
+
+
+def _world(clock=None, identity="op-a"):
+    op = Operator(clock=clock or FakeClock(10_000.0), identity=identity)
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    return op
+
+
+def _restart(op, identity):
+    """A fresh operator incarnation over the surviving world, past the
+    dead leader's lease."""
+    op.clock.step(LEASE_DURATION + 1)
+    return Operator(cloud=op.cloud, clock=op.clock, cluster=op.cluster,
+                    identity=identity)
+
+
+def _settle(op, max_ticks=30):
+    for _ in range(max_ticks):
+        op.tick()
+        if not op.cluster.pending_pods():
+            return True
+        op.clock.step(3.0)
+    return False
+
+
+def _running(op):
+    return [i for i in op.cloud.describe_instances() if i.state == "running"]
+
+
+class TestJournalProtocol:
+    def test_clean_launch_leaves_no_open_intents(self):
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        assert _settle(op)
+        assert op.cluster.list(ProvisioningIntent) == []
+        claim = op.cluster.list(NodeClaim)[0]
+        # the idempotency token made it onto the instance as a tag
+        inst = _running(op)[0]
+        assert inst.tags.get(INTENT_TOKEN_TAG, "").startswith("it-")
+        assert claim.provider_id
+
+    def test_clean_termination_leaves_no_open_intents(self):
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        assert _settle(op)
+        claim = op.cluster.list(NodeClaim)[0]
+        op.cluster.unbind_pods(claim.node_name)
+        for p in op.cluster.list(Pod):
+            p.metadata.finalizers = []
+            op.cluster.delete(Pod, p.metadata.name)
+        op.cluster.delete(NodeClaim, claim.metadata.name)
+        for _ in range(5):
+            op.tick()
+            op.clock.step(3.0)
+        assert op.cluster.list(ProvisioningIntent) == []
+        assert not _running(op)
+
+    def test_begin_launch_reuses_open_intent_and_token(self):
+        op = _world()
+        claim = NodeClaim("static-1")
+        op.cluster.create(claim)
+        first = op.journal.begin_launch(claim)
+        again = op.journal.begin_launch(claim)
+        assert again.token == first.token
+        assert len(op.cluster.list(ProvisioningIntent)) == 1
+
+
+class TestCrashRecovery:
+    def test_crash_mid_launch_adopts_not_doubles(self, failpoints):
+        """THE crash window: cloud launch landed, claim status commit did
+        not. Recovery must adopt the instance by its token -- one
+        instance, never two."""
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        failpoints.arm("crash.launch", "crash", times=1)
+        with pytest.raises(OperatorCrashed):
+            op.tick()
+        failpoints.reset()
+        assert len(op.cluster.list(ProvisioningIntent)) == 1
+        assert len(_running(op)) == 1
+        claim = op.cluster.list(NodeClaim)[0]
+        assert not claim.provider_id  # the uncommitted status
+
+        op2 = _restart(op, "op-b")
+        assert _settle(op2)
+        assert op2.recovery.last_sweep.get("adopted") == 1
+        assert op2.cluster.list(ProvisioningIntent) == []
+        insts = _running(op2)
+        assert len(insts) == 1, "double launch"
+        claim = op2.cluster.list(NodeClaim)[0]
+        assert parse_instance_id(claim.provider_id) == insts[0].id
+        assert op2.cloud.idempotent_hits == 0
+
+    def test_crash_half_launch_terminated_immediately(self, failpoints):
+        """Instance launched, but its claim is GONE by recovery time: the
+        sweep terminates it NOW -- no 60 s GC grace."""
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        failpoints.arm("crash.launch", "crash", times=1)
+        with pytest.raises(OperatorCrashed):
+            op.tick()
+        failpoints.reset()
+        claim = op.cluster.list(NodeClaim)[0]
+        claim.metadata.finalizers = []
+        op.cluster.delete(NodeClaim, claim.metadata.name)
+        op.cluster.delete(Pod, "p0")
+
+        op2 = _restart(op, "op-b")
+        op2.tick()  # election win runs the sweep; well inside LAUNCH_GRACE
+        assert op2.recovery.last_sweep.get("terminated_half_launch") == 1
+        assert not _running(op2)
+        assert op2.cluster.list(ProvisioningIntent) == []
+
+    def test_crash_before_cloud_mutation_relaunches_idempotently(self, failpoints):
+        """Crash at the provisioner dispatch: intent may not even exist;
+        whatever does exist recovers to a converged world with exactly the
+        capacity the pods need."""
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        failpoints.arm("crash.provisioner.dispatch", "crash", times=1)
+        with pytest.raises(OperatorCrashed):
+            op.tick()
+        failpoints.reset()
+        assert not _running(op)
+        op2 = _restart(op, "op-b")
+        assert _settle(op2)
+        assert len(_running(op2)) == 1
+        assert op2.cluster.list(ProvisioningIntent) == []
+
+    def test_crash_mid_termination_resumes(self, failpoints):
+        """Crash between the cloud delete and the finalizer removal: the
+        terminate intent resumes the teardown on the next incarnation."""
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        assert _settle(op)
+        claim = op.cluster.list(NodeClaim)[0]
+        op.cluster.unbind_pods(claim.node_name)
+        op.cluster.delete(Pod, "p0")
+        op.cluster.delete(NodeClaim, claim.metadata.name)
+        failpoints.arm("crash.termination", "crash", times=1)
+        with pytest.raises(OperatorCrashed):
+            op.tick()
+        failpoints.reset()
+        open_intents = op.cluster.list(ProvisioningIntent)
+        assert [i.op for i in open_intents] == ["terminate"]
+        assert not _running(op)  # the cloud delete DID land
+
+        op2 = _restart(op, "op-b")
+        for _ in range(3):
+            op2.tick()
+            op2.clock.step(3.0)
+        assert op2.cluster.list(ProvisioningIntent) == []
+        assert op2.cluster.list(NodeClaim) == []
+
+    def test_crash_during_recovery_survives_to_next_sweep(self, failpoints):
+        """The sweep itself is crash-safe: a crash mid-replay leaves the
+        unprocessed intents open for the next incarnation."""
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        op.cluster.create(Pod("p1", requests=Resources({"cpu": "3", "memory": "6Gi"})))
+        failpoints.arm("crash.launch", "crash", times=1)
+        with pytest.raises(OperatorCrashed):
+            op.tick()
+        failpoints.reset()
+        n_open = len(op.cluster.list(ProvisioningIntent))
+        assert n_open >= 1
+
+        failpoints.arm("crash.recovery", "crash", times=1)
+        op2 = _restart(op, "op-b")
+        with pytest.raises(OperatorCrashed):
+            op2.tick()  # election win -> recovery sweep -> crash
+        failpoints.reset()
+        # nothing lost: intents the crashed sweep did not resolve survive
+        assert len(op.cluster.list(ProvisioningIntent)) >= n_open - 1
+
+        op3 = _restart(op, "op-c")
+        assert _settle(op3)
+        assert op3.cluster.list(ProvisioningIntent) == []
+        pods = {p.metadata.name for p in op3.cluster.list(Pod) if p.node_name}
+        assert pods == {"p0", "p1"}
+        claims = op3.cluster.list(NodeClaim)
+        pids = [c.provider_id for c in claims if c.provider_id]
+        assert len(pids) == len(set(pids))
+
+
+class TestSweepFaultIsolation:
+    def test_cloud_fault_costs_one_intent_not_the_tick(self, failpoints):
+        """A throttled/erroring cloud during the recovery sweep must cost
+        that intent's replay (left open for the next pass), never the new
+        leader's whole first tick -- recovery is exactly when call volume
+        is highest."""
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        failpoints.arm("crash.launch", "crash", times=1)
+        with pytest.raises(OperatorCrashed):
+            op.tick()
+        failpoints.reset()
+        # half-launch shape: claim gone, instance alive -> replay must
+        # issue a cloud terminate, which we make fail once
+        claim = op.cluster.list(NodeClaim)[0]
+        claim.metadata.finalizers = []
+        op.cluster.delete(NodeClaim, claim.metadata.name)
+        op.cluster.delete(Pod, "p0")
+
+        op2 = _restart(op, "op-b")
+        op2.cloud.inject_errors["terminate_instances"] = [RuntimeError("Throttling")]
+        op2.tick()  # must NOT raise; the faulted intent survives the sweep
+        for _ in range(3):
+            op2.tick()
+            op2.clock.step(3.0)
+        assert op2.cluster.list(ProvisioningIntent) == []
+        assert not _running(op2), "half-launch never terminated after fault"
+
+
+class TestFencing:
+    def test_deposed_leader_cloud_mutations_rejected(self):
+        """The split-brain drill: A elected with epoch 1, B takes over
+        with epoch 2, A's still-in-flight launch and terminate fan-outs
+        fail closed at the cloud seam."""
+        from karpenter_tpu import metrics
+
+        clock = FakeClock(10_000.0)
+        a = _world(clock=clock, identity="op-a")
+        a.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        assert _settle(a)
+        assert a.fence.epoch == 1
+        claim = a.cluster.list(NodeClaim)[0]
+
+        b = Operator(cloud=a.cloud, clock=clock, cluster=a.cluster, identity="op-b")
+        clock.step(LEASE_DURATION + 1)
+        assert b.tick() is True
+        assert b.fence.epoch == 2
+
+        before = metrics.FENCING_REJECTED.value(op="create_fleet")
+        stale = NodeClaim("stale")
+        stale.node_class_ref = a.cluster.get(NodePool, "default").template.node_class_ref
+        with pytest.raises(StaleFencingEpochError):
+            a.cloud_provider.create(stale)
+        assert metrics.FENCING_REJECTED.value(op="create_fleet") == before + 1
+        with pytest.raises(StaleFencingEpochError):
+            a.cloud_provider.delete(claim)
+        # the instance survives the deposed leader's refused delete
+        assert _running(b)
+
+    def test_epoch_bumps_on_takeover_and_expired_reacquire_not_renew(self):
+        clock = FakeClock(10_000.0)
+        op = _world(clock=clock, identity="op-a")
+        op.elector.tick()
+        lease = op.cluster.get(Lease, op.elector.lease_name)
+        assert lease.epoch == 1
+        clock.step(2.0)
+        op.elector.tick()  # renew: no bump
+        assert op.cluster.get(Lease, op.elector.lease_name).epoch == 1
+        # expired re-acquisition by the SAME identity (process restart):
+        # bumps, so the previous incarnation's in-flight work is fenced
+        clock.step(LEASE_DURATION + 1)
+        op.elector.tick()
+        assert op.cluster.get(Lease, op.elector.lease_name).epoch == 2
+
+    def test_fence_checked_inside_batcher_exec(self):
+        """The TOCTOU the provider-level check alone leaves open: a
+        deposition landing while a request waits in the merge window must
+        fail the MERGED call closed -- the executors re-check at the last
+        instant before the wire."""
+        from karpenter_tpu.cloud.types import FleetRequest
+
+        clock = FakeClock(10_000.0)
+        a = _world(clock=clock, identity="op-a")
+        a.elector.tick()
+        b = Operator(cloud=a.cloud, clock=clock, cluster=a.cluster, identity="op-b")
+        clock.step(LEASE_DURATION + 1)
+        assert b.tick() is True
+        # a's request "already passed" the provider check; the executor is
+        # where the stale epoch must still catch it
+        with pytest.raises(StaleFencingEpochError):
+            a.batchers.create_fleet._exec([FleetRequest(
+                launch_template_name="lt", capacity_type="on-demand", overrides=[])])
+        with pytest.raises(StaleFencingEpochError):
+            a.batchers.terminate_instances._exec([("i-1",)])
+
+    def test_elector_less_restart_over_leftover_lease_not_bricked(self):
+        """An elector-less operator restarted over a bus that still
+        carries an election lease (epoch >= 1) adopts the current epoch on
+        its first tick instead of having every mutation rejected."""
+        clock = FakeClock(10_000.0)
+        a = _world(clock=clock, identity="op-a")
+        a.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        assert _settle(a)
+        # restart WITHOUT election over the same world
+        single = Operator(cloud=a.cloud, clock=clock, cluster=a.cluster)
+        single.cluster.create(Pod("p1", requests=Resources({"cpu": "500m"})))
+        assert _settle(single), "elector-less restart bricked by leftover lease"
+        assert single.fence.epoch >= 1
+
+    def test_unfenced_single_replica_is_noop(self):
+        op = Operator(clock=FakeClock(10_000.0))  # no identity, no lease
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        assert _settle(op)  # fence.current() stays 0: never rejects
+
+
+class TestStaleIntentJanitor:
+    def test_launch_error_intent_resolved_same_sweep(self, failpoints):
+        """A failed launch deletes its claim but leaves the intent OPEN (a
+        CloudError does not prove no instance was minted); GC's janitor
+        resolves it in the SAME sweep -- no open-intent accumulation, no
+        leak."""
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        failpoints.arm("instance.launch", "error", "InsufficientCapacityError", times=1)
+        op.tick()
+        failpoints.reset()
+        # the ICE'd launch's intent was replayed by the janitor this tick
+        assert op.cluster.list(ProvisioningIntent) == []
+        assert _settle(op)  # the retry converges once the fault clears
+
+    def test_owner_guard_never_kills_another_claims_instance(self):
+        """An open intent whose token points at an instance a DIFFERENT
+        claim committed (misdealt merged batch) is dropped, never
+        terminated -- killing an owned instance would turn bookkeeping
+        into an outage."""
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        assert _settle(op)
+        inst = _running(op)[0]
+        token = inst.tags[INTENT_TOKEN_TAG]
+        ghost = ProvisioningIntent(
+            "launch-ghost", op=ProvisioningIntent.OP_LAUNCH,
+            claim_name="ghost", token=token)
+        op.cluster.create(ghost)
+        outcome = op.recovery.replay_intent(ghost)
+        assert outcome == "dropped"
+        assert _running(op), "owner's instance was terminated"
+        assert op.cluster.list(ProvisioningIntent) == []
+
+
+class TestIdempotencyTokens:
+    def test_fleet_replay_with_known_token_returns_existing(self):
+        """The cloud-side half of launch-at-most-once: a fleet slot whose
+        client token already backs a live instance returns it."""
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        assert _settle(op)
+        inst = _running(op)[0]
+        token = inst.tags[INTENT_TOKEN_TAG]
+        from karpenter_tpu.cloud.types import FleetOverride, FleetRequest
+
+        lt = op.cloud.describe_launch_templates()[0]
+        req = FleetRequest(
+            launch_template_name=lt.name, capacity_type=inst.capacity_type,
+            overrides=[FleetOverride(
+                instance_type=inst.instance_type, subnet_id=inst.subnet_id,
+                zone=inst.zone)],
+            client_tokens=(token,),
+        )
+        result = op.cloud.create_fleet(req)
+        assert [i.id for i in result.instances] == [inst.id]
+        assert op.cloud.idempotent_hits == 1
+        assert len(_running(op)) == 1
+
+    def test_tokens_survive_checkpoint_restore(self):
+        op = _world()
+        op.cluster.create(Pod("p0", requests=Resources({"cpu": "500m"})))
+        assert _settle(op)
+        blob = op.cloud.checkpoint()
+        op.cloud.restore(blob)
+        inst = _running(op)[0]
+        token = inst.tags[INTENT_TOKEN_TAG]
+        assert op.cloud._fleet_tokens[token] == inst.id
+
+    def test_batched_identical_launches_still_merge(self):
+        """Distinct per-claim tokens must NOT split the fleet batcher's
+        buckets (they ride outside the hash): one merged call serves the
+        whole identical wave."""
+        op = _world()
+        for i in range(6):
+            op.cluster.create(Pod(f"p{i}", requests=Resources({"cpu": "30", "memory": "100Gi"})))
+        assert _settle(op)
+        sizes = op.batchers.create_fleet.batcher.batch_sizes
+        assert max(sizes) > 1, f"identical wave never merged: {sizes}"
+        tokens = [i.tags.get(INTENT_TOKEN_TAG) for i in _running(op)]
+        assert all(tokens) and len(tokens) == len(set(tokens))
+
+
+class TestDebugJournal:
+    def test_describe_lists_open_and_resolved(self):
+        op = _world()
+        claim = NodeClaim("c-1")
+        op.cluster.create(claim)
+        intent = op.journal.begin_launch(claim)
+        doc = op.journal.describe()
+        assert [e["name"] for e in doc["open"]] == [intent.metadata.name]
+        op.journal.resolve(intent, "committed")
+        doc = op.journal.describe()
+        assert doc["open"] == []
+        assert doc["recently_resolved"][-1]["outcome"] == "committed"
+
+    def test_debug_journal_endpoint(self):
+        import json
+        import urllib.request
+
+        from karpenter_tpu.operator.health import HealthServer
+
+        op = _world()
+        srv = HealthServer(port=0).start()
+        try:
+            srv.journal_info = op.journal.describe
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/journal"
+            ) as r:
+                doc = json.loads(r.read())
+            assert doc == {"open": [], "recently_resolved": []}
+        finally:
+            srv.stop()

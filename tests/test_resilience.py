@@ -197,6 +197,39 @@ class TestGarbageCollection:
         # within grace: not collected
         assert env.garbage_collection.reconcile() == []
 
+    def test_uncommitted_claim_spared_at_exact_grace_boundary(self, env):
+        """The round-6 GC race: a NodeClaim whose provider_id has NOT yet
+        committed left its instance unclaimed and eligible exactly at the
+        LAUNCH_GRACE boundary -- GC could collect it in the same tick the
+        provisioner was about to commit. The open journal intent (and the
+        inclusive boundary) must spare it."""
+        from karpenter_tpu.controllers.garbagecollection import LAUNCH_GRACE
+        from karpenter_tpu.failpoints import FAILPOINTS, OperatorCrashed
+
+        env.cluster.create(Pod("pb", requests=Resources({"cpu": "500m"})))
+        # leave the world exactly as the race sees it: instance launched,
+        # claim present, provider_id NOT committed, intent open
+        FAILPOINTS.arm("crash.launch", "crash", times=1)
+        try:
+            with pytest.raises(OperatorCrashed):
+                env.tick()
+        finally:
+            FAILPOINTS.reset()
+        claim = env.cluster.list(NodeClaim)[0]
+        assert not claim.provider_id
+        inst = [i for i in env.cloud.describe_instances() if i.state == "running"][0]
+        # FakeClock pinned to the EXACT boundary: launch age == LAUNCH_GRACE
+        env.clock.step(LAUNCH_GRACE - (env.clock.now() - inst.launch_time))
+        assert env.clock.now() - inst.launch_time == LAUNCH_GRACE
+        assert env.garbage_collection.reconcile() == []
+        insts = [i for i in env.cloud.describe_instances() if i.state == "running"]
+        assert len(insts) == 1, "boundary-aged uncommitted instance was collected"
+        # and PAST the boundary it is still protected -- the open intent
+        # owns it until the recovery sweep adopts (GC is demoted to
+        # out-of-band deletions only)
+        env.clock.step(1.0)
+        assert env.garbage_collection.reconcile() == []
+
 
 class TestTagging:
     def test_name_tag_applied_once(self, env):
