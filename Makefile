@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos sim-corpus
+.PHONY: test deflake benchmark bench-warm bench-wire benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos sim-corpus
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -37,8 +37,11 @@ benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
 bench-warm:  ## warm steady-state delta stage only (incremental tick engine: warm_delta_tick_p50_ms, delta payload bytes, tail_ratio); one JSON line
 	$(PY) bench.py --warm-only > bench_warm_last.json; rc=$$?; cat bench_warm_last.json; exit $$rc
 
-chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count (full-length schedule stays behind -m slow)
-	KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py -q -m 'not slow' $(call STAMP,chaos)
+bench-wire:  ## transport stage only (wire v2: warm_wire_p50/p99_ms shm vs tcp, wire_share_of_tick, reply_bytes_per_solve, copies-per-solve); one JSON line
+	$(PY) bench.py --wire-only > bench_wire_last.json; rc=$$?; cat bench_wire_last.json; exit $$rc
+
+chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count, incl. the shm-transport faults (full-length schedule stays behind -m slow)
+	KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py tests/test_wire.py -q -m 'not slow' $(call STAMP,chaos)
 
 crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection; diverging traces ddmin-shrink into crash-artifacts/ (full-length chain soak stays behind -m slow)
 	KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)

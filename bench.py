@@ -759,6 +759,127 @@ def _warm_delta(pool, items, zones, iters: int) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _wire_stage(pool, items, zones, iters: int) -> dict:
+    """Always-run transport stage (the wire-v2 tentpole's acceptance
+    measurement). The warm steady-state wave from the delta stage drives
+    THREE client configurations against one sidecar on a UNIX socket:
+
+    - shm ring + reply_v2 (the colocated default since wire v2),
+    - tcp socket + reply_v2 (the portable fallback),
+    - tcp socket + v1 replies (the pre-trim reference).
+
+    Fields: warm_wire_p50/p99_ms (the solver's "wire" span: transport +
+    server device + fetch), wire_share_of_tick, the transport-only
+    overhead vs the server's device exec (the ROADMAP target: under 2x
+    device exec on the capture rig), reply_bytes_per_solve v2 vs v1
+    (acceptance: >=3x smaller), and the encode/decode payload-copy
+    counters per solve (acceptance: 0 on the warm delta path)."""
+    import shutil
+    import tempfile
+
+    from karpenter_tpu import metrics, tracing
+    from karpenter_tpu.solver import rpc
+    from karpenter_tpu.solver.oracle import Scheduler
+    from karpenter_tpu.solver.service import TPUSolver
+
+    churn_frac = max(0.001, min(0.10, _env_f("BENCH_CHURN_FRACTION", 0.05)))
+    wave = max(8, int(N_PODS * churn_frac))
+    arrival_templates = min(N_SPEC_TEMPLATES, 40)
+    d = tempfile.mkdtemp(prefix="bench_wire_")
+    sock = os.path.join(d, "solver.sock")
+    srv = None
+    clients = []
+
+    def sched():
+        return Scheduler(
+            nodepools=[pool], instance_types={pool.name: items}, zones=set(zones)
+        )
+
+    def wave_pods(i: int):
+        return synth_pods(np.random.default_rng(1234), zones, wave,
+                          salt=90_000 + i, templates=arrival_templates)
+
+    def copies() -> float:
+        return (metrics.WIRE_PAYLOAD_COPIES.value(side="encode")
+                + metrics.WIRE_PAYLOAD_COPIES.value(side="decode"))
+
+    prev = (tracing.TRACER.enabled, tracing.TRACER.sample,
+            tracing.TRACER.recorder.slow_ms)
+    out: dict = {}
+    try:
+        srv = rpc.SolverServer(path=sock).start()
+        tracing.TRACER.configure(enabled=True, sample=1.0, slow_ms=1e12)
+        for label, kw in (
+            ("shm", {}),
+            ("tcp", {"shm": False}),
+            ("tcp_v1", {"shm": False, "reply_v2": False}),
+        ):
+            client = rpc.SolverClient(path=sock, **kw)
+            clients.append(client)
+            s = TPUSolver(g_max=G_MAX, client=client, incremental=True)
+            # unmeasured warm ticks: compile, stage, establish the delta
+            # epoch, fill the grouping/row caches -- then the copy
+            # counters must stay FLAT across the measured warm ticks
+            for w in (wave_pods(-2), wave_pods(-1)):
+                s.schedule(sched(), w)
+            tracing.TRACER.reset()
+            copies0 = copies()
+            tick_ms, reply_bytes = [], []
+            for i in range(iters):
+                pods = wave_pods(i)
+                t0 = time.perf_counter()
+                # spans only record under a root trace (the provisioner
+                # tick provides one in production)
+                with tracing.TRACER.trace("bench_wire_tick"):
+                    s.schedule(sched(), pods)
+                tick_ms.append((time.perf_counter() - t0) * 1e3)
+                reply_bytes.append(client.last_reply["bytes"])
+            st = tracing.TRACER.stats()
+            wire_p50 = float(st.get("wire", {}).get("p50_ms", 0.0))
+            wire_p99 = float(st.get("wire", {}).get("p99_ms", 0.0))
+            device_p50 = float(st.get("device", {}).get("p50_ms", 0.0))
+            tick_p50 = float(np.percentile(tick_ms, 50))
+            overhead = max(0.0, wire_p50 - device_p50)
+            copies_per_solve = (copies() - copies0) / max(1, iters)
+            prefix = {"shm": "warm_wire", "tcp": "warm_wire_tcp",
+                      "tcp_v1": "warm_wire_v1"}[label]
+            out[f"{prefix}_p50_ms"] = round(wire_p50, 2)
+            out[f"{prefix}_p99_ms"] = round(wire_p99, 2)
+            out[f"{prefix}_tick_p50_ms"] = round(tick_p50, 2)
+            out[f"{prefix}_reply_bytes_per_solve"] = int(np.median(reply_bytes))
+            out[f"{prefix}_copies_per_solve"] = round(copies_per_solve, 3)
+            if label == "shm":
+                out["wire_share_of_tick"] = round(wire_p50 / tick_p50, 3) if tick_p50 else 0.0
+                out["wire_device_p50_ms"] = round(device_p50, 2)
+                out["wire_transport_overhead_p50_ms"] = round(overhead, 2)
+                out["wire_overhead_vs_device_ratio"] = (
+                    round(overhead / device_p50, 2) if device_p50 else 0.0
+                )
+                out["wire_transport_negotiated"] = (
+                    "shm" if client._ring is not None else "tcp"
+                )
+        v2 = out.get("warm_wire_tcp_reply_bytes_per_solve", 0)
+        v1 = out.get("warm_wire_v1_reply_bytes_per_solve", 0)
+        out["reply_bytes_per_solve"] = out.get("warm_wire_reply_bytes_per_solve", v2)
+        out["reply_bytes_reduction_v2"] = round(v1 / v2, 1) if v2 else 0.0
+        # acceptance bool (the tail_ratio pattern): the v2 trimming must
+        # hold its >=3x reply-byte reduction at whatever tier this bench
+        # ran -- a dedup regression at real group counts fails the gate
+        out["reply_bytes_reduction_ok"] = bool(
+            v2 and v1 / v2 >= _env_f("BENCH_REPLY_REDUCTION_MIN", 3.0)
+        )
+        out["wire_shm_ring_full_total"] = int(metrics.WIRE_SHM_RING_FULL.value())
+        return out
+    finally:
+        tracing.TRACER.configure(enabled=prev[0], sample=prev[1], slow_ms=prev[2])
+        tracing.TRACER.reset()
+        for c in clients:
+            c.close()
+        if srv is not None:
+            srv.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _recovery_stage(warm_tick_p50_ms=None, iters: int = 4, k_intents: int = 16) -> dict:
     """Crash-recovery stage (crash-consistency tentpole; ALWAYS runs):
 
@@ -888,7 +1009,8 @@ def _gen2_collections() -> int:
     return int(gc.get_stats()[2].get("collections", 0))
 
 
-def run(profile: bool, progress=lambda ev: None, warm_only: bool = False):
+def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
+        wire_only: bool = False):
     import jax
 
     from karpenter_tpu.apis import NodePool
@@ -935,6 +1057,20 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False):
         out.update(_warm_delta(pool, items, zones,
                                iters=10 if backend != "cpu" else 8))
         out["value"] = out.get("warm_delta_tick_p50_ms", 0.0)
+        stage_fields(out)
+        return out
+    if wire_only:
+        # `make bench-wire`: only the transport stage (plus setup) -- the
+        # fast iteration loop for the wire-v2 layers
+        out = {
+            "metric": f"warm_wire_p50_{N_PODS // 1000}k_pods",
+            "unit": "ms",
+            "mode": "wire_only",
+            "platform": backend,
+        }
+        out.update(_wire_stage(pool, items, zones,
+                               iters=10 if backend != "cpu" else 6))
+        out["value"] = out.get("warm_wire_p50_ms", 0.0)
         stage_fields(out)
         return out
     solver = TPUSolver(g_max=G_MAX)
@@ -1082,6 +1218,17 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False):
     except Exception as e:  # noqa: BLE001
         production["warm_delta_error"] = f"{type(e).__name__}: {e}"[:200]
     progress({"ev": "phase", "name": "warm_delta"})
+    stage_fields(production)
+
+    # wire transport stage (wire-v2 tentpole): ALWAYS runs --
+    # warm_wire_p50/p99_ms, wire_share_of_tick, reply_bytes_per_solve and
+    # the payload-copy counters are headline acceptance data
+    try:
+        production.update(_wire_stage(
+            pool, items, zones, iters=10 if backend != "cpu" else 6))
+    except Exception as e:  # noqa: BLE001
+        production["wire_stage_error"] = f"{type(e).__name__}: {e}"[:200]
+    progress({"ev": "phase", "name": "wire_transport"})
     stage_fields(production)
 
     # crash-recovery stage (crash-consistency tentpole): ALWAYS runs --
@@ -1235,7 +1382,8 @@ def _child_main() -> None:
         # plugin via sitecustomize; the config override wins regardless
         jax.config.update("jax_platforms", "cpu")
     try:
-        out = run(profile, progress, warm_only="--warm-only" in sys.argv)
+        out = run(profile, progress, warm_only="--warm-only" in sys.argv,
+                  wire_only="--wire-only" in sys.argv)
         progress({"ev": "result", "out": out})
         print(json.dumps(out))
     except Exception as e:  # noqa: BLE001 - parent assembles a partial
@@ -1375,6 +1523,8 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
         args.append("--profile")
     if "--warm-only" in sys.argv:
         args.append("--warm-only")
+    if "--wire-only" in sys.argv:
+        args.append("--wire-only")
     proc = subprocess.Popen(
         args, stdout=subprocess.DEVNULL, stderr=None, text=True, env=env
     )

@@ -23,6 +23,10 @@ Actions:
 - ``latency(seconds)``     -- sleep before proceeding.
 - ``corrupt``              -- flip one deterministic byte of a frame at a
   ``corrupt()`` site (the RPC layer's CRC/JSON checks must DETECT it).
+  Byte-stream sites: ``rpc.frame.corrupt`` (any transport's frames) and
+  ``rpc.shm.corrupt`` (frames as written into the shared-memory ring --
+  solver/shm.py); ``rpc.shm.attach`` is the eval-site for ring attach
+  failures (the client degrades to the socket transport).
 - ``drop``                 -- alias of ``error(ConnectionError)`` (a
   connection-drop at stream sites).
 - ``kill_after(N)``        -- pass through N evaluations, then raise on
@@ -121,6 +125,14 @@ class Failpoint:
         # across processes regardless of PYTHONHASHSEED
         self._rng = random.Random(f"{seed}:{site}")
         self._lock = threading.Lock()
+
+    @property
+    def exhausted(self) -> bool:
+        """True once the firing discipline can never fire again (a
+        bounded ``times`` fully spent). Hot paths that pay a toll while a
+        site COULD fire (e.g. the framing layer's joining copy) use this
+        to stop paying once the drill is over."""
+        return self.times is not None and self.fires >= self.times
 
     def _should_fire(self) -> bool:
         with self._lock:
@@ -297,6 +309,17 @@ FAILPOINTS.arm_from_env()
 def eval(site: str) -> None:  # noqa: A001 - the site-evaluation verb
     if FAILPOINTS.armed:
         FAILPOINTS.eval(site)
+
+
+def live(site: str) -> Optional[Failpoint]:
+    """The Failpoint at `site` if it is armed and can still fire, else
+    None -- the gate for hot paths that pay a standing toll (e.g. the
+    framing layer's joining copy) only while a drill could actually
+    land, and stop paying the moment it is spent."""
+    if not FAILPOINTS.armed:
+        return None
+    fp = FAILPOINTS.get(site)
+    return None if fp is None or fp.exhausted else fp
 
 
 def corrupt(site: str, data: bytes) -> bytes:

@@ -338,6 +338,35 @@ SOLVER_STAGED_EVICTIONS = REGISTRY.counter(
     "epochs); an eviction costs the next referencing solve a full restage",
     labels=("kind",),  # catalog | class_epoch
 )
+# wire transport v2 (solver/rpc.py zero-copy framing, solver/shm.py ring)
+WIRE_BYTES = REGISTRY.counter(
+    "karpenter_wire_bytes_total",
+    "Solver wire bytes moved by the framing layer, by direction and "
+    "transport (shm = the shared-memory ring of the colocated sidecar; "
+    "tcp = the socket transport, TCP or UNIX-domain)",
+    labels=("direction", "transport"),  # sent | received x shm | tcp
+)
+WIRE_PAYLOAD_COPIES = REGISTRY.counter(
+    "karpenter_wire_payload_copies_total",
+    "Intermediate payload copies made by the wire framing beyond the "
+    "transport read/write itself (encode = send-side buffer copies before "
+    "the scatter-gather send; decode = receive-side copies past the "
+    "direct-into-tensor read, e.g. the epoch store's copy-on-first-write). "
+    "Zero on the warm delta path by construction -- test-asserted",
+    labels=("side",),  # encode | decode
+)
+WIRE_TRANSPORT = REGISTRY.gauge(
+    "karpenter_wire_transport_in_use",
+    "Active solver wire transport for this client (1 on the active "
+    "transport's series; shm degrades to tcp on attach/corruption failures)",
+    labels=("transport",),  # shm | tcp
+)
+WIRE_SHM_RING_FULL = REGISTRY.counter(
+    "karpenter_wire_shm_ring_full_total",
+    "Shared-memory ring send stalls: a frame waited for the reader to "
+    "free ring space (backpressure events, not errors; a sustained rate "
+    "means the segment is undersized -- see docs/operations.md)",
+)
 # crash-consistency layer: write-ahead intent journal (karpenter_tpu/
 # journal.py), restart recovery sweep (controllers/recovery.py), and
 # leadership fencing (karpenter_tpu/fencing.py)

@@ -42,13 +42,18 @@ from typing import Dict, List, Optional, Tuple
 from karpenter_tpu.sim.trace import pod_from_spec, validate_event
 
 BACKENDS = ("host", "wire", "pipelined")
-# extra named backend accepted by replay()/the CLI (not part of the
-# default differential trio): the wire sidecar with delta class shipping
-# and incremental grouping FORCED on regardless of environment -- the
-# corpus gate replays one scenario through it and fails on any digest
-# divergence from the committed host golden (the delta path's decisions
-# must be bit-identical to a full encode).
-EXTRA_BACKENDS = ("delta",)
+# extra named backends accepted by replay()/the CLI (not part of the
+# default differential trio):
+# - "delta": the wire sidecar with delta class shipping and incremental
+#   grouping FORCED on regardless of environment -- the corpus gate
+#   replays one scenario through it and fails on any digest divergence
+#   from the committed host golden (the delta path's decisions must be
+#   bit-identical to a full encode);
+# - "tcp": the wire sidecar with the shared-memory ring transport FORCED
+#   off (wire backends on a UNIX socket negotiate shm by default since
+#   wire v2, so the trio already exercises the ring; this backend pins
+#   the socket path, proving shm == tcp == host decision digests).
+EXTRA_BACKENDS = ("delta", "tcp")
 
 DEFAULT_TICK_SECONDS = 3.0
 MAX_SETTLE_TICKS = 80
@@ -159,6 +164,9 @@ class _Engine:
             self._client = SolverClient(
                 path=sock, timeout=30.0, connect_timeout=0.5,
                 delta=True if self.backend == "delta" else None,
+                # "tcp" pins the socket transport; everything else takes
+                # the environment default (shm ring on a UNIX socket)
+                shm=False if self.backend == "tcp" else None,
             )
             self._breaker = CircuitBreaker(
                 failure_threshold=2, backoff_base=1000.0, rng=breaker_rng

@@ -56,6 +56,18 @@ TOKEN_ENV = "KARPENTER_TPU_SOLVER_TOKEN"
 # to delta-on whenever the server advertises the feature; "0" forces every
 # solve back to the full class-tensor ship
 DELTA_ENV = "KARPENTER_TPU_DELTA"
+# shared-memory ring transport (solver/shm.py): "0" kills it on either
+# side; "1" forces the client to ask even over TCP (colocated-by-config);
+# unset, the client asks only on a UNIX-socket transport (the colocated
+# sidecar topology the ring exists for)
+SHM_ENV = "KARPENTER_TPU_SHM"
+# trimmed compact replies (reply_v2): "0" forces the v1 dense reply shape
+REPLY_V2_ENV = "KARPENTER_TPU_REPLY_V2"
+# consecutive shm-mode stream failures after which a client stops
+# re-negotiating the ring and stays on the socket transport (the
+# corrupt-shm degrade path: crc failures close the stream; two strikes
+# and the segment is considered bad, not the luck)
+SHM_MAX_FAILURES = 2
 
 # the per-class tensors delta shipping can patch row-wise. node_overhead
 # ([R], whole-set) always ships in full; open_allowed/join_allowed ([C, K]
@@ -99,46 +111,122 @@ MAX_FRAME = 256 * 1024 * 1024
 
 
 # -- framing -----------------------------------------------------------------
+#
+# Round 8 (wire v2): the framing is ZERO-COPY end to end on the hot path.
+# Encode ships C-contiguous tensor buffers as a scatter-gather send
+# (socket.sendmsg / RingEndpoint.sendmsg over memoryviews -- no tobytes(),
+# no join); decode receives straight INTO the final tensor buffers
+# (recv_into over a numpy allocation) and hands out read-only views.
+# Every residual copy is counted into karpenter_wire_payload_copies_total
+# -- the warm delta path's counters read 0, test-asserted.
 
-def _send_frame(sock: socket.socket, header: dict, tensors: Sequence[Tuple[str, np.ndarray]] = ()) -> None:
+
+def _transport(sock) -> str:
+    """Metric label for the wire a frame moved over: 'shm' for ring
+    endpoints (solver/shm.py), 'tcp' for any socket (TCP or UNIX)."""
+    return getattr(sock, "transport_label", "tcp")
+
+
+def _payload_views(tensors: Sequence[Tuple[str, np.ndarray]]):
+    """(byte views, copy count, total bytes) for a frame's payload.
+    C-contiguous arrays (everything the production encode produces) view
+    for free; a non-contiguous tensor pays one copy, counted."""
+    views, copies, nbytes = [], 0, 0
+    for _, a in tensors:
+        c = np.ascontiguousarray(a)
+        if c is not a:
+            copies += 1
+        if c.size == 0:
+            continue  # nothing on the wire; the header still records the shape
+        if c.ndim == 0:
+            c = c.reshape(1)  # 0-d buffers cannot cast; the header keeps shape []
+        views.append(memoryview(c).cast("B"))
+        nbytes += c.nbytes
+    return views, copies, nbytes
+
+
+def _sendmsg_all(sock, bufs) -> None:
+    """Drive a scatter-gather buffer list fully onto the wire (sendmsg
+    may send fewer bytes than offered). Raises NotImplementedError
+    untouched when the socket cannot scatter-gather (TLS) -- nothing has
+    been sent at that point, so the caller's join fallback is safe."""
+    bufs = [b if isinstance(b, memoryview) else memoryview(b) for b in bufs]
+    while bufs:
+        sent = sock.sendmsg(bufs)
+        while bufs and sent >= len(bufs[0]):
+            sent -= len(bufs[0])
+            bufs.pop(0)
+        if bufs and sent:
+            bufs[0] = bufs[0][sent:]
+
+
+def _send_frame(sock, header: dict, tensors: Sequence[Tuple[str, np.ndarray]] = ()) -> None:
     failpoints.eval("rpc.send")
     header = dict(header)
     header["tensors"] = [
         {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)} for name, a in tensors
     ]
-    payload = [np.ascontiguousarray(a).tobytes() for _, a in tensors]
-    if payload:
-        # payload integrity: one crc32 over the concatenated tensor bytes.
-        # A flipped bit in a decision tensor would otherwise decode into a
-        # silently WRONG placement; with the checksum it surfaces as a
-        # ConnectionError and the caller degrades through the ladder to a
-        # recomputed (correct) decision. Old peers ignore the extra header
-        # field; frames from old peers simply skip the check.
+    views, copies, payload_bytes = _payload_views(tensors)
+    if views:
+        # payload integrity: one crc32 STREAMED over the tensor views (no
+        # intermediate concatenation). A flipped bit in a decision tensor
+        # would otherwise decode into a silently WRONG placement; with the
+        # checksum it surfaces as a ConnectionError and the caller degrades
+        # through the ladder to a recomputed (correct) decision. Old peers
+        # ignore the extra header field; frames from old peers skip the check.
         crc = 0
-        for p in payload:
-            crc = zlib.crc32(p, crc)
+        for v in views:
+            crc = zlib.crc32(v, crc)
         header["crc"] = crc
     hb = json.dumps(header).encode()
-    data = b"".join([_LEN.pack(len(hb)), hb] + payload)
-    # chaos site: deterministic single-byte corruption past the length
-    # prefix (failpoints.py); the receiver's JSON/CRC checks must detect it
-    data = failpoints.corrupt("rpc.frame.corrupt", data)
-    sock.sendall(data)
+    prefix = _LEN.pack(len(hb)) + hb
+    if copies:
+        metrics.WIRE_PAYLOAD_COPIES.inc(copies, side="encode")
+    metrics.WIRE_BYTES.inc(
+        len(prefix) + payload_bytes, direction="sent", transport=_transport(sock)
+    )
+    if failpoints.live("rpc.frame.corrupt") is not None:
+        # chaos path: the corrupt site needs the whole frame as one buffer
+        # to flip a deterministic byte past the length prefix; the joining
+        # copy is acceptable while THIS site can still fire (and counted)
+        # -- a drill on an unrelated site, or one already spent, must not
+        # cost the zero-copy path
+        data = failpoints.corrupt("rpc.frame.corrupt", b"".join([prefix] + views))
+        if views:
+            metrics.WIRE_PAYLOAD_COPIES.inc(side="encode")
+        sock.sendall(data)
+        return
+    try:
+        _sendmsg_all(sock, [prefix] + views)
+    except (NotImplementedError, AttributeError):
+        # TLS sockets cannot scatter-gather (and encrypt-copy anyway):
+        # join and send -- the one transport where the copy is inherent
+        if views:
+            metrics.WIRE_PAYLOAD_COPIES.inc(side="encode")
+        sock.sendall(b"".join([prefix] + views))
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
-            raise ConnectionError("peer closed mid-frame")
-        buf.extend(chunk)
+def _recv_exact(sock, n: int) -> bytes:
+    """Header reads share the recv_into discipline of the tensor path:
+    one preallocated buffer filled in place (delta headers carry the
+    dirty-row index list -- KBs at high churn, not worth re-buffering)."""
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
     return bytes(buf)
 
 
-def _recv_frame(
-    sock: socket.socket, limit: int = MAX_FRAME
-) -> Tuple[dict, Dict[str, np.ndarray]]:
+def _recv_exact_into(sock, view: memoryview) -> None:
+    """Fill `view` completely from the wire -- the zero-copy receive: the
+    destination IS the final tensor buffer, there is no intermediate."""
+    got, n = 0, len(view)
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if not r:
+            raise ConnectionError("peer closed mid-frame")
+        got += r
+
+
+def _recv_frame(sock, limit: int = MAX_FRAME) -> Tuple[dict, Dict[str, np.ndarray]]:
     failpoints.eval("rpc.recv")
     (hlen,) = _LEN.unpack(_recv_exact(sock, 4))
     if hlen > limit:
@@ -169,15 +257,100 @@ def _recv_frame(
             # able to make the sidecar allocate unbounded buffers
             if nbytes > limit or total > limit:
                 raise ConnectionError(f"oversized tensor payload ({total} bytes)")
-            raw = _recv_exact(sock, nbytes)
-            crc = zlib.crc32(raw, crc)
-            tensors[spec["name"]] = np.frombuffer(raw, dtype=dtype).reshape(shape)
+            # receive DIRECTLY into the tensor's own allocation -- the
+            # decode-side zero copy -- then hand out a read-only view,
+            # mirroring the frombuffer-over-bytes contract every consumer
+            # (solve inputs, epoch store, reply decode) already tolerates
+            raw = np.empty((nbytes,), dtype=np.uint8)
+            mv = memoryview(raw)
+            _recv_exact_into(sock, mv)
+            crc = zlib.crc32(mv, crc)
+            arr = raw.view(dtype).reshape(shape)
+            arr.flags.writeable = False
+            tensors[spec["name"]] = arr
     except (TypeError, ValueError, KeyError) as e:
         raise ConnectionError(f"corrupt tensor spec: {e}") from None
     want = header.get("crc")
     if want is not None and tensors and crc != int(want):
         raise ConnectionError("frame payload crc mismatch")
+    metrics.WIRE_BYTES.inc(
+        4 + hlen + total, direction="received", transport=_transport(sock)
+    )
     return header, tensors
+
+
+# -- reply trimming (reply_v2) ------------------------------------------------
+#
+# The v1 compact reply ships full g_max-row group tensors and the whole
+# nnz_max sparse budget even though only n_open groups opened and nnz
+# entries are real -- at the 50k tier that is ~120 KB of mostly padding
+# and repetition per solve. reply_v2 (feature-negotiated like solve_delta)
+# ships only the DECISION ROWS: idx/val truncated to the true nnz, and
+# the per-group (survivor mask, zone/captype) rows deduplicated -- FFD
+# opens groups in runs, so consecutive groups repeat the same row; the
+# unique rows plus a per-group index reconstruct the dense form exactly.
+# The client's vectorized reconstruction (expand_reply_v2) rebuilds a
+# CompactDecision bit-identical in every decision-bearing lane, so
+# expand_compact and the whole decode are unchanged downstream.
+
+def _reply_v2_parts(d: Dict[str, np.ndarray]):
+    """(extra header fields, tensor list) for a trimmed v2 reply, from
+    the fetched CompactDecision arrays by field name."""
+    idx = np.atleast_1d(np.asarray(d["idx"]))
+    val = np.atleast_1d(np.asarray(d["val"]))
+    unplaced = np.atleast_1d(np.asarray(d["unplaced"]))
+    nnz = int(np.asarray(d["nnz"]).reshape(()))
+    n_open = int(np.asarray(d["n_open"]).reshape(()))
+    hdr = {"v": 2, "nnz": nnz, "n_open": n_open}
+    if nnz > idx.shape[0]:
+        # sparse-budget overflow: the compact decision is incomplete
+        # either way; ship no tensors and let the client's dense-refetch
+        # ladder take over (expand_compact returns None on nnz > len(idx))
+        return hdr, []
+    gmask_bits = np.asarray(d["gmask_bits"])[:n_open]
+    gzc = np.asarray(d["gzc"])[:n_open]
+    rows = np.concatenate([gmask_bits, gzc[:, None]], axis=1)
+    uniq, gid = np.unique(rows, axis=0, return_inverse=True)
+    tensors = [
+        ("idx", idx[:nnz]), ("val", val[:nnz]), ("unplaced", unplaced),
+        ("uniq", np.ascontiguousarray(uniq)),
+        ("gid", np.ascontiguousarray(gid.reshape(-1).astype(np.int32))),
+    ]
+    return hdr, tensors
+
+
+def expand_reply_v2(header: dict, t: Dict[str, np.ndarray], g_max: int):
+    """Vectorized client-side reconstruction of a v2 reply into a
+    CompactDecision (numpy leaves). Group rows rebuild as one fancy-index
+    over the unique-row table plus zero padding to g_max (decode never
+    reads past n_open). An overflow reply reconstructs with an empty idx,
+    which expand_compact maps to None -- the existing dense-refetch
+    ladder, unchanged."""
+    from karpenter_tpu.solver import ffd
+
+    nnz = int(header["nnz"])
+    n_open = int(header["n_open"])
+    if "idx" not in t:  # overflow: no tensors shipped
+        return ffd.CompactDecision(
+            idx=np.empty((0,), np.int32), val=np.empty((0,), np.int32),
+            nnz=np.int32(max(nnz, 1)), unplaced=np.empty((0,), np.int32),
+            n_open=np.int32(n_open), gmask_bits=np.empty((0, 0), np.uint32),
+            gzc=np.empty((0,), np.uint32),
+        )
+    uniq = np.asarray(t["uniq"])
+    gid = np.asarray(t["gid"]).reshape(-1)
+    kw = max(uniq.shape[1] - 1, 0)
+    gmask_bits = np.zeros((g_max, kw), dtype=np.uint32)
+    gzc = np.zeros((g_max,), dtype=np.uint32)
+    if n_open:
+        rows = uniq[gid]
+        gmask_bits[:n_open] = rows[:, :kw]
+        gzc[:n_open] = rows[:, kw]
+    return ffd.CompactDecision(
+        idx=t["idx"], val=t["val"], nnz=np.int32(nnz),
+        unplaced=t["unplaced"], n_open=np.int32(n_open),
+        gmask_bits=gmask_bits, gzc=gzc,
+    )
 
 
 # -- server ------------------------------------------------------------------
@@ -203,7 +376,30 @@ class SolverServer:
         path: Optional[str] = None, token: Optional[str] = None,
         insecure_tcp: bool = False, ssl_context=None,
         handshake_timeout: float = 30.0,
+        shm: Optional[bool] = None, shm_size: Optional[int] = None,
+        shm_dir: Optional[str] = None,
     ):
+        from karpenter_tpu.solver import shm as shm_mod
+
+        # shared-memory ring transport (solver/shm.py): advertised in ping
+        # features and established per connection via the shm_open op.
+        # Default on (the client only asks when IT decides the topology is
+        # colocated); $KARPENTER_TPU_SHM=0 or shm=False kills the advert.
+        if shm is None:
+            shm = os.environ.get(SHM_ENV, "1") != "0"
+        self._shm_enabled = bool(shm)
+        self._shm_size = shm_size or shm_mod.ring_size()
+        self._shm_dir = shm_dir
+        # crash janitor: unlink ring segments whose creator pid is dead
+        # (a SIGKILL'd sidecar cannot clean after itself) -- the
+        # transport-level analogue of the restart recovery sweep. Runs
+        # even with shm disabled: restarting with the kill switch set is
+        # exactly the post-incident move that must not strand segments.
+        shm_mod.cleanup_stale(self._shm_dir)
+        # live per-connection ring segments: stop() flags them closed so a
+        # handler blocked in a ring wait wakes and tears down (the listener
+        # close alone cannot reach it)
+        self._live_segs: set = set()
         self._staged: Dict[str, _StagedEntry] = {}
         # class-tensor epochs (solve_delta): epoch id -> {name: np array},
         # the full class tensor set as of that epoch, patched row-wise by
@@ -239,6 +435,12 @@ class SolverServer:
                 # Pre-auth frames are capped at 4 KB -- an unauthenticated
                 # peer must not be able to force MAX_FRAME allocations.
                 authed = outer._token is None
+                # the frame wire for this connection: starts as the
+                # socket; a successful shm_open handshake swaps in the
+                # ring endpoint (the socket stays open as the liveness
+                # anchor and the teardown signal)
+                wire = self.request
+                seg = None
                 try:
                     if ssl_context is not None:
                         # handshake in THIS per-connection thread, never in
@@ -249,13 +451,14 @@ class SolverServer:
                             self.request, server_side=True
                         )
                         self.request.settimeout(None)
+                        wire = self.request
                     while True:
                         # chaos site: a connection-drop here closes the
                         # stream mid-conversation (the handler's except
                         # path), the wedge/kill shapes the chaos soak arms
                         failpoints.eval("rpc.server.conn")
                         header, tensors = _recv_frame(
-                            self.request,
+                            wire,
                             limit=MAX_FRAME if authed else 4096,
                         )
                         op = header.get("op")
@@ -265,20 +468,31 @@ class SolverServer:
                                 supplied, outer._token
                             ):
                                 authed = True
-                                _send_frame(self.request, {"ok": True})
+                                _send_frame(wire, {"ok": True})
                                 continue
                             _send_frame(
-                                self.request, {"ok": False, "error": "unauthenticated"}
+                                wire, {"ok": False, "error": "unauthenticated"}
                             )
                             return
                         if not authed:
                             _send_frame(
-                                self.request, {"ok": False, "error": "unauthenticated"}
+                                wire, {"ok": False, "error": "unauthenticated"}
                             )
                             return
-                        outer._dispatch(self.request, header, tensors)
+                        if op == "shm_open":
+                            wire, seg = outer._op_shm_open(self.request, wire, seg)
+                            continue
+                        outer._dispatch(wire, header, tensors)
                 except (ConnectionError, OSError, ValueError):
                     return
+                finally:
+                    if seg is not None:
+                        # per-connection segment: unlink with the stream
+                        # (a crashed server's leftovers are swept by the
+                        # cleanup_stale janitor at the next start)
+                        with outer._lock:
+                            outer._live_segs.discard(seg)
+                        seg.destroy()
 
         if path is not None:
             class Server(socketserver.ThreadingUnixStreamServer):
@@ -315,6 +529,12 @@ class SolverServer:
         return self
 
     def stop(self) -> None:
+        with self._lock:
+            segs = list(self._live_segs)
+        for seg in segs:
+            # both closed flags: wake EITHER side's ring wait so the
+            # handler unblocks, tears down, and unlinks the segment
+            seg.set_closed_flags()
         self._server.shutdown()
         self._server.server_close()
 
@@ -339,10 +559,10 @@ class SolverServer:
                 # back -- e.g. taint-gated merged batches to the oracle
                 # (service._try_solve_merged) rather than silently packing
                 # without the join_allowed gate
-                _send_frame(
-                    sock,
-                    {"ok": True, "features": ["join_allowed", "trace_echo", "solve_delta"]},
-                )
+                features = ["join_allowed", "trace_echo", "solve_delta", "reply_v2"]
+                if self._shm_enabled:
+                    features.append("shm")
+                _send_frame(sock, {"ok": True, "features": features})
             elif op == "stage":
                 self._op_stage(sock, header, tensors)
             elif op == "solve":
@@ -357,6 +577,46 @@ class SolverServer:
                 _send_frame(sock, {"ok": False, "error": f"unknown op {op!r}"})
         except Exception as e:  # noqa: BLE001 -- errors cross the wire
             _send_frame(sock, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+    def _op_shm_open(self, sock, wire, seg):
+        """Transport-level handshake for the shared-memory ring (handled
+        in the connection loop, not _dispatch: it rebinds the wire). The
+        server creates a per-connection segment, names it over the
+        SOCKET, and switches to the ring only after the client confirms
+        its attach with shm_ready -- an attach failure (missing /dev/shm,
+        permissions, injected rpc.shm.attach fault) leaves both peers on
+        the socket with the stream intact. Returns (wire, seg)."""
+        from karpenter_tpu.solver import shm as shm_mod
+
+        if seg is not None or wire is not sock or not self._shm_enabled:
+            _send_frame(wire, {"ok": False, "error": "shm-unavailable"})
+            return wire, seg
+        try:
+            new_seg = shm_mod.ShmSegment.create(self._shm_size, self._shm_dir)
+        except OSError as e:
+            _send_frame(sock, {"ok": False, "error": f"shm-create: {e}"})
+            return wire, seg
+        try:
+            _send_frame(sock, {"ok": True, "path": new_seg.path, "size": new_seg.size})
+            # shm_ready rides the socket, BOUNDED: a client that dies (or
+            # hangs) mid-handshake must neither pin this thread nor leak
+            # the segment -- cleanup_stale cannot reclaim it while this
+            # server's pid is alive
+            prev_timeout = sock.gettimeout()
+            sock.settimeout(self._handshake_timeout)
+            try:
+                header, _ = _recv_frame(sock)
+            finally:
+                sock.settimeout(prev_timeout)
+        except BaseException:
+            new_seg.destroy()
+            raise
+        if header.get("op") == "shm_ready" and header.get("ok"):
+            with self._lock:
+                self._live_segs.add(new_seg)
+            return new_seg.endpoint("server", liveness=sock), new_seg
+        new_seg.destroy()
+        return sock, None
 
     def _op_stage(self, sock, header: dict, t: Dict[str, np.ndarray]) -> None:
         seqnum = str(header["seqnum"])
@@ -417,9 +677,22 @@ class SolverServer:
     def _resolve_epoch(self, sock, header: dict, t: Dict[str, np.ndarray]):
         """The full class tensor dict for this solve_delta request, staged
         under header["epoch"], or None after sending the unknown-epoch
-        error. Patching happens on a private copy outside the lock; the
-        stored epoch dicts are never mutated in place (a concurrent solve
-        reading a base must see a consistent snapshot)."""
+        error.
+
+        Round 8 (wire v2): epoch staging is COPY-FREE on the warm path.
+        A full ship stores the received read-only frame views as-is (no
+        defensive copy -- the old rpc.py:444 copy existed only so later
+        deltas could patch, and patching now copies on FIRST write
+        instead). A delta patch mutates its chain's base IN PLACE --
+        O(dirty rows), counted zero payload copies -- which is sound
+        because an epoch chain has exactly one writer: epoch ids are
+        client-unique (uuid prefix) and one connection's requests are
+        served strictly in order, so no concurrent reader of the base
+        exists by construction. The one residual copy (per tensor, once,
+        at the first patch after a full ship -- read-only view to
+        writable array) is counted into
+        karpenter_wire_payload_copies_total{side="decode"}; the warm
+        steady state after it reads 0, test-asserted."""
         epoch = str(header["epoch"])
         base = header.get("base")
         ent = None
@@ -433,17 +706,25 @@ class SolverServer:
             if ent is None:
                 _send_frame(sock, {"ok": False, "error": "unknown-epoch"})
                 return None
-            full = {name: arr.copy() for name, arr in ent.items()}
+            full = dict(ent)
             rows = np.asarray([int(r) for r in header.get("rows", ())], dtype=np.int64)
             for name, arr in t.items():
                 if name not in PER_CLASS_TENSORS:
-                    full[name] = np.array(arr)  # whole-set tensors replace
+                    full[name] = arr  # whole-set tensors replace wholesale
                 elif rows.size:
-                    full[name][rows] = arr
+                    cur = full[name]
+                    if not cur.flags.writeable:
+                        # copy-on-first-write: the base still holds the
+                        # full ship's read-only frame views
+                        cur = np.array(cur)
+                        metrics.WIRE_PAYLOAD_COPIES.inc(side="decode")
+                    cur[rows] = arr
+                    full[name] = cur
         else:
-            # frombuffer tensors are read-only views over the frame; own
-            # writable copies so later deltas can patch them
-            full = {name: np.array(arr) for name, arr in t.items()}
+            # the received tensors are read-only views over their own
+            # receive buffers; store them directly -- later deltas
+            # copy-on-first-write (above), so no defensive copy here
+            full = dict(t)
         with self._lock:
             if base is not None:
                 # the patched base is superseded: each client chain diffs
@@ -556,6 +837,13 @@ class SolverServer:
         with wt.stage("fetch"):
             arrays = jax.device_get(tuple(dec))
         names = ffd.CompactDecision._fields
+        if int(header.get("reply", 1)) >= 2:
+            # reply trimming (reply_v2): only the decision rows ship --
+            # idx/val cut to the true nnz, group rows deduplicated; the
+            # client reconstructs the dense form bit-identically
+            hdr2, tensors2 = _reply_v2_parts(dict(zip(names, arrays)))
+            _send_frame(sock, {"ok": True, **hdr2, **wt.echo()}, tensors2)
+            return
         _send_frame(
             sock, {"ok": True, **wt.echo()},
             [(n, np.atleast_1d(np.asarray(a))) for n, a in zip(names, arrays)],
@@ -588,11 +876,14 @@ class _PendingReply:
     the staged catalog the request referenced -- the claim side drops the
     matching delta base on staging-gap errors."""
 
-    __slots__ = ("outcome", "seqnum")
+    __slots__ = ("outcome", "seqnum", "g_max")
 
-    def __init__(self, seqnum: str = ""):
+    def __init__(self, seqnum: str = "", g_max: int = 0):
         self.outcome = None
         self.seqnum = seqnum
+        # the request's group budget: a reply_v2 reconstruction needs it
+        # to rebuild the dense g_max-row group tensors client-side
+        self.g_max = g_max
 
 
 class SolverClient:
@@ -607,9 +898,41 @@ class SolverClient:
         server_hostname: Optional[str] = None,
         connect_timeout: float = DEFAULT_CONNECT_TIMEOUT,
         delta: Optional[bool] = None,
+        shm: Optional[bool] = None, reply_v2: Optional[bool] = None,
+        track_transport: bool = True,
     ):
         self.addr = (host, port) if path is None else None
         self.path = path
+        # karpenter_wire_transport_in_use is process-global: only the
+        # PRIMARY client (the solver's real wire) reports to it. Throwaway
+        # connections -- the breaker's half-open probe, ad-hoc tooling --
+        # pass False so they never clobber the operator's degrade signal.
+        self._track_transport = bool(track_transport)
+        # shared-memory ring transport (solver/shm.py): negotiated per
+        # connection when the server advertises it. Default: ask only on
+        # a UNIX-socket transport (the colocated-sidecar topology -- a
+        # remote TCP sidecar cannot share memory); $KARPENTER_TPU_SHM=1
+        # forces the ask over TCP (colocated-by-config), =0 kills it.
+        # The socket stays the portable fallback: attach failures keep
+        # the connection on it, and SHM_MAX_FAILURES consecutive shm
+        # stream failures (e.g. crc mismatches from a corrupt segment)
+        # stop the client re-negotiating -- the automatic degrade to TCP.
+        if shm is None:
+            env = os.environ.get(SHM_ENV)
+            shm = (path is not None) if env is None else env != "0"
+        self.shm = bool(shm) and ssl_context is None
+        self._shm_failures = 0
+        self._ring = None          # live RingEndpoint (shm mode)
+        self._ring_seg = None      # its segment mapping
+        self._wire = None          # the frame wire: ring or socket
+        # trimmed compact replies (reply_v2): on when the server
+        # advertises the feature; $KARPENTER_TPU_REPLY_V2=0 kills
+        if reply_v2 is None:
+            reply_v2 = os.environ.get(REPLY_V2_ENV, "1") != "0"
+        self.reply_v2 = bool(reply_v2)
+        # reply observability for the LAST decision decoded (bench reads
+        # it): payload bytes on the wire and the reply shape version
+        self.last_reply = {"bytes": 0, "v": 0}
         # timeout = the per-solve READ budget; connect_timeout bounds
         # connection establishment (connect + TLS + auth). They were one
         # knob before, which made a dead sidecar cost the full solve
@@ -658,12 +981,14 @@ class SolverClient:
         # buffers latency (and decisions) without adding overlap
         self.MAX_INFLIGHT = 2
 
-    def _conn(self) -> socket.socket:
+    def _conn(self):
+        """The frame wire for this connection: the shared-memory ring
+        endpoint when negotiation succeeded, the socket otherwise."""
         if self._sock is None:
             failpoints.eval("rpc.client.connect")
             # the WHOLE establishment sequence (connect, TLS handshake,
-            # auth roundtrip) runs under connect_timeout; only then does
-            # the socket get the long per-solve read budget
+            # auth roundtrip, shm negotiation) runs under connect_timeout;
+            # only then does the wire get the long per-solve read budget
             if self.path is not None:
                 sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
                 sock.settimeout(self.connect_timeout)
@@ -676,6 +1001,7 @@ class SolverClient:
                         sock, server_hostname=self._server_hostname
                     )
             self._sock = sock
+            self._wire = sock
             self._staged_seqnums.clear()
             try:
                 if self.token:
@@ -685,12 +1011,81 @@ class SolverClient:
                     header, _ = _recv_frame(sock)
                     if not header.get("ok"):
                         raise ConnectionError("solver auth rejected")
+                if self.shm and self._shm_failures < SHM_MAX_FAILURES:
+                    self._try_shm(sock)
             except (ConnectionError, OSError):
                 sock.close()
                 self._sock = None
+                self._wire = None
                 raise
             sock.settimeout(self.timeout)
-        return self._sock
+            if self._ring is not None:
+                self._ring.settimeout(self.timeout)
+            if self._track_transport:
+                metrics.WIRE_TRANSPORT.set(
+                    1.0 if self._ring is not None else 0.0, transport="shm"
+                )
+                metrics.WIRE_TRANSPORT.set(
+                    0.0 if self._ring is not None else 1.0, transport="tcp"
+                )
+        return self._wire
+
+    def _try_shm(self, sock) -> None:
+        """Negotiate the shared-memory ring on a fresh connection. Every
+        failure mode leaves the SOCKET stream intact and usable:
+        - an injected rpc.shm.attach fault or a local attach failure fires
+          BEFORE/AFTER complete roundtrips, and shm_ready(ok=False) tells
+          the server to unlink the segment and stay on the socket;
+        - a server without the op answers with an error frame ("unknown
+          op"), which reads as a refusal."""
+        from karpenter_tpu.solver import shm as shm_mod
+
+        try:
+            failpoints.eval("rpc.shm.attach")
+        except (ConnectionError, OSError, RuntimeError):
+            return  # injected attach failure: stay on the socket
+        _send_frame(sock, {"op": "shm_open"})
+        header, _ = _recv_frame(sock)
+        if not header.get("ok") or "path" not in header:
+            return  # refused / old server: the socket is the transport
+        try:
+            seg = shm_mod.ShmSegment.attach(str(header["path"]), int(header["size"]))
+        except (shm_mod.ShmAttachError, ValueError, KeyError,
+                ConnectionError, OSError, RuntimeError):
+            # the wide net matters: attach re-evals the rpc.shm.attach
+            # failpoint, and an injected ConnectionError must degrade to
+            # the socket here, not tear down the whole connection
+            _send_frame(sock, {"op": "shm_ready", "ok": False})
+            return
+        try:
+            _send_frame(sock, {"op": "shm_ready", "ok": True})
+        except BaseException:
+            # the socket died between attach and ready: the segment was
+            # never adopted (self._ring_seg unset), so close the mapping
+            # here or its fd leaks for the life of the process under a
+            # reconnect storm against a crashing sidecar
+            seg.close()
+            raise
+        self._ring_seg = seg
+        self._ring = seg.endpoint("client", liveness=sock, timeout=self.connect_timeout)
+        self._wire = self._ring
+
+    def _wire_failed(self, exc: Optional[BaseException] = None) -> None:
+        """Stream-failure accounting for the shm degrade ladder: failures
+        WHILE the ring was the wire count toward SHM_MAX_FAILURES (after
+        which reconnects stay on the socket); socket failures do not.
+        Neither does a peer found ALREADY dead before the frame went onto
+        the ring (ShmPeerGoneError) -- every reconnect gets a fresh
+        segment, so a crash-looping sidecar must not permanently cost the
+        ring. Failures once bytes are in flight DO count: a server hangs
+        up on a corrupt stream, so a reply-wait EOF is ambiguous with
+        corruption, and crc/decode failures and wedged-peer timeouts are
+        direct evidence."""
+        from karpenter_tpu.solver import shm as shm_mod
+
+        if self._ring is None or isinstance(exc, shm_mod.ShmPeerGoneError):
+            return
+        self._shm_failures += 1
 
     def close(self) -> None:
         with self._lock:
@@ -700,6 +1095,16 @@ class SolverClient:
                 if h.outcome is None:
                     h.outcome = ("err", ConnectionError("connection closed with reply in flight"))
             self._pending.clear()
+            if self._ring is not None:
+                self._ring.close()      # sets the client-closed flag
+                self._ring = None
+            if self._ring_seg is not None:
+                self._ring_seg.close()  # unmap only; the server unlinks
+                self._ring_seg = None
+            self._wire = None
+            if self._track_transport:
+                metrics.WIRE_TRANSPORT.set(0.0, transport="shm")
+                metrics.WIRE_TRANSPORT.set(0.0, transport="tcp")
             if self._sock is not None:
                 self._sock.close()
                 self._sock = None
@@ -725,11 +1130,14 @@ class SolverClient:
             head = self._pending[0]
             if head.outcome is None:
                 try:
-                    header, tensors = _recv_frame(self._sock)
+                    header, tensors = _recv_frame(self._wire)
                     head.outcome = ("ok", header, tensors)
+                    if self._ring is not None:
+                        self._shm_failures = 0
                 except (ConnectionError, OSError) as e:
                     # the stream is unrecoverable mid-pipeline: every
                     # outstanding reply is lost with it
+                    self._wire_failed(e)
                     for h in self._pending:
                         if h.outcome is None:
                             h.outcome = ("err", e)
@@ -780,17 +1188,19 @@ class SolverClient:
             # solve_delta op and return only the dirty rows (feature-gated;
             # full ship otherwise -- the server reassembles identically)
             tensors = self._delta_request(seqnum, class_set, header)
+            self._maybe_reply_v2(header)
             sock = self._conn()
             try:
                 _send_frame(sock, header, tensors)
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as e:
                 # a PARTIAL frame may be on the wire: the stream is
                 # desynchronized, and a later synchronous fallback would
                 # write its frame into the torn one's remainder -- close
                 # so that fallback reconnects onto a clean stream
+                self._wire_failed(e)
                 self.close()
                 raise
-            handle = _PendingReply(seqnum)
+            handle = _PendingReply(seqnum, g_max=g_max)
             self._pending.append(handle)
             return handle
 
@@ -824,10 +1234,35 @@ class SolverClient:
         # this claim (the solver's "wire" span); the echo's trace context
         # links back to the dispatching tick when that differs
         tracing.TRACER.graft(header)
+        return self._compact_from_reply(header, out, handle.g_max)
+
+    def _compact_from_reply(self, header: dict, out: Dict[str, np.ndarray],
+                            g_max: int) -> "ffd.CompactDecision":
+        """A CompactDecision from a solve reply of either shape (v1 dense
+        or v2 trimmed), recording the reply's wire payload bytes."""
+        self.last_reply = {
+            "bytes": int(sum(a.nbytes for a in out.values())),
+            "v": int(header.get("v", 1)),
+        }
+        if int(header.get("v", 1)) >= 2:
+            return expand_reply_v2(header, out, g_max)
         fields = {n: out[n] for n in ffd.CompactDecision._fields}
         fields["nnz"] = fields["nnz"].reshape(())
         fields["n_open"] = fields["n_open"].reshape(())
         return ffd.CompactDecision(**fields)
+
+    def _maybe_reply_v2(self, header: dict) -> None:
+        """Request the trimmed reply shape when the op supports it and
+        the server advertises the feature (cached per connection -- the
+        probe rides the same ping `features()` already uses)."""
+        if not self.reply_v2 or header.get("op") not in ("solve_compact", "solve_delta"):
+            return
+        try:
+            if "reply_v2" in self.features():
+                header["reply"] = 2
+        except (ConnectionError, OSError):
+            # let the solve's own send surface the connection state
+            pass
 
     def features(self) -> frozenset:
         """Server feature set, probed once per connection via ping (an
@@ -850,12 +1285,27 @@ class SolverClient:
             sock = self._conn()
             try:
                 _send_frame(sock, header, tensors)
-                return _recv_frame(sock)
-            except (ConnectionError, OSError):
+                out = _recv_frame(sock)
+                if self._ring is not None:
+                    self._shm_failures = 0
+                return out
+            except (ConnectionError, OSError) as e:
+                self._wire_failed(e)
                 self.close()  # one reconnect attempt per call
                 sock = self._conn()
-                _send_frame(sock, header, tensors)
-                return _recv_frame(sock)
+                try:
+                    _send_frame(sock, header, tensors)
+                    out = _recv_frame(sock)
+                except (ConnectionError, OSError) as e2:
+                    # the retry leg's stream failures count toward the shm
+                    # degrade ladder too, or a persistently corrupt ring
+                    # takes twice the documented failures to stick to tcp
+                    self._wire_failed(e2)
+                    self.close()  # leave a clean slate for the next call
+                    raise
+                if self._ring is not None:
+                    self._shm_failures = 0
+                return out
 
     def ping(self) -> bool:
         header, _ = self._roundtrip({"op": "ping"})
@@ -1045,12 +1495,14 @@ class SolverClient:
                 self.stage_catalog(seqnum, catalog)
             header = dict(op_header)
             tensors = self._delta_request(seqnum, class_set, header)
+            self._maybe_reply_v2(header)
             resp, out = self._roundtrip(header, tensors)
             if not resp.get("ok") and resp.get("error") == "unknown-epoch":
                 self._drop_epoch(seqnum)
                 metrics.DELTA_EPOCH_RESTAGES.inc()
                 header = dict(op_header)
                 tensors = self._delta_request(seqnum, class_set, header)
+                self._maybe_reply_v2(header)
                 resp, out = self._roundtrip(header, tensors)
             if not resp.get("ok") and resp.get("error") == "unknown-seqnum":
                 # server restarted / evicted: re-stage once and retry with
@@ -1059,18 +1511,19 @@ class SolverClient:
                 self.stage_catalog(seqnum, catalog)
                 header = dict(op_header)
                 tensors = self._delta_request(seqnum, class_set, header)
+                self._maybe_reply_v2(header)
                 resp, out = self._roundtrip(header, tensors)
             if not resp.get("ok"):
                 raise RuntimeError(f"solve failed: {resp.get('error')}")
             tracing.TRACER.graft(resp)
-            return out
+            return resp, out
 
     def solve_classes(
         self, seqnum: str, catalog: encode.CatalogTensors, class_set: encode.PodClassSet,
         g_max: int = 512, objective: str = "price",
     ) -> ffd.SolveOutputs:
         header = {"op": "solve", "seqnum": seqnum, "g_max": g_max, "objective": objective}
-        out = self._solve_op(header, seqnum, catalog, class_set)
+        _, out = self._solve_op(header, seqnum, catalog, class_set)
         return ffd.SolveOutputs(**{n: out[n] for n in ffd.SolveOutputs._fields})
 
     def solve_classes_compact(
@@ -1086,12 +1539,8 @@ class SolverClient:
             "op": "solve_compact", "seqnum": seqnum, "g_max": g_max,
             "nnz_max": nnz_max, "objective": objective,
         }
-        out = self._solve_op(header, seqnum, catalog, class_set)
-        fields = {n: out[n] for n in ffd.CompactDecision._fields}
-        # scalars travel as 1-element arrays
-        fields["nnz"] = fields["nnz"].reshape(())
-        fields["n_open"] = fields["n_open"].reshape(())
-        return ffd.CompactDecision(**fields)
+        resp, out = self._solve_op(header, seqnum, catalog, class_set)
+        return self._compact_from_reply(resp, out, g_max)
 
 
 def serve_main(argv=None) -> int:
@@ -1124,6 +1573,20 @@ def serve_main(argv=None) -> int:
         "--handshake-timeout", type=float, default=30.0,
         help="TLS-handshake budget per connection (seconds)",
     )
+    parser.add_argument(
+        "--shm", action=argparse.BooleanOptionalAction, default=None,
+        help="advertise the shared-memory ring transport for colocated "
+        f"clients (default on; ${SHM_ENV}=0 also disables)",
+    )
+    parser.add_argument(
+        "--shm-dir", default=None, metavar="DIR",
+        help="ring-segment directory (default /dev/shm, else a per-user dir)",
+    )
+    parser.add_argument(
+        "--shm-size", type=int, default=None, metavar="BYTES",
+        help="ring size per direction (default 8 MiB or "
+        f"${'KARPENTER_TPU_SHM_SIZE'}; see docs/operations.md for sizing)",
+    )
     args = parser.parse_args(argv)
 
     token = None
@@ -1136,11 +1599,12 @@ def serve_main(argv=None) -> int:
 
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         ctx.load_cert_chain(args.tls_cert, args.tls_key)
+    shm_kw = dict(shm=args.shm, shm_dir=args.shm_dir, shm_size=args.shm_size)
     if args.host is not None:
         server = SolverServer(
             args.host, args.port, token=token,
             insecure_tcp=args.insecure, ssl_context=ctx,
-            handshake_timeout=args.handshake_timeout,
+            handshake_timeout=args.handshake_timeout, **shm_kw,
         ).start()
         print(
             f"solver service listening on {server.address[0]}:{server.address[1]}",
@@ -1156,7 +1620,7 @@ def serve_main(argv=None) -> int:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         else:
             ensure_socket_dir(path)  # squatting defense for the default dir
-        server = SolverServer(path=path, token=token).start()
+        server = SolverServer(path=path, token=token, **shm_kw).start()
         print(f"solver service listening on {path}", flush=True)
     try:
         threading.Event().wait()

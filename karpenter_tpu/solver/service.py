@@ -283,6 +283,11 @@ class TPUSolver:
                 token=c.token, ssl_context=c._ssl_context,
                 server_hostname=c._server_hostname,
                 connect_timeout=c.connect_timeout,
+                # a probe is one throwaway ping: negotiating (and then
+                # unlinking) a ring segment per probe would be pure churn,
+                # and its connect/close must not clobber the REAL client's
+                # transport gauge
+                shm=False, track_transport=False,
             )
             return bool(probe.ping())
         except Exception:  # noqa: BLE001 -- any wire failure = not recovered
@@ -771,6 +776,13 @@ class TPUSolver:
             return doc
         doc["delta_enabled"] = c.delta
         doc["last_delta"] = dict(c.last_delta)
+        # wire-v2 transport state: which byte transport the connection is
+        # on (shm ring vs socket), the trimmed-reply stats, and the shm
+        # degrade ladder's failure count
+        doc["transport"] = "shm" if c._ring is not None else "tcp"
+        doc["shm_enabled"] = c.shm
+        doc["shm_failures"] = c._shm_failures
+        doc["last_reply"] = dict(c.last_reply)
         with c._lock:
             doc["staged_seqnums"] = sorted(c._staged_seqnums)
             doc["epoch_bases"] = {sn: e for sn, (e, _) in c._epoch_bases.items()}

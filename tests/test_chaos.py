@@ -57,6 +57,14 @@ FAULTS = {
         "batcher.exec", "error", "RuntimeError", times=1)),
     "sidecar_dead": ("rpc.client.connect", lambda: FAILPOINTS.arm(
         "rpc.client.connect", "error", "ConnectionError")),
+    # wire-v2 shm transport seams: corrupt ring frames must be DETECTED
+    # (crc) and degrade to the socket transport (then the breaker) with
+    # no lost or double-launched pod; an attach failure leaves the fresh
+    # connection on the socket with the stream intact
+    "shm_corrupt": ("rpc.shm.corrupt", lambda: FAILPOINTS.arm(
+        "rpc.shm.corrupt", "corrupt", times=2)),
+    "shm_attach": ("rpc.shm.attach", lambda: FAILPOINTS.arm(
+        "rpc.shm.attach", "error", "ConnectionError", times=2)),
 }
 SIZES = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi")]
 
@@ -107,6 +115,12 @@ def _drive_chaos_schedule(tmp_path, seed, rounds):
                 # a kill also severs the live connection mid-flight: a
                 # dispatched pipelined solve loses its reply and the next
                 # drain must degrade through the ladder to the CPU path
+                client.close()
+            if fault in ("shm_attach", "shm_corrupt"):
+                # shm faults need the ring path live: clear any sticky
+                # degrade from an earlier corrupt round and reconnect
+                # (attach additionally only fires at establishment)
+                client._shm_failures = 0
                 client.close()
             pod_seq = _burst(op, rng, seed, pod_seq, int(rng.integers(3, 9)))
             # drive ticks WITH the fault armed so it bites mid-flight; if

@@ -1,0 +1,802 @@
+"""Wire transport v2: zero-copy framing, the shared-memory ring, trimmed
+replies (reply_v2), and the transport differential.
+
+The acceptance contracts this module pins (ISSUE 7):
+
+- encode/decode payload-copy counters read ZERO on the warm delta path
+  (zero-copy framing end to end, including the epoch store's
+  copy-on-first-write discipline -- the old rpc.py defensive copy);
+- reply_v2 ships only decision rows: >= 3x fewer reply bytes than the v1
+  dense shape at a realistic tier, decisions bit-identical;
+- shm, TCP, and in-process host paths produce identical decisions across
+  sync, pipelined, delta, and breaker-recovery ladders, and the sim
+  corpus digest matches the committed golden through the tcp backend;
+- corrupt/attach-failure shm failpoints degrade cleanly to the socket
+  transport (then the breaker), never a wrong decision.
+"""
+import json
+import os
+import socket as socket_mod
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import metrics
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver import encode, ffd, shm
+from karpenter_tpu.solver.rpc import (
+    SHM_MAX_FAILURES, SolverClient, SolverServer, _recv_frame, _send_frame,
+    expand_reply_v2,
+)
+from karpenter_tpu.solver.service import TPUSolver
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden", "scenarios")
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in cloud.describe_zones()},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [
+        SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()
+    ]
+    return prov.list(nc)
+
+
+def make_pods(n, cpu="500m", mem="1Gi", prefix="p"):
+    return [
+        Pod(f"{prefix}{i}", requests=Resources({"cpu": cpu, "memory": mem}))
+        for i in range(n)
+    ]
+
+
+def _sig(result):
+    return (
+        sorted(
+            (g.instance_types[0].name, tuple(sorted(p.metadata.name for p in g.pods)))
+            for g in result.new_groups
+        ),
+        sorted(result.unschedulable),
+        sorted(result.existing_assignments.items()),
+    )
+
+
+def _copies(side):
+    return metrics.WIRE_PAYLOAD_COPIES.value(side=side)
+
+
+# -- the ring itself ----------------------------------------------------------
+
+
+class TestRing:
+    def test_roundtrip_both_directions(self, tmp_path):
+        seg = shm.ShmSegment.create(size=65536, directory=str(tmp_path))
+        try:
+            att = shm.ShmSegment.attach(seg.path, 65536)
+            c = att.endpoint("client", timeout=2.0)
+            s = seg.endpoint("server", timeout=2.0)
+            c.sendall(b"hello-from-client")
+            buf = bytearray(17)
+            got = 0
+            while got < 17:
+                got += s.recv_into(memoryview(buf)[got:])
+            assert bytes(buf) == b"hello-from-client"
+            s.sendmsg([b"reply-", memoryview(np.arange(4, dtype=np.uint8))])
+            buf2 = bytearray(10)
+            got = 0
+            while got < 10:
+                got += c.recv_into(memoryview(buf2)[got:])
+            assert bytes(buf2) == b"reply-\x00\x01\x02\x03"
+            att.close()
+        finally:
+            seg.destroy()
+
+    def test_wraparound_preserves_bytes(self, tmp_path):
+        """Frames larger than the remaining tail of the ring split across
+        the wrap; the reader reassembles them byte-exact."""
+        seg = shm.ShmSegment.create(size=4096, directory=str(tmp_path))
+        try:
+            tx = seg.endpoint("client", timeout=2.0)
+            rx = seg.endpoint("server", timeout=2.0)
+            rng = np.random.default_rng(7)
+            for i in range(20):
+                payload = rng.integers(0, 256, size=3000, dtype=np.uint8).tobytes()
+                tx.sendall(payload)
+                buf = bytearray(3000)
+                got = 0
+                while got < 3000:
+                    got += rx.recv_into(memoryview(buf)[got:])
+                assert bytes(buf) == payload, f"iteration {i} corrupted"
+        finally:
+            seg.destroy()
+
+    def test_ring_full_backpressure_counted(self, tmp_path):
+        """A frame bigger than the ring blocks until the reader drains --
+        flow control exactly like a full socket buffer -- and the stall
+        is counted into karpenter_wire_shm_ring_full_total."""
+        seg = shm.ShmSegment.create(size=4096, directory=str(tmp_path))
+        try:
+            tx = seg.endpoint("client", timeout=10.0)
+            rx = seg.endpoint("server", timeout=10.0)
+            payload = bytes(range(256)) * 40  # 10240 bytes > 4096 ring
+            before = metrics.WIRE_SHM_RING_FULL.value()
+            received = bytearray()
+
+            def reader():
+                while len(received) < len(payload):
+                    buf = bytearray(2048)
+                    n = rx.recv_into(memoryview(buf))
+                    received.extend(buf[:n])
+
+            t = threading.Thread(target=reader, daemon=True)
+            t.start()
+            tx.sendall(payload)
+            t.join(timeout=10)
+            assert bytes(received) == payload
+            assert metrics.WIRE_SHM_RING_FULL.value() > before
+        finally:
+            seg.destroy()
+
+    def test_recv_timeout_raises_oserror(self, tmp_path):
+        seg = shm.ShmSegment.create(size=4096, directory=str(tmp_path))
+        try:
+            rx = seg.endpoint("server", timeout=0.05)
+            with pytest.raises(OSError):  # socket.timeout subclasses OSError
+                rx.recv_into(memoryview(bytearray(4)))
+        finally:
+            seg.destroy()
+
+    def test_peer_close_raises_connection_error(self, tmp_path):
+        seg = shm.ShmSegment.create(size=4096, directory=str(tmp_path))
+        try:
+            c = seg.endpoint("client", timeout=5.0)
+            s = seg.endpoint("server", timeout=5.0)
+            c.close()
+            with pytest.raises(ConnectionError):
+                s.recv_into(memoryview(bytearray(4)))
+        finally:
+            seg.destroy()
+
+    def test_attach_validates_geometry_and_magic(self, tmp_path):
+        seg = shm.ShmSegment.create(size=4096, directory=str(tmp_path))
+        try:
+            with pytest.raises(shm.ShmAttachError):
+                shm.ShmSegment.attach(seg.path, 8192)  # wrong size
+            with pytest.raises(shm.ShmAttachError):
+                shm.ShmSegment.attach(str(tmp_path / "nope"), 4096)
+            seg.mv[0:8] = b"GARBAGE!"
+            with pytest.raises(shm.ShmAttachError):
+                shm.ShmSegment.attach(seg.path, 4096)
+        finally:
+            seg.destroy()
+
+    def test_cleanup_stale_sweeps_dead_pid_segments(self, tmp_path):
+        d = str(tmp_path)
+        # a plausibly-dead pid (max pid is far below this on test rigs)
+        dead = os.path.join(d, f"{shm.PREFIX}999999999-deadbeef")
+        open(dead, "wb").close()
+        live = os.path.join(d, f"{shm.PREFIX}{os.getpid()}-cafecafe")
+        open(live, "wb").close()
+        unrelated = os.path.join(d, "not-a-ring-file")
+        open(unrelated, "wb").close()
+        removed = shm.cleanup_stale(d)
+        assert removed == 1
+        assert not os.path.exists(dead)
+        assert os.path.exists(live) and os.path.exists(unrelated)
+
+    def test_server_start_sweeps_stale_segments_even_with_shm_off(self, tmp_path):
+        """The post-incident move -- restart the sidecar with the shm kill
+        switch set -- must still unlink crash leftovers: the janitor runs
+        at every server start, shm enabled or not."""
+        d = str(tmp_path / "rings")
+        os.makedirs(d)
+        dead = os.path.join(d, f"{shm.PREFIX}999999999-deadbeef")
+        open(dead, "wb").close()
+        srv = SolverServer(path=str(tmp_path / "solver.sock"),
+                           shm=False, shm_dir=d).start()
+        try:
+            assert not os.path.exists(dead)
+        finally:
+            srv.stop()
+
+
+# -- zero-copy framing --------------------------------------------------------
+
+
+class TestZeroCopyFraming:
+    def test_contiguous_tensors_ship_copy_free(self):
+        s1, s2 = socket_mod.socketpair()
+        try:
+            a = np.arange(24, dtype=np.float32).reshape(4, 6)
+            b = np.arange(5, dtype=np.int64)
+            before = _copies("encode")
+            _send_frame(s1, {"op": "x"}, [("a", a), ("b", b)])
+            assert _copies("encode") == before, "contiguous send must not copy"
+            header, tensors = _recv_frame(s2)
+            np.testing.assert_array_equal(tensors["a"], a)
+            np.testing.assert_array_equal(tensors["b"], b)
+        finally:
+            s1.close()
+            s2.close()
+
+    def test_noncontiguous_tensor_copy_is_counted(self):
+        s1, s2 = socket_mod.socketpair()
+        try:
+            a = np.arange(24, dtype=np.float32).reshape(4, 6).T  # F-order view
+            before = _copies("encode")
+            _send_frame(s1, {"op": "x"}, [("a", a)])
+            assert _copies("encode") == before + 1
+            _, tensors = _recv_frame(s2)
+            np.testing.assert_array_equal(tensors["a"], a)
+        finally:
+            s1.close()
+            s2.close()
+
+    def test_received_tensors_are_read_only_views(self):
+        s1, s2 = socket_mod.socketpair()
+        try:
+            _send_frame(s1, {"op": "x"}, [("a", np.ones((3,), np.float32))])
+            _, tensors = _recv_frame(s2)
+            assert not tensors["a"].flags.writeable
+            with pytest.raises(ValueError):
+                tensors["a"][0] = 2.0
+        finally:
+            s1.close()
+            s2.close()
+
+    def test_unrelated_failpoint_keeps_zero_copy_path(self, failpoints):
+        """An armed site elsewhere in the process (a crash drill, a
+        latency drill on instance.launch) must not silently disable
+        scatter-gather: only the frame's OWN corrupt site buys the
+        joining copy."""
+        failpoints.arm("instance.launch", "latency", "0")
+        s1, s2 = socket_mod.socketpair()
+        try:
+            a = np.arange(24, dtype=np.float32).reshape(4, 6)
+            before = _copies("encode")
+            _send_frame(s1, {"op": "x"}, [("a", a)])
+            assert _copies("encode") == before, (
+                "unrelated armed site disabled the zero-copy send"
+            )
+            _, tensors = _recv_frame(s2)
+            np.testing.assert_array_equal(tensors["a"], a)
+        finally:
+            s1.close()
+            s2.close()
+
+    def test_corruption_still_detected_by_crc(self, failpoints):
+        """The chaos join path: with the corrupt site armed the frame is
+        assembled, one byte flips, and the receiver's crc/JSON integrity
+        checks surface it as ConnectionError -- unchanged under v2."""
+        failpoints.arm("rpc.frame.corrupt", "corrupt", times=1)
+        s1, s2 = socket_mod.socketpair()
+        try:
+            _send_frame(s1, {"op": "x"}, [("a", np.arange(1000, dtype=np.float32))])
+            with pytest.raises(ConnectionError):
+                _recv_frame(s2)
+        finally:
+            s1.close()
+            s2.close()
+
+    def test_exhausted_corrupt_discipline_restores_zero_copy(self, failpoints):
+        """Once a bounded corrupt drill has fully fired, later frames go
+        back to scatter-gather: a spent discipline must not keep taxing
+        every frame with the joining copy for the life of the process."""
+        failpoints.arm("rpc.frame.corrupt", "corrupt", times=1)
+        a = np.arange(1000, dtype=np.float32)
+        s1, s2 = socket_mod.socketpair()
+        try:
+            _send_frame(s1, {"op": "x"}, [("a", a)])  # the one fire
+        finally:
+            s1.close()
+            s2.close()
+        assert failpoints.fires("rpc.frame.corrupt") == 1
+        s1, s2 = socket_mod.socketpair()
+        try:
+            before = _copies("encode")
+            for _ in range(3):
+                _send_frame(s1, {"op": "x"}, [("a", a)])
+                _, tensors = _recv_frame(s2)
+                np.testing.assert_array_equal(tensors["a"], a)
+            assert _copies("encode") == before, (
+                "spent corrupt discipline kept the joining-copy path"
+            )
+        finally:
+            s1.close()
+            s2.close()
+
+
+# -- shm negotiation + degrade ------------------------------------------------
+
+
+class TestShmNegotiation:
+    def test_unix_client_negotiates_ring(self, tmp_path, catalog_items):
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        client = SolverClient(path=path)
+        try:
+            assert "shm" in client.features()
+            assert client._ring is not None, "UNIX client should be on the ring"
+            solver = TPUSolver(g_max=64, client=client)
+            res = solver.solve(NodePool("default"), catalog_items, make_pods(8))
+            assert not res.unschedulable
+            assert solver.describe_wire()["transport"] == "shm"
+            assert metrics.WIRE_TRANSPORT.value(transport="shm") == 1.0
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_env_kill_switch(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KARPENTER_TPU_SHM", "0")
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        client = SolverClient(path=path)
+        try:
+            assert client.ping() is True
+            assert client._ring is None
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_server_without_shm_keeps_socket(self, tmp_path):
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path, shm=False).start()
+        client = SolverClient(path=path)
+        try:
+            assert "shm" not in client.features()
+            assert client.ping() is True
+            assert client._ring is None
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_attach_failpoint_degrades_to_socket(self, tmp_path, catalog_items,
+                                                 failpoints):
+        """rpc.shm.attach fires -> the connection stays on the socket with
+        the stream intact; decisions are unaffected."""
+        failpoints.arm("rpc.shm.attach", "error", "ConnectionError")
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        client = SolverClient(path=path)
+        try:
+            solver = TPUSolver(g_max=64, client=client)
+            res = solver.solve(NodePool("default"), catalog_items, make_pods(9))
+            assert client._ring is None
+            assert failpoints.fires("rpc.shm.attach") >= 1
+            want = TPUSolver(g_max=64).solve(
+                NodePool("default"), catalog_items, make_pods(9))
+            assert _sig(res) == _sig(want)
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_attach_failpoint_inside_attach_degrades_to_socket(
+            self, tmp_path, catalog_items, failpoints):
+        """The rpc.shm.attach site evals twice per negotiation (top of
+        _try_shm, then inside ShmSegment.attach): a discipline whose
+        FIRST fire lands on the inner eval must still leave the handshake
+        on the socket, never tear down the whole connection."""
+        failpoints.arm("rpc.shm.attach", "error", "ConnectionError", after=1)
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        client = SolverClient(path=path)
+        try:
+            solver = TPUSolver(g_max=64, client=client)
+            res = solver.solve(NodePool("default"), catalog_items, make_pods(9))
+            assert client._ring is None
+            assert failpoints.fires("rpc.shm.attach") >= 1
+            want = TPUSolver(g_max=64).solve(
+                NodePool("default"), catalog_items, make_pods(9))
+            assert _sig(res) == _sig(want)
+        finally:
+            client.close()
+            srv.stop()
+
+    def test_close_zeroes_both_transport_gauges(self, tmp_path):
+        """A closed client reports NO active transport: close() must zero
+        the tcp series too, or a socket-mode client looks alive forever."""
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path, shm=False).start()
+        client = SolverClient(path=path)
+        try:
+            assert client.ping() is True
+            assert metrics.WIRE_TRANSPORT.value(transport="tcp") == 1.0
+        finally:
+            client.close()
+            srv.stop()
+        assert metrics.WIRE_TRANSPORT.value(transport="tcp") == 0.0
+        assert metrics.WIRE_TRANSPORT.value(transport="shm") == 0.0
+
+    def test_sidecar_death_does_not_stick_to_tcp(self, tmp_path):
+        """Peer death is not segment badness: a crash-looping sidecar gets
+        a FRESH segment per reconnect, so repeated sidecar deaths must not
+        permanently disable the ring -- only stream corruption counts
+        toward SHM_MAX_FAILURES."""
+        path = str(tmp_path / "solver.sock")
+        client = SolverClient(path=path, connect_timeout=2.0)
+        try:
+            for _ in range(3):  # more deaths than SHM_MAX_FAILURES
+                srv = SolverServer(path=path).start()
+                assert client.ping() is True
+                assert client._ring is not None
+                srv.stop()
+                with pytest.raises((ConnectionError, OSError)):
+                    client.ping()  # peer gone: fails, must not count
+            srv = SolverServer(path=path).start()
+            try:
+                assert client.ping() is True
+                assert client._ring is not None, "sidecar deaths made tcp sticky"
+                assert client._shm_failures == 0
+            finally:
+                srv.stop()
+        finally:
+            client.close()
+
+    def test_throwaway_client_does_not_clobber_transport_gauge(self, tmp_path):
+        """The gauge is process-global and belongs to the PRIMARY client:
+        a track_transport=False connection (the breaker's half-open probe)
+        must neither set it on connect nor zero it on close."""
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path, shm=False).start()
+        main = SolverClient(path=path)
+        try:
+            assert main.ping() is True
+            assert metrics.WIRE_TRANSPORT.value(transport="tcp") == 1.0
+            probe = SolverClient(path=path, track_transport=False)
+            try:
+                assert probe.ping() is True
+            finally:
+                probe.close()
+            assert metrics.WIRE_TRANSPORT.value(transport="tcp") == 1.0, (
+                "throwaway client clobbered the transport gauge"
+            )
+        finally:
+            main.close()
+            srv.stop()
+
+    def test_corrupt_shm_degrades_to_tcp_never_wrong(self, tmp_path,
+                                                     catalog_items, failpoints):
+        """The degrade ladder of the acceptance criteria: an unboundedly
+        corrupting ring is DETECTED (crc -> ConnectionError), the solve
+        falls back to the bit-identical host path (breaker accounting),
+        and after SHM_MAX_FAILURES the client stays on the socket -- where
+        solves flow over the wire again. No decision is ever wrong."""
+        from karpenter_tpu.solver.breaker import CircuitBreaker
+
+        failpoints.arm("rpc.shm.corrupt", "corrupt")
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        client = SolverClient(path=path, timeout=10.0, connect_timeout=0.5)
+        breaker = CircuitBreaker(failure_threshold=3, backoff_base=1000.0)
+        solver = TPUSolver(g_max=64, client=client, breaker=breaker)
+        ref = TPUSolver(g_max=64)
+        pool = NodePool("default")
+        try:
+            for i in range(SHM_MAX_FAILURES + 2):
+                pods = make_pods(6 + i, prefix=f"c{i}-")
+                got = solver.solve(pool, catalog_items, list(pods))
+                want = ref.solve(pool, catalog_items, list(pods))
+                assert _sig(got) == _sig(want), f"solve {i} diverged"
+            assert failpoints.fires("rpc.shm.corrupt") >= 1
+            assert client._shm_failures >= SHM_MAX_FAILURES
+            # the degrade is sticky: the live connection is on the socket
+            # and solves flow over the WIRE again (not the host fallback)
+            assert client._ring is None
+            assert client.ping() is True
+            if breaker.state != "closed":
+                assert breaker.probe_now() is True
+        finally:
+            breaker.stop()
+            client.close()
+            srv.stop()
+
+    def test_segments_are_cleaned_up(self, tmp_path, catalog_items):
+        shm_dir = str(tmp_path / "segs")
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path, shm_dir=shm_dir).start()
+        client = SolverClient(path=path)
+        try:
+            assert client.ping() is True
+            assert client._ring is not None
+            assert len(os.listdir(shm_dir)) == 1
+            client.close()
+            deadline = time.time() + 5
+            while os.listdir(shm_dir) and time.time() < deadline:
+                time.sleep(0.02)
+            assert not os.listdir(shm_dir), "segment not unlinked on teardown"
+        finally:
+            client.close()
+            srv.stop()
+
+
+# -- reply_v2 -----------------------------------------------------------------
+
+
+class TestReplyV2:
+    @staticmethod
+    def _encoded(catalog_items, pods, g_max=256):
+        pool = NodePool("default")
+        catalog = encode.encode_catalog(catalog_items)
+        classes = encode.group_pods(pods, extra_requirements=pool.requirements())
+        cs = encode.encode_classes(
+            classes, catalog, c_pad=encode.bucket(len(classes), 16))
+        return catalog, cs
+
+    def test_v2_matches_v1_bit_for_bit_in_decisions(self, tmp_path, catalog_items):
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        c2 = SolverClient(path=path, shm=False)
+        c1 = SolverClient(path=path, shm=False, reply_v2=False)
+        try:
+            pods = make_pods(60) + make_pods(20, cpu="2", mem="4Gi", prefix="big")
+            catalog, cs = self._encoded(catalog_items, pods)
+            dec2 = c2.solve_classes_compact("v2-seq", catalog, cs, g_max=256)
+            dec1 = c1.solve_classes_compact("v2-seq", catalog, cs, g_max=256)
+            assert c2.last_reply["v"] == 2 and c1.last_reply["v"] == 1
+            e2 = ffd.expand_compact(dec2, cs.c_pad, 256, catalog.k_pad,
+                                    encode.Z_PAD, encode.CT)
+            e1 = ffd.expand_compact(dec1, cs.c_pad, 256, catalog.k_pad,
+                                    encode.Z_PAD, encode.CT)
+            assert e1 is not None and e2 is not None
+            take2, unplaced2, n_open2, gmask2, gzone2, gcap2 = e2
+            take1, unplaced1, n_open1, gmask1, gzone1, gcap1 = e1
+            assert n_open1 == n_open2
+            np.testing.assert_array_equal(take1, take2)
+            np.testing.assert_array_equal(unplaced1, unplaced2)
+            # decision-bearing rows (decode reads only [:n_open])
+            np.testing.assert_array_equal(gmask1[:n_open1], gmask2[:n_open2])
+            np.testing.assert_array_equal(gzone1[:n_open1], gzone2[:n_open2])
+            np.testing.assert_array_equal(gcap1[:n_open1], gcap2[:n_open2])
+        finally:
+            c1.close()
+            c2.close()
+            srv.stop()
+
+    def test_reply_bytes_reduced_3x(self, tmp_path, catalog_items):
+        """The acceptance bar: >= 3x fewer reply bytes than the dense v1
+        shape at a realistic class-count/group-budget tier."""
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        c2 = SolverClient(path=path, shm=False)
+        c1 = SolverClient(path=path, shm=False, reply_v2=False)
+        try:
+            pods = make_pods(400) + make_pods(100, cpu="1", mem="2Gi", prefix="m")
+            catalog, cs = self._encoded(catalog_items, pods)
+            c2.solve_classes_compact("rb-seq", catalog, cs, g_max=512)
+            c1.solve_classes_compact("rb-seq", catalog, cs, g_max=512)
+            v2, v1 = c2.last_reply["bytes"], c1.last_reply["bytes"]
+            assert v2 > 0 and v1 / v2 >= 3.0, (v1, v2)
+        finally:
+            c1.close()
+            c2.close()
+            srv.stop()
+
+    def test_overflow_reply_maps_to_dense_refetch(self):
+        """An overflow v2 reply reconstructs with an empty idx, which
+        expand_compact maps to None -- the existing dense-refetch rung."""
+        dec = expand_reply_v2({"nnz": 999, "n_open": 4}, {}, g_max=8)
+        assert ffd.expand_compact(dec, 4, 8, 64, encode.Z_PAD, encode.CT) is None
+
+    def test_solver_ladder_handles_overflow_end_to_end(self, tmp_path,
+                                                       catalog_items, monkeypatch):
+        """Force the sparse budget to overflow: the wire ladder must land
+        on the dense op and still produce the correct decision."""
+        from karpenter_tpu.solver import rpc as rpc_mod
+
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        client = SolverClient(path=path, shm=False, delta=False)
+        try:
+            pods = make_pods(40) + make_pods(10, cpu="2", mem="4Gi", prefix="b")
+            want = TPUSolver(g_max=64).solve(
+                NodePool("default"), catalog_items, list(pods))
+            # a pathological nnz budget: every compact solve overflows
+            monkeypatch.setattr(rpc_mod.ffd, "nnz_budget", lambda c, g: 1)
+            solver = TPUSolver(g_max=64, client=client)
+            got = solver.solve(NodePool("default"), catalog_items, list(pods))
+            assert _sig(got) == _sig(want)
+        finally:
+            client.close()
+            srv.stop()
+
+
+# -- the epoch store's read-only discipline (satellite 1) ---------------------
+
+
+class TestEpochReadOnly:
+    def test_full_ship_stores_views_and_warm_path_copies_nothing(
+            self, tmp_path, catalog_items):
+        """Regression for the rpc.py:444 defensive copy: a full ship's
+        epoch holds the received READ-ONLY frame views (no writable copy);
+        the first delta pays one counted copy-on-write per tensor; every
+        warm tick after that patches in place -- encode AND decode copy
+        counters stay flat, the zero-copy acceptance criterion."""
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        client = SolverClient(path=path, shm=False)  # same-process server: one registry
+        solver = TPUSolver(g_max=64, client=client, incremental=True)
+        pool = NodePool("default")
+
+        def wave(i):
+            return (
+                make_pods(20, prefix=f"w{i}-")
+                + make_pods(4 + i % 3, cpu="2", mem="4Gi", prefix=f"s{i}-")
+            )
+
+        try:
+            from karpenter_tpu.solver.oracle import Scheduler
+
+            def sched():
+                zones = {
+                    o.zone for it in catalog_items for o in it.available_offerings()
+                }
+                return Scheduler(
+                    nodepools=[pool],
+                    instance_types={pool.name: catalog_items}, zones=zones,
+                )
+
+            solver.schedule(sched(), wave(0))  # full ship establishes the epoch
+            assert client.last_delta["mode"] == "full"
+            with srv._lock:
+                assert srv._epochs, "epoch not established"
+                for ep in srv._epochs.values():
+                    for name, arr in ep.items():
+                        assert not arr.flags.writeable, (
+                            f"epoch tensor {name} was defensively copied"
+                        )
+            solver.schedule(sched(), wave(1))  # first delta: counted CoW
+            assert client.last_delta["mode"] == "delta"
+            enc0, dec0 = _copies("encode"), _copies("decode")
+            for i in range(2, 5):  # warm steady state: ZERO copies
+                solver.schedule(sched(), wave(i))
+                assert client.last_delta["mode"] == "delta"
+            assert _copies("encode") == enc0, "warm delta path copied on encode"
+            assert _copies("decode") == dec0, "warm delta path copied on decode"
+        finally:
+            client.close()
+            srv.stop()
+
+
+# -- transport differential ---------------------------------------------------
+
+
+class TestTransportDifferential:
+    def _rig(self, tmp_path, **client_kw):
+        path = str(tmp_path / "solver.sock")
+        srv = SolverServer(path=path).start()
+        client = SolverClient(path=path, timeout=10.0, connect_timeout=0.5,
+                              **client_kw)
+        return srv, client
+
+    def test_host_tcp_shm_identical_sync_and_pipelined(self, tmp_path,
+                                                       catalog_items):
+        pool = NodePool("default")
+        srv, c_shm = self._rig(tmp_path)
+        c_tcp = SolverClient(path=srv.path, shm=False)
+        try:
+            s_host = TPUSolver(g_max=64)
+            s_shm = TPUSolver(g_max=64, client=c_shm)
+            s_tcp = TPUSolver(g_max=64, client=c_tcp)
+            assert c_shm.features() and c_shm._ring is not None
+            assert c_tcp.ping() and c_tcp._ring is None
+            for i in range(3):
+                pods = make_pods(10 + 7 * i, prefix=f"d{i}-")
+                sig_host = _sig(s_host.solve(pool, catalog_items, list(pods)))
+                assert sig_host == _sig(s_shm.solve(pool, catalog_items, list(pods)))
+                assert sig_host == _sig(s_tcp.solve(pool, catalog_items, list(pods)))
+                # pipelined halves through both transports
+                p1 = s_shm.solve_begin(pool, catalog_items, list(pods))
+                p2 = s_tcp.solve_begin(pool, catalog_items, list(pods))
+                assert sig_host == _sig(s_shm.solve_finish(p1))
+                assert sig_host == _sig(s_tcp.solve_finish(p2))
+        finally:
+            c_shm.close()
+            c_tcp.close()
+            srv.stop()
+
+    def test_breaker_recovery_ladder_over_shm(self, tmp_path, catalog_items,
+                                              failpoints):
+        """Trip the breaker while on the ring, solve on the host fallback
+        (same decision), re-promote through the probe, and resume on a
+        freshly negotiated ring -- still the same decision."""
+        from karpenter_tpu.solver.breaker import CLOSED, CircuitBreaker
+
+        pool = NodePool("default")
+        srv, client = self._rig(tmp_path)
+        breaker = CircuitBreaker(failure_threshold=1, backoff_base=1000.0)
+        solver = TPUSolver(g_max=64, client=client, breaker=breaker)
+        ref = TPUSolver(g_max=64)
+        try:
+            pods = make_pods(12)
+            assert _sig(solver.solve(pool, catalog_items, list(pods))) == _sig(
+                ref.solve(pool, catalog_items, list(pods)))
+            assert client._ring is not None
+            # sever: refuse reconnects, kill the live connection
+            failpoints.arm("rpc.client.connect", "error", "ConnectionError")
+            client.close()
+            got = solver.solve(pool, catalog_items, list(pods))
+            assert _sig(got) == _sig(ref.solve(pool, catalog_items, list(pods)))
+            assert breaker.state != CLOSED
+            failpoints.reset()
+            assert breaker.probe_now() is True and breaker.state == CLOSED
+            got = solver.solve(pool, catalog_items, list(pods))
+            assert _sig(got) == _sig(ref.solve(pool, catalog_items, list(pods)))
+            assert client._ring is not None, "ring not renegotiated after recovery"
+        finally:
+            breaker.stop()
+            client.close()
+            srv.stop()
+
+    def test_delta_chain_identical_across_transports(self, tmp_path,
+                                                     catalog_items):
+        """Warm delta ticks (epoch chain + reply_v2) through shm and tcp
+        against the host path: identical decisions every tick."""
+        from karpenter_tpu.solver.oracle import Scheduler
+
+        pool = NodePool("default")
+        zones = {o.zone for it in catalog_items for o in it.available_offerings()}
+
+        def sched():
+            return Scheduler(nodepools=[pool],
+                             instance_types={pool.name: catalog_items}, zones=zones)
+
+        def wave(i):
+            return (
+                make_pods(18, prefix=f"w{i}-")
+                + make_pods(3 + i % 4, cpu="2", mem="4Gi", prefix=f"s{i}-")
+            )
+
+        srv, c_shm = self._rig(tmp_path)
+        c_tcp = SolverClient(path=srv.path, shm=False)
+        try:
+            s_host = TPUSolver(g_max=64, incremental=False)
+            s_shm = TPUSolver(g_max=64, client=c_shm, incremental=True)
+            s_tcp = TPUSolver(g_max=64, client=c_tcp, incremental=True)
+            for i in range(5):
+                w = wave(i)
+                sig_host = _sig(s_host.schedule(sched(), list(w)))
+                assert sig_host == _sig(s_shm.schedule(sched(), list(w))), f"tick {i} shm"
+                assert sig_host == _sig(s_tcp.schedule(sched(), list(w))), f"tick {i} tcp"
+            assert c_shm.last_delta["mode"] == "delta"
+            assert c_tcp.last_delta["mode"] == "delta"
+        finally:
+            c_shm.close()
+            c_tcp.close()
+            srv.stop()
+
+    def test_corpus_digest_through_tcp_backend(self, tmp_path):
+        """Sim corpus replay (acceptance): the committed diurnal-small
+        golden digest holds through the tcp-pinned wire backend -- with
+        the wire/pipelined/delta backends already on the shm ring by
+        default (tests/test_sim.py), this closes shm == tcp == host."""
+        from karpenter_tpu.sim.replay import replay
+        from karpenter_tpu.sim.trace import read_trace
+
+        events = read_trace(os.path.join(GOLDEN_DIR, "diurnal-small.jsonl"))
+        with open(os.path.join(GOLDEN_DIR, "digests.json")) as f:
+            golden = json.load(f)
+        seed = events[0]["seed"]
+        res = replay(events, backend="tcp", seed=seed, tmpdir=str(tmp_path))
+        assert res.digest == golden["diurnal-small"]
