@@ -5,12 +5,24 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm bench-wire benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos sim-corpus
+.PHONY: test deflake benchmark bench-warm bench-wire benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos sim-corpus lint typecheck
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
 
-ci:  ## the CI gate: generated-docs drift (metrics registry vs docs/metrics.md, CRDs, compat matrix) THEN the test suites
+lint:  ## AST invariant checkers: determinism, lock discipline, zero-copy wire, registry drift (allowlist: hack/lint_baseline.json)
+	$(PY) -m karpenter_tpu.analysis
+
+typecheck:  ## targeted mypy over the solver package + the intent journal (hack/mypy.ini); skips with a notice where mypy is not installed (CI always runs it)
+	@if $(PY) -c "import mypy" >/dev/null 2>&1; then \
+		$(PY) -m mypy --config-file hack/mypy.ini karpenter_tpu/solver/ karpenter_tpu/journal.py; \
+	else \
+		echo "typecheck: mypy not installed in this environment; skipping (the CI typecheck job runs it; pip install mypy to run locally)"; \
+	fi
+
+ci:  ## the CI gate: invariant lint FIRST (cheapest, catches contract violations at the AST), then generated-docs drift (metrics registry vs docs/metrics.md, CRDs, compat matrix), then the test suites
+	$(MAKE) lint
+	$(MAKE) typecheck
 	$(MAKE) docs-check
 	$(MAKE) test
 
@@ -40,11 +52,11 @@ bench-warm:  ## warm steady-state delta stage only (incremental tick engine: war
 bench-wire:  ## transport stage only (wire v2: warm_wire_p50/p99_ms shm vs tcp, wire_share_of_tick, reply_bytes_per_solve, copies-per-solve); one JSON line
 	$(PY) bench.py --wire-only > bench_wire_last.json; rc=$$?; cat bench_wire_last.json; exit $$rc
 
-chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count, incl. the shm-transport faults (full-length schedule stays behind -m slow)
-	KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py tests/test_wire.py -q -m 'not slow' $(call STAMP,chaos)
+chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count, incl. the shm-transport faults, under the lock-order witness (zero inversions asserted at session end; full-length schedule stays behind -m slow)
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py tests/test_wire.py -q -m 'not slow' $(call STAMP,chaos)
 
-crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection; diverging traces ddmin-shrink into crash-artifacts/ (full-length chain soak stays behind -m slow)
-	KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
+crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection -- under the lock-order witness (zero inversions asserted at session end); diverging traces ddmin-shrink into crash-artifacts/ (full-length chain soak stays behind -m slow)
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
 
 sim-corpus:  ## differential-replay the committed scenario corpus (host vs wire vs pipelined, golden digests); shrinks any failing trace into sim-artifacts/
 	$(PY) -m karpenter_tpu sim corpus --dir tests/golden/scenarios --artifacts sim-artifacts $(call STAMP,sim-corpus)

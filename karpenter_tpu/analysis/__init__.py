@@ -1,0 +1,47 @@
+"""Invariant linter suite: the repo's load-bearing contracts, machine-checked.
+
+The guarantees this codebase leans on -- byte-deterministic replay (the
+sim subsystem's golden-digest discipline), deadlock-free threading across
+the pipelined solve / breaker / shm ring / elector, the zero-copy wire
+path, and generated-doc registries that cannot drift -- were enforced
+only at runtime until this package. Runtime tests catch a violation when
+a schedule happens to exercise it; the `uuid4` NodeClaim-name
+nondeterminism (PR 4) and the scrape-vs-observe histogram race (PR 2)
+both shipped before a test met them. These checkers walk the package AST
+and fail `make lint` the moment a violation is WRITTEN:
+
+- ``determinism``   -- bare ``uuid.uuid4()`` / ``random.*()`` /
+  ``time.time()`` / ``datetime.now()`` calls and iteration-order hazards
+  outside the seeding.py-derived streams and the named clock seams
+  (checkers/determinism.py).
+- ``locks``         -- the static lock-acquisition graph across every
+  ``threading.Lock/RLock``-holding class: lock-order cycles are rejected,
+  and attributes written both under and outside their class's lock are
+  flagged (checkers/locks.py).
+- ``zerocopy``      -- copying constructs (``.tobytes()``, ``bytes(view)``,
+  ``b"".join``, ...) on the rpc.py/shm.py framing hot path: the runtime
+  ``payload_copies == 0`` assertion, made static (checkers/zerocopy.py).
+- ``registry``      -- every failpoint site, metric family, and RPC
+  feature flag must appear in its docs table (checkers/registry_drift.py).
+
+Intentional exceptions live in ``hack/lint_baseline.json`` -- each entry
+carries file:line, the offending source line, and a justification; the
+suite fails if the baseline grows stale. Run it:
+
+    python -m karpenter_tpu.analysis            # == make lint
+    python -m karpenter_tpu.analysis --json     # machine-readable
+    python -m karpenter_tpu.analysis --write-baseline   # (re)seed
+
+The static lock pass is paired with a RUNTIME lock-order witness
+(witness.py): a debug wrapper around ``threading.Lock/RLock`` that
+records acquisition order per thread and reports any inversion of an
+observed edge -- the Python race detector for interleavings the chaos
+schedules cannot force. Tier-1 and the chaos soaks run under it and
+assert zero inversions (tests/conftest.py).
+"""
+from karpenter_tpu.analysis.base import (  # noqa: F401
+    Violation,
+    load_baseline,
+    run_suite,
+    write_baseline,
+)

@@ -1,0 +1,109 @@
+"""Invariant linter CLI (`make lint`).
+
+    python -m karpenter_tpu.analysis                  # all families, baseline-aware
+    python -m karpenter_tpu.analysis --rules locks    # one family
+    python -m karpenter_tpu.analysis --json           # machine-readable
+    python -m karpenter_tpu.analysis --graph          # dump the lock graph
+    python -m karpenter_tpu.analysis --write-baseline # (re)seed the allowlist
+
+Exit codes: 0 clean, 1 violations (or a stale baseline entry), 2 usage.
+A stale baseline entry -- one that no longer matches any violation --
+fails the run: the allowlist shrinks through deliberate edits, never rots.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from karpenter_tpu.analysis.base import (BASELINE_PATH, apply_baseline,
+                                         checkers, iter_modules,
+                                         load_baseline, run_suite,
+                                         write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m karpenter_tpu.analysis",
+        description="AST invariant checkers: determinism, lock discipline, "
+                    "zero-copy wire, registry drift")
+    ap.add_argument("--rules", action="append", default=None,
+                    metavar="FAMILY", help="run only these rule families "
+                    f"(choices: {', '.join(checkers())}; repeatable)")
+    ap.add_argument("--baseline", default=str(BASELINE_PATH),
+                    help="allowlist file (default hack/lint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report everything, including baselined exceptions")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current violations as the new baseline "
+                    "(justifications from matching old entries are kept)")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--graph", action="store_true",
+                    help="dump the static lock-acquisition graph and exit")
+    args = ap.parse_args(argv)
+
+    if args.graph:
+        from karpenter_tpu.analysis.checkers.locks import lock_graph
+
+        g = lock_graph(iter_modules())
+        payload = {
+            "locks": {lid: {"kind": ld.kind, "site": ld.site}
+                      for lid, ld in sorted(g.locks.items())},
+            "edges": sorted({(e.src, e.dst) for e in g.edges}),
+            "cycles": g.cycles(),
+        }
+        print(json.dumps(payload, indent=2))
+        return 0 if not payload["cycles"] else 1
+
+    violations = run_suite(args.rules)
+
+    import pathlib
+    baseline_path = pathlib.Path(args.baseline)
+    entries = [] if args.no_baseline else load_baseline(baseline_path)
+
+    if args.write_baseline:
+        old_entries = load_baseline(baseline_path)
+        old = {(e["rule"], e["path"], e["line_text"]): e["justification"]
+               for e in old_entries}
+        # a partial (--rules) rewrite replaces only the selected families'
+        # entries; everything out of scope is preserved verbatim
+        kept = [e for e in old_entries
+                if e["rule"].split("/")[0] not in set(args.rules)] \
+            if args.rules else []
+        write_baseline(violations, baseline_path, justifications=old,
+                       keep=kept)
+        print(f"wrote {baseline_path} ({len(violations) + len(kept)} entries)")
+        return 0
+
+    fresh, matched, stale = apply_baseline(violations, entries)
+    # a partial run must not flag out-of-scope baseline entries as stale
+    if args.rules:
+        stale = [e for e in stale if e["rule"].split("/")[0] in args.rules]
+
+    if args.json:
+        print(json.dumps({
+            "violations": [v.__dict__ for v in fresh],
+            "baselined": len(matched),
+            "stale_baseline": stale,
+        }, indent=2))
+        return 1 if fresh or stale else 0
+
+    for v in fresh:
+        print(v.render())
+    for e in stale:
+        print(f"{e['path']}: [baseline] stale entry for {e['rule']} "
+              f"({e['line_text']!r}) matches nothing; remove it from "
+              f"{baseline_path.name}", file=sys.stderr)
+    if fresh or stale:
+        print(f"\nlint: {len(fresh)} violation(s), {len(stale)} stale "
+              f"baseline entr{'y' if len(stale) == 1 else 'ies'} "
+              f"({len(matched)} baselined exception(s) suppressed)",
+              file=sys.stderr)
+        return 1
+    print(f"lint: clean ({len(matched)} baselined exception(s), "
+          f"{len(entries)} baseline entr{'y' if len(entries) == 1 else 'ies'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,142 @@
+"""Registry-drift checker: every registered name appears in its doc table.
+
+The metrics page already has a runtime drift gate (hack/metrics_gen.py
+--check renders docs/metrics.md from the live registry). This checker
+extends the discipline to ALL three registries, statically -- no imports,
+so it runs in a bare container and catches names in modules the doc
+generator's import list missed:
+
+- ``registry/metric-undocumented``    -- every metric family registered
+  via ``REGISTRY.counter/gauge/histogram("karpenter_...")`` must appear
+  in docs/metrics.md.
+- ``registry/failpoint-undocumented`` -- every failpoint site evaluated
+  in code (``failpoints.eval/corrupt/live("site")``) must appear in the
+  site table in docs/operations.md.
+- ``registry/feature-undocumented``   -- every RPC feature flag the
+  server advertises (the ``features`` list in solver/rpc.py, plus
+  conditional ``features.append``) must appear somewhere under docs/.
+
+Metric and failpoint names match backtick-exact (`` `name` ``) against
+their doc tables -- a plain substring test would let a name that merely
+prefixes a documented one pass. Feature flags match as substrings across
+docs/ (they appear in prose, not a canonical table).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from karpenter_tpu.analysis.base import REPO_ROOT, Module, Violation
+
+METRICS_DOC = "docs/metrics.md"
+FAILPOINTS_DOC = "docs/operations.md"
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+_SITE_FUNCS = {"eval", "corrupt", "live", "hits", "fires"}
+
+
+def _doc_text(rel: str) -> str:
+    p = REPO_ROOT / rel
+    return p.read_text() if p.exists() else ""
+
+
+def _collect_metric_families(modules: List[Module]) -> List[Tuple[Module, ast.Call, str]]:
+    out = []
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Attribute):
+                continue
+            if node.func.attr not in _REGISTER_METHODS or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str) \
+                    and first.value.startswith("karpenter_"):
+                out.append((mod, node, first.value))
+    return out
+
+
+def _collect_failpoint_sites(modules: List[Module]) -> List[Tuple[Module, ast.Call, str]]:
+    out = []
+    for mod in modules:
+        if mod.rel == "karpenter_tpu/failpoints.py":
+            continue  # the framework's own docstring examples
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            f = node.func
+            is_site_call = (
+                isinstance(f, ast.Attribute)
+                and f.attr in _SITE_FUNCS
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("failpoints", "FAILPOINTS")
+            )
+            if not is_site_call:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                out.append((mod, node, first.value))
+    return out
+
+
+def _collect_feature_flags(modules: List[Module]) -> List[Tuple[Module, ast.AST, str]]:
+    out = []
+    for mod in modules:
+        if mod.rel != "karpenter_tpu/solver/rpc.py":
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "features" \
+                    and isinstance(node.value, (ast.List, ast.Tuple)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                        out.append((mod, elt, elt.value))
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "append" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "features" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                    out.append((mod, arg, arg.value))
+    return out
+
+
+def check(modules: List[Module]) -> List[Violation]:
+    out: List[Violation] = []
+    metrics_doc = _doc_text(METRICS_DOC)
+    ops_doc = _doc_text(FAILPOINTS_DOC)
+    docs_all = "\n".join(
+        p.read_text() for p in sorted((REPO_ROOT / "docs").glob("*.md")))
+
+    seen: Set[Tuple[str, str]] = set()
+    for mod, node, name in _collect_metric_families(modules):
+        if ("metric", name) in seen:
+            continue
+        seen.add(("metric", name))
+        # backtick-exact, like the failpoint check below: a plain substring
+        # test would let a name that PREFIXES a documented family pass
+        # (e.g. karpenter_journal_writes inside karpenter_journal_writes_total)
+        if f"`{name}`" not in metrics_doc:
+            out.append(mod.violation(
+                "registry/metric-undocumented", node,
+                f"metric family {name} is not in {METRICS_DOC}; run "
+                "`python hack/metrics_gen.py` (and add its module to the "
+                "generator's import list if it is new)"))
+    for mod, node, site in _collect_failpoint_sites(modules):
+        if ("site", site) in seen:
+            continue
+        seen.add(("site", site))
+        if f"`{site}`" not in ops_doc:
+            out.append(mod.violation(
+                "registry/failpoint-undocumented", node,
+                f"failpoint site {site} is not in the site table in "
+                f"{FAILPOINTS_DOC}"))
+    for mod, node, flag in _collect_feature_flags(modules):
+        if ("feature", flag) in seen:
+            continue
+        seen.add(("feature", flag))
+        if flag not in docs_all:
+            out.append(mod.violation(
+                "registry/feature-undocumented", node,
+                f"RPC feature flag {flag!r} is advertised by the server but "
+                "documented nowhere under docs/"))
+    return out

@@ -29,7 +29,7 @@ def now() -> float:
 class ObjectMeta:
     name: str = ""
     namespace: str = ""
-    uid: str = field(default_factory=lambda: str(uuid.uuid4()))
+    uid: str = field(default_factory=lambda: generate_uid())
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     finalizers: List[str] = field(default_factory=list)
@@ -203,6 +203,31 @@ def generate_name(prefix: str) -> str:
     if _name_rng is not None:
         return f"{prefix}{_name_rng.getrandbits(32):08x}"
     return f"{prefix}{uuid.uuid4().hex[:8]}"
+
+
+# object uids draw from their OWN seeded stream for the same reason the
+# intent tokens below do: every object construction mints a uid, and
+# sharing the name rng would shift every generated name -- invalidating
+# the committed golden decision digests for a change that never touches
+# a decision. Uids are identity-only (cache keys, owner references) and
+# never enter decision lines, but a replay that logs or diffs raw
+# objects deserves byte-identical output too. Unseeded stays uuid4.
+_uid_rng = None
+
+
+def seed_object_uids(seed: Optional[int]) -> None:
+    if seed is None:
+        globals()["_uid_rng"] = None
+    else:
+        import random
+
+        globals()["_uid_rng"] = random.Random(f"object-uids:{seed}")
+
+
+def generate_uid() -> str:
+    if _uid_rng is not None:
+        return str(uuid.UUID(int=_uid_rng.getrandbits(128), version=4))
+    return str(uuid.uuid4())
 
 
 # THE idempotency-token key: stamped on the claim as an annotation (to

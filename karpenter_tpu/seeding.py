@@ -31,11 +31,14 @@ def apply(seed: Optional[int]) -> None:
     (None restores the production defaults where they exist). Process
     policy, like the tracer config: the last caller wins."""
     from karpenter_tpu import tracing
-    from karpenter_tpu.apis.objects import seed_intent_tokens, seed_object_names
+    from karpenter_tpu.apis.objects import (seed_intent_tokens,
+                                            seed_object_names,
+                                            seed_object_uids)
     from karpenter_tpu.failpoints import FAILPOINTS
 
     seed_object_names(seed)
     seed_intent_tokens(seed)
+    seed_object_uids(seed)
     if seed is not None:
         FAILPOINTS.seed = seed
         tracing.TRACER.configure(rng=seeded_rng("tracing", seed).random)
@@ -49,7 +52,8 @@ def snapshot() -> tuple:
     from karpenter_tpu.failpoints import FAILPOINTS
 
     return (
-        objects._name_rng, objects._token_rng, FAILPOINTS.seed,
+        objects._name_rng, objects._token_rng, objects._uid_rng,
+        FAILPOINTS.seed,
         tracing.TRACER._rng, tracing.TRACER.enabled, tracing.TRACER.sample,
     )
 
@@ -59,8 +63,9 @@ def restore(token: tuple) -> None:
     from karpenter_tpu.apis import objects
     from karpenter_tpu.failpoints import FAILPOINTS
 
-    name_rng, token_rng, fp_seed, t_rng, t_enabled, t_sample = token
+    name_rng, token_rng, uid_rng, fp_seed, t_rng, t_enabled, t_sample = token
     objects._name_rng = name_rng
     objects._token_rng = token_rng
+    objects._uid_rng = uid_rng
     FAILPOINTS.seed = fp_seed
     tracing.TRACER.configure(enabled=t_enabled, sample=t_sample, rng=t_rng)

@@ -131,7 +131,9 @@ def cleanup_stale(directory: Optional[str] = None) -> int:
     directory = directory or default_dir()
     removed = 0
     try:
-        names = os.listdir(directory)
+        # sorted: the sweep's unlink order (and its log lines) must not
+        # depend on readdir order -- the janitor runs inside seeded tests
+        names = sorted(os.listdir(directory))
     except OSError:
         return 0
     for name in names:

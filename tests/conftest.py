@@ -20,6 +20,18 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# Runtime lock-order witness (karpenter_tpu/analysis/witness.py): installed
+# BEFORE any karpenter_tpu module import so module-level locks are wrapped
+# too. Default ON for every pytest run (tier-1 included) -- the whole suite
+# doubles as the witness's schedule generator, and the session fixture
+# below asserts zero inversions at teardown. KARPENTER_TPU_LOCK_WITNESS=0
+# disables; =strict raises AT the inverted acquire instead of collecting.
+_WITNESS_MODE = os.environ.get("KARPENTER_TPU_LOCK_WITNESS", "1")
+if _WITNESS_MODE != "0":
+    from karpenter_tpu.analysis import witness as _witness
+
+    _witness.install(strict=_WITNESS_MODE == "strict")
+
 # py3.10 compat: tomllib landed in the stdlib in 3.11; the container ships
 # tomli (the library tomllib was vendored from, same API). Alias it so the
 # bootstrap suites' `import tomllib` works on both.
@@ -52,6 +64,19 @@ def pytest_collection_modifyitems(config, items):
 
 
 import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def lock_order_witness():
+    """Zero-inversion gate: any two package lock sites acquired in both
+    orders ANYWHERE in the session fail it with both stacks. (The static
+    pass proves the resolvable call graph cycle-free; this covers the
+    dynamic edges -- callbacks, injected functions -- it cannot see.)"""
+    yield
+    if _WITNESS_MODE != "0":
+        from karpenter_tpu.analysis import witness
+
+        assert not witness.inversions(), witness.report()
 
 
 @pytest.fixture()

@@ -1,0 +1,75 @@
+"""Seeded determinism violations: every determinism/* rule must fire on
+this file (tests/test_analysis.py asserts the exact rule set). NOT
+imported by anything -- the checkers parse it."""
+import glob
+import os
+import random
+import time
+import time as _time
+import uuid
+from datetime import datetime
+from datetime import datetime as dt
+from random import choice
+
+import numpy as np
+
+_decoy_rng = None
+
+
+def fresh_id():
+    return str(uuid.uuid4())  # determinism/uuid4: no *_rng in scope
+
+
+def seeded_arm_id():
+    # determinism/uuid4: reads a *_rng stream but the call sits on the
+    # SEEDED arm, not the unseeded fallback -- the loose-exemption trap
+    if _decoy_rng is not None:
+        return f"{_decoy_rng.getrandbits(8):x}-{uuid.uuid4().hex}"
+    return "fixed"
+
+
+def jitter():
+    return random.random()  # determinism/random: process-global entropy
+
+
+def np_draw():
+    return np.random.randint(10)  # determinism/random: global numpy stream
+
+
+def stamp():
+    return time.time()  # determinism/wallclock: not a now()/_now() seam
+
+
+def born():
+    return datetime.now()  # determinism/wallclock
+
+
+def aliased_stamp():
+    return _time.time()  # determinism/wallclock: an alias cannot launder it
+
+
+def aliased_born():
+    return dt.now()  # determinism/wallclock: from-import alias
+
+
+def aliased_pick(xs):
+    return choice(xs)  # determinism/random: from-imported entropy draw
+
+
+def listing(d):
+    return [p for p in glob.glob(d)]  # determinism/iter-order: unsorted listing
+
+
+def scan(d):
+    for entry in os.listdir(d):  # determinism/iter-order: unsorted listing
+        yield entry
+
+
+def set_loop(items):
+    for x in set(items):  # determinism/iter-order: PYTHONHASHSEED order
+        return x
+    return None
+
+
+def set_comp(items):
+    return [x for x in {i.strip() for i in items}]  # determinism/iter-order

@@ -1,0 +1,51 @@
+"""The sanctioned counterparts of det_bad.py: zero determinism/*
+violations (tests/test_analysis.py asserts the checker stays quiet --
+the exemptions are contract, not accident)."""
+import glob
+import random
+import time
+import uuid
+
+_name_rng = None
+
+
+def seeded_stream(seed):
+    return random.Random(f"fixture:{seed}")  # seeded construction: exempt
+
+
+def generate_name(prefix):
+    # the documented unseeded-fallback shape: uuid4 on the arm where the
+    # *_rng stream is None (the production default)
+    if _name_rng is not None:
+        return f"{prefix}{_name_rng.getrandbits(32):08x}"
+    return f"{prefix}{uuid.uuid4().hex[:8]}"
+
+
+def generate_token():
+    # the inverted spelling of the same fallback shape
+    if _name_rng is None:
+        return f"tk-{uuid.uuid4().hex}"
+    return f"tk-{_name_rng.getrandbits(64):016x}"
+
+
+def now():
+    return time.time()  # the named clock seam
+
+
+def duration(t0):
+    return time.monotonic() - t0  # durations never feed decisions
+
+
+def elapsed(t0):
+    import time as _t
+
+    return _t.perf_counter() - t0  # aliased duration clock: still exempt
+
+
+def listing(d):
+    # listing inside a sorted() argument: the sort erases readdir order
+    return sorted(p for p in glob.glob(d) if p.endswith(".jsonl"))
+
+
+def ordered(items):
+    return sorted(set(items))  # set is order-erased by the sort

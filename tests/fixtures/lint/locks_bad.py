@@ -1,0 +1,75 @@
+"""Seeded lock-discipline violations: an A<->B order cycle (via one
+direct nesting and one call-through edge), a second cycle built from
+explicit acquire()/release() sections, a non-reentrant self-deadlock
+reachable through a callee, and mixed-guard attribute writes (one plain,
+one through tuple unpacking)."""
+import threading
+
+ALPHA = threading.Lock()
+BETA = threading.Lock()
+GAMMA = threading.Lock()
+DELTA = threading.Lock()
+EPSILON = threading.Lock()
+
+
+def alpha_then_beta():
+    with ALPHA:
+        with BETA:  # edge ALPHA -> BETA (nested with)
+            pass
+
+
+def beta_then_alpha():
+    with BETA:
+        take_alpha()  # edge BETA -> ALPHA (call-through footprint)
+
+
+def take_alpha():
+    with ALPHA:
+        pass
+
+
+def delta_then_epsilon():
+    DELTA.acquire()  # explicit acquire holds DELTA for the section
+    try:
+        with EPSILON:  # edge DELTA -> EPSILON
+            pass
+    finally:
+        DELTA.release()
+
+
+def epsilon_then_delta():
+    with EPSILON:
+        DELTA.acquire()  # edge EPSILON -> DELTA: the explicit-form cycle
+        DELTA.release()
+
+
+def outer():
+    with GAMMA:
+        inner()  # GAMMA is non-reentrant: self-deadlock through the callee
+
+
+def inner():
+    with GAMMA:
+        pass
+
+
+class Tally:
+    """self.count guarded in inc() but written bare in reset(): the
+    locks/mixed-guard shape (the scrape-vs-observe race, distilled).
+    self.total takes its unlocked write through tuple unpacking."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+
+    def inc(self):
+        with self._lock:
+            self.count = self.count + 1
+            self.total += 1.0
+
+    def reset(self):
+        self.count = 0
+
+    def clear(self):
+        self.count, self.total = 0, 0.0

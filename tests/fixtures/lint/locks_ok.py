@@ -1,0 +1,66 @@
+"""Clean lock usage: consistent ordering, RLock reentrancy, init-time
+writes -- the locks checker must stay quiet here."""
+import threading
+
+FIRST = threading.Lock()
+SECOND = threading.Lock()
+RE = threading.RLock()
+
+
+def ordered_a():
+    with FIRST:
+        with SECOND:
+            pass
+
+
+def ordered_b():
+    with FIRST:
+        with SECOND:
+            pass
+
+
+def reentrant_outer():
+    with RE:
+        reentrant_inner()  # RLock self-edge is reentrancy, not deadlock
+
+
+def reentrant_inner():
+    with RE:
+        pass
+
+
+def explicit_same_order():
+    # explicit acquire/release in the SAME order as ordered_a/b: still clean
+    FIRST.acquire()
+    try:
+        with SECOND:
+            pass
+    finally:
+        FIRST.release()
+
+
+def try_acquire_out_of_order():
+    with SECOND:
+        # a try-acquire is the sanctioned out-of-order pattern: no edge
+        FIRST.acquire(blocking=False)
+        FIRST.release()
+
+
+class Guarded:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # constructor writes are pre-publication
+        self.low = 0
+        self.high = 0
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def window(self, lo, hi):
+        with self._lock:
+            self.low, self.high = lo, hi  # tuple write, still under the lock

@@ -1,0 +1,24 @@
+"""Seeded registry-drift violations: a metric family, a failpoint site,
+and an RPC feature flag that no docs table mentions. Tests load this
+under a forged rel of solver/rpc.py so the feature-flag scan applies."""
+from karpenter_tpu import failpoints, metrics
+
+UNDOCUMENTED = metrics.REGISTRY.counter(
+    "karpenter_lintfixture_never_documented_total", "not in docs/metrics.md"
+)
+
+# a PREFIX of a documented family (karpenter_journal_writes_total): the
+# match must be backtick-exact, not substring, for this to fire
+PREFIX_OF_DOCUMENTED = metrics.REGISTRY.counter(
+    "karpenter_journal_writes", "prefix of a documented family"
+)
+
+
+def poke():
+    failpoints.eval("lintfixture.site.never.documented")
+
+
+def handshake():
+    features = ["lintfixture-feature-never-documented"]
+    features.append("lintfixture-appended-feature-never-documented")
+    return features

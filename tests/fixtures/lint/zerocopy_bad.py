@@ -1,0 +1,33 @@
+"""Seeded zero-copy violations. The checker's scope is the HOT_PATH
+manifest (keyed by the REAL framing files), so tests load this source
+under a forged rel of solver/rpc.py (top-level functions scanned) and
+solver/shm.py (RingEndpoint methods scanned)."""
+
+
+def _send_frame(sock, views):
+    header = b"".join(views)  # zerocopy: joining copy
+    sock.sendall(header)
+
+
+def _recv_frame(sock, view):
+    data = bytes(view[4:])  # zerocopy: bytes(buffer-slice) copies
+    return data.tobytes() if hasattr(data, "tobytes") else data  # zerocopy: tobytes
+
+
+def _recv_exact(sock, n):
+    buf = bytearray(n)
+    return bytes(n)  # ALLOWED: bytes(size) preallocates, no violation
+
+
+class RingEndpoint:
+    def sendmsg(self, buffers):
+        flat = b"".join(buffers)  # zerocopy: joining copy in ring sendmsg
+        return len(flat)
+
+    def recv_into(self, view):
+        chunk = view.tobytes()  # zerocopy: tobytes on the ring read path
+        return len(chunk)
+
+    def recv(self, n):
+        # NOT in the manifest for RingEndpoint: the compat shim may copy
+        return bytes(bytearray(n))
