@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm bench-wire benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos sim-corpus lint typecheck
+.PHONY: test deflake benchmark bench-warm bench-wire benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos overload sim-corpus lint typecheck
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -57,6 +57,9 @@ chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration c
 
 crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection -- under the lock-order witness (zero inversions asserted at session end); diverging traces ddmin-shrink into crash-artifacts/ (full-length chain soak stays behind -m slow)
 	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
+
+overload:  ## overload storm soak: 10x offered load against the deadline-budgeted tick (p99 <= 2x deadline, zero pods lost, admitted-prefix bit-identity, brownout ladder + stuck-tick watchdog escalation, bounded interruption intake, shm send timeout) under the lock-order witness; a diverging storm replay ddmin-shrinks into overload-artifacts/
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_OVERLOAD_ARTIFACTS=overload-artifacts $(PYTEST) tests/test_overload.py -q -m 'not slow' $(call STAMP,overload)
 
 sim-corpus:  ## differential-replay the committed scenario corpus (host vs wire vs pipelined, golden digests); shrinks any failing trace into sim-artifacts/
 	$(PY) -m karpenter_tpu sim corpus --dir tests/golden/scenarios --artifacts sim-artifacts $(call STAMP,sim-corpus)

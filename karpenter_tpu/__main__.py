@@ -28,6 +28,9 @@ def build_operator(args):
         reserved_nics=args.reserved_nics,
         isolated_network=args.isolated_network,
         pipelined_scheduling=getattr(args, "pipelined_scheduling", True),
+        tick_deadline=getattr(args, "tick_deadline", 0.0),
+        admission_max_pods=getattr(args, "admission_max_pods", 0),
+        launch_max_groups=getattr(args, "launch_max_groups", 0),
         tracing=getattr(args, "tracing", True),
         tracing_sample=getattr(args, "trace_sample", 0.2),
         tracing_slow_ms=getattr(args, "trace_slow_ms", 1000.0),
@@ -228,6 +231,25 @@ def main(argv=None) -> int:
         help="half-open probe backoff cap (seconds)",
     )
     parser.add_argument(
+        "--tick-deadline", type=float, default=0.0,
+        help="per-tick deadline budget in seconds (0 disables): arms the "
+        "overload subsystem -- hierarchical stage budgets that clamp the "
+        "solver wire's read timeout, deadline-sized admission shedding, "
+        "the brownout ladder (disruption -> tracing -> delta staging), "
+        "and the stuck-tick watchdog (cancel -> breaker-open -> crash)",
+    )
+    parser.add_argument(
+        "--admission-max-pods", type=int, default=0,
+        help="bounded admission: at most this many pending pods solved "
+        "per tick; over the cap a deterministic priority/age-ordered "
+        "prefix solves and the rest defer to later ticks (0 = unbounded)",
+    )
+    parser.add_argument(
+        "--launch-max-groups", type=int, default=0,
+        help="bounded launch fan-out: at most this many decision groups "
+        "launch per tick; deferred groups' pods stay pending (0 = unbounded)",
+    )
+    parser.add_argument(
         "--failpoints", default="",
         help="arm fault-injection sites for game-day drills, e.g. "
         "'rpc.server.dispatch=latency(0.05):p=0.3;instance.launch="
@@ -321,6 +343,15 @@ def main(argv=None) -> int:
         # /debug/journal: the crash-consistency intent journal (open
         # write-ahead records + the recently-resolved ring)
         health.journal_info = op.journal.describe
+        # /debug/overload: deadline/admission bounds + brownout/watchdog
+        health.overload_info = op.describe_overload
+    if op.watchdog is not None:
+        # the stuck-tick watchdog's background thread is a wall-clock
+        # deployment concern -- deterministic rigs drive check_now().
+        # Its crash escalation raises OperatorCrashed in the run loop
+        # below; nothing here may catch it (the process dies, the
+        # supervisor restarts it, and the recovery sweep takes over).
+        op.watchdog.start()
     # latency GC policy: the provider graph and (if enabled) the jax
     # runtime are now the long-lived baseline; freeze it and stop gen2
     # collections from landing inside scheduling ticks
@@ -372,6 +403,8 @@ def main(argv=None) -> int:
         if args.max_ticks and ticks >= args.max_ticks:
             break
         op.wait_for_work(args.tick_interval)
+    if op.watchdog is not None:
+        op.watchdog.stop()
     if health is not None:
         health.stop()
     if recorder is not None:

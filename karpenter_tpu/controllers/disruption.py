@@ -86,12 +86,18 @@ class DisruptionController:
         feature_gates: Optional[dict] = None,
         evaluator=None,
         recorder=None,
+        brownout=None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
         self.pricing = pricing
         self.feature_gates = feature_gates or {}
         self.recorder = recorder  # optional events.Recorder
+        # optional overload.BrownoutController: consolidation/disruption
+        # is the brownout ladder's FIRST shed (rung 1) -- under sustained
+        # tick-deadline pressure the whole sweep stands down (counted)
+        # until the ladder recovers hysteretically
+        self.brownout = brownout
         # batched device evaluator (solver/consolidate.py): all candidate
         # sets are judged in one dispatch; candidates with stateful
         # constraints fall back to the per-candidate oracle simulation
@@ -357,6 +363,16 @@ class DisruptionController:
 
         from karpenter_tpu import metrics, tracing
 
+        if self.brownout is not None and self.brownout.sheds_disruption():
+            # brownout ladder rung 1: the sweep stands down entirely --
+            # consolidation is strictly optional work, and its candidate
+            # simulations are exactly the host-side cost a pressured tick
+            # cannot afford. Nothing is lost: candidates re-judge once
+            # the ladder recovers.
+            metrics.OVERLOAD_SKIPPED_SWEEPS.inc(stage="disruption")
+            tracing.annotate(disruption="shed-brownout")
+            self.last_decisions = []
+            return []
         t0 = _time.perf_counter()
         try:
             with tracing.span("disruption"):

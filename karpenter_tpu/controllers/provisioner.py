@@ -87,6 +87,7 @@ class Provisioner:
     def __init__(
         self, cluster: Cluster, cloud_provider: CloudProvider, solver=None,
         recorder=None, pipeline: Optional[bool] = None, journal=None,
+        admission_max_pods: int = 0, launch_max_groups: int = 0,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -123,6 +124,21 @@ class Provisioner:
         # the next tick's drain barrier
         self._inflight = None
         self._sustained = False
+        # bounded admission (overload tentpole, karpenter_tpu/overload.py):
+        # admission_max_pods caps how many pending pods one tick may solve
+        # (0 = unbounded); launch_max_groups caps the launch fan-out in
+        # whole decision groups (0 = unbounded). Over the caps, the tick
+        # solves a deterministic priority/age-ordered PREFIX and defers
+        # the rest -- see _admit.
+        self.admission_max_pods = int(admission_max_pods)
+        self.launch_max_groups = int(launch_max_groups)
+        # EWMA of the per-pod solve cost (seconds/pod), fed by
+        # _apply_decision: the deadline-budget admission sizing divides
+        # the tick's solve budget by this to size the admitted prefix
+        self._solve_cost_ewma = 0.0
+        # last logged shed shape, so a sustained storm logs level changes
+        # rather than one line per tick
+        self._last_shed_logged: Optional[tuple] = None
 
     # -- snapshot -----------------------------------------------------------
     def _existing_nodes(self) -> List[ExistingNode]:
@@ -194,12 +210,15 @@ class Provisioner:
         # crash site: the operator dies at the top of the provisioner
         # dispatch (nothing launched yet; restart must re-simulate cleanly)
         failpoints.eval("crash.provisioner.dispatch")
+        # stall site: the tick WEDGES here (before any solver dispatch) --
+        # the stuck-tick watchdog's escalation drill
+        failpoints.eval("stall.provisioner.solve")
         # pipeline barrier FIRST: the decision dispatched last tick lands
         # and its claims launch before this tick snapshots, so the new
         # snapshot sees that capacity in flight (drain-before-snapshot --
         # see __init__) and no two batches ever overlap
         prev = self._drain_pipeline()
-        pods = self.cluster.pending_pods()
+        pods = self._admit(self.cluster.pending_pods())
         result = SchedulingResult()
         if not pods:
             self._sustained = False
@@ -290,6 +309,62 @@ class Provisioner:
             decision, vol_blocked, time.perf_counter() - t0, len(pods)
         )
 
+    # bounded-admission progress floor: even a fully blown deadline budget
+    # admits this many pods, so a storm can never starve provisioning
+    MIN_ADMIT = 8
+
+    def _admit(self, pods: List) -> List:
+        """Bounded admission with priority-aware shedding (the overload
+        tentpole): when the pending set exceeds what this tick can solve
+        -- the explicit admission cap, or what the tick-deadline budget
+        can afford at the EWMA per-pod solve cost -- solve a
+        deterministic priority/age-ordered PREFIX and defer the rest.
+        Deferred pods simply stay pending and re-enter next tick's
+        ordering, so nothing is lost, only delayed; as placed pods leave
+        the pending set, the FIFO-within-priority order guarantees every
+        deferred pod eventually admits.
+
+        The prefix is a pure function of the pod set -- priority desc,
+        creation asc, name asc, with creation stamps from the injectable
+        cluster clock -- so sim replays shed identically on every
+        backend, and the admitted prefix's decision is bit-identical to
+        an unloaded solve of those same pods (it flows through exactly
+        the same solve)."""
+        from karpenter_tpu import overload
+
+        n = len(pods)
+        limit, reason = n, ""
+        if 0 < self.admission_max_pods < limit:
+            limit, reason = self.admission_max_pods, "admission-cap"
+        budget = overload.current()
+        if budget is not None and self._solve_cost_ewma > 0.0:
+            afford = max(
+                self.MIN_ADMIT, int(budget.solve_budget() / self._solve_cost_ewma)
+            )
+            if afford < limit:
+                limit, reason = afford, "deadline"
+        if limit >= n:
+            metrics.OVERLOAD_DEFERRED.set(0.0)
+            self._last_shed_logged = None
+            return pods
+        admitted = sorted(
+            pods,
+            key=lambda p: (
+                -p.priority, p.metadata.creation_timestamp, p.metadata.name,
+            ),
+        )[:limit]
+        shed = n - limit
+        metrics.OVERLOAD_SHED.inc(shed, reason=reason)
+        metrics.OVERLOAD_DEFERRED.set(float(shed))
+        tracing.annotate(admitted=limit, shed=shed, shed_reason=reason)
+        if self._last_shed_logged != (limit, reason):
+            self._last_shed_logged = (limit, reason)
+            self.log.info(
+                "overload: admission shed", admitted=limit, shed=shed,
+                reason=reason,
+            )
+        return admitted
+
     def _annotate_group_stats(self, sp) -> None:
         """Surface the solver's dirty-tracking grouping stats (incremental
         tick engine) on the dispatch span: how much of the pending set
@@ -346,6 +421,16 @@ class Provisioner:
     ) -> SchedulingResult:
         result.unschedulable.update(vol_blocked)
         metrics.SCHEDULING_DURATION.observe(duration_s)
+        if n_pods > 0 and duration_s > 0:
+            # per-pod solve cost EWMA: the deadline-budget admission
+            # sizing's denominator (_admit). Alpha 0.3: reactive enough to
+            # track a degrading sidecar within a few ticks, smooth enough
+            # that one outlier tick does not collapse admission.
+            per_pod = duration_s / n_pods
+            self._solve_cost_ewma = (
+                per_pod if self._solve_cost_ewma <= 0.0
+                else 0.7 * self._solve_cost_ewma + 0.3 * per_pod
+            )
         metrics.IGNORED_PODS.set(len(result.unschedulable))
         self._publish_unschedulable(result)
         # existing-node decisions hint the binder directly (node names).
@@ -392,9 +477,28 @@ class Provisioner:
     MAX_CONCURRENT_LAUNCHES = 10
 
     def _launch(self, result: SchedulingResult) -> None:
+        from karpenter_tpu import failpoints
+
+        # stall site: the launch fan-out WEDGES before any cloud call
+        # (watchdog escalation drill; nothing is in flight yet)
+        failpoints.eval("stall.launch")
         groups = result.new_groups
         if not groups:
             return
+        if 0 < self.launch_max_groups < len(groups):
+            # bounded launch fan-out (overload tentpole): whole decision
+            # groups past the bound are DEFERRED -- their claims are never
+            # created, their pods simply stay pending and re-solve next
+            # tick. The launched prefix's decision is untouched.
+            deferred = groups[self.launch_max_groups:]
+            groups = groups[: self.launch_max_groups]
+            n_deferred = sum(len(g.pods) for g in deferred)
+            metrics.OVERLOAD_SHED.inc(n_deferred, reason="launch-bound")
+            self.log.info(
+                "overload: launch fan-out bound",
+                launched_groups=len(groups), deferred_groups=len(deferred),
+                deferred_pods=n_deferred,
+            )
         with tracing.span("launch", groups=len(groups)):
             self._launch_groups(result, groups)
 

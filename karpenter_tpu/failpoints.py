@@ -31,6 +31,14 @@ Actions:
   connection-drop at stream sites).
 - ``kill_after(N)``        -- pass through N evaluations, then raise on
   every one after (a sidecar that dies mid-run and stays dead).
+- ``stall(seconds)``       -- a WEDGED stage, not mere latency: sleep the
+  armed duration (default 60 s) in 10 ms slices so the stuck-tick
+  watchdog's escalation (an async-raised ``OperatorCrashed`` --
+  karpenter_tpu/overload.py) can land mid-stall; one long sleep would
+  defer the kill to the stall's end. Sites on the tick's hot path:
+  ``stall.provisioner.solve`` (the provisioner wedges before its solver
+  dispatch), ``stall.launch`` (the launch fan-out wedges before any
+  cloud call).
 - ``crash``                -- raise ``OperatorCrashed`` (a BaseException:
   nothing on the controller paths may swallow it): the operator process
   dies mid-tick at this site, abandoning whatever was in flight. Drivers
@@ -106,7 +114,8 @@ class Failpoint:
     def __init__(self, site: str, action: str, arg: Optional[str] = None, *,
                  times: Optional[int] = None, after: int = 0, p: float = 1.0,
                  seed: int = 0):
-        if action not in ("error", "latency", "corrupt", "drop", "kill_after", "crash"):
+        if action not in ("error", "latency", "corrupt", "drop", "kill_after",
+                          "crash", "stall"):
             raise ValueError(f"unknown failpoint action {action!r}")
         if action == "drop":
             action, arg = "error", (arg or "ConnectionError")
@@ -252,6 +261,14 @@ class FailpointRegistry:
         self._record(fp)
         if fp.action == "latency":
             time.sleep(float(fp.arg or 0.01))
+            return
+        if fp.action == "stall":
+            # sliced sleep: an async-raised OperatorCrashed (watchdog
+            # escalation) lands at a bytecode boundary, so the wedge must
+            # surface one every ~10 ms instead of parking in one sleep
+            deadline = time.monotonic() + float(fp.arg or 60.0)
+            while time.monotonic() < deadline:
+                time.sleep(0.01)
             return
         if fp.action == "crash":
             raise OperatorCrashed(f"failpoint {site} crashed the operator")

@@ -403,6 +403,69 @@ FENCING_REJECTED = REGISTRY.counter(
     "fencing epoch trailed the lease's (a deposed leader failing closed)",
     labels=("op",),  # create_fleet | terminate_instances | create_tags
 )
+# overload control (karpenter_tpu/overload.py): tick deadline budgets,
+# priority-aware shedding, the brownout ladder, the stuck-tick watchdog
+OVERLOAD_SHED = REGISTRY.counter(
+    "karpenter_overload_shed_total",
+    "Pending pods deferred to a later tick by bounded admission (the "
+    "overload tentpole): admission-cap = the explicit per-tick intake "
+    "bound; deadline = the tick-deadline budget could not afford the "
+    "whole pending set; launch-bound = whole decision groups past the "
+    "launch fan-out bound. Deferred pods stay pending and re-admit in "
+    "priority/age order -- nothing is lost, only delayed",
+    labels=("reason",),  # admission-cap | deadline | launch-bound
+)
+OVERLOAD_DEFERRED = REGISTRY.gauge(
+    "karpenter_overload_deferred_pods",
+    "Pending pods the LAST provisioner tick deferred past its admission "
+    "bound (0 = the whole pending set was admitted)",
+)
+OVERLOAD_BROWNOUT_LEVEL = REGISTRY.gauge(
+    "karpenter_overload_brownout_level",
+    "Brownout ladder level (0 normal, 1 disruption sweeps shed, 2 + "
+    "trace sampling shed, 3 + delta-epoch staging shed); recovers "
+    "hysteretically -- see docs/operations.md overload runbook",
+)
+OVERLOAD_BROWNOUT_TRANSITIONS = REGISTRY.counter(
+    "karpenter_overload_brownout_transitions_total",
+    "Brownout ladder transitions by destination level name",
+    labels=("to",),  # normal | shed-disruption | shed-tracing | shed-delta
+)
+OVERLOAD_SKIPPED_SWEEPS = REGISTRY.counter(
+    "karpenter_overload_skipped_sweeps_total",
+    "Optional controller sweeps stood down by the brownout ladder",
+    labels=("stage",),  # disruption
+)
+OVERLOAD_WATCHDOG = REGISTRY.counter(
+    "karpenter_overload_watchdog_escalations_total",
+    "Stuck-tick watchdog escalations by ladder stage (cancel = solver "
+    "wire closed under the wedged tick; breaker-open = breaker forced "
+    "open; crash = OperatorCrashed async-raised so the restart recovery "
+    "sweep takes over)",
+    labels=("stage",),  # cancel | breaker-open | crash
+)
+OVERLOAD_TICK_OVERRUN = REGISTRY.histogram(
+    "karpenter_overload_tick_overrun_ratio",
+    "Tick duration over the configured tick deadline (1.0 = exactly on "
+    "budget; the brownout ladder's EWMA input)",
+    buckets=(0.1, 0.25, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0, 5.0, 10.0),
+)
+# bounded interruption intake (controllers/interruption.py)
+INTERRUPTION_DEFERRED = REGISTRY.counter(
+    "karpenter_interruption_deferred_total",
+    "Interruption sweeps whose per-sweep intake bound left messages for "
+    "the next sweep, counted when that sweep finds messages waiting "
+    "(bounded batch growth under an interruption storm; a bound landing "
+    "exactly on the last queued message counts nothing unless fresh "
+    "messages arrive in the gap)",
+)
+# bounded shm ring sends (solver/shm.py)
+WIRE_SHM_SEND_TIMEOUTS = REGISTRY.counter(
+    "karpenter_wire_shm_send_timeouts_total",
+    "Shared-memory ring sends abandoned because the peer reader never "
+    "freed ring space within the send deadline (a wedged reader; "
+    "surfaces as a ConnectionError feeding the shm->tcp degrade ladder)",
+)
 # scenario simulation & trace replay (karpenter_tpu/sim/)
 SIM_EVENTS = REGISTRY.counter(
     "karpenter_sim_replay_events_total",

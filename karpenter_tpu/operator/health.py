@@ -67,6 +67,12 @@ class HealthServer:
         # loopback-only -- the runbook's first stop after an operator
         # restart (docs/operations.md).
         self.journal_info = None
+        # optional () -> dict with the overload-control state (Operator
+        # .describe_overload: deadline/admission bounds, brownout ladder
+        # level + overrun EWMA, watchdog escalations). Served by
+        # /debug/overload, loopback-only -- the overload runbook's first
+        # stop during a storm (docs/operations.md).
+        self.overload_info = None
         self._started_at = time.monotonic()
         self._last_loop: float = 0.0   # 0 = run loop has not turned yet
         self._last_sweep: float = 0.0  # 0 = no full sweep completed yet
@@ -184,6 +190,11 @@ class HealthServer:
                     # describe_wire): grouping churn, delta shipping, the
                     # staging LRUs and their eviction counters
                     self._debug_json(outer.solver_info)
+                elif self.path == "/debug/overload":
+                    # overload control (karpenter_tpu/overload.py):
+                    # deadline/admission bounds, brownout ladder state,
+                    # watchdog escalation counts
+                    self._debug_json(outer.overload_info)
                 elif self.path == "/debug/journal":
                     # crash-consistency intent journal (karpenter_tpu/
                     # journal.py): open write-ahead intents + the

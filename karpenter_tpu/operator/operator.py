@@ -97,6 +97,23 @@ class Options:
     # replays of one trace produce byte-identical decision logs). None
     # (production default) leaves names on uuid4.
     seed: Optional[int] = None
+    # overload control (karpenter_tpu/overload.py). tick_deadline > 0 arms
+    # the per-tick deadline budget (decomposed into stage budgets on the
+    # trace span boundaries), the brownout ladder (EWMA of tick overrun
+    # sheds disruption sweeps, then trace sampling, then delta staging,
+    # recovering hysteretically), and the stuck-tick watchdog (a tick
+    # wedged past N x deadline escalates cancel -> breaker-open ->
+    # OperatorCrashed). 0 (the default) disables all three -- behavior is
+    # bit-identical to the pre-overload tree.
+    tick_deadline: float = 0.0
+    # bounded admission: at most this many pending pods admitted per
+    # provisioner tick; over the cap, a deterministic priority/age prefix
+    # solves and the rest defer (0 = unbounded). Deterministic -- the sim
+    # corpus pins storm digests through it.
+    admission_max_pods: int = 0
+    # bounded launch fan-out: at most this many decision groups launch
+    # per tick; deferred groups' pods stay pending (0 = unbounded)
+    launch_max_groups: int = 0
     feature_gates: dict = field(default_factory=lambda: {"ReservedCapacity": True, "SpotToSpotConsolidation": False})
 
 
@@ -144,6 +161,39 @@ class Operator:
         # binary points /healthz + /debug/breaker at
         # solver.breaker.describe when the wire topology is configured
         self.solver = solver
+        # overload-control subsystem (karpenter_tpu/overload.py), armed by
+        # Options.tick_deadline > 0: the brownout ladder observes every
+        # tick's budget overrun, and the watchdog escalates a wedged tick
+        # (cancel the wire -> force the breaker open -> OperatorCrashed,
+        # handing the restart recovery sweep the cleanup). The watchdog's
+        # background thread is the BINARY's concern (__main__ starts it);
+        # deterministic rigs drive check_now() themselves.
+        from karpenter_tpu import overload
+
+        self.brownout = None
+        self.watchdog = None
+        if self.options.tick_deadline > 0:
+            self.brownout = overload.BrownoutController(self.options.tick_deadline)
+            client = getattr(solver, "client", None) if solver is not None else None
+            # cancel must be OUT-OF-BAND (cancel_inflight): the wedged
+            # tick thread holds the client lock across its blocking read,
+            # so a lock-taking close() would block the watchdog itself --
+            # a client without cancel_inflight gets NO cancel rung (the
+            # breaker-open and crash escalations still fire) rather than
+            # one that wedges the watchdog
+            cancel = (
+                getattr(client, "cancel_inflight", None)
+                if client is not None else None
+            )
+            self.watchdog = overload.StuckTickWatchdog(
+                self.options.tick_deadline,
+                cancel=cancel,
+                breaker=getattr(solver, "breaker", None) if solver is not None else None,
+            )
+        # process policy, like the tracer config above: the last
+        # constructed Operator's brownout (or None) is what module-level
+        # consumers -- the solver client's delta shed -- observe
+        overload.install_brownout(self.brownout)
         # the coordination bus: the in-memory store by default; pass a
         # karpenter_tpu.kube.KubeCluster to run against a real apiserver
         # (the reference's kwok topology: real bus, emulated cloud)
@@ -214,6 +264,8 @@ class Operator:
         self.provisioner = Provisioner(
             self.cluster, self.cloud_provider, solver=solver, recorder=self.recorder,
             pipeline=self.options.pipelined_scheduling, journal=self.journal,
+            admission_max_pods=self.options.admission_max_pods,
+            launch_max_groups=self.options.launch_max_groups,
         )
         self.nodeclaim_lifecycle = NodeClaimLifecycleController(
             self.cluster, self.cloud_provider, recorder=self.recorder,
@@ -230,6 +282,7 @@ class Operator:
         self.disruption = DisruptionController(
             self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates,
             evaluator=consolidation_evaluator, recorder=self.recorder,
+            brownout=self.brownout,
         )
         # instance-id field index for interruption lookups, registered
         # exactly when the interruption queue is configured (reference
@@ -316,33 +369,67 @@ class Operator:
             self._recovery_pending = False
             self.fence.observe(self.fence.current())
             self.recovery.sweep()
-        from karpenter_tpu import tracing
+        from karpenter_tpu import overload, tracing
 
-        # the sweep is the trace ROOT: every controller's spans (the
-        # provisioner's drain/snapshot/dispatch/launch, the binder's bind,
-        # the disruption pass, batcher windows, solver + wire stages) nest
-        # under one "tick" tree, and the flight recorder judges slowness
-        # against the whole sweep
-        with tracing.trace("tick"):
-            self.nodeclass_controller.reconcile_all()
-            self.instance_type_refresh.reconcile()
-            self.pricing_refresh.reconcile()
-            self.version_controller.reconcile()
-            self.capacity_type_controller.reconcile_all()
-            self.reservation_expiration.reconcile_all()
-            self.interruption.reconcile()
-            self.repair.reconcile()
-            self.provisioner.reconcile()
-            self.nodeclaim_lifecycle.reconcile_all()
-            self.lifecycle.step()
-            self.binder.reconcile()
-            self.tagging.reconcile_all()
-            self.discovered_capacity.reconcile_all()
-            self.disruption.reconcile()
-            self.termination.reconcile_all()
-            self.garbage_collection.reconcile()
-            self.metrics_controller.reconcile_all()
+        # tick deadline budget (overload subsystem): built per sweep and
+        # threaded thread-locally so deep layers -- the solver client's
+        # read-timeout clamp, the provisioner's admission sizing -- shed
+        # work EARLY instead of timing out late. None when disabled.
+        budget = (
+            overload.TickBudget(self.options.tick_deadline)
+            if self.options.tick_deadline > 0 else None
+        )
+        if self.watchdog is not None:
+            self.watchdog.tick_started()
+        try:
+            # the sweep is the trace ROOT: every controller's spans (the
+            # provisioner's drain/snapshot/dispatch/launch, the binder's
+            # bind, the disruption pass, batcher windows, solver + wire
+            # stages) nest under one "tick" tree, and the flight recorder
+            # judges slowness against the whole sweep
+            with overload.active(budget), tracing.trace("tick"):
+                self.nodeclass_controller.reconcile_all()
+                self.instance_type_refresh.reconcile()
+                self.pricing_refresh.reconcile()
+                self.version_controller.reconcile()
+                self.capacity_type_controller.reconcile_all()
+                self.reservation_expiration.reconcile_all()
+                self.interruption.reconcile()
+                self.repair.reconcile()
+                self.provisioner.reconcile()
+                self.nodeclaim_lifecycle.reconcile_all()
+                self.lifecycle.step()
+                self.binder.reconcile()
+                self.tagging.reconcile_all()
+                self.discovered_capacity.reconcile_all()
+                self.disruption.reconcile()
+                self.termination.reconcile_all()
+                self.garbage_collection.reconcile()
+                self.metrics_controller.reconcile_all()
+        finally:
+            # the watchdog stands down and the brownout ladder sees the
+            # tick's overrun even when the sweep died mid-flight (a crash
+            # failpoint, the watchdog's own OperatorCrashed escalation)
+            if self.watchdog is not None:
+                self.watchdog.tick_finished()
+            if budget is not None and self.brownout is not None:
+                self.brownout.observe(budget.elapsed())
         return True
+
+    def describe_overload(self) -> dict:
+        """Overload-control state document for /debug/overload: the
+        configured bounds plus live brownout/watchdog state."""
+        doc: dict = {
+            "tick_deadline_s": self.options.tick_deadline,
+            "admission_max_pods": self.options.admission_max_pods,
+            "launch_max_groups": self.options.launch_max_groups,
+            "enabled": self.options.tick_deadline > 0,
+        }
+        if self.brownout is not None:
+            doc["brownout"] = self.brownout.describe()
+        if self.watchdog is not None:
+            doc["watchdog"] = self.watchdog.describe()
+        return doc
 
     def settle(self, max_ticks: int = 20, step_seconds: float = 3.0) -> int:
         """Tick until no pending pods or budget exhausted; returns ticks."""

@@ -103,7 +103,8 @@ def _percentile(samples: List[float], q: float) -> float:
 
 
 class _Engine:
-    def __init__(self, backend: str, seed: int, tmpdir: Optional[str] = None):
+    def __init__(self, backend: str, seed: int, tmpdir: Optional[str] = None,
+                 options_overrides: Optional[dict] = None):
         if backend not in BACKENDS + EXTRA_BACKENDS:
             raise ValueError(
                 f"unknown backend {backend!r} (want one of {BACKENDS + EXTRA_BACKENDS})"
@@ -111,6 +112,14 @@ class _Engine:
         self.backend = backend
         self.seed = seed
         self._tmpdir = tmpdir
+        # trace-header Options overrides, applied in build() through an
+        # explicit WHITELIST (the overload knobs): a trace must not be
+        # able to flip arbitrary process policy
+        self._overrides = dict(options_overrides or {})
+        # failpoint sites armed by `failpoint` events, disarmed at close()
+        # so a trace's fault schedule cannot leak into the next replay of
+        # a differential run (list, not set: disarm order stays stable)
+        self._armed_sites: List[str] = []
         self._own_tmpdir = None
         self._server = None
         self._client = None
@@ -145,6 +154,15 @@ class _Engine:
             interruption_queue="interruption-queue",
             tracing=False,
         )
+        for key, val in self._overrides.items():
+            # COUNT-based overload knobs only: both shed a pure function
+            # of the pod set, so digests stay machine-independent.
+            # tick_deadline is deliberately NOT accepted -- its shedding
+            # is sized from wall-clock EWMAs (per-pod solve cost, tick
+            # overrun), so a trace carrying it would shed a host-speed-
+            # dependent prefix and break byte-determinism.
+            if key in ("admission_max_pods", "launch_max_groups"):
+                setattr(options, key, int(val))
         self._options = options
         breaker_rng = seeding.seeded_rng("breaker", self.seed).random
         if self.backend == "host":
@@ -221,6 +239,8 @@ class _Engine:
         from karpenter_tpu.failpoints import FAILPOINTS
 
         for site in self.CRASH_SITES:
+            FAILPOINTS.disarm(site)
+        for site in self._armed_sites:
             FAILPOINTS.disarm(site)
         if self._breaker is not None:
             self._breaker.stop()
@@ -430,6 +450,18 @@ class _Engine:
                 from karpenter_tpu.failpoints import FAILPOINTS
 
                 FAILPOINTS.arm(ev["site"], "crash", times=1)
+            elif kind == "failpoint":
+                # arm a fault schedule mid-trace (the overload family's
+                # slow-sidecar windows). Wall-clock-only faults never
+                # touch decisions, so digests stay backend-identical;
+                # close() disarms every site named here.
+                from karpenter_tpu.failpoints import FAILPOINTS
+
+                FAILPOINTS.arm_spec(ev["spec"])
+                for pair in filter(None, (p.strip() for p in ev["spec"].split(";"))):
+                    site = pair.partition("=")[0].strip()
+                    if site and site not in self._armed_sites:
+                        self._armed_sites.append(site)
             elif kind == "operator_restart":
                 # clean restart between ticks (kill -9 while idle)
                 self._restart_operator()
@@ -522,11 +554,21 @@ class _Engine:
         return node.metadata.labels.get(label, "?") if node is not None else "?"
 
 
+def _header_options(events: List[dict]) -> Optional[dict]:
+    """The trace header's Options overrides, if any (sim/trace.py)."""
+    for ev in events:
+        if ev.get("ev") == "header":
+            opts = ev.get("options")
+            return opts if isinstance(opts, dict) else None
+    return None
+
+
 def replay(events: List[dict], backend: str = "host", seed: int = 0,
            tmpdir: Optional[str] = None) -> ReplayResult:
     """Replay `events` on one backend; raises InvariantViolation when the
     chaos contract breaks. Builds and tears down a fresh world."""
-    engine = _Engine(backend, seed, tmpdir)
+    engine = _Engine(backend, seed, tmpdir,
+                     options_overrides=_header_options(events))
     try:
         engine.build()
         return engine.run(events)

@@ -51,6 +51,10 @@ class ScenarioBuilder:
         self._seq = 0
         self._pods: List[Tuple[float, str]] = []  # (arrival t, name)
         self._pod_i = 0
+        # Operator Options overrides carried in the trace header; replay
+        # whitelists the COUNT-based overload knobs (admission_max_pods,
+        # launch_max_groups) -- see sim/trace.py
+        self._options: Dict[str, float] = {}
 
     # -- primitives ----------------------------------------------------------
     def at(self, t: float, event: dict) -> "ScenarioBuilder":
@@ -73,6 +77,12 @@ class ScenarioBuilder:
 
     def _random_size(self) -> Tuple[str, str]:
         return SIZES[int(self.rng.integers(0, len(SIZES)))]
+
+    def options(self, **kw) -> "ScenarioBuilder":
+        """Operator Options overrides for the replay, carried in the
+        trace header (whitelisted there to the overload knobs)."""
+        self._options.update(kw)
+        return self
 
     # -- workload generators -------------------------------------------------
     def poisson_arrivals(self, start: float, duration: float, rate_per_s: float,
@@ -120,6 +130,29 @@ class ScenarioBuilder:
         for i in range(n):
             cpu, mem = shapes[i % len(shapes)]
             self._pod(t, cpu, mem)
+        return self
+
+    def sustained_storm(self, start: float, duration: float, rate_per_s: float,
+                        labels: Optional[Dict] = None) -> "ScenarioBuilder":
+        """An arrival storm well past solver capacity -- the overload
+        family's driver. Same memoryless shape as poisson_arrivals; a
+        separate verb so scenarios read as what they model (the rate is
+        expected to exceed what bounded admission will take per tick, so
+        the pending set backs up and shedding engages)."""
+        return self.poisson_arrivals(start, duration, rate_per_s, labels)
+
+    def slow_sidecar(self, t: float, latency_s: float = 0.003,
+                     times: int = 12) -> "ScenarioBuilder":
+        """Arm wire latency at the sidecar dispatch site: each of the
+        next `times` solves pays `latency_s` before replying -- the
+        slow-sidecar half of the overload family. Wall-clock only: the
+        decisions (and therefore the digests) are identical on every
+        backend; what it exercises is the deadline budget's early-shed
+        path under a degraded wire."""
+        self.at(t, {
+            "ev": "failpoint",
+            "spec": f"rpc.server.dispatch=latency({latency_s}):times={times}",
+        })
         return self
 
     # -- chaos generators ----------------------------------------------------
@@ -196,6 +229,7 @@ class ScenarioBuilder:
         events: List[dict] = [{
             "ev": "header", "version": TRACE_VERSION, "scenario": self.name,
             "seed": self.seed, "tick_seconds": self.tick_seconds,
+            **({"options": dict(self._options)} if self._options else {}),
         }]
         if not self._timed:
             return events
@@ -289,6 +323,20 @@ def _scenario_crash_restart(seed: int) -> ScenarioBuilder:
     return b
 
 
+def _scenario_overload_storm(seed: int) -> ScenarioBuilder:
+    """Overload family: a sustained arrival storm well past what bounded
+    admission takes per tick, plus a slow-sidecar latency window. The
+    admission cap rides the trace header's options, so every backend
+    sheds the SAME deterministic priority/age prefix each tick -- the
+    committed golden digest pins that shed pods are re-admitted and
+    placed once the storm subsides, bit-identically across backends."""
+    b = ScenarioBuilder("overload-storm", seed)
+    b.options(admission_max_pods=12)
+    b.sustained_storm(start=0.0, duration=18.0, rate_per_s=4.0)
+    b.slow_sidecar(t=6.0, latency_s=0.003, times=12)
+    return b
+
+
 STANDARD_SCENARIOS = {
     "diurnal-small": _scenario_diurnal_small,
     "diurnal-medium": _scenario_diurnal_medium,
@@ -297,11 +345,14 @@ STANDARD_SCENARIOS = {
     "spread-burst": _scenario_spread_burst,
     "binpack-adversarial": _scenario_binpack_adversarial,
     "crash-restart": _scenario_crash_restart,
+    "overload-storm": _scenario_overload_storm,
 }
 
 # the committed corpus (tests/golden/scenarios/): small, fast, and one per
 # chaos family; diurnal-medium stays generate-on-demand (bench's stage)
-CORPUS_SCENARIOS = ("diurnal-small", "ice-storm", "interruption-wave")
+CORPUS_SCENARIOS = (
+    "diurnal-small", "ice-storm", "interruption-wave", "overload-storm",
+)
 DEFAULT_SEED = 20260803
 
 

@@ -31,6 +31,24 @@ Event vocabulary (version 1):
                                                # identity, lease takeover
                                                # after expiry, recovery
                                                # sweep on the win
+    {"ev": "failpoint",
+     "spec": "rpc.server.dispatch=latency(0.003):times=12"}
+                                               # arm a fault schedule
+                                               # mid-trace (the overload
+                                               # family's slow-sidecar
+                                               # windows); wall-clock-only
+                                               # faults never touch
+                                               # decisions, so digests
+                                               # stay backend-identical.
+                                               # The engine disarms the
+                                               # named sites at close.
+
+The header may carry an ``options`` object: Operator Options overrides
+for the replay, WHITELISTED by the engine to the COUNT-based overload
+knobs (``admission_max_pods``, ``launch_max_groups``) -- the
+overload-storm scenario pins its shedding digest through it.
+``tick_deadline`` is deliberately rejected: its shedding is sized from
+wall-clock EWMAs, which would make digests host-speed-dependent.
 
 `pick` selects a victim deterministically at APPLY time: index into the
 ready fleet ordered by node name (claim names are seed-deterministic, so
@@ -54,6 +72,7 @@ TRACE_VERSION = 1
 EVENT_KINDS = (
     "header", "advance", "pod_add", "pod_delete", "kill_node",
     "interruption", "ice", "price", "crash", "operator_restart",
+    "failpoint",
 )
 
 
@@ -73,6 +92,8 @@ def validate_event(ev: dict, lineno: int = 0) -> dict:
         raise TraceFormatError(f"line {lineno}: pod_add needs a pod object")
     if kind == "crash" and not (isinstance(ev.get("site"), str) and ev["site"]):
         raise TraceFormatError(f"line {lineno}: crash needs a failpoint site")
+    if kind == "failpoint" and not (isinstance(ev.get("spec"), str) and ev["spec"]):
+        raise TraceFormatError(f"line {lineno}: failpoint needs a spec string")
     if kind == "header" and ev.get("version") != TRACE_VERSION:
         raise TraceFormatError(
             f"line {lineno}: unsupported trace version {ev.get('version')!r}"

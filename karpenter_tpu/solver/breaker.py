@@ -105,21 +105,48 @@ class CircuitBreaker:
         with self._lock:
             self._consecutive += 1
             if self._state == CLOSED and self._consecutive >= self.failure_threshold:
-                self._transition(OPEN)
-                self.trips += 1
-                self._opened_at = self._clock()
-                self._backoff = self.backoff_base
-                self._schedule_probe()
-                self.log.warning(
+                self._open_locked(
                     "solver wire breaker OPEN",
                     consecutive_failures=self._consecutive,
-                    next_probe_in_s=round(self._next_probe_at - self._clock(), 3),
                 )
-                if self.auto_probe:
-                    self._ensure_probe_thread()
-                self._wake.set()
                 return True
             return False
+
+    def force_open(self, reason: str = "") -> None:
+        """Trip the breaker regardless of the consecutive-failure count --
+        the stuck-tick watchdog's escalation rung (karpenter_tpu/
+        overload.py): a wedged solve the finish-level failure accounting
+        never sees (it only advances when a wire call RETURNS) must still
+        stop regular traffic touching the wire. Same transition machinery
+        as record_failure (_open_locked), so probes, backoff, and
+        recovery (supervised probe + catalog re-stage) behave
+        identically."""
+        with self._lock:
+            if self._state != CLOSED:
+                return  # already open/half-open: the ladder is running
+            self._consecutive = max(self._consecutive, self.failure_threshold)
+            self._open_locked(
+                "solver wire breaker FORCED OPEN",
+                reason=reason or "watchdog escalation",
+            )
+
+    def _open_locked(self, log_msg: str, **log_fields) -> None:
+        """THE open-transition body (caller holds the lock), shared by
+        the counted trip and the watchdog's forced trip so the two can
+        never drift on probe scheduling or backoff seeding."""
+        self._transition(OPEN)
+        self.trips += 1
+        self._opened_at = self._clock()
+        self._backoff = self.backoff_base
+        self._schedule_probe()
+        self.log.warning(
+            log_msg,
+            next_probe_in_s=round(self._next_probe_at - self._clock(), 3),
+            **log_fields,
+        )
+        if self.auto_probe:
+            self._ensure_probe_thread()
+        self._wake.set()
 
     # -- probing / recovery ---------------------------------------------------
     def maybe_probe(self) -> bool:

@@ -48,7 +48,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from karpenter_tpu import failpoints, metrics, tracing
+from karpenter_tpu import failpoints, metrics, overload, tracing
 from karpenter_tpu.solver import encode, ffd
 
 TOKEN_ENV = "KARPENTER_TPU_SOLVER_TOKEN"
@@ -614,7 +614,13 @@ class SolverServer:
         if header.get("op") == "shm_ready" and header.get("ok"):
             with self._lock:
                 self._live_segs.add(new_seg)
-            return new_seg.endpoint("server", liveness=sock), new_seg
+            # the server endpoint reads with timeout=None (parked between
+            # operator ticks is healthy) but its reply SENDS are bounded:
+            # a client reader that wedges with the ring full must cost
+            # this handler the handshake budget, not its lifetime
+            return new_seg.endpoint(
+                "server", liveness=sock, send_timeout=self._handshake_timeout
+            ), new_seg
         new_seg.destroy()
         return sock, None
 
@@ -939,6 +945,11 @@ class SolverClient:
         # budget per reconnect attempt instead of ~1s.
         self.timeout = timeout
         self.connect_timeout = connect_timeout
+        # True while the LAST _apply_budget_timeout clamped the read
+        # budget below `timeout` (an active tick-deadline budget): a
+        # timeout in that state is deliberate shedding, and _wire_failed
+        # exempts it from the shm degrade ladder
+        self._budget_clamped = False
         self.token = (token if token is not None else os.environ.get(TOKEN_ENV)) or None
         self._ssl_context = ssl_context
         self._server_hostname = server_hostname or (host if host else None)
@@ -1070,6 +1081,25 @@ class SolverClient:
         self._ring = seg.endpoint("client", liveness=sock, timeout=self.connect_timeout)
         self._wire = self._ring
 
+    def _apply_budget_timeout(self) -> None:
+        """Per-tick deadline budgets (karpenter_tpu/overload.py): clamp
+        this roundtrip's READ budget to the active tick budget's
+        remaining time, so a tick that is going to blow its deadline
+        fails the wire EARLY -- the expiring timeout surfaces as the same
+        OSError every degrade ladder (reconnect, breaker, CPU fallback)
+        already handles -- instead of timing out late. No active budget
+        (the default, and every deterministic test) leaves the configured
+        solve timeout untouched. Caller holds the lock."""
+        wire = self._wire
+        if wire is None:
+            return
+        t = overload.clamp_timeout(self.timeout)
+        # remembered for _wire_failed: a timeout under a clamped budget is
+        # OUR impatience, not transport evidence
+        self._budget_clamped = t < self.timeout
+        if wire.gettimeout() != t:
+            wire.settimeout(t)
+
     def _wire_failed(self, exc: Optional[BaseException] = None) -> None:
         """Stream-failure accounting for the shm degrade ladder: failures
         WHILE the ring was the wire count toward SHM_MAX_FAILURES (after
@@ -1080,12 +1110,43 @@ class SolverClient:
         ring. Failures once bytes are in flight DO count: a server hangs
         up on a corrupt stream, so a reply-wait EOF is ambiguous with
         corruption, and crc/decode failures and wedged-peer timeouts are
-        direct evidence."""
+        direct evidence.
+
+        A TIMEOUT while the tick-deadline budget had CLAMPED the read
+        below the configured solve timeout is OUR deliberate impatience
+        (overload early-shed), not transport evidence -- counting it
+        would let one slow storm permanently degrade the ring to tcp for
+        the client's lifetime (there is no shm re-promotion probe)."""
         from karpenter_tpu.solver import shm as shm_mod
 
         if self._ring is None or isinstance(exc, shm_mod.ShmPeerGoneError):
             return
+        if isinstance(exc, TimeoutError) and getattr(self, "_budget_clamped", False):
+            return
         self._shm_failures += 1
+
+    def cancel_inflight(self) -> None:
+        """Out-of-band cancellation for the stuck-tick watchdog
+        (karpenter_tpu/overload.py): tear the TRANSPORT down WITHOUT
+        taking the client lock -- the wedged thread holds it across its
+        blocking read, so close() here would block the watchdog instead
+        of unsticking the tick. Closing the ring endpoint flips its
+        closed flag (the blocked ring wait's liveness check raises
+        ShmError within milliseconds) and shutting the socket down makes
+        a blocked recv return EOF; either way the wedged call surfaces a
+        ConnectionError into the normal degrade ladder, which then
+        closes the client PROPERLY under the lock."""
+        ring, sock = self._ring, self._sock
+        try:
+            if ring is not None:
+                ring.close()
+        except Exception:  # noqa: BLE001 -- cancellation is best-effort
+            pass
+        try:
+            if sock is not None:
+                sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
 
     def close(self) -> None:
         with self._lock:
@@ -1126,6 +1187,7 @@ class SolverClient:
         and including `target`). MUST run before any synchronous roundtrip
         so a pipelined reply is never misattributed to a later request.
         Caller holds the lock."""
+        self._apply_budget_timeout()
         while self._pending:
             head = self._pending[0]
             if head.outcome is None:
@@ -1283,6 +1345,7 @@ class SolverClient:
             # this request would read an earlier solve's reply as its own
             self._drain_pending()
             sock = self._conn()
+            self._apply_budget_timeout()
             try:
                 _send_frame(sock, header, tensors)
                 out = _recv_frame(sock)
@@ -1293,6 +1356,7 @@ class SolverClient:
                 self._wire_failed(e)
                 self.close()  # one reconnect attempt per call
                 sock = self._conn()
+                self._apply_budget_timeout()
                 try:
                     _send_frame(sock, header, tensors)
                     out = _recv_frame(sock)
@@ -1410,6 +1474,16 @@ class SolverClient:
         tensors = self._class_tensors(class_set)
         full_bytes = int(sum(a.nbytes for _, a in tensors))
         if not self.delta or header.get("op") != "solve_compact":
+            self._bypass_delta(full_bytes)
+            return tensors
+        if overload.sheds_delta():
+            # brownout ladder rung 3 (karpenter_tpu/overload.py): under
+            # sustained deadline pressure the delta-epoch machinery stands
+            # down -- no staging diffs, no epoch bookkeeping, and above
+            # all no unknown-epoch restage retry roundtrips. The full ship
+            # is bit-identical by construction; the ladder's hysteretic
+            # recovery restores delta shipping (the first solve after
+            # re-entry establishes a fresh epoch).
             self._bypass_delta(full_bytes)
             return tensors
         named = dict(tensors)

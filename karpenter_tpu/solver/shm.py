@@ -70,6 +70,12 @@ SIZE_ENV = "KARPENTER_TPU_SHM_SIZE"
 DEFAULT_RING_SIZE = 8 * 1024 * 1024
 MIN_RING_SIZE = 64 * 1024
 
+# ring-full SEND bound: a send blocked on a wedged reader (the peer
+# stopped draining but its process is alive, so no liveness signal fires)
+# must abandon within this budget when the endpoint carries no timeout of
+# its own -- the server's reply sends were previously unbounded
+SEND_TIMEOUT_DEFAULT = 30.0
+
 PREFIX = "karpenter-tpu-ring-"
 _NAME_RE = re.compile(rf"^{re.escape(PREFIX)}(\d+)-[0-9a-f]+$")
 
@@ -93,6 +99,16 @@ class ShmAttachError(ShmError):
     """The segment could not be attached/validated (missing file, magic
     or geometry mismatch, injected `rpc.shm.attach` fault). The client
     answers by staying on the socket transport for the connection."""
+
+
+class ShmSendTimeoutError(ShmError, TimeoutError):
+    """Ring-full send abandoned at the send deadline (a wedged reader).
+    Also a TimeoutError on purpose: the client's tick-budget exemption
+    (rpc.SolverClient._wire_failed) recognizes timeouts that fired under
+    a CLAMPED budget as deliberate overload shedding -- without the dual
+    parentage, one storm's clamped send waits would count toward
+    SHM_MAX_FAILURES and permanently degrade the ring to tcp, the exact
+    outcome the exemption exists to prevent on the read path."""
 
 
 class ShmPeerGoneError(ShmError):
@@ -231,8 +247,10 @@ class ShmSegment:
 
     # -- lifecycle -----------------------------------------------------------
     def endpoint(self, role: str, liveness: Optional[socket.socket] = None,
-                 timeout: Optional[float] = None) -> "RingEndpoint":
-        return RingEndpoint(self, role, liveness=liveness, timeout=timeout)
+                 timeout: Optional[float] = None,
+                 send_timeout: Optional[float] = None) -> "RingEndpoint":
+        return RingEndpoint(self, role, liveness=liveness, timeout=timeout,
+                            send_timeout=send_timeout)
 
     def close(self) -> None:
         if self._closed:
@@ -285,7 +303,8 @@ class RingEndpoint:
 
     def __init__(self, seg: ShmSegment, role: str,
                  liveness: Optional[socket.socket] = None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 send_timeout: Optional[float] = None):
         if role not in ("client", "server"):
             raise ValueError(f"unknown ring role {role!r}")
         self._seg = seg
@@ -302,6 +321,11 @@ class RingEndpoint:
         self._size = size
         self._liveness = liveness
         self._timeout = timeout
+        # dedicated SEND bound for the ring-full wait: a server handler
+        # legitimately parks in recv with timeout=None between operator
+        # ticks, but its reply SENDS must never block forever on a reader
+        # that stopped draining -- see _send_budget
+        self._send_timeout = send_timeout
         self._closed = False
 
     # -- ring-pointer accessors (aligned u64 loads/stores) --------------------
@@ -346,7 +370,9 @@ class RingEndpoint:
             if eof:
                 raise ShmError("shm peer connection closed")
 
-    def _wait(self, avail, what: str) -> int:
+    _USE_ENDPOINT_TIMEOUT = object()  # sentinel: _wait uses self._timeout
+
+    def _wait(self, avail, what: str, timeout=_USE_ENDPOINT_TIMEOUT) -> int:
         """Spin-then-sleep until `avail()` returns nonzero. The first
         ~200 iterations yield only (the peer is usually mid-memcpy);
         past that the poll backs off to 200 us, then 2 ms, then -- after
@@ -354,8 +380,12 @@ class RingEndpoint:
         between operator ticks must idle at ~100 wakeups/s, not burn a
         core. Peer-liveness checks ride the poll (denser on the deep
         rung), so a dead peer surfaces in well under a second and a
-        wedged one at the configured timeout."""
-        deadline = None if self._timeout is None else time.monotonic() + self._timeout
+        wedged one at the configured timeout. `timeout` overrides the
+        endpoint timeout for waits with their own budget (the ring-full
+        send bound)."""
+        if timeout is RingEndpoint._USE_ENDPOINT_TIMEOUT:
+            timeout = self._timeout
+        deadline = None if timeout is None else time.monotonic() + timeout
         spins = 0
         while True:
             n = avail()
@@ -380,16 +410,53 @@ class RingEndpoint:
     def _tx_free(self) -> int:
         return self._size - (self._load(self._tx_hdr) - self._load(self._tx_hdr + 8))
 
+    def _send_budget(self) -> float:
+        """The ring-full send bound: the endpoint's dedicated send
+        timeout, else its read timeout, else the module default -- NEVER
+        unbounded. A reader that stopped draining but whose process is
+        alive defeats every liveness check; without this bound a reply
+        send into its full ring blocked forever (and the server handler
+        thread with it)."""
+        if self._send_timeout is not None:
+            return self._send_timeout
+        if self._timeout is not None:
+            return self._timeout
+        return SEND_TIMEOUT_DEFAULT
+
     def _write_buf(self, mv: memoryview) -> None:
         off, n = 0, len(mv)
         data0, size = self._tx_data, self._size
+        # ONE deadline for the whole buffer send, armed at the FIRST
+        # ring-full stall: a reader that frees a trickle of space before
+        # each wait must not reset the budget per stall, or a
+        # mostly-wedged reader keeps a multi-chunk send (and the handler
+        # thread behind it) blocked for its lifetime -- the bound is per
+        # SEND, not per wait
+        send_deadline = None
         while off < n:
             free = self._tx_free()
             if not free:
                 # backpressure, not an error: the reader is draining.
                 # Counted so an undersized segment is visible in metrics.
                 metrics.WIRE_SHM_RING_FULL.inc()
-                free = self._wait(self._tx_free, "send")
+                if send_deadline is None:
+                    send_deadline = time.monotonic() + self._send_budget()
+                try:
+                    remaining = send_deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise socket.timeout("shm send budget spent")
+                    free = self._wait(self._tx_free, "send", timeout=remaining)
+                except socket.timeout:
+                    # a WEDGED reader (alive process, stopped draining):
+                    # surface as a counted ConnectionError so the client's
+                    # shm degrade ladder (SHM_MAX_FAILURES -> tcp) and the
+                    # server's handler teardown both take over, instead of
+                    # this thread blocking for the reader's lifetime
+                    metrics.WIRE_SHM_SEND_TIMEOUTS.inc()
+                    raise ShmSendTimeoutError(
+                        f"shm ring-full send timed out after "
+                        f"{self._send_budget()}s (peer reader wedged)"
+                    ) from None
             head = self._load(self._tx_hdr)
             pos = head % size
             chunk = min(free, n - off, size - pos)

@@ -187,6 +187,11 @@ class Tracer:
                  slow_ms: float = 1000.0, capacity: int = 32):
         self.enabled = enabled
         self.sample = sample
+        # brownout throttle (karpenter_tpu/overload.py ladder rung 2):
+        # while throttled the sample rate reads 0 but the CONFIGURED rate
+        # is remembered for the hysteretic recovery
+        self._throttled = False
+        self._base_sample = sample
         self._clock = clock
         self._rng = rng
         self.recorder = FlightRecorder(capacity=capacity, slow_ms=slow_ms)
@@ -209,7 +214,12 @@ class Tracer:
         if enabled is not None:
             self.enabled = enabled
         if sample is not None:
-            self.sample = sample
+            if self._throttled:
+                # the configured rate updates UNDER the throttle: it is
+                # what set_throttled(False) will restore
+                self._base_sample = sample
+            else:
+                self.sample = sample
         if slow_ms is not None:
             self.recorder.slow_ms = slow_ms
         if capacity is not None:
@@ -223,6 +233,20 @@ class Tracer:
         if rng is not None:
             self._rng = rng
         return self
+
+    def set_throttled(self, throttled: bool) -> None:
+        """Brownout ladder rung 2 (karpenter_tpu/overload.py): stop the
+        per-span stats/metrics volume without forgetting the configured
+        sample rate. Throttled tracing still BUILDS trees -- the flight
+        recorder must keep catching the slow ticks that caused the
+        brownout; only the sampled-in volume stops."""
+        if throttled == self._throttled:
+            return
+        self._throttled = throttled
+        if throttled:
+            self._base_sample, self.sample = self.sample, 0.0
+        else:
+            self.sample = self._base_sample
 
     def reset(self) -> None:
         """Drop stats + recorder state (tests, bench segments)."""

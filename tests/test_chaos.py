@@ -243,6 +243,85 @@ MIDFLIGHT_FAULTS = {
 }
 
 
+def test_probe_promotion_preserves_brownout_shed_state(failpoints, tmp_path):
+    """Breaker half-open probes under sustained load: a probe success
+    re-promotes the WIRE, and only the wire -- it must not reset the
+    brownout ladder or the admission shed state mid-brownout (the
+    overload and degrade ladders are independent by design; a recovered
+    sidecar does not mean the load went away)."""
+    from karpenter_tpu import metrics, overload
+    from karpenter_tpu.operator.operator import Options
+
+    path = str(tmp_path / "solver.sock")
+    srv = SolverServer(path=path).start()
+    client = SolverClient(path=path, timeout=10.0, connect_timeout=0.25)
+    breaker = CircuitBreaker(failure_threshold=2, backoff_base=1000.0)
+    solver = TPUSolver(g_max=64, client=client, breaker=breaker)
+    op = Operator(
+        clock=FakeClock(50_000.0), solver=solver,
+        options=Options(tick_deadline=1.0, admission_max_pods=4),
+    )
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    rng = np.random.default_rng(99)
+    try:
+        # sustained pressure: drive the brownout ladder to rung 2
+        for _ in range(8):
+            op.brownout.observe(3.0)
+        level = op.brownout.level
+        assert level >= 2
+        # sustained load: more pending than the admission cap takes
+        pod_seq = _burst(op, rng, 4242, 0, 12)
+        op.tick()
+        shed_after_tick = metrics.OVERLOAD_SHED.value(reason="admission-cap")
+        assert shed_after_tick > 0
+        deferred = metrics.OVERLOAD_DEFERRED.value()
+        assert deferred > 0
+        # sidecar dies mid-brownout; trip the breaker through its own
+        # failure accounting (the trip mechanics have their dedicated
+        # suites -- this test is about what promotion must NOT reset)
+        FAILPOINTS.arm("rpc.client.connect", "error", "ConnectionError")
+        client.close()
+        while breaker.state == CLOSED:
+            breaker.record_failure()
+        op.tick()  # a degraded tick serves on the CPU fallback
+        op.clock.step(3.0)
+        # supervised recovery: the sidecar is back, the probe promotes
+        FAILPOINTS.disarm("rpc.client.connect")
+        assert breaker.probe_now() is True
+        assert breaker.state == CLOSED
+        # ... and NOTHING about the overload state was reset by it (the
+        # ladder may legitimately CLIMB further -- the degraded ticks
+        # overran too -- but a promotion must never knock it back down)
+        assert op.brownout.level >= level, "probe promotion reset the brownout"
+        assert op.brownout.sheds_tracing()
+        before = metrics.OVERLOAD_SKIPPED_SWEEPS.value(stage="disruption")
+        shed_before = metrics.OVERLOAD_SHED.value(reason="admission-cap")
+        pod_seq = _burst(op, rng, 4242, pod_seq, 8)
+        op.tick()
+        assert metrics.OVERLOAD_SKIPPED_SWEEPS.value(stage="disruption") > before, (
+            "disruption sweep ran mid-brownout after re-promotion"
+        )
+        assert metrics.OVERLOAD_SHED.value(reason="admission-cap") > shed_before, (
+            "admission shedding stopped after re-promotion"
+        )
+        # the storm ends: the ladder recovers hysteretically and every
+        # deferred pod places -- re-promotion changed none of that
+        for _ in range(40):
+            op.tick()
+            check_invariants(op)
+            if not op.cluster.pending_pods():
+                break
+            op.clock.step(3.0)
+        assert not op.cluster.pending_pods()
+    finally:
+        FAILPOINTS.reset()
+        overload.install_brownout(None)
+        breaker.stop()
+        client.close()
+        srv.stop()
+
+
 @pytest.mark.parametrize("seed", range(8))
 def test_chaos_sync_equals_pipelined(seed, failpoints, catalog_items, tmp_path):
     """Invariant 2 of the chaos contract: whatever fault lands between the

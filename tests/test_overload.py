@@ -1,0 +1,599 @@
+"""Overload-resilience suite: deadline budgets, shedding, brownout, watchdog.
+
+The overload tentpole's acceptance criteria live here:
+
+- STORM SOAK: at ~10x offered load (arrivals far past the admission
+  bound) against a slow sidecar, tick p99 stays <= 2x the configured
+  tick deadline, ZERO pods are lost (every shed pod is re-admitted and
+  placed once the storm subsides), and the shed accounting proves the
+  bound actually bit;
+- ADMITTED-PREFIX BIT-IDENTITY: the decision for the admitted prefix
+  under load equals an unloaded solve of that same prefix -- shedding
+  changes WHAT is solved, never HOW;
+- the brownout ladder climbs and recovers in its fixed documented order
+  with hysteresis, and the stuck-tick watchdog escalates
+  cancel -> breaker-open -> OperatorCrashed (with the recovery sweep
+  taking over after the crash);
+- the satellites: bounded interruption intake with carry-over, and the
+  shm ring-full send timeout.
+
+The sim side of the contract -- byte-deterministic storm replay with a
+committed golden digest -- is pinned by the corpus gate
+(tests/golden/scenarios/overload-storm.jsonl + tests/test_sim.py).
+`make overload` runs this module (KARPENTER_TPU_OVERLOAD_ARTIFACTS names
+where a diverging storm replay's ddmin-shrunk repro lands).
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_tpu import metrics, overload
+from karpenter_tpu.apis import NodeClaim, NodePool, Pod, TPUNodeClass
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.failpoints import FAILPOINTS, OperatorCrashed
+from karpenter_tpu.operator import Operator, Options
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver.breaker import CLOSED, OPEN, CircuitBreaker
+from karpenter_tpu.solver.rpc import SolverClient, SolverServer
+from karpenter_tpu.solver.service import TPUSolver
+from tests.test_soak import check_invariants
+
+ARTIFACT_DIR = os.environ.get("KARPENTER_TPU_OVERLOAD_ARTIFACTS", "overload-artifacts")
+SIZES = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi")]
+
+
+def _rig(tmp_path, **opts):
+    """The production topology (sidecar + pipelined tick + breaker) with
+    overload options; mirrors tests/test_chaos._rig."""
+    path = str(tmp_path / "solver.sock")
+    srv = SolverServer(path=path).start()
+    client = SolverClient(path=path, timeout=10.0, connect_timeout=0.25)
+    breaker = CircuitBreaker(failure_threshold=2, backoff_base=1000.0)
+    solver = TPUSolver(g_max=64, client=client, breaker=breaker)
+    op = Operator(clock=FakeClock(50_000.0), solver=solver, options=Options(**opts))
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    return srv, client, breaker, op
+
+
+def _teardown(srv, client, breaker):
+    breaker.stop()
+    client.close()
+    srv.stop()
+    overload.install_brownout(None)
+
+
+def _burst(op, rng, prefix, start, n, priority=0):
+    for i in range(n):
+        cpu, mem = SIZES[int(rng.integers(0, len(SIZES)))]
+        op.cluster.create(Pod(
+            f"{prefix}-{start + i:04d}",
+            requests=Resources({"cpu": cpu, "memory": mem}),
+            priority=priority,
+        ))
+    return start + n
+
+
+# -- tick budget unit --------------------------------------------------------
+
+
+class TestTickBudget:
+    def test_stage_fractions_cover_the_tick(self):
+        assert abs(sum(overload.STAGE_FRACTIONS.values()) - 1.0) < 1e-9
+
+    def test_remaining_and_overrun(self):
+        now = {"t": 100.0}
+        b = overload.TickBudget(2.0, clock=lambda: now["t"])
+        assert b.remaining() == pytest.approx(2.0)
+        now["t"] = 101.0
+        assert b.elapsed() == pytest.approx(1.0)
+        assert b.overrun() == pytest.approx(0.5)
+        now["t"] = 104.0
+        assert b.overrun() == pytest.approx(2.0)
+
+    def test_stage_deadline_floors_never_zero(self):
+        now = {"t": 0.0}
+        b = overload.TickBudget(1.0, clock=lambda: now["t"])
+        assert b.stage_deadline("wire") == pytest.approx(0.2)  # its ceiling
+        now["t"] = 10.0  # budget long blown
+        assert b.stage_deadline("wire") == pytest.approx(0.1)  # the floor
+
+    def test_clamp_timeout_only_under_an_active_budget(self):
+        assert overload.clamp_timeout(30.0) == 30.0
+        now = {"t": 0.0}
+        b = overload.TickBudget(1.0, clock=lambda: now["t"])
+        with overload.active(b):
+            # fresh budget: the whole remaining tick
+            assert overload.clamp_timeout(30.0) == pytest.approx(1.0)
+            # a default below the clamp is never raised
+            assert overload.clamp_timeout(0.05) == pytest.approx(0.05)
+            now["t"] = 0.7
+            assert overload.clamp_timeout(30.0) == pytest.approx(0.3)
+            now["t"] = 5.0  # budget long blown: the floor, never zero
+            assert overload.clamp_timeout(30.0) == pytest.approx(0.1)
+        assert overload.clamp_timeout(30.0) == 30.0
+
+
+# -- bounded admission -------------------------------------------------------
+
+
+class TestAdmission:
+    def test_priority_age_prefix_and_no_pod_lost(self):
+        op = Operator(
+            clock=FakeClock(1_000.0),
+            options=Options(admission_max_pods=4, tick_deadline=30.0),
+        )
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        try:
+            for i in range(8):
+                op.cluster.create(Pod(
+                    f"lo-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+            for i in range(4):
+                op.cluster.create(Pod(
+                    f"hi-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"}),
+                    priority=1000))
+            shed0 = metrics.OVERLOAD_SHED.value(reason="admission-cap")
+            op.tick()
+            # the admitted prefix is exactly the high-priority pods
+            r = op.provisioner.last_result
+            placed = sorted(
+                p.metadata.name for g in r.new_groups for p in g.pods
+            ) + sorted(r.existing_assignments)
+            assert placed == ["hi-0", "hi-1", "hi-2", "hi-3"]
+            assert metrics.OVERLOAD_SHED.value(reason="admission-cap") - shed0 == 8
+            assert metrics.OVERLOAD_DEFERRED.value() == 8.0
+            # deferred pods are only DELAYED: everything places eventually
+            assert op.settle(max_ticks=30) < 30
+            for p in op.cluster.list(Pod):
+                assert p.node_name, f"pod {p.metadata.name} lost"
+            # one more sweep over the empty pending set: the gauge reads
+            # the LAST tick's deferral (a shed pod the binder placed in
+            # the same sweep leaves it stale until the next tick)
+            op.tick()
+            assert metrics.OVERLOAD_DEFERRED.value() == 0.0
+        finally:
+            overload.install_brownout(None)
+
+    def test_admitted_prefix_bit_identical_to_unloaded_solve(self):
+        """The acceptance bit-identity: the decision for the admitted
+        prefix under load == an unloaded solve of that same prefix."""
+        def build(cap, pods):
+            op = Operator(
+                clock=FakeClock(1_000.0), solver=TPUSolver(g_max=64),
+                options=Options(admission_max_pods=cap),
+            )
+            op.cluster.create(TPUNodeClass("default"))
+            op.cluster.create(NodePool("default"))
+            for name, cpu, prio in pods:
+                op.cluster.create(Pod(
+                    name, requests=Resources({"cpu": cpu, "memory": "1Gi"}),
+                    priority=prio))
+            return op
+
+        rng = np.random.default_rng(7)
+        cpus = ["250m", "500m", "1", "2"]
+        pods = [
+            (f"p-{i:03d}", cpus[int(rng.integers(0, 4))], int(rng.integers(0, 3)) * 100)
+            for i in range(24)
+        ]
+        loaded = build(6, pods)
+        try:
+            loaded.tick()
+            got = loaded.provisioner.last_result
+            prefix = sorted(
+                pods,
+                key=lambda t: (-t[2], t[0]),  # same priority/name order (equal ages)
+            )[:6]
+            unloaded = build(0, prefix)
+            unloaded.tick()
+            want = unloaded.provisioner.last_result
+
+            def sig(res):
+                return (
+                    sorted(
+                        (len(g.pods), g.instance_types[0].name,
+                         tuple(sorted(p.metadata.name for p in g.pods)))
+                        for g in res.new_groups
+                    ),
+                    sorted(res.unschedulable),
+                    sorted(res.existing_assignments.items()),
+                )
+
+            assert sig(got) == sig(want), "admitted-prefix decision diverged"
+        finally:
+            overload.install_brownout(None)
+
+    def test_launch_fanout_bound_defers_whole_groups(self):
+        op = Operator(
+            clock=FakeClock(1_000.0),
+            options=Options(launch_max_groups=1),
+        )
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        try:
+            # pods over half the biggest catalog shape (192 vcpu): no two
+            # share a node, so the decision MUST open several groups
+            for i in range(3):
+                op.cluster.create(Pod(
+                    f"big-{i}", requests=Resources({"cpu": "100", "memory": "64Gi"})))
+            shed0 = metrics.OVERLOAD_SHED.value(reason="launch-bound")
+            op.tick()
+            assert len(op.cluster.list(NodeClaim)) <= 1
+            assert metrics.OVERLOAD_SHED.value(reason="launch-bound") > shed0
+            # the bound only delays: everything still places
+            assert op.settle(max_ticks=40) < 40
+            for p in op.cluster.list(Pod):
+                assert p.node_name, f"pod {p.metadata.name} lost"
+        finally:
+            overload.install_brownout(None)
+
+
+# -- storm soak (the acceptance invariant) ------------------------------------
+
+
+class TestStormSoak:
+    def test_ten_x_storm_p99_bounded_zero_pods_lost(self, failpoints, tmp_path):
+        """10x offered load vs the admission bound, against a sidecar
+        paying injected latency per solve: tick p99 <= 2x deadline, shed
+        accounting fires, and once the storm subsides every pod places
+        (zero lost) with the breaker never needed."""
+        deadline = 1.0
+        srv, client, breaker, op = _rig(
+            tmp_path, tick_deadline=deadline, admission_max_pods=24,
+            tracing=False,
+        )
+        rng = np.random.default_rng(42)
+        try:
+            # warm: one small burst settles fully, paying the XLA compile
+            # and seeding the per-pod cost EWMA OUTSIDE the measured storm
+            _burst(op, rng, "warm", 0, 6)
+            assert op.settle(max_ticks=30) < 30
+            def shed_total():
+                # shedding may attribute to either bound depending on host
+                # speed: the explicit cap, or the deadline-sized bound once
+                # the EWMA sees the injected latency (tighter on slow CI)
+                return (metrics.OVERLOAD_SHED.value(reason="admission-cap")
+                        + metrics.OVERLOAD_SHED.value(reason="deadline"))
+
+            FAILPOINTS.arm("rpc.server.dispatch", "latency", "0.02")
+            shed0 = shed_total()
+            tick_ms = []
+            seq = 0
+            for _ in range(8):  # the storm: ~10x the admission bound offered
+                seq = _burst(op, rng, "storm", seq, 30)
+                t0 = time.perf_counter()
+                op.tick()
+                tick_ms.append((time.perf_counter() - t0) * 1e3)
+                check_invariants(op)
+                op.clock.step(3.0)
+            p99 = float(np.percentile(tick_ms, 99))
+            assert p99 <= 2_000.0 * deadline, (
+                f"storm tick p99 {p99:.0f}ms > 2x deadline ({tick_ms})"
+            )
+            assert shed_total() > shed0, (
+                "the storm never tripped admission shedding"
+            )
+            # storm subsides: every deferred pod is re-admitted and placed
+            FAILPOINTS.reset()
+            for _ in range(60):
+                op.tick()
+                check_invariants(op)
+                if not op.cluster.pending_pods():
+                    break
+                op.clock.step(3.0)
+            assert not op.cluster.pending_pods(), "pods lost after the storm"
+            for p in op.cluster.list(Pod):
+                assert p.node_name, f"pod {p.metadata.name} lost (never bound)"
+            assert breaker.state == CLOSED
+        finally:
+            FAILPOINTS.reset()
+            _teardown(srv, client, breaker)
+
+
+# -- brownout ladder ----------------------------------------------------------
+
+
+class TestBrownoutLadder:
+    def test_climbs_and_recovers_in_order_with_hysteresis(self):
+        from karpenter_tpu import tracing
+
+        ctrl = overload.BrownoutController(1.0, dwell=0)
+        overload.install_brownout(ctrl)
+        tracing.TRACER.configure(enabled=True, sample=0.5)
+        try:
+            seen = []
+            for _ in range(6):
+                seen.append(ctrl.observe(2.0))  # sustained 2x overrun
+            # one rung per tick, in the fixed documented order
+            assert seen[:3] == [1, 2, 3]
+            assert ctrl.sheds_disruption() and ctrl.sheds_tracing() and ctrl.sheds_delta()
+            assert overload.sheds_delta()
+            # rung 2 throttles the SAMPLE volume but remembers the rate
+            assert tracing.TRACER.sample == 0.0
+            # between thresholds: dwell, no flapping
+            level = ctrl.level
+            for _ in range(4):
+                assert ctrl.observe(0.8) == level
+            # sustained recovery steps back down, one rung at a time
+            down = [ctrl.observe(0.1) for _ in range(6)]
+            assert down[-1] == 0
+            assert sorted(down, reverse=True) == down, f"non-monotone: {down}"
+            assert tracing.TRACER.sample == 0.5, "sample rate not restored"
+            assert not overload.sheds_delta()
+        finally:
+            overload.install_brownout(None)
+            tracing.TRACER.configure(enabled=False, sample=0.5)
+
+    def test_disruption_sweep_stands_down_under_brownout(self):
+        op = Operator(
+            clock=FakeClock(1_000.0), options=Options(tick_deadline=1.0))
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        try:
+            # force rung 1 (dwell left at default: one transition)
+            op.brownout.observe(5.0)
+            assert op.brownout.sheds_disruption()
+            before = metrics.OVERLOAD_SKIPPED_SWEEPS.value(stage="disruption")
+            op.tick()
+            assert metrics.OVERLOAD_SKIPPED_SWEEPS.value(stage="disruption") > before
+        finally:
+            overload.install_brownout(None)
+
+    def test_delta_shed_ships_full_not_delta(self, tmp_path):
+        """Rung 3: the wire ships bypass (full tensors, no epoch) while
+        shed, and re-establishes delta after recovery -- decisions
+        identical throughout."""
+        srv, client, breaker, op = _rig(tmp_path, tick_deadline=1.0)
+        rng = np.random.default_rng(3)
+        try:
+            _burst(op, rng, "d", 0, 6)
+            assert op.settle(max_ticks=30) < 30
+            # push to rung 3 (dwell=3 between rungs)
+            for _ in range(12):
+                op.brownout.observe(9.0)
+            assert op.brownout.sheds_delta()
+            _burst(op, rng, "d2", 0, 4)
+            op.tick()
+            assert client.last_delta["mode"] == "bypass"
+            assert op.settle(max_ticks=30) < 30
+        finally:
+            _teardown(srv, client, breaker)
+
+
+# -- stuck-tick watchdog -------------------------------------------------------
+
+
+class TestStuckTickWatchdog:
+    def test_escalation_ladder_cancel_breaker_crash(self, failpoints):
+        cancels = []
+        breaker = CircuitBreaker(failure_threshold=3, backoff_base=1000.0)
+        wd = overload.StuckTickWatchdog(
+            0.05, cancel=lambda: cancels.append(1), breaker=breaker,
+            multiples=(1.0, 2.0, 3.0),
+        )
+        outcome = {}
+        FAILPOINTS.arm("stall.unit.test", "stall", "30")
+
+        def wedged_tick():
+            wd.tick_started()
+            try:
+                FAILPOINTS.eval("stall.unit.test")
+                outcome["finished"] = True
+            except OperatorCrashed:
+                outcome["crashed"] = True
+            finally:
+                wd.tick_finished()
+
+        t = threading.Thread(target=wedged_tick)
+        t.start()
+        try:
+            fired = []
+            deadline = time.monotonic() + 10.0
+            while len(fired) < 3 and time.monotonic() < deadline:
+                stage = wd.check_now()
+                if stage:
+                    fired.append(stage)
+                time.sleep(0.02)
+            t.join(timeout=10.0)
+            assert not t.is_alive(), "the wedged tick never died"
+            assert fired == ["cancel", "breaker-open", "crash"]
+            assert cancels, "cancel hook never ran"
+            assert breaker.state == OPEN
+            assert outcome.get("crashed"), "OperatorCrashed never landed"
+            assert wd.escalations["crash"] == 1
+        finally:
+            breaker.stop()
+            t.join(timeout=10.0)
+
+    def test_cancel_inflight_unsticks_a_blocked_wire_read(self, failpoints, tmp_path):
+        """The cancel rung is OUT-OF-BAND: a solve blocked on a wedged
+        sidecar holds the client lock, so the watchdog tears the
+        transport down without it (cancel_inflight); the wedged read
+        dies into the degrade ladder and the tick completes -- well
+        before the configured read timeout would have freed it."""
+        # deadline high enough that the budget clamp does NOT shrink the
+        # 10s read timeout: completion under ~8s proves the cancel did it
+        srv, client, breaker, op = _rig(tmp_path, tick_deadline=60.0)
+        rng = np.random.default_rng(5)
+        try:
+            _burst(op, rng, "c", 0, 4)
+            assert op.settle(max_ticks=30) < 30
+            # wedge the sidecar: the next solve's reply never arrives
+            # within the stall window
+            FAILPOINTS.arm("rpc.server.dispatch", "stall", "20", times=1)
+            _burst(op, rng, "c2", 0, 3)
+            done = {}
+
+            def tick():
+                op.tick()
+                done["ok"] = True
+
+            t = threading.Thread(target=tick)
+            t.start()
+            time.sleep(0.5)  # the solve is now blocked on its reply
+            t0 = time.perf_counter()
+            client.cancel_inflight()
+            t.join(timeout=30.0)
+            elapsed = time.perf_counter() - t0
+            assert done.get("ok"), "tick never completed after cancel"
+            assert elapsed < 8.0, (
+                f"tick freed in {elapsed:.1f}s -- the read timeout, not the cancel"
+            )
+            # the retried solve (fresh connection) decided; nothing lost
+            assert op.settle(max_ticks=30) < 30
+        finally:
+            FAILPOINTS.reset()
+            _teardown(srv, client, breaker)
+
+    def test_crash_hands_over_to_recovery(self, failpoints):
+        """The full circle: a wedged tick is crashed by the watchdog, a
+        fresh operator over the surviving world recovers and places the
+        pending pods -- the PR-6 recovery path, driven by overload."""
+        op = Operator(
+            clock=FakeClock(1_000.0), options=Options(tick_deadline=0.05))
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        # tighten the ladder so the drill escalates within a second (the
+        # rungs fire sequentially: cancel -> breaker-open -> crash)
+        op.watchdog.multiples = (1.0, 2.0, 3.0)
+        for i in range(4):
+            op.cluster.create(Pod(
+                f"w-{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        FAILPOINTS.arm("stall.provisioner.solve", "stall", "30", times=1)
+        outcome = {}
+
+        def run_tick():
+            try:
+                op.tick()
+                outcome["finished"] = True
+            except OperatorCrashed:
+                outcome["crashed"] = True
+
+        t = threading.Thread(target=run_tick)
+        t.start()
+        try:
+            deadline = time.monotonic() + 10.0
+            while "crashed" not in outcome and time.monotonic() < deadline:
+                op.watchdog.check_now()
+                time.sleep(0.02)
+            t.join(timeout=10.0)
+            assert outcome.get("crashed"), "watchdog never crashed the tick"
+            # supervisor restart: fresh operator, same cluster/cloud; the
+            # elector-less recovery sweep runs before its first sweep
+            op2 = Operator(
+                cloud=op.cloud, clock=op.clock, cluster=op.cluster,
+                options=Options(),
+            )
+            assert op2.settle(max_ticks=30) < 30
+            for p in op2.cluster.list(Pod):
+                assert p.node_name, f"pod {p.metadata.name} lost after crash"
+        finally:
+            t.join(timeout=10.0)
+            overload.install_brownout(None)
+
+
+# -- satellites ---------------------------------------------------------------
+
+
+class TestInterruptionIntakeBound:
+    def test_bounded_sweep_carries_over(self):
+        from tests.conftest import spot_interruption_body
+
+        op = Operator(options=Options(interruption_queue="q"))
+        for i in range(25):
+            claim = NodeClaim(f"c-{i}")
+            claim.provider_id = f"tpu:///us-central-1a/i-{i:06d}"
+            op.cluster.create(claim)
+            op.cloud.send(spot_interruption_body(f"i-{i:06d}"))
+        before = metrics.INTERRUPTION_DEFERRED.value()
+        assert op.interruption.reconcile(max_messages=10, max_per_sweep=10) == 10
+        # the deferral is counted when the carried-over messages are
+        # RECEIVED next sweep -- not speculatively at the bound (a bound
+        # landing exactly on the last message must count nothing)
+        assert metrics.INTERRUPTION_DEFERRED.value() == before
+        assert op.interruption.reconcile(max_messages=10, max_per_sweep=10) == 10
+        assert metrics.INTERRUPTION_DEFERRED.value() == before + 1
+        assert op.interruption.reconcile(max_messages=10, max_per_sweep=10) == 5
+        assert metrics.INTERRUPTION_DEFERRED.value() == before + 2
+        # the bound landed mid-queue twice; the final 5 drained clean
+        assert op.interruption.reconcile(max_messages=10, max_per_sweep=10) == 0
+        assert metrics.INTERRUPTION_DEFERRED.value() == before + 2
+        assert all(c.deleting for c in op.cluster.list(NodeClaim))
+
+    def test_unbounded_mode_drains_everything(self):
+        from tests.conftest import spot_interruption_body
+
+        op = Operator(options=Options(interruption_queue="q"))
+        for i in range(30):
+            op.cloud.send(spot_interruption_body(f"i-{i:06d}"))
+        assert op.interruption.reconcile(max_messages=10, max_per_sweep=0) == 30
+
+
+class TestShmSendTimeout:
+    def test_ring_full_send_times_out_as_connection_error(self):
+        from karpenter_tpu.solver import shm
+
+        seg = shm.ShmSegment.create(size=shm.MIN_RING_SIZE)
+        try:
+            ep = seg.endpoint("client", timeout=0.3)
+            before = metrics.WIRE_SHM_SEND_TIMEOUTS.value()
+            full0 = metrics.WIRE_SHM_RING_FULL.value()
+            # nobody ever drains the server side: the send must abandon
+            # at the deadline, not block for the reader's lifetime
+            with pytest.raises(ConnectionError):
+                ep.sendmsg([b"x" * (shm.MIN_RING_SIZE + 4096)])
+            assert metrics.WIRE_SHM_SEND_TIMEOUTS.value() == before + 1
+            assert metrics.WIRE_SHM_RING_FULL.value() > full0
+        finally:
+            seg.destroy()
+
+    def test_send_timeout_is_a_shm_error(self):
+        """ShmError subclasses ConnectionError, so the send timeout feeds
+        the client's existing shm->tcp degrade ladder unchanged."""
+        from karpenter_tpu.solver import shm
+
+        assert issubclass(shm.ShmError, ConnectionError)
+
+    def test_server_endpoint_send_bounded_with_unbounded_recv(self):
+        """The deployed shape: the server parks in recv with timeout=None
+        between ticks, but its reply sends still carry a bound."""
+        from karpenter_tpu.solver import shm
+
+        seg = shm.ShmSegment.create(size=shm.MIN_RING_SIZE)
+        try:
+            ep = seg.endpoint("server", timeout=None, send_timeout=0.2)
+            with pytest.raises(ConnectionError):
+                ep.sendmsg([b"y" * (shm.MIN_RING_SIZE + 4096)])
+        finally:
+            seg.destroy()
+
+
+# -- storm replay divergence -> shrunk artifact --------------------------------
+
+
+class TestStormReplayArtifact:
+    def test_storm_scenario_differential_with_artifact_on_divergence(self):
+        """The committed storm trace replays differentially (mirroring
+        the corpus gate); a divergence ddmin-shrinks into the overload
+        artifacts dir so CI uploads a ready-made repro."""
+        from karpenter_tpu.sim.replay import differential
+        from karpenter_tpu.sim.trace import read_trace
+
+        path = os.path.join("tests", "golden", "scenarios", "overload-storm.jsonl")
+        events = read_trace(path)
+        res = differential(events, seed=20260803, backends=("host", "pipelined"))
+        if not res.ok:
+            from karpenter_tpu.sim.shrink import differential_failing, shrink_to_repro
+
+            shrink_to_repro(
+                events, differential_failing(20260803), ARTIFACT_DIR,
+                "overload-storm")
+        assert res.ok, f"storm replay diverged: {res.divergences} {res.errors}"
+        # shedding actually happened on this trace: the admission cap is
+        # far below the storm's arrival count, so pods waited in line
+        host = res.results["host"]
+        assert host.kpis["pending_latency_p99_s"] > host.kpis["pending_latency_p50_s"] >= 9.0

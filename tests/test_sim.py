@@ -15,7 +15,11 @@ import os
 import pytest
 
 from karpenter_tpu.sim.replay import differential, replay
-from karpenter_tpu.sim.scenario import ScenarioBuilder, build_scenario
+from karpenter_tpu.sim.scenario import (
+    CORPUS_SCENARIOS,
+    ScenarioBuilder,
+    build_scenario,
+)
 from karpenter_tpu.sim.shrink import ddmin
 from karpenter_tpu.sim.trace import (
     TraceRecorder, pod_from_spec, pod_to_spec, read_trace, write_trace,
@@ -132,7 +136,7 @@ class TestGoldenCorpus:
         assert k["nodes_peak"] > 0 and k["node_churn"] >= k["nodes_peak"]
 
     def test_corpus_traces_have_headers_and_seeds(self):
-        for name in ("diurnal-small", "ice-storm", "interruption-wave"):
+        for name in CORPUS_SCENARIOS:
             events = read_trace(os.path.join(GOLDEN_DIR, f"{name}.jsonl"))
             head = events[0]
             assert head["ev"] == "header" and head["scenario"] == name
@@ -142,7 +146,7 @@ class TestGoldenCorpus:
         """The committed corpus IS its generator's output: scenario name +
         seed fully determine the trace, so the corpus can never drift from
         the DSL silently."""
-        for name in ("diurnal-small", "ice-storm", "interruption-wave"):
+        for name in CORPUS_SCENARIOS:
             committed = read_trace(os.path.join(GOLDEN_DIR, f"{name}.jsonl"))
             assert build_scenario(name, seed=committed[0]["seed"]) == committed
 
