@@ -10,12 +10,12 @@ PYTEST ?= $(PY) -m pytest
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
 
-lint:  ## AST invariant checkers: determinism, lock discipline, zero-copy wire, registry drift (allowlist: hack/lint_baseline.json)
+lint:  ## AST invariant checkers: determinism, lock discipline, zero-copy wire, registry drift, jax compilation discipline (jaxjit retrace hazards + jaxhost sync rules) (allowlist: hack/lint_baseline.json)
 	$(PY) -m karpenter_tpu.analysis
 
-typecheck:  ## targeted mypy over the solver package + the intent journal (hack/mypy.ini); skips with a notice where mypy is not installed (CI always runs it)
+typecheck:  ## targeted mypy over the solver package, the intent journal, the mesh layer, and the analysis tooling (hack/mypy.ini); skips with a notice where mypy is not installed (CI always runs it)
 	@if $(PY) -c "import mypy" >/dev/null 2>&1; then \
-		$(PY) -m mypy --config-file hack/mypy.ini karpenter_tpu/solver/ karpenter_tpu/journal.py; \
+		$(PY) -m mypy --config-file hack/mypy.ini karpenter_tpu/solver/ karpenter_tpu/journal.py karpenter_tpu/parallel/ karpenter_tpu/analysis/; \
 	else \
 		echo "typecheck: mypy not installed in this environment; skipping (the CI typecheck job runs it; pip install mypy to run locally)"; \
 	fi
@@ -42,24 +42,24 @@ define STAMP
 && ($(PY) hack/tier_stamp.py $(1) --ok || true) || { $(PY) hack/tier_stamp.py $(1) --failed || true; exit 1; }
 endef
 
-benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line)
-	$(PY) bench.py --profile > bench_last.json; rc=$$?; cat bench_last.json; \
+benchmark:  ## the 50k-pod scheduling-latency benchmark (one JSON line; warm stage runs under the jax retrace witness, warm_retrace_count asserted 0)
+	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --profile > bench_last.json; rc=$$?; cat bench_last.json; \
 	$(PY) hack/tier_stamp.py benchmark --from-bench bench_last.json || true; exit $$rc
 
-bench-warm:  ## warm steady-state delta stage only (incremental tick engine: warm_delta_tick_p50_ms, delta payload bytes, tail_ratio); one JSON line
-	$(PY) bench.py --warm-only > bench_warm_last.json; rc=$$?; cat bench_warm_last.json; exit $$rc
+bench-warm:  ## warm steady-state delta stage only (incremental tick engine: warm_delta_tick_p50_ms, delta payload bytes, tail_ratio, warm_retrace_count); one JSON line
+	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --warm-only > bench_warm_last.json; rc=$$?; cat bench_warm_last.json; exit $$rc
 
-bench-wire:  ## transport stage only (wire v2: warm_wire_p50/p99_ms shm vs tcp, wire_share_of_tick, reply_bytes_per_solve, copies-per-solve); one JSON line
-	$(PY) bench.py --wire-only > bench_wire_last.json; rc=$$?; cat bench_wire_last.json; exit $$rc
+bench-wire:  ## transport stage only (wire v2: warm_wire_p50/p99_ms shm vs tcp, wire_share_of_tick, reply_bytes_per_solve, copies-per-solve, wire_warm_retrace_count); one JSON line
+	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --wire-only > bench_wire_last.json; rc=$$?; cat bench_wire_last.json; exit $$rc
 
 chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count, incl. the shm-transport faults, under the lock-order witness (zero inversions asserted at session end; full-length schedule stays behind -m slow)
-	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py tests/test_wire.py -q -m 'not slow' $(call STAMP,chaos)
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py tests/test_wire.py -q -m 'not slow' $(call STAMP,chaos)
 
 crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection -- under the lock-order witness (zero inversions asserted at session end); diverging traces ddmin-shrink into crash-artifacts/ (full-length chain soak stays behind -m slow)
 	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
 
-overload:  ## overload storm soak: 10x offered load against the deadline-budgeted tick (p99 <= 2x deadline, zero pods lost, admitted-prefix bit-identity, brownout ladder + stuck-tick watchdog escalation, bounded interruption intake, shm send timeout) under the lock-order witness; a diverging storm replay ddmin-shrinks into overload-artifacts/
-	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_OVERLOAD_ARTIFACTS=overload-artifacts $(PYTEST) tests/test_overload.py -q -m 'not slow' $(call STAMP,overload)
+overload:  ## overload storm soak: 10x offered load against the deadline-budgeted tick (p99 <= 2x deadline, zero pods lost, admitted-prefix bit-identity, brownout ladder + stuck-tick watchdog escalation, bounded interruption intake, shm send timeout) under the lock-order AND jax retrace witnesses; a diverging storm replay ddmin-shrinks into overload-artifacts/
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_OVERLOAD_ARTIFACTS=overload-artifacts $(PYTEST) tests/test_overload.py -q -m 'not slow' $(call STAMP,overload)
 
 sim-corpus:  ## differential-replay the committed scenario corpus (host vs wire vs pipelined, golden digests); shrinks any failing trace into sim-artifacts/
 	$(PY) -m karpenter_tpu sim corpus --dir tests/golden/scenarios --artifacts sim-artifacts $(call STAMP,sim-corpus)

@@ -23,6 +23,14 @@ and fail `make lint` the moment a violation is WRITTEN:
   ``payload_copies == 0`` assertion, made static (checkers/zerocopy.py).
 - ``registry``      -- every failpoint site, metric family, and RPC
   feature flag must appear in its docs table (checkers/registry_drift.py).
+- ``jaxjit``        -- retrace hazards at jax.jit decoration sites:
+  static args outside the bounded-cardinality bucketing manifest,
+  closures over mutable state, Python branching on traced values, and
+  weak-dtype array creation (checkers/jax_discipline.py).
+- ``jaxhost``       -- host-sync discipline over the per-tick encode ->
+  dispatch -> decode manifest: ``.item()``, scalar casts of live device
+  values, unsanctioned ``np.asarray``/``device_get``, and hot-path
+  barriers (checkers/jax_discipline.py).
 
 Intentional exceptions live in ``hack/lint_baseline.json`` -- each entry
 carries file:line, the offending source line, and a justification; the
@@ -37,7 +45,11 @@ The static lock pass is paired with a RUNTIME lock-order witness
 records acquisition order per thread and reports any inversion of an
 observed edge -- the Python race detector for interleavings the chaos
 schedules cannot force. Tier-1 and the chaos soaks run under it and
-assert zero inversions (tests/conftest.py).
+assert zero inversions (tests/conftest.py). The jax pass is paired the
+same way with a runtime retrace/transfer witness (jax_witness.py):
+compile events and unsanctioned device->host conversions inside
+declared-warm hot sections are recorded per call site, asserted zero by
+tier-1's warm-delta gate and the bench warm stage.
 """
 from karpenter_tpu.analysis.base import (  # noqa: F401
     Violation,

@@ -26,7 +26,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m karpenter_tpu.analysis",
         description="AST invariant checkers: determinism, lock discipline, "
-                    "zero-copy wire, registry drift")
+                    "zero-copy wire, registry drift, jax compilation "
+                    "discipline (jaxjit retrace hazards + jaxhost sync rules)")
     ap.add_argument("--rules", action="append", default=None,
                     metavar="FAMILY", help="run only these rule families "
                     f"(choices: {', '.join(checkers())}; repeatable)")

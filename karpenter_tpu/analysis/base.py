@@ -170,14 +170,17 @@ CheckerFn = Callable[[List[Module]], List[Violation]]
 def checkers() -> Dict[str, CheckerFn]:
     """The rule families, imported lazily (keeps `import
     karpenter_tpu.analysis` feather-light for the witness path)."""
-    from karpenter_tpu.analysis.checkers import (determinism, locks,
-                                                 registry_drift, zerocopy)
+    from karpenter_tpu.analysis.checkers import (determinism, jax_discipline,
+                                                 locks, registry_drift,
+                                                 zerocopy)
 
     return {
         "determinism": determinism.check,
         "locks": locks.check,
         "zerocopy": zerocopy.check,
         "registry": registry_drift.check,
+        "jaxjit": jax_discipline.check_retrace,
+        "jaxhost": jax_discipline.check_hostsync,
     }
 
 

@@ -1,0 +1,684 @@
+"""XLA compilation-discipline checker: the jit contract, static.
+
+The whole performance story (ROADMAP "Where the time goes now") rests on
+the jitted solve path never silently recompiling and never syncing to
+host mid-tick: ~8 ms of device exec against a warm tick that must cost
+O(churn). Nothing enforced that contract -- one unbounded static-arg
+value or one stray ``.item()`` turns the 8 ms solve into a multi-second
+XLA compile stall (exactly round 2's p99 tail) that no decision-level
+test can see. This checker rejects the hazard the moment it is written;
+``analysis/jax_witness.py`` is the runtime complement (compile events
+and host transfers counted per call site after warmup).
+
+Two rule families over ``solver/`` and ``parallel/``:
+
+``jaxjit/*`` -- retrace hazards at ``jax.jit`` decoration sites and
+inside jitted bodies (module-local helpers resolved transitively):
+
+- ``jaxjit/unbounded-static``: every ``static_argnames`` entry must be
+  declared in ``STATIC_ARG_BUCKETS``, the bounded-cardinality bucketing
+  manifest. A static arg whose value set is not provably finite compiles
+  a fresh program per distinct value -- the manifest records WHY each
+  name is bounded (padding buckets, catalog geometry, a closed enum) and
+  makes a new static axis a reviewed decision instead of a drive-by.
+  Non-literal ``static_argnames`` and any use of ``static_argnums``
+  (positional indices drift silently under refactors) also fire here.
+- ``jaxjit/closure-state``: a jitted body reading ``self.X`` or a
+  module-level MUTABLE name (lowercase by convention; ALL_CAPS constants
+  are exempt) closes over state jax hashes by identity at trace time --
+  a rebind never retriggers tracing (stale constant baked into the
+  program) or, for arrays, retraces per object. Thread state through
+  arguments instead.
+- ``jaxjit/traced-branch``: ``if``/``while``/ternary/``for`` over a
+  TRACED value inside a jitted body -- a ConcretizationError at best, a
+  silent per-value recompile via an intermediate ``static_argnames``
+  "fix" at worst. Shape/dtype reads (``x.shape[0]`` and friends) are
+  trace-time Python ints and do not taint.
+- ``jaxjit/weak-dtype``: array creation (``jnp.arange``/``zeros``/
+  ``full``/...) without an explicit dtype inside a jitted body leaks
+  weak types; a weak-vs-committed dtype mismatch between two call paths
+  is a signature change and a retrace (and on TPU a silent f32/bf16
+  surprise). ``*_like`` constructors inherit and are exempt.
+
+``jaxhost/*`` -- host-sync discipline over ``DEVICE_HOT_PATH``, the
+explicit manifest of the per-tick encode -> dispatch -> decode functions
+(the zero-copy ``HOT_PATH`` pattern). Within manifest functions:
+
+- ``jaxhost/item``: ``.item()`` synchronously round-trips device->host.
+- ``jaxhost/scalar-cast``: ``float()``/``int()`` on a value produced by
+  a jit entry point (local dataflow; a fetch through ``np.asarray`` /
+  ``jax.device_get`` clears the taint) blocks on device compute.
+- ``jaxhost/np-on-device``: ``np.asarray``/``np.array``/``np.copy`` or
+  ``jax.device_get`` on a bare name/attribute forces a synchronous
+  device->host copy. The SANCTIONED fetch sites -- the one designed
+  barrier per path, prefetched via ``copy_to_host_async`` -- are the
+  ``SANCTIONED_FETCH`` manifest, shared verbatim with the runtime
+  witness so both halves bless exactly the same seams.
+- ``jaxhost/block-until-ready``: an explicit barrier in the hot path
+  serializes dispatch against the device; the pipelined tick exists to
+  avoid exactly that wait (trace-mode attribution barriers are vetted
+  baseline entries).
+
+Stdlib-only by design: `make lint` and the CI lint job never import jax.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from karpenter_tpu.analysis.base import Module, Violation
+from karpenter_tpu.analysis.base import dotted as _dotted
+
+# -- the bucketing manifest ---------------------------------------------------
+#
+# Every static_argnames entry in the tree must appear here with the
+# argument for WHY its value set is bounded (so the jit cache stays a
+# handful of programs per geometry, not one per tick). Adding a static
+# axis = adding an entry = explaining the bound in review.
+
+STATIC_ARG_BUCKETS: Dict[str, str] = {
+    "g_max": "open-group slot budget: fixed per solver instance "
+             "(TPUSolver(g_max=...)); bench/prod use one value per tier",
+    "nnz_max": "sparse-take budget: ffd.nnz_budget(c_pad, g_max), a pure "
+               "function of the padded class bucket and g_max -- one value "
+               "per (c_pad bucket, g_max) pair",
+    "word_offsets": "packed-bitset geometry: cumsum of the catalog's "
+                    "requirement-dimension word counts; one value per "
+                    "catalog encoding (staged once per seqnum)",
+    "words": "packed-bitset geometry: per-dimension word counts, fixed by "
+             "the catalog encoding alongside word_offsets",
+    "objective": "closed enum {'price', 'fit'}: two programs total",
+}
+
+# rel-path prefixes the jaxjit rules scan (jit entry points live here;
+# the control plane holds no jitted code by design)
+JIT_SCAN_PREFIXES: Tuple[str, ...] = (
+    "karpenter_tpu/solver/",
+    "karpenter_tpu/parallel/",
+)
+
+# module -> jit-decorated function names (the decoration-site registry).
+# The runtime witness resolves these for per-entry compilation-cache
+# attribution, and tests/test_analysis.py asserts the checker's
+# discovered decoration sites match -- a new jit entry point must be
+# ADDED here to get witness coverage.
+JIT_ENTRY_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
+    "karpenter_tpu.solver.ffd": (
+        "ffd_solve", "select_offerings", "ffd_solve_packed",
+        "ffd_solve_compact", "ffd_solve_fused",
+    ),
+    "karpenter_tpu.solver.consolidate": ("_repack", "_replacement_search"),
+}
+
+# modules that build jit wrappers dynamically (jax.jit(...) call sites,
+# cached per mesh/statics); the witness polls their caches instead
+DYNAMIC_JIT_MODULES: Tuple[str, ...] = ("karpenter_tpu.parallel.mesh",)
+
+# -- the device hot-path manifest ---------------------------------------------
+#
+# Same shape as zerocopy.HOT_PATH: rel -> (module functions, {class:
+# methods}). These are the per-tick encode -> dispatch -> decode
+# functions; a host sync inside any of them stalls the tick on device
+# compute (or worse, serializes the pipelined begin/finish overlap).
+
+DEVICE_HOT_PATH: Dict[str, Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]] = {
+    "karpenter_tpu/solver/encode.py": (
+        ("group_pods", "encode_classes"),
+        {},
+    ),
+    "karpenter_tpu/solver/spread.py": (
+        ("split_zone_spread",),
+        {},
+    ),
+    "karpenter_tpu/solver/ffd.py": (
+        ("make_inputs_staged", "solve_dense_tuple", "expand_fused",
+         "expand_compact"),
+        {},
+    ),
+    "karpenter_tpu/solver/service.py": (
+        (),
+        {"TPUSolver": ("solve_begin", "solve_finish", "_finish_remote",
+                       "_solve_local_dense", "_pack_existing")},
+    ),
+    "karpenter_tpu/solver/rpc.py": (
+        (),
+        {
+            "SolverServer": ("_op_solve_delta", "_staged_inputs",
+                             "_op_solve", "_op_solve_compact"),
+            "SolverClient": ("begin_solve_compact", "finish_solve_compact"),
+        },
+    ),
+    "karpenter_tpu/solver/consolidate.py": (
+        (),
+        {"ConsolidationEvaluator": ("evaluate",)},
+    ),
+    "karpenter_tpu/parallel/mesh.py": (
+        ("sharded_solve", "sharded_repack", "_fetch_multiprocess"),
+        {},
+    ),
+}
+
+# (rel-path, function-name) pairs where a device->host conversion is THE
+# designed fetch barrier for its path (prefetched via
+# copy_to_host_async, one round trip per tick). The runtime witness
+# (jax_witness.py) exempts transfers whose call stack passes through one
+# of these, so the static and dynamic passes bless identical seams.
+SANCTIONED_FETCH: Set[Tuple[str, str]] = {
+    ("karpenter_tpu/solver/ffd.py", "solve_dense_tuple"),
+    ("karpenter_tpu/solver/ffd.py", "expand_fused"),
+    ("karpenter_tpu/solver/ffd.py", "expand_compact"),
+    ("karpenter_tpu/solver/service.py", "solve_finish"),
+    ("karpenter_tpu/solver/service.py", "_pack_existing"),
+    ("karpenter_tpu/solver/rpc.py", "_op_solve"),
+    ("karpenter_tpu/solver/rpc.py", "_op_solve_compact"),
+    ("karpenter_tpu/solver/consolidate.py", "evaluate"),
+    ("karpenter_tpu/parallel/mesh.py", "_fetch_multiprocess"),
+}
+
+RULE_UNBOUNDED = "jaxjit/unbounded-static"
+RULE_CLOSURE = "jaxjit/closure-state"
+RULE_BRANCH = "jaxjit/traced-branch"
+RULE_DTYPE = "jaxjit/weak-dtype"
+RULE_ITEM = "jaxhost/item"
+RULE_CAST = "jaxhost/scalar-cast"
+RULE_NP = "jaxhost/np-on-device"
+RULE_BLOCK = "jaxhost/block-until-ready"
+
+# attribute reads that produce trace-time Python values (no taint)
+_SHAPE_ATTRS = ("shape", "dtype", "ndim", "size", "weak_type", "sharding")
+# calls whose result is never a traced value regardless of arguments
+_TAINT_KILLERS = ("len", "isinstance", "type", "range", "id", "repr", "str")
+_CREATION_FNS = ("zeros", "ones", "full", "empty", "arange", "linspace",
+                 "eye", "identity", "array", "asarray")
+_DTYPE_NAME_HINTS = (
+    "float32", "float64", "float16", "bfloat16", "int8", "int16", "int32",
+    "int64", "uint8", "uint16", "uint32", "uint64", "bool_", "complex64",
+)
+_NP_SYNC_TAILS = ("asarray", "array", "copy")
+_JIT_ENTRY_NAMES = frozenset(
+    name for names in JIT_ENTRY_FUNCTIONS.values() for name in names
+)
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return _dotted(node) in ("jax.jit", "jit")
+
+
+def _jit_call_of(dec: ast.AST) -> Optional[ast.Call]:
+    """The jax.jit(...) Call a decorator represents, or None. Handles
+    ``@jax.jit``, ``@jax.jit(...)``, and ``@functools.partial(jax.jit,
+    ...)`` (the repo idiom)."""
+    if isinstance(dec, ast.Call):
+        if _is_jax_jit(dec.func):
+            return dec
+        if _dotted(dec.func) in ("functools.partial", "partial") and dec.args \
+                and _is_jax_jit(dec.args[0]):
+            return dec
+    return None
+
+
+def _literal_argnames(call: ast.Call) -> Optional[Tuple[Optional[List[str]], bool]]:
+    """(static_argnames as a list of strings or None when absent,
+    uses_static_argnums). Returns None when static_argnames is present
+    but not a literal (itself a violation)."""
+    names: Optional[List[str]] = None
+    has_nums = False
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            has_nums = True
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                names = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in v.elts
+            ):
+                names = [e.value for e in v.elts]  # type: ignore[misc]
+            else:
+                return None
+    return names, has_nums
+
+
+class _ModuleContext:
+    """Per-module name classification for the jitted-body rules."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.constants: Set[str] = set()
+        self.mutables: Set[str] = set()
+        imported: Set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node  # type: ignore[assignment]
+            elif isinstance(node, ast.ClassDef):
+                imported.add(node.name)
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    imported.add((a.asname or a.name).split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    imported.add(a.asname or a.name)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            if n.id.lstrip("_").isupper():
+                                self.constants.add(n.id)
+                            else:
+                                self.mutables.add(n.id)
+        self.imported = imported
+        # a name both assigned and imported counts as imported (re-export)
+        self.mutables -= imported
+
+
+class _BodyScan:
+    """Taint-tracking walk of ONE jitted body (plus module-local helpers,
+    transitively). Taint = "this expression is a traced value"."""
+
+    def __init__(self, ctx: _ModuleContext, out: List[Violation],
+                 seen: Set[Tuple[str, int, str]]):
+        self.ctx = ctx
+        self.out = out
+        self.seen = seen          # (rule, line, detail) dedup across entry points
+        # (FunctionDef id, frozen traced-param set): a helper is
+        # re-scanned per DISTINCT taint mapping -- one call site passing
+        # only statics must not shadow a later one passing traced values
+        self.visited: Set[Tuple[int, frozenset]] = set()
+
+    def _emit(self, rule: str, node: ast.AST, msg: str) -> None:
+        key = (rule, getattr(node, "lineno", 0), msg)
+        if key in self.seen:
+            return
+        self.seen.add(key)
+        self.out.append(self.ctx.mod.violation(rule, node, msg))
+
+    # -- taint evaluation -----------------------------------------------------
+    def _taint(self, node: ast.AST, env: Dict[str, bool]) -> bool:
+        if isinstance(node, ast.Name):
+            return env.get(node.id, False)
+        if isinstance(node, ast.Attribute):
+            if node.attr in _SHAPE_ATTRS:
+                return False
+            return self._taint(node.value, env)
+        if isinstance(node, ast.Subscript):
+            return self._taint(node.value, env)
+        if isinstance(node, ast.Call):
+            f = _dotted(node.func) or ""
+            if f in _TAINT_KILLERS:
+                return False
+            # a method call on a traced value (x.sum(), v.max()) is traced
+            recv = self._taint(node.func.value, env) \
+                if isinstance(node.func, ast.Attribute) else False
+            return recv or any(self._taint(a, env) for a in node.args) or any(
+                self._taint(kw.value, env) for kw in node.keywords
+            )
+        if isinstance(node, (ast.BinOp,)):
+            return self._taint(node.left, env) or self._taint(node.right, env)
+        if isinstance(node, ast.BoolOp):
+            return any(self._taint(v, env) for v in node.values)
+        if isinstance(node, ast.Compare):
+            return self._taint(node.left, env) or any(
+                self._taint(c, env) for c in node.comparators
+            )
+        if isinstance(node, ast.UnaryOp):
+            return self._taint(node.operand, env)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self._taint(e, env) for e in node.elts)
+        if isinstance(node, ast.IfExp):
+            return (self._taint(node.body, env) or self._taint(node.orelse, env)
+                    or self._taint(node.test, env))
+        if isinstance(node, ast.Starred):
+            return self._taint(node.value, env)
+        return False
+
+    def _bind(self, target: ast.AST, tainted: bool, env: Dict[str, bool]) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name):
+                env[n.id] = tainted
+
+    # -- the walk -------------------------------------------------------------
+    def scan_function(self, fn: ast.FunctionDef,
+                      traced_params: Optional[Iterable[str]] = None,
+                      outer_env: Optional[Dict[str, bool]] = None) -> None:
+        args = fn.args
+        all_params = [a.arg for a in
+                      args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            all_params.append(args.vararg.arg)
+        if args.kwarg:
+            all_params.append(args.kwarg.arg)
+        traced = set(traced_params) if traced_params is not None else set(all_params)
+        key = (id(fn), frozenset(traced))
+        if key in self.visited:
+            return
+        self.visited.add(key)
+        env: Dict[str, bool] = dict(outer_env or {})
+        for p in all_params:
+            env[p] = p in traced
+        self._scan_block(fn.body, env)
+
+    def _scan_block(self, body: List[ast.stmt], env: Dict[str, bool]) -> None:
+        for stmt in body:
+            self._scan_stmt(stmt, env)
+
+    def _scan_stmt(self, stmt: ast.stmt, env: Dict[str, bool]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def inside a jitted body is (almost always) traced
+            # through lax control flow: every parameter is a traced value,
+            # free variables resolve through the enclosing taint env
+            self.scan_function(stmt, None, outer_env=env)  # type: ignore[arg-type]
+            env[stmt.name] = False
+            return
+        if isinstance(stmt, ast.Assign):
+            self._scan_expr(stmt.value, env)
+            t = self._taint(stmt.value, env)
+            for target in stmt.targets:
+                self._bind(target, t, env)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._scan_expr(stmt.value, env)
+            self._bind(stmt.target, self._taint(stmt.value, env), env)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_expr(stmt.value, env)
+            if self._taint(stmt.value, env):
+                self._bind(stmt.target, True, env)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._scan_expr(stmt.test, env)
+            if self._taint(stmt.test, env):
+                self._emit(RULE_BRANCH, stmt,
+                           "Python branching on a traced value inside a jitted "
+                           "body; use jnp.where/lax.cond (or make the input a "
+                           "manifest-declared static)")
+            self._scan_block(stmt.body, env)
+            self._scan_block(stmt.orelse, env)
+            return
+        if isinstance(stmt, ast.For):
+            self._scan_expr(stmt.iter, env)
+            if self._taint(stmt.iter, env):
+                self._emit(RULE_BRANCH, stmt,
+                           "Python loop over a traced value inside a jitted "
+                           "body; use lax.scan/fori_loop")
+            self._bind(stmt.target, False, env)
+            self._scan_block(stmt.body, env)
+            self._scan_block(stmt.orelse, env)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._scan_expr(stmt.value, env)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value, env)
+            return
+        if isinstance(stmt, (ast.With,)):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, env)
+            self._scan_block(stmt.body, env)
+            return
+        if isinstance(stmt, (ast.Try,)):
+            self._scan_block(stmt.body, env)
+            for h in stmt.handlers:
+                self._scan_block(h.body, env)
+            self._scan_block(stmt.orelse, env)
+            self._scan_block(stmt.finalbody, env)
+            return
+        # raise/pass/assert/etc: walk expressions for rule hits
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, env)
+
+    def _scan_expr(self, expr: ast.expr, env: Dict[str, bool]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self" and isinstance(node.ctx, ast.Load):
+                self._emit(RULE_CLOSURE, node,
+                           f"jitted body reads instance state self.{node.attr}; "
+                           "jax hashes closures by identity -- pass it as an "
+                           "argument (static if bounded, traced otherwise)")
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) \
+                    and node.id in self.ctx.mutables and node.id not in env:
+                self._emit(RULE_CLOSURE, node,
+                           f"jitted body reads module-level mutable {node.id!r}; "
+                           "a rebind is invisible to the compiled program -- "
+                           "pass it as an argument or promote it to an "
+                           "ALL_CAPS constant")
+            elif isinstance(node, ast.IfExp) and self._taint(node.test, env):
+                self._emit(RULE_BRANCH, node,
+                           "ternary on a traced value inside a jitted body; "
+                           "use jnp.where")
+            elif isinstance(node, ast.Call):
+                self._check_call(node, env)
+
+    def _check_call(self, node: ast.Call, env: Dict[str, bool]) -> None:
+        f = _dotted(node.func) or ""
+        parts = f.split(".")
+        # weak-dtype: array creation without an explicit dtype
+        if len(parts) >= 2 and parts[-1] in _CREATION_FNS \
+                and parts[-2] in ("jnp", "numpy", "np"):
+            if not self._has_dtype(node):
+                self._emit(RULE_DTYPE, node,
+                           f"{f}() without an explicit dtype inside a jitted "
+                           "body leaks a weak type; a weak-vs-committed dtype "
+                           "mismatch between call paths is a retrace")
+        # transitive scan of module-local helpers, with argument taints
+        # mapped onto the callee's parameters
+        target = None
+        if len(parts) == 1 and parts[0] in self.ctx.functions:
+            target = self.ctx.functions[parts[0]]
+        if target is not None:
+            # scan_function dedupes by (function, taint set): each call
+            # site contributes its own mapping
+            traced = self._map_call_taints(target, node, env)
+            self.scan_function(target, traced)
+
+    def _map_call_taints(self, fn: ast.FunctionDef, call: ast.Call,
+                         env: Dict[str, bool]) -> Set[str]:
+        params = [a.arg for a in
+                  fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs]
+        traced: Set[str] = set()
+        for i, a in enumerate(call.args):
+            if i < len(params) and self._taint(a, env):
+                traced.add(params[i])
+        for kw in call.keywords:
+            if kw.arg in params and self._taint(kw.value, env):
+                traced.add(kw.arg)
+        return traced
+
+    @staticmethod
+    def _has_dtype(node: ast.Call) -> bool:
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                return True
+        for a in node.args[1:]:
+            if isinstance(a, ast.Attribute) and a.attr in _DTYPE_NAME_HINTS:
+                return True
+            if isinstance(a, ast.Name) and a.id in ("bool", "float", "int"):
+                return True
+        return False
+
+
+def jit_decoration_sites(modules: List[Module]) -> Dict[str, List[Tuple[str, ast.FunctionDef, Optional[ast.Call]]]]:
+    """rel -> [(name, function node, jit call or None for bare @jax.jit)]
+    for every jit-decorated function under the scan prefixes."""
+    out: Dict[str, List[Tuple[str, ast.FunctionDef, Optional[ast.Call]]]] = {}
+    for mod in modules:
+        if not mod.rel.startswith(JIT_SCAN_PREFIXES):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                call = _jit_call_of(dec)
+                if call is not None or _is_jax_jit(dec):
+                    out.setdefault(mod.rel, []).append(
+                        (node.name, node, call))  # type: ignore[arg-type]
+    return out
+
+
+def _validate_jit_statics(mod: Module, call: ast.Call, where: str,
+                          out: List[Violation]) -> List[str]:
+    """Shared static_argnames/static_argnums policy for BOTH discovery
+    paths (decorators and standalone jax.jit(...) wrappers): literal
+    argnames only, no positional argnums, every name a declared bucket.
+    Returns the parsed static names (empty on a non-literal)."""
+    lit = _literal_argnames(call)
+    if lit is None:
+        out.append(mod.violation(
+            RULE_UNBOUNDED, call,
+            f"{where}: static_argnames must be a literal tuple of strings "
+            "so the bucketing manifest can be checked"))
+        return []
+    names, has_nums = lit
+    if has_nums:
+        out.append(mod.violation(
+            RULE_UNBOUNDED, call,
+            f"{where}: static_argnums is positional and drifts silently "
+            "under refactors; use static_argnames"))
+    for sn in names or []:
+        if sn not in STATIC_ARG_BUCKETS:
+            out.append(mod.violation(
+                RULE_UNBOUNDED, call,
+                f"{where}: static arg {sn!r} is not in the "
+                "bounded-cardinality bucketing manifest (STATIC_ARG_BUCKETS); "
+                "an unbounded static compiles one program per distinct value"))
+    return list(names or [])
+
+
+def check_retrace(modules: List[Module]) -> List[Violation]:
+    out: List[Violation] = []
+    sites = jit_decoration_sites(modules)
+    for mod in modules:
+        if not mod.rel.startswith(JIT_SCAN_PREFIXES):
+            continue
+        entries = sites.get(mod.rel, [])
+        decorator_calls = {id(call) for _, _, call in entries if call is not None}
+        ctx = _ModuleContext(mod)
+        seen: Set[Tuple[str, int, str]] = set()
+        scan = _BodyScan(ctx, out, seen)
+        for name, fn, call in entries:
+            static_names: List[str] = []
+            if call is not None:
+                static_names = _validate_jit_statics(mod, call, name, out)
+            args = fn.args
+            params = [a.arg for a in
+                      args.posonlyargs + args.args + args.kwonlyargs]
+            traced = [p for p in params if p not in static_names]
+            scan.scan_function(fn, traced)
+        # standalone jax.jit(...) call sites (dynamic wrappers, mesh.py):
+        # statics still validate; bodies resolve only for local names
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func) \
+                    and id(node) not in decorator_calls:
+                _validate_jit_statics(mod, node, "jax.jit call", out)
+    return out
+
+
+# -- host-sync rules ----------------------------------------------------------
+
+
+def _taints_from_jit_calls(fn: ast.AST) -> Set[str]:
+    """Names whose LAST assignment (in source order) in this function is
+    directly a jit entry-point call. Any other reassignment clears
+    (fetching through np.asarray / jax.device_get launders the device
+    value by design). ast.walk is breadth-first, so assignments are
+    explicitly re-sorted by source position -- a nested conditional
+    assign must not be processed after a later top-level one."""
+    assigns = sorted(
+        (n for n in ast.walk(fn) if isinstance(n, ast.Assign)),
+        key=lambda n: (n.lineno, n.col_offset),
+    )
+    tainted: Set[str] = set()
+    for node in assigns:
+        v = node.value
+        d = _dotted(v.func) if isinstance(v, ast.Call) else None
+        is_jit = d is not None and d.split(".")[-1] in _JIT_ENTRY_NAMES
+        for target in node.targets:
+            for n in ast.walk(target):
+                if isinstance(n, ast.Name):
+                    if is_jit:
+                        tainted.add(n.id)
+                    else:
+                        tainted.discard(n.id)
+    return tainted
+
+
+def _scan_hot_function(mod: Module, fn: ast.AST, where: str,
+                       sanctioned: bool) -> List[Violation]:
+    out: List[Violation] = []
+    tainted = _taints_from_jit_calls(fn)
+
+    def root_name(e: ast.AST) -> Optional[str]:
+        while isinstance(e, (ast.Attribute, ast.Subscript)):
+            e = e.value
+        return e.id if isinstance(e, ast.Name) else None
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        d = _dotted(f) or ""
+        tail = d.split(".")[-1]
+        if tail == "item" and isinstance(f, ast.Attribute):
+            out.append(mod.violation(RULE_ITEM, node,
+                                     f"{where}: .item() synchronously round-trips "
+                                     "device->host on the tick hot path"))
+        elif tail == "block_until_ready":
+            out.append(mod.violation(RULE_BLOCK, node,
+                                     f"{where}: explicit device barrier on the hot "
+                                     "path serializes the pipelined tick"))
+        elif d in ("float", "int") and node.args:
+            arg = node.args[0]
+            an = root_name(arg)
+            arg_call = _dotted(arg.func) if isinstance(arg, ast.Call) else None
+            from_jit = arg_call is not None and \
+                arg_call.split(".")[-1] in _JIT_ENTRY_NAMES
+            if (an is not None and an in tainted) or from_jit:
+                out.append(mod.violation(RULE_CAST, node,
+                                         f"{where}: {d}() on a jit-entry result "
+                                         "blocks on device compute; fetch through "
+                                         "the sanctioned barrier first"))
+        elif not sanctioned and (
+            (tail in _NP_SYNC_TAILS and len(d.split(".")) >= 2
+             and d.split(".")[-2] in ("np", "numpy"))
+            or tail == "device_get"
+        ):
+            if node.args and isinstance(node.args[0], (ast.Name, ast.Attribute)):
+                out.append(mod.violation(RULE_NP, node,
+                                         f"{where}: {d}() forces a synchronous "
+                                         "device->host copy; route through a "
+                                         "SANCTIONED_FETCH site (prefetched via "
+                                         "copy_to_host_async)"))
+    return out
+
+
+def check_hostsync(modules: List[Module]) -> List[Violation]:
+    out: List[Violation] = []
+    by_rel = {m.rel: m for m in modules}
+    for rel, (func_names, class_methods) in DEVICE_HOT_PATH.items():
+        mod = by_rel.get(rel)
+        if mod is None:
+            continue
+        for node in mod.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in func_names:
+                out.extend(_scan_hot_function(
+                    mod, node, node.name, (rel, node.name) in SANCTIONED_FETCH))
+            elif isinstance(node, ast.ClassDef) and node.name in class_methods:
+                wanted = class_methods[node.name]
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                            and item.name in wanted:
+                        out.extend(_scan_hot_function(
+                            mod, item, f"{node.name}.{item.name}",
+                            (rel, item.name) in SANCTIONED_FETCH))
+    return out
+
+
+def hot_path_functions(rel: str) -> Optional[Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]]:
+    """Manifest lookup (the zerocopy contract shape): a new hot-path
+    function must be ADDED here to be guarded."""
+    return DEVICE_HOT_PATH.get(rel)

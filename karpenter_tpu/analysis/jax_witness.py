@@ -1,0 +1,432 @@
+"""Runtime retrace/transfer witness: the dynamic half of the jax
+compilation-discipline checker.
+
+The static pass (checkers/jax_discipline.py) rejects retrace hazards and
+host syncs it can SEE -- but a retrace can also come from a shape the
+bucketing missed, a weak-type drift between call paths, or a dependency
+bump changing jit cache keys, and a host transfer can hide behind any
+call the AST cannot resolve. This module is the runtime complement, the
+jax analogue of the lock-order witness (witness.py):
+
+- **Compile events.** ``install()`` registers a ``jax.monitoring``
+  duration listener; every ``/jax/core/compile/*`` phase is accumulated
+  into a breakdown (count + seconds, persisted by bench via the PR-5
+  side-file). A ``jaxpr_trace`` that fires inside a ``hot()`` section --
+  after the caller declared warmup complete -- is a RETRACE: recorded
+  with the dispatch stack (the listener runs synchronously in the
+  compiling thread, so the stack IS the call site) and counted into
+  ``karpenter_jaxwitness_retraces_total``. The trigger is the trace
+  phase rather than ``backend_compile`` deliberately: with the
+  persistent compilation cache warm, a retrace still re-traces and
+  re-lowers (the stall) while the binary comes from disk.
+- **Host transfers.** ``install()`` wraps ``np.asarray`` / ``np.array``
+  and ``jax.device_get``. A conversion of a live ``jax.Array`` whose
+  call stack does NOT pass through a ``SANCTIONED_FETCH`` function (the
+  manifest shared verbatim with the static checker -- both halves bless
+  exactly the same seams) inside a ``hot()`` section is a violation,
+  counted into ``karpenter_jaxwitness_host_transfers_total``. Python
+  scalarization (``float(arr)`` / ``.item()``) bottoms out in C++ and is
+  not hookable at this layer; the static ``jaxhost/`` rules own those
+  spellings.
+
+A deadlock needs two orders to run concurrently; a retrace only needs
+the warm path to run AT ALL after warmup -- so tier-1 doubles as the
+schedule generator: tests/conftest.py installs the witness session-wide
+(KARPENTER_TPU_JAX_WITNESS=0 disables), the warm-delta suite drives the
+production tick inside ``hot()``, and the session fixture asserts ZERO
+hot-section retraces and transfers at teardown. Bench's warm stage runs
+its measured loop under ``hot()`` and persists ``warm_retrace_count``
+(asserted 0) plus the compile-time breakdown.
+
+Importing this module stays jax/numpy-free (same contract as the lock
+witness: conftest may import it before heavy deps); everything heavyweight
+happens inside ``install()``.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from karpenter_tpu.analysis.base import PACKAGE_ROOT, REPO_ROOT
+from karpenter_tpu.analysis.checkers.jax_discipline import (
+    DYNAMIC_JIT_MODULES, JIT_ENTRY_FUNCTIONS, SANCTIONED_FETCH)
+
+_RETRACES = None
+_TRANSFERS = None
+
+
+def _retraces_metric():
+    """Lazy like the lock witness's: importing this module must not drag
+    in karpenter_tpu.metrics (conftest order), and metrics_gen reaches
+    the families through the _register_metrics hook."""
+    global _RETRACES
+    if _RETRACES is None:
+        from karpenter_tpu import metrics
+
+        _RETRACES = metrics.REGISTRY.counter(
+            "karpenter_jaxwitness_retraces_total",
+            "Jit traces observed inside a declared-warm hot section (a "
+            "retrace on the delta path after warmup: an unbounded static "
+            "arg, a shape outside the padding buckets, or a weak-type "
+            "drift -- counted at the trace phase so a warm persistent "
+            "compilation cache cannot mask the stall). Asserted zero by "
+            "tier-1 and the bench warm stage.",
+        )
+    return _RETRACES
+
+
+def _transfers_metric():
+    global _TRANSFERS
+    if _TRANSFERS is None:
+        from karpenter_tpu import metrics
+
+        _TRANSFERS = metrics.REGISTRY.counter(
+            "karpenter_jaxwitness_host_transfers_total",
+            "Device->host conversions of live jax arrays inside a hot "
+            "section from OUTSIDE the sanctioned-fetch manifest (a stray "
+            "np.asarray/device_get stalling the tick on device compute). "
+            "Asserted zero by tier-1 and the bench warm stage.",
+        )
+    return _TRANSFERS
+
+
+def _register_metrics():
+    _retraces_metric()
+    _transfers_metric()
+
+
+if "karpenter_tpu.metrics" in sys.modules:
+    _register_metrics()
+
+_REAL_LOCK = threading.Lock
+_PKG_PREFIX = str(PACKAGE_ROOT) + "/"
+_REPO_PREFIX = str(REPO_ROOT) + "/"
+
+
+class JaxWitnessViolation(RuntimeError):
+    """Raised in strict mode at the offending compile/transfer."""
+
+
+@dataclass(frozen=True)
+class Retrace:
+    label: str        # hot-section label
+    site: str         # first package frame of the dispatch (file:line)
+    secs: float       # jaxpr trace duration (the re-trace cost; backend
+                      # compile may be served from the persistent cache)
+    stack: str
+
+    def render(self) -> str:
+        return (f"jit retrace inside hot section {self.label!r} at {self.site} "
+                f"({self.secs * 1e3:.1f} ms jaxpr re-trace; backend compile "
+                f"extra when the persistent cache misses)\n{self.stack}")
+
+
+@dataclass(frozen=True)
+class Transfer:
+    label: str
+    kind: str         # "np.asarray" | "np.array" | "jax.device_get"
+    site: str
+    stack: str
+
+    def render(self) -> str:
+        return (f"unsanctioned host transfer ({self.kind}) inside hot section "
+                f"{self.label!r} at {self.site}\n{self.stack}")
+
+
+@dataclass
+class _State:
+    guard: Any = field(default_factory=_REAL_LOCK)
+    installed: bool = False
+    strict: bool = False
+    listener_registered: bool = False
+    hot_depth: int = 0
+    hot_labels: List[str] = field(default_factory=list)
+    retraces: List[Retrace] = field(default_factory=list)
+    transfers: List[Transfer] = field(default_factory=list)
+    sanctioned_fetches: int = 0
+    cold_unsanctioned: int = 0     # diagnostics only: outside hot sections
+    compiles_total: int = 0
+    compile_secs_total: float = 0.0
+    compile_breakdown: Dict[str, List[float]] = field(default_factory=dict)
+    originals: Dict[str, Any] = field(default_factory=dict)
+    array_type: Any = None
+
+
+_state = _State()
+
+_COMPILE_PREFIX = "/jax/core/compile/"
+_BACKEND_PHASE = "backend_compile_duration"
+# the hot-section retrace trigger is the TRACE phase, not the backend
+# compile: with the persistent compilation cache warm (bench enables it),
+# a retrace re-traces and re-lowers -- a 100ms+ stall -- but serves the
+# binary from disk, so backend_compile never fires. jaxpr_trace fires on
+# every jit python-cache miss and on nothing else.
+_TRACE_PHASE = "jaxpr_trace_duration"
+
+
+def _pkg_site_and_sanctioned() -> Tuple[str, bool]:
+    """(first package frame as file:line, stack passes through a
+    SANCTIONED_FETCH function). Walks at most a dozen frames -- only runs
+    on actual jax-array transfers / compile events, never per-op."""
+    site = "<outside-package>"
+    sanctioned = False
+    f = sys._getframe(2)
+    pkg_frames = 0
+    while f is not None and pkg_frames < 12:
+        fn = f.f_code.co_filename
+        if fn != __file__ and fn.startswith(_PKG_PREFIX):
+            rel = fn[len(_REPO_PREFIX):]
+            if site == "<outside-package>":
+                site = f"{rel}:{f.f_lineno}"
+            if (rel, f.f_code.co_name) in SANCTIONED_FETCH:
+                sanctioned = True
+                break
+            pkg_frames += 1
+        f = f.f_back
+    return site, sanctioned
+
+
+def _stack() -> str:
+    return "".join(traceback.format_stack(limit=12)[:-2])
+
+
+def _on_compile_duration(name: str, secs: float, **kw: Any) -> None:
+    if not name.startswith(_COMPILE_PREFIX) or not _state.installed:
+        return
+    phase = name[len(_COMPILE_PREFIX):]
+    hit: Optional[Retrace] = None
+    with _state.guard:
+        cell = _state.compile_breakdown.setdefault(phase, [0, 0.0])
+        cell[0] += 1
+        cell[1] += secs
+        if phase == _BACKEND_PHASE:
+            _state.compiles_total += 1
+            _state.compile_secs_total += secs
+        if phase == _TRACE_PHASE and _state.hot_depth > 0:
+            site, _ = _pkg_site_and_sanctioned()
+            hit = Retrace(
+                label=_state.hot_labels[-1] if _state.hot_labels else "?",
+                site=site, secs=secs, stack=_stack(),
+            )
+            _state.retraces.append(hit)
+    if hit is not None:
+        _retraces_metric().inc()
+        if _state.strict:
+            raise JaxWitnessViolation(hit.render())
+
+
+def _note_transfer(kind: str) -> None:
+    site, sanctioned = _pkg_site_and_sanctioned()
+    if sanctioned:
+        with _state.guard:
+            _state.sanctioned_fetches += 1
+        return
+    hit: Optional[Transfer] = None
+    with _state.guard:
+        if _state.hot_depth > 0:
+            hit = Transfer(
+                label=_state.hot_labels[-1] if _state.hot_labels else "?",
+                kind=kind, site=site, stack=_stack(),
+            )
+            _state.transfers.append(hit)
+        else:
+            _state.cold_unsanctioned += 1
+    if hit is not None:
+        _transfers_metric().inc()
+        if _state.strict:
+            raise JaxWitnessViolation(hit.render())
+
+
+def _is_jax_value(x: Any) -> bool:
+    t = _state.array_type
+    return t is not None and isinstance(x, t)
+
+
+def _tree_has_jax(x: Any) -> bool:
+    if _is_jax_value(x):
+        return True
+    if isinstance(x, (tuple, list)):
+        return any(_is_jax_value(v) for v in x)
+    return False
+
+
+def install(strict: bool = False) -> None:
+    """Register the compile listener and patch the transfer seams.
+    Requires jax importable (tests/conftest.py and bench import jax
+    first); idempotent."""
+    _state.strict = strict
+    if _state.installed:
+        return
+    import jax
+    import numpy as np
+
+    _state.array_type = jax.Array
+    if not _state.listener_registered:
+        # jax.monitoring has no unregister; the callback goes inert via
+        # _state.installed instead
+        jax.monitoring.register_event_duration_secs_listener(_on_compile_duration)
+        _state.listener_registered = True
+    if not _state.originals:
+        real_asarray = np.asarray
+        real_array = np.array
+        real_device_get = jax.device_get
+
+        def asarray(*args: Any, **kwargs: Any):
+            if args and _state.installed and _is_jax_value(args[0]):
+                _note_transfer("np.asarray")
+            return real_asarray(*args, **kwargs)
+
+        def array(*args: Any, **kwargs: Any):
+            if args and _state.installed and _is_jax_value(args[0]):
+                _note_transfer("np.array")
+            return real_array(*args, **kwargs)
+
+        def device_get(x: Any):
+            if _state.installed and _tree_has_jax(x):
+                _note_transfer("jax.device_get")
+            return real_device_get(x)
+
+        _state.originals = {
+            "np.asarray": (np, "asarray", real_asarray),
+            "np.array": (np, "array", real_array),
+            "jax.device_get": (jax, "device_get", real_device_get),
+        }
+        np.asarray = asarray          # type: ignore[assignment]
+        np.array = array              # type: ignore[assignment]
+        jax.device_get = device_get   # type: ignore[assignment]
+    _state.installed = True
+
+
+def uninstall() -> None:
+    for mod, name, real in _state.originals.values():
+        setattr(mod, name, real)
+    _state.originals = {}
+    _state.installed = False
+
+
+def installed() -> bool:
+    return _state.installed
+
+
+class _HotSection:
+    def __init__(self, label: str):
+        self.label = label
+
+    def __enter__(self) -> "_HotSection":
+        with _state.guard:
+            _state.hot_depth += 1
+            _state.hot_labels.append(self.label)
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        with _state.guard:
+            _state.hot_depth -= 1
+            if _state.hot_labels:
+                _state.hot_labels.pop()
+        return False
+
+
+def hot(label: str = "hot") -> _HotSection:
+    """Declare warmup complete: until exit, ANY backend compile or
+    unsanctioned jax-array host conversion (process-wide -- the sidecar
+    server thread included, which is the point) is a recorded violation."""
+    return _HotSection(label)
+
+
+def reset() -> None:
+    """Drop accumulated events (a fresh witness epoch; patches stay)."""
+    with _state.guard:
+        _state.retraces.clear()
+        _state.transfers.clear()
+        _state.compile_breakdown.clear()
+        _state.compiles_total = 0
+        _state.compile_secs_total = 0.0
+        _state.sanctioned_fetches = 0
+        _state.cold_unsanctioned = 0
+
+
+def hot_retraces() -> List[Retrace]:
+    with _state.guard:
+        return list(_state.retraces)
+
+
+def hot_transfers() -> List[Transfer]:
+    with _state.guard:
+        return list(_state.transfers)
+
+
+def hot_violations() -> List[Any]:
+    with _state.guard:
+        return list(_state.retraces) + list(_state.transfers)
+
+
+def stats() -> Dict[str, Any]:
+    """Snapshot for bench persistence: totals plus the per-phase compile
+    breakdown {phase: {count, secs}}."""
+    with _state.guard:
+        return {
+            "compiles_total": _state.compiles_total,
+            "compile_secs_total": round(_state.compile_secs_total, 4),
+            "compile_breakdown": {
+                phase: {"count": int(c), "secs": round(s, 4)}
+                for phase, (c, s) in sorted(_state.compile_breakdown.items())
+            },
+            "hot_retraces": len(_state.retraces),
+            "hot_transfers": len(_state.transfers),
+            "sanctioned_fetches": _state.sanctioned_fetches,
+            "cold_unsanctioned_transfers": _state.cold_unsanctioned,
+        }
+
+
+def entry_cache_sizes() -> Dict[str, int]:
+    """Per-entry jit cache sizes from the decoration-site registry
+    (JIT_ENTRY_FUNCTIONS) plus the dynamic wrapper caches -- the
+    per-call-site attribution surface: snapshot before warmup, compare
+    after; a grown entry is the one that retraced. Only entries whose
+    modules are already imported are reported (polling must not import
+    solver modules in a process that avoided them)."""
+    out: Dict[str, int] = {}
+    for modname, fns in JIT_ENTRY_FUNCTIONS.items():
+        mod = sys.modules.get(modname)
+        if mod is None:
+            continue
+        for fn in fns:
+            jitted = getattr(mod, fn, None)
+            size = getattr(jitted, "_cache_size", None)
+            if callable(size):
+                try:
+                    out[f"{modname}.{fn}"] = int(size())
+                except Exception:  # pragma: no cover - cache introspection only
+                    pass
+    for modname in DYNAMIC_JIT_MODULES:
+        mod = sys.modules.get(modname)
+        cache = getattr(mod, "_JIT_CACHE", None) if mod else None
+        if cache:
+            for key, jitted in list(cache.items()):
+                size = getattr(jitted, "_cache_size", None)
+                if callable(size):
+                    try:
+                        out[f"{modname}[{key!r}]"] = int(size())
+                    except Exception:  # pragma: no cover
+                        pass
+    return out
+
+
+def report() -> str:
+    st = stats()
+    if not st["hot_retraces"] and not st["hot_transfers"]:
+        return (
+            f"jax witness: 0 hot-section retraces, 0 unsanctioned hot "
+            f"transfers ({st['compiles_total']} warmup compiles, "
+            f"{st['sanctioned_fetches']} sanctioned fetches)"
+        )
+    out = [
+        f"jax witness: {st['hot_retraces']} retrace(s), "
+        f"{st['hot_transfers']} unsanctioned transfer(s) in hot sections:"
+    ]
+    out.extend(r.render() for r in hot_retraces())
+    out.extend(t.render() for t in hot_transfers())
+    return "\n".join(out)

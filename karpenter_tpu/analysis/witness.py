@@ -42,7 +42,7 @@ from __future__ import annotations
 import threading
 import traceback
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import sys
 
@@ -110,8 +110,9 @@ class Inversion:
 @dataclass
 class _State:
     # bookkeeping guarded by a REAL (unwitnessed) lock; edges/inversions
-    # are tiny (site pairs, not acquisitions)
-    guard: object = field(default_factory=_REAL_LOCK)
+    # are tiny (site pairs, not acquisitions). Any: the factory is the
+    # saved pre-patch threading.Lock, opaque to the checker
+    guard: Any = field(default_factory=_REAL_LOCK)
     edges: Dict[Tuple[str, str], str] = field(default_factory=dict)  # -> first stack
     inversions: List[Inversion] = field(default_factory=list)
     seen_pairs: set = field(default_factory=set)

@@ -212,6 +212,11 @@ class ConsolidationEvaluator:
         pools/catalogs: replacement context (optional; omit for delete-only).
         daemon_overhead: per-pool fresh-node reserve (apis/daemonset) --
         a replacement node must fit the leftovers PLUS its daemonsets.
+
+        On the jax-discipline hot-path manifest (DEVICE_HOT_PATH) and a
+        SANCTIONED_FETCH site: the np.asarray fetches below are this
+        path's designed host barriers (async-prefetched); any other sync
+        added here is a lint violation.
         """
         if not sets:
             return []

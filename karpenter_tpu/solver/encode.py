@@ -697,7 +697,11 @@ def encode_classes(
     node_overhead: Optional[np.ndarray] = None,
     row_cache: Optional[Dict] = None,
 ) -> PodClassSet:
-    """classes -> dense solver tensors. `row_cache` (optional, scoped to
+    """classes -> dense solver tensors. On the jax-discipline hot-path
+    manifest (DEVICE_HOT_PATH): per-tick encode work stays host-side
+    numpy; a device-value sync here is a lint violation.
+
+    `row_cache` (optional, scoped to
     ONE catalog encoding -- the caller keys it per staged-catalog entry)
     memoizes the per-class row products that are pure functions of
     (requirements, tolerations, pool taints, requests): the packed allowed

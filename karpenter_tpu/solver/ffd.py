@@ -194,6 +194,11 @@ def ffd_solve_impl(
     return _ffd_body(inp, g_max, word_offsets, words, objective=objective)
 
 
+# every static_argnames entry below is a declared bounded-cardinality
+# bucket (STATIC_ARG_BUCKETS in analysis/checkers/jax_discipline.py);
+# adding a static axis means adding a manifest entry explaining its
+# bound, and the decoration sites are registered in JIT_ENTRY_FUNCTIONS
+# for the runtime witness's per-entry cache attribution (test-enforced)
 @functools.partial(jax.jit, static_argnames=("g_max", "word_offsets", "words", "objective"))
 def ffd_solve(
     inp: SolveInputs, *, g_max: int, word_offsets: Tuple[int, ...], words: Tuple[int, ...],
@@ -455,7 +460,9 @@ def _sparse_take(take: jax.Array, nnz_max: int) -> Tuple[jax.Array, jax.Array, j
     flat = take.ravel()
     nnz_true = jnp.sum(flat != 0).astype(jnp.int32)
     (idx,) = jnp.nonzero(flat, size=nnz_max, fill_value=0)
-    valid = jnp.arange(nnz_max) < nnz_true
+    # explicit dtype: a weak-int arange would re-specialize the program
+    # if a caller ever committed the comparison operand's dtype
+    valid = jnp.arange(nnz_max, dtype=jnp.int32) < nnz_true
     val = jnp.where(valid, flat[idx], 0).astype(jnp.int32)
     idx = jnp.where(valid, idx, -1).astype(jnp.int32)
     return idx, val, nnz_true
@@ -622,7 +629,12 @@ def solve_dense_tuple(
 ):
     """Dense solve fetched to host as the (take, unplaced, n_open, gmask,
     gzone, gcap) decode tuple -- the fallback when a CompactDecision's
-    sparse budget overflows (expand_compact returned None)."""
+    sparse budget overflows (expand_compact returned None).
+
+    SANCTIONED_FETCH site (analysis/checkers/jax_discipline.py): the
+    device_get below is this path's designed host barrier, prefetched via
+    copy_to_host_async; host syncs anywhere else on the tick manifest are
+    lint violations and runtime-witness hits."""
     out = ffd_solve(
         inp, g_max=g_max, word_offsets=word_offsets, words=words, objective=objective,
     )

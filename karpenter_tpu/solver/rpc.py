@@ -810,6 +810,7 @@ class SolverServer:
                 # the overlapped dispatch->fetch path untouched.
                 jax.block_until_ready(out)
         with wt.stage("fetch"):
+            # SANCTIONED_FETCH (jax_discipline): the dense op's host barrier
             arrays = jax.device_get(tuple(out))
         names = ffd.SolveOutputs._fields
         _send_frame(
@@ -841,6 +842,7 @@ class SolverServer:
                 # in "device", not "fetch"
                 jax.block_until_ready(dec)
         with wt.stage("fetch"):
+            # SANCTIONED_FETCH (jax_discipline): the compact op's host barrier
             arrays = jax.device_get(tuple(dec))
         names = ffd.CompactDecision._fields
         if int(header.get("reply", 1)) >= 2:

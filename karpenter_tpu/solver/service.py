@@ -1598,6 +1598,10 @@ class TPUSolver:
                 dense = self._finish_remote(pending)
         else:
             with tracing.span("device"):
+                # SANCTIONED_FETCH (jax_discipline): THE host barrier of
+                # the in-process tick -- drains the copy_to_host_async
+                # issued at dispatch; any other sync on this path is a
+                # lint violation and a runtime-witness hit
                 host_buf = np.asarray(pending.buf)
             dense = ffd.expand_fused(
                 host_buf, class_set.c_pad, self.g_max,

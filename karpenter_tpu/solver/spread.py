@@ -198,6 +198,11 @@ def split_zone_spread(
     """The carry pass: returns classes with every spread class replaced by
     zone-pinned sub-classes (FFD order preserved).
 
+    On the jax-discipline hot-path manifest (DEVICE_HOT_PATH in
+    analysis/checkers/jax_discipline.py): this runs inside every spread
+    tick between encode and dispatch, so device-value host syncs here
+    are lint violations -- everything below is host numpy by design.
+
     Sub-classes are emitted in GROUP-SIZED CHUNKS ordered by the oracle's
     per-pod chronology, not zone-major: the oracle's min-count pinning
     serves zones level by level (lexicographic within a level), so the k-th

@@ -32,6 +32,19 @@ if _WITNESS_MODE != "0":
 
     _witness.install(strict=_WITNESS_MODE == "strict")
 
+# Runtime jax retrace/transfer witness (karpenter_tpu/analysis/jax_witness.py):
+# compile events and unsanctioned device->host conversions are recorded
+# session-wide; tests that drive the warm delta path declare warmup complete
+# with jax_witness.hot(...) and the session fixture below asserts ZERO
+# hot-section retraces and transfers at teardown (the
+# zero-retraces-on-the-warm-delta-path gate). KARPENTER_TPU_JAX_WITNESS=0
+# disables; =strict raises AT the offending compile/transfer.
+_JAXW_MODE = os.environ.get("KARPENTER_TPU_JAX_WITNESS", "1")
+if _JAXW_MODE != "0":
+    from karpenter_tpu.analysis import jax_witness as _jax_witness
+
+    _jax_witness.install(strict=_JAXW_MODE == "strict")
+
 # py3.10 compat: tomllib landed in the stdlib in 3.11; the container ships
 # tomli (the library tomllib was vendored from, same API). Alias it so the
 # bootstrap suites' `import tomllib` works on both.
@@ -77,6 +90,20 @@ def lock_order_witness():
         from karpenter_tpu.analysis import witness
 
         assert not witness.inversions(), witness.report()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def jax_retrace_witness():
+    """Zero-retrace / zero-hot-transfer gate: any XLA compile or
+    unsanctioned device->host conversion inside a declared-warm hot()
+    section ANYWHERE in the session fails it with the dispatch stack.
+    (The static jaxjit/jaxhost rules prove what the AST can see; this
+    covers the shapes, weak types, and unresolvable calls it cannot.)"""
+    yield
+    if _JAXW_MODE != "0":
+        from karpenter_tpu.analysis import jax_witness
+
+        assert not jax_witness.hot_violations(), jax_witness.report()
 
 
 @pytest.fixture()

@@ -1,0 +1,79 @@
+"""Seeded jax compilation-discipline violations.
+
+The jaxjit family scans rels under solver/ and parallel/, and the
+jaxhost family keys off the DEVICE_HOT_PATH manifest, so tests load this
+source under a forged rel of karpenter_tpu/solver/ffd.py (where
+solve_dense_tuple / make_inputs_staged are manifest functions and
+solve_dense_tuple is a SANCTIONED_FETCH site).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_scale_table = {}  # module-level MUTABLE (lowercase): closure hazard
+
+
+# jaxjit/unbounded-static: pod_count is not in the bucketing manifest --
+# one compiled program per distinct pending-pod count
+@functools.partial(jax.jit, static_argnames=("pod_count",))
+def bad_static(x, *, pod_count):
+    # jaxjit/closure-state: reads module-level mutable state
+    bias = _scale_table.get("bias", 0.0)
+    # jaxjit/traced-branch: Python branch on a traced value
+    if x.sum() > 0:
+        x = x + bias
+    # jaxjit/weak-dtype: arange without an explicit dtype
+    pad = jnp.arange(pod_count)
+    return x, pad
+
+
+# jaxjit/unbounded-static: static_argnums is positional
+@functools.partial(jax.jit, static_argnums=(1,))
+def bad_nums(x, k):
+    return x * k
+
+
+def _helper_branches(v):
+    # reached transitively from bad_transitive with a traced argument:
+    # the branch hazard must not hide in a module-local helper
+    while v.max() > 1.0:
+        v = v * 0.5
+    return v
+
+
+@jax.jit
+def bad_transitive(x):
+    return _helper_branches(x)
+
+
+class Solver:
+    def __init__(self):
+        self.scale = 2.0
+
+    @functools.partial(jax.jit, static_argnames=("g_max",))
+    def bad_method(self, x, *, g_max):
+        # jaxjit/closure-state: instance state inside a jitted body
+        return x * self.scale
+
+
+def solve_dense_tuple(inp):
+    # ffd_solve is a registered jit entry name: its result is a live
+    # device value until laundered through a sanctioned fetch
+    out = ffd_solve(inp)
+    # jaxhost/scalar-cast: int() directly on a live jit-entry result
+    n = int(out.n_open)
+    # jaxhost/item: synchronous scalar round-trip
+    first = out.take.item()
+    # jaxhost/block-until-ready: explicit barrier on the hot path
+    jax.block_until_ready(out)
+    return n, first
+
+
+def make_inputs_staged(staged, classes):
+    # jaxhost/np-on-device: make_inputs_staged is NOT a sanctioned fetch
+    # site -- this conversion forces a synchronous device->host copy
+    host = np.asarray(staged.cap)
+    fetched = jax.device_get(staged.price)
+    return host, fetched

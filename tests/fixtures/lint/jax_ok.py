@@ -1,0 +1,48 @@
+"""Sanctioned jax patterns the discipline checker must stay quiet on.
+
+Loaded under a forged rel of karpenter_tpu/solver/ffd.py (same scope as
+jax_bad.py): manifest statics, shape-derived Python branching, constants,
+dtype-explicit creation, and the sanctioned fetch barrier.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_INF = np.float32(np.inf)  # ALL_CAPS module constant: sanctioned closure
+_CT_SHIFT = 8
+
+
+# statics drawn from the bucketing manifest: bounded by construction
+@functools.partial(jax.jit, static_argnames=("g_max", "objective"))
+def good_solve(x, *, g_max, objective="price"):
+    Z = x.shape[-1]            # shape reads are trace-time Python ints
+    if Z > _CT_SHIFT:          # branching on shapes/statics is fine
+        raise ValueError("geometry")
+    if objective == "price":   # static arg: two programs total
+        x = x * 2.0
+    slot = jnp.arange(g_max, dtype=jnp.int32)   # explicit dtype
+    acc = jnp.zeros((g_max, Z), jnp.float32)    # positional dtype
+    flags = jnp.ones((g_max,), bool)            # builtin dtype
+    return jnp.where(x > 0, x, _INF), slot, acc, flags
+
+
+def _helper_clean(v, lo):
+    # traced args flow through lax/jnp ops only -- no Python branching
+    return jnp.maximum(v, lo)
+
+
+@jax.jit
+def good_transitive(x):
+    return _helper_clean(x, 0.0)
+
+
+def solve_dense_tuple(inp, g_max):
+    # THE sanctioned fetch barrier: async prefetch, one device_get, then
+    # host-side scalar reads on the fetched numpy
+    out = ffd_solve(inp, g_max=g_max)
+    for leaf in out:
+        leaf.copy_to_host_async()
+    out = SolveOutputs(*jax.device_get(tuple(out)))
+    return np.asarray(out.take), int(out.n_open)

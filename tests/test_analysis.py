@@ -20,8 +20,8 @@ import pathlib
 import pytest
 
 from karpenter_tpu.analysis import base
-from karpenter_tpu.analysis.checkers import (determinism, locks,
-                                             registry_drift, zerocopy)
+from karpenter_tpu.analysis.checkers import (determinism, jax_discipline,
+                                             locks, registry_drift, zerocopy)
 
 FIXTURES = pathlib.Path(__file__).resolve().parent / "fixtures" / "lint"
 
@@ -227,6 +227,205 @@ class TestZerocopyChecker:
                         if isinstance(i, (ast.FunctionDef, ast.AsyncFunctionDef))}
                 for m in methods:
                     assert m in have, f"{rel}: {cls} lost method {m}"
+
+
+# -- jax compilation discipline -----------------------------------------------
+
+
+class TestJaxDisciplineChecker:
+    def _bad(self):
+        return load_forged("jax_bad.py", "karpenter_tpu/solver/ffd.py")
+
+    def _ok(self):
+        return load_forged("jax_ok.py", "karpenter_tpu/solver/ffd.py")
+
+    def test_every_retrace_rule_fires_on_fixture(self):
+        fired = {v.rule for v in jax_discipline.check_retrace([self._bad()])}
+        assert fired == {
+            "jaxjit/unbounded-static",
+            "jaxjit/closure-state",
+            "jaxjit/traced-branch",
+            "jaxjit/weak-dtype",
+        }
+
+    def test_every_hostsync_rule_fires_on_fixture(self):
+        fired = {v.rule for v in jax_discipline.check_hostsync([self._bad()])}
+        assert fired == {
+            "jaxhost/item",
+            "jaxhost/scalar-cast",
+            "jaxhost/np-on-device",
+            "jaxhost/block-until-ready",
+        }
+
+    def test_counts_are_exact(self):
+        out = jax_discipline.check_retrace([self._bad()]) \
+            + jax_discipline.check_hostsync([self._bad()])
+        by_rule = {}
+        for v in out:
+            by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+        assert by_rule == {
+            "jaxjit/unbounded-static": 2,   # pod_count + static_argnums
+            "jaxjit/closure-state": 2,      # module mutable + self.scale
+            "jaxjit/traced-branch": 2,      # direct if + transitive while
+            "jaxjit/weak-dtype": 1,         # jnp.arange without dtype
+            "jaxhost/item": 1,
+            "jaxhost/scalar-cast": 1,
+            "jaxhost/np-on-device": 2,      # np.asarray + jax.device_get
+            "jaxhost/block-until-ready": 1,
+        }
+
+    def test_transitive_helper_branch_is_caught(self):
+        """The traced-branch hazard must not hide in a module-local
+        helper reached from the jitted body."""
+        out = [v for v in jax_discipline.check_retrace([self._bad()])
+               if v.rule == "jaxjit/traced-branch"]
+        assert any("while v.max()" in v.line_text for v in out), (
+            [v.line_text for v in out])
+
+    def test_quiet_on_sanctioned_patterns(self):
+        """Shape-derived branching, manifest statics, ALL_CAPS constants,
+        dtype-explicit creation, and the sanctioned fetch barrier."""
+        assert jax_discipline.check_retrace([self._ok()]) == []
+        assert jax_discipline.check_hostsync([self._ok()]) == []
+
+    def test_scalar_cast_taint_tracks_source_order_not_walk_order(self):
+        """ast.walk is breadth-first: a nested conditional jit-assign
+        followed by a top-level fetch must end UNtainted (review finding:
+        BFS processed the clearing assign first, leaving clean code
+        flagged)."""
+        src = (
+            "import numpy as np\n"
+            "def solve_dense_tuple(inp, cond):\n"
+            "    out = None\n"
+            "    if cond:\n"
+            "        out = ffd_solve(inp)\n"
+            "    out = np.asarray(out)\n"
+            "    return float(out)\n")
+        mod = base.Module(path=pathlib.Path("t.py"),
+                          rel="karpenter_tpu/solver/ffd.py", source=src,
+                          tree=ast.parse(src), lines=src.splitlines())
+        assert [v for v in jax_discipline.check_hostsync([mod])
+                if v.rule == "jaxhost/scalar-cast"] == []
+
+    def test_helper_rescanned_per_taint_mapping(self):
+        """A helper first called with only statics must STILL be scanned
+        when a later call passes a traced value (review finding: the
+        visited set keyed on the function alone made detection
+        call-order-dependent)."""
+        src = (
+            "import jax\n"
+            "def _helper(v):\n"
+            "    if v > 0:\n"
+            "        return v\n"
+            "    return v\n"
+            "@jax.jit\n"
+            "def entry(x):\n"
+            "    a = _helper(0)\n"   # untainted call first
+            "    return _helper(x)\n")  # traced call second
+        mod = base.Module(path=pathlib.Path("t.py"),
+                          rel="karpenter_tpu/solver/x.py", source=src,
+                          tree=ast.parse(src), lines=src.splitlines())
+        fired = [v for v in jax_discipline.check_retrace([mod])
+                 if v.rule == "jaxjit/traced-branch"]
+        assert fired, "traced call site after an untainted one was skipped"
+
+    def test_scalar_cast_taint_clears_on_fetch(self):
+        """int() AFTER the device_get/np.asarray barrier is host-side and
+        quiet (the jax_ok solve_dense_tuple shape)."""
+        out = [v for v in jax_discipline.check_hostsync([self._ok()])
+               if v.rule == "jaxhost/scalar-cast"]
+        assert out == []
+
+    def test_real_tree_static_args_all_in_bucketing_manifest(self):
+        """THE retrace certification: every static_argnames entry in the
+        production tree is a declared bounded-cardinality bucket."""
+        mods = base.iter_modules()
+        sites = jax_discipline.jit_decoration_sites(mods)
+        assert sites, "no jit decoration sites discovered -- scope broke"
+        fired = [v for v in jax_discipline.check_retrace(mods)
+                 if v.rule == "jaxjit/unbounded-static"]
+        assert fired == [], "\n".join(v.render() for v in fired)
+
+    def test_discovered_jit_sites_match_entry_registry(self):
+        """The witness's per-entry attribution registry must track the
+        checker's discovered decoration sites: a new jit entry point has
+        to be ADDED to JIT_ENTRY_FUNCTIONS to get witness coverage."""
+        mods = base.iter_modules()
+        sites = jax_discipline.jit_decoration_sites(mods)
+        discovered = {
+            (rel[: -len(".py")].replace("/", "."), name)
+            for rel, entries in sites.items() for name, _, _ in entries
+        }
+        registered = {
+            (mod, fn)
+            for mod, fns in jax_discipline.JIT_ENTRY_FUNCTIONS.items()
+            for fn in fns
+        }
+        assert discovered == registered, (
+            f"decoration sites {discovered} != registry {registered}")
+
+    def test_hot_path_manifest_names_exist_in_real_tree(self):
+        """Same contract as the zerocopy manifest: a rename must not
+        silently unguard a hot-path function."""
+        by_rel = {m.rel: m for m in base.iter_modules()}
+        for rel, (funcs, class_methods) in jax_discipline.DEVICE_HOT_PATH.items():
+            mod = by_rel[rel]
+            top = {n.name for n in mod.tree.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+            for fn in funcs:
+                assert fn in top, f"{rel}: manifest names missing function {fn}"
+            classes = {n.name: n for n in mod.tree.body
+                       if isinstance(n, ast.ClassDef)}
+            for cls, methods in class_methods.items():
+                assert cls in classes, f"{rel}: manifest names missing class {cls}"
+                have = {i.name for i in classes[cls].body
+                        if isinstance(i, (ast.FunctionDef, ast.AsyncFunctionDef))}
+                for m in methods:
+                    assert m in have, f"{rel}: {cls} lost method {m}"
+
+    def test_sanctioned_fetch_sites_exist_and_are_in_manifest(self):
+        """Every sanctioned fetch names a real function that is ALSO in
+        the hot-path manifest (sanctioning an unscanned function would
+        be a dead entry)."""
+        for rel, fn in jax_discipline.SANCTIONED_FETCH:
+            scope = jax_discipline.DEVICE_HOT_PATH.get(rel)
+            assert scope is not None, f"sanctioned {rel} not in DEVICE_HOT_PATH"
+            funcs, class_methods = scope
+            in_scope = fn in funcs or any(
+                fn in methods for methods in class_methods.values())
+            assert in_scope, f"{rel}:{fn} sanctioned but not manifest-scanned"
+
+    def test_static_bucket_manifest_entries_justified(self):
+        for name, why in jax_discipline.STATIC_ARG_BUCKETS.items():
+            assert len(why) > 20, f"{name}: bucketing manifest needs a real bound"
+
+    def test_fixture_violations_fail_the_cli(self, tmp_path, monkeypatch, capsys):
+        """The acceptance shape: a tree containing a retrace-hazard file
+        and a host-sync file exits nonzero through the REAL CLI (scope
+        roots monkeypatched to a forged package tree)."""
+        import shutil
+
+        pkg = tmp_path / "karpenter_tpu" / "solver"
+        pkg.mkdir(parents=True)
+        shutil.copy(FIXTURES / "jax_bad.py", pkg / "ffd.py")
+        monkeypatch.setattr(base, "REPO_ROOT", tmp_path)
+        monkeypatch.setattr(base, "PACKAGE_ROOT", tmp_path / "karpenter_tpu")
+        from karpenter_tpu.analysis.__main__ import main
+
+        bl = tmp_path / "baseline.json"
+        bl.write_text('{"entries": []}')
+        assert main(["--rules", "jaxjit", "--baseline", str(bl)]) == 1
+        assert "jaxjit/" in capsys.readouterr().out
+        assert main(["--rules", "jaxhost", "--baseline", str(bl)]) == 1
+        assert "jaxhost/" in capsys.readouterr().out
+
+    def test_real_tree_weak_dtype_quiet(self):
+        """Pins the round-10 fix the rule surfaced (_sparse_take's
+        dtype-less arange): jitted bodies in the production tree create
+        arrays with explicit dtypes only."""
+        fired = [v for v in jax_discipline.check_retrace(base.iter_modules())
+                 if v.rule == "jaxjit/weak-dtype"]
+        assert fired == [], "\n".join(v.render() for v in fired)
 
 
 # -- registry drift -----------------------------------------------------------
