@@ -52,14 +52,17 @@ bench-warm:  ## warm steady-state delta stage only (incremental tick engine: war
 bench-wire:  ## transport stage only (wire v2: warm_wire_p50/p99_ms shm vs tcp, wire_share_of_tick, reply_bytes_per_solve, copies-per-solve, wire_warm_retrace_count); one JSON line
 	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --wire-only > bench_wire_last.json; rc=$$?; cat bench_wire_last.json; exit $$rc
 
+# the chaos-family soaks route the observatory's crash-flushed black box
+# (karpenter_tpu/obs/flight.py) into their artifact dirs, so a failing
+# job uploads the last 256 ticks of flight data next to its shrunk repro
 chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count, incl. the shm-transport faults, under the lock-order witness (zero inversions asserted at session end; full-length schedule stays behind -m slow)
-	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_CHAOS_SEEDS=20 $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py tests/test_wire.py -q -m 'not slow' $(call STAMP,chaos)
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_CHAOS_SEEDS=20 KARPENTER_TPU_FLIGHTDATA=chaos-artifacts/flightdata.jsonl $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py tests/test_wire.py -q -m 'not slow' $(call STAMP,chaos)
 
-crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection -- under the lock-order witness (zero inversions asserted at session end); diverging traces ddmin-shrink into crash-artifacts/ (full-length chain soak stays behind -m slow)
-	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
+crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection -- under the lock-order witness (zero inversions asserted at session end); diverging traces ddmin-shrink into crash-artifacts/
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts KARPENTER_TPU_FLIGHTDATA=crash-artifacts/flightdata.jsonl $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
 
 overload:  ## overload storm soak: 10x offered load against the deadline-budgeted tick (p99 <= 2x deadline, zero pods lost, admitted-prefix bit-identity, brownout ladder + stuck-tick watchdog escalation, bounded interruption intake, shm send timeout) under the lock-order AND jax retrace witnesses; a diverging storm replay ddmin-shrinks into overload-artifacts/
-	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_OVERLOAD_ARTIFACTS=overload-artifacts $(PYTEST) tests/test_overload.py -q -m 'not slow' $(call STAMP,overload)
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_OVERLOAD_ARTIFACTS=overload-artifacts KARPENTER_TPU_FLIGHTDATA=overload-artifacts/flightdata.jsonl $(PYTEST) tests/test_overload.py -q -m 'not slow' $(call STAMP,overload)
 
 sim-corpus:  ## differential-replay the committed scenario corpus (host vs wire vs pipelined, golden digests); shrinks any failing trace into sim-artifacts/
 	$(PY) -m karpenter_tpu sim corpus --dir tests/golden/scenarios --artifacts sim-artifacts $(call STAMP,sim-corpus)

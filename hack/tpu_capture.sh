@@ -10,10 +10,35 @@
 # warm_compile_breakdown) riding the same pass -- one tunnel window, both
 # artifacts. The wire stage is best-effort: its failure never invalidates
 # the main capture (the grep gates below already passed).
+#
+# Since the device observatory (karpenter_tpu/obs/), the same tunnel
+# window also lands the device-MEMORY truth: memory_stats() snapshots
+# before and after the bench-wire pass (BENCH_TPU_MEMSTATS.json -- the
+# warm/wire stages additionally persist device_hbm_peak_bytes and
+# staged_bytes_by_kind inside their own JSON lines), plus one 10-tick
+# programmatic jax.profiler trace of the controller rig
+# (BENCH_TPU_PROFILE/, ready for tensorboard --logdir). Both best-effort.
 cd /root/repo
 OUT=BENCH_TPU_CAPTURE.json
 WIRE_OUT=BENCH_WIRE_CAPTURE.json
+MEM_OUT=BENCH_TPU_MEMSTATS.json
+PROFILE_DIR=BENCH_TPU_PROFILE
 LOG=BENCH_TPU_CAPTURE.log
+
+memstats_snapshot() {
+  # one memory_stats() ledger line per device, tagged by capture phase
+  timeout 150 python -c "
+import json, sys
+import jax
+phase = sys.argv[1]
+out = {'phase': phase, 'devices': {}}
+for d in jax.devices():
+    st = d.memory_stats()
+    if st:
+        out['devices'][f'{d.platform}:{d.id}'] = {k: int(v) for k, v in st.items()}
+print(json.dumps(out))
+" "$1" >> "$MEM_OUT" 2>> "$LOG" || true
+}
 for i in $(seq 1 200); do
   echo "[capture] probe attempt $i $(date -u +%H:%M:%S)" >> "$LOG"
   if timeout 150 python -c "
@@ -38,7 +63,12 @@ print('BACKEND=' + jax.default_backend())
         # bench-wire stage on the still-warm tunnel: transport + retrace
         # counters for the wire-v2 ROADMAP claim. Short budgets -- the
         # wire stage is a fraction of the full bench -- and non-fatal.
+        # memory_stats() snapshots bracket it so the pass lands the
+        # device-memory truth (staged bytes live inside the bench JSON)
+        # in the same run.
         echo "[capture] wire stage $(date -u +%H:%M:%S)" >> "$LOG"
+        rm -f "$MEM_OUT"
+        memstats_snapshot "pre-wire"
         if timeout 1200 env BENCH_PROBE_BUDGET_S=120 BENCH_CPU_BUDGET_S=60 KARPENTER_TPU_JAX_WITNESS=1 python bench.py --wire-only > "$WIRE_OUT.tmp" 2>> "$LOG" \
            && grep -q '"platform"' "$WIRE_OUT.tmp" && ! grep -q '"platform": "cpu"' "$WIRE_OUT.tmp"; then
           mv "$WIRE_OUT.tmp" "$WIRE_OUT"
@@ -47,6 +77,18 @@ print('BACKEND=' + jax.default_backend())
           echo "[capture] wire stage failed/degraded; main capture stands" >> "$LOG"
           cat "$WIRE_OUT.tmp" >> "$LOG" 2>/dev/null
           rm -f "$WIRE_OUT.tmp"
+        fi
+        memstats_snapshot "post-wire"
+        # one 10-tick programmatic profiler trace of the controller rig
+        # (the observatory's --profile-ticks seam): the on-device
+        # timeline for TensorBoard/xprof. Best-effort, bounded.
+        echo "[capture] profiler trace $(date -u +%H:%M:%S)" >> "$LOG"
+        rm -rf "$PROFILE_DIR"
+        if timeout 600 env KARPENTER_TPU_PROFILE_DIR="$PROFILE_DIR" python -m karpenter_tpu --max-ticks 12 --tick-interval 0.2 --profile-ticks 10 >> "$LOG" 2>&1 \
+           && [ -d "$PROFILE_DIR" ]; then
+          echo "[capture] profiler trace SUCCESS" >> "$LOG"
+        else
+          echo "[capture] profiler trace failed; captures stand" >> "$LOG"
         fi
         exit 0
       fi

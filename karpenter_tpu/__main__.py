@@ -34,6 +34,7 @@ def build_operator(args):
         tracing=getattr(args, "tracing", True),
         tracing_sample=getattr(args, "trace_sample", 0.2),
         tracing_slow_ms=getattr(args, "trace_slow_ms", 1000.0),
+        observatory=getattr(args, "observatory", True),
         seed=getattr(args, "seed", None),
     )
     # feature gates merge over the defaults (reference: the core's
@@ -290,6 +291,19 @@ def main(argv=None) -> int:
         help="print the slow-tick flight recorder (JSON span trees) on exit",
     )
     parser.add_argument(
+        "--observatory", action=argparse.BooleanOptionalAction, default=True,
+        help="device performance observatory (karpenter_tpu/obs/): per-tick "
+        "HBM accounting, the always-on flight-data ring behind "
+        "/debug/flightdata (crash-flushed to $KARPENTER_TPU_FLIGHTDATA), "
+        "profiler tick bracketing, and the per-jit-entry cost table",
+    )
+    parser.add_argument(
+        "--profile-ticks", type=int, default=0, metavar="N",
+        help="arm an on-demand jax.profiler capture bracketing the first N "
+        "production ticks (trace dir under $KARPENTER_TPU_PROFILE_DIR, "
+        "default profiles/; same machinery as GET /debug/profile?ticks=N)",
+    )
+    parser.add_argument(
         "--seed", type=int, default=None,
         help="determinism root: every RNG on the replay path (object-name "
         "suffixes, failpoint schedules, trace sampling, breaker jitter) "
@@ -345,6 +359,14 @@ def main(argv=None) -> int:
         health.journal_info = op.journal.describe
         # /debug/overload: deadline/admission bounds + brownout/watchdog
         health.overload_info = op.describe_overload
+        # /debug/profile only arms captures a tick will actually service
+        health.profile_enabled = args.observatory
+    if args.profile_ticks > 0 and args.observatory:
+        # same machinery the /debug/profile endpoint arms -- here it
+        # brackets the FIRST ticks, so warmup compiles land in the trace
+        from karpenter_tpu.obs.profiler import PROFILER
+
+        PROFILER.request(args.profile_ticks)
     if op.watchdog is not None:
         # the stuck-tick watchdog's background thread is a wall-clock
         # deployment concern -- deterministic rigs drive check_now().
@@ -389,20 +411,34 @@ def main(argv=None) -> int:
 
     ticks = 0
     op.watch_pods()   # pod arrivals wake the loop through the batch window
-    while not stop["flag"]:
-        swept = op.tick()
-        if recorder is not None and swept:
-            recorder.record_tick()
-        if health is not None:
-            # the LOOP beat proves the process turns (leader or standby:
-            # liveness); the SWEEP beat only on a real sweep (readiness)
-            health.beat_loop()
-            if swept:
-                health.beat_sweep()
-        ticks += 1
-        if args.max_ticks and ticks >= args.max_ticks:
-            break
-        op.wait_for_work(args.tick_interval)
+    try:
+        while not stop["flag"]:
+            swept = op.tick()
+            if recorder is not None and swept:
+                recorder.record_tick()
+            if health is not None:
+                # the LOOP beat proves the process turns (leader or standby:
+                # liveness); the SWEEP beat only on a real sweep (readiness)
+                health.beat_loop()
+                if swept:
+                    health.beat_sweep()
+            ticks += 1
+            if args.max_ticks and ticks >= args.max_ticks:
+                break
+            op.wait_for_work(args.tick_interval)
+    except BaseException:
+        # OperatorCrashed (and any other death) still propagates -- the
+        # process must die loudly for the supervisor -- but the black
+        # box's location goes to stderr first so the postmortem knows
+        # where to start (Operator.tick already flushed it)
+        from karpenter_tpu.obs.flight import RECORDER as _flight
+
+        if _flight.flushes:
+            print(
+                f"flight data: {_flight.dump()['last_flush_path']}",
+                file=sys.stderr,
+            )
+        raise
     if op.watchdog is not None:
         op.watchdog.stop()
     if health is not None:

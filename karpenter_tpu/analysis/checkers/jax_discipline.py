@@ -156,6 +156,23 @@ DEVICE_HOT_PATH: Dict[str, Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]] =
         ("sharded_solve", "sharded_repack", "_fetch_multiprocess"),
         {},
     ),
+    # device performance observatory (karpenter_tpu/obs/): these run on
+    # EVERY tick, so they are hot-path by construction and the jaxhost
+    # rules must machine-check they stay sync-free -- their designed
+    # runtime-introspection seams (device.memory_stats, the programmatic
+    # jax.profiler bracket) are the SANCTIONED entries below
+    "karpenter_tpu/obs/hbm.py": (
+        ("poll", "sum_nbytes"),
+        {},
+    ),
+    "karpenter_tpu/obs/flight.py": (
+        ("stage_summary",),
+        {"FlightDataRecorder": ("record",)},
+    ),
+    "karpenter_tpu/obs/profiler.py": (
+        (),
+        {"ProfilerCapture": ("on_tick_start", "on_tick_end")},
+    ),
 }
 
 # (rel-path, function-name) pairs where a device->host conversion is THE
@@ -173,6 +190,14 @@ SANCTIONED_FETCH: Set[Tuple[str, str]] = {
     ("karpenter_tpu/solver/rpc.py", "_op_solve_compact"),
     ("karpenter_tpu/solver/consolidate.py", "evaluate"),
     ("karpenter_tpu/parallel/mesh.py", "_fetch_multiprocess"),
+    # observatory introspection seams: memory_stats() reads the
+    # allocator ledger (metadata, no transfer) and the profiler bracket
+    # drives the runtime's own trace collection -- both are designed
+    # device-runtime touchpoints, blessed for the static rules AND the
+    # runtime witness exactly like the fetch barriers above
+    ("karpenter_tpu/obs/hbm.py", "poll"),
+    ("karpenter_tpu/obs/profiler.py", "on_tick_start"),
+    ("karpenter_tpu/obs/profiler.py", "on_tick_end"),
 }
 
 RULE_UNBOUNDED = "jaxjit/unbounded-static"

@@ -150,12 +150,27 @@ class _State:
     cold_unsanctioned: int = 0     # diagnostics only: outside hot sections
     compiles_total: int = 0
     compile_secs_total: float = 0.0
+    # trace-phase totals, separate from the backend-compile totals above:
+    # the jit cost table (obs/jitstats.py) attributes a per-entry compile
+    # by the delta of THESE across one dispatch -- the trace phase fires
+    # on every jit python-cache miss even when the persistent compilation
+    # cache serves the binary. Plain int/float stores so the per-dispatch
+    # probe can read them lock-free.
+    traces_total: int = 0
+    trace_secs_total: float = 0.0
     compile_breakdown: Dict[str, List[float]] = field(default_factory=dict)
     originals: Dict[str, Any] = field(default_factory=dict)
     array_type: Any = None
 
 
 _state = _State()
+
+# per-thread trace totals: jax.monitoring duration listeners run
+# SYNCHRONOUSLY in the compiling thread, so this thread-local ledger
+# gives exact per-dispatch compile attribution (obs/jitstats.py reads a
+# delta across one probe call) even while another thread -- the
+# auto_warm precompiler, a sidecar handler -- compiles concurrently
+_tls = threading.local()
 
 _COMPILE_PREFIX = "/jax/core/compile/"
 _BACKEND_PHASE = "backend_compile_duration"
@@ -197,6 +212,10 @@ def _on_compile_duration(name: str, secs: float, **kw: Any) -> None:
     if not name.startswith(_COMPILE_PREFIX) or not _state.installed:
         return
     phase = name[len(_COMPILE_PREFIX):]
+    if phase == _TRACE_PHASE:
+        # outside the guard: thread-local, no contention by definition
+        _tls.traces = getattr(_tls, "traces", 0) + 1
+        _tls.trace_secs = getattr(_tls, "trace_secs", 0.0) + secs
     hit: Optional[Retrace] = None
     with _state.guard:
         cell = _state.compile_breakdown.setdefault(phase, [0, 0.0])
@@ -205,6 +224,9 @@ def _on_compile_duration(name: str, secs: float, **kw: Any) -> None:
         if phase == _BACKEND_PHASE:
             _state.compiles_total += 1
             _state.compile_secs_total += secs
+        if phase == _TRACE_PHASE:
+            _state.traces_total += 1
+            _state.trace_secs_total += secs
         if phase == _TRACE_PHASE and _state.hot_depth > 0:
             site, _ = _pkg_site_and_sanctioned()
             hit = Retrace(
@@ -344,8 +366,18 @@ def reset() -> None:
         _state.compile_breakdown.clear()
         _state.compiles_total = 0
         _state.compile_secs_total = 0.0
+        _state.traces_total = 0
+        _state.trace_secs_total = 0.0
         _state.sanctioned_fetches = 0
         _state.cold_unsanctioned = 0
+
+
+def thread_trace_totals() -> Tuple[int, float]:
+    """(jit traces, trace seconds) observed on THE CALLING THREAD since
+    it first compiled -- the per-dispatch attribution seam for the jit
+    cost table (obs/jitstats.py): a delta across one entry call on one
+    thread belongs to that entry, concurrency-proof."""
+    return (getattr(_tls, "traces", 0), getattr(_tls, "trace_secs", 0.0))
 
 
 def hot_retraces() -> List[Retrace]:
@@ -370,6 +402,8 @@ def stats() -> Dict[str, Any]:
         return {
             "compiles_total": _state.compiles_total,
             "compile_secs_total": round(_state.compile_secs_total, 4),
+            "traces_total": _state.traces_total,
+            "trace_secs_total": round(_state.trace_secs_total, 4),
             "compile_breakdown": {
                 phase: {"count": int(c), "secs": round(s, 4)}
                 for phase, (c, s) in sorted(_state.compile_breakdown.items())

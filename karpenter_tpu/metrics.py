@@ -338,6 +338,25 @@ SOLVER_STAGED_EVICTIONS = REGISTRY.counter(
     "epochs); an eviction costs the next referencing solve a full restage",
     labels=("kind",),  # catalog | class_epoch
 )
+# device performance observatory: HBM owner attribution + pressure
+# eviction (karpenter_tpu/obs/hbm.py; the karpenter_device_hbm_* gauges
+# register there)
+SOLVER_STAGED_BYTES = REGISTRY.gauge(
+    "karpenter_solver_staged_bytes",
+    "Staged tensor bytes by owner: catalog = encoded+device-staged "
+    "catalog LRU entries; class_epoch = the sidecar's class-tensor epoch "
+    "store; solve_temporaries = the last solve's input tensors. The HBM "
+    "attribution half of karpenter_device_hbm_bytes_in_use",
+    labels=("kind",),  # catalog | class_epoch | solve_temporaries
+)
+SOLVER_STAGED_PRESSURE_EVICTIONS = REGISTRY.counter(
+    "karpenter_solver_staged_pressure_evictions_total",
+    "Staging-LRU entries evicted because device HBM headroom dropped "
+    "below the evict threshold ($KARPENTER_TPU_HBM_EVICT_HEADROOM, "
+    "default 0.10) -- memory pressure shrinking the LRUs to their floor "
+    "ahead of their fixed capacity",
+    labels=("kind",),  # catalog | class_epoch
+)
 # wire transport v2 (solver/rpc.py zero-copy framing, solver/shm.py ring)
 WIRE_BYTES = REGISTRY.counter(
     "karpenter_wire_bytes_total",

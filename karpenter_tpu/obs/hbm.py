@@ -1,0 +1,220 @@
+"""HBM accounting: device memory truth, per tick, with owner attribution.
+
+``device.memory_stats()`` is the runtime's own allocator ledger (bytes
+in use, bytes reservable, peak) -- a cheap C call, safe on the warm
+tick. This module polls it into gauges, tracks the process-lifetime
+peak, and derives one **headroom** signal the staging layers consume:
+when the fraction of HBM still free drops below the evict threshold,
+the staged-catalog and class-epoch LRUs (solver/service.py,
+solver/rpc.py) shrink to a floor of one entry instead of waiting for
+their fixed capacity of 4 -- memory pressure evicts, not just slot
+count.
+
+Attribution rides next to the raw gauges: the solver service and the
+sidecar already know their staged dicts, so summing ``nbytes`` per
+entry splits staged bytes by owner into
+``karpenter_solver_staged_bytes{kind=catalog|class_epoch|
+solve_temporaries}`` (see ``TPUSolver.staged_bytes_by_kind`` and
+``SolverServer._staged_bytes``). ``sum_nbytes`` here is the shared
+walker: ``.nbytes`` is array METADATA on both numpy and jax arrays --
+reading it never transfers, which is why this whole layer stays
+witness-clean.
+
+The CPU backend returns ``memory_stats() -> None`` (no allocator
+ledger); polls then record nothing and ``headroom()`` is None, so every
+pressure consumer degrades to capacity-only eviction -- the exact
+pre-observatory behavior. Tests inject a provider
+(``set_stats_provider``) to exercise the pressure paths off-device.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from karpenter_tpu import metrics
+
+HBM_IN_USE = metrics.REGISTRY.gauge(
+    "karpenter_device_hbm_bytes_in_use",
+    "Device HBM bytes currently allocated, per device, from the runtime's "
+    "own allocator ledger (device.memory_stats(); absent on backends "
+    "without one, e.g. CPU)",
+    labels=("device",),
+)
+HBM_LIMIT = metrics.REGISTRY.gauge(
+    "karpenter_device_hbm_bytes_limit",
+    "Device HBM capacity visible to the allocator, per device "
+    "(bytes_limit from device.memory_stats())",
+    labels=("device",),
+)
+HBM_PEAK = metrics.REGISTRY.gauge(
+    "karpenter_device_hbm_peak_bytes",
+    "High-water mark of device HBM bytes in use since process start, per "
+    "device (max over every observatory poll; the runtime's own "
+    "peak_bytes_in_use when it reports one)",
+    labels=("device",),
+)
+HBM_HEADROOM = metrics.REGISTRY.gauge(
+    "karpenter_device_hbm_headroom_fraction",
+    "Fraction of HBM still free on the FULLEST device (min over devices "
+    "of 1 - in_use/limit); below the evict threshold the staged-catalog "
+    "and class-epoch LRUs shrink on pressure instead of capacity -- see "
+    "karpenter_solver_staged_pressure_evictions_total",
+)
+
+# headroom fraction below which the staging LRUs evict down to their
+# floor (docs/observability.md HBM runbook); 0 disables pressure
+# eviction entirely
+EVICT_HEADROOM_ENV = "KARPENTER_TPU_HBM_EVICT_HEADROOM"
+EVICT_HEADROOM_DEFAULT = 0.10
+
+# polls within this window reuse the last snapshot: the per-tick caller
+# (the flight recorder) and the per-stage caller (the sidecar's LRU
+# insert) must not turn a fast tick loop into a memory_stats() storm
+POLL_MAX_AGE_S = 0.2
+
+_lock = threading.Lock()
+_peak: Dict[str, int] = {}
+_last_snapshot: Dict[str, Any] = {"devices": {}, "headroom_fraction": None}
+_last_poll: float = -1e9
+# test seam: () -> {device_label: {"bytes_in_use": int, "bytes_limit":
+# int, ...}} | None; None = read the real jax devices
+_stats_provider: Optional[Callable[[], Optional[Dict[str, dict]]]] = None
+
+
+def set_stats_provider(fn: Optional[Callable[[], Optional[Dict[str, dict]]]]) -> None:
+    """Inject a memory-stats source (tests / fakes); None restores the
+    real ``jax.devices()`` walk. Resets the peak ledger: a provider swap
+    is a new device world."""
+    global _stats_provider, _last_poll
+    with _lock:
+        _stats_provider = fn
+        _peak.clear()
+        _last_poll = -1e9
+
+
+def _real_stats() -> Optional[Dict[str, dict]]:
+    import sys
+
+    if "jax" not in sys.modules:
+        # accounting must never be the reason the jax runtime comes up:
+        # a solver-less operator (oracle mode, light tests) polls nothing
+        return None
+    try:
+        import jax
+
+        out: Dict[str, dict] = {}
+        for d in jax.devices():
+            st = d.memory_stats()
+            if st:
+                out[f"{d.platform}:{d.id}"] = dict(st)
+        return out or None
+    except Exception:  # noqa: BLE001 -- accounting must never fail a tick
+        return None
+
+
+def poll(max_age_s: float = POLL_MAX_AGE_S) -> Dict[str, Any]:
+    """One accounting pass: read memory stats, update the gauges and the
+    per-device peak ledger, return the snapshot. Recent polls (within
+    ``max_age_s``) return the cached snapshot untouched."""
+    global _last_poll
+    now = time.monotonic()
+    with _lock:
+        if now - _last_poll < max_age_s:
+            return dict(_last_snapshot)
+        provider = _stats_provider
+    stats = provider() if provider is not None else _real_stats()
+    devices: Dict[str, dict] = {}
+    headroom: Optional[float] = None
+    if stats:
+        for label, st in sorted(stats.items()):
+            in_use = int(st.get("bytes_in_use", 0))
+            limit = int(st.get("bytes_limit", 0))
+            peak = max(int(st.get("peak_bytes_in_use", 0)), in_use)
+            with _lock:
+                peak = max(peak, _peak.get(label, 0))
+                _peak[label] = peak
+            HBM_IN_USE.set(float(in_use), device=label)
+            HBM_PEAK.set(float(peak), device=label)
+            if limit > 0:
+                HBM_LIMIT.set(float(limit), device=label)
+                free = 1.0 - in_use / limit
+                headroom = free if headroom is None else min(headroom, free)
+            devices[label] = {
+                "bytes_in_use": in_use, "bytes_limit": limit,
+                "peak_bytes": peak,
+            }
+        if headroom is not None:
+            HBM_HEADROOM.set(headroom)
+    snapshot = {"devices": devices, "headroom_fraction": headroom}
+    with _lock:
+        _last_snapshot.clear()
+        _last_snapshot.update(snapshot)
+        _last_poll = now
+    return snapshot
+
+
+def headroom() -> Optional[float]:
+    """Min-over-devices free-HBM fraction from a fresh-enough poll;
+    None when no device reports an allocator ledger (CPU backend)."""
+    return poll().get("headroom_fraction")
+
+
+def evict_threshold() -> float:
+    try:
+        return float(os.environ.get(EVICT_HEADROOM_ENV, EVICT_HEADROOM_DEFAULT))
+    except ValueError:
+        return EVICT_HEADROOM_DEFAULT
+
+
+def under_pressure() -> bool:
+    """True when the fullest device's free fraction is below the evict
+    threshold -- the staging LRUs' signal to shrink to their floor. No
+    ledger (CPU) = never under pressure (capacity eviction still holds)."""
+    thresh = evict_threshold()
+    if thresh <= 0:
+        return False
+    free = headroom()
+    return free is not None and free < thresh
+
+
+def peak_bytes_max() -> int:
+    """Largest per-device peak seen since process start (bench persists
+    this as device_hbm_peak_bytes)."""
+    with _lock:
+        return max(_peak.values(), default=0)
+
+
+def reset_peaks() -> None:
+    with _lock:
+        _peak.clear()
+
+
+def sum_nbytes(obj: Any) -> int:
+    """Total ``nbytes`` under obj: arrays count themselves; tuples/lists/
+    dicts/NamedTuples/objects with ``_fields`` or ``__dict__`` walk one
+    level of their values. Metadata reads only -- never a transfer."""
+    n = getattr(obj, "nbytes", None)
+    if isinstance(n, int):
+        return n
+    if obj is None:
+        return 0
+    if isinstance(obj, dict):
+        values = obj.values()
+    elif isinstance(obj, (tuple, list)):
+        values = obj
+    elif hasattr(obj, "_fields"):  # NamedTuple
+        values = (getattr(obj, f) for f in obj._fields)
+    elif hasattr(obj, "__dict__"):
+        values = vars(obj).values()
+    else:
+        return 0
+    total = 0
+    for v in values:
+        n = getattr(v, "nbytes", None)
+        if isinstance(n, int):
+            total += n
+        elif isinstance(v, (tuple, list, dict)):
+            total += sum_nbytes(v)
+    return total

@@ -1,0 +1,203 @@
+"""Per-entry jit cost attribution: which program costs what, per tick.
+
+The jax witness (analysis/jax_witness.py) already owns the compile
+listener and the ``JIT_ENTRY_FUNCTIONS`` decoration-site registry, but
+it answers one binary question -- "did the warm path retrace?". This
+module extends it into a continuous accounting TABLE: per jit entry,
+how many dispatches, how much cumulative dispatch wall time, how many
+compiles, how much compile time -- the attribution CvxCluster-style
+batching work needs ("which entry pays when the candidate batch grows")
+and the `/debug/solver` surface serves.
+
+Mechanism: ``install()`` wraps every registered entry function (the
+module attribute -- every call site in the tree calls through the
+module, verified at review) in a probe that
+
+- counts the call and its wall time into the entry's row. On an async
+  backend this is DISPATCH cost (trace + lowering on a cache miss,
+  argument staging + launch on a hit); device EXECUTION overlaps
+  asynchronously and lands behind the sanctioned fetch barriers, so the
+  per-entry on-device timeline is the profiler capture's job
+  (obs/profiler.py), not this table's -- the column is named
+  ``dispatch_ms`` for exactly that reason;
+- attributes compiles: the witness's compile listener runs
+  synchronously in the compiling thread, so a delta of THIS thread's
+  trace totals (``jax_witness.thread_trace_totals``) across one probe
+  call belongs to that entry -- a concurrent compile on another thread
+  (auto_warm precompile, a sidecar handler) lands in its own thread's
+  ledger and is never misattributed. Attribution only populates while
+  the witness is installed (tests, bench, and any deployment that opts
+  in) and reads zero otherwise.
+
+The probe forwards ``_cache_size`` (jax's own per-function cache
+introspection) so ``jax_witness.entry_cache_sizes()`` keeps working
+through the wrapper, and forwards the jitted function's ``__wrapped__``
+(the raw Python function -- mesh.py re-jits it with shardings);
+uninstall restores from its own originals map.
+Cost: two clock reads + four counter bumps per dispatch -- a handful of
+microseconds against a millisecond-scale solve, inside the bench
+observatory overhead budget.
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+import time
+from typing import Any, Dict
+
+from karpenter_tpu import metrics
+from karpenter_tpu.analysis import jax_witness
+from karpenter_tpu.analysis.checkers.jax_discipline import JIT_ENTRY_FUNCTIONS
+
+JIT_DISPATCHES = metrics.REGISTRY.counter(
+    "karpenter_jit_entry_dispatches_total",
+    "Calls into each registered jit entry point (JIT_ENTRY_FUNCTIONS), "
+    "per entry -- the denominator of every per-entry cost claim",
+    labels=("entry",),
+)
+JIT_DISPATCH_SECS = metrics.REGISTRY.counter(
+    "karpenter_jit_entry_dispatch_seconds_total",
+    "Cumulative wall seconds inside each jit entry call: trace+lower on "
+    "a cache miss, argument staging + async launch on a hit (device "
+    "execution overlaps and is NOT in here -- capture it with "
+    "/debug/profile)",
+    labels=("entry",),
+)
+JIT_COMPILES = metrics.REGISTRY.counter(
+    "karpenter_jit_entry_compiles_total",
+    "Jit traces attributed to each entry (compile-counter delta across "
+    "one dispatch; populated while the jax witness's compile listener "
+    "is installed)",
+    labels=("entry",),
+)
+JIT_COMPILE_SECS = metrics.REGISTRY.counter(
+    "karpenter_jit_entry_compile_seconds_total",
+    "Cumulative jaxpr-trace seconds attributed to each entry (the "
+    "retrace stall cost; backend-compile time comes on top when the "
+    "persistent compilation cache misses)",
+    labels=("entry",),
+)
+
+_lock = threading.Lock()
+# entry -> [dispatches, dispatch_secs, compiles, compile_secs]
+_table: Dict[str, list] = {}
+# modname -> {fn_name: original}; non-empty = installed
+_originals: Dict[str, Dict[str, Any]] = {}
+
+
+def _probe(entry: str, fn):
+    thread_totals = jax_witness.thread_trace_totals
+
+    def probed(*args: Any, **kwargs: Any):
+        t0 = time.perf_counter()
+        # THREAD-LOCAL trace totals: the compile listener runs
+        # synchronously in the compiling thread, so a delta on this
+        # thread's counters belongs to THIS dispatch -- a concurrent
+        # compile (the auto_warm precompile thread, a sidecar handler)
+        # lands in its own thread's ledger, never double-attributed here
+        tr0, ts0 = thread_totals()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            dt = time.perf_counter() - t0
+            tr1, ts1 = thread_totals()
+            d_traces = tr1 - tr0
+            d_secs = ts1 - ts0
+            with _lock:
+                row = _table.setdefault(entry, [0, 0.0, 0, 0.0])
+                row[0] += 1
+                row[1] += dt
+                row[2] += d_traces
+                row[3] += d_secs
+            JIT_DISPATCHES.inc(entry=entry)
+            JIT_DISPATCH_SECS.inc(dt, entry=entry)
+            if d_traces:
+                JIT_COMPILES.inc(d_traces, entry=entry)
+                JIT_COMPILE_SECS.inc(d_secs, entry=entry)
+
+    probed._karpenter_jit_probe = True  # type: ignore[attr-defined]
+    # __wrapped__ forwards what the jitted function itself exposes --
+    # jax.jit sets it to the RAW Python function, and mesh.py re-jits
+    # exactly that with shardings (consolidate._repack.__wrapped__);
+    # pointing it at the jitted fn would silently build pjit-in-pjit
+    probed.__wrapped__ = getattr(fn, "__wrapped__", fn)  # type: ignore[attr-defined]
+    probed.__name__ = getattr(fn, "__name__", entry)
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is not None:
+        # entry_cache_sizes() polls this through the module attribute;
+        # the probe must stay transparent to it
+        probed._cache_size = cache_size  # type: ignore[attr-defined]
+    return probed
+
+
+def install() -> int:
+    """Wrap every registered jit entry with the dispatch probe; returns
+    the number of probes installed. Idempotent. Imports the solver
+    modules -- callers are the operator (which already built a solver)
+    and bench, never a lint/analysis process."""
+    installed = 0
+    for modname, fns in JIT_ENTRY_FUNCTIONS.items():
+        mod = importlib.import_module(modname)
+        saved = _originals.setdefault(modname, {})
+        for fn_name in fns:
+            if fn_name in saved:
+                continue
+            fn = getattr(mod, fn_name, None)
+            # jax.jit itself sets __wrapped__, so the probe carries its
+            # own marker to make reinstall idempotent
+            if fn is None or getattr(fn, "_karpenter_jit_probe", False):
+                continue
+            saved[fn_name] = fn
+            setattr(mod, fn_name, _probe(f"{modname}.{fn_name}", fn))
+            installed += 1
+    return installed
+
+
+def uninstall() -> None:
+    import sys
+
+    for modname, saved in _originals.items():
+        mod = sys.modules.get(modname)
+        if mod is None:
+            continue
+        for fn_name, fn in saved.items():
+            setattr(mod, fn_name, fn)
+    _originals.clear()
+
+
+def installed() -> bool:
+    return bool(_originals)
+
+
+def reset() -> None:
+    with _lock:
+        _table.clear()
+
+
+def table() -> Dict[str, Dict[str, Any]]:
+    """The accounting table, per entry: {dispatches, dispatch_ms,
+    compiles, compile_ms, cache_size}. Cache sizes ride along from the
+    witness's registry poll so a grown entry is visible next to its
+    dispatch cost ({} while probes are not installed)."""
+    with _lock:
+        rows = {k: list(v) for k, v in _table.items()}
+    if not rows and not _originals:
+        return {}
+    sizes = jax_witness.entry_cache_sizes()
+    out: Dict[str, Dict[str, Any]] = {}
+    for entry, (dispatches, d_secs, compiles, c_secs) in sorted(rows.items()):
+        out[entry] = {
+            "dispatches": dispatches,
+            "dispatch_ms": round(d_secs * 1e3, 3),
+            "compiles": compiles,
+            "compile_ms": round(c_secs * 1e3, 3),
+        }
+        if entry in sizes:
+            out[entry]["cache_size"] = sizes[entry]
+    # entries registered but never dispatched still show their cache
+    # size: "this program exists and is resident" is attribution too
+    for entry, size in sorted(sizes.items()):
+        out.setdefault(entry, {"dispatches": 0, "dispatch_ms": 0.0,
+                               "compiles": 0, "compile_ms": 0.0,
+                               "cache_size": size})
+    return out

@@ -1,0 +1,176 @@
+"""On-demand ``jax.profiler`` capture bracketing production ticks.
+
+The jit cost table (obs/jitstats.py) attributes DISPATCH cost; the
+on-device timeline -- kernel durations, HBM traffic, the gaps between
+dispatches -- only exists in an XLA profiler trace. This module arms a
+programmatic ``jax.profiler.start_trace``/``stop_trace`` pair around
+the next N production ticks, on demand:
+
+- ``GET /debug/profile?ticks=N`` (operator/health.py, loopback-only)
+  arms a capture on the live controller; ``GET /debug/profile`` reads
+  the capture state without arming anything;
+- ``python -m karpenter_tpu --profile-ticks N`` arms one at startup
+  (the cold path: warmup compiles land in the trace, which is exactly
+  what a first-tick investigation wants).
+
+The operator brackets every sweep with ``on_tick_start``/
+``on_tick_end``; both are a lock-free int check when nothing is armed
+(the no-op-when-idle contract bench measures). Traces land under
+``$KARPENTER_TPU_PROFILE_DIR`` (default ``profiles/``) in per-capture
+subdirectories, ready for TensorBoard/xprof (``tensorboard --logdir``).
+
+Brownout rung 2 throttles capture exactly like trace sampling
+(overload.BrownoutController._apply): an armed capture WAITS while the
+ladder sheds tracing -- profiling is the one observatory layer with a
+real device-side cost, so a brownout must not let a debug request deepen
+the overload it is diagnosing. Armed ticks resume when the ladder
+recovers; the flight recorder (obs/flight.py) is deliberately NOT
+throttled the same way.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+from karpenter_tpu import metrics
+from karpenter_tpu.logging import get_logger
+
+PROFILE_DIR_ENV = "KARPENTER_TPU_PROFILE_DIR"
+PROFILE_DIR_DEFAULT = "profiles"
+MAX_TICKS_PER_CAPTURE = 1000
+
+PROFILER_CAPTURES = metrics.REGISTRY.counter(
+    "karpenter_profiler_captures_total",
+    "Completed on-demand jax.profiler captures by outcome (ok = trace "
+    "written; error = start/stop raised and the capture was abandoned)",
+    labels=("outcome",),
+)
+PROFILER_ARMED = metrics.REGISTRY.gauge(
+    "karpenter_profiler_armed_ticks",
+    "Production ticks still to be captured by the armed jax.profiler "
+    "request (0 = idle; holds while brownout rung 2 defers the capture)",
+)
+
+
+class ProfilerCapture:
+    """Arms and drives one capture at a time. State transitions happen
+    under the lock; the actual ``jax.profiler`` start/stop calls run
+    outside it (they do real work and must not serialize against a
+    concurrent ``describe`` from the debug handler thread)."""
+
+    log = get_logger("profiler")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed = 0          # ticks still to capture (0 = idle)
+        self._active = False     # a start_trace is live
+        self._throttled = False  # brownout rung 2: defer, keep armed
+        self._out_dir: Optional[str] = None
+        self._capture_seq = 0
+        self.captures = 0
+        self.errors = 0
+        self.last_trace_dir: Optional[str] = None
+
+    # -- arming (debug endpoint / CLI) ---------------------------------------
+    def request(self, ticks: int, out_dir: Optional[str] = None) -> Dict[str, Any]:
+        """Arm a capture of the next `ticks` production ticks; returns
+        the state document. A request while a capture is armed/active
+        REPLACES the remaining tick count (the operator asked again for
+        a reason) but never the live trace directory."""
+        ticks = max(1, min(int(ticks), MAX_TICKS_PER_CAPTURE))
+        with self._lock:
+            self._armed = ticks
+            if not self._active:
+                self._capture_seq += 1
+                base = out_dir or os.environ.get(PROFILE_DIR_ENV) or PROFILE_DIR_DEFAULT
+                self._out_dir = os.path.join(base, f"capture-{self._capture_seq}")
+        PROFILER_ARMED.set(float(ticks))
+        self.log.info("profiler capture armed", ticks=ticks, dir=self._out_dir)
+        return self.describe()
+
+    def set_throttled(self, throttled: bool) -> None:
+        """Brownout ladder rung 2 (karpenter_tpu/overload.py): while
+        throttled, an armed capture waits and a live one stops at the
+        current tick boundary -- same edge semantics as the tracer's
+        sample throttle."""
+        with self._lock:
+            self._throttled = throttled
+
+    # -- tick bracketing (Operator.tick) -------------------------------------
+    def on_tick_start(self) -> None:
+        with self._lock:
+            if self._armed <= 0 or self._active or self._throttled:
+                return
+            out_dir = self._out_dir
+            self._active = True
+        try:
+            import jax
+
+            os.makedirs(out_dir, exist_ok=True)  # type: ignore[arg-type]
+            jax.profiler.start_trace(out_dir)
+        except Exception as e:  # noqa: BLE001 -- profiling must never fail a tick
+            with self._lock:
+                self._active = False
+                self._armed = 0
+            self.errors += 1
+            PROFILER_ARMED.set(0.0)
+            PROFILER_CAPTURES.inc(outcome="error")
+            self.log.warning("profiler start failed", error=str(e)[:200])
+
+    def on_tick_end(self) -> None:
+        with self._lock:
+            if not self._active:
+                return
+            self._armed -= 1
+            finish = self._armed <= 0 or self._throttled
+            if not finish:
+                PROFILER_ARMED.set(float(self._armed))
+                return
+            self._active = False
+            out_dir = self._out_dir
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            self.captures += 1
+            self.last_trace_dir = out_dir
+            PROFILER_CAPTURES.inc(outcome="ok")
+            self.log.info("profiler capture written", dir=out_dir)
+        except Exception as e:  # noqa: BLE001
+            self.errors += 1
+            PROFILER_CAPTURES.inc(outcome="error")
+            self.log.warning("profiler stop failed", error=str(e)[:200])
+        PROFILER_ARMED.set(float(max(0, self._armed)))
+
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "armed_ticks": self._armed,
+                "active": self._active,
+                "throttled": self._throttled,
+                "out_dir": self._out_dir,
+                "captures": self.captures,
+                "errors": self.errors,
+                "last_trace_dir": self.last_trace_dir,
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._armed = 0
+            self._active = False
+            self._throttled = False
+            self._out_dir = None
+        # the outcome fields are only ever written from the tick thread
+        # (on_tick_start/on_tick_end) and read for display -- they stay
+        # outside the lock everywhere, including here
+        self.captures = 0
+        self.errors = 0
+        self.last_trace_dir = None
+        PROFILER_ARMED.set(0.0)
+
+
+# process-wide capture handle (the same policy shape as tracing.TRACER):
+# the health server arms it, the operator brackets ticks with it, the
+# brownout ladder throttles it
+PROFILER = ProfilerCapture()

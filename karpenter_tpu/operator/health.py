@@ -21,11 +21,11 @@ Probes:
   `stall_after` -- standbys and demoted ex-leaders report 503 (not
   serving), which is endpoint semantics, not a restart signal.
 - `/metrics`: the Prometheus registry.
-- `/debug/stacks`: every thread's stack (loopback-only).
-- `/debug/traces`: the slow-tick flight recorder's span trees as JSON
-  (loopback-only; see karpenter_tpu/tracing.py and docs/observability.md).
-- `/debug/breaker`: the solver-wire circuit breaker's state document
-  (loopback-only; solver/breaker.py). /healthz also carries the breaker
+- `/debug/` (and every route under it): the loopback-only debug surface.
+  The index route enumerates every endpoint with a one-line description
+  (DEBUG_ENDPOINTS below is the single source; docs/observability.md
+  carries the matching table and tests/test_obs.py parametrizes the
+  loopback-enforcement suite over it). /healthz also carries the breaker
   state in its body -- an OPEN breaker is a degraded-but-alive condition
   (CPU fallback serving), never a liveness failure.
 
@@ -38,8 +38,47 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from karpenter_tpu.logging import get_logger
+
+# the loopback-only debug surface, enumerated: path -> one-line
+# description. Served as JSON by the index routes (`/debug`, `/debug/`),
+# mirrored as a table in docs/observability.md (test-pinned), and the
+# loopback-enforcement tests parametrize over exactly this dict -- a new
+# endpoint that skips it ships without enforcement coverage and fails
+# the suite.
+DEBUG_ENDPOINTS = {
+    "/debug/stacks": (
+        "every thread's current stack (the pprof-goroutine analogue)"),
+    "/debug/traces": (
+        "slow-tick trace recorder: the last N span trees whose sweep "
+        "exceeded the slow threshold, plus the worst-ever tree "
+        "(karpenter_tpu/tracing.py)"),
+    "/debug/breaker": (
+        "solver-wire circuit breaker state: consecutive failures, "
+        "backoff, probe history (solver/breaker.py)"),
+    "/debug/solver": (
+        "incremental-tick engine + observatory state: grouping churn, "
+        "delta shipping, staged bytes by kind, the per-jit-entry cost "
+        "table, staging LRUs and eviction counters (solver/service.py)"),
+    "/debug/journal": (
+        "crash-consistency intent journal: open write-ahead intents + "
+        "the recently-resolved ring (karpenter_tpu/journal.py)"),
+    "/debug/overload": (
+        "overload control: deadline/admission bounds, brownout ladder "
+        "level + overrun EWMA, watchdog escalations "
+        "(karpenter_tpu/overload.py)"),
+    "/debug/flightdata": (
+        "always-on flight-data recorder: one compact record per tick "
+        "for the last 256 ticks -- the black box the crash paths flush "
+        "to JSONL (karpenter_tpu/obs/flight.py)"),
+    "/debug/profile": (
+        "on-demand jax.profiler capture: ?ticks=N arms a trace "
+        "bracketing the next N production ticks (TensorBoard/xprof "
+        "output dir); without ?ticks reads the capture state "
+        "(karpenter_tpu/obs/profiler.py)"),
+}
 
 
 class HealthServer:
@@ -73,6 +112,12 @@ class HealthServer:
         # /debug/overload, loopback-only -- the overload runbook's first
         # stop during a storm (docs/operations.md).
         self.overload_info = None
+        # whether the run loop actually brackets ticks with the profiler
+        # (Options.observatory): with the observatory off, an armed
+        # capture would wait forever, so /debug/profile must report
+        # unconfigured instead of arming into the void. The binary wires
+        # this from its flags; standalone servers (tests) default on.
+        self.profile_enabled = True
         self._started_at = time.monotonic()
         self._last_loop: float = 0.0   # 0 = run loop has not turned yet
         self._last_sweep: float = 0.0  # 0 = no full sweep completed yet
@@ -157,6 +202,63 @@ class HealthServer:
                 )
 
             def do_GET(self):
+                # /debug/profile carries a query string; everything else
+                # matches on the bare path
+                url = urlparse(self.path)
+                if url.path in ("/debug", "/debug/"):
+                    # the index: every debug endpoint with its one-line
+                    # description (loopback-only like its members)
+                    self._debug_json(lambda: {"endpoints": DEBUG_ENDPOINTS})
+                    return
+                if url.path == "/debug/flightdata":
+                    # always-on flight-data ring (karpenter_tpu/obs/
+                    # flight.py): one compact record per tick, the black
+                    # box the crash paths flush
+                    if not self._loopback_only():
+                        return
+                    from karpenter_tpu.obs import flight
+
+                    self._send(
+                        200, flight.dump_json(indent=2),
+                        ctype="application/json",
+                    )
+                    return
+                if url.path == "/debug/profile":
+                    # on-demand jax.profiler capture (karpenter_tpu/obs/
+                    # profiler.py): ?ticks=N arms the next N production
+                    # ticks; no query = read the capture state
+                    if not self._loopback_only():
+                        return
+                    import json
+
+                    if not outer.profile_enabled:
+                        # observatory off: no tick would ever service a
+                        # capture -- never arm, report unconfigured
+                        self._send(
+                            200, json.dumps({"configured": False}, indent=2),
+                            ctype="application/json",
+                        )
+                        return
+                    from karpenter_tpu.obs.profiler import PROFILER
+
+                    query = parse_qs(url.query)
+                    ticks_raw = (query.get("ticks") or [""])[0]
+                    if ticks_raw:
+                        try:
+                            ticks = int(ticks_raw)
+                            if ticks <= 0:
+                                raise ValueError(ticks_raw)
+                        except ValueError:
+                            self._send(400, "ticks must be a positive integer")
+                            return
+                        doc = PROFILER.request(ticks)
+                    else:
+                        doc = PROFILER.describe()
+                    self._send(
+                        200, json.dumps(doc, indent=2),
+                        ctype="application/json",
+                    )
+                    return
                 if self.path == "/healthz":
                     # alive() evaluated ONCE: body and status must agree
                     # even when the stall window flips mid-request

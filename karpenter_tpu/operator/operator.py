@@ -114,6 +114,18 @@ class Options:
     # bounded launch fan-out: at most this many decision groups launch
     # per tick; deferred groups' pods stay pending (0 = unbounded)
     launch_max_groups: int = 0
+    # device performance observatory (karpenter_tpu/obs/): per-tick HBM
+    # accounting, the always-on flight-data ring (/debug/flightdata +
+    # the crash-flushed JSONL black box), profiler tick bracketing, and
+    # the per-jit-entry cost table. Default ON: the per-tick cost is a
+    # record build + a rate-limited memory_stats poll, measured <1% of
+    # the warm tick (bench observatory_overhead_pct). False = none of it
+    # runs (the pre-observatory tick, bit-identical).
+    observatory: bool = True
+    # flight-data ring depth: how many ticks the black box retains
+    # (postmortems start with these; 256 covers ~4 minutes at the 1s
+    # default cadence)
+    flight_capacity: int = 256
     feature_gates: dict = field(default_factory=lambda: {"ReservedCapacity": True, "SpotToSpotConsolidation": False})
 
 
@@ -194,6 +206,17 @@ class Operator:
         # constructed Operator's brownout (or None) is what module-level
         # consumers -- the solver client's delta shed -- observe
         overload.install_brownout(self.brownout)
+        # device performance observatory (karpenter_tpu/obs/): the
+        # flight-data ring is process-global like the tracer; the last
+        # Operator's capacity wins. The per-jit-entry dispatch probes
+        # install once, only when a solver exists (they wrap the solver
+        # package's jit entries).
+        if self.options.observatory:
+            from karpenter_tpu.obs import flight, jitstats
+
+            flight.RECORDER.configure(capacity=self.options.flight_capacity)
+            if solver is not None:
+                jitstats.install()
         # the coordination bus: the in-memory store by default; pass a
         # karpenter_tpu.kube.KubeCluster to run against a real apiserver
         # (the reference's kwok topology: real bus, emulated cloud)
@@ -379,15 +402,26 @@ class Operator:
             overload.TickBudget(self.options.tick_deadline)
             if self.options.tick_deadline > 0 else None
         )
+        obs_on = self.options.observatory
+        if obs_on:
+            # profiler tick bracketing (obs/profiler.py): a lock-free
+            # int check when nothing is armed; an armed /debug/profile
+            # or --profile-ticks request starts its trace here
+            from karpenter_tpu.obs import profiler as obs_profiler
+
+            obs_profiler.PROFILER.on_tick_start()
         if self.watchdog is not None:
             self.watchdog.tick_started()
+        root_sp = None
+        tick_t0 = time.monotonic()
+        crashed = False
         try:
             # the sweep is the trace ROOT: every controller's spans (the
             # provisioner's drain/snapshot/dispatch/launch, the binder's
             # bind, the disruption pass, batcher windows, solver + wire
             # stages) nest under one "tick" tree, and the flight recorder
             # judges slowness against the whole sweep
-            with overload.active(budget), tracing.trace("tick"):
+            with overload.active(budget), tracing.trace("tick") as root_sp:
                 self.nodeclass_controller.reconcile_all()
                 self.instance_type_refresh.reconcile()
                 self.pricing_refresh.reconcile()
@@ -406,6 +440,14 @@ class Operator:
                 self.termination.reconcile_all()
                 self.garbage_collection.reconcile()
                 self.metrics_controller.reconcile_all()
+        except BaseException as e:
+            # OperatorCrashed (a crash failpoint or the watchdog's async
+            # raise) is the postmortem trigger: the finally below records
+            # this tick and flushes the black box before it propagates
+            from karpenter_tpu.failpoints import OperatorCrashed
+
+            crashed = isinstance(e, OperatorCrashed)
+            raise
         finally:
             # the watchdog stands down and the brownout ladder sees the
             # tick's overrun even when the sweep died mid-flight (a crash
@@ -414,7 +456,32 @@ class Operator:
                 self.watchdog.tick_finished()
             if budget is not None and self.brownout is not None:
                 self.brownout.observe(budget.elapsed())
+            if obs_on:
+                self._observe_tick(root_sp, tick_t0, crashed)
         return True
+
+    def _observe_tick(self, root_sp, t0: float, crashed: bool) -> None:
+        """One flight-data record per sweep, EVERY sweep -- brownout rung
+        or not (obs/flight.py is the black box; the ticks that caused a
+        brownout must stay visible). The record itself is built by
+        flight.build_tick_record -- the SAME function bench's
+        observatory-overhead measurement drives, so the <1% contract
+        bounds exactly this work. A crashed tick records
+        ``crashed: true`` and flushes the JSONL black box before the
+        exception propagates."""
+        from karpenter_tpu.obs import flight
+        from karpenter_tpu.obs import profiler as obs_profiler
+
+        obs_profiler.PROFILER.on_tick_end()
+        try:
+            flight.record(flight.build_tick_record(
+                root_sp, t0, solver=self.solver, brownout=self.brownout,
+                crashed=crashed,
+            ))
+            if crashed:
+                flight.flush_blackbox(reason="operator-crashed")
+        except Exception:  # noqa: BLE001 -- the observatory must never fail a tick
+            pass
 
     def describe_overload(self) -> dict:
         """Overload-control state document for /debug/overload: the

@@ -165,9 +165,16 @@ class BrownoutController:
         1 shed-disruption  -- consolidation/disruption sweeps stand down
                               (controllers/disruption.py gates on this)
         2 shed-tracing     -- trace sampling stops feeding the per-span
-                              stats/metrics volume (the flight recorder
-                              still judges every sweep -- the slow ticks
-                              that CAUSED the brownout must stay visible)
+                              stats/metrics volume, and an armed
+                              jax.profiler capture (obs/profiler.py)
+                              defers -- profiling has a real device-side
+                              cost and must not deepen the overload it
+                              would diagnose. The slow-tick trace
+                              recorder still judges every sweep, and the
+                              flight-data recorder (obs/flight.py) keeps
+                              writing its per-tick record on EVERY rung:
+                              it is the black box, and the ticks that
+                              caused the brownout must stay visible
         3 shed-delta       -- delta-epoch class staging stands down (the
                               wire ships full; no staging diffs, no
                               restage retry roundtrips; bit-identical by
@@ -227,13 +234,18 @@ class BrownoutController:
 
     def _apply(self, level: int, ewma: float) -> None:
         from karpenter_tpu import tracing
+        from karpenter_tpu.obs import profiler as obs_profiler
 
         metrics.OVERLOAD_BROWNOUT_LEVEL.set(float(level))
         metrics.OVERLOAD_BROWNOUT_TRANSITIONS.inc(to=self.LEVELS[level])
         # rung 2's effect applies on the transition edge in both
         # directions: throttle keeps the configured sample rate around
-        # for the hysteretic recovery (tracing.Tracer.set_throttled)
+        # for the hysteretic recovery (tracing.Tracer.set_throttled).
+        # The profiler capture throttles on the same edge -- an armed
+        # capture defers and resumes when the ladder recovers. The
+        # flight-data recorder is deliberately NOT on this rung.
         tracing.TRACER.set_throttled(level >= 2)
+        obs_profiler.PROFILER.set_throttled(level >= 2)
         self.log.warning(
             "brownout ladder transition",
             ladder_level=self.LEVELS[level], overrun_ewma=round(ewma, 3),
@@ -283,11 +295,14 @@ def install_brownout(ctrl: Optional[BrownoutController]) -> None:
     global _BROWNOUT
     _BROWNOUT = ctrl
     from karpenter_tpu import tracing
+    from karpenter_tpu.obs import profiler as obs_profiler
 
-    # the tracer throttle follows the INSTALLED brownout's state: a new
-    # Operator replacing a mid-brownout one (tests, restarts) must not
-    # inherit a stuck throttle from the previous reign
-    tracing.TRACER.set_throttled(ctrl is not None and ctrl.sheds_tracing())
+    # the tracer/profiler throttles follow the INSTALLED brownout's
+    # state: a new Operator replacing a mid-brownout one (tests,
+    # restarts) must not inherit a stuck throttle from the previous reign
+    throttled = ctrl is not None and ctrl.sheds_tracing()
+    tracing.TRACER.set_throttled(throttled)
+    obs_profiler.PROFILER.set_throttled(throttled)
 
 
 def brownout() -> Optional[BrownoutController]:
@@ -399,6 +414,20 @@ class StuckTickWatchdog:
             gen = self._generation
         name = self.STAGES[stage]
         if name == "crash":
+            # flush the flight-data black box BEFORE the raise, from this
+            # (the watchdog's own) thread: the wedged tick may never reach
+            # a bytecode boundary (a C-level hang), in which case the
+            # async exception never lands and the tick-side
+            # OperatorCrashed flush never runs -- and once the raise is
+            # pending, nothing after it in THIS thread is guaranteed
+            # either (deterministic rigs drive check_now from the tick
+            # thread itself)
+            try:
+                from karpenter_tpu.obs import flight
+
+                flight.flush_blackbox(reason="watchdog-crash")
+            except Exception:  # noqa: BLE001 -- best-effort, like cancel
+                pass
             # re-check AND raise under the lock: tick_finished takes this
             # same lock, so the exception is pending in the wedged thread
             # before the tick can possibly be marked finished -- a tick

@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from karpenter_tpu import failpoints, metrics, overload, tracing
+from karpenter_tpu.obs import hbm as obs_hbm
 from karpenter_tpu.solver import encode, ffd
 
 TOKEN_ENV = "KARPENTER_TPU_SOLVER_TOKEN"
@@ -641,19 +642,56 @@ class SolverServer:
                 self._evictions["catalog"] += 1
                 metrics.SOLVER_STAGED_EVICTIONS.inc(kind="catalog")
             self._staged[seqnum] = _StagedEntry(staged, offsets, words)
+            self._evict_for_pressure_locked()
+            self._staged_bytes_locked()
         _send_frame(sock, {"ok": True, "seqnum": seqnum})
 
+    def _staged_bytes_locked(self) -> Dict[str, int]:
+        """Staged bytes by owner (HBM attribution, obs/hbm.py): sums
+        nbytes over the catalog staging and the class-epoch store --
+        metadata reads, never a transfer -- and mirrors the split into
+        karpenter_solver_staged_bytes{kind} so scrape and debug doc
+        agree. Caller holds the lock."""
+        catalog = sum(obs_hbm.sum_nbytes(e) for e in self._staged.values())
+        epochs = sum(obs_hbm.sum_nbytes(e) for e in self._epochs.values())
+        metrics.SOLVER_STAGED_BYTES.set(float(catalog), kind="catalog")
+        metrics.SOLVER_STAGED_BYTES.set(float(epochs), kind="class_epoch")
+        return {"catalog": int(catalog), "class_epoch": int(epochs)}
+
+    def _evict_for_pressure_locked(self) -> None:
+        """Memory-pressure eviction (obs/hbm.py): headroom below the
+        evict threshold shrinks BOTH staging LRUs to a floor of one
+        (the most recently used entry) instead of waiting for the fixed
+        capacity of 4 -- dropping the references releases the device
+        buffers. No allocator ledger (CPU) = capacity-only, as before.
+        Caller holds the lock; under_pressure's poll is rate-limited."""
+        if len(self._staged) <= 1 and len(self._epochs) <= 1:
+            return
+        if not obs_hbm.under_pressure():
+            return
+        while len(self._staged) > 1:
+            self._staged.pop(next(iter(self._staged)))
+            self._evictions["catalog"] += 1
+            metrics.SOLVER_STAGED_EVICTIONS.inc(kind="catalog")
+            metrics.SOLVER_STAGED_PRESSURE_EVICTIONS.inc(kind="catalog")
+        while len(self._epochs) > 1:
+            self._epochs.pop(next(iter(self._epochs)))
+            self._evictions["class_epoch"] += 1
+            metrics.SOLVER_STAGED_EVICTIONS.inc(kind="class_epoch")
+            metrics.SOLVER_STAGED_PRESSURE_EVICTIONS.inc(kind="class_epoch")
+
     def _op_debug(self, sock) -> None:
-        """Staging observability: what the LRUs hold and how often they
-        evicted (the /debug/solver endpoint surfaces this in-process; this
-        op serves the true sidecar topology where the server's counters
-        live in another process)."""
+        """Staging observability: what the LRUs hold, their bytes by
+        owner, and how often they evicted (the /debug/solver endpoint
+        surfaces this in-process; this op serves the true sidecar
+        topology where the server's counters live in another process)."""
         with self._lock:
             doc = {
                 "ok": True,
                 "staged_seqnums": list(self._staged),
                 "class_epochs": list(self._epochs),
                 "evictions": dict(self._evictions),
+                "staged_bytes": self._staged_bytes_locked(),
             }
         _send_frame(sock, doc)
 
@@ -745,6 +783,8 @@ class SolverServer:
                 self._epochs.pop(next(iter(self._epochs)))
                 self._evictions["class_epoch"] += 1
                 metrics.SOLVER_STAGED_EVICTIONS.inc(kind="class_epoch")
+            self._evict_for_pressure_locked()
+            self._staged_bytes_locked()
         return full
 
     def _staged_inputs(self, sock, header: dict, t: Dict[str, np.ndarray]):

@@ -28,6 +28,7 @@ import numpy as np
 
 from karpenter_tpu import failpoints, metrics, tracing
 from karpenter_tpu.apis import NodePool, Pod, labels as wk
+from karpenter_tpu.obs import hbm as obs_hbm
 from karpenter_tpu.logging import ChangeMonitor, get_logger
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resources
 from karpenter_tpu.scheduling import resources as res
@@ -197,6 +198,9 @@ class TPUSolver:
         # merged multi-pool catalog lists, keyed by (per-pool catalog ids,
         # per-pool requirement hashes); bounded (catalogs refresh 12-hourly)
         self._merged_cache: Dict[tuple, tuple] = {}
+        # HBM attribution (obs/hbm.py): bytes of the last solve's input
+        # tensors -- the "solve temporaries" owner in staged_bytes_by_kind
+        self._last_solve_bytes = 0
         self._lock = threading.Lock()
 
     # -- catalog staging ----------------------------------------------------
@@ -241,6 +245,15 @@ class TPUSolver:
             self._catalog_cache[key] = entry
             while len(self._catalog_cache) > self._catalog_cache_cap:
                 self._catalog_cache.pop(next(iter(self._catalog_cache)))
+            # memory-pressure eviction (obs/hbm.py): when device headroom
+            # drops below the evict threshold, shrink to the entry just
+            # staged instead of waiting for the fixed capacity -- dropping
+            # the host references releases the staged device buffers. No
+            # allocator ledger (CPU backend) = capacity-only, as before.
+            if len(self._catalog_cache) > 1 and obs_hbm.under_pressure():
+                while len(self._catalog_cache) > 1:
+                    self._catalog_cache.pop(next(iter(self._catalog_cache)))
+                    metrics.SOLVER_STAGED_PRESSURE_EVICTIONS.inc(kind="catalog")
             staged_entry = entry
         if staged_entry is not None and self.auto_warm and self.client is None:
             threading.Thread(
@@ -761,15 +774,41 @@ class TPUSolver:
         gc.collect()
         gc.freeze()
 
+    def staged_bytes_by_kind(self) -> Dict[str, int]:
+        """Staged tensor bytes attributed by owner, the HBM accounting
+        the observatory's flight recorder and /debug/solver serve:
+        ``catalog`` = every LRU entry's encoded + device-staged tensors
+        (remote mode stages on the sidecar, so local entries carry only
+        the host encoding); ``solve_temporaries`` = the last solve's
+        input tensors. Metadata reads only (nbytes) -- never a transfer
+        -- and mirrored into karpenter_solver_staged_bytes{kind} so the
+        scrape and the debug doc agree."""
+        with self._lock:
+            entries = list(self._catalog_cache.values())
+            temporaries = self._last_solve_bytes
+        catalog = sum(
+            obs_hbm.sum_nbytes(e.tensors) + obs_hbm.sum_nbytes(e.staged)
+            for e in entries
+        )
+        metrics.SOLVER_STAGED_BYTES.set(float(catalog), kind="catalog")
+        metrics.SOLVER_STAGED_BYTES.set(
+            float(temporaries), kind="solve_temporaries")
+        return {"catalog": int(catalog), "solve_temporaries": int(temporaries)}
+
     def describe_wire(self) -> dict:
         """Delta/staging state document for /debug/solver: the grouping
-        churn stats, the last solve's shipping mode, the client's staged
-        seqnums and epoch bases, and (best-effort) the sidecar's own
+        churn stats, the last solve's shipping mode, staged bytes by
+        owner, the per-jit-entry cost table, the client's staged seqnums
+        and epoch bases, and (best-effort) the sidecar's own
         staging/eviction counters via the debug op."""
+        from karpenter_tpu.obs import jitstats
+
         doc = {
             "incremental": self.incremental,
             "group_stats": dict(self.last_group_stats),
             "wire": self.client is not None,
+            "staged_bytes": self.staged_bytes_by_kind(),
+            "jit_entries": jitstats.table(),
         }
         c = self.client
         if c is None:
@@ -799,7 +838,8 @@ class TPUSolver:
                 server = c.debug_info()
                 doc["server"] = {
                     k: server[k]
-                    for k in ("staged_seqnums", "class_epochs", "evictions")
+                    for k in ("staged_seqnums", "class_epochs", "evictions",
+                              "staged_bytes")
                     if k in server
                 }
             except Exception:  # noqa: BLE001 -- debug output must never fail a probe
@@ -1535,6 +1575,8 @@ class TPUSolver:
                 # but a copy enqueued now streams back as soon as the result
                 # exists and the later read drains in <1 ms
                 nnz_max = ffd.nnz_budget(class_set.c_pad, self.g_max)
+                # HBM attribution: nbytes is array metadata, not a fetch
+                self._last_solve_bytes = obs_hbm.sum_nbytes(inp)
                 buf = ffd.ffd_solve_fused(
                     inp, g_max=self.g_max, nnz_max=nnz_max,
                     word_offsets=offsets, words=words,
