@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm bench-wire benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos overload sim-corpus lint typecheck
+.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos overload sim-corpus lint typecheck
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -51,6 +51,9 @@ bench-warm:  ## warm steady-state delta stage only (incremental tick engine: war
 
 bench-wire:  ## transport stage only (wire v2: warm_wire_p50/p99_ms shm vs tcp, wire_share_of_tick, reply_bytes_per_solve, copies-per-solve, wire_warm_retrace_count); one JSON line
 	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --wire-only > bench_wire_last.json; rc=$$?; cat bench_wire_last.json; exit $$rc
+
+bench-consolidate:  ## consolidation stage only (disrupt engine: consolidation_nodes_per_s >=100 at tier, sweep p50/p99, device-vs-wire verdict differential asserted 0, warm retrace count); one JSON line
+	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --consolidate-only > bench_consolidate_last.json; rc=$$?; cat bench_consolidate_last.json; exit $$rc
 
 # the chaos-family soaks route the observatory's crash-flushed black box
 # (karpenter_tpu/obs/flight.py) into their artifact dirs, so a failing

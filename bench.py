@@ -1025,6 +1025,141 @@ def _wire_stage(pool, items, zones, iters: int) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _consolidation_stage(pool, items, iters: int = 6) -> dict:
+    """Always-run consolidation stage (device-consolidation tentpole's
+    acceptance measurement). A synthetic underutilized fleet at the
+    current tier -- every node holding a residual pod after a simulated
+    ramp-down -- drives full batched candidate-set sweeps through the
+    DisruptEngine: singletons for every candidate plus the price-ranked
+    multi-node prefixes and underutilized pairs the controller
+    enumerates, with replacement context against this tier's catalog.
+
+    Fields:
+    - consolidation_nodes_per_s: candidate nodes judged per second of
+      sweep wall time (acceptance: >=100 at the 50k tier);
+    - consolidation_sweep_p50/p99_ms, consolidation_sets_per_sweep;
+    - consolidation_verdict_differential: device-route vs wire-route
+      verdict mismatches over identical inputs, asserted 0 (the
+      host == wire == device decision contract, measured not assumed);
+    - consolidation_warm_retrace_count: jax-witness retraces/unsanctioned
+      transfers across the measured warm sweeps, asserted 0."""
+    import shutil
+    import tempfile
+
+    from karpenter_tpu.apis import Pod, labels as wk
+    from karpenter_tpu.scheduling import Resources
+    from karpenter_tpu.scheduling import resources as res
+    from karpenter_tpu.solver import rpc
+    from karpenter_tpu.solver.disrupt import DisruptEngine, enumerate_pairs
+    from karpenter_tpu.solver.oracle import ExistingNode
+    from karpenter_tpu.solver.service import TPUSolver
+
+    n_nodes = max(64, min(1024, N_PODS // 48))
+    n_cand = min(256, n_nodes)
+    rng = np.random.default_rng(7)
+    shapes = ((4000, 8 << 30), (8000, 16 << 30), (16000, 32 << 30))
+    nodes = []
+    for i in range(n_nodes):
+        cpu_m, mem = shapes[int(rng.integers(0, len(shapes)))]
+        used_cpu = int(rng.integers(200, cpu_m // 4))
+        nodes.append(ExistingNode(
+            name=f"bench-n{i}",
+            labels={wk.HOSTNAME_LABEL: f"bench-n{i}",
+                    wk.ZONE_LABEL: "us-central-1a"},
+            allocatable=Resources.from_base_units(
+                {res.CPU: cpu_m, res.MEMORY: mem, res.PODS: 110}),
+            used=Resources.from_base_units(
+                {res.CPU: used_cpu, res.MEMORY: mem // 8}),
+        ))
+
+    def cand_pods(i: int):
+        # the candidate's residual pods: 1-3 small survivors of the ramp-down
+        k = 1 + i % 3
+        return [
+            Pod(f"bench-c{i}-{j}",
+                requests=Resources({"cpu": "500m", "memory": "512Mi"}))
+            for j in range(k)
+        ]
+
+    pods_of = [cand_pods(i) for i in range(n_cand)]
+    # the controller's enumeration: singletons, prefixes 2..K, pairs
+    sets = [(pods_of[i], [nodes[i].name]) for i in range(n_cand)]
+    prefix_k = min(32, n_cand)
+    for k in range(2, prefix_k + 1):
+        sets.append((
+            [p for i in range(k) for p in pods_of[i]],
+            [nodes[i].name for i in range(k)],
+        ))
+    for i, j in enumerate_pairs(n_cand):
+        sets.append((pods_of[i] + pods_of[j], [nodes[i].name, nodes[j].name]))
+
+    from karpenter_tpu.analysis import jax_witness
+
+    if os.environ.get("KARPENTER_TPU_JAX_WITNESS", "1") != "0":
+        jax_witness.install()
+    wit0 = jax_witness.stats()
+    d = tempfile.mkdtemp(prefix="bench_consolidate_")
+    sock = os.path.join(d, "solver.sock")
+    srv = None
+    client = None
+    out: dict = {}
+    try:
+        engine = DisruptEngine()
+        kw = dict(pools=[pool], catalogs={pool.name: items})
+        base = engine.evaluate(nodes, sets, **kw)  # compile + stage, unmeasured
+        sweep_ms = []
+        with jax_witness.hot("bench_consolidation"):
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                verdicts = engine.evaluate(nodes, sets, **kw)
+                sweep_ms.append((time.perf_counter() - t0) * 1e3)
+        p50 = float(np.percentile(sweep_ms, 50))
+        out["consolidation_sweep_p50_ms"] = round(p50, 2)
+        out["consolidation_sweep_p99_ms"] = round(float(np.percentile(sweep_ms, 99)), 2)
+        out["consolidation_sets_per_sweep"] = len(sets)
+        out["consolidation_candidates_per_sweep"] = n_cand
+        out["consolidation_fleet_nodes"] = n_nodes
+        out["consolidation_nodes_per_s"] = round(n_cand / (p50 / 1e3), 1) if p50 else 0.0
+        out["consolidation_nodes_per_s_ok"] = bool(
+            out["consolidation_nodes_per_s"]
+            >= _env_f("BENCH_CONSOLIDATION_NODES_PER_S_MIN", 100.0)
+        )
+        assert [repr(v) for v in verdicts] == [repr(v) for v in base], (
+            "warm sweep verdicts drifted across iterations"
+        )
+        # wire differential: the SAME sweep through a loopback sidecar's
+        # solve_disrupt op must produce bit-identical verdicts
+        srv = rpc.SolverServer(path=sock).start()
+        client = rpc.SolverClient(path=sock)
+        solver = TPUSolver(g_max=G_MAX, client=client)
+        wire_engine = DisruptEngine(solver=solver)
+        wire_verdicts = wire_engine.evaluate(nodes, sets, **kw)
+        diff = sum(
+            1 for a, b in zip(wire_verdicts, verdicts) if repr(a) != repr(b)
+        )
+        out["consolidation_wire_path"] = wire_engine.last_dispatch["path"]
+        out["consolidation_verdict_differential"] = int(diff)
+        out["consolidation_differential_ok"] = bool(
+            diff == 0 and wire_engine.last_dispatch["path"] == "wire"
+        )
+        if jax_witness.installed():
+            wit1 = jax_witness.stats()
+            retraces = wit1["hot_retraces"] - wit0["hot_retraces"]
+            transfers = wit1["hot_transfers"] - wit0["hot_transfers"]
+            out["consolidation_warm_retrace_count"] = int(retraces)
+            out["consolidation_warm_host_transfer_count"] = int(transfers)
+            out["consolidation_warm_retrace_ok"] = bool(
+                retraces == 0 and transfers == 0
+            )
+        return out
+    finally:
+        if client is not None:
+            client.close()
+        if srv is not None:
+            srv.stop()
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _recovery_stage(warm_tick_p50_ms=None, iters: int = 4, k_intents: int = 16) -> dict:
     """Crash-recovery stage (crash-consistency tentpole; ALWAYS runs):
 
@@ -1289,7 +1424,7 @@ def _gen2_collections() -> int:
 
 
 def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
-        wire_only: bool = False):
+        wire_only: bool = False, consolidate_only: bool = False):
     import jax
 
     from karpenter_tpu.apis import NodePool
@@ -1358,6 +1493,20 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
         out.update(_wire_stage(pool, items, zones,
                                iters=10 if backend != "cpu" else 6))
         out["value"] = out.get("warm_wire_p50_ms", 0.0)
+        stage_fields(out)
+        return out
+    if consolidate_only:
+        # `make bench-consolidate`: only the consolidation stage (plus
+        # setup) -- the fast iteration loop for the disrupt engine
+        out = {
+            "metric": f"consolidation_nodes_per_s_{N_PODS // 1000}k_pods",
+            "unit": "nodes/s",
+            "mode": "consolidate_only",
+            "platform": backend,
+        }
+        out.update(_consolidation_stage(
+            pool, items, iters=8 if backend != "cpu" else 5))
+        out["value"] = out.get("consolidation_nodes_per_s", 0.0)
         stage_fields(out)
         return out
     solver = TPUSolver(g_max=G_MAX)
@@ -1545,6 +1694,18 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
     progress({"ev": "phase", "name": "overload"})
     stage_fields(production)
 
+    # consolidation stage (device-consolidation tentpole): ALWAYS runs --
+    # consolidation_nodes_per_s (>=100 at the 50k tier), sweep p50/p99,
+    # and the device-vs-wire verdict differential (asserted 0) are
+    # headline acceptance data, persisted via the incremental side-file
+    try:
+        production.update(_consolidation_stage(
+            pool, items, iters=6 if backend != "cpu" else 4))
+    except Exception as e:  # noqa: BLE001
+        production["consolidation_stage_error"] = f"{type(e).__name__}: {e}"[:200]
+    progress({"ev": "phase", "name": "consolidation"})
+    stage_fields(production)
+
     # secondary measurements -- each individually fenced so a failure can
     # never cost the headline (the JSON line must always appear)
     secondary: dict = {}
@@ -1694,7 +1855,8 @@ def _child_main() -> None:
         jax.config.update("jax_platforms", "cpu")
     try:
         out = run(profile, progress, warm_only="--warm-only" in sys.argv,
-                  wire_only="--wire-only" in sys.argv)
+                  wire_only="--wire-only" in sys.argv,
+                  consolidate_only="--consolidate-only" in sys.argv)
         progress({"ev": "result", "out": out})
         print(json.dumps(out))
     except Exception as e:  # noqa: BLE001 - parent assembles a partial
@@ -1836,6 +1998,8 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
         args.append("--warm-only")
     if "--wire-only" in sys.argv:
         args.append("--wire-only")
+    if "--consolidate-only" in sys.argv:
+        args.append("--consolidate-only")
     proc = subprocess.Popen(
         args, stdout=subprocess.DEVNULL, stderr=None, text=True, env=env
     )

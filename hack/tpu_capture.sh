@@ -21,6 +21,7 @@
 cd /root/repo
 OUT=BENCH_TPU_CAPTURE.json
 WIRE_OUT=BENCH_WIRE_CAPTURE.json
+CONSOLIDATE_OUT=BENCH_CONSOLIDATION_CAPTURE.json
 MEM_OUT=BENCH_TPU_MEMSTATS.json
 PROFILE_DIR=BENCH_TPU_PROFILE
 LOG=BENCH_TPU_CAPTURE.log
@@ -79,6 +80,21 @@ print('BACKEND=' + jax.default_backend())
           rm -f "$WIRE_OUT.tmp"
         fi
         memstats_snapshot "post-wire"
+        # consolidation stage on the same warm tunnel: the disrupt
+        # engine's nodes/s + sweep percentiles + device-vs-wire verdict
+        # differential at this tier (the device-consolidation ROADMAP
+        # item's on-TPU acceptance numbers). Best-effort like the wire
+        # stage: its failure never invalidates the main capture.
+        echo "[capture] consolidation stage $(date -u +%H:%M:%S)" >> "$LOG"
+        if timeout 1200 env BENCH_PROBE_BUDGET_S=120 BENCH_CPU_BUDGET_S=60 KARPENTER_TPU_JAX_WITNESS=1 python bench.py --consolidate-only > "$CONSOLIDATE_OUT.tmp" 2>> "$LOG" \
+           && grep -q '"platform"' "$CONSOLIDATE_OUT.tmp" && ! grep -q '"platform": "cpu"' "$CONSOLIDATE_OUT.tmp"; then
+          mv "$CONSOLIDATE_OUT.tmp" "$CONSOLIDATE_OUT"
+          echo "[capture] consolidation SUCCESS $(date -u +%H:%M:%S)" >> "$LOG"
+        else
+          echo "[capture] consolidation stage failed/degraded; captures stand" >> "$LOG"
+          cat "$CONSOLIDATE_OUT.tmp" >> "$LOG" 2>/dev/null
+          rm -f "$CONSOLIDATE_OUT.tmp"
+        fi
         # one 10-tick programmatic profiler trace of the controller rig
         # (the observatory's --profile-ticks seam): the on-device
         # timeline for TensorBoard/xprof. Best-effort, bounded.

@@ -144,7 +144,11 @@ def build_operator(args):
                 **breaker_kw,
             )
         solver = TPUSolver(auto_warm=client is None, client=client, breaker=breaker)
-        evaluator = ConsolidationEvaluator()
+        # the consolidation engine rides the SAME wire as the scheduling
+        # solve: with a sidecar configured, candidate-set sweeps dispatch
+        # as the solve_disrupt op against the catalogs already staged per
+        # seqnum, and the breaker's degrade ladder covers both paths
+        evaluator = ConsolidationEvaluator(solver=solver)
     cluster = None
     if getattr(args, "kubeconfig", None) or getattr(args, "in_cluster", False):
         # real coordination bus (the reference's kwok deployment topology:

@@ -88,6 +88,8 @@ STATIC_ARG_BUCKETS: Dict[str, str] = {
     "words": "packed-bitset geometry: per-dimension word counts, fixed by "
              "the catalog encoding alongside word_offsets",
     "objective": "closed enum {'price', 'fit'}: two programs total",
+    "od_col": "on-demand column of the closed capacity-type vocabulary "
+              "(encode.CAPTYPE_INDEX): one value per process",
 }
 
 # rel-path prefixes the jaxjit rules scan (jit entry points live here;
@@ -107,7 +109,7 @@ JIT_ENTRY_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
         "ffd_solve", "select_offerings", "ffd_solve_packed",
         "ffd_solve_compact", "ffd_solve_fused",
     ),
-    "karpenter_tpu.solver.consolidate": ("_repack", "_replacement_search"),
+    "karpenter_tpu.solver.disrupt.kernel": ("disrupt_repack", "disrupt_replace"),
 }
 
 # modules that build jit wrappers dynamically (jax.jit(...) call sites,
@@ -144,13 +146,15 @@ DEVICE_HOT_PATH: Dict[str, Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]] =
         (),
         {
             "SolverServer": ("_op_solve_delta", "_staged_inputs",
-                             "_op_solve", "_op_solve_compact"),
+                             "_op_solve", "_op_solve_compact",
+                             "_op_solve_disrupt"),
             "SolverClient": ("begin_solve_compact", "finish_solve_compact"),
         },
     ),
-    "karpenter_tpu/solver/consolidate.py": (
+    "karpenter_tpu/solver/disrupt/engine.py": (
         (),
-        {"ConsolidationEvaluator": ("evaluate",)},
+        {"DisruptEngine": ("evaluate", "_dispatch_local", "_evaluate_local",
+                           "_evaluate_wire", "_assemble")},
     ),
     "karpenter_tpu/parallel/mesh.py": (
         ("sharded_solve", "sharded_repack", "_fetch_multiprocess"),
@@ -188,7 +192,9 @@ SANCTIONED_FETCH: Set[Tuple[str, str]] = {
     ("karpenter_tpu/solver/service.py", "_pack_existing"),
     ("karpenter_tpu/solver/rpc.py", "_op_solve"),
     ("karpenter_tpu/solver/rpc.py", "_op_solve_compact"),
-    ("karpenter_tpu/solver/consolidate.py", "evaluate"),
+    ("karpenter_tpu/solver/rpc.py", "_op_solve_disrupt"),
+    ("karpenter_tpu/solver/disrupt/engine.py", "_dispatch_local"),
+    ("karpenter_tpu/solver/disrupt/engine.py", "_evaluate_local"),
     ("karpenter_tpu/parallel/mesh.py", "_fetch_multiprocess"),
     # observatory introspection seams: memory_stats() reads the
     # allocator ledger (metadata, no transfer) and the profiler bracket

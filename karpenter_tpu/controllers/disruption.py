@@ -46,6 +46,11 @@ from karpenter_tpu.scheduling import resources as res
 from karpenter_tpu.solver.oracle import ExistingNode, Scheduler
 
 MIN_NODE_LIFETIME = 5 * 60.0  # consolidation waits for PVC binding etc.
+# brownout rung 1 (overload.BrownoutController): with a device evaluator
+# wired, the sweep DOWNGRADES to a bounded singleton-only device pass
+# over this many cheapest-to-disrupt candidates instead of standing down
+# entirely -- one dispatch, no drift/replacement/multi-node host work
+BROWNOUT_MAX_CANDIDATES = 16
 # spot->spot consolidation keeps at least this many cheaper instance-type
 # options on the replacement (upstream's flexibility minimum: replacing a
 # spot node with a single cheaper spot type would trade price for a much
@@ -103,6 +108,14 @@ class DisruptionController:
         # constraints fall back to the per-candidate oracle simulation
         self.evaluator = evaluator
         self.last_decisions: List[Tuple[str, str]] = []  # (claim name, reason)
+        # per-sweep stats for the flight recorder (obs/flight.py): sweep
+        # mode (full / bounded / shed), wall ms, candidate-set counts by
+        # enumeration kind, and the engine's dispatch route
+        self.last_sweep_stats: dict = {}
+        # candidate-set counts accumulated across the CURRENT pass's
+        # batched dispatches (singleton batch + prefix/pair batch +
+        # mid-pass re-judges)
+        self._pass_set_counts: Dict[str, int] = {}
         # nodes disrupted in the CURRENT pass: their NodeClaims are deleting
         # but the Node objects are not yet marked (termination runs later),
         # so simulations must exclude them explicitly or later candidates
@@ -363,19 +376,33 @@ class DisruptionController:
 
         from karpenter_tpu import metrics, tracing
 
+        bounded = False
         if self.brownout is not None and self.brownout.sheds_disruption():
-            # brownout ladder rung 1: the sweep stands down entirely --
-            # consolidation is strictly optional work, and its candidate
-            # simulations are exactly the host-side cost a pressured tick
-            # cannot afford. Nothing is lost: candidates re-judge once
-            # the ladder recovers.
-            metrics.OVERLOAD_SKIPPED_SWEEPS.inc(stage="disruption")
-            tracing.annotate(disruption="shed-brownout")
-            self.last_decisions = []
-            return []
+            if self.evaluator is None:
+                # brownout ladder rung 1, no device engine wired: the
+                # sweep stands down entirely -- the per-candidate oracle
+                # simulations are exactly the host-side cost a pressured
+                # tick cannot afford. Nothing is lost: candidates
+                # re-judge once the ladder recovers.
+                metrics.OVERLOAD_SKIPPED_SWEEPS.inc(stage="disruption")
+                tracing.annotate(disruption="shed-brownout")
+                self.last_decisions = []
+                self.last_sweep_stats = {"mode": "shed", "consolidation_ms": 0.0}
+                return []
+            # with the batched device engine the sweep is cheap enough to
+            # LEAVE ON during brownout: rung 1 downgrades to a bounded
+            # singleton-only device pass (one dispatch over the cheapest
+            # candidates, deletion verdicts only) instead of standing down
+            bounded = True
         t0 = _time.perf_counter()
+        self._pass_set_counts = {}
+        mode = "bounded" if bounded else "full"
         try:
             with tracing.span("disruption"):
+                if bounded:
+                    metrics.DISRUPTION_DEVICE_BOUNDED_SWEEPS.inc()
+                    tracing.annotate(disruption="brownout-bounded")
+                    return self._reconcile_bounded(max_disruptions)
                 return self._reconcile(max_disruptions)
         finally:
             self._pass_pools, self._pass_catalogs = None, None
@@ -386,7 +413,22 @@ class DisruptionController:
             # last pass's volume world
             self._pass_vol_index = None
             self._pass_blocked_logged = set()
-            metrics.DISRUPTION_EVAL_DURATION.observe(_time.perf_counter() - t0)
+            elapsed = _time.perf_counter() - t0
+            metrics.DISRUPTION_EVAL_DURATION.observe(elapsed)
+            if self.evaluator is None:
+                path = "oracle"
+            elif not self._pass_set_counts:
+                # THIS pass made no device dispatch; last_dispatch would
+                # report a previous sweep's route
+                path = "none"
+            else:
+                path = getattr(self.evaluator, "last_dispatch", {}).get("path", "none")
+            self.last_sweep_stats = {
+                "mode": mode,
+                "consolidation_ms": round(elapsed * 1e3, 3),
+                "sets": dict(self._pass_set_counts),
+                "path": path,
+            }
 
     def _daemon_overhead(self, pools) -> Dict[str, "Resources"]:
         """Per-pool fresh-node daemonset reserve, SNAPSHOT per pass like
@@ -418,7 +460,9 @@ class DisruptionController:
                 catalogs[pool.name] = []
         return pools, catalogs
 
-    def _reconcile(self, max_disruptions: int) -> List[Tuple[str, str]]:
+    def _pass_setup(self) -> None:
+        """Per-pass snapshot state shared by the full and bounded sweeps
+        (torn down by reconcile's finally)."""
         from karpenter_tpu.apis.storage import VolumeIndex
 
         self.last_decisions = []
@@ -429,6 +473,10 @@ class DisruptionController:
         self._pass_pdb_guard = None
         self._pass_daemon_overhead = None
         self._pass_pools, self._pass_catalogs = self._pool_context()
+
+    def _disruption_counts(self) -> Tuple[Dict[str, int], Dict[str, int]]:
+        """(claims currently disrupting, claim totals) per pool -- the
+        budget denominators."""
         disrupting: Dict[str, int] = {}
         totals: Dict[str, int] = {}
         for claim in self.cluster.list(NodeClaim):
@@ -436,7 +484,75 @@ class DisruptionController:
                 totals[claim.nodepool_name] = totals.get(claim.nodepool_name, 0) + 1
                 if claim.deleting:
                     disrupting[claim.nodepool_name] = disrupting.get(claim.nodepool_name, 0) + 1
+        return disrupting, totals
 
+    def _consolidatable(self, candidates: Sequence[Candidate]) -> List[Candidate]:
+        """Pool-owned, consenting, past the consolidation age gate, in
+        ascending disruption-cost order -- the candidate assembly both
+        sweep modes share."""
+        now = self.cluster.clock.now()
+        acted = [n for n, _ in self.last_decisions]
+        return sorted(
+            (
+                c
+                for c in candidates
+                if not c.do_not_disrupt
+                and c.claim.metadata.name not in acted
+                and c.nodepool is not None  # pool-policy reasons only
+                and now - c.claim.metadata.creation_timestamp
+                >= max(MIN_NODE_LIFETIME, c.nodepool.disruption.consolidate_after)
+            ),
+            key=lambda c: c.disruption_cost,
+        )
+
+    def _reconcile_bounded(self, max_disruptions: int) -> List[Tuple[str, str]]:
+        """The brownout rung-1 sweep: candidate assembly capped at the
+        BROWNOUT_MAX_CANDIDATES cheapest-to-disrupt nodes, ONE singleton-
+        only device dispatch with no replacement context, emptiness +
+        deletion verdicts applied under the usual budget/PDB gates. No
+        drift, no expiration, no replacement launches, no multi-node work
+        -- the host-side cost is candidate assembly plus verdict
+        application, which is exactly what a pressured tick can afford."""
+        self._pass_setup()
+        if self.cluster.pending_pods():
+            return self.last_decisions
+        disrupting, totals = self._disruption_counts()
+        consolidatable = self._consolidatable(self._candidates())[:BROWNOUT_MAX_CANDIDATES]
+        verdicts = self._device_verdicts(consolidatable, replacement=False)
+        decided = len(self.last_decisions)
+        for i, c in enumerate(consolidatable):
+            if len(self.last_decisions) >= max_disruptions:
+                break
+            if len(self.last_decisions) != decided:
+                # same re-judge discipline as the full sweep: an earlier
+                # disruption this pass consumed surviving headroom, and a
+                # stale verdict would double-book it -- one fresh bounded
+                # dispatch per decision, still O(max_disruptions) cheap
+                decided = len(self.last_decisions)
+                verdicts = self._device_verdicts(
+                    consolidatable[i:], replacement=False)
+            reschedulable = [p for p in c.pods if p.owner_kind != "Node"]
+            if not reschedulable:
+                c.claim.status_conditions.set_true(COND_EMPTY)
+                if self._budget_allows(c.nodepool, REASON_EMPTY, disrupting, totals):
+                    self._disrupt(c, REASON_EMPTY, disrupting)
+                continue
+            if c.nodepool.disruption.consolidation_policy == CONSOLIDATION_WHEN_EMPTY:
+                continue
+            v = verdicts.get(c.claim.metadata.name)
+            if v is None or not v.can_delete:
+                continue
+            if not self._all_pods_evictable(c.pods):
+                continue
+            if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, disrupting, totals):
+                continue
+            c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+            self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
+        return self.last_decisions
+
+    def _reconcile(self, max_disruptions: int) -> List[Tuple[str, str]]:
+        self._pass_setup()
+        disrupting, totals = self._disruption_counts()
         candidates = self._candidates()
         now = self.cluster.clock.now()
 
@@ -480,18 +596,7 @@ class DisruptionController:
         # 3) emptiness + 4) consolidation share the stabilization gate
         if self.cluster.pending_pods():
             return self.last_decisions
-        consolidatable = sorted(
-            (
-                c
-                for c in candidates
-                if not c.do_not_disrupt
-                and c.claim.metadata.name not in [n for n, _ in self.last_decisions]
-                and c.nodepool is not None  # pool-policy reasons only
-                and now - c.claim.metadata.creation_timestamp
-                >= max(MIN_NODE_LIFETIME, c.nodepool.disruption.consolidate_after)
-            ),
-            key=lambda c: c.disruption_cost,
-        )
+        consolidatable = self._consolidatable(candidates)
         verdicts = self._device_verdicts(consolidatable)
         decided = len(self.last_decisions)
         for i, c in enumerate(consolidatable):
@@ -545,7 +650,9 @@ class DisruptionController:
 
         # 5) multi-node consolidation: try deleting the k cheapest-to-disrupt
         #    candidates together; when pure deletion fails, collapse them
-        #    into ONE cheaper replacement node
+        #    into ONE cheaper replacement node, and when no PREFIX of the
+        #    disruption-cost order works, try underutilized PAIRS outside
+        #    it (two nodes whose pods only fold together)
         #    (reference: designs/consolidation.md:5-36 node replacement)
         if len(self.last_decisions) < max_disruptions and len(consolidatable) >= 2:
             remaining = [
@@ -565,22 +672,28 @@ class DisruptionController:
                         break
                     self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
             elif len(remaining) >= 2:
-                self._multi_node_replacement(remaining, device_verdicts, disrupting, totals)
+                acted = self._multi_node_replacement(
+                    remaining, device_verdicts, disrupting, totals)
+                if not acted:
+                    self._pair_consolidation(
+                        remaining, device_verdicts, disrupting, totals,
+                        max_disruptions)
         return self.last_decisions
 
     def _multi_node_replacement(
         self,
         remaining: List[Candidate],
-        device_verdicts: Optional[Dict[int, object]],
+        device_verdicts: Optional[Dict[object, object]],
         disrupting: Dict[str, int],
         totals: Dict[str, int],
-    ) -> None:
+    ) -> bool:
         """Replace N underutilized nodes with one cheaper node: largest
         prefix (by the disruption-cost order) whose pods fit the survivors
         plus ONE new node strictly cheaper than the prefix's aggregate
         price. `device_verdicts` is the per-prefix batch already dispatched
         for the deletion decision (replacement context included); the oracle
-        re-derives the replacement group before acting."""
+        re-derives the replacement group before acting. True when a
+        replacement launched (the pair stage only runs when nothing did)."""
         for k in range(len(remaining), 1, -1):
             prefix = remaining[:k]
             if device_verdicts is not None:
@@ -590,32 +703,105 @@ class DisruptionController:
             # the whole prefix drains behind one launch, so budget-check it
             # as a unit: members from one pool count against that pool's
             # budget cumulatively
-            trial = dict(disrupting)
-            ok_budget = True
-            for c in prefix:
-                if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, trial, totals):
-                    ok_budget = False
-                    break
-                trial[c.nodepool.name] = trial.get(c.nodepool.name, 0) + 1
-            if not ok_budget:
+            if not self._budget_allows_set(prefix, disrupting, totals):
                 continue
             ok, groups = self._simulate(prefix, allow_new_node=True)
             if ok and groups and self._replacement_cheaper(prefix, groups):
                 for c in prefix:
                     c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
                 self._replace_then_disrupt(prefix, groups, REASON_UNDERUTILIZED, disrupting)
-                return
+                return True
+        return False
+
+    def _budget_allows_set(self, cands: List[Candidate], disrupting: Dict[str, int],
+                           totals: Dict[str, int]) -> bool:
+        """Budget-check a candidate set as a UNIT (the whole set drains
+        behind one decision): members from one pool count against that
+        pool's budget cumulatively."""
+        trial = dict(disrupting)
+        for c in cands:
+            if not self._budget_allows(c.nodepool, REASON_UNDERUTILIZED, trial, totals):
+                return False
+            trial[c.nodepool.name] = trial.get(c.nodepool.name, 0) + 1
+        return True
+
+    def _pair_consolidation(
+        self,
+        remaining: List[Candidate],
+        device_verdicts: Optional[Dict[object, object]],
+        disrupting: Dict[str, int],
+        totals: Dict[str, int],
+        max_disruptions: int,
+    ) -> bool:
+        """Underutilized pairs OUTSIDE the prefix order: two nodes whose
+        pods only fold together (or onto one cheaper replacement) even
+        though no contiguous disruption-cost prefix worked -- the
+        multi-node shape the reference's descending-k loop cannot see.
+        Pairs come from solver/disrupt.enumerate_pairs over the cheapest
+        candidates (bounded window, (0, 1) excluded: that set IS the k=2
+        prefix already judged). The device batch pre-filters; deletion
+        verdicts apply directly (exact equivalence) while replacement
+        re-derives through the oracle -- and the oracle-only path runs
+        the same pair order through the same simulations, so decisions
+        agree with and without the engine."""
+        from karpenter_tpu.solver.disrupt import enumerate_pairs
+
+        def delete_pair(pair: List[Candidate]) -> None:
+            # _budget_allows_set above already proved both members fit the
+            # pool budgets with exactly this accumulation
+            for c in pair:
+                c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+                self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
+
+        def replace_pair(pair: List[Candidate]) -> bool:
+            ok, groups = self._simulate(pair, allow_new_node=True)
+            if ok and groups and self._replacement_cheaper(pair, groups):
+                for c in pair:
+                    c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+                self._replace_then_disrupt(pair, groups, REASON_UNDERUTILIZED, disrupting)
+                return True
+            return False
+
+        for i, j in enumerate_pairs(len(remaining)):
+            if len(self.last_decisions) >= max_disruptions:
+                return False
+            pair = [remaining[i], remaining[j]]
+            if not self._budget_allows_set(pair, disrupting, totals):
+                continue
+            if device_verdicts is not None:
+                v = device_verdicts.get(("pair", i, j))
+                if v is None:
+                    continue
+                if v.can_delete:
+                    # deletion decisions are oracle-equivalent
+                    # (differential tests): act without re-simulation
+                    delete_pair(pair)
+                    return True
+                if self._device_replacement_cheaper_multi(pair, v) and replace_pair(pair):
+                    return True
+                continue
+            # oracle path: same order, same checks
+            ok, _ = self._simulate(pair, allow_new_node=False)
+            if ok:
+                delete_pair(pair)
+                return True
+            if replace_pair(pair):
+                return True
+        return False
 
     def _device_prefix_verdicts(self, remaining: List[Candidate]):
-        """k -> SetVerdict for every prefix (k = 2..N of the disruption-cost
-        order), judged in ONE device dispatch with replacement context --
-        serves both the deletion decision and the multi-node replacement
-        price gate. None when any pod is device-ineligible (the oracle
-        loops judge prefixes themselves)."""
+        """Multi-node candidate-set batch, ONE device dispatch with
+        replacement context: a SetVerdict for every prefix (keyed k =
+        2..N of the disruption-cost order) AND every underutilized pair
+        (keyed ("pair", i, j) from solver/disrupt.enumerate_pairs) --
+        serves the deletion decisions, the multi-node replacement price
+        gate, and the pair stage. None when any pod is device-ineligible
+        (the oracle loops judge the same sets themselves)."""
         if self.evaluator is None or len(remaining) < 2:
             return None
+        from karpenter_tpu import metrics
         from karpenter_tpu.apis.storage import effective_pods
-        from karpenter_tpu.solver.consolidate import device_eligible
+        from karpenter_tpu.solver.disrupt import device_eligible, enumerate_pairs
 
         # same volume lowering as _device_verdicts: raw claim-carrying
         # pods would under-state attach demand in the prefix repacks
@@ -635,24 +821,35 @@ class DisruptionController:
             device_eligible(resched[c.claim.metadata.name]) for c in remaining
         ) or not device_eligible(in_flight):
             return None
-        sets = []
-        ks = []
-        for k in range(2, len(remaining) + 1):
-            prefix = remaining[:k]
-            sets.append(
-                (
-                    in_flight + [p for c in prefix for p in resched[c.claim.metadata.name]],
-                    [c.node.metadata.name for c in prefix],
-                )
+
+        def one_set(members: List[Candidate]):
+            return (
+                in_flight + [p for c in members for p in resched[c.claim.metadata.name]],
+                [c.node.metadata.name for c in members],
             )
-            ks.append(k)
+
+        sets = []
+        keys: List[object] = []
+        for k in range(2, len(remaining) + 1):
+            sets.append(one_set(remaining[:k]))
+            keys.append(k)
+        n_prefix = len(sets)
+        for i, j in enumerate_pairs(len(remaining)):
+            sets.append(one_set([remaining[i], remaining[j]]))
+            keys.append(("pair", i, j))
+        self._pass_set_counts["prefix"] = (
+            self._pass_set_counts.get("prefix", 0) + n_prefix)
+        self._pass_set_counts["pair"] = (
+            self._pass_set_counts.get("pair", 0) + len(sets) - n_prefix)
+        metrics.DISRUPTION_DEVICE_SETS.inc(n_prefix, kind="prefix")
+        metrics.DISRUPTION_DEVICE_SETS.inc(len(sets) - n_prefix, kind="pair")
         pools, catalogs = self._pool_context()
         verdicts = self.evaluator.evaluate(
             self._other_nodes(list(self._pass_disrupted)), sets,
             pools=pools, catalogs=catalogs,
             daemon_overhead=self._daemon_overhead(pools),
         )
-        return dict(zip(ks, verdicts))
+        return dict(zip(keys, verdicts))
 
     def _device_replacement_cheaper_multi(self, prefix: List[Candidate], v) -> bool:
         import math
@@ -665,7 +862,8 @@ class DisruptionController:
         return math.isfinite(price) and price < sum(c.price for c in prefix)
 
     def _largest_deletable_prefix(
-        self, remaining: List[Candidate], device_verdicts: Optional[Dict[int, object]] = None
+        self, remaining: List[Candidate],
+        device_verdicts: Optional[Dict[object, object]] = None,
     ) -> List[Candidate]:
         """Largest k such that candidates[0:k] can all be deleted with their
         pods repacked on surviving capacity. `device_verdicts` is the
@@ -689,14 +887,18 @@ class DisruptionController:
             k -= 1
         return []
 
-    def _device_verdicts(self, consolidatable: Sequence[Candidate]) -> Dict[str, object]:
+    def _device_verdicts(self, consolidatable: Sequence[Candidate],
+                         replacement: bool = True) -> Dict[str, object]:
         """One batched device evaluation of every eligible single-node
         candidate; ineligible candidates (stateful constraints) are absent
-        from the result and take the oracle path."""
+        from the result and take the oracle path. ``replacement=False``
+        (the brownout-bounded sweep) skips the per-pool replacement
+        context entirely: deletion verdicts only, minimum host encode."""
         if self.evaluator is None or not consolidatable:
             return {}
+        from karpenter_tpu import metrics
         from karpenter_tpu.apis.storage import effective_pods
-        from karpenter_tpu.solver.consolidate import device_eligible
+        from karpenter_tpu.solver.disrupt import device_eligible
 
         # volume-backed pods evaluate as their RESOLVED scheduling copies
         # (attach counts on the volume axis, bound zones as selector pins
@@ -725,12 +927,20 @@ class DisruptionController:
             sets.append((in_flight + resched, [c.node.metadata.name]))
         if not eligible:
             return {}
-        pools, catalogs = self._pool_context()
-        verdicts = self.evaluator.evaluate(
-            self._other_nodes(list(self._pass_disrupted)), sets,
-            pools=pools, catalogs=catalogs,
-            daemon_overhead=self._daemon_overhead(pools),
-        )
+        self._pass_set_counts["singleton"] = (
+            self._pass_set_counts.get("singleton", 0) + len(sets))
+        metrics.DISRUPTION_DEVICE_SETS.inc(len(sets), kind="singleton")
+        if replacement:
+            pools, catalogs = self._pool_context()
+            verdicts = self.evaluator.evaluate(
+                self._other_nodes(list(self._pass_disrupted)), sets,
+                pools=pools, catalogs=catalogs,
+                daemon_overhead=self._daemon_overhead(pools),
+            )
+        else:
+            verdicts = self.evaluator.evaluate(
+                self._other_nodes(list(self._pass_disrupted)), sets,
+            )
         return {c.claim.metadata.name: v for c, v in zip(eligible, verdicts)}
 
     def _device_replacement_cheaper(self, c: Candidate, v) -> bool:
@@ -891,6 +1101,8 @@ class DisruptionController:
         from karpenter_tpu.controllers.provisioner import Provisioner
         from karpenter_tpu.solver.oracle import SchedulingResult
 
+        from karpenter_tpu import failpoints
+
         if isinstance(cands, Candidate):
             cands = [cands]
         prov = Provisioner(self.cluster, self.cloud_provider)
@@ -899,5 +1111,12 @@ class DisruptionController:
         prov._launch(result)
         if result.unschedulable:
             return  # replacement did not materialize; try again next tick
+        # chaos site: a crash HERE is the half-applied verdict -- the
+        # replacement launched (journaled through the provisioner's
+        # intent path) but no victim deleted yet. The crash soak asserts
+        # the next incarnation's recovery sweep + consolidation passes
+        # converge with no pod lost, no orphan instance, and no node
+        # disrupted twice (tests/test_chaos.py).
+        failpoints.eval("crash.disruption.apply")
         for c in cands:
             self._disrupt(c, reason, disrupting)

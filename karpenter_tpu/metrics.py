@@ -243,6 +243,40 @@ DISRUPTION_EVAL_DURATION = REGISTRY.histogram(
     "karpenter_voluntary_disruption_decision_evaluation_duration_seconds",
     "Duration of one disruption evaluation pass",
 )
+# device-resident consolidation engine (solver/disrupt/)
+DISRUPTION_DEVICE_SETS = REGISTRY.counter(
+    "karpenter_disruption_device_sets_total",
+    "Consolidation candidate sets judged by the batched device evaluator, "
+    "by enumeration kind (singleton = one node; prefix = the k cheapest-"
+    "to-disrupt nodes together; pair = an underutilized pair outside the "
+    "prefix order)",
+    labels=("kind",),  # singleton | prefix | pair
+)
+DISRUPTION_DEVICE_DISPATCHES = REGISTRY.counter(
+    "karpenter_disruption_device_dispatches_total",
+    "Batched consolidation evaluations by dispatch route (wire = the "
+    "solve_disrupt op on the solver sidecar; local = the same kernels in "
+    "process -- also the breaker-open / wire-dead fallback route)",
+    labels=("path",),  # wire | local
+)
+DISRUPTION_DEVICE_FALLBACKS = REGISTRY.counter(
+    "karpenter_disruption_device_fallbacks_total",
+    "Consolidation evaluations that fell off the wire route to the "
+    "in-process kernels, by reason (decisions stay bit-identical; "
+    "rpc-down failures also count toward the shared circuit breaker)",
+    labels=("reason",),  # rpc-down | breaker-open | feature-missing
+)
+DISRUPTION_DEVICE_SWEEP_SECONDS = REGISTRY.histogram(
+    "karpenter_disruption_device_sweep_seconds",
+    "Wall time of one batched candidate-set evaluation (encode + "
+    "dispatch + verdict assembly, every set in one device pass)",
+)
+DISRUPTION_DEVICE_BOUNDED_SWEEPS = REGISTRY.counter(
+    "karpenter_disruption_device_bounded_sweeps_total",
+    "Brownout rung-1 disruption sweeps that ran the bounded singleton-"
+    "only device path instead of standing down entirely (the pre-device "
+    "rung-1 behavior, still taken when no device evaluator is wired)",
+)
 GARBAGE_COLLECTED = REGISTRY.counter(
     "karpenter_garbage_collected_instances_total",
     "Orphaned cloud instances terminated by garbage collection",

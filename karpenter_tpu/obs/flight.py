@@ -9,6 +9,7 @@ regardless of tracing state or brownout rung (rung 2 throttles trace
 system degrades -- test-pinned in tests/test_obs.py):
 
     {seq, t_mono_s, tick_ms, stages_ms, device_ms, hbm_*, dirty_fraction,
+     consolidation_ms, consolidation_mode, consolidation_sets,
      deferred_pods, shed_total, brownout_level, breaker, nodes_ready,
      pods_bound_total, crashed?}
 
@@ -190,7 +191,7 @@ STAGE_NAMES = (
 
 
 def build_tick_record(root_sp, t0: float, *, solver=None, brownout=None,
-                      breaker=None, crashed: bool = False,
+                      breaker=None, disruption=None, crashed: bool = False,
                       clock=None) -> Dict[str, Any]:
     """ONE tick's flight record, the single source of what a record
     contains: the operator's per-tick path (Operator._observe_tick) and
@@ -232,6 +233,18 @@ def build_tick_record(root_sp, t0: float, *, solver=None, brownout=None,
         rec["breaker"] = breaker.state
     if brownout is not None:
         rec["brownout_level"] = brownout.level
+    if disruption is not None:
+        # device-consolidation sweep (controllers/disruption.py
+        # last_sweep_stats): sweep mode + wall ms + candidate-set counts
+        # by enumeration kind -- the black box must show whether the
+        # rung-1 bounded sweep kept running through a brownout
+        st = getattr(disruption, "last_sweep_stats", None)
+        if st and "consolidation_ms" in st:
+            rec["consolidation_ms"] = st["consolidation_ms"]
+            rec["consolidation_mode"] = st.get("mode", "full")
+            sets = st.get("sets") or {}
+            if sets:
+                rec["consolidation_sets"] = dict(sets)
     rec["deferred_pods"] = int(metrics.OVERLOAD_DEFERRED.value())
     shed = {
         reason: int(metrics.OVERLOAD_SHED.value(reason=reason))

@@ -118,8 +118,9 @@ def _probe(entry: str, fn):
     probed._karpenter_jit_probe = True  # type: ignore[attr-defined]
     # __wrapped__ forwards what the jitted function itself exposes --
     # jax.jit sets it to the RAW Python function, and mesh.py re-jits
-    # exactly that with shardings (consolidate._repack.__wrapped__);
-    # pointing it at the jitted fn would silently build pjit-in-pjit
+    # exactly that with shardings (disrupt/kernel.disrupt_repack
+    # .__wrapped__); pointing it at the jitted fn would silently build
+    # pjit-in-pjit
     probed.__wrapped__ = getattr(fn, "__wrapped__", fn)  # type: ignore[attr-defined]
     probed.__name__ = getattr(fn, "__name__", entry)
     cache_size = getattr(fn, "_cache_size", None)

@@ -476,7 +476,7 @@ class Operator:
         try:
             flight.record(flight.build_tick_record(
                 root_sp, t0, solver=self.solver, brownout=self.brownout,
-                crashed=crashed,
+                disruption=self.disruption, crashed=crashed,
             ))
             if crashed:
                 flight.flush_blackbox(reason="operator-crashed")

@@ -20,7 +20,9 @@ operator tick a degraded-but-predictable mode instead, four pieces:
   nothing is lost, only delayed;
 - ``BrownoutController`` -- a fixed, documented shed ladder above the
   transport degrade ladder, driven by an EWMA of tick-budget overrun:
-  (1) consolidation/disruption sweeps stand down, (2) trace sampling
+  (1) consolidation/disruption sweeps downgrade to a bounded
+  singleton-only device pass (or stand down when no device engine is
+  wired), (2) trace sampling
   stops feeding the stats/metrics volume, (3) delta-epoch staging (and
   its restage retry roundtrips) stands down. Recovery is hysteretic
   (exit threshold below the enter threshold, plus a dwell) so the
@@ -162,7 +164,13 @@ class BrownoutController:
     deadline pressure, recovering hysteretically. Levels:
 
         0 normal           -- nothing shed
-        1 shed-disruption  -- consolidation/disruption sweeps stand down
+        1 shed-disruption  -- consolidation/disruption sweeps downgrade:
+                              with the batched device engine wired
+                              (solver/disrupt/), the sweep runs a BOUNDED
+                              singleton-only device pass (one dispatch
+                              over the cheapest candidates, deletion
+                              verdicts only) -- cheap enough to leave on;
+                              without it, the sweep stands down entirely
                               (controllers/disruption.py gates on this)
         2 shed-tracing     -- trace sampling stops feeding the per-span
                               stats/metrics volume, and an armed

@@ -34,6 +34,16 @@ def _trace_seed(events: List[dict], override: Optional[int]) -> int:
     return 0
 
 
+def _trace_backends(events: List[dict]):
+    """The header's differential backend restriction, or None for the
+    default trio. Scenarios whose main phase consolidates pin the
+    synchronous backends (sim/scenario.ScenarioBuilder.backends)."""
+    for ev in events:
+        if ev.get("ev") == "header" and isinstance(ev.get("backends"), list):
+            return tuple(str(b) for b in ev["backends"])
+    return None
+
+
 def _cmd_generate(args) -> int:
     from karpenter_tpu.sim.scenario import (
         CORPUS_SCENARIOS, DEFAULT_SEED, STANDARD_SCENARIOS, build_scenario,
@@ -72,7 +82,10 @@ def _cmd_replay(args) -> int:
     events = read_trace(args.trace)
     seed = _trace_seed(events, args.seed)
     if args.differential:
-        res = differential(events, seed=seed)
+        from karpenter_tpu.sim.replay import BACKENDS
+
+        res = differential(events, seed=seed,
+                           backends=_trace_backends(events) or BACKENDS)
         out = {
             "trace": args.trace, "mode": "differential", "seed": seed,
             "ok": res.ok,
@@ -156,7 +169,10 @@ def _cmd_corpus(args) -> int:
         name = os.path.splitext(os.path.basename(path))[0]
         events = read_trace(path)
         seed = _trace_seed(events, None)
-        res = differential(events, seed=seed)
+        from karpenter_tpu.sim.replay import BACKENDS
+
+        res = differential(events, seed=seed,
+                           backends=_trace_backends(events) or BACKENDS)
         host_digest = res.results["host"].digest if "host" in res.results else None
         entry = {
             "ok": res.ok,

@@ -165,6 +165,8 @@ class _Engine:
                 setattr(options, key, int(val))
         self._options = options
         breaker_rng = seeding.seeded_rng("breaker", self.seed).random
+        from karpenter_tpu.solver.disrupt import DisruptEngine
+
         if self.backend == "host":
             solver = TPUSolver(g_max=64)
         else:
@@ -192,9 +194,15 @@ class _Engine:
             solver = TPUSolver(g_max=64, client=self._client, breaker=self._breaker)
         # identity-based election: replay runs the REAL leadership flow
         # (lease, fencing epoch, recovery-on-win) so crash/restart events
-        # drive crash -> re-elect -> recover through the production stack
+        # drive crash -> re-elect -> recover through the production stack.
+        # The consolidation engine rides the backend: host replays run the
+        # in-process kernels, wire/pipelined replays dispatch the
+        # solve_disrupt op through the same solver client -- so the
+        # corpus's digest equality IS the host == wire == device verdict
+        # differential for every consolidation decision in the trace.
         self.op = Operator(
             clock=FakeClock(100_000.0), solver=solver, options=options,
+            consolidation_evaluator=DisruptEngine(solver=solver),
             identity=f"replay-{self.backend}-0",
         )
         self.op.cluster.create(TPUNodeClass("default"))
@@ -223,6 +231,7 @@ class _Engine:
         self.op = Operator(
             cloud=old.cloud, clock=old.clock, options=self._options,
             solver=old.solver, cluster=old.cluster,
+            consolidation_evaluator=old.disruption.evaluator,
             identity=f"replay-{self.backend}-{self._generation}",
         )
         objects._name_rng, objects._token_rng = name_rng, token_rng
@@ -232,7 +241,7 @@ class _Engine:
     # replay of a differential run (the registry is process-global)
     CRASH_SITES = (
         "crash.provisioner.dispatch", "crash.launch", "crash.bind",
-        "crash.termination", "crash.recovery",
+        "crash.termination", "crash.recovery", "crash.disruption.apply",
     )
 
     def close(self):
@@ -278,6 +287,11 @@ class _Engine:
         pod_hours = 0.0
         churn = 0
         nodes_peak = 0
+        # trough shape (the consolidation KPI): the hourly fleet price at
+        # its per-tick peak vs at convergence -- a fleet still paying the
+        # day's peak through the night shows final ~= peak
+        fleet_price_peak = 0.0
+        fleet_price_final = 0.0
         deleted_pods: set = set()
 
         # per-tick diff state
@@ -314,6 +328,7 @@ class _Engine:
 
         def do_tick(dt: float):
             nonlocal tick_i, fleet_cost, pod_hours, churn, nodes_peak
+            nonlocal fleet_price_peak, fleet_price_final
             nonlocal prev_pod_node, prev_claims, prev_nodes
             from karpenter_tpu.failpoints import OperatorCrashed
 
@@ -332,7 +347,10 @@ class _Engine:
             metrics.SIM_TICKS.inc(backend=self.backend)
             # KPI integration over this tick's dt
             nodes = cluster.list(Node)
-            fleet_cost += sum(node_price(n) for n in nodes) * dt / 3600.0
+            fleet_price = sum(node_price(n) for n in nodes)
+            fleet_price_peak = max(fleet_price_peak, fleet_price)
+            fleet_price_final = fleet_price
+            fleet_cost += fleet_price * dt / 3600.0
             bound = [p for p in cluster.list(Pod) if p.node_name]
             pod_hours += len(bound) * dt / 3600.0
             nodes_peak = max(nodes_peak, len(nodes))
@@ -524,6 +542,8 @@ class _Engine:
             "pending_latency_p99_s": round(_percentile(latencies, 99), 3),
             "node_churn": churn,
             "nodes_peak": nodes_peak,
+            "fleet_price_peak_per_h": round(fleet_price_peak, 6),
+            "fleet_price_final_per_h": round(fleet_price_final, 6),
             "pods_total": n_final + len(deleted_pods),
             "pods_bound_final": n_final,
             "sim_seconds": round(clock.now() - 100_000.0, 3),

@@ -55,6 +55,13 @@ class ScenarioBuilder:
         # whitelists the COUNT-based overload knobs (admission_max_pods,
         # launch_max_groups) -- see sim/trace.py
         self._options: Dict[str, float] = {}
+        # differential backend set carried in the header (None = the
+        # default trio). Scenarios whose MAIN phase consolidates restrict
+        # to the synchronous backends: in-phase consolidation churn on
+        # the pipelined backend legally picks different same-shaped
+        # survivors (the drain-phase precedent in sim/replay.py), so
+        # comparing its placements would flag a legal shift as a bug.
+        self._backends: Optional[Tuple[str, ...]] = None
 
     # -- primitives ----------------------------------------------------------
     def at(self, t: float, event: dict) -> "ScenarioBuilder":
@@ -82,6 +89,14 @@ class ScenarioBuilder:
         """Operator Options overrides for the replay, carried in the
         trace header (whitelisted there to the overload knobs)."""
         self._options.update(kw)
+        return self
+
+    def backends(self, *names: str) -> "ScenarioBuilder":
+        """Restrict this scenario's differential replay to the named
+        backends (carried in the trace header; the corpus gate honors
+        it). Use for scenarios whose main phase consolidates -- see the
+        _backends comment above."""
+        self._backends = tuple(names)
         return self
 
     # -- workload generators -------------------------------------------------
@@ -230,6 +245,7 @@ class ScenarioBuilder:
             "ev": "header", "version": TRACE_VERSION, "scenario": self.name,
             "seed": self.seed, "tick_seconds": self.tick_seconds,
             **({"options": dict(self._options)} if self._options else {}),
+            **({"backends": list(self._backends)} if self._backends else {}),
         }]
         if not self._timed:
             return events
@@ -323,6 +339,43 @@ def _scenario_crash_restart(seed: int) -> ScenarioBuilder:
     return b
 
 
+def _scenario_diurnal_consolidation(seed: int) -> ScenarioBuilder:
+    """Consolidation family: a diurnal ramp-down that leaves the fleet
+    underutilized. The day's peak builds nodes; the churn at the start of
+    the trough strands their survivors across too many of them; the quiet
+    tail (plus the drain) is where the batched consolidation engine must
+    fold the fleet back down. The differential corpus pins host == wire
+    == pipelined decision digests THROUGH the consolidation decisions
+    (every disrupted claim and replaced node is a decision-log line), and
+    tests/test_sim.py asserts the KPI shape: the hourly fleet price at
+    convergence sits below the peak, i.e. cost_per_pod_hour actually
+    drops in the trough instead of paying for the day's peak forever."""
+    b = ScenarioBuilder("diurnal-consolidation", seed)
+    b.diurnal(start=0.0, duration=90.0, base_rate=0.2, peak_rate=2.2)
+    # ramp-down into the trough: most of the peak's pods leave, their
+    # nodes stay -- the workload-shrinks-behind-us shape
+    b.pod_churn(t=120.0, fraction=0.55)
+    # a trough trickle keeps the fleet serving...
+    b.poisson_arrivals(start=150.0, duration=9.0, rate_per_s=0.2)
+    # ...and a DECISION-FREE timeline extension (a no-op price event)
+    # carries the quiet trough past MIN_NODE_LIFETIME for the day's
+    # nodes (5 min), so the consolidation age gate opens IN the trough
+    # and the fold-down is part of the pinned decision digest, not just
+    # drain-phase cleanup. An arrival here instead would overlap the
+    # consolidation window, where the pipelined tick's legal one-tick
+    # bind shift can change WHICH same-shaped node a pod lands on --
+    # chaos-in-quiet-windows discipline (module docstring) applies to
+    # consolidation exactly like it applies to kills.
+    b.price_shock(t=450.0, instance_types=_cheap_types(1), factor=1.0)
+    # synchronous backends only (plus the corpus's delta gate, which
+    # replays this trace): in-phase consolidation on the pipelined
+    # backend legally shifts WHICH same-shaped node survives, exactly
+    # like drain-phase churn -- invariants still hold there, but
+    # placement equality is a sync-backend contract for this family
+    b.backends("host", "wire")
+    return b
+
+
 def _scenario_overload_storm(seed: int) -> ScenarioBuilder:
     """Overload family: a sustained arrival storm well past what bounded
     admission takes per tick, plus a slow-sidecar latency window. The
@@ -340,6 +393,7 @@ def _scenario_overload_storm(seed: int) -> ScenarioBuilder:
 STANDARD_SCENARIOS = {
     "diurnal-small": _scenario_diurnal_small,
     "diurnal-medium": _scenario_diurnal_medium,
+    "diurnal-consolidation": _scenario_diurnal_consolidation,
     "ice-storm": _scenario_ice_storm,
     "interruption-wave": _scenario_interruption_wave,
     "spread-burst": _scenario_spread_burst,
@@ -351,7 +405,8 @@ STANDARD_SCENARIOS = {
 # the committed corpus (tests/golden/scenarios/): small, fast, and one per
 # chaos family; diurnal-medium stays generate-on-demand (bench's stage)
 CORPUS_SCENARIOS = (
-    "diurnal-small", "ice-storm", "interruption-wave", "overload-storm",
+    "diurnal-small", "diurnal-consolidation", "ice-storm",
+    "interruption-wave", "overload-storm",
 )
 DEFAULT_SEED = 20260803
 

@@ -1,359 +1,18 @@
-"""Batched consolidation-candidate evaluation on the accelerator.
+"""Back-compat shim: the batched consolidation evaluator moved to
+``karpenter_tpu/solver/disrupt/`` (the device-resident consolidation
+subsystem: kernels in ``disrupt/kernel.py``, host orchestration + the
+``solve_disrupt`` wire route in ``disrupt/engine.py``).
 
-The TPU reformulation of the disruption engine's candidate simulation
-(HOT LOOP #3, SURVEY.md section 3.2: for each candidate node (set), "can its
-pods reschedule onto the remaining nodes, plus at most one strictly cheaper
-new node?"). The reference evaluates candidates one at a time against a full
-scheduling simulation (designs/consolidation.md); here every candidate set
-is evaluated simultaneously:
-
-- the repack simulation is a vmap over candidate sets of a lax.scan over
-  FFD-ordered pod classes; the carry is the per-node remaining headroom
-  [N, R], and first-fit spill across nodes uses the same exclusive-cumsum
-  trick as the provisioning solver (solver/ffd.py)
-- node-level feasibility (labels, taints) is a [C, N] boolean mask computed
-  host-side from concrete node labels (nodes are few and labels are
-  concrete -- no bitset vocabulary needed on this side)
-- the one-new-node replacement search reduces to: which instance types are
-  compatible with EVERY leftover class and large enough for their aggregate
-  -- a masked min over the staged (type, zone, captype) price tensor
-
-Scope: candidate sets whose pods carry stateful constraints (hard topology
-spread, affinity terms, multi-term node affinity) are routed to the Python
-oracle by the disruption controller; for everything else this evaluator is
-differentially equivalent to oracle.Scheduler (tests/test_consolidate.py).
-
-Verdicts are *decisions* for deletion (equivalence is exact) and a
-*pre-filter plus price* for replacement: the controller re-derives the
-replacement group through the oracle for the one candidate it acts on,
-so N-candidate scans cost one device call instead of N full simulations.
+``ConsolidationEvaluator`` remains the historical name for
+``DisruptEngine`` -- same constructor, same ``evaluate`` contract -- so
+existing callers and tests keep working unchanged.
 """
-from __future__ import annotations
+from karpenter_tpu.solver.disrupt.engine import (  # noqa: F401
+    DisruptEngine,
+    SetVerdict,
+    _node_feasibility,
+    _with_pool_requirements,
+    device_eligible,
+)
 
-import functools
-from dataclasses import dataclass
-from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
-
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-
-from karpenter_tpu.apis import NodePool, Pod, labels as wk
-from karpenter_tpu.scheduling import Resources, tolerates_all
-from karpenter_tpu.scheduling import resources as res
-from karpenter_tpu.solver import encode
-from karpenter_tpu.solver.encode import CatalogTensors
-from karpenter_tpu.solver.oracle import ExistingNode
-
-# numpy scalar, NOT jnp: a module-level jnp constant would initialize the
-# XLA backend at import (see solver/ffd.py _INF)
-_INF = np.float32(np.inf)
-
-_bucket = encode.bucket
-
-
-# -- device kernels ----------------------------------------------------------
-
-@functools.partial(jax.jit, static_argnames=())
-def _repack(
-    headroom0: jax.Array,   # [N, R] f32 remaining capacity of surviving nodes
-    feas: jax.Array,        # [C, N] bool class-on-node feasibility
-    req: jax.Array,         # [C, R] f32 per-pod request (includes pods=1)
-    member: jax.Array,      # [S, C] i32 pods of class c in candidate set s
-    excl: jax.Array,        # [S, N] bool node n is being deleted by set s
-) -> Tuple[jax.Array, jax.Array]:
-    """([S, C] i32 leftovers, [S, C, N] i32 per-node placements): pods of
-    class c in set s packed first-fit-decreasing onto the surviving nodes
-    (node order = oracle order); leftover did not fit anywhere."""
-
-    def one_set(member_s: jax.Array, excl_s: jax.Array):
-        hr0 = jnp.where(excl_s[:, None], 0.0, headroom0)          # [N, R]
-
-        def step(hr, xs):
-            req_c, feas_c, count_c = xs
-            safe = jnp.where(req_c > 0, req_c, 1.0)               # [R]
-            per_axis = jnp.where(
-                req_c[None, :] > 0, jnp.floor(hr / safe[None, :]), _INF
-            )                                                     # [N, R]
-            fit = jnp.maximum(jnp.min(per_axis, axis=-1), 0.0)    # [N]
-            fit = jnp.where(feas_c, fit, 0.0).astype(jnp.int32)
-            cum_before = jnp.cumsum(fit) - fit
-            take = jnp.clip(count_c - cum_before, 0, fit)         # [N]
-            hr2 = hr - take[:, None].astype(jnp.float32) * req_c[None, :]
-            return hr2, (count_c - jnp.sum(take), take)
-
-        _, (leftover, takes) = jax.lax.scan(step, hr0, (req, feas, member_s))
-        return leftover, takes                                    # [C], [C, N]
-
-    return jax.vmap(one_set)(member, excl)
-
-
-@functools.partial(jax.jit, static_argnames=())
-def _replacement_search(
-    leftover: jax.Array,    # [S, C] i32
-    req: jax.Array,         # [C, R] f32
-    compat: jax.Array,      # [C, K] bool class-type compat (pool ctx included)
-    azone: jax.Array,       # [C, Z] bool
-    acap: jax.Array,        # [C, CT] bool
-    cap: jax.Array,         # [K, R] f32
-    price: jax.Array,       # [K, Z, CT] f32 (+inf when unavailable)
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Cheapest single new node that absorbs every leftover pod of each set.
-    Returns (best_price [S], best_od_price [S], best_type [S] i32, -1 none).
-    A type qualifies iff it is compatible with every leftover class and its
-    capacity covers the aggregate leftover request; the offering must sit in
-    a zone/captype admitted by every leftover class."""
-    need = leftover > 0                                           # [S, C]
-    agg = jnp.einsum("sc,cr->sr", leftover.astype(jnp.float32), req)
-    ok_type = ~jnp.einsum("sc,ck->sk", need, ~compat)             # [S, K] no violator
-    fits = jnp.all(cap[None, :, :] >= agg[:, None, :], axis=-1)   # [S, K]
-    ok_type = ok_type & fits & jnp.any(need, axis=-1)[:, None]
-    zone_ok = ~jnp.einsum("sc,cz->sz", need, ~azone)              # [S, Z]
-    cap_ok = ~jnp.einsum("sc,ct->st", need, ~acap)                # [S, CT]
-    masked = jnp.where(
-        ok_type[:, :, None, None]
-        & zone_ok[:, None, :, None]
-        & cap_ok[:, None, None, :],
-        price[None, :, :, :],
-        _INF,
-    )                                                             # [S, K, Z, CT]
-    S, K, Z, CTn = masked.shape
-    flat = masked.reshape(S, -1)
-    best_price = jnp.min(flat, axis=-1)
-    best_type = jnp.where(
-        jnp.isfinite(best_price), (jnp.argmin(flat, axis=-1) // (Z * CTn)).astype(jnp.int32), -1
-    )
-    od = encode.CAPTYPE_INDEX[wk.CAPACITY_TYPE_ON_DEMAND]
-    best_od_price = jnp.min(masked[:, :, :, od].reshape(S, -1), axis=-1)
-    return best_price, best_od_price, best_type
-
-
-# -- host-side encoding + evaluator ------------------------------------------
-
-@dataclass
-class SetVerdict:
-    """Device verdict for one candidate set."""
-
-    can_delete: bool
-    leftover: int                      # pods that did not fit existing nodes
-    replace_price: float               # cheapest single-new-node price (inf none)
-    replace_od_price: float            # cheapest on-demand-only price (inf none)
-    replace_type: Optional[str]        # instance type name (None when inf)
-    nodepool: Optional[str]            # pool the replacement came from
-
-
-def _node_feasibility(
-    classes: Sequence[encode.PodClass], nodes: Sequence[ExistingNode],
-    class_zone_pins: bool = False,
-) -> np.ndarray:
-    """[C, N] bool: a pod of class c may land on node n (labels + taints).
-    Mirrors oracle._try_existing's compatibility gate. With
-    `class_zone_pins`, a SPREAD SUB-CLASS's pinned zone (the split pass
-    marks these env_count == 0) additionally gates the node's zone -- the
-    oracle's pinned-zone node-packing rule. Ordinary classes stay
-    pool-agnostic: a pool-derived zone requirement must not block packing
-    onto live capacity the oracle would use."""
-    C, N = len(classes), len(nodes)
-    out = np.zeros((C, N), dtype=bool)
-    for ci, pc in enumerate(classes):
-        pod = pc.pods[0]
-        zreq = (
-            pc.requirements.get(wk.ZONE_LABEL)
-            if class_zone_pins and pc.env_count == 0
-            else None
-        )
-        for ni, node in enumerate(nodes):
-            if not tolerates_all(pod.tolerations, node.taints):
-                continue
-            if zreq is not None:
-                node_zone = node.labels.get(wk.ZONE_LABEL)
-                if node_zone is None or not zreq.matches(node_zone):
-                    continue
-            out[ci, ni] = any(
-                alt.matches_labels(node.labels) for alt in pod.scheduling_requirements()
-            )
-    return out
-
-
-class ConsolidationEvaluator:
-    """Evaluates many consolidation candidate sets in one device dispatch.
-
-    Replacement context comes from the nodepools in weight order: the first
-    pool whose catalog admits a feasible replacement wins (the oracle's
-    pool-iteration order in _open_group)."""
-
-    def __init__(self, mesh=None):
-        # optional jax.sharding.Mesh: candidate sets are data-parallel
-        # across devices (parallel/mesh.sharded_repack); None = single chip
-        self.mesh = mesh
-        # keyed by object identity; holds the items list so the id stays valid
-        self._catalog_cache: Dict[int, Tuple[list, CatalogTensors]] = {}
-
-    def _catalog_tensors(self, items: list) -> CatalogTensors:
-        key = id(items)
-        hit = self._catalog_cache.get(key)
-        if hit is None:
-            if len(self._catalog_cache) > 8:  # bound it; evict oldest entry
-                self._catalog_cache.pop(next(iter(self._catalog_cache)))
-            hit = self._catalog_cache[key] = (items, encode.encode_catalog(items))
-        return hit[1]
-
-    def evaluate(
-        self,
-        nodes: Sequence[ExistingNode],
-        sets: Sequence[Tuple[Sequence[Pod], Sequence[str]]],
-        pools: Sequence[NodePool] = (),
-        catalogs: Optional[Dict[str, list]] = None,
-        daemon_overhead: Optional[Dict[str, "Resources"]] = None,
-    ) -> List[SetVerdict]:
-        """nodes: surviving-capacity snapshot (oracle node order).
-        sets: per candidate set, (pods to repack, names of excluded nodes).
-        pools/catalogs: replacement context (optional; omit for delete-only).
-        daemon_overhead: per-pool fresh-node reserve (apis/daemonset) --
-        a replacement node must fit the leftovers PLUS its daemonsets.
-
-        On the jax-discipline hot-path manifest (DEVICE_HOT_PATH) and a
-        SANCTIONED_FETCH site: the np.asarray fetches below are this
-        path's designed host barriers (async-prefetched); any other sync
-        added here is a lint violation.
-        """
-        if not sets:
-            return []
-        all_pods = [p for pods, _ in sets for p in pods]
-        if not all_pods:
-            return [
-                SetVerdict(True, 0, float("inf"), float("inf"), None, None) for _ in sets
-            ]
-        classes = encode.group_pods(all_pods)
-        key_of = {pc.key: i for i, pc in enumerate(classes)}
-
-        C = _bucket(len(classes))
-        N = _bucket(max(1, len(nodes)), lo=16)
-        S = _bucket(len(sets))
-        if self.mesh is not None and S % self.mesh.size:
-            # the sharded set axis must divide evenly across devices
-            S = ((S + self.mesh.size - 1) // self.mesh.size) * self.mesh.size
-        R = encode.R
-
-        req = np.zeros((C, R), dtype=np.float32)
-        for i, pc in enumerate(classes):
-            req[i] = pc.requests
-        feas = np.zeros((C, N), dtype=bool)
-        feas[: len(classes), : len(nodes)] = _node_feasibility(classes, nodes)
-        headroom = np.zeros((N, R), dtype=np.float32)
-        for ni, node in enumerate(nodes):
-            headroom[ni] = encode.scale_vector(node.remaining().to_vector())
-
-        member = np.zeros((S, C), dtype=np.int32)
-        excl = np.zeros((S, N), dtype=bool)
-        name_to_idx = {n.name: i for i, n in enumerate(nodes)}
-        for si, (pods, excluded) in enumerate(sets):
-            for p in pods:
-                pc_reqs = p.scheduling_requirements()[0]
-                k = encode._class_key(p, pc_reqs)
-                member[si, key_of[k]] += 1
-            for name in excluded:
-                ni = name_to_idx.get(name)
-                if ni is not None:
-                    excl[si, ni] = True
-
-        if self.mesh is not None:
-            from karpenter_tpu.parallel.mesh import sharded_repack
-
-            leftover, _ = sharded_repack(self.mesh, headroom, feas, req, member, excl)
-        else:
-            leftover, _ = _repack(headroom, feas, req, member, excl)
-        if hasattr(leftover, "copy_to_host_async"):
-            # one async D2H issued at dispatch (a synchronous fetch over a
-            # tunneled device costs a flat ~64 ms RTT; see service.solve)
-            leftover.copy_to_host_async()
-        leftover = np.asarray(leftover)
-        left_total = leftover.sum(axis=1)
-
-        verdicts = [
-            SetVerdict(
-                can_delete=bool(left_total[si] == 0),
-                leftover=int(left_total[si]),
-                replace_price=float("inf"),
-                replace_od_price=float("inf"),
-                replace_type=None,
-                nodepool=None,
-            )
-            for si in range(len(sets))
-        ]
-
-        # replacement search per pool, weight order, first feasible pool wins
-        pending = [si for si in range(len(sets)) if left_total[si] > 0]
-        if not pending or not pools or not catalogs:
-            return verdicts
-        for pool in sorted(pools, key=lambda p: -p.weight):
-            items = catalogs.get(pool.name) or []
-            if not items:
-                continue
-            catalog = self._catalog_tensors(items)
-            cs = encode.encode_classes(
-                _with_pool_requirements(classes, pool), catalog,
-                # template.taints ONLY: startup taints lift before pods land
-                # (provisioner.py:68), and the oracle's _open_group gates on
-                # exactly this set -- including startup taints here would
-                # wrongly report inf replacement price for pods that do not
-                # tolerate them (ADVICE round 1, medium)
-                pool_taints=list(pool.template.taints),
-                c_pad=C,
-            )
-            compat = encode.compat_matrix(catalog, cs)
-            cap_eff = catalog.cap
-            ovh = (daemon_overhead or {}).get(pool.name)
-            if ovh is not None:
-                ovh_vec = encode.scale_vector(ovh.to_vector()).astype(np.float32)
-                if np.any(ovh_vec):
-                    cap_eff = np.maximum(cap_eff - ovh_vec[None, :], np.float32(0.0))
-            out = _replacement_search(
-                jnp.asarray(leftover), jnp.asarray(cs.req), jnp.asarray(compat),
-                jnp.asarray(cs.azone), jnp.asarray(cs.acap),
-                jnp.asarray(cap_eff), jnp.asarray(catalog.price),
-            )
-            for x in out:
-                if hasattr(x, "copy_to_host_async"):
-                    x.copy_to_host_async()  # overlap the three fetches
-            best, best_od, best_k = (np.asarray(x) for x in out)
-            still = []
-            for si in pending:
-                if np.isfinite(best[si]):
-                    verdicts[si] = SetVerdict(
-                        can_delete=False,
-                        leftover=int(left_total[si]),
-                        replace_price=float(best[si]),
-                        replace_od_price=float(best_od[si]),
-                        replace_type=catalog.names[int(best_k[si])],
-                        nodepool=pool.name,
-                    )
-                else:
-                    still.append(si)
-            pending = still
-            if not pending:
-                break
-        return verdicts
-
-
-def _with_pool_requirements(classes: Sequence[encode.PodClass], pool: NodePool) -> List[encode.PodClass]:
-    """Re-derive each class's requirements merged with the pool's (the class
-    set was grouped pool-agnostically; replacement compat is per-pool).
-    One shared implementation with the provisioning path -- merge
-    orientation is immaterial because Requirement.intersect is commutative
-    in every branch (set ops + symmetric min/max windows)."""
-    return encode.with_extra_requirements(classes, pool.requirements())
-
-
-def device_eligible(pods: Sequence[Pod]) -> bool:
-    """True when every pod is free of the stateful constraints the batch
-    evaluator does not model (routing mirror of solver/service.py)."""
-    for p in pods:
-        if p.affinity_terms or p.preferred_node_affinity_terms or p.preferred_affinity_terms:
-            return False
-        if any(t.hard() for t in p.topology_spread):
-            return False
-        if len(p.scheduling_requirements()) != 1:
-            return False
-    return True
+ConsolidationEvaluator = DisruptEngine

@@ -1,0 +1,28 @@
+"""Device-resident consolidation engine (the disruption solve).
+
+The batched candidate-set evaluator the disruption controller drives:
+enumerate candidate node sets (singletons, price-ranked multi-node
+prefixes, underutilized pairs), fit-check every evicted pod against the
+surviving capacity AND the replacement instance-type options in one
+masked device pass, and return per-set verdicts (delete /
+replace-cheaper / blocked, with replacement type and savings) from a
+single dispatch.
+
+Layout:
+
+- ``kernel.py``  -- the jitted device kernels (``disrupt_repack``,
+  ``disrupt_replace``), registered in the jax-discipline manifests;
+- ``engine.py``  -- ``DisruptEngine``: host-side encoding, candidate-set
+  enumeration helpers, the wire dispatch (``solve_disrupt`` on the
+  sidecar, reusing staged catalog seqnums), and the in-process fallback
+  that keeps decisions bit-identical through the breaker/degrade ladder.
+
+``solver/consolidate.py`` remains as the back-compat shim re-exporting
+this package's public names.
+"""
+from karpenter_tpu.solver.disrupt.engine import (  # noqa: F401
+    DisruptEngine,
+    SetVerdict,
+    device_eligible,
+    enumerate_pairs,
+)

@@ -1,0 +1,117 @@
+"""Device kernels for the batched consolidation solve.
+
+The TPU reformulation of the disruption engine's candidate simulation
+(HOT LOOP #3, SURVEY.md section 3.2: for each candidate node (set), "can
+its pods reschedule onto the remaining nodes, plus at most one strictly
+cheaper new node?"). The reference evaluates candidates one at a time
+against a full scheduling simulation (designs/consolidation.md); here
+every candidate set is evaluated simultaneously:
+
+- ``disrupt_repack``: the repack simulation is a vmap over candidate
+  sets of a lax.scan over FFD-ordered pod classes; the carry is the
+  per-node remaining headroom [N, R], and first-fit spill across nodes
+  uses the same exclusive-cumsum trick as the provisioning solver
+  (solver/ffd.py);
+- ``disrupt_replace``: the one-new-node replacement search reduces to:
+  which instance types are compatible with EVERY leftover class and
+  large enough for their aggregate -- a masked min over the staged
+  (type, zone, captype) price tensor. The daemonset overhead vector is
+  subtracted INSIDE the kernel so the host-fallback and the wire path
+  compute cap_eff identically (bit-identity by construction).
+
+Both kernels run identically on the sidecar (solver/rpc.py
+``solve_disrupt``, against the catalog staged per seqnum) and in process
+(the breaker-open / wire-dead fallback), so the differential contract --
+host == wire == device verdicts -- holds the same way it does for the
+provisioning solve.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# numpy scalar, NOT jnp: a module-level jnp constant would initialize the
+# XLA backend at import (see solver/ffd.py _INF)
+_INF = np.float32(np.inf)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def disrupt_repack(
+    headroom0: jax.Array,   # [N, R] f32 remaining capacity of surviving nodes
+    feas: jax.Array,        # [C, N] bool class-on-node feasibility
+    req: jax.Array,         # [C, R] f32 per-pod request (includes pods=1)
+    member: jax.Array,      # [S, C] i32 pods of class c in candidate set s
+    excl: jax.Array,        # [S, N] bool node n is being deleted by set s
+) -> Tuple[jax.Array, jax.Array]:
+    """([S, C] i32 leftovers, [S, C, N] i32 per-node placements): pods of
+    class c in set s packed first-fit-decreasing onto the surviving nodes
+    (node order = oracle order); leftover did not fit anywhere."""
+
+    def one_set(member_s: jax.Array, excl_s: jax.Array):
+        hr0 = jnp.where(excl_s[:, None], 0.0, headroom0)          # [N, R]
+
+        def step(hr, xs):
+            req_c, feas_c, count_c = xs
+            safe = jnp.where(req_c > 0, req_c, 1.0)               # [R]
+            per_axis = jnp.where(
+                req_c[None, :] > 0, jnp.floor(hr / safe[None, :]), _INF
+            )                                                     # [N, R]
+            fit = jnp.maximum(jnp.min(per_axis, axis=-1), 0.0)    # [N]
+            fit = jnp.where(feas_c, fit, 0.0).astype(jnp.int32)
+            cum_before = jnp.cumsum(fit) - fit
+            take = jnp.clip(count_c - cum_before, 0, fit)         # [N]
+            hr2 = hr - take[:, None].astype(jnp.float32) * req_c[None, :]
+            return hr2, (count_c - jnp.sum(take), take)
+
+        _, (leftover, takes) = jax.lax.scan(step, hr0, (req, feas, member_s))
+        return leftover, takes                                    # [C], [C, N]
+
+    return jax.vmap(one_set)(member, excl)
+
+
+@functools.partial(jax.jit, static_argnames=("od_col",))
+def disrupt_replace(
+    leftover: jax.Array,    # [S, C] i32
+    req: jax.Array,         # [C, R] f32
+    compat: jax.Array,      # [C, K] bool class-type compat (pool ctx included)
+    azone: jax.Array,       # [C, Z] bool
+    acap: jax.Array,        # [C, CT] bool
+    cap: jax.Array,         # [K, R] f32 raw type capacity (staged per seqnum)
+    ovh: jax.Array,         # [R] f32 per-pool fresh-node daemonset reserve
+    price: jax.Array,       # [K, Z, CT] f32 (+inf when unavailable)
+    *,
+    od_col: int,            # on-demand captype column (closed vocabulary)
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Cheapest single new node that absorbs every leftover pod of each set.
+    Returns (best_price [S], best_od_price [S], best_type [S] i32, -1 none).
+    A type qualifies iff it is compatible with every leftover class and its
+    overhead-adjusted capacity covers the aggregate leftover request; the
+    offering must sit in a zone/captype admitted by every leftover class."""
+    cap_eff = jnp.maximum(cap - ovh[None, :], 0.0)                # [K, R]
+    need = leftover > 0                                           # [S, C]
+    agg = jnp.einsum("sc,cr->sr", leftover.astype(jnp.float32), req)
+    ok_type = ~jnp.einsum("sc,ck->sk", need, ~compat)             # [S, K] no violator
+    fits = jnp.all(cap_eff[None, :, :] >= agg[:, None, :], axis=-1)   # [S, K]
+    ok_type = ok_type & fits & jnp.any(need, axis=-1)[:, None]
+    zone_ok = ~jnp.einsum("sc,cz->sz", need, ~azone)              # [S, Z]
+    cap_ok = ~jnp.einsum("sc,ct->st", need, ~acap)                # [S, CT]
+    masked = jnp.where(
+        ok_type[:, :, None, None]
+        & zone_ok[:, None, :, None]
+        & cap_ok[:, None, None, :],
+        price[None, :, :, :],
+        _INF,
+    )                                                             # [S, K, Z, CT]
+    S, K, Z, CTn = masked.shape
+    flat = masked.reshape(S, -1)
+    best_price = jnp.min(flat, axis=-1)
+    best_type = jnp.where(
+        jnp.isfinite(best_price), (jnp.argmin(flat, axis=-1) // (Z * CTn)).astype(jnp.int32), -1
+    )
+    best_od_price = jnp.min(masked[:, :, :, od_col].reshape(S, -1), axis=-1)
+    return best_price, best_od_price, best_type

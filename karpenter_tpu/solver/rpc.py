@@ -406,10 +406,15 @@ class SolverServer:
         # the full class tensor set as of that epoch, patched row-wise by
         # delta solves. Same bounded-LRU discipline as the catalog staging.
         self._epochs: Dict[str, Dict[str, np.ndarray]] = {}
+        # disrupt leftover epochs (solve_disrupt): depoch id -> [S, C]
+        # leftover tensor from a repack pass, referenced by the same
+        # sweep's per-pool replacement passes so they ship only the class
+        # masks. Same bounded-LRU + pressure-eviction discipline.
+        self._disrupt: Dict[str, np.ndarray] = {}
         # eviction accounting (the LRUs used to evict silently): mirrored
         # into karpenter_solver_staged_evictions_total and served by the
         # "debug" op for the true sidecar topology
-        self._evictions = {"catalog": 0, "class_epoch": 0}
+        self._evictions = {"catalog": 0, "class_epoch": 0, "disrupt": 0}
         self._lock = threading.Lock()
         # TLS-handshake budget (was a hardcoded 30s): a peer stalling the
         # handshake holds one daemon thread, never the accept loop, but the
@@ -560,7 +565,10 @@ class SolverServer:
                 # back -- e.g. taint-gated merged batches to the oracle
                 # (service._try_solve_merged) rather than silently packing
                 # without the join_allowed gate
-                features = ["join_allowed", "trace_echo", "solve_delta", "reply_v2"]
+                features = [
+                    "join_allowed", "trace_echo", "solve_delta", "reply_v2",
+                    "solve_disrupt",
+                ]
                 if self._shm_enabled:
                     features.append("shm")
                 _send_frame(sock, {"ok": True, "features": features})
@@ -572,6 +580,8 @@ class SolverServer:
                 self._op_solve_compact(sock, header, tensors, wt)
             elif op == "solve_delta":
                 self._op_solve_delta(sock, header, tensors, wt)
+            elif op == "solve_disrupt":
+                self._op_solve_disrupt(sock, header, tensors, wt)
             elif op == "debug":
                 self._op_debug(sock)
             else:
@@ -654,9 +664,14 @@ class SolverServer:
         agree. Caller holds the lock."""
         catalog = sum(obs_hbm.sum_nbytes(e) for e in self._staged.values())
         epochs = sum(obs_hbm.sum_nbytes(e) for e in self._epochs.values())
+        disrupt = sum(obs_hbm.sum_nbytes(e) for e in self._disrupt.values())
         metrics.SOLVER_STAGED_BYTES.set(float(catalog), kind="catalog")
         metrics.SOLVER_STAGED_BYTES.set(float(epochs), kind="class_epoch")
-        return {"catalog": int(catalog), "class_epoch": int(epochs)}
+        metrics.SOLVER_STAGED_BYTES.set(float(disrupt), kind="disrupt")
+        return {
+            "catalog": int(catalog), "class_epoch": int(epochs),
+            "disrupt": int(disrupt),
+        }
 
     def _evict_for_pressure_locked(self) -> None:
         """Memory-pressure eviction (obs/hbm.py): headroom below the
@@ -665,7 +680,7 @@ class SolverServer:
         capacity of 4 -- dropping the references releases the device
         buffers. No allocator ledger (CPU) = capacity-only, as before.
         Caller holds the lock; under_pressure's poll is rate-limited."""
-        if len(self._staged) <= 1 and len(self._epochs) <= 1:
+        if len(self._staged) <= 1 and len(self._epochs) <= 1 and len(self._disrupt) <= 1:
             return
         if not obs_hbm.under_pressure():
             return
@@ -679,6 +694,11 @@ class SolverServer:
             self._evictions["class_epoch"] += 1
             metrics.SOLVER_STAGED_EVICTIONS.inc(kind="class_epoch")
             metrics.SOLVER_STAGED_PRESSURE_EVICTIONS.inc(kind="class_epoch")
+        while len(self._disrupt) > 1:
+            self._disrupt.pop(next(iter(self._disrupt)))
+            self._evictions["disrupt"] += 1
+            metrics.SOLVER_STAGED_EVICTIONS.inc(kind="disrupt")
+            metrics.SOLVER_STAGED_PRESSURE_EVICTIONS.inc(kind="disrupt")
 
     def _op_debug(self, sock) -> None:
         """Staging observability: what the LRUs hold, their bytes by
@@ -690,6 +710,7 @@ class SolverServer:
                 "ok": True,
                 "staged_seqnums": list(self._staged),
                 "class_epochs": list(self._epochs),
+                "disrupt_epochs": list(self._disrupt),
                 "evictions": dict(self._evictions),
                 "staged_bytes": self._staged_bytes_locked(),
             }
@@ -787,10 +808,10 @@ class SolverServer:
             self._staged_bytes_locked()
         return full
 
-    def _staged_inputs(self, sock, header: dict, t: Dict[str, np.ndarray]):
-        """(entry, SolveInputs) for the staged catalog named by the header's
-        seqnum (LRU-touched), or None after sending the unknown-seqnum error
-        (the client re-stages on that contract)."""
+    def _staged_entry(self, sock, header: dict) -> Optional[_StagedEntry]:
+        """The staged catalog named by the header's seqnum (LRU-touched),
+        or None after sending the unknown-seqnum error (the client
+        re-stages on that contract)."""
         seqnum = str(header["seqnum"])
         with self._lock:
             entry = self._staged.get(seqnum)
@@ -801,6 +822,13 @@ class SolverServer:
                 self._staged[seqnum] = entry
         if entry is None:
             _send_frame(sock, {"ok": False, "error": "unknown-seqnum"})
+        return entry
+
+    def _staged_inputs(self, sock, header: dict, t: Dict[str, np.ndarray]):
+        """(entry, SolveInputs) for the staged catalog named by the header's
+        seqnum, or None after sending the unknown-seqnum error."""
+        entry = self._staged_entry(sock, header)
+        if entry is None:
             return None
         inp = ffd.SolveInputs(
             cap=entry.staged.cap, tcode=entry.staged.tcode, tnum=entry.staged.tnum,
@@ -896,6 +924,84 @@ class SolverServer:
             sock, {"ok": True, **wt.echo()},
             [(n, np.atleast_1d(np.asarray(a))) for n, a in zip(names, arrays)],
         )
+
+    def _op_solve_disrupt(self, sock, header: dict, t: Dict[str, np.ndarray],
+                          wt: Optional[tracing.WireTrace] = None) -> None:
+        """Batched consolidation solve (solver/disrupt): one repack of
+        every candidate set against the surviving headroom, plus an
+        optional replacement search against the catalog ALREADY STAGED
+        under the header's seqnum -- the capacity/price tensors never
+        re-ship. The repacked leftover stages under the header's
+        ``depoch`` so the same sweep's later per-pool replacement passes
+        ship only the [C, K] class masks (a shipped ``leftover`` tensor
+        is the fallback when the depoch was pressure-evicted mid-sweep,
+        keeping the op stateless-correct). Kernels are the same jit
+        entries the in-process fallback runs, so host == wire verdicts
+        hold by construction."""
+        import jax
+
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
+
+        wt = wt or tracing.WireTrace(None)
+        depoch = header.get("depoch")
+        reply: List[Tuple[str, np.ndarray]] = []
+        if "member" in t:  # the repack half
+            with wt.stage("device", op="solve_disrupt"):
+                lo, _ = disrupt_kernel.disrupt_repack(
+                    t["headroom"], t["feas"], t["req"], t["member"], t["excl"]
+                )
+                if wt.ctx is not None:
+                    # see _op_solve: sync traced requests so XLA compute
+                    # lands in "device", not "fetch"
+                    jax.block_until_ready(lo)
+            with wt.stage("fetch"):
+                # SANCTIONED_FETCH (jax_discipline): the disrupt op's host barrier
+                leftover = np.asarray(jax.device_get(lo))
+            if depoch is not None:
+                with self._lock:
+                    self._disrupt[str(depoch)] = leftover
+                    while len(self._disrupt) > 4:
+                        self._disrupt.pop(next(iter(self._disrupt)))
+                        self._evictions["disrupt"] += 1
+                        metrics.SOLVER_STAGED_EVICTIONS.inc(kind="disrupt")
+                    self._evict_for_pressure_locked()
+                    self._staged_bytes_locked()
+            reply.append(("leftover", leftover))
+        else:  # replacement-only pass of an in-flight sweep
+            leftover = None
+            if depoch is not None:
+                with self._lock:
+                    leftover = self._disrupt.get(str(depoch))
+                    if leftover is not None:  # LRU touch
+                        self._disrupt.pop(str(depoch))
+                        self._disrupt[str(depoch)] = leftover
+            if leftover is None:
+                leftover = t.get("leftover")
+            if leftover is None:
+                _send_frame(sock, {"ok": False, "error": "unknown-depoch"})
+                return
+        if "compat" in t:  # the replacement half, against the staged catalog
+            entry = self._staged_entry(sock, header)
+            if entry is None:
+                return
+            od_col = int(encode.CAPTYPE_INDEX[wk.CAPACITY_TYPE_ON_DEMAND])
+            with wt.stage("device", op="disrupt_replace"):
+                out = disrupt_kernel.disrupt_replace(
+                    leftover, t["creq"], t["compat"], t["azone"], t["acap"],
+                    entry.staged.cap, t["ovh"], entry.staged.price,
+                    od_col=od_col,
+                )
+                if wt.ctx is not None:
+                    jax.block_until_ready(out)
+            with wt.stage("fetch"):
+                # SANCTIONED_FETCH (jax_discipline): the replace half's barrier
+                arrays = jax.device_get(tuple(out))
+            reply.extend(
+                (n, np.atleast_1d(np.asarray(a)))
+                for n, a in zip(("best", "best_od", "best_k"), arrays)
+            )
+        _send_frame(sock, {"ok": True, **wt.echo()}, reply)
 
 
 # -- client ------------------------------------------------------------------
@@ -1657,6 +1763,65 @@ class SolverClient:
         }
         resp, out = self._solve_op(header, seqnum, catalog, class_set)
         return self._compact_from_reply(resp, out, g_max)
+
+    # -- batched consolidation (solver/disrupt, the solve_disrupt op) ---------
+    def _disrupt_roundtrip(self, header: dict, tensors, seqnum, catalog):
+        """stage-if-needed + solve + one unknown-seqnum restage retry:
+        the disrupt op's staging ladder, the same contract as _solve_op
+        (the depoch fallback tensor makes a lost disrupt epoch a
+        non-error, so only the catalog gap needs a rung)."""
+        with self._lock:  # atomic stage-then-solve (reentrant)
+            if seqnum is not None and seqnum not in self._staged_seqnums:
+                self.stage_catalog(seqnum, catalog)
+            resp, out = self._roundtrip(header, tensors)
+            if (
+                not resp.get("ok") and resp.get("error") == "unknown-seqnum"
+                and seqnum is not None
+            ):
+                # sidecar restarted / evicted: re-stage once and retry
+                self.stage_catalog(seqnum, catalog)
+                resp, out = self._roundtrip(header, tensors)
+            if not resp.get("ok"):
+                raise RuntimeError(f"solve_disrupt failed: {resp.get('error')}")
+            tracing.TRACER.graft(resp)
+            return out
+
+    def solve_disrupt_repack(
+        self, repack: Dict[str, np.ndarray], *,
+        seqnum: Optional[str] = None, catalog=None,
+        replace: Optional[Dict[str, np.ndarray]] = None,
+    ):
+        """Dispatch one batched consolidation repack (and, when `replace`
+        names a staged catalog context, the first pool's replacement
+        search in the same roundtrip). Returns (depoch, reply tensors):
+        the depoch names the leftover tensor now staged sidecar-side for
+        this sweep's later replacement passes."""
+        failpoints.eval("rpc.disrupt.dispatch")
+        with self._lock:
+            depoch = self._next_epoch()
+            header = {"op": "solve_disrupt", "depoch": depoch}
+            tensors = list(repack.items())
+            if replace is not None and seqnum is not None:
+                header["seqnum"] = seqnum
+                tensors += list(replace.items())
+            out = self._disrupt_roundtrip(header, tensors, seqnum, catalog)
+            return depoch, out
+
+    def solve_disrupt_replace(
+        self, depoch: str, *, seqnum: str, catalog,
+        replace: Dict[str, np.ndarray],
+        leftover: Optional[np.ndarray] = None,
+    ) -> Dict[str, np.ndarray]:
+        """One pool's replacement search against an in-flight sweep's
+        staged leftover (`depoch`) and the catalog staged under `seqnum`.
+        `leftover` rides along as the stateless fallback for a
+        pressure-evicted depoch."""
+        failpoints.eval("rpc.disrupt.dispatch")
+        header = {"op": "solve_disrupt", "depoch": depoch, "seqnum": seqnum}
+        tensors = list(replace.items())
+        if leftover is not None:
+            tensors.append(("leftover", leftover))
+        return self._disrupt_roundtrip(header, tensors, seqnum, catalog)
 
 
 def serve_main(argv=None) -> int:

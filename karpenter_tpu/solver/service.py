@@ -810,6 +810,16 @@ class TPUSolver:
             "staged_bytes": self.staged_bytes_by_kind(),
             "jit_entries": jitstats.table(),
         }
+        # disrupt-entry jit cache sizes, explicitly surfaced next to the
+        # staged bytes: the device-consolidation kernels stage their own
+        # tensors (the sidecar's "disrupt" staged-bytes kind, pressure-
+        # evicted like the catalogs), and their cache growth is the HBM
+        # signal the observatory sizes eviction against
+        doc["disrupt_entries"] = {
+            entry: stats
+            for entry, stats in doc["jit_entries"].items()
+            if ".disrupt." in entry
+        }
         c = self.client
         if c is None:
             return doc
@@ -838,8 +848,8 @@ class TPUSolver:
                 server = c.debug_info()
                 doc["server"] = {
                     k: server[k]
-                    for k in ("staged_seqnums", "class_epochs", "evictions",
-                              "staged_bytes")
+                    for k in ("staged_seqnums", "class_epochs",
+                              "disrupt_epochs", "evictions", "staged_bytes")
                     if k in server
                 }
             except Exception:  # noqa: BLE001 -- debug output must never fail a probe
@@ -1785,7 +1795,8 @@ class TPUSolver:
     def _pack_existing(self, classes, existing_nodes, result: SchedulingResult) -> np.ndarray:
         """First-fit pods onto live/in-flight nodes on device; fills
         result.existing_assignments and returns per-class placed counts."""
-        from karpenter_tpu.solver import consolidate
+        from karpenter_tpu.solver.disrupt import engine as disrupt_engine
+        from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
 
         C = _bucket(len(classes), self.c_pad_min)
         N = _bucket(len(existing_nodes), 16)
@@ -1795,13 +1806,13 @@ class TPUSolver:
             req[i] = pc.requests
             member[0, i] = len(pc.pods)
         feas = np.zeros((C, N), dtype=bool)
-        feas[: len(classes), : len(existing_nodes)] = consolidate._node_feasibility(
+        feas[: len(classes), : len(existing_nodes)] = disrupt_engine._node_feasibility(
             classes, existing_nodes, class_zone_pins=True
         )
         headroom = np.zeros((N, encode.R), dtype=np.float32)
         for ni, node in enumerate(existing_nodes):
             headroom[ni] = encode.scale_vector(node.remaining().to_vector())
-        _, takes = consolidate._repack(
+        _, takes = disrupt_kernel.disrupt_repack(
             headroom, feas, req, member, np.zeros((1, N), dtype=bool)
         )
         if hasattr(takes, "copy_to_host_async"):

@@ -372,3 +372,172 @@ def test_chaos_sync_equals_pipelined(seed, failpoints, catalog_items, tmp_path):
         solver.breaker.stop()
         client.close()
         srv.stop()
+
+
+# -- device-consolidation chaos (solver/disrupt, rpc.disrupt.dispatch,
+#    crash.disruption.apply) --------------------------------------------------
+
+
+def _overprovisioned_op(evaluator, clock_start=100_000.0, n=2):
+    """n nodes left holding one small pod each (the test_consolidate
+    shape): deletion-consolidation folds them onto surviving capacity."""
+    from karpenter_tpu.controllers.disruption import MIN_NODE_LIFETIME
+
+    op = Operator(clock=FakeClock(clock_start), consolidation_evaluator=evaluator)
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    for i in range(n):
+        op.cluster.create(Pod(f"big{i}", requests=Resources({"cpu": "3", "memory": "4Gi"})))
+        op.settle(max_ticks=30)
+        op.cluster.create(Pod(f"small{i}", requests=Resources({"cpu": "600m", "memory": "512Mi"})))
+        op.settle(max_ticks=30)
+    for i in range(n):
+        big = op.cluster.get(Pod, f"big{i}")
+        big.metadata.finalizers = []
+        op.cluster.delete(Pod, f"big{i}")
+    op.clock.step(MIN_NODE_LIFETIME + 60)
+    return op
+
+
+def test_disrupt_mid_sweep_sidecar_kill_no_double_disrupt(failpoints, tmp_path):
+    """A sidecar death mid-consolidation-sweep (the solve_disrupt
+    dispatch errors and the connection dies) must neither double-disrupt
+    a node nor change the decisions: the engine falls back to the
+    in-process kernels mid-sweep and the verdicts are bit-identical."""
+    from karpenter_tpu.solver.disrupt import DisruptEngine
+
+    path = str(tmp_path / "solver.sock")
+    srv = SolverServer(path=path).start()
+    client = SolverClient(path=path, timeout=10.0, connect_timeout=0.25)
+    breaker = CircuitBreaker(failure_threshold=2, backoff_base=1000.0)
+    solver = TPUSolver(g_max=64, client=client, breaker=breaker)
+    try:
+        op = _overprovisioned_op(DisruptEngine(solver=solver))
+        ref = _overprovisioned_op(DisruptEngine())
+        if len(op.cluster.list(NodeClaim)) < 2 or len(ref.cluster.list(NodeClaim)) < 2:
+            pytest.skip("pods packed onto one node; nothing to consolidate")
+        # the kill: every disrupt dispatch errors AND the stream is gone
+        FAILPOINTS.arm("rpc.disrupt.dispatch", "error", "ConnectionError")
+        client.close()
+        decisions = op.disruption.reconcile(max_disruptions=5)
+        assert FAILPOINTS.fires("rpc.disrupt.dispatch") >= 1
+        want = ref.disruption.reconcile(max_disruptions=5)
+        # no double-disrupt: every acted claim and node appears once
+        names = [n for n, _ in decisions]
+        assert len(names) == len(set(names)), f"claim disrupted twice: {decisions}"
+        disrupted_nodes = op.disruption._pass_disrupted
+        assert len(disrupted_nodes) == len(set(disrupted_nodes))
+        # identical decisions (by reason sequence; names differ by rig)
+        assert [r for _, r in decisions] == [r for _, r in want]
+        # convergence: evicted pods rebind, invariants hold throughout
+        for _ in range(20):
+            op.tick()
+            check_invariants(op)
+            if not op.cluster.pending_pods():
+                break
+            op.clock.step(3.0)
+        assert not op.cluster.pending_pods()
+    finally:
+        FAILPOINTS.reset()
+        breaker.stop()
+        client.close()
+        srv.stop()
+
+
+def test_disrupt_breaker_open_identical_decisions(failpoints, tmp_path):
+    """Breaker open = the sweep runs on the in-process host evaluator
+    with decisions identical to the wire path's (the instant-fallback
+    contract extends to consolidation)."""
+    from karpenter_tpu import metrics
+    from karpenter_tpu.solver.disrupt import DisruptEngine
+
+    path = str(tmp_path / "solver.sock")
+    srv = SolverServer(path=path).start()
+    client = SolverClient(path=path, timeout=10.0, connect_timeout=0.25)
+    breaker = CircuitBreaker(failure_threshold=2, backoff_base=1000.0)
+    solver = TPUSolver(g_max=64, client=client, breaker=breaker)
+    try:
+        op = _overprovisioned_op(DisruptEngine(solver=solver))
+        ref = _overprovisioned_op(DisruptEngine())
+        if len(op.cluster.list(NodeClaim)) < 2 or len(ref.cluster.list(NodeClaim)) < 2:
+            pytest.skip("pods packed onto one node; nothing to consolidate")
+        breaker.force_open("chaos")
+        before = metrics.DISRUPTION_DEVICE_FALLBACKS.value(reason="breaker-open")
+        decisions = op.disruption.reconcile(max_disruptions=5)
+        want = ref.disruption.reconcile(max_disruptions=5)
+        assert [r for _, r in decisions] == [r for _, r in want]
+        assert decisions, "scenario should consolidate"
+        assert metrics.DISRUPTION_DEVICE_FALLBACKS.value(reason="breaker-open") > before
+        assert op.disruption.evaluator.last_dispatch["path"] == "local"
+    finally:
+        FAILPOINTS.reset()
+        breaker.stop()
+        client.close()
+        srv.stop()
+
+
+def test_crash_disruption_apply_no_half_applied_verdict(failpoints):
+    """crash.disruption.apply: the operator dies AFTER the replacement
+    launched but BEFORE any victim was tainted -- the half-applied
+    verdict. The next incarnation must converge with no node disrupted
+    twice, no pod lost, and no orphan instance: the launched replacement
+    is real capacity, so the stranded victims consolidate onto it (or
+    the empty replacement itself is reaped) on later passes."""
+    from karpenter_tpu.controllers.disruption import MIN_NODE_LIFETIME
+    from karpenter_tpu.failpoints import OperatorCrashed
+    from karpenter_tpu.solver.disrupt import DisruptEngine
+
+    op = Operator(clock=FakeClock(100_000.0), consolidation_evaluator=DisruptEngine())
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    # one oversized node (sized for big+small) whose survivor is small:
+    # no other capacity, so the verdict is REPLACE with one cheaper node
+    op.cluster.create(Pod("big", requests=Resources({"cpu": "3", "memory": "4Gi"})))
+    op.settle(max_ticks=30)
+    op.cluster.create(Pod("small", requests=Resources({"cpu": "600m", "memory": "512Mi"})))
+    op.settle(max_ticks=30)
+    big = op.cluster.get(Pod, "big")
+    big.metadata.finalizers = []
+    op.cluster.delete(Pod, "big")
+    op.clock.step(MIN_NODE_LIFETIME + 60)
+    claims_before = {c.metadata.name for c in op.cluster.list(NodeClaim)}
+    FAILPOINTS.arm("crash.disruption.apply", "crash", times=1)
+    crashed = False
+    try:
+        for _ in range(10):
+            try:
+                op.tick()
+            except OperatorCrashed:
+                crashed = True
+                break
+            op.clock.step(3.0)
+        if not crashed:
+            pytest.skip("no replacement verdict materialized (nothing launched)")
+        assert FAILPOINTS.fires("crash.disruption.apply") == 1
+        # the half-applied state: replacement launched, victims intact
+        claims_now = {c.metadata.name for c in op.cluster.list(NodeClaim)}
+        assert claims_before <= claims_now, "a victim was deleted before the crash"
+        assert len(claims_now) > len(claims_before), "replacement not journaled/launched"
+        # next incarnation: recovery + later sweeps converge the fleet
+        all_decisions = []
+        for _ in range(40):
+            op.tick()
+            check_invariants(op)
+            all_decisions += op.disruption.last_decisions
+            op.clock.step(10.0)
+            if not op.cluster.pending_pods() and len(op.cluster.list(NodeClaim)) <= 1:
+                break
+        names = [n for n, _ in all_decisions]
+        assert len(names) == len(set(names)), f"node disrupted twice: {all_decisions}"
+        assert op.cluster.get(Pod, "small").node_name, "pod lost after crash"
+        # no orphan instance survives the GC drain
+        for _ in range(10):
+            op.tick()
+            op.clock.step(10.0)
+        check_invariants(op)
+        claimed = {c.provider_id for c in op.cluster.list(NodeClaim) if c.provider_id}
+        for inst in op.cloud.describe_instances():
+            if inst.state == "running":
+                assert inst.provider_id in claimed, f"orphan instance {inst.id}"
+    finally:
+        FAILPOINTS.reset()

@@ -1,0 +1,350 @@
+"""Device-resident consolidation subsystem (solver/disrupt/): the wire
+op, its degrade ladder, candidate-set enumeration, the brownout-bounded
+sweep, and the flight-recorder fields.
+
+The correctness contract (device verdicts == oracle decisions) lives in
+tests/test_consolidate.py; this file covers the NEW subsystem seams:
+
+- solve_disrupt on the sidecar: feature negotiation, staged-seqnum reuse,
+  the disrupt-epoch staging, and wire == local verdict bit-identity;
+- the breaker/degrade ladder: dispatch faults and an open breaker fall
+  back to the in-process kernels with identical verdicts, counted;
+- underutilized-pair enumeration and the controller's pair stage;
+- brownout rung 1 downgrading to the bounded singleton-only device sweep
+  instead of standing down;
+- the per-tick flight record's consolidation fields.
+"""
+import pytest
+
+from karpenter_tpu import metrics
+from karpenter_tpu.apis import NodeClaim, Node, NodePool, Pod, TPUNodeClass
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.controllers.disruption import MIN_NODE_LIFETIME
+from karpenter_tpu.failpoints import FAILPOINTS
+from karpenter_tpu.operator import Operator, Options
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver.breaker import CircuitBreaker
+from karpenter_tpu.solver.disrupt import DisruptEngine, enumerate_pairs
+from karpenter_tpu.solver.rpc import SolverClient, SolverServer
+from karpenter_tpu.solver.service import TPUSolver
+from tests.test_consolidate import mk_node, mk_pods
+
+
+@pytest.fixture()
+def wire_rig(tmp_path):
+    sock = str(tmp_path / "solver.sock")
+    srv = SolverServer(path=sock).start()
+    client = SolverClient(path=sock, timeout=10.0, connect_timeout=0.25)
+    breaker = CircuitBreaker(failure_threshold=2, backoff_base=1000.0)
+    solver = TPUSolver(g_max=64, client=client, breaker=breaker)
+    yield srv, client, breaker, solver
+    breaker.stop()
+    client.close()
+    srv.stop()
+
+
+@pytest.fixture()
+def pool_catalog():
+    op = Operator(clock=FakeClock(100_000.0))
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    op.nodeclass_controller.reconcile_all()
+    pool = op.cluster.get(NodePool, "default")
+    return pool, op.cloud_provider.get_instance_types(pool)
+
+
+def _fleet():
+    nodes = [mk_node("n0", 4000, 8192), mk_node("n1", 4000, 8192)]
+    sets = [
+        (mk_pods(4, 1000, 1024), []),
+        (mk_pods(9, 1000, 1024, prefix="q"), ["n1"]),
+        (mk_pods(40, 1000, 2048, prefix="r"), []),
+    ]
+    return nodes, sets
+
+
+def _sig(verdicts):
+    return [repr(v) for v in verdicts]
+
+
+class TestWireOp:
+    def test_feature_advertised(self, wire_rig):
+        _, client, _, _ = wire_rig
+        assert "solve_disrupt" in client.features()
+
+    def test_wire_matches_local_bit_identical(self, wire_rig, pool_catalog):
+        *_, solver = wire_rig
+        pool, catalog = pool_catalog
+        nodes, sets = _fleet()
+        kw = dict(pools=[pool], catalogs={"default": catalog})
+        wire = DisruptEngine(solver=solver)
+        local = DisruptEngine()
+        vw = wire.evaluate(nodes, sets, **kw)
+        assert wire.last_dispatch["path"] == "wire"
+        vl = local.evaluate(nodes, sets, **kw)
+        assert local.last_dispatch["path"] == "local"
+        assert _sig(vw) == _sig(vl)
+
+    def test_delete_only_sweep_needs_no_catalog(self, wire_rig):
+        *_, solver = wire_rig
+        nodes, sets = _fleet()
+        wire = DisruptEngine(solver=solver)
+        vw = wire.evaluate(nodes, sets)
+        assert wire.last_dispatch["path"] == "wire"
+        assert _sig(vw) == _sig(DisruptEngine().evaluate(nodes, sets))
+
+    def test_sidecar_restart_restages_seqnum(self, wire_rig, pool_catalog, tmp_path):
+        srv, client, _, solver = wire_rig
+        pool, catalog = pool_catalog
+        nodes, sets = _fleet()
+        kw = dict(pools=[pool], catalogs={"default": catalog})
+        wire = DisruptEngine(solver=solver)
+        before = wire.evaluate(nodes, sets, **kw)
+        # simulate a sidecar that lost its staging but kept the socket:
+        # clear the server-side LRUs; the op's unknown-seqnum rung must
+        # restage and retry within the same call
+        with srv._lock:
+            srv._staged.clear()
+            srv._disrupt.clear()
+        client._staged_seqnums.clear()
+        after = wire.evaluate(nodes, sets, **kw)
+        assert wire.last_dispatch["path"] == "wire"
+        assert _sig(before) == _sig(after)
+
+    def test_disrupt_epoch_eviction_falls_back_to_shipped_leftover(
+        self, wire_rig, pool_catalog
+    ):
+        """A pressure-evicted disrupt epoch mid-sweep must not fail the
+        sweep: the replacement-only call ships the leftover tensor as
+        the stateless fallback."""
+        srv, client, _, solver = wire_rig
+        pool, catalog = pool_catalog
+        nodes, sets = _fleet()
+        pool2 = NodePool("p2", weight=5)
+        kw = dict(pools=[pool, pool2],
+                  catalogs={"default": catalog, "p2": []})
+        # evict every disrupt epoch between the repack and the second
+        # pool pass by shrinking the LRU under the server lock whenever
+        # it fills -- emulated here by clearing after a first full sweep,
+        # then re-running with the store cleared mid-flight via monkeying
+        wire = DisruptEngine(solver=solver)
+        want = _sig(DisruptEngine().evaluate(nodes, sets, **kw))
+        orig = client.solve_disrupt_replace
+
+        def evict_then_replace(*a, **k):
+            with srv._lock:
+                srv._disrupt.clear()
+            return orig(*a, **k)
+
+        client.solve_disrupt_replace = evict_then_replace
+        try:
+            got = wire.evaluate(nodes, sets, **kw)
+        finally:
+            client.solve_disrupt_replace = orig
+        assert wire.last_dispatch["path"] == "wire"
+        assert _sig(got) == want
+
+    def test_debug_op_reports_disrupt_staging(self, wire_rig, pool_catalog):
+        *_, solver = wire_rig
+        pool, catalog = pool_catalog
+        nodes, sets = _fleet()
+        DisruptEngine(solver=solver).evaluate(
+            nodes, sets, pools=[pool], catalogs={"default": catalog})
+        doc = solver.client.debug_info()
+        assert doc["disrupt_epochs"], "repack leftover not staged under a depoch"
+        assert doc["staged_bytes"]["disrupt"] > 0
+        wire_doc = solver.describe_wire()
+        assert "disrupt_entries" in wire_doc
+        assert wire_doc["server"]["staged_bytes"]["disrupt"] > 0
+
+
+class TestDegradeLadder:
+    def test_dispatch_fault_falls_back_identical(self, wire_rig, pool_catalog, failpoints):
+        *_, breaker, solver = wire_rig
+        pool, catalog = pool_catalog
+        nodes, sets = _fleet()
+        kw = dict(pools=[pool], catalogs={"default": catalog})
+        want = _sig(DisruptEngine().evaluate(nodes, sets, **kw))
+        engine = DisruptEngine(solver=solver)
+        before = metrics.DISRUPTION_DEVICE_FALLBACKS.value(reason="rpc-down")
+        FAILPOINTS.arm("rpc.disrupt.dispatch", "error", "ConnectionError", times=1)
+        got = engine.evaluate(nodes, sets, **kw)
+        assert FAILPOINTS.fires("rpc.disrupt.dispatch") == 1
+        assert engine.last_dispatch["path"] == "local"
+        assert _sig(got) == want
+        assert metrics.DISRUPTION_DEVICE_FALLBACKS.value(reason="rpc-down") == before + 1
+        assert breaker._consecutive >= 1 or breaker.state != "closed"
+
+    def test_breaker_open_short_circuits_to_local(self, wire_rig, pool_catalog):
+        *_, breaker, solver = wire_rig
+        pool, catalog = pool_catalog
+        nodes, sets = _fleet()
+        kw = dict(pools=[pool], catalogs={"default": catalog})
+        want = _sig(DisruptEngine().evaluate(nodes, sets, **kw))
+        breaker.force_open("test")
+        engine = DisruptEngine(solver=solver)
+        before = metrics.DISRUPTION_DEVICE_FALLBACKS.value(reason="breaker-open")
+        got = engine.evaluate(nodes, sets, **kw)
+        assert engine.last_dispatch["path"] == "local"
+        assert _sig(got) == want
+        assert metrics.DISRUPTION_DEVICE_FALLBACKS.value(reason="breaker-open") == before + 1
+
+
+class TestPairEnumeration:
+    def test_excludes_prefix_pair_and_bounds_window(self):
+        pairs = enumerate_pairs(10, window=4)
+        assert (0, 1) not in pairs
+        assert all(i < j < 4 for i, j in pairs)
+        assert pairs == [(0, 2), (0, 3), (1, 2), (1, 3), (2, 3)]
+        assert enumerate_pairs(1) == []
+        assert enumerate_pairs(2) == []
+
+    def test_deterministic(self):
+        assert enumerate_pairs(6) == enumerate_pairs(6)
+
+
+class TestPairStage:
+    def _controller(self, evaluator=None):
+        op = Operator(clock=FakeClock(100_000.0), consolidation_evaluator=evaluator)
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        return op
+
+    def test_pair_stage_acts_when_no_prefix_works(self, monkeypatch):
+        """Control-flow contract: with every prefix blocked but pair
+        (1, 2) deletable, both the device-verdict branch and the
+        oracle branch act on exactly that pair."""
+        from karpenter_tpu.controllers.disruption import Candidate
+
+        op = self._controller()
+        ctrl = op.disruption
+
+        def cand(name):
+            claim = NodeClaim(name)
+            node = Node(f"node-{name}")
+            pool = op.cluster.get(NodePool, "default")
+            return Candidate(claim=claim, node=node, nodepool=pool,
+                             pods=[], price=1.0, disruption_cost=1.0)
+
+        remaining = [cand("a"), cand("b"), cand("c")]
+        sim_calls = []
+
+        def fake_simulate(cands, allow_new_node):
+            names = tuple(c.claim.metadata.name for c in cands)
+            sim_calls.append((names, allow_new_node))
+            return (names == ("b", "c") and not allow_new_node), []
+
+        acted = []
+        monkeypatch.setattr(ctrl, "_simulate", fake_simulate)
+        monkeypatch.setattr(
+            ctrl, "_disrupt",
+            lambda c, reason, disrupting: acted.append(c.claim.metadata.name))
+        # oracle branch (totals sized so pool budgets admit the pair)
+        totals = {"default": 100}  # 10% default budget must admit both pair members
+        assert ctrl._pair_consolidation(remaining, None, {}, totals, 5) is True
+        assert acted == ["b", "c"]
+        # device branch: the batch's pair verdict short-circuits straight
+        # to the disruption (no re-simulation for deletion)
+        from karpenter_tpu.solver.disrupt import SetVerdict
+
+        acted.clear()
+        verdicts = {
+            ("pair", 0, 2): SetVerdict(False, 1, float("inf"), float("inf"), None, None),
+            ("pair", 1, 2): SetVerdict(True, 0, float("inf"), float("inf"), None, None),
+        }
+        assert ctrl._pair_consolidation(remaining, verdicts, {}, totals, 5) is True
+        assert acted == ["b", "c"]
+
+
+class TestBoundedBrownoutSweep:
+    def _overprovisioned(self, evaluator, tick_deadline=1.0):
+        op = Operator(
+            clock=FakeClock(100_000.0),
+            options=Options(tick_deadline=tick_deadline),
+            consolidation_evaluator=evaluator,
+        )
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        for i in range(2):
+            op.cluster.create(Pod(f"big{i}", requests=Resources({"cpu": "3", "memory": "4Gi"})))
+            op.settle(max_ticks=30)
+            op.cluster.create(Pod(f"small{i}", requests=Resources({"cpu": "600m", "memory": "512Mi"})))
+            op.settle(max_ticks=30)
+        for i in range(2):
+            big = op.cluster.get(Pod, f"big{i}")
+            big.metadata.finalizers = []
+            op.cluster.delete(Pod, f"big{i}")
+        op.clock.step(MIN_NODE_LIFETIME + 60)
+        return op
+
+    def test_rung1_runs_bounded_device_sweep(self):
+        from karpenter_tpu import overload
+
+        op = self._overprovisioned(DisruptEngine())
+        if len(op.cluster.list(NodeClaim)) < 2:
+            pytest.skip("pods packed onto one node; nothing to consolidate")
+        try:
+            op.brownout.observe(5.0)  # force rung 1
+            assert op.brownout.sheds_disruption()
+            skipped = metrics.OVERLOAD_SKIPPED_SWEEPS.value(stage="disruption")
+            bounded = metrics.DISRUPTION_DEVICE_BOUNDED_SWEEPS.value()
+            decisions = op.disruption.reconcile(max_disruptions=5)
+            assert decisions, "bounded sweep should still consolidate"
+            assert op.disruption.last_sweep_stats["mode"] == "bounded"
+            assert metrics.DISRUPTION_DEVICE_BOUNDED_SWEEPS.value() == bounded + 1
+            # the stand-down counter must NOT move: the sweep ran
+            assert metrics.OVERLOAD_SKIPPED_SWEEPS.value(stage="disruption") == skipped
+        finally:
+            overload.install_brownout(None)
+
+    def test_rung1_without_engine_still_stands_down(self):
+        from karpenter_tpu import overload
+
+        op = self._overprovisioned(None)
+        try:
+            op.brownout.observe(5.0)
+            assert op.brownout.sheds_disruption()
+            skipped = metrics.OVERLOAD_SKIPPED_SWEEPS.value(stage="disruption")
+            assert op.disruption.reconcile(max_disruptions=5) == []
+            assert op.disruption.last_sweep_stats["mode"] == "shed"
+            assert metrics.OVERLOAD_SKIPPED_SWEEPS.value(stage="disruption") == skipped + 1
+        finally:
+            overload.install_brownout(None)
+
+    def test_bounded_sweep_respects_max_disruptions(self):
+        from karpenter_tpu import overload
+
+        op = self._overprovisioned(DisruptEngine())
+        if len(op.cluster.list(NodeClaim)) < 2:
+            pytest.skip("pods packed onto one node; nothing to consolidate")
+        try:
+            op.brownout.observe(5.0)
+            decisions = op.disruption.reconcile(max_disruptions=1)
+            assert len(decisions) <= 1
+        finally:
+            overload.install_brownout(None)
+
+
+class TestFlightRecordFields:
+    def test_record_carries_consolidation_stats(self):
+        from karpenter_tpu.obs import flight
+
+        class FakeDisruption:
+            last_sweep_stats = {
+                "mode": "bounded", "consolidation_ms": 4.2,
+                "sets": {"singleton": 7}, "path": "wire",
+            }
+
+        rec = flight.build_tick_record(None, 0.0, disruption=FakeDisruption())
+        assert rec["consolidation_ms"] == 4.2
+        assert rec["consolidation_mode"] == "bounded"
+        assert rec["consolidation_sets"] == {"singleton": 7}
+
+    def test_sweep_populates_stats(self):
+        op = Operator(clock=FakeClock(100_000.0), consolidation_evaluator=DisruptEngine())
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.disruption.reconcile()
+        st = op.disruption.last_sweep_stats
+        assert st["mode"] == "full"
+        assert "consolidation_ms" in st

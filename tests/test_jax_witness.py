@@ -219,3 +219,49 @@ class TestWarmDeltaPath:
         assert len(w.hot_transfers()) == t0, w.report()
         # the tick DID fetch -- through the sanctioned barrier
         assert w.stats()["sanctioned_fetches"] > 0
+
+
+class TestWarmConsolidationSweep:
+    """Tier-1 acceptance for the device-consolidation subsystem: a warm
+    batched candidate-set sweep (solver/disrupt) -- repack + per-pool
+    replacement with identical shapes -- compiles nothing and syncs
+    nothing unsanctioned; its fetches all pass the sanctioned barriers
+    (DisruptEngine._dispatch_local / _evaluate_local)."""
+
+    def test_zero_retraces_and_transfers_on_warm_sweep(self, jaxw_scratch):
+        _require_installed()
+        from karpenter_tpu.apis import NodePool, TPUNodeClass
+        from karpenter_tpu.cache.ttl import FakeClock
+        from karpenter_tpu.operator import Operator
+        from karpenter_tpu.solver.disrupt import DisruptEngine
+        from tests.test_consolidate import mk_node, mk_pods
+
+        w = jaxw_scratch
+        op = Operator(clock=FakeClock(100_000.0))
+        op.cluster.create(TPUNodeClass("default"))
+        op.cluster.create(NodePool("default"))
+        op.nodeclass_controller.reconcile_all()
+        pool = op.cluster.get(NodePool, "default")
+        catalog = op.cloud_provider.get_instance_types(pool)
+
+        engine = DisruptEngine()
+        nodes = [mk_node(f"n{i}", 4000, 8192) for i in range(4)]
+        sets = [
+            (mk_pods(3, 1000, 1024), ["n0"]),
+            (mk_pods(9, 1000, 1024, prefix="q"), ["n1"]),
+            (mk_pods(40, 1000, 2048, prefix="r"), []),
+        ]
+        kw = dict(pools=[pool], catalogs={"default": catalog})
+        # warmup sweep: compiles the repack/replace programs for this
+        # shape bucket and encodes the catalog once
+        base = engine.evaluate(nodes, sets, **kw)
+        r0, t0 = len(w.hot_retraces()), len(w.hot_transfers())
+        fetches0 = w.stats()["sanctioned_fetches"]
+        with w.hot("warm_consolidation_sweep"):
+            for _ in range(3):
+                got = engine.evaluate(nodes, sets, **kw)
+        assert [repr(v) for v in got] == [repr(v) for v in base]
+        assert len(w.hot_retraces()) == r0, w.report()
+        assert len(w.hot_transfers()) == t0, w.report()
+        # the sweep DID fetch -- through the sanctioned barriers
+        assert w.stats()["sanctioned_fetches"] > fetches0

@@ -13,6 +13,7 @@ from karpenter_tpu.parallel.mesh import make_mesh, sharded_repack, sharded_solve
 from karpenter_tpu.scheduling import Resources
 from karpenter_tpu.scheduling import resources as res
 from karpenter_tpu.solver import consolidate, encode, ffd
+from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
 from karpenter_tpu.solver.oracle import ExistingNode
 
 
@@ -94,7 +95,7 @@ class TestShardedRepack:
         feas = rng.random((C, N)) < 0.8
         member = rng.integers(0, 6, size=(S, C)).astype(np.int32)
         excl = rng.random((S, N)) < 0.2
-        l1, t1 = consolidate._repack(headroom, feas, req, member, excl)
+        l1, t1 = disrupt_kernel.disrupt_repack(headroom, feas, req, member, excl)
         l2, t2 = sharded_repack(mesh, headroom, feas, req, member, excl)
         np.testing.assert_array_equal(np.asarray(l1), np.asarray(l2))
         np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))

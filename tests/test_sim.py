@@ -331,3 +331,49 @@ class TestShrinker:
             lambda evs: any(e["ev"] == "advance" for e in evs),
         )
         assert metrics.SIM_SHRINK_ROUNDS.value() > before
+
+
+# -- diurnal-consolidation: the trough KPI contract ---------------------------
+
+
+class TestDiurnalConsolidation:
+    """The consolidation corpus scenario (device-consolidation round): a
+    diurnal ramp-down leaves the fleet underutilized; the batched
+    disrupt engine must fold it down IN the trough. Pins the golden
+    decision digest (host backend; the corpus gate also replays it
+    through wire + the delta backend, asserting host == wire == device
+    verdict parity) and the KPI shape: hourly fleet price at convergence
+    sits strictly below the day's peak, so cost_per_pod_hour drops in
+    the trough instead of paying for the peak forever."""
+
+    @pytest.fixture(scope="class")
+    def consolidation_host(self):
+        events = read_trace(
+            os.path.join(GOLDEN_DIR, "diurnal-consolidation.jsonl"))
+        return replay(events, backend="host", seed=20260803)
+
+    def test_digest_matches_golden(self, consolidation_host):
+        with open(os.path.join(GOLDEN_DIR, "digests.json")) as f:
+            golden = json.load(f)
+        assert consolidation_host.digest == golden["diurnal-consolidation"], (
+            "decision digest drifted from the committed golden -- if the "
+            "change is intentional, regenerate with "
+            "`python -m karpenter_tpu sim corpus --update-digests`"
+        )
+
+    def test_cost_drops_in_the_trough(self, consolidation_host):
+        k = consolidation_host.kpis
+        assert k["fleet_price_peak_per_h"] > 0
+        assert k["fleet_price_final_per_h"] < k["fleet_price_peak_per_h"], (
+            "fleet never consolidated: trough price equals the day's peak"
+        )
+        # the fold-down is substantial, not one node at the margin
+        assert k["fleet_price_final_per_h"] <= 0.8 * k["fleet_price_peak_per_h"]
+        assert k["node_churn"] > 0 and k["pods_bound_final"] > 0
+
+    def test_header_restricts_differential_to_sync_backends(self):
+        from karpenter_tpu.sim.cli import _trace_backends
+
+        events = read_trace(
+            os.path.join(GOLDEN_DIR, "diurnal-consolidation.jsonl"))
+        assert _trace_backends(events) == ("host", "wire")
