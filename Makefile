@@ -10,12 +10,12 @@ PYTEST ?= $(PY) -m pytest
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
 
-lint:  ## AST invariant checkers: determinism, lock discipline, zero-copy wire, registry drift, jax compilation discipline (jaxjit retrace hazards + jaxhost sync rules) (allowlist: hack/lint_baseline.json)
+lint:  ## AST invariant checkers: determinism, lock discipline, zero-copy wire, registry drift, jax compilation discipline (jaxjit retrace hazards + jaxhost sync rules), error-path soundness (errflow: ladder-seam escape sets, crash-swallow, broad-except discipline), resource lifecycle (reslife) (allowlist: hack/lint_baseline.json)
 	$(PY) -m karpenter_tpu.analysis
 
-typecheck:  ## targeted mypy over the solver package, the intent journal, the mesh layer, and the analysis tooling (hack/mypy.ini); skips with a notice where mypy is not installed (CI always runs it)
+typecheck:  ## targeted mypy over the solver package, the intent journal, the mesh layer, and the analysis tooling incl. every checker family (hack/mypy.ini); skips with a notice where mypy is not installed (CI always runs it)
 	@if $(PY) -c "import mypy" >/dev/null 2>&1; then \
-		$(PY) -m mypy --config-file hack/mypy.ini karpenter_tpu/solver/ karpenter_tpu/journal.py karpenter_tpu/parallel/ karpenter_tpu/analysis/; \
+		$(PY) -m mypy --config-file hack/mypy.ini karpenter_tpu/solver/ karpenter_tpu/journal.py karpenter_tpu/parallel/ karpenter_tpu/analysis/ karpenter_tpu/analysis/checkers/; \
 	else \
 		echo "typecheck: mypy not installed in this environment; skipping (the CI typecheck job runs it; pip install mypy to run locally)"; \
 	fi
@@ -58,14 +58,14 @@ bench-consolidate:  ## consolidation stage only (disrupt engine: consolidation_n
 # the chaos-family soaks route the observatory's crash-flushed black box
 # (karpenter_tpu/obs/flight.py) into their artifact dirs, so a failing
 # job uploads the last 256 ticks of flight data next to its shrunk repro
-chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count, incl. the shm-transport faults, under the lock-order witness (zero inversions asserted at session end; full-length schedule stays behind -m slow)
-	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_CHAOS_SEEDS=20 KARPENTER_TPU_FLIGHTDATA=chaos-artifacts/flightdata.jsonl $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py tests/test_wire.py -q -m 'not slow' $(call STAMP,chaos)
+chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration count, incl. the shm-transport faults, under the lock-order AND exception-escape witnesses (zero inversions + zero unsanctioned ladder-class swallows asserted at session end; full-length schedule stays behind -m slow)
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_ERRFLOW_WITNESS=1 KARPENTER_TPU_CHAOS_SEEDS=20 KARPENTER_TPU_FLIGHTDATA=chaos-artifacts/flightdata.jsonl $(PYTEST) tests/test_chaos.py tests/test_failpoints.py tests/test_breaker.py tests/test_wire.py -q -m 'not slow' $(call STAMP,chaos)
 
-crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection -- under the lock-order witness (zero inversions asserted at session end); diverging traces ddmin-shrink into crash-artifacts/
-	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts KARPENTER_TPU_FLIGHTDATA=crash-artifacts/flightdata.jsonl $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
+crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection -- under the lock-order AND exception-escape witnesses (zero inversions, zero unsanctioned OperatorCrashed swallows); diverging traces ddmin-shrink into crash-artifacts/
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_ERRFLOW_WITNESS=1 KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts KARPENTER_TPU_FLIGHTDATA=crash-artifacts/flightdata.jsonl $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
 
-overload:  ## overload storm soak: 10x offered load against the deadline-budgeted tick (p99 <= 2x deadline, zero pods lost, admitted-prefix bit-identity, brownout ladder + stuck-tick watchdog escalation, bounded interruption intake, shm send timeout) under the lock-order AND jax retrace witnesses; a diverging storm replay ddmin-shrinks into overload-artifacts/
-	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_OVERLOAD_ARTIFACTS=overload-artifacts KARPENTER_TPU_FLIGHTDATA=overload-artifacts/flightdata.jsonl $(PYTEST) tests/test_overload.py -q -m 'not slow' $(call STAMP,overload)
+overload:  ## overload storm soak: 10x offered load against the deadline-budgeted tick (p99 <= 2x deadline, zero pods lost, admitted-prefix bit-identity, brownout ladder + stuck-tick watchdog escalation, bounded interruption intake, shm send timeout) under the lock-order, jax retrace, AND exception-escape witnesses; a diverging storm replay ddmin-shrinks into overload-artifacts/
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_ERRFLOW_WITNESS=1 KARPENTER_TPU_OVERLOAD_ARTIFACTS=overload-artifacts KARPENTER_TPU_FLIGHTDATA=overload-artifacts/flightdata.jsonl $(PYTEST) tests/test_overload.py -q -m 'not slow' $(call STAMP,overload)
 
 sim-corpus:  ## differential-replay the committed scenario corpus (host vs wire vs pipelined, golden digests); shrinks any failing trace into sim-artifacts/
 	$(PY) -m karpenter_tpu sim corpus --dir tests/golden/scenarios --artifacts sim-artifacts $(call STAMP,sim-corpus)

@@ -31,6 +31,17 @@ and fail `make lint` the moment a violation is WRITTEN:
   dispatch -> decode manifest: ``.item()``, scalar casts of live device
   values, unsanctioned ``np.asarray``/``device_get``, and hot-path
   barriers (checkers/jax_discipline.py).
+- ``errflow``       -- interprocedural exception-flow soundness over the
+  ``LADDER_SEAMS`` manifest: every wire failure provably degrades
+  through the shm->tcp->breaker->host ladder (escape sets checked
+  against per-seam must_handle/may_raise contracts), no handler can
+  swallow ``OperatorCrashed`` outside the sanctioned run-loop drivers,
+  broad ``except Exception`` must re-raise/convert/count/log, and no
+  ``return`` hides in a ``finally`` (checkers/errflow.py).
+- ``reslife``       -- resource lifecycle: sockets, shm segments/mmaps,
+  fds, files, tempfiles, and threads are released on every path,
+  error edges included -- the static analogue of ``cleanup_stale``
+  (checkers/reslife.py).
 
 Intentional exceptions live in ``hack/lint_baseline.json`` -- each entry
 carries file:line, the offending source line, and a justification; the
@@ -49,7 +60,13 @@ assert zero inversions (tests/conftest.py). The jax pass is paired the
 same way with a runtime retrace/transfer witness (jax_witness.py):
 compile events and unsanctioned device->host conversions inside
 declared-warm hot sections are recorded per call site, asserted zero by
-tier-1's warm-delta gate and the bench warm stage.
+tier-1's warm-delta gate and the bench warm stage. The errflow pass is
+paired with a runtime exception-escape witness (errwitness.py): the
+ladder exception classes are construction-tapped to arm per-thread
+tracing only while one is in flight, and every ladder-class exception
+SWALLOWED by a package handler counts into
+``karpenter_errflow_swallowed_total{site}`` -- tier-1 and the
+chaos/overload soaks assert no unsanctioned site swallowed one.
 """
 from karpenter_tpu.analysis.base import (  # noqa: F401
     Violation,

@@ -4,6 +4,7 @@
     python -m karpenter_tpu.analysis --rules locks    # one family
     python -m karpenter_tpu.analysis --json           # machine-readable
     python -m karpenter_tpu.analysis --graph          # dump the lock graph
+    python -m karpenter_tpu.analysis --graph --family errflow   # seam escape sets
     python -m karpenter_tpu.analysis --write-baseline # (re)seed the allowlist
 
 Exit codes: 0 clean, 1 violations (or a stale baseline entry), 2 usage.
@@ -27,7 +28,9 @@ def main(argv=None) -> int:
         prog="python -m karpenter_tpu.analysis",
         description="AST invariant checkers: determinism, lock discipline, "
                     "zero-copy wire, registry drift, jax compilation "
-                    "discipline (jaxjit retrace hazards + jaxhost sync rules)")
+                    "discipline (jaxjit retrace hazards + jaxhost sync "
+                    "rules), error-path soundness (errflow), and resource "
+                    "lifecycle (reslife)")
     ap.add_argument("--rules", action="append", default=None,
                     metavar="FAMILY", help="run only these rule families "
                     f"(choices: {', '.join(checkers())}; repeatable)")
@@ -40,10 +43,36 @@ def main(argv=None) -> int:
                     "(justifications from matching old entries are kept)")
     ap.add_argument("--json", action="store_true", help="JSON output")
     ap.add_argument("--graph", action="store_true",
-                    help="dump the static lock-acquisition graph and exit")
+                    help="dump a static graph and exit (default: the "
+                         "lock-acquisition graph; --family errflow dumps "
+                         "the per-seam exception-propagation graph)")
+    ap.add_argument("--family", default="locks", metavar="FAMILY",
+                    help="which graph --graph dumps: locks (default) or "
+                         "errflow")
+    ap.add_argument("--seam", default=None, metavar="KEY",
+                    help="with --graph --family errflow: restrict the dump "
+                         "to seams whose key contains KEY (debugging aid)")
     args = ap.parse_args(argv)
 
     if args.graph:
+        if args.family == "errflow":
+            from karpenter_tpu.analysis.checkers import errflow
+
+            mods = iter_modules()
+            # ONE analyzer serves both the dump and the exit code: the
+            # interprocedural escape-set pass is the expensive part
+            an = errflow.ExcAnalyzer(mods)
+            payload = errflow.exception_graph(mods, analyzer=an)
+            if args.seam:
+                payload["seams"] = {k: v for k, v in payload["seams"].items()
+                                    if args.seam in k}
+            print(json.dumps(payload, indent=2))
+            seam_violations = [v for v in errflow.check(mods, analyzer=an)
+                               if v.rule.startswith("errflow/seam-")]
+            return 0 if not seam_violations else 1
+        if args.family != "locks":
+            ap.error(f"--graph knows families 'locks' and 'errflow', "
+                     f"not {args.family!r}")
         from karpenter_tpu.analysis.checkers.locks import lock_graph
 
         g = lock_graph(iter_modules())

@@ -170,8 +170,9 @@ CheckerFn = Callable[[List[Module]], List[Violation]]
 def checkers() -> Dict[str, CheckerFn]:
     """The rule families, imported lazily (keeps `import
     karpenter_tpu.analysis` feather-light for the witness path)."""
-    from karpenter_tpu.analysis.checkers import (determinism, jax_discipline,
-                                                 locks, registry_drift,
+    from karpenter_tpu.analysis.checkers import (determinism, errflow,
+                                                 jax_discipline, locks,
+                                                 registry_drift, reslife,
                                                  zerocopy)
 
     return {
@@ -181,6 +182,8 @@ def checkers() -> Dict[str, CheckerFn]:
         "registry": registry_drift.check,
         "jaxjit": jax_discipline.check_retrace,
         "jaxhost": jax_discipline.check_hostsync,
+        "errflow": errflow.check,
+        "reslife": reslife.check,
     }
 
 

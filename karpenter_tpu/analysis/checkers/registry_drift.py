@@ -15,6 +15,10 @@ generator's import list missed:
 - ``registry/feature-undocumented``   -- every RPC feature flag the
   server advertises (the ``features`` list in solver/rpc.py, plus
   conditional ``features.append``) must appear somewhere under docs/.
+- ``registry/seam-unfailpointed``     -- every ``LADDER_SEAMS`` entry
+  (checkers/errflow.py) must name a failpoint site that actually exists
+  as a ``failpoints.eval/corrupt/live`` call in the package: a degrade
+  seam without a chaos drill is a contract nothing exercises.
 
 Metric and failpoint names match backtick-exact (`` `name` ``) against
 their doc tables -- a plain substring test would let a name that merely
@@ -139,4 +143,26 @@ def check(modules: List[Module]) -> List[Violation]:
                 "registry/feature-undocumented", node,
                 f"RPC feature flag {flag!r} is advertised by the server but "
                 "documented nowhere under docs/"))
+
+    # every degrade-ladder seam must have a live chaos drill: the
+    # failpoint site its LADDER_SEAMS entry names has to exist in code
+    from karpenter_tpu.analysis.checkers.errflow import LADDER_SEAMS
+
+    code_sites = {site for _, _, site in _collect_failpoint_sites(modules)}
+    by_rel = {m.rel: m for m in modules}
+    for seam in LADDER_SEAMS:
+        mod = by_rel.get(seam.rel)
+        if mod is None:
+            continue  # fixture runs carry partial trees
+        if not seam.failpoint:
+            out.append(mod.violation(
+                "registry/seam-unfailpointed", 1,
+                f"LADDER_SEAMS entry {seam.key} declares no failpoint "
+                "site: a degrade seam needs a chaos drill"))
+        elif seam.failpoint not in code_sites:
+            out.append(mod.violation(
+                "registry/seam-unfailpointed", 1,
+                f"LADDER_SEAMS entry {seam.key} names failpoint site "
+                f"{seam.failpoint!r}, but no failpoints.eval/corrupt/live "
+                "call evaluates that site anywhere in the package"))
     return out

@@ -180,7 +180,9 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
             try:
                 obs(kind, detail)
             except Exception:  # noqa: BLE001 -- observers must never fault the cloud
-                pass
+                from karpenter_tpu import metrics
+
+                metrics.HANDLED_ERRORS.inc(site="kwok.chaos_observer")
 
     # -- plumbing -----------------------------------------------------------
     def _now(self) -> float:
@@ -477,7 +479,9 @@ class FakeCloud(ComputeAPI, PricingAPI, QueueAPI, ParamStoreAPI, IdentityAPI, Cl
             # EventBridge detail.instance-id shape is replayable)
             try:
                 iid = json.loads(body).get("detail", {}).get("instance-id")
-            except Exception:  # noqa: BLE001
+            except (ValueError, AttributeError, TypeError, KeyError):
+                # a malformed chaos payload carries no instance id; the
+                # narrow net keeps real faults (and crashes) propagating
                 iid = None
             if iid:
                 self._notify_chaos("interruption", instance_id=iid)

@@ -335,6 +335,18 @@ FAILPOINT_FIRES = REGISTRY.counter(
     "karpenter_failpoints_fired_total",
     "Fault injections fired by armed failpoints", labels=("site", "action"),
 )
+# silent-absorption accounting (analysis/checkers/errflow.py,
+# errflow/broad-swallow): a must-never-fail handler that deliberately
+# absorbs an error counts it here instead of staying invisible -- the
+# static rule requires every broad `except Exception` to re-raise,
+# convert to a typed error, log, or count into a metric
+HANDLED_ERRORS = REGISTRY.counter(
+    "karpenter_handled_errors_total",
+    "Errors deliberately absorbed by a must-never-fail handler, by "
+    "handler site (the errflow/broad-swallow lint contract: silence "
+    "must be observable)",
+    labels=("site",),
+)
 # incremental delta-solve engine (solver/encode.IncrementalGrouper,
 # solver/rpc.py solve_delta, solver/service.py wiring)
 DELTA_SOLVES = REGISTRY.counter(

@@ -111,6 +111,7 @@ def _real_stats() -> Optional[Dict[str, dict]]:
                 out[f"{d.platform}:{d.id}"] = dict(st)
         return out or None
     except Exception:  # noqa: BLE001 -- accounting must never fail a tick
+        metrics.HANDLED_ERRORS.inc(site="obs.hbm.memory_stats")
         return None
 
 

@@ -154,6 +154,9 @@ class HealthServer:
         try:
             return fn()
         except Exception:  # noqa: BLE001 -- a probe must never 500 on this
+            from karpenter_tpu import metrics
+
+            metrics.HANDLED_ERRORS.inc(site="health.breaker_doc")
             return None
 
     # -- server -------------------------------------------------------------
@@ -194,6 +197,9 @@ class HealthServer:
                 try:
                     doc = fn() if fn is not None else None
                 except Exception:  # noqa: BLE001 -- debug must never 500
+                    from karpenter_tpu import metrics
+
+                    metrics.HANDLED_ERRORS.inc(site="health.debug_endpoint")
                     doc = None
                 self._send(
                     200,

@@ -481,7 +481,9 @@ class Operator:
             if crashed:
                 flight.flush_blackbox(reason="operator-crashed")
         except Exception:  # noqa: BLE001 -- the observatory must never fail a tick
-            pass
+            from karpenter_tpu import metrics
+
+            metrics.HANDLED_ERRORS.inc(site="operator.observe_tick")
 
     def describe_overload(self) -> dict:
         """Overload-control state document for /debug/overload: the

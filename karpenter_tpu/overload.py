@@ -435,7 +435,7 @@ class StuckTickWatchdog:
 
                 flight.flush_blackbox(reason="watchdog-crash")
             except Exception:  # noqa: BLE001 -- best-effort, like cancel
-                pass
+                metrics.HANDLED_ERRORS.inc(site="overload.watchdog.flush")
             # re-check AND raise under the lock: tick_finished takes this
             # same lock, so the exception is pending in the wedged thread
             # before the tick can possibly be marked finished -- a tick
@@ -465,13 +465,13 @@ class StuckTickWatchdog:
                 try:
                     self._cancel()
                 except Exception:  # noqa: BLE001 -- cancel is best-effort
-                    pass
+                    metrics.HANDLED_ERRORS.inc(site="overload.watchdog.cancel")
         elif name == "breaker-open":
             if self._breaker is not None:
                 try:
                     self._breaker.force_open(reason="stuck-tick watchdog")
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 -- escalation is best-effort
+                    metrics.HANDLED_ERRORS.inc(site="overload.watchdog.breaker")
         # (the crash rung already raised above, under the lock)
         return name
 

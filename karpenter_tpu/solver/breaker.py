@@ -182,8 +182,12 @@ class CircuitBreaker:
             # reconnects and re-stages the catalog
             try:
                 self._on_promote()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001
+                self.log.warning(
+                    "breaker promotion hook failed; promoting anyway "
+                    "(the next wire solve reconnects and re-stages)",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
         with self._lock:
             self._probing = False
             if ok:

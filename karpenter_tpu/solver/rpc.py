@@ -1150,15 +1150,26 @@ class SolverClient:
             # only then does the wire get the long per-solve read budget
             if self.path is not None:
                 sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                sock.settimeout(self.connect_timeout)
-                sock.connect(self.path)
+                try:
+                    sock.settimeout(self.connect_timeout)
+                    sock.connect(self.path)
+                except OSError:
+                    # close on the error edge too: a reconnect storm
+                    # against a dead sidecar must not dangle one fd per
+                    # attempt until GC (reslife/leak-on-error)
+                    sock.close()
+                    raise
             else:
                 sock = socket.create_connection(self.addr, timeout=self.connect_timeout)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                if self._ssl_context is not None:
-                    sock = self._ssl_context.wrap_socket(
-                        sock, server_hostname=self._server_hostname
-                    )
+                try:
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    if self._ssl_context is not None:
+                        sock = self._ssl_context.wrap_socket(
+                            sock, server_hostname=self._server_hostname
+                        )
+                except OSError:
+                    sock.close()
+                    raise
             self._sock = sock
             self._wire = sock
             self._staged_seqnums.clear()
@@ -1289,7 +1300,7 @@ class SolverClient:
             if ring is not None:
                 ring.close()
         except Exception:  # noqa: BLE001 -- cancellation is best-effort
-            pass
+            metrics.HANDLED_ERRORS.inc(site="rpc.cancel_inflight")
         try:
             if sock is not None:
                 sock.shutdown(socket.SHUT_RDWR)

@@ -318,7 +318,7 @@ class TPUSolver:
         try:
             self.client.close()
         except Exception:  # noqa: BLE001 -- closing a dead socket is best-effort
-            pass
+            metrics.HANDLED_ERRORS.inc(site="solver.wire_restored_close")
 
     def _local_staged(self, entry: "_CatalogEntry") -> "_CatalogEntry":
         """The entry with HOST-backend staged tensors: remote-mode entries
@@ -853,7 +853,7 @@ class TPUSolver:
                     if k in server
                 }
             except Exception:  # noqa: BLE001 -- debug output must never fail a probe
-                pass
+                metrics.HANDLED_ERRORS.inc(site="solver.describe_wire")
         return doc
 
     # -- entry point (Provisioner contract) ---------------------------------

@@ -258,8 +258,8 @@ class ShmSegment:
         self._closed = True
         try:
             self.mv.release()
-        except Exception:  # noqa: BLE001 -- releasing twice is harmless
-            pass
+        except (BufferError, ValueError):
+            pass  # releasing twice (or with exports live) is harmless
         try:
             self._mm.close()
         except (BufferError, OSError):

@@ -45,6 +45,21 @@ if _JAXW_MODE != "0":
 
     _jax_witness.install(strict=_JAXW_MODE == "strict")
 
+# Runtime exception-escape witness (karpenter_tpu/analysis/errwitness.py):
+# every ladder-class exception (OperatorCrashed/ShmError/StaleSeqnumError/
+# CloudError subclasses) swallowed by a package handler is recorded per
+# handler site and counted into karpenter_errflow_swallowed_total; the
+# session fixture below asserts no UNSANCTIONED site swallowed one (the
+# allowlist is the LADDER_SEAMS + sanctioned-swallow manifests in
+# analysis/checkers/errflow.py, shared with the static pass).
+# KARPENTER_TPU_ERRFLOW_WITNESS=0 disables; =strict raises at the
+# swallow's GC point instead of collecting.
+_ERRW_MODE = os.environ.get("KARPENTER_TPU_ERRFLOW_WITNESS", "1")
+if _ERRW_MODE != "0":
+    from karpenter_tpu.analysis import errwitness as _errwitness
+
+    _errwitness.install(strict=_ERRW_MODE == "strict")
+
 # py3.10 compat: tomllib landed in the stdlib in 3.11; the container ships
 # tomli (the library tomllib was vendored from, same API). Alias it so the
 # bootstrap suites' `import tomllib` works on both.
@@ -90,6 +105,23 @@ def lock_order_witness():
         from karpenter_tpu.analysis import witness
 
         assert not witness.inversions(), witness.report()
+
+
+@pytest.fixture(scope="session", autouse=True)
+def errflow_escape_witness():
+    """Zero-unsanctioned-swallow gate: any package handler site that
+    absorbed a ladder-class exception ANYWHERE in the session without
+    being a LADDER_SEAMS function or a sanctioned-swallow manifest entry
+    fails it with the site and the swallowed exception. (The static
+    errflow pass proves what the AST can see; this covers callbacks,
+    duck-typed receivers, and every handler chaos actually exercised.)"""
+    yield
+    if _ERRW_MODE != "0":
+        from karpenter_tpu.analysis import errwitness
+
+        errwitness.flush()
+        assert not errwitness.swallows(unsanctioned_only=True), \
+            errwitness.report()
 
 
 @pytest.fixture(scope="session", autouse=True)

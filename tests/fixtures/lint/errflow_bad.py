@@ -1,0 +1,42 @@
+"""Seeded errflow violations -- every handler rule must fire here
+(tests/test_analysis.py pins the exact rule set and counts). The seam
+rules fire on the forged-path fixture (errflow_seam_bad.py) instead:
+their scope keys off the real LADDER_SEAMS file paths."""
+
+
+def step():
+    raise ValueError("boom")
+
+
+def cleanup():
+    pass
+
+
+def swallow_crash_bare():
+    try:
+        step()
+    except:  # noqa: E722 -- seeded: a bare except can swallow OperatorCrashed
+        cleanup()
+
+
+def swallow_crash_base():
+    try:
+        step()
+    except BaseException:  # seeded: no raise in the handler body
+        cleanup()
+
+
+def broad_silent():
+    fallback = None
+    try:
+        step()
+    except Exception:  # seeded: neither raises, converts, counts, nor logs
+        fallback = 1
+    return fallback
+
+
+def finally_eats():
+    try:
+        step()
+    finally:
+        return 0  # noqa: B012 -- seeded: swallows any in-flight exception

@@ -1,0 +1,61 @@
+"""Sanctioned error-handling shapes -- the errflow rules must stay
+quiet on every one of these."""
+
+METRIC = None
+log = None
+
+
+def step():
+    raise ValueError("boom")
+
+
+def reraises_crash():
+    try:
+        step()
+    except BaseException:
+        raise  # a crash passes through: sanctioned
+
+
+def converts():
+    try:
+        step()
+    except Exception as e:
+        raise RuntimeError(f"typed: {e}") from e
+
+
+def counts_metric():
+    try:
+        step()
+    except Exception:
+        METRIC.inc(site="here")
+
+
+def logs_it():
+    try:
+        step()
+    except Exception as e:
+        log.warning("step failed", error=str(e))
+
+
+def typed_return():
+    try:
+        step()
+    except Exception as e:
+        return ValueError(str(e))  # the fan-out conversion shape
+    return None
+
+
+def narrow_is_fine():
+    try:
+        step()
+    except (ValueError, KeyError):
+        pass  # narrow handlers are not the broad-swallow rule's business
+
+
+def loop_break_inside_finally():
+    try:
+        step()
+    finally:
+        for i in range(3):
+            if i:
+                break  # the loop lives inside the finally: swallows nothing

@@ -1,0 +1,14 @@
+"""Seam-rule fixture, loaded FORGED under karpenter_tpu/solver/service.py
+(the LADDER_SEAMS scope keys off real file paths):
+
+- TPUSolver._finish_remote leaks ConnectionError -> seam-ladder-escape
+  (the terminal rung's must_handle contract).
+- TPUSolver._probe_sidecar is missing entirely -> seam-missing.
+"""
+
+
+class TPUSolver:
+    def _finish_remote(self, pending):
+        # seeded: a wire failure escaping the terminal rung instead of
+        # degrading to the in-process host solve
+        raise ConnectionError("leaked past the ladder")

@@ -1,0 +1,36 @@
+"""Mid-rung seam fixture, loaded FORGED under karpenter_tpu/solver/rpc.py:
+SolverClient._roundtrip lets RuntimeError (a ladder class) escape, which
+its may_raise declaration does not cover -> seam-undeclared-escape. The
+other rpc seams are stubbed clean so exactly one seam rule fires."""
+
+
+class SolverClient:
+    def _conn(self):
+        pass
+
+    def _try_shm(self, sock):
+        pass
+
+    def _roundtrip(self, header, tensors=()):
+        # seeded: a ladder-class escape the seam never declared
+        raise RuntimeError("routed outside the breaker")
+
+    def begin_solve_compact(self, *a, **k):
+        pass
+
+    def finish_solve_compact(self, handle):
+        pass
+
+    def _solve_op(self, *a, **k):
+        pass
+
+    def _disrupt_roundtrip(self, *a, **k):
+        pass
+
+    def stage_catalog(self, *a, **k):
+        pass
+
+
+class SolverServer:
+    def _dispatch(self, sock, header, tensors):
+        pass

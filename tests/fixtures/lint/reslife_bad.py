@@ -1,0 +1,37 @@
+"""Seeded resource-lifecycle violations -- every reslife rule must fire
+here (tests/test_analysis.py pins the exact rule set and counts)."""
+import mmap
+import os
+import socket
+import threading
+
+
+def risky():
+    pass
+
+
+def unreleased():
+    s = socket.socket()
+    s.connect(("127.0.0.1", 1))  # seeded: used, never closed, never escapes
+
+
+def leak_before_handoff(holder):
+    s = socket.socket()
+    s.connect(("127.0.0.1", 1))  # seeded: can raise with nothing closing s
+    holder.sock = s
+
+
+def leak_window_to_close():
+    f = os.open("/tmp/reslife-fixture", 0)
+    risky()  # seeded: raises past the fall-through-only close below
+    os.close(f)
+
+
+def unjoined():
+    t = threading.Thread(target=print)
+    t.start()  # seeded: non-daemon, never joined, never escapes
+
+
+class PinsForever:
+    def __init__(self):
+        self._mm = mmap.mmap(-1, 4096)  # seeded: no method ever releases it

@@ -1,0 +1,66 @@
+"""Sanctioned resource-lifecycle shapes -- reslife must stay quiet on
+every one of these (they are the repo's real idioms)."""
+import os
+import socket
+import threading
+
+
+def with_statement():
+    with socket.socket() as s:
+        s.connect(("127.0.0.1", 1))
+
+
+def try_finally():
+    s = socket.socket()
+    try:
+        s.connect(("127.0.0.1", 1))
+    finally:
+        s.close()
+
+
+def except_edge_then_handoff(holder):
+    # the _conn shape: close on the error edge, re-raise, adopt on success
+    s = socket.socket()
+    try:
+        s.settimeout(1.0)
+        s.connect(("127.0.0.1", 1))
+    except OSError:
+        s.close()
+        raise
+    holder.sock = s
+
+
+def wrap_continues_the_resource(ctx, holder):
+    s = socket.create_connection(("127.0.0.1", 1))
+    try:
+        s = ctx.wrap_socket(s)  # rebind-through-call: same resource
+    except OSError:
+        s.close()
+        raise
+    holder.sock = s
+
+
+def daemon_thread():
+    t = threading.Thread(target=print, daemon=True)
+    t.start()
+
+
+def joined_thread():
+    t = threading.Thread(target=print)
+    t.start()
+    t.join()
+
+
+def immediate_handoff(registry):
+    s = socket.socket()
+    registry.adopt(s)  # ownership transfer with no risky window
+
+
+class Lifecycled:
+    def __init__(self):
+        self._fd = os.open("/tmp/reslife-fixture", 0)
+        self._sock = socket.socket()
+
+    def close(self):
+        os.close(self._fd)  # arg-style release
+        self._sock.close()  # receiver-style release
