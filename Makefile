@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos overload sim-corpus lint typecheck
+.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate bench-fleet benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos overload sim-corpus sim-fleet multichip lint typecheck
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -15,7 +15,7 @@ lint:  ## AST invariant checkers: determinism, lock discipline, zero-copy wire, 
 
 typecheck:  ## targeted mypy over the solver package, the intent journal, the mesh layer, and the analysis tooling incl. every checker family (hack/mypy.ini); skips with a notice where mypy is not installed (CI always runs it)
 	@if $(PY) -c "import mypy" >/dev/null 2>&1; then \
-		$(PY) -m mypy --config-file hack/mypy.ini karpenter_tpu/solver/ karpenter_tpu/journal.py karpenter_tpu/parallel/ karpenter_tpu/analysis/ karpenter_tpu/analysis/checkers/; \
+		$(PY) -m mypy --config-file hack/mypy.ini karpenter_tpu/solver/ karpenter_tpu/journal.py karpenter_tpu/parallel/ karpenter_tpu/fleet/ karpenter_tpu/analysis/ karpenter_tpu/analysis/checkers/; \
 	else \
 		echo "typecheck: mypy not installed in this environment; skipping (the CI typecheck job runs it; pip install mypy to run locally)"; \
 	fi
@@ -55,6 +55,9 @@ bench-wire:  ## transport stage only (wire v2: warm_wire_p50/p99_ms shm vs tcp, 
 bench-consolidate:  ## consolidation stage only (disrupt engine: consolidation_nodes_per_s >=100 at tier, sweep p50/p99, device-vs-wire verdict differential asserted 0, warm retrace count); one JSON line
 	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --consolidate-only > bench_consolidate_last.json; rc=$$?; cat bench_consolidate_last.json; exit $$rc
 
+bench-fleet:  ## fleet tier: 500k-pod/2k-type mesh-sharded solve (sharded warm-tick p50/p99, in-jit all-gather share, sharded==unsharded differential, multi-tenant coalescing gain); memory-aware skip on small rigs; one JSON line
+	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --fleet-only > bench_fleet_last.json; rc=$$?; cat bench_fleet_last.json; exit $$rc
+
 # the chaos-family soaks route the observatory's crash-flushed black box
 # (karpenter_tpu/obs/flight.py) into their artifact dirs, so a failing
 # job uploads the last 256 ticks of flight data next to its shrunk repro
@@ -69,6 +72,13 @@ overload:  ## overload storm soak: 10x offered load against the deadline-budgete
 
 sim-corpus:  ## differential-replay the committed scenario corpus (host vs wire vs pipelined, golden digests); shrinks any failing trace into sim-artifacts/
 	$(PY) -m karpenter_tpu sim corpus --dir tests/golden/scenarios --artifacts sim-artifacts $(call STAMP,sim-corpus)
+
+sim-fleet:  ## multi-tenant fleet replay: N engines sharing one coalescing sidecar; per-tenant digests pinned in multi-cluster-storm.digests.json (multi-tenant == isolated)
+	$(PY) -m karpenter_tpu sim fleet --tenants 3 $(call STAMP,sim-fleet)
+
+multichip:  ## the MULTICHIP bit-identity gate on the virtual 8-device host mesh: mesh-sharding + fleet differential suites plus the graft-entry dry-run (CI runs this on every PR; on hardware the same tests assert on real chips)
+	$(PYTEST) tests/test_mesh.py tests/test_fleet.py tests/test_tenant.py -q -m 'not slow' \
+	&& $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)" $(call STAMP,multichip)
 
 e2e:  ## scale + end-to-end suites only
 	$(PYTEST) tests/test_scale.py tests/test_e2e_provisioning.py tests/test_storage.py tests/test_soak.py -q

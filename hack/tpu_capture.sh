@@ -22,6 +22,7 @@ cd /root/repo
 OUT=BENCH_TPU_CAPTURE.json
 WIRE_OUT=BENCH_WIRE_CAPTURE.json
 CONSOLIDATE_OUT=BENCH_CONSOLIDATION_CAPTURE.json
+MESH_OUT=BENCH_MESH_CAPTURE.json
 MEM_OUT=BENCH_TPU_MEMSTATS.json
 PROFILE_DIR=BENCH_TPU_PROFILE
 LOG=BENCH_TPU_CAPTURE.log
@@ -94,6 +95,23 @@ print('BACKEND=' + jax.default_backend())
           echo "[capture] consolidation stage failed/degraded; captures stand" >> "$LOG"
           cat "$CONSOLIDATE_OUT.tmp" >> "$LOG" 2>/dev/null
           rm -f "$CONSOLIDATE_OUT.tmp"
+        fi
+        # fleet stage on the same warm tunnel (the mesh-sharding ROADMAP
+        # item's on-TPU acceptance numbers): 500k-pod/2k-type sharded
+        # warm-tick p50/p99, the in-jit all-gather's share of device
+        # exec, sharded == unsharded asserted at tier, and the
+        # per-tenant coalescing gain. On real chips the full production
+        # group budget runs (the CPU rig's bounded-g_max cap does not
+        # apply). Best-effort like the other stages.
+        echo "[capture] fleet stage $(date -u +%H:%M:%S)" >> "$LOG"
+        if timeout 1800 env BENCH_PROBE_BUDGET_S=120 BENCH_CPU_BUDGET_S=60 FLEET_G_MAX=1024 KARPENTER_TPU_JAX_WITNESS=1 python bench.py --fleet-only > "$MESH_OUT.tmp" 2>> "$LOG" \
+           && grep -q '"platform"' "$MESH_OUT.tmp" && ! grep -q '"platform": "cpu"' "$MESH_OUT.tmp"; then
+          mv "$MESH_OUT.tmp" "$MESH_OUT"
+          echo "[capture] fleet SUCCESS $(date -u +%H:%M:%S)" >> "$LOG"
+        else
+          echo "[capture] fleet stage failed/degraded; captures stand" >> "$LOG"
+          cat "$MESH_OUT.tmp" >> "$LOG" 2>/dev/null
+          rm -f "$MESH_OUT.tmp"
         fi
         # one 10-tick programmatic profiler trace of the controller rig
         # (the observatory's --profile-ticks seam): the on-device

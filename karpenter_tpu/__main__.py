@@ -143,12 +143,23 @@ def build_operator(args):
                 auto_probe=True,
                 **breaker_kw,
             )
-        solver = TPUSolver(auto_warm=client is None, client=client, breaker=breaker)
+        # mesh-sharded production solve (karpenter_tpu/fleet/): in-process
+        # mode only -- a sidecar owns its own mesh via `python -m
+        # karpenter_tpu.solver.rpc --mesh`
+        mesh = None
+        if client is None:
+            from karpenter_tpu.fleet.shard import mesh_from_env, parse_mesh_spec
+
+            spec = getattr(args, "mesh_devices", None)
+            mesh = parse_mesh_spec(spec) if spec else mesh_from_env()
+        solver = TPUSolver(
+            auto_warm=client is None, client=client, breaker=breaker, mesh=mesh,
+        )
         # the consolidation engine rides the SAME wire as the scheduling
         # solve: with a sidecar configured, candidate-set sweeps dispatch
         # as the solve_disrupt op against the catalogs already staged per
         # seqnum, and the breaker's degrade ladder covers both paths
-        evaluator = ConsolidationEvaluator(solver=solver)
+        evaluator = ConsolidationEvaluator(solver=solver, mesh=mesh)
     cluster = None
     if getattr(args, "kubeconfig", None) or getattr(args, "in_cluster", False):
         # real coordination bus (the reference's kwok deployment topology:
@@ -203,6 +214,13 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--tpu-solver", action=argparse.BooleanOptionalAction, default=True,
         help="route scheduling + consolidation decisions through the accelerator",
+    )
+    parser.add_argument(
+        "--mesh-devices", default=None, metavar="SPEC",
+        help="shard the in-process production solve across a device mesh: "
+        "a count ('8') or NxM hosts-x-devices layout ('2x4'); default "
+        "$KARPENTER_TPU_MESH, else single-device (ignored with a sidecar "
+        "configured -- run the sidecar with --mesh instead)",
     )
     parser.add_argument(
         "--pipelined-scheduling", action=argparse.BooleanOptionalAction, default=True,

@@ -97,6 +97,7 @@ STATIC_ARG_BUCKETS: Dict[str, str] = {
 JIT_SCAN_PREFIXES: Tuple[str, ...] = (
     "karpenter_tpu/solver/",
     "karpenter_tpu/parallel/",
+    "karpenter_tpu/fleet/",
 )
 
 # module -> jit-decorated function names (the decoration-site registry).
@@ -114,7 +115,10 @@ JIT_ENTRY_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
 
 # modules that build jit wrappers dynamically (jax.jit(...) call sites,
 # cached per mesh/statics); the witness polls their caches instead
-DYNAMIC_JIT_MODULES: Tuple[str, ...] = ("karpenter_tpu.parallel.mesh",)
+DYNAMIC_JIT_MODULES: Tuple[str, ...] = (
+    "karpenter_tpu.parallel.mesh",
+    "karpenter_tpu.fleet.shard",
+)
 
 # -- the device hot-path manifest ---------------------------------------------
 #
@@ -160,6 +164,16 @@ DEVICE_HOT_PATH: Dict[str, Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]] =
         ("sharded_solve", "sharded_repack", "_fetch_multiprocess"),
         {},
     ),
+    # fleet subsystem: the mesh engine's dispatch methods run on every
+    # tick of a mesh-configured solver/sidecar -- hot-path by
+    # construction; its one designed barrier is `fetch` (SANCTIONED
+    # below; outputs are replicated on device by the in-jit all-gather,
+    # so the fetch is a local read)
+    "karpenter_tpu/fleet/shard.py": (
+        (),
+        {"MeshSolveEngine": ("solve_fused", "solve_compact", "solve_dense",
+                             "repack", "replace", "fetch", "_put_inputs")},
+    ),
     # device performance observatory (karpenter_tpu/obs/): these run on
     # EVERY tick, so they are hot-path by construction and the jaxhost
     # rules must machine-check they stay sync-free -- their designed
@@ -196,6 +210,7 @@ SANCTIONED_FETCH: Set[Tuple[str, str]] = {
     ("karpenter_tpu/solver/disrupt/engine.py", "_dispatch_local"),
     ("karpenter_tpu/solver/disrupt/engine.py", "_evaluate_local"),
     ("karpenter_tpu/parallel/mesh.py", "_fetch_multiprocess"),
+    ("karpenter_tpu/fleet/shard.py", "fetch"),
     # observatory introspection seams: memory_stats() reads the
     # allocator ledger (metadata, no transfer) and the profiler bracket
     # drives the runtime's own trace collection -- both are designed

@@ -1,0 +1,34 @@
+"""Fleet subsystem: one TPU mesh as the scheduling brain of a fleet.
+
+Two halves (ROADMAP "Mesh-sharded production solve" open item):
+
+- ``fleet/shard.py`` -- the mesh-sharded PRODUCTION solve: promotes the
+  multichip dry-run (``parallel/mesh.py``, MULTICHIP_r05) onto the real
+  tick. Catalog and candidate-pool tensors shard across the device mesh,
+  per-shard winners all-gather INSIDE the jitted entry (replicated
+  ``out_shardings``), and the pipelined ``solve_begin``/``solve_finish``
+  and delta-epoch contracts hold per shard. ``sharded == unsharded`` is
+  bit-identity asserted the way ``host == wire`` is today
+  (tests/test_fleet.py, the ``mesh`` sim backend).
+
+- ``fleet/coalesce.py`` -- the multi-tenant dispatch coalescer: the rpc
+  sidecar already stages catalogs under per-connection seqnums; the
+  coalescer batches concurrent solves from N operator replicas into
+  shared device dispatch windows with deterministic tenant ordering,
+  per-tenant deadline budgets feeding the existing overload ladder, and
+  a per-tenant breaker/degrade so one sick cluster never poisons
+  another. ``multi-tenant == isolated`` is asserted via differential sim
+  replay (``sim/fleet.py``, the ``multi-cluster-storm`` corpus scenario).
+
+``fleet/service.py`` glues both into a deployable sidecar topology.
+"""
+from karpenter_tpu.fleet.coalesce import DispatchCoalescer, TenantRefusal
+from karpenter_tpu.fleet.shard import MeshSolveEngine, mesh_from_env, parse_mesh_spec
+
+__all__ = [
+    "DispatchCoalescer",
+    "MeshSolveEngine",
+    "TenantRefusal",
+    "mesh_from_env",
+    "parse_mesh_spec",
+]

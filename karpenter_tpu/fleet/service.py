@@ -1,0 +1,91 @@
+"""Fleet service glue: the multi-cluster solver sidecar, assembled.
+
+One solver process serves N operator replicas ("tenants" -- one per
+cluster): the rpc server stages each tenant's catalogs/epochs under its
+own ids, the DispatchCoalescer batches their concurrent solves into
+shared device dispatch windows, and (when a mesh is configured) every
+dispatch runs the mesh-sharded jit entries. This module is the small
+assembly layer over `SolverServer(mesh=, coalescer=)` -- the same shape
+the binary exposes as `python -m karpenter_tpu.solver.rpc --coalesce
+--mesh ... --tenant-budget ...` -- shared by the sim fleet replay
+(sim/fleet.py) and ad-hoc embedders.
+
+Sizing (docs/operations.md "Multi-tenant runbook"): each tenant's staged
+state is bounded by the server's LRUs (4 catalogs + 4 class epochs + 4
+disrupt epochs per process-wide store, pressure-evicted below the HBM
+headroom threshold), so tenant count is sized from measured headroom --
+`max_tenants_for_headroom` is that arithmetic, fed by the round-16 HBM
+ledger (obs/hbm.py).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.fleet.coalesce import DispatchCoalescer
+from karpenter_tpu.fleet.shard import MeshSolveEngine, mesh_from_env
+from karpenter_tpu.obs import hbm as obs_hbm
+
+# a 50k-pod/627-type tenant's resident staging footprint, measured on the
+# round-16 ledger (BENCH json staged_bytes_by_kind: catalog ~1.6 MB +
+# class epoch ~0.4 MB + headroom for one in-flight solve's temporaries);
+# deliberately rounded UP -- sizing must err toward fewer tenants
+TENANT_STAGED_BYTES_ESTIMATE = 8 * 1024 * 1024
+
+
+def max_tenants_for_headroom(
+    headroom_bytes: Optional[int] = None,
+    per_tenant_bytes: int = TENANT_STAGED_BYTES_ESTIMATE,
+    reserve_fraction: float = 0.5,
+) -> Optional[int]:
+    """How many tenants the measured device headroom supports, keeping
+    `reserve_fraction` of it free for solve temporaries and compile
+    workspace. None when no allocator ledger exists (CPU backend) --
+    capacity is then bounded by the LRUs alone, and the operator sizes
+    from the runbook's table instead."""
+    if headroom_bytes is None:
+        devices = obs_hbm.poll().get("devices") or {}
+        free = [
+            int(d["bytes_limit"]) - int(d["bytes_in_use"])
+            for d in devices.values()
+            if int(d.get("bytes_limit", 0)) > 0
+        ]
+        if not free:
+            return None
+        headroom_bytes = min(free)
+    usable = int(headroom_bytes * (1.0 - reserve_fraction))
+    return max(usable // int(per_tenant_bytes), 0)
+
+
+def build_fleet_server(
+    *, path: Optional[str] = None, host: str = "127.0.0.1", port: int = 0,
+    token: Optional[str] = None, insecure_tcp: bool = False,
+    mesh=None, coalesce: bool = True,
+    tenant_budget_s: float = 0.0, window_s: Optional[float] = None,
+    **server_kw,
+):
+    """A started SolverServer wired for the fleet topology: the dispatch
+    coalescer on (deterministic tenant ordering, per-tenant breaker and
+    deadline budget) and, when `mesh` (or $KARPENTER_TPU_MESH) names a
+    layout, the mesh-sharded solve engine. `mesh=None` consults the
+    environment; any other falsy value (False, 0, "") pins the
+    single-device path regardless of it -- deterministic gates must not
+    take hidden configuration. Returns the running server; callers own
+    stop()."""
+    from karpenter_tpu.solver.rpc import SolverServer
+
+    if mesh is None:
+        mesh = mesh_from_env()
+    engine = None
+    if mesh:
+        engine = mesh if isinstance(mesh, MeshSolveEngine) else MeshSolveEngine(mesh)
+    coalescer = None
+    if coalesce:
+        kw = {"budget_s": tenant_budget_s}
+        if window_s is not None:
+            kw["window_s"] = window_s
+        coalescer = DispatchCoalescer(**kw)
+    server = SolverServer(
+        host, port, path=path, token=token, insecure_tcp=insecure_tcp,
+        mesh=engine, coalescer=coalescer, **server_kw,
+    )
+    return server.start()

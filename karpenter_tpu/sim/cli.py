@@ -216,6 +216,22 @@ def _cmd_corpus(args) -> int:
             rc = 1
             entry = {"ok": False, "note": f"delta-path invariant violation: {e}"}
         report[f"delta:{name}"] = entry
+        # mesh-path gate (fleet subsystem): the same scenario re-replayed
+        # with the production solve SHARDED over the device mesh (the
+        # virtual 8-device host mesh in CI); its digest must equal the
+        # committed host golden bit-for-bit -- sharded == unsharded,
+        # asserted the way host == wire is
+        try:
+            mres = replay(events, backend="mesh", seed=seed)
+            mentry = {"ok": mres.digest == want, "digest": mres.digest}
+            if not mentry["ok"]:
+                rc = 1
+                mentry["golden_digest"] = want
+                mentry["note"] = "mesh-path digest diverged from golden"
+        except InvariantViolation as e:
+            rc = 1
+            mentry = {"ok": False, "note": f"mesh-path invariant violation: {e}"}
+        report[f"mesh:{name}"] = mentry
     if args.update_digests:
         if rc != 0:
             # never pin a diverging run's digest (or null from a failed
@@ -229,6 +245,50 @@ def _cmd_corpus(args) -> int:
             json.dump(new_digests, f, indent=2, sort_keys=True)
             f.write("\n")
     print(json.dumps({"corpus": report, "ok": rc == 0}, sort_keys=True))
+    return rc
+
+
+def _cmd_fleet(args) -> int:
+    """N tenants through one shared coalescing sidecar (sim/fleet.py):
+    per-tenant digests must equal their isolated replays AND the goldens
+    pinned in multi-cluster-storm.digests.json. The fleet CI gate."""
+    from karpenter_tpu.sim.fleet import replay_fleet
+    from karpenter_tpu.sim.scenario import DEFAULT_SEED
+
+    seed = args.seed if args.seed is not None else DEFAULT_SEED
+    res = replay_fleet(args.tenants, base_seed=seed, mesh=args.mesh)
+    digest_path = os.path.join(args.dir, "multi-cluster-storm.digests.json")
+    golden = {}
+    if os.path.exists(digest_path):
+        with open(digest_path) as f:
+            golden = json.load(f)
+    rc = 0 if res.ok else 1
+    report = {
+        "tenants": args.tenants, "seed": seed, "mesh": bool(args.mesh),
+        "digests": res.digests,
+        "divergences": list(res.divergences),
+    }
+    if not args.update_digests and golden:
+        drift = {
+            t: {"golden": golden.get(t), "got": d}
+            for t, d in res.digests.items()
+            if golden.get(t) not in (None, d)
+        }
+        if drift:
+            rc = 1
+            report["drift"] = drift
+            report["note"] = "per-tenant decision digest drifted from golden"
+    if args.update_digests:
+        if rc != 0:
+            print(json.dumps({
+                "fleet": report, "ok": False,
+                "error": "refusing --update-digests: fleet run diverged",
+            }, sort_keys=True))
+            return 1
+        with open(digest_path, "w") as f:
+            json.dump(res.digests, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps({"fleet": report, "ok": rc == 0}, sort_keys=True))
     return rc
 
 
@@ -250,7 +310,8 @@ def main(argv=None) -> int:
 
     rep = sub.add_parser("replay", help="replay a trace through the operator stack")
     rep.add_argument("trace")
-    rep.add_argument("--backend", choices=("host", "wire", "pipelined", "delta"),
+    rep.add_argument("--backend",
+                     choices=("host", "wire", "pipelined", "delta", "tcp", "mesh"),
                      default="host")
     rep.add_argument("--differential", action="store_true",
                      help="replay through host+wire+pipelined and compare")
@@ -264,7 +325,8 @@ def main(argv=None) -> int:
     shr.add_argument("trace")
     shr.add_argument("--mode", choices=("differential", "invariant"),
                      default="differential")
-    shr.add_argument("--backend", choices=("host", "wire", "pipelined", "delta"),
+    shr.add_argument("--backend",
+                     choices=("host", "wire", "pipelined", "delta", "tcp", "mesh"),
                      default="host", help="backend for --mode invariant")
     shr.add_argument("--seed", type=int, default=None)
     shr.add_argument("--max-probes", type=int, default=2_000)
@@ -277,6 +339,21 @@ def main(argv=None) -> int:
     cor.add_argument("--update-digests", action="store_true",
                      help="rewrite digests.json from this run")
     cor.set_defaults(fn=_cmd_corpus)
+
+    flt = sub.add_parser(
+        "fleet",
+        help="multi-tenant replay: N engines sharing one coalescing "
+        "sidecar, per-tenant golden digests (multi-tenant == isolated)",
+    )
+    flt.add_argument("--tenants", type=int, default=3)
+    flt.add_argument("--seed", type=int, default=None)
+    flt.add_argument("--mesh", action="store_true",
+                     help="also shard the shared sidecar's solves over "
+                     "the device mesh")
+    flt.add_argument("--dir", default="tests/golden/scenarios")
+    flt.add_argument("--update-digests", action="store_true",
+                     help="rewrite multi-cluster-storm.digests.json from this run")
+    flt.set_defaults(fn=_cmd_fleet)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -52,8 +52,13 @@ BACKENDS = ("host", "wire", "pipelined")
 # - "tcp": the wire sidecar with the shared-memory ring transport FORCED
 #   off (wire backends on a UNIX socket negotiate shm by default since
 #   wire v2, so the trio already exercises the ring; this backend pins
-#   the socket path, proving shm == tcp == host decision digests).
-EXTRA_BACKENDS = ("delta", "tcp")
+#   the socket path, proving shm == tcp == host decision digests);
+# - "mesh": TPUSolver in-process with the production solve sharded over
+#   the device mesh (karpenter_tpu/fleet/shard.py; the virtual 8-device
+#   CPU mesh in CI) -- the corpus gate replays one scenario through it
+#   and fails on any digest divergence from the committed host golden
+#   (sharded == unsharded, asserted the way host == wire is).
+EXTRA_BACKENDS = ("delta", "tcp", "mesh")
 
 DEFAULT_TICK_SECONDS = 3.0
 MAX_SETTLE_TICKS = 80
@@ -104,7 +109,8 @@ def _percentile(samples: List[float], q: float) -> float:
 
 class _Engine:
     def __init__(self, backend: str, seed: int, tmpdir: Optional[str] = None,
-                 options_overrides: Optional[dict] = None):
+                 options_overrides: Optional[dict] = None,
+                 server_path: Optional[str] = None, tenant: Optional[str] = None):
         if backend not in BACKENDS + EXTRA_BACKENDS:
             raise ValueError(
                 f"unknown backend {backend!r} (want one of {BACKENDS + EXTRA_BACKENDS})"
@@ -112,6 +118,12 @@ class _Engine:
         self.backend = backend
         self.seed = seed
         self._tmpdir = tmpdir
+        # fleet replay (sim/fleet.py): connect to a SHARED sidecar at
+        # `server_path` under this tenant id instead of spawning one --
+        # close() then tears down only the client; the shared server's
+        # owner stops it
+        self._server_path = server_path
+        self._tenant = tenant
         # trace-header Options overrides, applied in build() through an
         # explicit WHITELIST (the overload knobs): a trace must not be
         # able to flip arbitrary process policy
@@ -169,14 +181,29 @@ class _Engine:
 
         if self.backend == "host":
             solver = TPUSolver(g_max=64)
+        elif self.backend == "mesh":
+            # the sharded production solve on the virtual device mesh
+            # (fleet/shard.py): in-process like "host", every dispatch
+            # through the mesh engine -- digest equality with the host
+            # golden IS the sharded == unsharded differential
+            import jax
+
+            from karpenter_tpu.parallel.mesh import make_mesh
+
+            solver = TPUSolver(g_max=64, mesh=make_mesh(min(8, len(jax.devices()))))
         else:
             from karpenter_tpu.solver.rpc import SolverClient, SolverServer
 
-            if self._tmpdir is None:
-                self._own_tmpdir = tempfile.TemporaryDirectory(prefix="karpenter-sim-")
-                self._tmpdir = self._own_tmpdir.name
-            sock = os.path.join(self._tmpdir, f"solver-{self.backend}.sock")
-            self._server = SolverServer(path=sock).start()
+            if self._server_path is not None:
+                # fleet replay: the shared coalescing sidecar already
+                # listens here; this engine is one tenant of it
+                sock = self._server_path
+            else:
+                if self._tmpdir is None:
+                    self._own_tmpdir = tempfile.TemporaryDirectory(prefix="karpenter-sim-")
+                    self._tmpdir = self._own_tmpdir.name
+                sock = os.path.join(self._tmpdir, f"solver-{self.backend}.sock")
+                self._server = SolverServer(path=sock).start()
             # the delta backend forces delta class shipping on (wire and
             # pipelined inherit the environment default, which is also on
             # -- the trio therefore exercises the delta path in CI, and
@@ -187,6 +214,7 @@ class _Engine:
                 # "tcp" pins the socket transport; everything else takes
                 # the environment default (shm ring on a UNIX socket)
                 shm=False if self.backend == "tcp" else None,
+                tenant=self._tenant,
             )
             self._breaker = CircuitBreaker(
                 failure_threshold=2, backoff_base=1000.0, rng=breaker_rng

@@ -390,6 +390,30 @@ def _scenario_overload_storm(seed: int) -> ScenarioBuilder:
     return b
 
 
+def _scenario_multi_cluster_storm(seed: int) -> ScenarioBuilder:
+    """Fleet family: ONE cluster's slice of a multi-cluster arrival storm.
+    The fleet replay (sim/fleet.py) derives N tenant variants of this
+    scenario (per-tenant seeds -> staggered storm starts and distinct pod
+    mixes) and drives them through ONE coalescing solver sidecar; each
+    tenant's digest is pinned per seed in multi-cluster-storm.digests.json
+    and must equal its isolated single-sidecar replay bit-for-bit
+    (multi-tenant == isolated). The base trace also rides the standard
+    corpus differential (host == wire == pipelined) like every scenario."""
+    b = ScenarioBuilder("multi-cluster-storm", seed)
+    # synchronous duo, like diurnal-consolidation: a storm's per-tick
+    # batch composition legitimately shifts under the pipelined tick's
+    # one-tick decision lag, so pod->group placements differ while both
+    # stay individually correct; the pipelined path keeps its corpus
+    # coverage via the other scenarios, and THIS scenario's job is the
+    # host == wire golden plus the multi-tenant fleet gate.
+    b.backends("host", "wire")
+    stagger = float(seed % 5) * 1.5
+    b.sustained_storm(start=stagger, duration=9.0, rate_per_s=2.5)
+    b.poisson_arrivals(start=stagger + 12.0, duration=6.0, rate_per_s=1.0)
+    b.pod_churn(t=stagger + 21.0, fraction=0.3)
+    return b
+
+
 STANDARD_SCENARIOS = {
     "diurnal-small": _scenario_diurnal_small,
     "diurnal-medium": _scenario_diurnal_medium,
@@ -400,13 +424,14 @@ STANDARD_SCENARIOS = {
     "binpack-adversarial": _scenario_binpack_adversarial,
     "crash-restart": _scenario_crash_restart,
     "overload-storm": _scenario_overload_storm,
+    "multi-cluster-storm": _scenario_multi_cluster_storm,
 }
 
 # the committed corpus (tests/golden/scenarios/): small, fast, and one per
 # chaos family; diurnal-medium stays generate-on-demand (bench's stage)
 CORPUS_SCENARIOS = (
     "diurnal-small", "diurnal-consolidation", "ice-storm",
-    "interruption-wave", "overload-storm",
+    "interruption-wave", "overload-storm", "multi-cluster-storm",
 )
 DEFAULT_SEED = 20260803
 

@@ -356,6 +356,39 @@ def expand_reply_v2(header: dict, t: Dict[str, np.ndarray], g_max: int):
 
 # -- server ------------------------------------------------------------------
 
+class _ReplyBuffer:
+    """Capture a coalesced op's reply frames in memory so the SHARED
+    dispatcher thread never blocks on one tenant's socket: a stalled
+    operator (full TCP window, SIGSTOP'd controller) must cost ITS
+    handler thread at flush time, never head-of-line-block every other
+    tenant's window. Quacks like the frame wire for _send_frame's
+    purposes (sendmsg/sendall + the transport label); the one buffered
+    copy per reply is the price of the isolation and replies are small
+    (reply_v2 trims them to the decision rows)."""
+
+    def __init__(self, sock):
+        self.transport_label = _transport(sock)
+        self._chunks: List[bytes] = []
+
+    def sendmsg(self, bufs) -> int:
+        n = 0
+        for b in bufs:
+            bb = bytes(b)
+            self._chunks.append(bb)
+            n += len(bb)
+        return n
+
+    def sendall(self, data) -> None:
+        self._chunks.append(bytes(data))
+
+    def flush_to(self, sock) -> None:
+        """Write the buffered frames onto the real wire -- called from
+        the submitting connection's own handler thread."""
+        for chunk in self._chunks:
+            sock.sendall(chunk)
+        self._chunks.clear()
+
+
 class _StagedEntry:
     def __init__(self, staged, offsets, words):
         self.staged = staged
@@ -379,8 +412,23 @@ class SolverServer:
         handshake_timeout: float = 30.0,
         shm: Optional[bool] = None, shm_size: Optional[int] = None,
         shm_dir: Optional[str] = None,
+        mesh=None, coalescer=None,
     ):
         from karpenter_tpu.solver import shm as shm_mod
+
+        # fleet subsystem (karpenter_tpu/fleet/): `mesh` is a
+        # MeshSolveEngine (or a Mesh/layout spec) routing every device
+        # dispatch through the sharded jit entries -- sharded==unsharded
+        # bit-identity means the wire contract is byte-unchanged;
+        # `coalescer` is a DispatchCoalescer batching concurrent
+        # per-tenant solve ops into shared dispatch windows.
+        if mesh is not None:
+            from karpenter_tpu.fleet.shard import MeshSolveEngine
+
+            if not isinstance(mesh, MeshSolveEngine):
+                mesh = MeshSolveEngine(mesh)
+        self._mesh = mesh
+        self._coalescer = coalescer
 
         # shared-memory ring transport (solver/shm.py): advertised in ping
         # features and established per connection via the shm_open op.
@@ -535,6 +583,10 @@ class SolverServer:
         return self
 
     def stop(self) -> None:
+        if self._coalescer is not None:
+            # fail queued tenant submissions first so handler threads
+            # blocked in submit() unwind before the listener dies
+            self._coalescer.close()
         with self._lock:
             segs = list(self._live_segs)
         for seg in segs:
@@ -571,23 +623,50 @@ class SolverServer:
                 ]
                 if self._shm_enabled:
                     features.append("shm")
+                if self._coalescer is not None:
+                    features.append("coalesce")
                 _send_frame(sock, {"ok": True, "features": features})
             elif op == "stage":
                 self._op_stage(sock, header, tensors)
-            elif op == "solve":
-                self._op_solve(sock, header, tensors, wt)
-            elif op == "solve_compact":
-                self._op_solve_compact(sock, header, tensors, wt)
-            elif op == "solve_delta":
-                self._op_solve_delta(sock, header, tensors, wt)
-            elif op == "solve_disrupt":
-                self._op_solve_disrupt(sock, header, tensors, wt)
+            elif op in ("solve", "solve_compact", "solve_delta", "solve_disrupt"):
+                if self._coalescer is not None:
+                    # fleet topology: device dispatches from N tenants
+                    # batch into shared windows with deterministic tenant
+                    # ordering; a TenantRefusal (breaker open, deadline
+                    # blown while queued) or a per-tenant dispatch error
+                    # re-raises HERE -- in this tenant's handler thread --
+                    # and crosses the wire as ITS error reply below,
+                    # never another tenant's. The reply itself buffers
+                    # inside the window and flushes from THIS thread, so
+                    # a stalled tenant socket can never head-of-line-
+                    # block the shared dispatcher.
+                    reply = _ReplyBuffer(sock)
+                    self._coalescer.submit(
+                        str(header.get("tenant", "")),
+                        lambda: self._dispatch_solve(reply, op, header, tensors, wt),
+                    )
+                    reply.flush_to(sock)
+                else:
+                    self._dispatch_solve(sock, op, header, tensors, wt)
             elif op == "debug":
                 self._op_debug(sock)
             else:
                 _send_frame(sock, {"ok": False, "error": f"unknown op {op!r}"})
         except Exception as e:  # noqa: BLE001 -- errors cross the wire
             _send_frame(sock, {"ok": False, "error": f"{type(e).__name__}: {e}"})
+
+    def _dispatch_solve(self, sock, op: str, header: dict,
+                        tensors: Dict[str, np.ndarray], wt) -> None:
+        """The device-dispatching ops (everything the fleet coalescer
+        batches); replies stream on the submitting connection's wire."""
+        if op == "solve":
+            self._op_solve(sock, header, tensors, wt)
+        elif op == "solve_compact":
+            self._op_solve_compact(sock, header, tensors, wt)
+        elif op == "solve_delta":
+            self._op_solve_delta(sock, header, tensors, wt)
+        else:
+            self._op_solve_disrupt(sock, header, tensors, wt)
 
     def _op_shm_open(self, sock, wire, seg):
         """Transport-level handshake for the shared-memory ring (handled
@@ -645,7 +724,12 @@ class SolverServer:
             tcap=t["tcap"], price=t["price"], vocabs=[], zones=list(header["zones"]),
             words=list(words),
         )
-        staged, offsets, words = ffd.stage_catalog(catalog)
+        if self._mesh is not None:
+            # fleet: catalog tensors stage K-sharded across the mesh once
+            # per seqnum; every tenant's later solves reuse the shards
+            staged, offsets, words = self._mesh.stage_catalog(catalog)
+        else:
+            staged, offsets, words = ffd.stage_catalog(catalog)
         with self._lock:
             if len(self._staged) >= 4 and seqnum not in self._staged:
                 self._staged.pop(next(iter(self._staged)))
@@ -714,6 +798,10 @@ class SolverServer:
                 "evictions": dict(self._evictions),
                 "staged_bytes": self._staged_bytes_locked(),
             }
+        if self._mesh is not None:
+            doc["mesh"] = self._mesh.describe()
+        if self._coalescer is not None:
+            doc["coalescer"] = self._coalescer.describe()
         _send_frame(sock, doc)
 
     def _op_solve_delta(self, sock, header: dict, t: Dict[str, np.ndarray],
@@ -865,11 +953,18 @@ class SolverServer:
             return
         entry, inp = hit
         with wt.stage("device", op="solve"):
-            out = ffd.ffd_solve(
-                inp, g_max=int(header["g_max"]),
-                word_offsets=entry.offsets, words=entry.words,
-                objective=str(header.get("objective", "price")),
-            )
+            if self._mesh is not None:
+                out = self._mesh.solve_dense(
+                    inp, g_max=int(header["g_max"]),
+                    word_offsets=entry.offsets, words=entry.words,
+                    objective=str(header.get("objective", "price")),
+                )
+            else:
+                out = ffd.ffd_solve(
+                    inp, g_max=int(header["g_max"]),
+                    word_offsets=entry.offsets, words=entry.words,
+                    objective=str(header.get("objective", "price")),
+                )
             if wt.ctx is not None:
                 # jit dispatch is ASYNC: without a barrier the XLA compute
                 # would block inside device_get and the echo would claim
@@ -900,11 +995,18 @@ class SolverServer:
             return
         entry, inp = hit
         with wt.stage("device", op="solve_compact"):
-            dec = ffd.ffd_solve_compact(
-                inp, g_max=int(header["g_max"]), nnz_max=int(header["nnz_max"]),
-                word_offsets=entry.offsets, words=entry.words,
-                objective=str(header.get("objective", "price")),
-            )
+            if self._mesh is not None:
+                dec = self._mesh.solve_compact(
+                    inp, g_max=int(header["g_max"]), nnz_max=int(header["nnz_max"]),
+                    word_offsets=entry.offsets, words=entry.words,
+                    objective=str(header.get("objective", "price")),
+                )
+            else:
+                dec = ffd.ffd_solve_compact(
+                    inp, g_max=int(header["g_max"]), nnz_max=int(header["nnz_max"]),
+                    word_offsets=entry.offsets, words=entry.words,
+                    objective=str(header.get("objective", "price")),
+                )
             if wt.ctx is not None:
                 # see _op_solve: sync traced requests so XLA compute lands
                 # in "device", not "fetch"
@@ -948,9 +1050,14 @@ class SolverServer:
         reply: List[Tuple[str, np.ndarray]] = []
         if "member" in t:  # the repack half
             with wt.stage("device", op="solve_disrupt"):
-                lo, _ = disrupt_kernel.disrupt_repack(
-                    t["headroom"], t["feas"], t["req"], t["member"], t["excl"]
-                )
+                if self._mesh is not None:
+                    lo, _ = self._mesh.repack(
+                        t["headroom"], t["feas"], t["req"], t["member"], t["excl"]
+                    )
+                else:
+                    lo, _ = disrupt_kernel.disrupt_repack(
+                        t["headroom"], t["feas"], t["req"], t["member"], t["excl"]
+                    )
                 if wt.ctx is not None:
                     # see _op_solve: sync traced requests so XLA compute
                     # lands in "device", not "fetch"
@@ -987,11 +1094,18 @@ class SolverServer:
                 return
             od_col = int(encode.CAPTYPE_INDEX[wk.CAPACITY_TYPE_ON_DEMAND])
             with wt.stage("device", op="disrupt_replace"):
-                out = disrupt_kernel.disrupt_replace(
-                    leftover, t["creq"], t["compat"], t["azone"], t["acap"],
-                    entry.staged.cap, t["ovh"], entry.staged.price,
-                    od_col=od_col,
-                )
+                if self._mesh is not None:
+                    out = self._mesh.replace(
+                        leftover, t["creq"], t["compat"], t["azone"], t["acap"],
+                        entry.staged.cap, t["ovh"], entry.staged.price,
+                        od_col=od_col,
+                    )
+                else:
+                    out = disrupt_kernel.disrupt_replace(
+                        leftover, t["creq"], t["compat"], t["azone"], t["acap"],
+                        entry.staged.cap, t["ovh"], entry.staged.price,
+                        od_col=od_col,
+                    )
                 if wt.ctx is not None:
                     jax.block_until_ready(out)
             with wt.stage("fetch"):
@@ -1053,10 +1167,17 @@ class SolverClient:
         connect_timeout: float = DEFAULT_CONNECT_TIMEOUT,
         delta: Optional[bool] = None,
         shm: Optional[bool] = None, reply_v2: Optional[bool] = None,
-        track_transport: bool = True,
+        track_transport: bool = True, tenant: Optional[str] = None,
     ):
         self.addr = (host, port) if path is None else None
         self.path = path
+        # fleet topology (karpenter_tpu/fleet/): the tenant id this
+        # replica's solve ops carry -- the shared sidecar's coalescer keys
+        # its deterministic ordering, deadline budget, and per-tenant
+        # breaker on it. None (the single-cluster default) omits the
+        # field; the server then treats the connection as the anonymous
+        # tenant, which is exactly the pre-fleet behavior.
+        self.tenant = str(tenant) if tenant else None
         # karpenter_wire_transport_in_use is process-global: only the
         # PRIMARY client (the solver's real wire) reports to it. Throwaway
         # connections -- the breaker's half-open probe, ad-hoc tooling --
@@ -1340,6 +1461,14 @@ class SolverClient:
             # cost one unknown-epoch roundtrip per seqnum before recovering
             self._epoch_bases.clear()
 
+    def _op_header(self, **fields) -> dict:
+        """An op header carrying this replica's tenant id (fleet
+        topology); single-cluster clients omit the field entirely so the
+        frames are byte-identical to the pre-fleet protocol."""
+        if self.tenant is not None:
+            fields["tenant"] = self.tenant
+        return fields
+
     # -- request pipelining (the async solve path) ---------------------------
     def _drain_pending(self, target: Optional[_PendingReply] = None) -> None:
         """Receive outstanding replies in FIFO order (all of them, or up to
@@ -1384,10 +1513,10 @@ class SolverClient:
         seqnum surfaces as StaleSeqnumError -- no silent restage."""
         if not nnz_max:
             nnz_max = ffd.nnz_budget(class_set.c_pad, g_max)
-        header = {
-            "op": "solve_compact", "seqnum": seqnum, "g_max": g_max,
-            "nnz_max": nnz_max, "objective": objective,
-        }
+        header = self._op_header(
+            op="solve_compact", seqnum=seqnum, g_max=g_max,
+            nnz_max=nnz_max, objective=objective,
+        )
         # trace-id propagation: the DISPATCHING tick's context rides the
         # request header; the server echoes it (plus its stage timings)
         # in the reply, so the claim side can graft the stages even when
@@ -1755,7 +1884,9 @@ class SolverClient:
         self, seqnum: str, catalog: encode.CatalogTensors, class_set: encode.PodClassSet,
         g_max: int = 512, objective: str = "price",
     ) -> ffd.SolveOutputs:
-        header = {"op": "solve", "seqnum": seqnum, "g_max": g_max, "objective": objective}
+        header = self._op_header(
+            op="solve", seqnum=seqnum, g_max=g_max, objective=objective
+        )
         _, out = self._solve_op(header, seqnum, catalog, class_set)
         return ffd.SolveOutputs(**{n: out[n] for n in ffd.SolveOutputs._fields})
 
@@ -1768,10 +1899,10 @@ class SolverClient:
         ffd.expand_compact and falls back to solve_classes on overflow."""
         if not nnz_max:
             nnz_max = ffd.nnz_budget(class_set.c_pad, g_max)
-        header = {
-            "op": "solve_compact", "seqnum": seqnum, "g_max": g_max,
-            "nnz_max": nnz_max, "objective": objective,
-        }
+        header = self._op_header(
+            op="solve_compact", seqnum=seqnum, g_max=g_max,
+            nnz_max=nnz_max, objective=objective,
+        )
         resp, out = self._solve_op(header, seqnum, catalog, class_set)
         return self._compact_from_reply(resp, out, g_max)
 
@@ -1810,7 +1941,7 @@ class SolverClient:
         failpoints.eval("rpc.disrupt.dispatch")
         with self._lock:
             depoch = self._next_epoch()
-            header = {"op": "solve_disrupt", "depoch": depoch}
+            header = self._op_header(op="solve_disrupt", depoch=depoch)
             tensors = list(repack.items())
             if replace is not None and seqnum is not None:
                 header["seqnum"] = seqnum
@@ -1828,7 +1959,7 @@ class SolverClient:
         `leftover` rides along as the stateless fallback for a
         pressure-evicted depoch."""
         failpoints.eval("rpc.disrupt.dispatch")
-        header = {"op": "solve_disrupt", "depoch": depoch, "seqnum": seqnum}
+        header = self._op_header(op="solve_disrupt", depoch=depoch, seqnum=seqnum)
         tensors = list(replace.items())
         if leftover is not None:
             tensors.append(("leftover", leftover))
@@ -1879,6 +2010,24 @@ def serve_main(argv=None) -> int:
         help="ring size per direction (default 8 MiB or "
         f"${'KARPENTER_TPU_SHM_SIZE'}; see docs/operations.md for sizing)",
     )
+    parser.add_argument(
+        "--mesh", default=None, metavar="SPEC",
+        help="shard the production solve across a device mesh: a count "
+        "('8') or an NxM (hosts x devices) layout ('2x4'); default "
+        "$KARPENTER_TPU_MESH, else single-device",
+    )
+    parser.add_argument(
+        "--coalesce", action="store_true",
+        help="fleet topology: batch concurrent solves from N operator "
+        "replicas into shared dispatch windows (deterministic tenant "
+        "ordering, per-tenant breaker; see docs/operations.md)",
+    )
+    parser.add_argument(
+        "--tenant-budget", type=float, default=0.0, metavar="SECONDS",
+        help="per-tenant dispatch deadline budget under --coalesce "
+        "(0 = unbounded); a blown budget refuses THAT tenant's solve "
+        "into its client's overload ladder",
+    )
     args = parser.parse_args(argv)
 
     token = None
@@ -1892,6 +2041,18 @@ def serve_main(argv=None) -> int:
         ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
         ctx.load_cert_chain(args.tls_cert, args.tls_key)
     shm_kw = dict(shm=args.shm, shm_dir=args.shm_dir, shm_size=args.shm_size)
+    mesh = None
+    mesh_spec = args.mesh if args.mesh is not None else os.environ.get("KARPENTER_TPU_MESH")
+    if mesh_spec:
+        from karpenter_tpu.fleet.shard import parse_mesh_spec
+
+        mesh = parse_mesh_spec(mesh_spec)
+    if args.coalesce:
+        from karpenter_tpu.fleet.coalesce import DispatchCoalescer
+
+        shm_kw["coalescer"] = DispatchCoalescer(budget_s=args.tenant_budget)
+    if mesh is not None:
+        shm_kw["mesh"] = mesh
     if args.host is not None:
         server = SolverServer(
             args.host, args.port, token=token,

@@ -125,8 +125,23 @@ class TPUSolver:
     def __init__(
         self, g_max: int = 1024, c_pad_min: int = 16, client=None,
         objective: str = "price", auto_warm: bool = False, breaker=None,
-        incremental: bool = True,
+        incremental: bool = True, mesh=None,
     ):
+        # mesh-sharded production solve (karpenter_tpu/fleet/shard.py):
+        # with a mesh configured (and no wire client -- the sidecar owns
+        # its own mesh in remote mode), catalog staging and every jitted
+        # dispatch route through the MeshSolveEngine's sharded entries.
+        # Decisions are bit-identical to the single-device path (GSPMD
+        # changes placement, never semantics; tests/test_fleet.py), so
+        # everything downstream -- pipelining, delta epochs, the degrade
+        # ladder -- is untouched.
+        self.mesh_engine = None
+        if mesh is not None and client is None:
+            from karpenter_tpu.fleet.shard import MeshSolveEngine
+
+            self.mesh_engine = (
+                mesh if isinstance(mesh, MeshSolveEngine) else MeshSolveEngine(mesh)
+            )
         # auto_warm: precompile every class-count bucket in a background
         # thread whenever a new catalog is staged (see warm()); opt-in so
         # unit tests with tiny catalogs don't pay 5 compiles per solver
@@ -226,9 +241,13 @@ class TPUSolver:
                 return entry
             tensors = encode.encode_catalog(instance_types)
             # remote mode: the sidecar stages on ITS device; no local copy
-            staged, offsets, words = (
-                ffd.stage_catalog(tensors) if self.client is None else (None, (), ())
-            )
+            if self.client is not None:
+                staged, offsets, words = None, (), ()
+            elif self.mesh_engine is not None:
+                # fleet: the catalog stages K-sharded across the mesh
+                staged, offsets, words = self.mesh_engine.stage_catalog(tensors)
+            else:
+                staged, offsets, words = ffd.stage_catalog(tensors)
             # decode acceleration: type objects pre-sorted by cheapest
             # price so per-group survivor lists are one boolean fancy-
             # index instead of a dict-lookup + sort per group
@@ -390,12 +409,21 @@ class TPUSolver:
         for cp in c_pads:
             cs = encode.encode_classes([], entry.tensors, c_pad=cp)
             inp = ffd.make_inputs_staged(entry.staged, cs)
-            outs.append(
-                ffd.ffd_solve_fused(
-                    inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(cp, self.g_max),
-                    word_offsets=entry.offsets, words=entry.words, objective=self.objective,
+            if self.mesh_engine is not None:
+                outs.append(
+                    self.mesh_engine.solve_fused(
+                        inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(cp, self.g_max),
+                        word_offsets=entry.offsets, words=entry.words,
+                        objective=self.objective,
+                    )
                 )
-            )
+            else:
+                outs.append(
+                    ffd.ffd_solve_fused(
+                        inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(cp, self.g_max),
+                        word_offsets=entry.offsets, words=entry.words, objective=self.objective,
+                    )
+                )
             self._warmed_pads.add(self._warm_key(cp, entry))
         jax.block_until_ready(outs)
 
@@ -1587,11 +1615,21 @@ class TPUSolver:
                 nnz_max = ffd.nnz_budget(class_set.c_pad, self.g_max)
                 # HBM attribution: nbytes is array metadata, not a fetch
                 self._last_solve_bytes = obs_hbm.sum_nbytes(inp)
-                buf = ffd.ffd_solve_fused(
-                    inp, g_max=self.g_max, nnz_max=nnz_max,
-                    word_offsets=offsets, words=words,
-                    objective=self.objective,
-                )
+                if self.mesh_engine is not None:
+                    # the mesh-sharded production dispatch: same fused
+                    # buffer, per-shard winners all-gathered in-jit, so
+                    # the async fetch below is a replicated local read
+                    buf = self.mesh_engine.solve_fused(
+                        inp, g_max=self.g_max, nnz_max=nnz_max,
+                        word_offsets=offsets, words=words,
+                        objective=self.objective,
+                    )
+                else:
+                    buf = ffd.ffd_solve_fused(
+                        inp, g_max=self.g_max, nnz_max=nnz_max,
+                        word_offsets=offsets, words=words,
+                        objective=self.objective,
+                    )
                 buf.copy_to_host_async()
             pending.buf = buf
             pending.inp = inp
@@ -1663,10 +1701,22 @@ class TPUSolver:
                 # sparse budget overflow (placements not near-diagonal):
                 # refetch the dense decision -- correctness over latency
                 with tracing.span("device", refetch="dense"):
-                    dense = ffd.solve_dense_tuple(
-                        pending.inp, g_max=self.g_max, word_offsets=entry.offsets,
-                        words=entry.words, objective=self.objective,
-                    )
+                    if self.mesh_engine is not None:
+                        out = self.mesh_engine.solve_dense(
+                            pending.inp, g_max=self.g_max,
+                            word_offsets=entry.offsets, words=entry.words,
+                            objective=self.objective,
+                        )
+                        f = self.mesh_engine.fetch(out)
+                        dense = (
+                            f.take, f.unplaced, int(f.n_open),
+                            f.gmask, f.gzone, f.gcap,
+                        )
+                    else:
+                        dense = ffd.solve_dense_tuple(
+                            pending.inp, g_max=self.g_max, word_offsets=entry.offsets,
+                            words=entry.words, objective=self.objective,
+                        )
         with tracing.span("decode"):
             return self._decode(
                 pending.pool, entry, class_set, dense, pending.nodepool_usage,
