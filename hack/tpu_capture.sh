@@ -23,6 +23,7 @@ OUT=BENCH_TPU_CAPTURE.json
 WIRE_OUT=BENCH_WIRE_CAPTURE.json
 CONSOLIDATE_OUT=BENCH_CONSOLIDATION_CAPTURE.json
 MESH_OUT=BENCH_MESH_CAPTURE.json
+MPOD_OUT=BENCH_MPOD_CAPTURE.json
 MEM_OUT=BENCH_TPU_MEMSTATS.json
 PROFILE_DIR=BENCH_TPU_PROFILE
 LOG=BENCH_TPU_CAPTURE.log
@@ -112,6 +113,24 @@ print('BACKEND=' + jax.default_backend())
           echo "[capture] fleet stage failed/degraded; captures stand" >> "$LOG"
           cat "$MESH_OUT.tmp" >> "$LOG" 2>/dev/null
           rm -f "$MESH_OUT.tmp"
+        fi
+        # mpod stage on the same warm tunnel (the million-pod-tick
+        # ROADMAP item's on-TPU acceptance numbers): 1M-pod/5k-type
+        # packed-mask solve on the 2x4 multi-host mesh layout --
+        # warm-tick p50/p99, the >= 8x packed-mask byte reduction
+        # (staged inputs AND the live HBM ledger), packed == full
+        # asserted at tier, and the Pallas-vs-XLA per-entry dispatch
+        # numbers. Full production group budget on real chips.
+        # Best-effort like the other stages.
+        echo "[capture] mpod stage $(date -u +%H:%M:%S)" >> "$LOG"
+        if timeout 2400 env BENCH_PROBE_BUDGET_S=120 BENCH_CPU_BUDGET_S=60 MPOD_G_MAX=1024 KARPENTER_TPU_JAX_WITNESS=1 python bench.py --mpod-only > "$MPOD_OUT.tmp" 2>> "$LOG" \
+           && grep -q '"platform"' "$MPOD_OUT.tmp" && ! grep -q '"platform": "cpu"' "$MPOD_OUT.tmp"; then
+          mv "$MPOD_OUT.tmp" "$MPOD_OUT"
+          echo "[capture] mpod SUCCESS $(date -u +%H:%M:%S)" >> "$LOG"
+        else
+          echo "[capture] mpod stage failed/degraded; captures stand" >> "$LOG"
+          cat "$MPOD_OUT.tmp" >> "$LOG" 2>/dev/null
+          rm -f "$MPOD_OUT.tmp"
         fi
         # one 10-tick programmatic profiler trace of the controller rig
         # (the observatory's --profile-ticks seam): the on-device

@@ -111,6 +111,21 @@ JIT_ENTRY_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
         "ffd_solve_compact", "ffd_solve_fused",
     ),
     "karpenter_tpu.solver.disrupt.kernel": ("disrupt_repack", "disrupt_replace"),
+    "karpenter_tpu.solver.kernels.ffd_pallas": ("ffd_solve_fused_pallas",),
+    "karpenter_tpu.solver.kernels.disrupt_pallas": ("disrupt_repack_pallas",),
+}
+
+# every Pallas kernel entry must keep a registered XLA twin: the
+# dispatch fallback rung (service._dispatch_fused / _dispatch_disrupt_
+# repack) pins the process to the twin on any lowering or runtime
+# failure, so a kernel without one would strand the degrade ladder.
+# Maps (kernel rel, jit entry) -> (twin rel, twin function); the
+# jaxjit/pallas-twin rule verifies both sides exist by AST.
+PALLAS_TWINS: Dict[Tuple[str, str], Tuple[str, str]] = {
+    ("karpenter_tpu/solver/kernels/ffd_pallas.py", "ffd_solve_fused_pallas"):
+        ("karpenter_tpu/solver/ffd.py", "ffd_solve_fused"),
+    ("karpenter_tpu/solver/kernels/disrupt_pallas.py", "disrupt_repack_pallas"):
+        ("karpenter_tpu/solver/disrupt/kernel.py", "disrupt_repack"),
 }
 
 # modules that build jit wrappers dynamically (jax.jit(...) call sites,
@@ -144,7 +159,19 @@ DEVICE_HOT_PATH: Dict[str, Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]] =
     "karpenter_tpu/solver/service.py": (
         (),
         {"TPUSolver": ("solve_begin", "solve_finish", "_finish_remote",
-                       "_solve_local_dense", "_pack_existing")},
+                       "_solve_local_dense", "_pack_existing",
+                       "_dispatch_fused", "_dispatch_disrupt_repack")},
+    ),
+    # Pallas kernel entries: the wrappers run per tick when selected
+    # (TPUSolver(kernels="pallas")), so their prologue/epilogue code is
+    # hot-path like the twins' -- no host syncs around the pallas_call
+    "karpenter_tpu/solver/kernels/ffd_pallas.py": (
+        ("ffd_solve_fused_pallas",),
+        {},
+    ),
+    "karpenter_tpu/solver/kernels/disrupt_pallas.py": (
+        ("disrupt_repack_pallas",),
+        {},
     ),
     "karpenter_tpu/solver/rpc.py": (
         (),
@@ -222,6 +249,7 @@ SANCTIONED_FETCH: Set[Tuple[str, str]] = {
 }
 
 RULE_UNBOUNDED = "jaxjit/unbounded-static"
+RULE_PALLAS_TWIN = "jaxjit/pallas-twin"
 RULE_CLOSURE = "jaxjit/closure-state"
 RULE_BRANCH = "jaxjit/traced-branch"
 RULE_DTYPE = "jaxjit/weak-dtype"
@@ -620,6 +648,47 @@ def check_retrace(modules: List[Module]) -> List[Violation]:
             if isinstance(node, ast.Call) and _is_jax_jit(node.func) \
                     and id(node) not in decorator_calls:
                 _validate_jit_statics(mod, node, "jax.jit call", out)
+    out.extend(_check_pallas_twins(modules, sites))
+    return out
+
+
+def _check_pallas_twins(
+    modules: List[Module],
+    sites: Dict[str, List[Tuple[str, ast.FunctionDef, Optional[ast.Call]]]],
+) -> List[Violation]:
+    """jaxjit/pallas-twin: every jit entry in a module that lowers
+    through pallas_call must declare a twin in PALLAS_TWINS, and the
+    declared twin function must exist (by AST) in its module -- the
+    fallback rung is a manifest contract, not a convention."""
+    out: List[Violation] = []
+    by_rel = {m.rel: m for m in modules}
+    for mod in modules:
+        has_pallas = any(
+            isinstance(n, ast.Call)
+            and (_dotted(n.func) or "").split(".")[-1] == "pallas_call"
+            for n in ast.walk(mod.tree))
+        if not has_pallas:
+            continue
+        for name, fn, _call in sites.get(mod.rel, []):
+            twin = PALLAS_TWINS.get((mod.rel, name))
+            if twin is None:
+                out.append(mod.violation(
+                    RULE_PALLAS_TWIN, fn,
+                    f"{name}: Pallas kernel entry has no registered XLA twin "
+                    "(PALLAS_TWINS); the dispatch fallback rung would be "
+                    "orphaned"))
+                continue
+            twin_rel, twin_fn = twin
+            twin_mod = by_rel.get(twin_rel)
+            defined = twin_mod is not None and any(
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name == twin_fn
+                for n in ast.walk(twin_mod.tree))
+            if not defined:
+                out.append(mod.violation(
+                    RULE_PALLAS_TWIN, fn,
+                    f"{name}: declared XLA twin {twin_rel}:{twin_fn} "
+                    "does not exist"))
     return out
 
 

@@ -4,7 +4,10 @@ One solver process serves N operator replicas ("tenants" -- one per
 cluster): the rpc server stages each tenant's catalogs/epochs under its
 own ids, the DispatchCoalescer batches their concurrent solves into
 shared device dispatch windows, and (when a mesh is configured) every
-dispatch runs the mesh-sharded jit entries. This module is the small
+dispatch runs the mesh-sharded jit entries. Tenant sizing reads the
+live HBM ledger when one exists (tenant_staged_bytes: the resident
+packed-mask staging, not the round-16 full-width extrapolation). This
+module is the small
 assembly layer over `SolverServer(mesh=, coalescer=)` -- the same shape
 the binary exposes as `python -m karpenter_tpu.solver.rpc --coalesce
 --mesh ... --tenant-budget ...` -- shared by the sim fleet replay
@@ -23,25 +26,66 @@ from typing import Optional
 
 from karpenter_tpu.fleet.coalesce import DispatchCoalescer
 from karpenter_tpu.fleet.shard import MeshSolveEngine, mesh_from_env
+from karpenter_tpu.logging import get_logger
 from karpenter_tpu.obs import hbm as obs_hbm
 
-# a 50k-pod/627-type tenant's resident staging footprint, measured on the
-# round-16 ledger (BENCH json staged_bytes_by_kind: catalog ~1.6 MB +
-# class epoch ~0.4 MB + headroom for one in-flight solve's temporaries);
-# deliberately rounded UP -- sizing must err toward fewer tenants
-TENANT_STAGED_BYTES_ESTIMATE = 8 * 1024 * 1024
+# fallback per-tenant footprint when no live ledger is available: the
+# round-20 packed-mask staging profile (BENCH json staged_bytes_by_kind:
+# catalog ~1.6 MB + class epoch ~0.4 MB with the open/join masks
+# bit-packed at 8x below the round-16 bool rows + headroom for one
+# in-flight solve's temporaries); deliberately rounded UP -- sizing must
+# err toward fewer tenants
+TENANT_STAGED_BYTES_FALLBACK = 6 * 1024 * 1024
+
+# in-flight multiplier over the ledger's resident bytes: a tenant's
+# steady-state staging plus one dispatch's transient copies (the staged
+# epoch being replaced lingers until the LRU drops it)
+_LIVE_SIZING_HEADROOM = 2
+
+
+def tenant_staged_bytes(solver=None) -> int:
+    """Per-tenant resident staging footprint for sizing. With a live
+    solver, reads the HBM ledger (staged_bytes_by_kind: catalog +
+    class_masks + solve_temporaries -- the PACKED mask bytes, i.e. what
+    is actually resident, not the full-width equivalent) and doubles it
+    for in-flight headroom; an empty ledger or no solver falls back to
+    the round-20 static profile. Never returns below the fallback --
+    a one-tenant measurement must not oversell capacity."""
+    if solver is not None:
+        try:
+            kinds = solver.staged_bytes_by_kind()
+        except Exception as e:  # noqa: BLE001 - sizing must never raise
+            get_logger("fleet").warning(
+                "tenant sizing: ledger read failed; using static fallback",
+                error=f"{type(e).__name__}: {e}"[:200],
+            )
+            kinds = {}
+        live = (
+            int(kinds.get("catalog", 0))
+            + int(kinds.get("class_masks", 0))
+            + int(kinds.get("solve_temporaries", 0))
+        )
+        if live > 0:
+            return max(_LIVE_SIZING_HEADROOM * live, TENANT_STAGED_BYTES_FALLBACK)
+    return TENANT_STAGED_BYTES_FALLBACK
 
 
 def max_tenants_for_headroom(
     headroom_bytes: Optional[int] = None,
-    per_tenant_bytes: int = TENANT_STAGED_BYTES_ESTIMATE,
+    per_tenant_bytes: Optional[int] = None,
     reserve_fraction: float = 0.5,
+    solver=None,
 ) -> Optional[int]:
     """How many tenants the measured device headroom supports, keeping
     `reserve_fraction` of it free for solve temporaries and compile
-    workspace. None when no allocator ledger exists (CPU backend) --
-    capacity is then bounded by the LRUs alone, and the operator sizes
-    from the runbook's table instead."""
+    workspace. Per-tenant bytes come from the live HBM ledger when a
+    `solver` is passed (tenant_staged_bytes), else the static fallback;
+    an explicit `per_tenant_bytes` overrides both. None when no
+    allocator ledger exists (CPU backend) -- capacity is then bounded by
+    the LRUs alone, and the operator sizes from the runbook's table
+    instead."""
+    if per_tenant_bytes is None:
+        per_tenant_bytes = tenant_staged_bytes(solver)
     if headroom_bytes is None:
         devices = obs_hbm.poll().get("devices") or {}
         free = [

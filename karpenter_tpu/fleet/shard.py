@@ -42,7 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from karpenter_tpu import metrics
 from karpenter_tpu.parallel import mesh as mesh_mod
-from karpenter_tpu.solver import ffd
+from karpenter_tpu.solver import ffd, packing
 
 # mesh layout for the production solve: "8" -> flat 8-device mesh,
 # "2x4" -> (hosts, types); unset/empty/"0"/"1" -> single-device path
@@ -123,6 +123,21 @@ class MeshSolveEngine:
                 join_allowed=NamedSharding(mesh, ck),
             )
         self._in_shardings = shardings
+        # bit-packed [C, KW] masks (solver/packing.py): KW = k_pad/32
+        # need not divide the types axis, and the words are 8x smaller
+        # than the bool rows they replace -- so the packed form drops
+        # the K split: class rows shard over the HOSTS axis on a 2D mesh
+        # (the DCN fabric the per-tick rows cross anyway), replicated on
+        # a flat mesh. Selected per dispatch by mask dtype, same two-
+        # bounded-programs discipline as the kernels.
+        row = (
+            P(mesh.axis_names[:-1], None)
+            if len(mesh.axis_names) > 1 else P()
+        )
+        self._in_shardings_packed = shardings._replace(
+            open_allowed=NamedSharding(mesh, row),
+            join_allowed=NamedSharding(mesh, row),
+        )
         # candidate-pool axis: data-parallel over every mesh axis
         self._s_shard = NamedSharding(mesh, P(tuple(mesh.axis_names)))
         self._cat_k = NamedSharding(mesh, P(mesh_mod.TYPES_AXIS))
@@ -151,13 +166,25 @@ class MeshSolveEngine:
             return mesh_mod._put_multiprocess(x, sharding)
         return jax.device_put(x, sharding)
 
+    def _mask_form(self, inp: ffd.SolveInputs) -> bool:
+        """True when this solve's masks ride the packed shardings (a
+        dtype metadata read -- the per-dispatch analogue of the kernels'
+        trace-time dispatch)."""
+        return packing.is_packed(inp.open_allowed) or packing.is_packed(
+            inp.join_allowed
+        )
+
     def _put_inputs(self, inp: ffd.SolveInputs) -> ffd.SolveInputs:
         """Multi-process meshes materialize shards per process; on an
         addressable mesh the jit's in_shardings move the host leaves, so
         the inputs pass through untouched (async dispatch preserved)."""
         if not self._multiproc:
             return inp
-        return mesh_mod._put_multiprocess(inp, self._in_shardings)
+        sh = (
+            self._in_shardings_packed
+            if self._mask_form(inp) else self._in_shardings
+        )
+        return mesh_mod._put_multiprocess(inp, sh)
 
     # -- jitted entries (cached per statics, replicated outputs) --------------
     def _entry(self, kind: str, statics: tuple):
@@ -173,7 +200,13 @@ class MeshSolveEngine:
         return fn
 
     def _build(self, kind: str, statics: tuple):
-        solve_kw = dict(in_shardings=(self._in_shardings,), out_shardings=self._rep)
+        # the trailing static selects the mask shardings for solve kinds
+        # (packed vs full-width -- part of the cache key, so each form
+        # compiles its own sharded program exactly once)
+        if kind in ("dense", "compact", "fused"):
+            statics, packed = statics[:-1], statics[-1]
+            in_sh = self._in_shardings_packed if packed else self._in_shardings
+            solve_kw = dict(in_shardings=(in_sh,), out_shardings=self._rep)
         if kind == "dense":
             g_max, offsets, words, objective = statics
             return jax.jit(
@@ -228,7 +261,10 @@ class MeshSolveEngine:
         u32 buffer out (the in-jit all-gather), same fused layout as
         ffd.ffd_solve_fused -- the caller's copy_to_host_async +
         expand_fused path is unchanged."""
-        fn = self._entry("fused", (g_max, nnz_max, word_offsets, words, objective))
+        fn = self._entry(
+            "fused",
+            (g_max, nnz_max, word_offsets, words, objective, self._mask_form(inp)),
+        )
         metrics.MESH_DISPATCHES.inc(entry="fused")
         return fn(self._put_inputs(inp))
 
@@ -237,7 +273,10 @@ class MeshSolveEngine:
         word_offsets: Tuple[int, ...], words: Tuple[int, ...],
         objective: str = "price",
     ) -> ffd.CompactDecision:
-        fn = self._entry("compact", (g_max, nnz_max, word_offsets, words, objective))
+        fn = self._entry(
+            "compact",
+            (g_max, nnz_max, word_offsets, words, objective, self._mask_form(inp)),
+        )
         metrics.MESH_DISPATCHES.inc(entry="compact")
         return fn(self._put_inputs(inp))
 
@@ -246,7 +285,10 @@ class MeshSolveEngine:
         word_offsets: Tuple[int, ...], words: Tuple[int, ...],
         objective: str = "price",
     ) -> ffd.SolveOutputs:
-        fn = self._entry("dense", (g_max, word_offsets, words, objective))
+        fn = self._entry(
+            "dense",
+            (g_max, word_offsets, words, objective, self._mask_form(inp)),
+        )
         metrics.MESH_DISPATCHES.inc(entry="dense")
         return fn(self._put_inputs(inp))
 

@@ -391,9 +391,38 @@ SOLVER_STAGED_BYTES = REGISTRY.gauge(
     "karpenter_solver_staged_bytes",
     "Staged tensor bytes by owner: catalog = encoded+device-staged "
     "catalog LRU entries; class_epoch = the sidecar's class-tensor epoch "
-    "store; solve_temporaries = the last solve's input tensors. The HBM "
+    "store; class_masks = the last solve's open/join allowed-mask rows "
+    "(packed or full-width; see karpenter_solver_packed_mask_bytes); "
+    "solve_temporaries = the last solve's input tensors. The HBM "
     "attribution half of karpenter_device_hbm_bytes_in_use",
-    labels=("kind",),  # catalog | class_epoch | solve_temporaries
+    labels=("kind",),  # catalog | class_epoch | class_masks | solve_temporaries
+)
+# bit-packed [C,K] class masks (solver/packing.py) + hand-written Pallas
+# kernels (solver/kernels/): the round-20 million-pod-tick families
+SOLVER_PACKED_MASK_BYTES = REGISTRY.gauge(
+    "karpenter_solver_packed_mask_bytes",
+    "Bytes of the last solve's open/join allowed-mask tensors: packed = "
+    "the form actually staged (uint32 words when packed_masks is on, "
+    "bool rows otherwise); full_equiv = what the full-width bool [C,K] "
+    "form would cost. packed/full_equiv is the measured mask reduction "
+    "(>=8x when packed, k_pad being a multiple of 128)",
+    labels=("form",),  # packed | full_equiv
+)
+SOLVER_KERNEL_DISPATCHES = REGISTRY.counter(
+    "karpenter_solver_kernel_dispatches_total",
+    "Hot-path kernel dispatches by jit entry and implementation actually "
+    "run: pallas = the hand-written fused kernel (solver/kernels/), xla = "
+    "the scan/vmap twin. A pallas-configured solver dispatching xla means "
+    "the fallback rung engaged (see _fallbacks_total)",
+    labels=("entry", "impl"),  # ffd_solve_fused | disrupt_repack x pallas | xla
+)
+SOLVER_KERNEL_FALLBACKS = REGISTRY.counter(
+    "karpenter_solver_kernel_fallbacks_total",
+    "Pallas kernel dispatches that failed (lowering/runtime error) and "
+    "degraded permanently to the registered XLA twin for this process -- "
+    "the kernel-selection rung of the degrade ladder; any nonzero value "
+    "is an operations signal (docs/operations.md)",
+    labels=("entry",),  # ffd_solve_fused | disrupt_repack
 )
 SOLVER_STAGED_PRESSURE_EVICTIONS = REGISTRY.counter(
     "karpenter_solver_staged_pressure_evictions_total",

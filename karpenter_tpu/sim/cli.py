@@ -232,6 +232,22 @@ def _cmd_corpus(args) -> int:
             rc = 1
             mentry = {"ok": False, "note": f"mesh-path invariant violation: {e}"}
         report[f"mesh:{name}"] = mentry
+        # packed-path gate (bit-packed masks, solver/packing.py): the
+        # same scenario re-replayed with the open/join masks shipped as
+        # uint32 words end to end; its digest must equal the committed
+        # host golden bit-for-bit -- packed == full-width, asserted the
+        # way sharded == unsharded is
+        try:
+            pres = replay(events, backend="packed", seed=seed)
+            pentry = {"ok": pres.digest == want, "digest": pres.digest}
+            if not pentry["ok"]:
+                rc = 1
+                pentry["golden_digest"] = want
+                pentry["note"] = "packed-path digest diverged from golden"
+        except InvariantViolation as e:
+            rc = 1
+            pentry = {"ok": False, "note": f"packed-path invariant violation: {e}"}
+        report[f"packed:{name}"] = pentry
     if args.update_digests:
         if rc != 0:
             # never pin a diverging run's digest (or null from a failed

@@ -57,8 +57,13 @@ BACKENDS = ("host", "wire", "pipelined")
 #   the device mesh (karpenter_tpu/fleet/shard.py; the virtual 8-device
 #   CPU mesh in CI) -- the corpus gate replays one scenario through it
 #   and fails on any digest divergence from the committed host golden
-#   (sharded == unsharded, asserted the way host == wire is).
-EXTRA_BACKENDS = ("delta", "tcp", "mesh")
+#   (sharded == unsharded, asserted the way host == wire is);
+# - "packed": TPUSolver in-process with the open/join masks bit-packed
+#   (solver/packing.py, TPUSolver(packed_masks=True)) -- the corpus gate
+#   replays one scenario through it and fails on any digest divergence
+#   from the committed host golden (packed == full-width, asserted the
+#   way sharded == unsharded is).
+EXTRA_BACKENDS = ("delta", "tcp", "mesh", "packed")
 
 DEFAULT_TICK_SECONDS = 3.0
 MAX_SETTLE_TICKS = 80
@@ -181,6 +186,11 @@ class _Engine:
 
         if self.backend == "host":
             solver = TPUSolver(g_max=64)
+        elif self.backend == "packed":
+            # bit-packed open/join masks through the whole in-process
+            # path (solver/packing.py): digest equality with the host
+            # golden IS the packed == full-width differential
+            solver = TPUSolver(g_max=64, packed_masks=True)
         elif self.backend == "mesh":
             # the sharded production solve on the virtual device mesh
             # (fleet/shard.py): in-process like "host", every dispatch

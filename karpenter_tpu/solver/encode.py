@@ -263,6 +263,22 @@ class PodClassSet:
     base_req: np.ndarray = None
 
 
+def pack_class_masks(class_set: "PodClassSet") -> "PodClassSet":
+    """Convert the set's [C, K] bool open/join masks to the bit-packed
+    [C, KW] uint32 form IN PLACE (solver/packing.py; no-op for absent or
+    already-packed masks) and return the set. The packed rows are what a
+    packed_masks solver stages and what the wire's negotiated form ships
+    -- every kernel dispatches on dtype, so downstream is agnostic.
+    Exactly invertible, so decisions are bit-identical by construction."""
+    from karpenter_tpu.solver import packing
+
+    for name in ("open_allowed", "join_allowed"):
+        m = getattr(class_set, name, None)
+        if m is not None and not packing.is_packed(m):
+            setattr(class_set, name, packing.pack_mask(m))
+    return class_set
+
+
 def soft_zone_tsc(pod: Pod):
     """The pod's single EFFECTIVE soft (ScheduleAnyway) zone-spread
     preference, or None. Applies only when the pod carries NO hard

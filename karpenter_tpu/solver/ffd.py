@@ -41,14 +41,14 @@ delta updates only).
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
-from karpenter_tpu.solver import encode
+from karpenter_tpu.solver import encode, packing
 from karpenter_tpu.solver.encode import CatalogTensors, PodClassSet
 
 # numpy scalar, NOT jnp: a module-level jnp constant initializes the XLA
@@ -253,7 +253,14 @@ def _ffd_body(
     K = inp.cap.shape[0]
     Z = inp.tzone.shape[1]
     CTn = inp.tcap.shape[1]
-    compat = _device_compat(inp, word_offsets, words) & inp.join_allowed  # [C, K]
+    # the open/join masks arrive either full-width bool [C, K] or
+    # bit-packed uint32 [C, K/32] (solver/packing.py -- 8x less HBM and
+    # wire). The dtype read is trace-time, so this is two bounded jit
+    # programs, not a new static axis; unpack(pack(m)) == m exactly, so
+    # the packed program's winners are bit-identical by construction.
+    join_allowed = packing.as_bool_mask_jnp(inp.join_allowed, K)
+    open_allowed = packing.as_bool_mask_jnp(inp.open_allowed, K)
+    compat = _device_compat(inp, word_offsets, words) & join_allowed  # [C, K]
     # fresh nodes reserve the pool's daemonset overhead: every fit count
     # (in-scan and fresh) sees the reduced capacity. Padding rows clip to
     # zero so they stay unusable.
@@ -266,7 +273,7 @@ def _ffd_body(
     # [K]-sized passes inside the sequential loop)
     n_fresh_all = _fresh_fit_counts(cap_eff, inp.req)             # [C, K]
     fresh_join = _joint_ok(azc[:, None] & tzc[None, :])           # [C, K]
-    fresh_mask_all = compat & fresh_join & inp.open_allowed       # [C, K]
+    fresh_mask_all = compat & fresh_join & open_allowed           # [C, K]
     if objective == "price":
         # price-aware opening (BASELINE.json configs 3-4): fresh groups are
         # sized to the type minimizing the TOTAL cost of hosting the class's
@@ -698,24 +705,47 @@ def stage_catalog(catalog: CatalogTensors, device=None) -> Tuple[StagedCatalog, 
     return staged, offsets, words
 
 
-def _open_allowed(classes: PodClassSet, k_pad: int) -> np.ndarray:
-    oa = getattr(classes, "open_allowed", None)
-    if oa is None:
-        return np.ones((classes.c_pad, k_pad), dtype=bool)
-    return oa
+def _mask_form(mask: Optional[np.ndarray], c_pad: int, k_pad: int,
+               packed: bool) -> np.ndarray:
+    """The requested representation of an open/join mask: ``packed``
+    selects the uint32 word form (solver/packing.py), else full bool.
+    None (no restriction) materializes all-true in the requested form;
+    a mask already in the requested form passes through untouched."""
+    if mask is None:
+        if packed:
+            # all-ones words directly: never materialize the [C, K] bool
+            return np.full(
+                (c_pad, packing.packed_words(k_pad)), 0xFFFFFFFF, dtype=np.uint32
+            )
+        return np.ones((c_pad, k_pad), dtype=bool)
+    if packed and not packing.is_packed(mask):
+        return packing.pack_mask(mask)
+    if not packed and packing.is_packed(mask):
+        return packing.unpack_mask(mask, k_pad)
+    return mask
 
 
-def _join_allowed(classes: PodClassSet, k_pad: int) -> np.ndarray:
-    ja = getattr(classes, "join_allowed", None)
-    if ja is None:
-        return np.ones((classes.c_pad, k_pad), dtype=bool)
-    return ja
+def _open_allowed(classes: PodClassSet, k_pad: int, packed: bool = False) -> np.ndarray:
+    return _mask_form(
+        getattr(classes, "open_allowed", None), classes.c_pad, k_pad, packed
+    )
 
 
-def make_inputs_staged(staged: StagedCatalog, classes: PodClassSet) -> SolveInputs:
+def _join_allowed(classes: PodClassSet, k_pad: int, packed: bool = False) -> np.ndarray:
+    return _mask_form(
+        getattr(classes, "join_allowed", None), classes.c_pad, k_pad, packed
+    )
+
+
+def make_inputs_staged(
+    staged: StagedCatalog, classes: PodClassSet, packed_masks: bool = False,
+) -> SolveInputs:
     """SolveInputs over a pre-staged device catalog; class-side leaves stay
-    host numpy so the jit dispatch streams them asynchronously."""
+    host numpy so the jit dispatch streams them asynchronously.
+    ``packed_masks`` ships the open/join masks bit-packed (8x fewer mask
+    bytes to device; the kernel unpacks in-jit, decisions identical)."""
     allowed = np.concatenate(classes.allowed, axis=1)
+    k_pad = int(staged.cap.shape[0])
     return SolveInputs(
         cap=staged.cap, tcode=staged.tcode, tnum=staged.tnum,
         tnum_present=staged.tnum_present, tzone=staged.tzone, tcap=staged.tcap,
@@ -725,12 +755,14 @@ def make_inputs_staged(staged: StagedCatalog, classes: PodClassSet) -> SolveInpu
         num_lo=classes.num_lo, num_hi=classes.num_hi, azone=classes.azone,
         acap=classes.acap, schedulable=classes.schedulable,
         node_overhead=classes.node_overhead,
-        open_allowed=_open_allowed(classes, int(staged.cap.shape[0])),
-        join_allowed=_join_allowed(classes, int(staged.cap.shape[0])),
+        open_allowed=_open_allowed(classes, k_pad, packed=packed_masks),
+        join_allowed=_join_allowed(classes, k_pad, packed=packed_masks),
     )
 
 
-def make_inputs(catalog: CatalogTensors, classes: PodClassSet) -> Tuple[SolveInputs, Tuple[int, ...], Tuple[int, ...]]:
+def make_inputs(
+    catalog: CatalogTensors, classes: PodClassSet, packed_masks: bool = False,
+) -> Tuple[SolveInputs, Tuple[int, ...], Tuple[int, ...]]:
     words = tuple(catalog.words)
     offsets = tuple(int(x) for x in np.cumsum((0,) + words[:-1]))
     allowed = np.concatenate(classes.allowed, axis=1)             # [C, TW]
@@ -752,7 +784,7 @@ def make_inputs(catalog: CatalogTensors, classes: PodClassSet) -> Tuple[SolveInp
         acap=jnp.asarray(classes.acap),
         schedulable=jnp.asarray(classes.schedulable),
         node_overhead=jnp.asarray(classes.node_overhead),
-        open_allowed=jnp.asarray(_open_allowed(classes, catalog.k_pad)),
-        join_allowed=jnp.asarray(_join_allowed(classes, catalog.k_pad)),
+        open_allowed=jnp.asarray(_open_allowed(classes, catalog.k_pad, packed=packed_masks)),
+        join_allowed=jnp.asarray(_join_allowed(classes, catalog.k_pad, packed=packed_masks)),
     )
     return inp, offsets, words

@@ -1,0 +1,34 @@
+"""Hand-written Pallas kernels for the two hottest solve entries.
+
+Round-16 per-jit-entry attribution showed the FFD scan-reduce and the
+disrupt repack paying XLA materialization between every scan step: each
+step's [G, K] temporaries round-trip HBM because XLA schedules the scan
+body as separate fusions. These kernels run the WHOLE sequential pass
+inside one Pallas program -- the carry (group accumulators, the packed
+group-type masks, zone/captype bitsets, the open-slot counter) lives in
+VMEM/SMEM scratch across grid steps, and the group open/close logic is
+fused into the same kernel, so nothing materializes between steps.
+
+Masks are consumed in the bit-packed uint32 form (solver/packing.py):
+the group-survivor x class-compat intersection is a bitwise AND on
+packed words, 32 type columns per lane.
+
+Contract (identical to every existing entry family):
+
+- bit-identical outputs to the XLA twins -- same float32 ops in the
+  same order, same argmin tie-breaking, same fused buffer layout
+  (tests/test_packing.py asserts differentially);
+- same jit signatures and static argument buckets, registered in
+  JIT_ENTRY_FUNCTIONS / STATIC_ARG_BUCKETS / DEVICE_HOT_PATH like the
+  twins, and every kernel here MUST keep a registered XLA twin (the
+  jaxjit pallas-twin lint rule) -- the fallback rung cannot be
+  orphaned;
+- selected via ``TPUSolver(kernels="pallas")``; any lowering or runtime
+  failure (including VMEM overflow at extreme [G, K] tiers) is caught
+  at dispatch and pins the process to the XLA twin
+  (service._dispatch_fused) -- decisions never change, only who
+  computes them;
+- interpret mode on non-TPU backends (a trace-time backend read), so
+  the differential suite runs the real kernel logic on CPU rigs.
+"""
+from karpenter_tpu.solver.kernels import disrupt_pallas, ffd_pallas  # noqa: F401

@@ -1,0 +1,102 @@
+"""Pallas disrupt-repack kernel ([S, C, N] candidate-set simulation).
+
+The XLA twin (solver/disrupt/kernel.py disrupt_repack) vmaps a per-set
+lax.scan over pod classes; each scan step's [N, R] headroom carry
+materializes between fusions. Here the grid is (S, C) -- row-major, so
+the class axis iterates innermost -- and the headroom carry for the
+current candidate set lives in VMEM scratch across the C steps: the
+whole per-set repack simulation runs without touching HBM.
+
+Step math is the twin's, float32 ops in the same order (per-axis floor
+of headroom over requests, first-fit exclusive cumsum, clip to the
+class count), so takes and leftovers are bit-identical by construction.
+
+Boolean feasibility/exclusion operands arrive as float32 at the
+pallas_call boundary (TPU kernels avoid sub-byte bool blocks); the
+wrapper converts, the comparison against zero inside the kernel
+restores the predicate.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_INF = np.float32(np.inf)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# same signature and (empty) static bucket as disrupt_kernel.disrupt_repack,
+# the registered XLA twin (jaxjit/pallas-twin links the two)
+@jax.jit
+def disrupt_repack_pallas(headroom0, feas, req, member, excl):
+    S, N = excl.shape
+    C, R = req.shape
+
+    feas_f = feas.astype(jnp.float32)                             # [C, N]
+    excl_f = excl.astype(jnp.float32)                             # [S, N]
+    member_i = member.astype(jnp.int32)                           # [S, C]
+
+    def kernel(
+        head_ref, req_ref, feas_ref, excl_ref, member_ref,
+        left_ref, takes_ref, hr_s,
+    ):
+        c = pl.program_id(1)
+
+        @pl.when(c == 0)
+        def _init():
+            excl_row = excl_ref[0, :]                             # [N]
+            hr_s[...] = jnp.where(
+                excl_row[:, None] > 0.0, 0.0, head_ref[...]
+            )
+
+        hr = hr_s[...]                                            # [N, R]
+        req_c = req_ref[0, :]                                     # [R]
+        feas_c = feas_ref[0, :]                                   # [N]
+        count_c = member_ref[0, 0]
+
+        safe = jnp.where(req_c > 0.0, req_c, 1.0)
+        per_axis = jnp.where(
+            req_c[None, :] > 0.0, jnp.floor(hr / safe[None, :]), _INF
+        )                                                         # [N, R]
+        fit = jnp.maximum(jnp.min(per_axis, axis=-1), 0.0)
+        fit = jnp.where(feas_c > 0.0, fit, 0.0).astype(jnp.int32)
+
+        cum_before = jnp.cumsum(fit) - fit
+        take = jnp.clip(count_c - cum_before, 0, fit)             # [N]
+        hr2 = hr - take[:, None].astype(jnp.float32) * req_c[None, :]
+
+        takes_ref[0, 0, :] = take
+        left_ref[0, 0] = count_c - jnp.sum(take)
+        hr_s[...] = hr2
+
+    fixed = lambda s, c: (0, 0)  # noqa: E731
+
+    leftover, takes = pl.pallas_call(
+        kernel,
+        grid=(S, C),
+        in_specs=[
+            pl.BlockSpec((N, R), fixed),
+            pl.BlockSpec((1, R), lambda s, c: (c, 0)),
+            pl.BlockSpec((1, N), lambda s, c: (c, 0)),
+            pl.BlockSpec((1, N), lambda s, c: (s, 0)),
+            pl.BlockSpec((1, 1), lambda s, c: (s, c), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda s, c: (s, c)),
+            pl.BlockSpec((1, 1, N), lambda s, c: (s, c, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((S, C), jnp.int32),
+            jax.ShapeDtypeStruct((S, C, N), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((N, R), jnp.float32)],
+        interpret=_interpret(),
+    )(headroom0, req, feas_f, excl_f, member_i)
+    return leftover, takes

@@ -1,0 +1,320 @@
+"""Fused Pallas FFD scan-reduce (the provisioning solve's inner loop).
+
+One Pallas program runs the whole class scan: grid = (C,), carry in
+scratch (accum [G, R] f32 in VMEM, the group-survivor mask BIT-PACKED
+[G, KW] u32 in VMEM, the packed zone/captype bitsets [G] u32, the
+open-slot counter in SMEM), so the per-step [G, K] temporaries never
+round-trip HBM and the group open/close arithmetic fuses with the fit
+reduction. Per-class operands stream in as (1, ...) blocks -- exactly
+the xs of the XLA twin's lax.scan (solver/ffd.py _ffd_body).
+
+The survivor-mask x class-compat intersection is a bitwise AND on the
+packed words (32 type columns per u32 lane); rows unpack in-register
+only where the fit arithmetic needs the full width.
+
+The XLA prologue (compat, fresh fits, price tables -- all batch [C, K]
+work with no sequential dependence) and epilogue (sparse take, fused
+u32 buffer concat) are shared with the twin BY CALLING ITS HELPERS, so
+the only reimplemented math is the scan step itself -- float32 ops in
+the twin's order, same argmin tie-breaking: bit-identical by
+construction, asserted differentially in tests/test_packing.py.
+
+Interpret mode on non-TPU backends (trace-time backend read) runs the
+same kernel logic on CPU rigs; real-TPU lowering failures (e.g. VMEM
+overflow at extreme [G, K] tiers) surface at dispatch and take the XLA
+fallback rung (service._dispatch_fused).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from karpenter_tpu.solver import ffd, packing
+
+_INF = np.float32(np.inf)
+
+
+def _interpret() -> bool:
+    """Trace-time backend read: the kernel interprets everywhere but on
+    a real TPU (same program either way -- interpret mode executes the
+    identical kernel logic through XLA on the host)."""
+    return jax.default_backend() != "tpu"
+
+
+def _pack_rows(mask: jax.Array) -> jax.Array:
+    """[..., K] bool -> [..., K/32] u32, little-endian within the word
+    (bit j of word w = column 32w + j; packing.py's convention and the
+    CompactDecision.gmask_bits convention -- one bit layout everywhere)."""
+    k = mask.shape[-1]
+    kw = k // 32
+    return jnp.sum(
+        mask.reshape(mask.shape[:-1] + (kw, 32)).astype(jnp.uint32)
+        << jnp.arange(32, dtype=jnp.uint32),
+        axis=-1,
+    )
+
+
+def _unpack_rows(words: jax.Array, k: int) -> jax.Array:
+    """[..., KW] u32 -> [..., k] bool (inverse of _pack_rows)."""
+    bits = (words[..., :, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    return bits.reshape(words.shape[:-1] + (k,)).astype(bool)
+
+
+def _fused_scan(
+    inp: ffd.SolveInputs, g_max: int, word_offsets: Tuple[int, ...],
+    words: Tuple[int, ...], objective: str,
+):
+    """(take [C, G] i32, unplaced [C] i32, n_open i32, gmask_bits
+    [G, KW] u32, gzc [G] u32): the scan of _ffd_body as one Pallas
+    program, outputs already in the compact decision's packed forms."""
+    C, R = inp.req.shape
+    K = int(inp.cap.shape[0])
+    if K % 32:
+        raise ValueError(f"pallas ffd kernel needs k_pad % 32 == 0, got {K}")
+    KW = K // 32
+    G = g_max
+
+    # -- XLA prologue: the twin's hoisted batch work, via its helpers ----
+    join_allowed = packing.as_bool_mask_jnp(inp.join_allowed, K)
+    open_allowed = packing.as_bool_mask_jnp(inp.open_allowed, K)
+    compat = ffd._device_compat(inp, word_offsets, words) & join_allowed
+    cap_eff = jnp.maximum(inp.cap - inp.node_overhead[None, :], 0.0)
+    tzc = ffd._pack_zc(inp.tzone, inp.tcap)                       # [K] u32
+    azc = ffd._pack_zc(inp.azone, inp.acap)                       # [C] u32
+    n_fresh_all = ffd._fresh_fit_counts(cap_eff, inp.req)         # [C, K]
+    fresh_join = ffd._joint_ok(azc[:, None] & tzc[None, :])
+    fresh_mask_all = compat & fresh_join & open_allowed
+    if objective == "price":
+        price_ck, has_res_ck = ffd._class_type_price(inp)
+    else:
+        price_ck = jnp.zeros_like(n_fresh_all)
+        has_res_ck = jnp.zeros(n_fresh_all.shape, dtype=bool)
+
+    # the kernel's streamed mask operands, bit-packed 32 columns per lane
+    compat_w = _pack_rows(compat)                                 # [C, KW]
+    fresh_w = _pack_rows(fresh_mask_all)                          # [C, KW]
+    hasres_w = _pack_rows(has_res_ck)                             # [C, KW]
+    count2 = inp.count.reshape(C, 1).astype(jnp.int32)
+    env2 = inp.env_count.reshape(C, 1).astype(jnp.int32)
+    azc2 = azc.reshape(C, 1)
+    tzc2 = tzc.reshape(1, K)
+
+    def kernel(
+        req_ref, compat_ref, fresh_ref, nfresh_ref, price_ref, hasres_ref,
+        count_ref, env_ref, azc_ref, cap_ref, tzc_ref,
+        take_ref, unp_ref, gmasko_ref, gzco_ref, nopeno_ref,
+        accum_s, gmask_s, gzc_s, nopen_s,
+    ):
+        c = pl.program_id(0)
+
+        @pl.when(c == 0)
+        def _init():
+            accum_s[...] = jnp.zeros_like(accum_s)
+            gmask_s[...] = jnp.zeros_like(gmask_s)
+            gzc_s[...] = jnp.zeros_like(gzc_s)
+            nopen_s[0] = jnp.int32(0)
+
+        accum = accum_s[...]                                      # [G, R]
+        gmask_w = gmask_s[...]                                    # [G, KW]
+        gzc = gzc_s[...][:, 0]                                    # [G] u32
+        n_open = nopen_s[0]
+
+        req_c = req_ref[0, :]                                     # [R]
+        count_c = count_ref[0, 0]
+        env_c = env_ref[0, 0]
+        azc_c = azc_ref[0, 0]
+        tzc_k = tzc_ref[0, :]                                     # [K] u32
+        cap_k = cap_ref[...]                                      # [K, R]
+        compat_cw = compat_ref[0, :]                              # [KW] u32
+        fresh_row = _unpack_rows(fresh_ref[0, :], K)              # [K] bool
+        has_res_row = _unpack_rows(hasres_ref[0, :], K)
+        n_fresh_row = nfresh_ref[0, :]                            # [K] f32
+        price_row = price_ref[0, :]
+
+        slot = jax.lax.broadcasted_iota(jnp.int32, (G, 1), 0)[:, 0]
+        inf32 = jnp.float32(jnp.inf)
+
+        # -- joint feasibility: bitwise AND on the PACKED words, then the
+        #    zone/captype bitset intersection (twin: _ffd_body.step)
+        gzc_new = gzc & azc_c                                     # [G] u32
+        mw = gmask_w & compat_cw[None, :]                         # [G, KW]
+        m = _unpack_rows(mw, K) & ffd._joint_ok(
+            gzc_new[:, None] & tzc_k[None, :]
+        )                                                         # [G, K]
+
+        # -- in-scan fit counts, R-unrolled exactly like ffd._fit_counts
+        n_fit = None
+        for r in range(R):
+            d = jnp.where(req_c[r] > 0.0, req_c[r], 1.0)
+            axis_n = jnp.where(
+                req_c[r] > 0.0,
+                jnp.floor((cap_k[None, :, r] - accum[:, r, None]) / d),
+                inf32,
+            )                                                     # [G, K]
+            n_fit = axis_n if n_fit is None else jnp.minimum(n_fit, axis_n)
+        n_fit = jnp.maximum(n_fit, 0.0)
+
+        n_grp = jnp.max(jnp.where(m, n_fit, 0.0), axis=-1)        # [G]
+        n_grp = jnp.where(slot < n_open, n_grp, 0.0).astype(jnp.int32)
+
+        cum_before = jnp.cumsum(n_grp) - n_grp
+        take = jnp.clip(count_c - cum_before, 0, n_grp)           # [G]
+        placed = jnp.sum(take)
+        leftover = count_c - placed
+
+        max_fit_f = jnp.max(jnp.where(fresh_row, n_fresh_row, 0.0))
+        per_new_fit = max_fit_f.astype(jnp.int32)
+        if objective == "price":
+            env = jnp.where(
+                env_c > 0, env_c, jnp.maximum(leftover + (-env_c - 1), 1)
+            )
+            ngroups = jnp.ceil(
+                env.astype(jnp.float32) / jnp.maximum(n_fresh_row, 1.0)
+            )
+            envf = env.astype(jnp.float32)
+            need = jnp.minimum(max_fit_f, envf)
+            eligible = (
+                fresh_row
+                & (n_fresh_row >= 1.0)
+                & ((2.0 * jnp.minimum(n_fresh_row, envf) >= need) | has_res_row)
+            )
+            total_cost = jnp.where(eligible, price_row * ngroups, inf32)
+            kstar = jnp.argmin(total_cost)
+            ok = jnp.isfinite(total_cost[kstar])
+            per_new_price = jnp.where(ok, n_fresh_row[kstar], 0.0).astype(jnp.int32)
+            p_star = price_row[kstar]
+            price_mask = (
+                fresh_row
+                & (n_fresh_row >= per_new_price.astype(n_fresh_row.dtype))
+                & (price_row <= p_star)
+                & ok
+            )
+            use_fit = env_c == 0
+            per_new = jnp.where(use_fit, per_new_fit, per_new_price)
+            open_mask = jnp.where(use_fit, fresh_row, price_mask)
+        else:
+            per_new = per_new_fit
+            open_mask = fresh_row
+
+        can_open = (leftover > 0) & (per_new > 0)
+        n_new = jnp.where(can_open, -(-leftover // jnp.maximum(per_new, 1)), 0)
+        n_new = jnp.minimum(n_new, G - n_open)
+        is_new = (slot >= n_open) & (slot < n_open + n_new)
+        ordinal = slot - n_open
+        take_new = jnp.where(
+            is_new, jnp.clip(leftover - ordinal * per_new, 0, per_new), 0
+        ).astype(jnp.int32)
+
+        take_all = take + take_new
+        still_unplaced = count_c - jnp.sum(take_all)
+
+        accum2 = accum + take_all[:, None].astype(jnp.float32) * req_c[None, :]
+        takef = take_all.astype(jnp.float32)
+        touched_existing = take > 0
+        gmask2 = jnp.where(
+            touched_existing[:, None], m & (takef[:, None] <= n_fit),
+            _unpack_rows(gmask_w, K),
+        )
+        gmask2 = jnp.where(
+            is_new[:, None],
+            open_mask[None, :] & (takef[:, None] <= n_fresh_row[None, :]),
+            gmask2,
+        )
+        gmask2_w = _pack_rows(gmask2)                             # [G, KW]
+        gzc2 = jnp.where(touched_existing, gzc_new, gzc)
+        gzc2 = jnp.where(is_new, azc_c, gzc2)
+        n_open2 = n_open + n_new
+
+        take_ref[0, :] = take_all
+        unp_ref[0, 0] = still_unplaced
+        accum_s[...] = accum2
+        gmask_s[...] = gmask2_w
+        gzc_s[...] = gzc2[:, None]
+        nopen_s[0] = n_open2
+
+        @pl.when(c == pl.num_programs(0) - 1)
+        def _final():
+            gmasko_ref[...] = gmask2_w
+            gzco_ref[...] = gzc2[:, None]
+            nopeno_ref[0, 0] = n_open2
+
+    fixed = lambda c: (0, 0)  # noqa: E731 -- whole-array block each step
+    row = lambda c: (c, 0)    # noqa: E731 -- per-class streamed block
+
+    take, unplaced, gmask_bits, gzc_out, n_open = pl.pallas_call(
+        kernel,
+        grid=(C,),
+        in_specs=[
+            pl.BlockSpec((1, R), row),
+            pl.BlockSpec((1, KW), row),
+            pl.BlockSpec((1, KW), row),
+            pl.BlockSpec((1, K), row),
+            pl.BlockSpec((1, K), row),
+            pl.BlockSpec((1, KW), row),
+            pl.BlockSpec((1, 1), row, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), row, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), row, memory_space=pltpu.SMEM),
+            pl.BlockSpec((K, R), fixed),
+            pl.BlockSpec((1, K), fixed),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, G), row),
+            pl.BlockSpec((1, 1), row),
+            pl.BlockSpec((G, KW), fixed),
+            pl.BlockSpec((G, 1), fixed),
+            pl.BlockSpec((1, 1), fixed, memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((C, G), jnp.int32),
+            jax.ShapeDtypeStruct((C, 1), jnp.int32),
+            jax.ShapeDtypeStruct((G, KW), jnp.uint32),
+            jax.ShapeDtypeStruct((G, 1), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((G, R), jnp.float32),
+            pltpu.VMEM((G, KW), jnp.uint32),
+            pltpu.VMEM((G, 1), jnp.uint32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+        interpret=_interpret(),
+    )(
+        inp.req, compat_w, fresh_w, n_fresh_all, price_ck, hasres_w,
+        count2, env2, azc2, cap_eff, tzc2,
+    )
+    return take, unplaced[:, 0], n_open[0, 0], gmask_bits, gzc_out[:, 0]
+
+
+# same signature, statics, and fused buffer layout as ffd.ffd_solve_fused
+# (the registered XLA twin -- jaxjit/pallas-twin links the two)
+@functools.partial(jax.jit, static_argnames=("g_max", "nnz_max", "word_offsets", "words", "objective"))
+def ffd_solve_fused_pallas(
+    inp: ffd.SolveInputs,
+    *,
+    g_max: int,
+    nnz_max: int,
+    word_offsets: Tuple[int, ...],
+    words: Tuple[int, ...],
+    objective: str = "price",
+) -> jax.Array:
+    take, unplaced, n_open, gmask_bits, gzc = _fused_scan(
+        inp, g_max, word_offsets, words, objective
+    )
+    idx, val, nnz_true = ffd._sparse_take(take, nnz_max)
+    parts = [
+        nnz_true.reshape(1).astype(jnp.uint32),
+        n_open.reshape(1).astype(jnp.uint32),
+        jax.lax.bitcast_convert_type(unplaced, jnp.uint32).ravel(),
+        jax.lax.bitcast_convert_type(idx, jnp.uint32).ravel(),
+        jax.lax.bitcast_convert_type(val, jnp.uint32).ravel(),
+        gmask_bits.ravel(),
+        gzc.ravel(),
+    ]
+    return jnp.concatenate(parts)

@@ -1,0 +1,105 @@
+"""Bit-packed [C, K] class/type masks (32 type columns per uint32 word).
+
+The open/join allowed masks are the solve's widest per-class tensors: at
+the 2k-type tier a bool [C, K] row is k_pad bytes per class per mask --
+they dominate the staged class bytes on the wire, in the epoch store,
+and in HBM. Packing 32 columns per uint32 lane cuts that 8x (bool is one
+byte per element; k_pad is a multiple of 128, so there is never a
+partial word) while staying EXACTLY invertible: ``unpack(pack(m)) == m``
+bit for bit, which is what makes the packed solve's winners identical to
+the full-width solve's by construction (the kernel unpacks in-jit and
+runs the same program from there).
+
+Bit layout matches the repo's existing bitset conventions
+(ffd.CompactDecision.gmask_bits, encode's per-dim ``allowed`` words):
+bit j of word w covers column ``32*w + j`` -- little-endian within the
+word, words in ascending column order. Host pack/unpack ride
+np.packbits/np.unpackbits(bitorder="little") so a 1M-row pack stays a
+memcpy-speed pass, and the jnp unpacker is the same broadcast-shift
+idiom expand_fused uses on the host.
+
+Packed masks are a WIRE/HBM representation, not a second semantics:
+everything downstream dispatches on dtype (uint32 = packed, bool =
+full), which is a trace-time read -- two bounded jit programs, no new
+static argument axis (the lesson of the removed pallas step kernel,
+solver/ffd.py module docstring).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+WORD_BITS = 32
+
+
+def packed_words(k: int) -> int:
+    """Words per row for k columns (k_pad is a multiple of 128 in every
+    real catalog, so this is exactly k // 32 there)."""
+    return (k + WORD_BITS - 1) // WORD_BITS
+
+
+def is_packed(arr) -> bool:
+    """True when `arr` is a packed mask (uint32 words), False for the
+    full-width bool form. The ONE dispatch predicate every consumer
+    shares -- dtype reads are trace-time, so this is jit-safe."""
+    return arr is not None and np.dtype(getattr(arr, "dtype", None)) == np.uint32
+
+
+def pack_mask(mask: np.ndarray) -> np.ndarray:
+    """[..., K] bool -> [..., KW] uint32 (host numpy). K may be any
+    size; tail bits of the last word are zero."""
+    mask = np.ascontiguousarray(np.asarray(mask, dtype=bool))
+    k = mask.shape[-1]
+    kw = packed_words(k)
+    packed8 = np.packbits(mask, axis=-1, bitorder="little")       # [..., ceil(K/8)] u8
+    want8 = kw * 4
+    if packed8.shape[-1] != want8:
+        pad = np.zeros(mask.shape[:-1] + (want8 - packed8.shape[-1],), dtype=np.uint8)
+        packed8 = np.concatenate([packed8, pad], axis=-1)
+    return np.ascontiguousarray(packed8).view(np.uint32)
+
+
+def unpack_mask(words: np.ndarray, k: int) -> np.ndarray:
+    """[..., KW] uint32 -> [..., k] bool (host numpy inverse)."""
+    words = np.ascontiguousarray(np.asarray(words, dtype=np.uint32))
+    bits = np.unpackbits(words.view(np.uint8), axis=-1, bitorder="little")
+    return bits[..., :k].astype(bool)
+
+
+def unpack_mask_jnp(words, k: int):
+    """[..., KW] uint32 -> [..., k] bool, traceable (the in-jit unpack
+    the kernels run; same broadcast-shift idiom as ffd.expand_fused)."""
+    kw = words.shape[-1]
+    bits = (
+        words[..., :, None] >> jnp.arange(WORD_BITS, dtype=jnp.uint32)
+    ) & jnp.uint32(1)
+    flat = bits.reshape(words.shape[:-1] + (kw * WORD_BITS,))
+    return flat[..., :k].astype(bool)
+
+
+def as_bool_mask_jnp(mask, k: int):
+    """The kernel-side dispatch: packed uint32 words unpack to [..., k]
+    bool; a full-width bool mask passes through unchanged. The dtype
+    read is trace-time (two programs total: packed and full)."""
+    if is_packed(mask):
+        return unpack_mask_jnp(mask, k)
+    return mask
+
+
+def full_mask_nbytes(shape_c: int, k: int) -> int:
+    """Bytes of the full-width bool [C, K] form (the ledger's
+    full-equivalent reference for the measured reduction)."""
+    return shape_c * k
+
+
+def packed_mask_nbytes(shape_c: int, k: int) -> int:
+    """Bytes of the packed [C, KW] uint32 form."""
+    return shape_c * packed_words(k) * 4
+
+
+def mask_nbytes(mask) -> int:
+    """Actual bytes of a mask tensor in either form (metadata read)."""
+    if mask is None:
+        return 0
+    return int(np.prod(mask.shape)) * np.dtype(mask.dtype).itemsize

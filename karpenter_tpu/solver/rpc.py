@@ -50,7 +50,7 @@ import numpy as np
 
 from karpenter_tpu import failpoints, metrics, overload, tracing
 from karpenter_tpu.obs import hbm as obs_hbm
-from karpenter_tpu.solver import encode, ffd
+from karpenter_tpu.solver import encode, ffd, packing
 
 TOKEN_ENV = "KARPENTER_TPU_SOLVER_TOKEN"
 # kill switch for delta class shipping (solve_delta): the client defaults
@@ -72,12 +72,23 @@ SHM_MAX_FAILURES = 2
 
 # the per-class tensors delta shipping can patch row-wise. node_overhead
 # ([R], whole-set) always ships in full; open_allowed/join_allowed ([C, K]
-# merged-multipool masks) bypass the delta path entirely -- they dominate
-# the payload when present and the merged shape re-derives them per tick.
+# merged-multipool masks) bypass the delta path entirely when they ship
+# full-width -- bool rows dominate the payload and the merged shape
+# re-derives them per tick. BIT-PACKED masks (solver/packing.py, the
+# feature-negotiated "packed_masks" wire form) are [C, KW] uint32 rows an
+# eighth the size, so they rejoin the row-patch machinery like any other
+# per-class tensor (PACKED_MASK_TENSORS below).
 PER_CLASS_TENSORS = (
     "req", "count", "env_count", "allowed", "num_lo", "num_hi",
     "azone", "acap", "schedulable",
 )
+# mask tensors that become row-patchable once packed: only clients that
+# negotiated "packed_masks" ship them inside a delta request, so a server
+# that advertises the feature is by construction the one patching them
+PACKED_MASK_TENSORS = ("open_allowed", "join_allowed")
+# kill switch for the packed-mask wire form: "0" ships full-width bool
+# masks even to a packed_masks-advertising server
+PACKED_MASKS_ENV = "KARPENTER_TPU_PACKED_MASKS"
 # never ship a delta when more than this fraction of rows changed: the
 # row-index header plus per-row framing overtakes the dense ship
 DELTA_MAX_DIRTY_FRACTION = 0.5
@@ -619,7 +630,7 @@ class SolverServer:
                 # without the join_allowed gate
                 features = [
                     "join_allowed", "trace_echo", "solve_delta", "reply_v2",
-                    "solve_disrupt",
+                    "solve_disrupt", "packed_masks",
                 ]
                 if self._shm_enabled:
                     features.append("shm")
@@ -862,7 +873,10 @@ class SolverServer:
             full = dict(ent)
             rows = np.asarray([int(r) for r in header.get("rows", ())], dtype=np.int64)
             for name, arr in t.items():
-                if name not in PER_CLASS_TENSORS:
+                # packed [C, KW] mask rows patch like any per-class tensor
+                # (only packed_masks-negotiated clients ship them here;
+                # full-width bool masks never enter a delta request)
+                if name not in PER_CLASS_TENSORS and name not in PACKED_MASK_TENSORS:
                     full[name] = arr  # whole-set tensors replace wholesale
                 elif rows.size:
                     cur = full[name]
@@ -1168,6 +1182,7 @@ class SolverClient:
         delta: Optional[bool] = None,
         shm: Optional[bool] = None, reply_v2: Optional[bool] = None,
         track_transport: bool = True, tenant: Optional[str] = None,
+        packed_masks: Optional[bool] = None,
     ):
         self.addr = (host, port) if path is None else None
         self.path = path
@@ -1234,6 +1249,16 @@ class SolverClient:
         if delta is None:
             delta = os.environ.get(DELTA_ENV, "1") != "0"
         self.delta = bool(delta)
+        # bit-packed mask wire form (solver/packing.py): when the server
+        # advertises "packed_masks", the [C, K] open/join masks ship as
+        # [C, KW] uint32 words -- 8x less payload AND row-patchable by
+        # the delta path (full-width bool masks bypass it). Bit-identical
+        # by construction: the kernel unpacks in-jit. Default on;
+        # packed_masks=False or $KARPENTER_TPU_PACKED_MASKS=0 forces the
+        # full-width ship (and an older server simply never negotiates).
+        if packed_masks is None:
+            packed_masks = os.environ.get(PACKED_MASKS_ENV, "1") != "0"
+        self.packed_masks = bool(packed_masks)
         # seqnum -> (epoch id, {name: array copy}): the last class tensor
         # state the server is known to hold for that catalog. Bounded LRU;
         # dropped eagerly on close() and on any staging-gap error.
@@ -1627,6 +1652,19 @@ class SolverClient:
                 self._features = frozenset(header.get("features", ()))
             return self._features
 
+    def _packed_wire(self) -> bool:
+        """True when class masks should ship bit-packed: enabled on this
+        client AND negotiated with the server. A wire error here answers
+        False (full-width is always understood) and lets the solve's own
+        send surface the connection state -- same discipline as the
+        solve_delta gate in _delta_request."""
+        if not self.packed_masks:
+            return False
+        try:
+            return "packed_masks" in self.features()
+        except (ConnectionError, OSError):
+            return False
+
     def _roundtrip(self, header, tensors=()):
         with self._lock:
             # pipelined replies still on the stream MUST drain first, or
@@ -1680,10 +1718,25 @@ class SolverClient:
             self._staged_seqnums.add(seqnum)
 
     @staticmethod
-    def _class_tensors(class_set: encode.PodClassSet):
+    def _class_tensors(class_set: encode.PodClassSet, packed: bool = False):
         """The pod-class tensor list both solve ops ship (ONE copy: a new
         class tensor must appear here or the dense and compact paths
-        desynchronize)."""
+        desynchronize). With `packed` (the negotiated "packed_masks" wire
+        form) the [C, K] bool masks ship as [C, KW] uint32 words -- the
+        server's kernels dispatch on dtype, so no header flag is needed
+        and the decision is bit-identical either way."""
+
+        def _mask(m):
+            if packed and not packing.is_packed(m):
+                return packing.pack_mask(m)
+            if not packed and packing.is_packed(m):
+                # a pre-packed class set meeting a server that never
+                # negotiated the form: ship the full-width bool rows the
+                # old server understands (KW*32 == k_pad exactly -- k_pad
+                # is a multiple of 128)
+                return packing.unpack_mask(m, m.shape[-1] * packing.WORD_BITS)
+            return m
+
         return [
             ("req", class_set.req), ("count", class_set.count),
             ("env_count", class_set.env_count),
@@ -1693,10 +1746,10 @@ class SolverClient:
             ("schedulable", class_set.schedulable),
             ("node_overhead", class_set.node_overhead),
         ] + (
-            [("open_allowed", class_set.open_allowed)]
+            [("open_allowed", _mask(class_set.open_allowed))]
             if getattr(class_set, "open_allowed", None) is not None else []
         ) + (
-            [("join_allowed", class_set.join_allowed)]
+            [("join_allowed", _mask(class_set.join_allowed))]
             if getattr(class_set, "join_allowed", None) is not None else []
         )
 
@@ -1721,14 +1774,15 @@ class SolverClient:
             self._epoch_bases.pop(next(iter(self._epoch_bases)))
 
     def _patch_base(self, seqnum: str, epoch: str, b: Dict[str, np.ndarray],
-                    rows: np.ndarray, named: Dict[str, np.ndarray]) -> None:
+                    rows: np.ndarray, named: Dict[str, np.ndarray],
+                    row_names=PER_CLASS_TENSORS) -> None:
         """Advance a delta chain's stored base IN PLACE: O(dirty rows)
         host work per tick, like everything else in the engine -- a full
         re-copy here would spend memory bandwidth on exactly the bytes
         the delta ship avoids. Caller holds the lock; `b` is this
         client's private copy (never aliased into a frame)."""
         if rows.size:
-            for name in PER_CLASS_TENSORS:
+            for name in row_names:
                 b[name][rows] = named[name][rows]
         b["node_overhead"] = np.array(named["node_overhead"])
         self._epoch_bases.pop(seqnum, None)  # LRU refresh
@@ -1759,7 +1813,7 @@ class SolverClient:
         The server reassembles the identical tensor set in every mode, so
         the decision is bit-identical by construction (tests/test_delta.py
         asserts it differentially). Caller holds the lock."""
-        tensors = self._class_tensors(class_set)
+        tensors = self._class_tensors(class_set, packed=self._packed_wire())
         full_bytes = int(sum(a.nbytes for _, a in tensors))
         if not self.delta or header.get("op") != "solve_compact":
             self._bypass_delta(full_bytes)
@@ -1775,11 +1829,19 @@ class SolverClient:
             self._bypass_delta(full_bytes)
             return tensors
         named = dict(tensors)
-        if "open_allowed" in named or "join_allowed" in named:
-            # merged multi-pool: the [C, K] masks dominate the payload and
-            # are re-derived per tick -- the delta path stands down
+        if any(
+            n in named and not packing.is_packed(named[n])
+            for n in PACKED_MASK_TENSORS
+        ):
+            # merged multi-pool, FULL-WIDTH masks: the bool [C, K] rows
+            # dominate the payload and are re-derived per tick -- the
+            # delta path stands down. Packed [C, KW] uint32 masks are an
+            # eighth the size and row-patch below like any class tensor.
             self._bypass_delta(full_bytes)
             return tensors
+        row_names = list(PER_CLASS_TENSORS) + [
+            n for n in PACKED_MASK_TENSORS if n in named
+        ]
         try:
             if "solve_delta" not in self.features():
                 self._bypass_delta(full_bytes)
@@ -1797,7 +1859,7 @@ class SolverClient:
                 for n in named
             ):
                 changed = np.zeros((named["req"].shape[0],), dtype=bool)
-                for name in PER_CLASS_TENSORS:
+                for name in row_names:
                     diff = named[name] != b[name]
                     if diff.ndim > 1:
                         diff = diff.any(axis=tuple(range(1, diff.ndim)))
@@ -1810,11 +1872,11 @@ class SolverClient:
                     header["rows"] = [int(r) for r in rows]
                     out = [
                         (name, np.ascontiguousarray(named[name][rows]))
-                        for name in PER_CLASS_TENSORS
+                        for name in row_names
                     ]
                     # whole-set tensors always ship (tiny [R] vector)
                     out.append(("node_overhead", named["node_overhead"]))
-                    self._patch_base(seqnum, epoch, b, rows, named)
+                    self._patch_base(seqnum, epoch, b, rows, named, row_names)
                     payload = int(sum(a.nbytes for _, a in out))
                     self.last_delta = {
                         "mode": "delta", "rows": int(rows.size),

@@ -32,7 +32,7 @@ from karpenter_tpu.obs import hbm as obs_hbm
 from karpenter_tpu.logging import ChangeMonitor, get_logger
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resources
 from karpenter_tpu.scheduling import resources as res
-from karpenter_tpu.solver import encode, ffd
+from karpenter_tpu.solver import encode, ffd, packing
 from karpenter_tpu.solver.encode import CatalogTensors
 from karpenter_tpu.solver.oracle import NewNodeGroup, Scheduler, SchedulingResult
 from karpenter_tpu.utils import gc_paused
@@ -125,7 +125,8 @@ class TPUSolver:
     def __init__(
         self, g_max: int = 1024, c_pad_min: int = 16, client=None,
         objective: str = "price", auto_warm: bool = False, breaker=None,
-        incremental: bool = True, mesh=None,
+        incremental: bool = True, mesh=None, kernels: str = "xla",
+        packed_masks: bool = False,
     ):
         # mesh-sharded production solve (karpenter_tpu/fleet/shard.py):
         # with a mesh configured (and no wire client -- the sidecar owns
@@ -216,6 +217,25 @@ class TPUSolver:
         # HBM attribution (obs/hbm.py): bytes of the last solve's input
         # tensors -- the "solve temporaries" owner in staged_bytes_by_kind
         self._last_solve_bytes = 0
+        # bit-packed [C,K] allowed masks (solver/packing.py): the class
+        # open/join rows stage as uint32 words (8x less HBM/bandwidth at
+        # any real k_pad) and the kernels unpack in-jit -- winners are
+        # bit-identical by construction (tests/test_packing.py). The
+        # packed/full byte pair of the last solve feeds the ledger's
+        # class_masks kind so the reduction is measured, not claimed.
+        self.packed_masks = packed_masks
+        self._last_mask_bytes = 0
+        self._last_mask_full_bytes = 0
+        # kernel selection (solver/kernels/): "pallas" dispatches the
+        # hand-written fused kernels with the XLA twins as the permanent
+        # in-process fallback rung -- one lowering/runtime failure pins
+        # this process to XLA (same decisions, never a dead tick);
+        # "xla" is the default scan/vmap path. Interpret mode on CPU rigs
+        # is resolved inside solver/kernels (trace-time backend read).
+        if kernels not in ("xla", "pallas"):
+            raise ValueError(f"kernels must be 'xla' or 'pallas', got {kernels!r}")
+        self.kernels = kernels
+        self._pallas_failed: set = set()   # entry names that fell back
         self._lock = threading.Lock()
 
     # -- catalog staging ----------------------------------------------------
@@ -408,24 +428,75 @@ class TPUSolver:
         outs = []
         for cp in c_pads:
             cs = encode.encode_classes([], entry.tensors, c_pad=cp)
-            inp = ffd.make_inputs_staged(entry.staged, cs)
-            if self.mesh_engine is not None:
-                outs.append(
-                    self.mesh_engine.solve_fused(
-                        inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(cp, self.g_max),
-                        word_offsets=entry.offsets, words=entry.words,
-                        objective=self.objective,
-                    )
+            inp = ffd.make_inputs_staged(
+                entry.staged, cs, packed_masks=self.packed_masks)
+            outs.append(
+                self._dispatch_fused(
+                    inp, nnz_max=ffd.nnz_budget(cp, self.g_max),
+                    offsets=entry.offsets, words=entry.words,
                 )
-            else:
-                outs.append(
-                    ffd.ffd_solve_fused(
-                        inp, g_max=self.g_max, nnz_max=ffd.nnz_budget(cp, self.g_max),
-                        word_offsets=entry.offsets, words=entry.words, objective=self.objective,
-                    )
-                )
+            )
             self._warmed_pads.add(self._warm_key(cp, entry))
         jax.block_until_ready(outs)
+
+    # -- kernel selection ---------------------------------------------------
+    def _dispatch_fused(self, inp, nnz_max: int, offsets, words):
+        """One fused-solve dispatch through the configured kernel rung:
+        mesh engine when sharded, the hand-written Pallas kernel when
+        kernels='pallas' (solver/kernels/ffd_pallas.py -- same jit
+        signature, same statics, bit-identical fused buffer), the XLA
+        scan otherwise. A Pallas failure (lowering or runtime) logs once,
+        counts, and pins THIS entry to the XLA twin for the process --
+        the kernel-selection rung of the degrade ladder: decisions never
+        change, only who computes them."""
+        common = dict(
+            g_max=self.g_max, nnz_max=nnz_max, word_offsets=offsets,
+            words=words, objective=self.objective,
+        )
+        if self.mesh_engine is not None:
+            return self.mesh_engine.solve_fused(inp, **common)
+        if self.kernels == "pallas" and "ffd_solve_fused" not in self._pallas_failed:
+            from karpenter_tpu.solver.kernels import ffd_pallas
+
+            try:
+                buf = ffd_pallas.ffd_solve_fused_pallas(inp, **common)
+                metrics.SOLVER_KERNEL_DISPATCHES.inc(
+                    entry="ffd_solve_fused", impl="pallas")
+                return buf
+            except Exception as e:  # noqa: BLE001 -- any lowering/runtime
+                # failure takes the fallback rung, never the tick
+                self._pallas_failed.add("ffd_solve_fused")
+                metrics.SOLVER_KERNEL_FALLBACKS.inc(entry="ffd_solve_fused")
+                self.log.warning(
+                    "pallas ffd kernel failed; pinned to XLA twin",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+        metrics.SOLVER_KERNEL_DISPATCHES.inc(entry="ffd_solve_fused", impl="xla")
+        return ffd.ffd_solve_fused(inp, **common)
+
+    def _dispatch_disrupt_repack(self, headroom, feas, req, member, excl):
+        """disrupt_repack through the same kernel-selection rung as
+        _dispatch_fused (Pallas twin: solver/kernels/disrupt_pallas.py)."""
+        from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
+
+        if self.kernels == "pallas" and "disrupt_repack" not in self._pallas_failed:
+            from karpenter_tpu.solver.kernels import disrupt_pallas
+
+            try:
+                out = disrupt_pallas.disrupt_repack_pallas(
+                    headroom, feas, req, member, excl)
+                metrics.SOLVER_KERNEL_DISPATCHES.inc(
+                    entry="disrupt_repack", impl="pallas")
+                return out
+            except Exception as e:  # noqa: BLE001
+                self._pallas_failed.add("disrupt_repack")
+                metrics.SOLVER_KERNEL_FALLBACKS.inc(entry="disrupt_repack")
+                self.log.warning(
+                    "pallas disrupt kernel failed; pinned to XLA twin",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+        metrics.SOLVER_KERNEL_DISPATCHES.inc(entry="disrupt_repack", impl="xla")
+        return disrupt_kernel.disrupt_repack(headroom, feas, req, member, excl)
 
     # -- routing ------------------------------------------------------------
     @staticmethod
@@ -814,14 +885,24 @@ class TPUSolver:
         with self._lock:
             entries = list(self._catalog_cache.values())
             temporaries = self._last_solve_bytes
+            mask_bytes = self._last_mask_bytes
+            mask_full = self._last_mask_full_bytes
         catalog = sum(
             obs_hbm.sum_nbytes(e.tensors) + obs_hbm.sum_nbytes(e.staged)
             for e in entries
         )
         metrics.SOLVER_STAGED_BYTES.set(float(catalog), kind="catalog")
+        metrics.SOLVER_STAGED_BYTES.set(float(mask_bytes), kind="class_masks")
         metrics.SOLVER_STAGED_BYTES.set(
             float(temporaries), kind="solve_temporaries")
-        return {"catalog": int(catalog), "solve_temporaries": int(temporaries)}
+        metrics.SOLVER_PACKED_MASK_BYTES.set(float(mask_bytes), form="packed")
+        metrics.SOLVER_PACKED_MASK_BYTES.set(float(mask_full), form="full_equiv")
+        return {
+            "catalog": int(catalog),
+            "class_masks": int(mask_bytes),
+            "class_masks_full_equiv": int(mask_full),
+            "solve_temporaries": int(temporaries),
+        }
 
     def describe_wire(self) -> dict:
         """Delta/staging state document for /debug/solver: the grouping
@@ -1536,6 +1617,13 @@ class TPUSolver:
             # single-pool: class requirements already carry the pool's
             # extras, so the envelope key needs no further merge
             self._unify_envelopes(classes, class_set, lambda c: (pool.name, None))
+        if self.packed_masks and self.client is None:
+            # bit-pack the [C, K] mask rows at encode time: the class set
+            # carries [C, KW] uint32 words from here on, so staging and
+            # every make_inputs pass them through (wire clients negotiate
+            # their own form in rpc._class_tensors instead -- an old
+            # server must keep receiving full-width bool)
+            encode.pack_class_masks(class_set)
         counts = class_set.count.copy()
         counts[: len(classes)] -= placed_existing.astype(counts.dtype)
         class_set.count = counts
@@ -1605,7 +1693,8 @@ class TPUSolver:
                     pending.rpc_handle = None
         else:
             with tracing.span("dispatch_device"):
-                inp = ffd.make_inputs_staged(staged, class_set)
+                inp = ffd.make_inputs_staged(
+                    staged, class_set, packed_masks=self.packed_masks)
                 # fused compact decision: the whole result in ONE ~140 KB u32
                 # buffer instead of 7 arrays (the tunnel serializes per-array
                 # copies at ~5 ms each), fetched with ONE async copy issued at
@@ -1615,21 +1704,19 @@ class TPUSolver:
                 nnz_max = ffd.nnz_budget(class_set.c_pad, self.g_max)
                 # HBM attribution: nbytes is array metadata, not a fetch
                 self._last_solve_bytes = obs_hbm.sum_nbytes(inp)
-                if self.mesh_engine is not None:
-                    # the mesh-sharded production dispatch: same fused
-                    # buffer, per-shard winners all-gathered in-jit, so
-                    # the async fetch below is a replicated local read
-                    buf = self.mesh_engine.solve_fused(
-                        inp, g_max=self.g_max, nnz_max=nnz_max,
-                        word_offsets=offsets, words=words,
-                        objective=self.objective,
-                    )
-                else:
-                    buf = ffd.ffd_solve_fused(
-                        inp, g_max=self.g_max, nnz_max=nnz_max,
-                        word_offsets=offsets, words=words,
-                        objective=self.objective,
-                    )
+                # mask-family attribution: actual staged bytes of the
+                # open/join rows vs their full-width bool equivalent --
+                # staged_bytes_by_kind's class_masks pair, the measured
+                # half of the packed-mask reduction claim
+                self._last_mask_bytes = (
+                    packing.mask_nbytes(inp.open_allowed)
+                    + packing.mask_nbytes(inp.join_allowed)
+                )
+                self._last_mask_full_bytes = 2 * packing.full_mask_nbytes(
+                    class_set.c_pad, entry.tensors.k_pad
+                )
+                buf = self._dispatch_fused(
+                    inp, nnz_max=nnz_max, offsets=offsets, words=words)
                 buf.copy_to_host_async()
             pending.buf = buf
             pending.inp = inp
@@ -1759,7 +1846,8 @@ class TPUSolver:
         have returned."""
         entry = self._local_staged(pending.entry)
         pending.entry = entry
-        inp = ffd.make_inputs_staged(entry.staged, pending.class_set)
+        inp = ffd.make_inputs_staged(
+            entry.staged, pending.class_set, packed_masks=self.packed_masks)
         return ffd.solve_dense_tuple(
             inp, g_max=self.g_max, word_offsets=entry.offsets,
             words=entry.words, objective=self.objective,
@@ -1846,7 +1934,6 @@ class TPUSolver:
         """First-fit pods onto live/in-flight nodes on device; fills
         result.existing_assignments and returns per-class placed counts."""
         from karpenter_tpu.solver.disrupt import engine as disrupt_engine
-        from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
 
         C = _bucket(len(classes), self.c_pad_min)
         N = _bucket(len(existing_nodes), 16)
@@ -1862,7 +1949,7 @@ class TPUSolver:
         headroom = np.zeros((N, encode.R), dtype=np.float32)
         for ni, node in enumerate(existing_nodes):
             headroom[ni] = encode.scale_vector(node.remaining().to_vector())
-        _, takes = disrupt_kernel.disrupt_repack(
+        _, takes = self._dispatch_disrupt_repack(
             headroom, feas, req, member, np.zeros((1, N), dtype=bool)
         )
         if hasattr(takes, "copy_to_host_async"):
