@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate bench-fleet bench-mpod benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos overload sim-corpus sim-fleet multichip lint typecheck
+.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate bench-fleet bench-mpod bench-quality bench-trend benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos overload sim-corpus sim-fleet multichip lint typecheck
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -60,6 +60,12 @@ bench-fleet:  ## fleet tier: 500k-pod/2k-type mesh-sharded solve (sharded warm-t
 
 bench-mpod:  ## mpod tier: 1M-pod/5k-type packed-mask solve on the 2x4 multi-host mesh layout (warm-tick p50/p99, >=8x packed-mask byte reduction asserted against staged inputs AND the live HBM ledger, packed==full differential); memory-aware skip on small rigs, rig caveats in the JSON; one JSON line
 	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --mpod-only > bench_mpod_last.json; rc=$$?; cat bench_mpod_last.json; exit $$rc
+
+bench-quality:  ## solution-quality stage only (quality observatory: optimality gap >= 1.0 at the 10k/50k tiers, bound dispatch+fetch cost, waste attribution, quality_retrace_count asserted 0); one JSON line
+	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --quality-only > bench_quality_last.json; rc=$$?; cat bench_quality_last.json; exit $$rc
+
+bench-trend:  ## round-over-round trend table consolidating the BENCH_rNN.json artifacts (one row per driver round: cold/warm/wire/consolidation/fleet/mpod/quality headline fields; crashed rounds render as dashes)
+	$(PY) hack/bench_trend.py
 
 # the chaos-family soaks route the observatory's crash-flushed black box
 # (karpenter_tpu/obs/flight.py) into their artifact dirs, so a failing

@@ -1480,6 +1480,98 @@ def _rig_caveats(platform: str, g_max: int, full_g: int) -> list:
     return caveats
 
 
+def _quality_stage(pool, items, zones, rng, warm_tick_p50_ms=None,
+                   iters: int = 30, platform: str = "cpu") -> dict:
+    """Solution-quality stage (quality-observatory tentpole): ALWAYS
+    runs. Proves three things about the in-jit fractional price bound
+    (solver/bound.py + obs/quality.py):
+
+    - soundness: optimality_gap = realized fleet price / bound >= 1.0 at
+      the 10k and 50k tiers (a gap below 1 means the "lower bound"
+      exceeded a real feasible solution -- the bound is wrong, not the
+      solver good);
+    - cost: the bound dispatch + fetch measured ALONE over N iterations
+      lands under 1% of the warm tick p50 (the observatory must not tax
+      the tick it observes);
+    - discipline: the measured loop runs inside a jax-witness hot
+      section, so any retrace or unsanctioned host transfer is a
+      recorded violation (fetch_bound is the one SANCTIONED seam).
+    """
+    from karpenter_tpu.analysis import jax_witness
+    from karpenter_tpu.solver import bound as bound_mod
+    from karpenter_tpu.solver.service import TPUSolver
+
+    out: dict = {}
+    captured: dict = {}
+    solver = None
+    for tier in sorted({min(N_PODS, 10_000), min(N_PODS, 50_000)}):
+        solver = TPUSolver(g_max=G_MAX)
+        pods = synth_pods(rng, zones, tier, salt=91_000 + tier)
+        solver.solve(pool, items, pods)  # compile + stage
+        # capture the bound's own inputs off the warm solve so the cost
+        # loop below measures exactly the dispatch production pays
+        orig = solver._dispatch_bound
+
+        def _capture(inp, placed, offsets, words, _orig=orig):
+            captured.update(inp=inp, placed=placed,
+                            offsets=offsets, words=words)
+            return _orig(inp, placed, offsets=offsets, words=words)
+
+        solver._dispatch_bound = _capture
+        try:
+            solver.solve(pool, items, pods)
+        finally:
+            solver._dispatch_bound = orig
+        q = dict(solver.last_quality or {})
+        gap = q.get("optimality_gap")
+        assert gap is not None and gap >= 1.0, (
+            f"fractional bound unsound at the {tier}-pod tier: gap={gap}")
+        tag = f"{tier // 1000}k"
+        out[f"quality_gap_{tag}"] = round(float(gap), 4)
+        out[f"quality_bound_per_h_{tag}"] = round(float(q["bound_per_h"]), 4)
+        out[f"quality_realized_per_h_{tag}"] = round(
+            float(q["realized_per_h"]), 4)
+        out[f"quality_binding_resource_{tag}"] = q.get("binding_resource")
+        out[f"quality_stranded_cpu_{tag}"] = round(
+            float(q.get("stranded_cpu_fraction", 0.0)), 4)
+        out[f"quality_stranded_memory_{tag}"] = round(
+            float(q.get("stranded_memory_fraction", 0.0)), 4)
+        out[f"quality_fragmentation_{tag}"] = round(
+            float(q.get("fragmentation_index", 0.0)), 4)
+
+    # bound cost, measured ALONE on the top tier's captured inputs:
+    # dispatch + the blocking fetch, inside a witness hot section
+    wit0 = jax_witness.stats() if jax_witness.installed() else None
+    cost_ms = []
+    with jax_witness.hot("bench_quality_bound"):
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            totals = solver._dispatch_bound(
+                captured["inp"], captured["placed"],
+                offsets=captured["offsets"], words=captured["words"])
+            bound_mod.fetch_bound(totals)
+            cost_ms.append((time.perf_counter() - t0) * 1e3)
+    cost_p50 = float(np.percentile(cost_ms, 50))
+    out["quality_bound_cost_ms"] = round(cost_p50, 4)
+    out["quality_bound_cost_p99_ms"] = round(float(np.percentile(cost_ms, 99)), 4)
+    if wit0 is not None:
+        wit1 = jax_witness.stats()
+        out["quality_retrace_count"] = int(
+            wit1["hot_retraces"] - wit0["hot_retraces"])
+        out["quality_host_transfer_count"] = int(
+            wit1["hot_transfers"] - wit0["hot_transfers"])
+        out["quality_retrace_ok"] = bool(
+            out["quality_retrace_count"] == 0
+            and out["quality_host_transfer_count"] == 0)
+    if warm_tick_p50_ms and warm_tick_p50_ms > 0:
+        share = cost_p50 / float(warm_tick_p50_ms)
+        out["quality_bound_share_of_warm_tick"] = round(share, 5)
+        assert share < 0.01, (
+            f"bound cost {cost_p50:.3f}ms is {share:.1%} of the "
+            f"{warm_tick_p50_ms:.1f}ms warm tick (budget: <1%)")
+    return out
+
+
 def _fleet_stage(items, zones, progress=lambda ev: None,
                  stage_fields=lambda fields: None, platform: str = "cpu") -> dict:
     """The 500k-pod / 2k-type FLEET tier (`make bench-fleet`): the
@@ -1931,7 +2023,8 @@ def _gen2_collections() -> int:
 
 def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
         wire_only: bool = False, consolidate_only: bool = False,
-        fleet_only: bool = False, mpod_only: bool = False):
+        fleet_only: bool = False, mpod_only: bool = False,
+        quality_only: bool = False):
     import jax
 
     from karpenter_tpu.apis import NodePool
@@ -1982,6 +2075,7 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
             "unit": "ms",
             "mode": "warm_delta_only",
             "platform": backend,
+            "rig_caveats": _rig_caveats(backend, G_MAX, 1_024),
         }
         out.update(_warm_delta(pool, items, zones,
                                iters=10 if backend != "cpu" else 8))
@@ -1996,6 +2090,7 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
             "unit": "ms",
             "mode": "wire_only",
             "platform": backend,
+            "rig_caveats": _rig_caveats(backend, G_MAX, 1_024),
         }
         out.update(_wire_stage(pool, items, zones,
                                iters=10 if backend != "cpu" else 6))
@@ -2041,6 +2136,24 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
         out["value"] = out.get("mpod_warm_tick_p50_ms", 0.0)
         stage_fields(out)
         return out
+    if quality_only:
+        # `make bench-quality`: only the solution-quality stage (plus
+        # setup) -- the fast iteration loop for the quality observatory:
+        # gap soundness + bound cost at the 10k/50k tiers
+        out = {
+            "metric": f"quality_gap_{min(N_PODS, 50_000) // 1000}k_pods",
+            "unit": "ratio",
+            "mode": "quality_only",
+            "platform": backend,
+            "rig_caveats": _rig_caveats(backend, G_MAX, 1_024),
+        }
+        out.update(_quality_stage(
+            pool, items, zones, np.random.default_rng(42),
+            iters=30 if backend != "cpu" else 12, platform=backend))
+        out["value"] = out.get(
+            f"quality_gap_{min(N_PODS, 50_000) // 1000}k", 0.0)
+        stage_fields(out)
+        return out
     if consolidate_only:
         # `make bench-consolidate`: only the consolidation stage (plus
         # setup) -- the fast iteration loop for the disrupt engine
@@ -2049,6 +2162,7 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
             "unit": "nodes/s",
             "mode": "consolidate_only",
             "platform": backend,
+            "rig_caveats": _rig_caveats(backend, G_MAX, 1_024),
         }
         out.update(_consolidation_stage(
             pool, items, iters=8 if backend != "cpu" else 5))
@@ -2160,6 +2274,7 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
         "p50_ms": round(p50, 2), "mode": "cold_pods",
         "warm_p50_ms": round(warm_p50, 2), "warm_p99_ms": round(warm_p99, 2),
         "platform": backend,
+        "rig_caveats": _rig_caveats(backend, G_MAX, 1_024),
     })
 
     # fleet price of the decision under the price objective, and the same
@@ -2250,6 +2365,21 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
     except Exception as e:  # noqa: BLE001
         production["consolidation_stage_error"] = f"{type(e).__name__}: {e}"[:200]
     progress({"ev": "phase", "name": "consolidation"})
+    stage_fields(production)
+
+    # solution-quality stage (quality-observatory tentpole): ALWAYS runs
+    # -- gap >= 1.0 at the 10k/50k tiers, the bound's own dispatch+fetch
+    # cost vs the warm tick (<1% acceptance), and the witness counters
+    # for the bound's measured loop are headline acceptance data,
+    # persisted via the incremental side-file like every other stage
+    try:
+        production.update(_quality_stage(
+            pool, items, zones, rng,
+            warm_tick_p50_ms=production.get("warm_delta_tick_p50_ms") or warm_p50,
+            iters=30 if backend != "cpu" else 12, platform=backend))
+    except Exception as e:  # noqa: BLE001
+        production["quality_stage_error"] = f"{type(e).__name__}: {e}"[:200]
+    progress({"ev": "phase", "name": "quality"})
     stage_fields(production)
 
     # secondary measurements -- each individually fenced so a failure can
@@ -2378,6 +2508,7 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
         "fleet_price_per_hour": round(fleet_price, 2),
         "fleet_price_fit_mode": round(fit_price, 2),
         "objective": solver.objective,
+        "rig_caveats": _rig_caveats(backend, G_MAX, 1_024),
         **production,
         **secondary,
     }
@@ -2404,7 +2535,8 @@ def _child_main() -> None:
                   wire_only="--wire-only" in sys.argv,
                   consolidate_only="--consolidate-only" in sys.argv,
                   fleet_only="--fleet-only" in sys.argv,
-                  mpod_only="--mpod-only" in sys.argv)
+                  mpod_only="--mpod-only" in sys.argv,
+                  quality_only="--quality-only" in sys.argv)
         progress({"ev": "result", "out": out})
         print(json.dumps(out))
     except Exception as e:  # noqa: BLE001 - parent assembles a partial
@@ -2552,6 +2684,8 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
         args.append("--fleet-only")
     if "--mpod-only" in sys.argv:
         args.append("--mpod-only")
+    if "--quality-only" in sys.argv:
+        args.append("--quality-only")
     proc = subprocess.Popen(
         args, stdout=subprocess.DEVNULL, stderr=None, text=True, env=env
     )

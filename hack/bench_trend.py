@@ -1,0 +1,127 @@
+"""Round-over-round bench trend table (quality-observatory satellite).
+
+Each driver round leaves one ``BENCH_rNN.json`` artifact in the repo
+root: ``{n, cmd, rc, tail, parsed}`` where ``parsed`` is the bench's
+one-JSON-line output (or null when the round crashed -- r01's rc=1 and
+r05's rc=124 are real rows, not noise, and the table must show them).
+Reading five of those side by side by hand is exactly the drift this
+script removes: it consolidates the headline field of every stage family
+(warm, wire, consolidation, fleet, mpod, quality) into ONE table, one
+row per round, so a regression reads as a column going the wrong way.
+
+Usage:
+    python hack/bench_trend.py            # text table (make bench-trend)
+    python hack/bench_trend.py --json     # machine-readable rows
+    python hack/bench_trend.py --dir X    # artifacts live elsewhere
+
+Crashed rounds render with ``-`` in every stage column; a field a round
+predates (stages accrete over the PR sequence -- r02 has no wire
+numbers, nothing before the quality observatory has a gap) is also
+``-``, never an error. Exit 0 unless no artifacts were found at all.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+# (column header, parsed-dict key) per stage family -- the headline
+# field each stage's Makefile target names first in its help line
+COLUMNS = (
+    ("cold_p99_ms", "value"),
+    ("warm_p50_ms", "warm_p50_ms"),
+    ("warm_delta_p50_ms", "warm_delta_tick_p50_ms"),
+    ("wire_p50_ms", "warm_wire_p50_ms"),
+    ("consol_nodes_per_s", "consolidation_nodes_per_s"),
+    ("fleet_tick_p50_ms", "fleet_warm_tick_p50_ms"),
+    ("mpod_tick_p50_ms", "mpod_warm_tick_p50_ms"),
+    ("quality_gap", "quality_gap_50k"),
+    ("bound_cost_ms", "quality_bound_cost_ms"),
+    ("fleet_price_per_h", "fleet_price_per_hour"),
+)
+
+
+def load_rounds(directory: Path) -> list:
+    rounds = []
+    for path in sorted(directory.glob("BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path.name)
+        if m is None:
+            continue
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"bench_trend: skipping {path.name}: {e}", file=sys.stderr)
+            continue
+        rounds.append({
+            "round": int(m.group(1)),
+            "rc": doc.get("rc"),
+            "parsed": doc.get("parsed") or None,
+        })
+    return rounds
+
+
+def trend_rows(rounds: list) -> list:
+    """One flat dict per round: round, rc, platform, then each stage
+    column (None when the round crashed or predates the stage)."""
+    rows = []
+    for r in rounds:
+        p = r["parsed"] if isinstance(r["parsed"], dict) else {}
+        row = {
+            "round": r["round"],
+            "rc": r["rc"],
+            "platform": p.get("platform"),
+        }
+        for header, key in COLUMNS:
+            v = p.get(key)
+            row[header] = v if isinstance(v, (int, float)) else None
+        rows.append(row)
+    return rows
+
+
+def render_table(rows: list) -> str:
+    headers = ["round", "rc", "platform"] + [h for h, _ in COLUMNS]
+    table = [headers]
+    for row in rows:
+        table.append([
+            "-" if row.get(h) is None else str(row[h]) for h in headers
+        ])
+    widths = [max(len(line[i]) for line in table) for i in range(len(headers))]
+    lines = []
+    for j, line in enumerate(table):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(line, widths)))
+        if j == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dir", default=str(ROOT),
+                   help="directory holding BENCH_rNN.json (default: repo root)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the rows as a JSON array instead of a table")
+    args = p.parse_args(argv)
+
+    rounds = load_rounds(Path(args.dir))
+    if not rounds:
+        print(f"bench_trend: no BENCH_rNN.json artifacts in {args.dir}",
+              file=sys.stderr)
+        return 1
+    rows = trend_rows(rounds)
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(render_table(rows))
+        crashed = [r["round"] for r in rows if r["rc"] not in (0, None)]
+        if crashed:
+            print(f"\ncrashed rounds (rc != 0, no parsed line): "
+                  f"{', '.join(f'r{n:02d}' for n in crashed)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

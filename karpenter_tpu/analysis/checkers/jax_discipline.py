@@ -113,6 +113,9 @@ JIT_ENTRY_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     "karpenter_tpu.solver.disrupt.kernel": ("disrupt_repack", "disrupt_replace"),
     "karpenter_tpu.solver.kernels.ffd_pallas": ("ffd_solve_fused_pallas",),
     "karpenter_tpu.solver.kernels.disrupt_pallas": ("disrupt_repack_pallas",),
+    # solution-quality observatory: the fractional price bound runs on
+    # every warm tick right behind the solve (observe-only)
+    "karpenter_tpu.solver.bound": ("fractional_price_bound",),
 }
 
 # every Pallas kernel entry must keep a registered XLA twin: the
@@ -156,11 +159,21 @@ DEVICE_HOT_PATH: Dict[str, Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]] =
          "expand_compact"),
         {},
     ),
+    # solution-quality observatory: the bound is dispatched per warm tick
+    # inside solve_finish (overlapping decode), fetched through the one
+    # SANCTIONED barrier below -- hot-path by construction even though
+    # its output is observe-only
+    "karpenter_tpu/solver/bound.py": (
+        ("fractional_price_bound", "fractional_price_bound_impl",
+         "fetch_bound"),
+        {},
+    ),
     "karpenter_tpu/solver/service.py": (
         (),
         {"TPUSolver": ("solve_begin", "solve_finish", "_finish_remote",
                        "_solve_local_dense", "_pack_existing",
-                       "_dispatch_fused", "_dispatch_disrupt_repack")},
+                       "_dispatch_fused", "_dispatch_disrupt_repack",
+                       "_dispatch_bound", "_begin_quality")},
     ),
     # Pallas kernel entries: the wrappers run per tick when selected
     # (TPUSolver(kernels="pallas")), so their prologue/epilogue code is
@@ -199,7 +212,8 @@ DEVICE_HOT_PATH: Dict[str, Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]] =
     "karpenter_tpu/fleet/shard.py": (
         (),
         {"MeshSolveEngine": ("solve_fused", "solve_compact", "solve_dense",
-                             "repack", "replace", "fetch", "_put_inputs")},
+                             "price_bound", "repack", "replace", "fetch",
+                             "_put_inputs")},
     ),
     # device performance observatory (karpenter_tpu/obs/): these run on
     # EVERY tick, so they are hot-path by construction and the jaxhost
@@ -238,6 +252,9 @@ SANCTIONED_FETCH: Set[Tuple[str, str]] = {
     ("karpenter_tpu/solver/disrupt/engine.py", "_evaluate_local"),
     ("karpenter_tpu/parallel/mesh.py", "_fetch_multiprocess"),
     ("karpenter_tpu/fleet/shard.py", "fetch"),
+    # the optimality-gap bound's designed barrier: drains the
+    # copy_to_host_async issued when solve_finish dispatched the bound
+    ("karpenter_tpu/solver/bound.py", "fetch_bound"),
     # observatory introspection seams: memory_stats() reads the
     # allocator ledger (metadata, no transfer) and the profiler bracket
     # drives the runtime's own trace collection -- both are designed

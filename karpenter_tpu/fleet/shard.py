@@ -230,6 +230,22 @@ class MeshSolveEngine:
                 ),
                 **solve_kw,
             )
+        if kind == "bound":
+            # quality observatory (solver/bound.py): same input shardings
+            # as the solve it shadows, placed counts replicated, [R]
+            # totals all-gathered in-jit like every other entry
+            offsets, words, packed = statics
+            from karpenter_tpu.solver import bound as bound_mod
+
+            in_sh = self._in_shardings_packed if packed else self._in_shardings
+            return jax.jit(
+                functools.partial(
+                    bound_mod.fractional_price_bound_impl,
+                    word_offsets=offsets, words=words,
+                ),
+                in_shardings=(in_sh, self._rep),
+                out_shardings=self._rep,
+            )
         if kind == "repack":
             from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
 
@@ -291,6 +307,21 @@ class MeshSolveEngine:
         )
         metrics.MESH_DISPATCHES.inc(entry="dense")
         return fn(self._put_inputs(inp))
+
+    def price_bound(
+        self, inp: ffd.SolveInputs, placed, *,
+        word_offsets: Tuple[int, ...], words: Tuple[int, ...],
+    ) -> jax.Array:
+        """The optimality-gap bound's sharded dispatch (solver/bound.py):
+        async, [R] replicated totals out -- the caller's
+        copy_to_host_async + fetch_bound barrier is unchanged."""
+        fn = self._entry(
+            "bound", (word_offsets, words, self._mask_form(inp)))
+        metrics.MESH_DISPATCHES.inc(entry="bound")
+        args = (self._put_inputs(inp), placed)
+        if self._multiproc:
+            args = (args[0], mesh_mod._put_multiprocess(placed, self._rep))
+        return fn(*args)
 
     def repack(self, headroom, feas, req, member, excl):
         """Disrupt candidate-pool repack, set axis sharded over every mesh

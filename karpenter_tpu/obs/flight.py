@@ -227,6 +227,21 @@ def build_tick_record(root_sp, t0: float, *, solver=None, brownout=None,
         staged = getattr(solver, "staged_bytes_by_kind", None)
         if callable(staged):
             rec["staged_bytes"] = staged()
+        # solution-quality observatory (obs/quality.py): the last solve's
+        # gap + waste attribution headline fields -- cheap dict reads of
+        # the document solve_finish already built, so the black box shows
+        # answer quality next to where the time went
+        q = getattr(solver, "last_quality", None)
+        if q:
+            if "optimality_gap" in q:
+                rec["optimality_gap"] = q["optimality_gap"]
+            rec["quality"] = {
+                k: q[k]
+                for k in ("bound_per_h", "realized_per_h",
+                          "stranded_cpu_fraction", "stranded_memory_fraction",
+                          "fragmentation_index")
+                if k in q
+            }
         if breaker is None:
             breaker = getattr(solver, "breaker", None)
     if breaker is not None:

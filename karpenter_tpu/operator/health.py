@@ -78,6 +78,11 @@ DEBUG_ENDPOINTS = {
         "bracketing the next N production ticks (TensorBoard/xprof "
         "output dir); without ?ticks reads the capture state "
         "(karpenter_tpu/obs/profiler.py)"),
+    "/debug/quality": (
+        "solution-quality observatory: the last solve's optimality gap "
+        "(realized fleet price / fractional bound), waste attribution "
+        "(stranded CPU/mem, fragmentation index), price by pool and "
+        "capacity type (karpenter_tpu/obs/quality.py)"),
 }
 
 
@@ -226,6 +231,20 @@ class HealthServer:
 
                     self._send(
                         200, flight.dump_json(indent=2),
+                        ctype="application/json",
+                    )
+                    return
+                if url.path == "/debug/quality":
+                    # solution-quality observatory (karpenter_tpu/obs/
+                    # quality.py): the last solve's gap + waste
+                    # attribution document, recorded process-wide by
+                    # solve_finish -- no binary wiring needed
+                    if not self._loopback_only():
+                        return
+                    from karpenter_tpu.obs import quality
+
+                    self._send(
+                        200, quality.dump_json(indent=2),
                         ctype="application/json",
                     )
                     return

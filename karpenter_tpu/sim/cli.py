@@ -162,6 +162,17 @@ def _cmd_corpus(args) -> int:
     if os.path.exists(digest_path):
         with open(digest_path) as f:
             golden = json.load(f)
+    # solution-quality regression gate (obs/quality.py KPIs): per-scenario
+    # optimality-gap upper bounds pinned next to the digests. Decision
+    # digests prove behavior didn't CHANGE; these bounds catch a solver
+    # change making the ANSWERS worse while every digest stays green.
+    quality_path = os.path.join(args.dir, "quality.json")
+    quality_gold = {}
+    if os.path.exists(quality_path):
+        with open(quality_path) as f:
+            quality_gold = json.load(f)
+    quality_violations = {}
+    new_quality = {}
     report = {}
     new_digests = {}
     rc = 0
@@ -192,6 +203,30 @@ def _cmd_corpus(args) -> int:
             entry["ok"] = False
             entry["golden_digest"] = golden.get(name)
             entry["note"] = "decision digest drifted from golden"
+        host_kpis = res.results["host"].kpis if "host" in res.results else {}
+        gap_keys = ("optimality_gap_p50", "optimality_gap_final")
+        entry["quality"] = {
+            k: host_kpis.get(k, 0.0)
+            for k in gap_keys + ("stranded_cpu_fraction",
+                                 "stranded_memory_fraction",
+                                 "fragmentation_index")
+        }
+        # 30% relative headroom over this run's gaps: loose enough for
+        # tick-alignment jitter across environments, tight enough that a
+        # packing regression (gap creep) trips the gate
+        new_quality[name] = {
+            k + "_max": round(float(host_kpis.get(k, 0.0)) * 1.3, 6)
+            for k in gap_keys
+        }
+        gate = quality_gold.get(name)
+        if gate and not args.update_quality:
+            for k in gap_keys:
+                cap = gate.get(k + "_max")
+                observed = host_kpis.get(k, 0.0)
+                if cap is not None and observed > cap:
+                    quality_violations.setdefault(name, {})[k] = {
+                        "observed": observed, "max": cap,
+                    }
         report[name] = entry
     # delta-path gate (incremental-tick engine): one scenario re-replayed
     # through the wire sidecar with delta class shipping + incremental
@@ -248,6 +283,21 @@ def _cmd_corpus(args) -> int:
             rc = 1
             pentry = {"ok": False, "note": f"packed-path invariant violation: {e}"}
         report[f"packed:{name}"] = pentry
+    if quality_violations:
+        # the regression diff is a ready-made artifact: the sim-corpus CI
+        # job uploads args.artifacts on failure, so the observed-vs-bound
+        # table arrives alongside any shrunk repro
+        rc = 1
+        os.makedirs(args.artifacts, exist_ok=True)
+        diff_path = os.path.join(args.artifacts, "quality-regression.json")
+        with open(diff_path, "w") as f:
+            json.dump(quality_violations, f, indent=2, sort_keys=True)
+            f.write("\n")
+        report["quality_regression"] = {
+            "violations": quality_violations, "diff": diff_path,
+            "note": "optimality gap exceeded the pinned bound "
+                    "(tests/golden/scenarios/quality.json)",
+        }
     if args.update_digests:
         if rc != 0:
             # never pin a diverging run's digest (or null from a failed
@@ -259,6 +309,16 @@ def _cmd_corpus(args) -> int:
             return 1
         with open(digest_path, "w") as f:
             json.dump(new_digests, f, indent=2, sort_keys=True)
+            f.write("\n")
+    if args.update_quality:
+        if rc != 0:
+            print(json.dumps({
+                "corpus": report, "ok": False,
+                "error": "refusing --update-quality: corpus run diverged",
+            }, sort_keys=True))
+            return 1
+        with open(quality_path, "w") as f:
+            json.dump(new_quality, f, indent=2, sort_keys=True)
             f.write("\n")
     print(json.dumps({"corpus": report, "ok": rc == 0}, sort_keys=True))
     return rc
@@ -354,6 +414,9 @@ def main(argv=None) -> int:
     cor.add_argument("--artifacts", default="sim-artifacts")
     cor.add_argument("--update-digests", action="store_true",
                      help="rewrite digests.json from this run")
+    cor.add_argument("--update-quality", action="store_true",
+                     help="rewrite quality.json (per-scenario optimality-"
+                     "gap upper bounds) from this run")
     cor.set_defaults(fn=_cmd_corpus)
 
     flt = sub.add_parser(

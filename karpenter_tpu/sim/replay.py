@@ -306,7 +306,8 @@ class _Engine:
     # -- replay --------------------------------------------------------------
     def run(self, events: List[dict]) -> ReplayResult:
         from karpenter_tpu import metrics
-        from karpenter_tpu.apis import Node, NodeClaim, Pod, labels as wk
+        from karpenter_tpu.apis import Node, NodeClaim, Pod, TPUNodeClass, labels as wk
+        from karpenter_tpu.obs import quality as obs_quality
         from karpenter_tpu.utils import parse_instance_id
 
         op = self.op if self.op is not None else self.build()
@@ -331,6 +332,12 @@ class _Engine:
         fleet_price_peak = 0.0
         fleet_price_final = 0.0
         deleted_pods: set = set()
+        # solution-quality observatory (obs/quality.py): per-tick
+        # optimality gaps against the host-side reference bound, plus the
+        # final fleet's waste attribution. KPI-only -- the decision log
+        # (and therefore every golden digest) never sees any of it.
+        gaps: List[float] = []
+        gap_final = 0.0
 
         # per-tick diff state
         prev_pod_node: Dict[str, str] = {}
@@ -364,9 +371,19 @@ class _Engine:
                     if not usage[name].fits(node.allocatable):
                         raise InvariantViolation(f"node {name} over-committed", tick_i)
 
+        def replay_catalog():
+            """The provider's current catalog list for the reference
+            bound, or None (quality is observe-only: never raises)."""
+            try:
+                ncs = cluster.list(TPUNodeClass)
+                return op.instance_types.list(ncs[0]) if ncs else None
+            except Exception:  # noqa: BLE001 -- quality must never fail a tick
+                metrics.HANDLED_ERRORS.inc(site="sim.quality_catalog")
+                return None
+
         def do_tick(dt: float):
             nonlocal tick_i, fleet_cost, pod_hours, churn, nodes_peak
-            nonlocal fleet_price_peak, fleet_price_final
+            nonlocal fleet_price_peak, fleet_price_final, gap_final
             nonlocal prev_pod_node, prev_claims, prev_nodes
             from karpenter_tpu.failpoints import OperatorCrashed
 
@@ -392,6 +409,18 @@ class _Engine:
             bound = [p for p in cluster.list(Pod) if p.node_name]
             pod_hours += len(bound) * dt / 3600.0
             nodes_peak = max(nodes_peak, len(nodes))
+            # per-tick optimality gap: realized hourly fleet price over
+            # the fractional bound of hosting the currently-bound pods
+            # (obs/quality.py fleet_bound -- sound, so gap >= 1 except
+            # transiently around a price event before the catalog
+            # refreshes, which is why the corpus gate pins upper bounds)
+            if bound and fleet_price > 0.0:
+                catalog = replay_catalog()
+                if catalog:
+                    b = obs_quality.fleet_bound(bound, catalog)
+                    if b > 0.0:
+                        gap_final = fleet_price / b
+                        gaps.append(gap_final)
             # decision-log diff
             pod_node = {p.metadata.name: p.node_name for p in cluster.list(Pod)}
             claims = {c.metadata.name for c in cluster.list(NodeClaim)}
@@ -571,6 +600,14 @@ class _Engine:
             if inst.state == "running" and inst.provider_id not in claimed:
                 raise InvariantViolation(f"orphan instance {inst.id}", tick_i)
 
+        # final-fleet waste attribution (obs/quality.py): stranded
+        # capacity + fragmentation of the converged fleet, from the same
+        # usage map shape the invariant check builds
+        final_nodes = cluster.list(Node)
+        waste = obs_quality.fleet_waste(
+            final_nodes,
+            cluster.node_usage_map([n.metadata.name for n in final_nodes]),
+        )
         n_final = len(cluster.list(Pod))
         kpis = {
             "cost_per_pod_hour": round(fleet_cost / pod_hours, 6) if pod_hours else 0.0,
@@ -585,6 +622,13 @@ class _Engine:
             "pods_total": n_final + len(deleted_pods),
             "pods_bound_final": n_final,
             "sim_seconds": round(clock.now() - 100_000.0, 3),
+            # solution-quality KPIs (observe-only; gated by
+            # tests/golden/scenarios/quality.json in `make sim-corpus`)
+            "optimality_gap_p50": round(_percentile(gaps, 50), 6),
+            "optimality_gap_final": round(gap_final, 6),
+            "stranded_cpu_fraction": waste["stranded_cpu_fraction"],
+            "stranded_memory_fraction": waste["stranded_memory_fraction"],
+            "fragmentation_index": waste["fragmentation_index"],
         }
         return ReplayResult(
             backend=self.backend, seed=self.seed, decision_log=log,

@@ -1,0 +1,175 @@
+"""Fractional lower bound on hourly fleet price: the optimality-gap base.
+
+The solution-quality observatory's in-jit half (the other half is
+``obs/quality.py``, the host-side waste attribution). The FFD solve is a
+heuristic; nothing in the tree measured how far its answers sit from
+optimal. This module computes a RELAXATION bound on every warm tick, on
+device, from the already-staged catalog tensors -- the CvxCluster
+observation (PAPERS.md): the fractional relaxation of a granular
+allocation problem is a masked min-reduce over exactly the [C, K] masks
+and price vectors the encode already built.
+
+The bound, per resource axis r:
+
+    rate[c, r] = min over feasible k of price_ck[c, k] / cap_eff[k, r]
+    total[r]   = sum_c placed[c] * req[c, r] * rate[c, r]
+    bound      = max_r total[r]
+
+where ``cap_eff = max(cap - node_overhead, 0)`` (fresh nodes reserve the
+daemonset overhead) and the feasible set of class c is every type the
+solver could have placed c on: device compat AND the join gate AND a
+finite admitted offering price AND >= 1 pod fits an empty node. Each
+placed pod is fractionally billed the cheapest feasible price per unit
+of its binding resource -- no packing, no integrality, so every real
+assignment pays at least it:
+
+    soundness: a group hosting pods of classes S on chosen type k* has
+    sum_c take_c * req[c, r] <= cap_eff[k*, r] and k* feasible for every
+    c in S, so price(k*) >= sum_c take_c * req[c, r] * price(k*) /
+    cap_eff[k*, r] >= sum_c take_c * req[c, r] * rate[c, r]; summing
+    over groups gives realized >= total[r] for EVERY r, hence >= the
+    max. gap = realized / bound >= 1 is the property test's pin
+    (tests/test_quality.py), and the bound is permutation-invariant by
+    construction (a sum over classes).
+
+``placed`` is a TRACED per-class count of pods the solve actually placed
+on new groups (take-row sums) -- billing REQUESTED counts would break
+gap >= 1 whenever pods go unplaced. The entry is a proper jit citizen:
+registered in JIT_ENTRY_FUNCTIONS (witness cache attribution), statics
+limited to the already-manifested packed-bitset geometry
+(STATIC_ARG_BUCKETS: word_offsets/words), dispatched async from
+``solve_finish`` and fetched through the SANCTIONED ``fetch_bound``
+barrier, mesh-shardable via the fleet engine's ``price_bound`` entry.
+Observe-only by contract: nothing downstream of a decision reads it.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.solver import packing
+from karpenter_tpu.solver.ffd import (
+    SolveInputs, _class_type_price, _device_compat, _fresh_fit_counts,
+)
+
+# numpy scalar, NOT jnp: a module-level jnp constant initializes the XLA
+# backend at import (breaks jax.distributed.initialize in multi-process
+# workers); inside jit the two trace identically (weak f32 scalar).
+_INF = np.float32(np.inf)
+
+
+def fractional_price_bound_impl(
+    inp: SolveInputs, placed: jax.Array, *,
+    word_offsets: Tuple[int, ...], words: Tuple[int, ...],
+) -> jax.Array:
+    """Unjitted body (jit via `fractional_price_bound`; exposed for the
+    fleet engine's sharded wrapper and graft-entry compile checks).
+    Returns the [R] per-resource fractional price totals ($/h); the
+    bound is their max (taken host-side so the binding resource is
+    attributable from the same fetch)."""
+    K = inp.cap.shape[0]
+    R = inp.cap.shape[1]
+    join_allowed = packing.as_bool_mask_jnp(inp.join_allowed, K)
+    compat = _device_compat(inp, word_offsets, words) & join_allowed   # [C, K]
+    cap_eff = jnp.maximum(inp.cap - inp.node_overhead[None, :], 0.0)   # [K, R]
+    price_ck, _ = _class_type_price(inp)                               # [C, K]
+    # feasible = could actually host a pod of c: compat+join, an admitted
+    # finite offering, and at least one pod fits an empty node
+    feas = compat & jnp.isfinite(price_ck) & (
+        _fresh_fit_counts(cap_eff, inp.req) >= 1.0
+    )                                                                  # [C, K]
+    placed_f = placed.astype(jnp.float32)                              # [C]
+    # R-unrolled like _fit_counts (lane-dim discipline: R in the lanes
+    # pads to 128; R separate [C, K] passes keep K there and fuse)
+    totals = []
+    for r in range(R):
+        capr = cap_eff[None, :, r]                                     # [1, K]
+        rate = jnp.where(feas & (capr > 0.0), price_ck / capr, _INF)   # [C, K]
+        best = jnp.min(rate, axis=-1)                                  # [C]
+        # a class with no finite rate on axis r (placed pods then have
+        # req[c, r] == 0, or every feasible type has zero capacity
+        # there) contributes nothing -- where() guards inf * 0 = nan
+        contrib = jnp.where(jnp.isfinite(best), best, 0.0) * inp.req[:, r] * placed_f
+        totals.append(jnp.sum(contrib))
+    return jnp.stack(totals)                                           # [R]
+
+
+# every static_argnames entry below is a declared bounded-cardinality
+# bucket (STATIC_ARG_BUCKETS in analysis/checkers/jax_discipline.py --
+# word_offsets/words are the staged packed-bitset geometry, one value
+# per catalog encoding), and the decoration site is registered in
+# JIT_ENTRY_FUNCTIONS for the runtime witness's per-entry cache
+# attribution (test-enforced)
+@functools.partial(jax.jit, static_argnames=("word_offsets", "words"))
+def fractional_price_bound(
+    inp: SolveInputs, placed: jax.Array, *,
+    word_offsets: Tuple[int, ...], words: Tuple[int, ...],
+) -> jax.Array:
+    return fractional_price_bound_impl(
+        inp, placed, word_offsets=word_offsets, words=words
+    )
+
+
+def fetch_bound(totals) -> Tuple[float, int]:
+    """SANCTIONED_FETCH site (analysis/checkers/jax_discipline.py): the
+    bound's one designed host barrier, draining the copy_to_host_async
+    issued at dispatch. Returns (bound $/h, binding resource axis)."""
+    host = np.asarray(totals)
+    r_star = int(np.argmax(host))
+    return float(host[r_star]), r_star
+
+
+def reference_bound(catalog, classes, placed: np.ndarray) -> Tuple[float, int]:
+    """Host/numpy reference implementation over the UNstaged tensors
+    (encode.CatalogTensors + PodClassSet) -- the oracle the device entry
+    is differentially pinned against (tests/test_quality.py), and the
+    bound sim replays use on wire-mode rigs where nothing is staged
+    locally. Same formulation, float64 accumulation."""
+    from karpenter_tpu.solver import encode
+
+    compat = encode.compat_matrix(catalog, classes)                    # [C, K]
+    join = getattr(classes, "join_allowed", None)
+    if join is not None:
+        if packing.is_packed(join):
+            join = packing.unpack_mask(join, catalog.k_pad)
+        compat = compat & join
+    cap_eff = np.maximum(
+        catalog.cap - classes.node_overhead[None, :], 0.0
+    ).astype(np.float64)                                               # [K, R]
+    # cheapest admitted offering per (class, type), mirroring
+    # ffd._class_type_price
+    C, K = compat.shape
+    price_ck = np.full((C, K), np.inf, dtype=np.float64)
+    Z = catalog.tzone.shape[1]
+    CTn = catalog.tcap.shape[1]
+    for z in range(Z):
+        for ct in range(CTn):
+            m = classes.azone[:, z] & classes.acap[:, ct]              # [C]
+            cand = np.where(m[:, None], catalog.price[None, :, z, ct], np.inf)
+            price_ck = np.minimum(price_ck, cand)
+    req = classes.req.astype(np.float64)                               # [C, R]
+    # >= 1 pod fits an empty node (R-axis min of floor(cap/req))
+    fits = np.ones((C, K), dtype=bool)
+    for r in range(cap_eff.shape[1]):
+        need = req[:, r][:, None]                                      # [C, 1]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            n = np.floor(cap_eff[None, :, r] / np.where(need > 0, need, 1.0))
+        fits &= np.where(need > 0, n >= 1.0, True)
+    feas = compat & np.isfinite(price_ck) & fits
+    placed_f = np.asarray(placed, dtype=np.float64)
+    best_total, r_star = 0.0, 0
+    for r in range(cap_eff.shape[1]):
+        capr = cap_eff[:, r][None, :]                                  # [1, K]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            rate = np.where(feas & (capr > 0.0), price_ck / capr, np.inf)
+        best = rate.min(axis=-1)                                       # [C]
+        contrib = np.where(np.isfinite(best), best, 0.0) * req[:, r] * placed_f
+        total = float(contrib.sum())
+        if total > best_total:
+            best_total, r_star = total, r
+    return best_total, r_star

@@ -29,9 +29,11 @@ import numpy as np
 from karpenter_tpu import failpoints, metrics, tracing
 from karpenter_tpu.apis import NodePool, Pod, labels as wk
 from karpenter_tpu.obs import hbm as obs_hbm
+from karpenter_tpu.obs import quality as obs_quality
 from karpenter_tpu.logging import ChangeMonitor, get_logger
 from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resources
 from karpenter_tpu.scheduling import resources as res
+from karpenter_tpu.solver import bound as price_bound
 from karpenter_tpu.solver import encode, ffd, packing
 from karpenter_tpu.solver.encode import CatalogTensors
 from karpenter_tpu.solver.oracle import NewNodeGroup, Scheduler, SchedulingResult
@@ -236,6 +238,13 @@ class TPUSolver:
             raise ValueError(f"kernels must be 'xla' or 'pallas', got {kernels!r}")
         self.kernels = kernels
         self._pallas_failed: set = set()   # entry names that fell back
+        # solution-quality observatory (obs/quality.py): the last solve's
+        # quality document -- optimality gap (realized fleet price /
+        # solver/bound.py fractional bound), waste attribution, price
+        # decomposition. Observe-only: written at the end of
+        # solve_finish, read by the flight recorder and /debug/quality;
+        # nothing downstream of a decision reads it.
+        self.last_quality: Optional[dict] = None
         self._lock = threading.Lock()
 
     # -- catalog staging ----------------------------------------------------
@@ -436,6 +445,15 @@ class TPUSolver:
                     offsets=entry.offsets, words=entry.words,
                 )
             )
+            # quality observatory: the bound runs right behind every warm
+            # solve (solve_finish), so its program warms per bucket too --
+            # otherwise the first tick of each bucket pays its compile
+            outs.append(
+                self._dispatch_bound(
+                    inp, np.zeros((cp,), np.float32),
+                    offsets=entry.offsets, words=entry.words,
+                )
+            )
             self._warmed_pads.add(self._warm_key(cp, entry))
         jax.block_until_ready(outs)
 
@@ -473,6 +491,57 @@ class TPUSolver:
                 )
         metrics.SOLVER_KERNEL_DISPATCHES.inc(entry="ffd_solve_fused", impl="xla")
         return ffd.ffd_solve_fused(inp, **common)
+
+    def _dispatch_bound(self, inp, placed: np.ndarray, offsets, words):
+        """One fractional-price-bound dispatch (solver/bound.py) through
+        the same routing as the solve it shadows: the mesh engine's
+        sharded entry when configured, the plain jit entry otherwise.
+        Returns the in-flight [R] per-resource totals."""
+        if self.mesh_engine is not None:
+            return self.mesh_engine.price_bound(
+                inp, placed, word_offsets=offsets, words=words)
+        return price_bound.fractional_price_bound(
+            inp, placed, word_offsets=offsets, words=words)
+
+    def _begin_quality(self, pending: "_PendingSolve", dense):
+        """Dispatch the optimality-gap bound for the decision just
+        expanded -- async, so the device computes while the host decodes;
+        _finish_quality drains it after decode. `placed` is the take-row
+        sum: pods the solve ACTUALLY placed on new groups (billing
+        requested counts would break gap >= 1 whenever pods go
+        unplaced). Wire mode stages nothing locally, so the in-process
+        bound only covers device-path ticks (sim replays carry the
+        host-side reference bound for every backend -- obs/quality.py).
+        Observe-only: a failure counts and is swallowed, never a dead
+        tick."""
+        if pending.inp is None:
+            return None
+        try:
+            placed = dense[0].sum(axis=1).astype(np.float32)
+            totals = self._dispatch_bound(
+                pending.inp, placed,
+                offsets=pending.entry.offsets, words=pending.entry.words,
+            )
+            totals.copy_to_host_async()
+            return totals
+        except Exception:  # noqa: BLE001 -- quality must never fail a tick
+            metrics.HANDLED_ERRORS.inc(site="solver.quality_dispatch")
+            return None
+
+    def _finish_quality(self, result: SchedulingResult, totals) -> None:
+        """The observe-only epilogue of solve_finish: drain the bound's
+        async copy (fetch_bound, the SANCTIONED barrier), attribute waste
+        from the decode outputs, publish gauges + last_quality for the
+        flight recorder and /debug/quality. Never raises into the tick."""
+        try:
+            if totals is not None:
+                bound_h, r_star = price_bound.fetch_bound(totals)
+            else:
+                bound_h, r_star = None, None
+            self.last_quality = obs_quality.solve_quality(
+                result, bound_h, r_star)
+        except Exception:  # noqa: BLE001 -- quality must never fail a tick
+            metrics.HANDLED_ERRORS.inc(site="solver.quality_finish")
 
     def _dispatch_disrupt_repack(self, headroom, feas, req, member, excl):
         """disrupt_repack through the same kernel-selection rung as
@@ -1804,11 +1873,16 @@ class TPUSolver:
                             pending.inp, g_max=self.g_max, word_offsets=entry.offsets,
                             words=entry.words, objective=self.objective,
                         )
+        # quality observatory: dispatch the bound BEFORE decode so the
+        # device computes it while the host decodes; fetch after
+        qtotals = self._begin_quality(pending, dense)
         with tracing.span("decode"):
-            return self._decode(
+            out = self._decode(
                 pending.pool, entry, class_set, dense, pending.nodepool_usage,
                 result=pending.result, class_offset=pending.placed_existing,
             )
+        self._finish_quality(out, qtotals)
+        return out
 
     def _finish_remote(self, pending: "_PendingSolve"):
         """Claim (or re-run) the wire solve with circuit-breaker

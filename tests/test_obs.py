@@ -193,6 +193,36 @@ class TestFlightSurvivesBrownout:
         assert 3 in levels and any(l < 3 for l in levels), (
             "records span the climb, not just the end state")
 
+    def test_quality_fields_through_full_rung_climb(self, clean_obs):
+        """Quality attribution is black-box cargo: once a solve has
+        produced a document, every tick's flight record carries the gap
+        and waste fields -- INCLUDING the records written at the deepest
+        brownout rung (quality rides solve_finish, which brownout never
+        sheds; rung 2 throttles trace sampling only)."""
+        op = _rig(solver=TPUSolver(g_max=64), tick_deadline=1e-6)
+        for i in range(4):
+            op.cluster.create(Pod(
+                f"q{i}", requests=Resources({"cpu": "500m", "memory": "1Gi"})))
+        ticks = 0
+        while op.brownout.level < 3 and ticks < 40:
+            op.tick()
+            ticks += 1
+        assert op.brownout.level == 3, "ladder must reach shed-delta"
+        d = flight.RECORDER.dump()
+        with_q = [r for r in d["records"] if "quality" in r]
+        assert with_q, "quality fields must land in the black box"
+        last = with_q[-1]
+        assert last["optimality_gap"] >= 1.0
+        q = last["quality"]
+        assert q["realized_per_h"] >= q["bound_per_h"] > 0.0
+        for key in ("stranded_cpu_fraction", "stranded_memory_fraction",
+                    "fragmentation_index"):
+            assert 0.0 <= q[key] <= 1.0, (key, q)
+        # the deepest-rung records still carry it
+        rung3 = [r for r in d["records"] if r.get("brownout_level") == 3]
+        assert rung3 and any("quality" in r for r in rung3), (
+            "rung 3 must not shed quality attribution")
+
     def test_profiler_throttle_recovers_with_ladder(self, clean_obs):
         from karpenter_tpu import overload
 
@@ -604,6 +634,24 @@ class TestDebugSurface:
             f"http://127.0.0.1:{srv.port}/debug/flightdata", timeout=10).read())
         assert doc["records"][-1]["tick_ms"] == 7.0
         assert doc["capacity"] == flight.CAPACITY_DEFAULT
+
+    def test_quality_endpoint_serves_last_document(self, srv):
+        """Unconfigured before any solve; the live quality document
+        after one (the same process-wide store solve_finish writes)."""
+        from karpenter_tpu.obs import quality
+
+        quality.reset()
+        doc = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/debug/quality", timeout=10).read())
+        assert doc == {"configured": False}
+        quality.record({"optimality_gap": 1.25, "realized_per_h": 5.0})
+        try:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/quality",
+                timeout=10).read())
+            assert doc["optimality_gap"] == 1.25
+        finally:
+            quality.reset()
 
     def test_profile_endpoint_unconfigured_when_observatory_off(self, srv):
         """With the observatory off no tick would ever service a
