@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate bench-fleet bench-mpod bench-quality bench-trend benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos overload sim-corpus sim-fleet multichip lint typecheck
+.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate bench-fleet bench-mpod bench-quality bench-mesh-degrade bench-trend benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos mesh-chaos overload sim-corpus sim-fleet multichip lint typecheck
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -64,6 +64,9 @@ bench-mpod:  ## mpod tier: 1M-pod/5k-type packed-mask solve on the 2x4 multi-hos
 bench-quality:  ## solution-quality stage only (quality observatory: optimality gap >= 1.0 at the 10k/50k tiers, bound dispatch+fetch cost, waste attribution, quality_retrace_count asserted 0); one JSON line
 	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --quality-only > bench_quality_last.json; rc=$$?; cat bench_quality_last.json; exit $$rc
 
+bench-mesh-degrade:  ## mesh degrade stage only (fault-tolerance ladder: reshard p50/p99, shrunk power-of-two layout warm-tick delta vs full mesh, quarantine-tick cost, rig caveats in the JSON); one JSON line
+	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --mesh-degrade-only > bench_mesh_degrade_last.json; rc=$$?; cat bench_mesh_degrade_last.json; exit $$rc
+
 bench-trend:  ## round-over-round trend table consolidating the BENCH_rNN.json artifacts (one row per driver round: cold/warm/wire/consolidation/fleet/mpod/quality headline fields; crashed rounds render as dashes)
 	$(PY) hack/bench_trend.py
 
@@ -75,6 +78,9 @@ chaos:  ## seeded chaos soak: failpoint fault schedules at a bounded iteration c
 
 crash-chaos:  ## seeded crash-restart soak: >=20 crash schedules (sites x scenarios, incl. crash-during-recovery) through the replay engine -- no pod lost, no leak past one recovery sweep, no double-launch, stale-epoch rejection -- under the lock-order AND exception-escape witnesses (zero inversions, zero unsanctioned OperatorCrashed swallows); diverging traces ddmin-shrink into crash-artifacts/
 	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_ERRFLOW_WITNESS=1 KARPENTER_TPU_CRASH_ARTIFACTS=crash-artifacts KARPENTER_TPU_FLIGHTDATA=crash-artifacts/flightdata.jsonl $(PYTEST) tests/test_crash_chaos.py tests/test_recovery.py -q -m 'not slow' $(call STAMP,crash-chaos)
+
+mesh-chaos:  ## mesh fault-tolerance soak: >=20 seeded device-loss/straggler/restage-fault schedules against the mesh sidecar rig (zero pods lost, no double-launch, bit-identical decisions through every topology transition, re-promotion after device return) plus the degrade-ladder differential and the staging-reshard races, under the lock-order, jax retrace, AND exception-escape witnesses
+	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_ERRFLOW_WITNESS=1 KARPENTER_TPU_CHAOS_SEEDS=20 KARPENTER_TPU_FLIGHTDATA=mesh-artifacts/flightdata.jsonl $(PYTEST) tests/test_mesh_chaos.py -q -m 'not slow' $(call STAMP,mesh-chaos)
 
 overload:  ## overload storm soak: 10x offered load against the deadline-budgeted tick (p99 <= 2x deadline, zero pods lost, admitted-prefix bit-identity, brownout ladder + stuck-tick watchdog escalation, bounded interruption intake, shm send timeout) under the lock-order, jax retrace, AND exception-escape witnesses; a diverging storm replay ddmin-shrinks into overload-artifacts/
 	KARPENTER_TPU_LOCK_WITNESS=1 KARPENTER_TPU_JAX_WITNESS=1 KARPENTER_TPU_ERRFLOW_WITNESS=1 KARPENTER_TPU_OVERLOAD_ARTIFACTS=overload-artifacts KARPENTER_TPU_FLIGHTDATA=overload-artifacts/flightdata.jsonl $(PYTEST) tests/test_overload.py -q -m 'not slow' $(call STAMP,overload)

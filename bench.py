@@ -1572,6 +1572,102 @@ def _quality_stage(pool, items, zones, rng, warm_tick_p50_ms=None,
     return out
 
 
+def _mesh_degrade_stage(pool, items, zones, rng, iters: int = 6,
+                        platform: str = "cpu") -> dict:
+    """Mesh degrade stage (mesh fault-tolerance tentpole): ALWAYS runs.
+    The degrade ladder's cost card, measured at the 2k-pod tier through
+    the production TPUSolver-over-MeshSolveEngine path:
+
+    - mesh_reshard_p50/p99_ms: the topology swap ALONE (mesh rebuild +
+      sharding-table re-derivation at the _sync_topology seam), programs
+      already warm on both layouts -- the latency a tick pays the first
+      time it dispatches after a membership change, minus the solve;
+    - mesh_shrunk_warm_tick_delta_ms: warm tick p50 on the shrunk
+      power-of-two layout vs the full mesh (the steady-state tax of
+      running degraded);
+    - mesh_quarantine_first_tick_ms: the tick immediately after the
+      straggler watchdog's quarantine rung fires (reshard + catalog
+      restage + dispatch), against the full-mesh warm p50.
+    """
+    import jax
+
+    from karpenter_tpu.fleet.shard import MeshSolveEngine
+    from karpenter_tpu.parallel.mesh import make_mesh
+    from karpenter_tpu.solver.service import TPUSolver
+
+    n_dev = min(8, len(jax.devices()))
+    if n_dev < 2:
+        return {"mesh_degrade_skipped":
+                f"{n_dev} device(s): no mesh to degrade"}
+    engine = MeshSolveEngine(make_mesh(n_dev))
+    n_pods = min(N_PODS, 2_000)
+    # g_max sized to the tier (see _breaker_degraded): the scan cost is
+    # slots x catalog, and the full 1024-slot budget at 2k pods would
+    # measure a misconfiguration, not the degrade ladder
+    g_max = 128
+    s = TPUSolver(g_max=g_max, mesh=engine)
+    workloads = [synth_pods(rng, zones, n_pods, salt=91_000 + i)
+                 for i in range(3)]
+
+    def tick_ms(i: int) -> float:
+        t0 = time.perf_counter()
+        s.solve(pool, items, workloads[i % len(workloads)])
+        return (time.perf_counter() - t0) * 1e3
+
+    # warm each layout once first: the one-off compile must not land in
+    # any percentile (losing 1 of n_dev shrinks to the pow2 prefix)
+    tick_ms(0)
+    full = [tick_ms(i) for i in range(iters)]
+    engine.mark_device_lost(n_dev - 1, reason="bench")
+    tick_ms(0)
+    shrunk = [tick_ms(i) for i in range(iters)]
+    engine.mark_device_returned(n_dev - 1)
+    tick_ms(0)
+
+    # the swap alone: flip membership, time _sync_topology (the seam
+    # every dispatch crosses), both directions in the sample set
+    reshard = []
+    for i in range(max(iters, 4)):
+        if i % 2 == 0:
+            engine.mark_device_lost(n_dev - 1, reason="bench")
+        else:
+            engine.mark_device_returned(n_dev - 1)
+        t0 = time.perf_counter()
+        engine._sync_topology()
+        reshard.append((time.perf_counter() - t0) * 1e3)
+    for idx in sorted(engine.topology.quarantined()):
+        engine.mark_device_returned(idx)
+    engine._sync_topology()
+
+    # quarantine rung: the first tick after quarantine_worst_device
+    # (reshard + catalog restage + dispatch, warm programs)
+    engine.quarantine_worst_device(reason="bench")
+    quarantine_tick = tick_ms(1)
+    for idx in sorted(engine.topology.quarantined()):
+        engine.mark_device_returned(idx)
+
+    full50 = float(np.percentile(full, 50))
+    shrunk50 = float(np.percentile(shrunk, 50))
+    return {
+        "mesh_degrade_devices": n_dev,
+        "mesh_degrade_pods": n_pods,
+        "mesh_reshard_p50_ms": round(float(np.percentile(reshard, 50)), 3),
+        "mesh_reshard_p99_ms": round(float(np.percentile(reshard, 99)), 3),
+        "mesh_full_warm_tick_p50_ms": round(full50, 2),
+        "mesh_shrunk_warm_tick_p50_ms": round(shrunk50, 2),
+        "mesh_shrunk_warm_tick_delta_ms": round(shrunk50 - full50, 2),
+        "mesh_quarantine_first_tick_ms": round(quarantine_tick, 2),
+        "mesh_quarantine_tick_over_warm": round(
+            quarantine_tick / full50, 2) if full50 > 0 else 0.0,
+        "mesh_degrade_rig_caveats": _rig_caveats(platform, g_max, g_max) + [
+            "reshard_ms measures the program/sharding swap on an "
+            "already-detected loss; real chip-failure detection latency "
+            "(the XLA runtime surfacing the error) is not on this rig's "
+            "path"
+        ],
+    }
+
+
 def _fleet_stage(items, zones, progress=lambda ev: None,
                  stage_fields=lambda fields: None, platform: str = "cpu") -> dict:
     """The 500k-pod / 2k-type FLEET tier (`make bench-fleet`): the
@@ -2024,7 +2120,7 @@ def _gen2_collections() -> int:
 def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
         wire_only: bool = False, consolidate_only: bool = False,
         fleet_only: bool = False, mpod_only: bool = False,
-        quality_only: bool = False):
+        quality_only: bool = False, mesh_degrade_only: bool = False):
     import jax
 
     from karpenter_tpu.apis import NodePool
@@ -2152,6 +2248,23 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
             iters=30 if backend != "cpu" else 12, platform=backend))
         out["value"] = out.get(
             f"quality_gap_{min(N_PODS, 50_000) // 1000}k", 0.0)
+        stage_fields(out)
+        return out
+    if mesh_degrade_only:
+        # `make bench-mesh-degrade`: only the mesh degrade stage (plus
+        # setup) -- the fast iteration loop for the fault-tolerance
+        # ladder: reshard p50/p99, the shrunk-layout warm-tick delta,
+        # the quarantine-tick cost
+        out = {
+            "metric": "mesh_reshard_p50",
+            "unit": "ms",
+            "mode": "mesh_degrade_only",
+            "platform": backend,
+        }
+        out.update(_mesh_degrade_stage(
+            pool, items, zones, np.random.default_rng(42),
+            iters=8 if backend != "cpu" else 5, platform=backend))
+        out["value"] = out.get("mesh_reshard_p50_ms", 0.0)
         stage_fields(out)
         return out
     if consolidate_only:
@@ -2382,6 +2495,19 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
     progress({"ev": "phase", "name": "quality"})
     stage_fields(production)
 
+    # mesh degrade stage (mesh fault-tolerance tentpole): ALWAYS runs --
+    # reshard p50/p99, the shrunk-layout warm-tick delta vs the full
+    # mesh, and the quarantine-tick cost are headline acceptance data,
+    # persisted via the incremental side-file like every other stage
+    try:
+        production.update(_mesh_degrade_stage(
+            pool, items, zones, rng,
+            iters=8 if backend != "cpu" else 5, platform=backend))
+    except Exception as e:  # noqa: BLE001
+        production["mesh_degrade_stage_error"] = f"{type(e).__name__}: {e}"[:200]
+    progress({"ev": "phase", "name": "mesh_degrade"})
+    stage_fields(production)
+
     # secondary measurements -- each individually fenced so a failure can
     # never cost the headline (the JSON line must always appear)
     secondary: dict = {}
@@ -2536,7 +2662,8 @@ def _child_main() -> None:
                   consolidate_only="--consolidate-only" in sys.argv,
                   fleet_only="--fleet-only" in sys.argv,
                   mpod_only="--mpod-only" in sys.argv,
-                  quality_only="--quality-only" in sys.argv)
+                  quality_only="--quality-only" in sys.argv,
+                  mesh_degrade_only="--mesh-degrade-only" in sys.argv)
         progress({"ev": "result", "out": out})
         print(json.dumps(out))
     except Exception as e:  # noqa: BLE001 - parent assembles a partial
@@ -2686,6 +2813,8 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
         args.append("--mpod-only")
     if "--quality-only" in sys.argv:
         args.append("--quality-only")
+    if "--mesh-degrade-only" in sys.argv:
+        args.append("--mesh-degrade-only")
     proc = subprocess.Popen(
         args, stdout=subprocess.DEVNULL, stderr=None, text=True, env=env
     )

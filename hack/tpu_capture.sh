@@ -25,6 +25,7 @@ CONSOLIDATE_OUT=BENCH_CONSOLIDATION_CAPTURE.json
 MESH_OUT=BENCH_MESH_CAPTURE.json
 MPOD_OUT=BENCH_MPOD_CAPTURE.json
 QUALITY_OUT=BENCH_QUALITY_CAPTURE.json
+MESH_DEGRADE_OUT=BENCH_MESH_DEGRADE_CAPTURE.json
 MEM_OUT=BENCH_TPU_MEMSTATS.json
 PROFILE_DIR=BENCH_TPU_PROFILE
 LOG=BENCH_TPU_CAPTURE.log
@@ -150,6 +151,23 @@ print('BACKEND=' + jax.default_backend())
           echo "[capture] quality stage failed/degraded; captures stand" >> "$LOG"
           cat "$QUALITY_OUT.tmp" >> "$LOG" 2>/dev/null
           rm -f "$QUALITY_OUT.tmp"
+        fi
+        # mesh degrade stage on the same warm tunnel (the mesh
+        # fault-tolerance ROADMAP item's on-TPU acceptance numbers):
+        # reshard p50/p99 on real chips, the shrunk power-of-two
+        # layout's warm-tick delta vs the full mesh, and the
+        # quarantine-tick cost. The MAIN capture above already carries
+        # the mesh_* fields from its always-run stage; this standalone
+        # pass is the fast-loop artifact. Best-effort like the others.
+        echo "[capture] mesh degrade stage $(date -u +%H:%M:%S)" >> "$LOG"
+        if timeout 1200 env BENCH_PROBE_BUDGET_S=120 BENCH_CPU_BUDGET_S=60 KARPENTER_TPU_JAX_WITNESS=1 python bench.py --mesh-degrade-only > "$MESH_DEGRADE_OUT.tmp" 2>> "$LOG" \
+           && grep -q '"platform"' "$MESH_DEGRADE_OUT.tmp" && ! grep -q '"platform": "cpu"' "$MESH_DEGRADE_OUT.tmp"; then
+          mv "$MESH_DEGRADE_OUT.tmp" "$MESH_DEGRADE_OUT"
+          echo "[capture] mesh degrade SUCCESS $(date -u +%H:%M:%S)" >> "$LOG"
+        else
+          echo "[capture] mesh degrade stage failed/degraded; captures stand" >> "$LOG"
+          cat "$MESH_DEGRADE_OUT.tmp" >> "$LOG" 2>/dev/null
+          rm -f "$MESH_DEGRADE_OUT.tmp"
         fi
         # one 10-tick programmatic profiler trace of the controller rig
         # (the observatory's --profile-ticks seam): the on-device

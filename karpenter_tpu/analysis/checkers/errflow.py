@@ -216,6 +216,32 @@ LADDER_SEAMS: Tuple[Seam, ...] = (
          why="op dispatch: solver errors become error REPLIES (the client's "
              "ladder sees a typed refusal, not a dead sidecar); only "
              "transport failures may tear the connection down"),
+    # -- mesh fault tolerance: the topology-epoch degrade ladder --------------
+    Seam("karpenter_tpu/fleet/shard.py", "MeshSolveEngine", "_dispatch",
+         may_raise=("StaleSeqnumError", "StaleEpochError", "RuntimeError"),
+         failpoint="mesh.device.lost",
+         why="every sharded solve funnels here: a stale staged epoch or a "
+             "device lost mid-dispatch surfaces as StaleTopologyError (a "
+             "StaleSeqnumError, so every existing restage/retry/breaker "
+             "rung handles it unchanged); a RuntimeError that does NOT "
+             "classify as device loss re-raises untouched -- misreading a "
+             "program bug as a dead chip would shrink the mesh forever"),
+    Seam("karpenter_tpu/fleet/shard.py", "MeshSolveEngine", "_reshard",
+         must_handle=("RuntimeError",),
+         failpoint="mesh.restage",
+         why="the restage seam: a failed reshard (half-dead runtime, the "
+             "mesh.restage failpoint) descends one rung to the unsharded "
+             "single-device path (counted via karpenter_handled_errors_"
+             "total + karpenter_mesh_reshards_total{reason=restage-failed}) "
+             "-- the engine must always come out of a reshard dispatchable"),
+    Seam("karpenter_tpu/fleet/straggler.py", "ShardStragglerWatchdog",
+         "check_now",
+         must_handle=("RuntimeError",),
+         failpoint="mesh.shard.stall",
+         why="the quarantine seam: escalation hooks (cancel wire, "
+             "quarantine worst device, force breaker open) are best-effort "
+             "-- a hook failure is counted and the ladder continues; only "
+             "the crash rung's async raise leaves this frame"),
 )
 
 # Handler sites sanctioned to absorb a crash (``OperatorCrashed``) or a
@@ -362,8 +388,8 @@ _DOTTED_ALIASES = {"timeout": "TimeoutError", "error": "OSError",
 # KeyError in a parser) is out of the wire ladder's scope
 LADDER_CLASSES: Tuple[str, ...] = (
     "ConnectionError", "OSError", "TimeoutError", "ShmError",
-    "StaleSeqnumError", "StaleEpochError", "OperatorCrashed",
-    "CloudError", "RuntimeError",
+    "StaleSeqnumError", "StaleEpochError", "StaleTopologyError",
+    "OperatorCrashed", "CloudError", "RuntimeError",
 )
 
 # what an armed failpoints.eval() site can inject, by site-name prefix
@@ -383,6 +409,11 @@ FAILPOINT_INJECTS: Dict[str, Tuple[str, ...]] = {
                  "OperatorCrashed"),
     "crash.": ("OperatorCrashed",),
     "stall.": ("OperatorCrashed",),
+    # mesh sites inject bare RuntimeError: the device-loss classifier
+    # (fleet/topology.py) matches the site name in the message and the
+    # dispatch seam converts it to StaleTopologyError; the stall action
+    # can surface the straggler watchdog's async-raised OperatorCrashed
+    "mesh.": ("RuntimeError", "OperatorCrashed"),
 }
 
 # socket-object verbs whose calls seed OSError (the stdlib raises these;

@@ -20,15 +20,23 @@ Two halves (ROADMAP "Mesh-sharded production solve" open item):
   another. ``multi-tenant == isolated`` is asserted via differential sim
   replay (``sim/fleet.py``, the ``multi-cluster-storm`` corpus scenario).
 
-``fleet/service.py`` glues both into a deployable sidecar topology.
+``fleet/service.py`` glues both into a deployable sidecar topology;
+``fleet/topology.py`` + ``fleet/straggler.py`` are its failure ladder
+(topology epochs, the device-loss degrade ladder, and the shard-straggler
+watchdog).
 """
 from karpenter_tpu.fleet.coalesce import DispatchCoalescer, TenantRefusal
 from karpenter_tpu.fleet.shard import MeshSolveEngine, mesh_from_env, parse_mesh_spec
+from karpenter_tpu.fleet.straggler import ShardStragglerWatchdog
+from karpenter_tpu.fleet.topology import TopologyTracker, classify_device_error
 
 __all__ = [
     "DispatchCoalescer",
     "MeshSolveEngine",
+    "ShardStragglerWatchdog",
     "TenantRefusal",
+    "TopologyTracker",
+    "classify_device_error",
     "mesh_from_env",
     "parse_mesh_spec",
 ]

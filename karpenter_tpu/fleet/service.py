@@ -75,6 +75,7 @@ def max_tenants_for_headroom(
     per_tenant_bytes: Optional[int] = None,
     reserve_fraction: float = 0.5,
     solver=None,
+    engine=None,
 ) -> Optional[int]:
     """How many tenants the measured device headroom supports, keeping
     `reserve_fraction` of it free for solve temporaries and compile
@@ -83,11 +84,38 @@ def max_tenants_for_headroom(
     an explicit `per_tenant_bytes` overrides both. None when no
     allocator ledger exists (CPU backend) -- capacity is then bounded by
     the LRUs alone, and the operator sizes from the runbook's table
-    instead."""
+    instead.
+
+    TOPOLOGY-AWARE when `engine` (the MeshSolveEngine) is passed: sizing
+    reads the engine's topology AT CALL TIME, so every call after an
+    epoch bump recomputes against the surviving device set -- the
+    pre-topology arithmetic froze the device count at sidecar start, and
+    a shrunk mesh silently oversubscribed HBM headroom two ways: the
+    quarantined chip's stale ledger entry still fed the min-headroom,
+    and the K-sharded staging that concentrates onto fewer survivors
+    still sized at the full-mesh per-device footprint."""
     if per_tenant_bytes is None:
         per_tenant_bytes = tenant_staged_bytes(solver)
+        if engine is not None and getattr(engine, "topology", None) is not None:
+            # shrunk mesh: the K-sharded catalog and packed masks
+            # concentrate onto the survivors, so each healthy device
+            # holds full/healthy times the per-device staging the
+            # measurement (or fallback profile) was taken at
+            topo = engine.topology
+            healthy = len(topo.healthy_indices())
+            if 0 < healthy < topo.size:
+                per_tenant_bytes = int(per_tenant_bytes * topo.size / healthy)
     if headroom_bytes is None:
         devices = obs_hbm.poll().get("devices") or {}
+        if engine is not None and getattr(engine, "topology", None) is not None:
+            # a quarantined chip's ledger entry is stale (or the device
+            # is gone outright): only healthy devices' headroom counts.
+            # An empty intersection (label scheme drift, fake provider)
+            # falls back to the unfiltered set -- sizing must degrade,
+            # not vanish.
+            labels = engine.topology.healthy_labels()
+            filtered = {k: v for k, v in devices.items() if k in labels}
+            devices = filtered or devices
         free = [
             int(d["bytes_limit"]) - int(d["bytes_in_use"])
             for d in devices.values()

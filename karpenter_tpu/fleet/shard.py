@@ -34,13 +34,15 @@ from __future__ import annotations
 import functools
 import os
 import threading
+import time
 from typing import Dict, Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from karpenter_tpu import metrics
+from karpenter_tpu import failpoints, metrics
+from karpenter_tpu.fleet import topology as topo_mod
 from karpenter_tpu.parallel import mesh as mesh_mod
 from karpenter_tpu.solver import ffd, packing
 
@@ -108,7 +110,32 @@ class MeshSolveEngine:
             if parsed is None:
                 raise ValueError(f"mesh spec {mesh!r} parses to no mesh")
             mesh = parsed
-        self.mesh: Mesh = mesh
+        # the membership ledger: every dispatch syncs against it, every
+        # staged catalog is stamped with the epoch it was staged under
+        self.topology = topo_mod.TopologyTracker.from_mesh(mesh)
+        # reshard is a swap of the engine's sharding tables: one writer
+        # at a time, re-entrant because stage_catalog holds it across
+        # _sync_topology
+        self._topo_lock = threading.RLock()
+        self._watchdog = None      # ShardStragglerWatchdog, attached by the owner
+        self._apply_mesh(mesh)
+        self._applied_epoch = self.topology.epoch
+
+    def _apply_mesh(self, mesh: Optional[Mesh]) -> None:
+        """Point every sharding table at `mesh`; ``None`` is the
+        UNSHARDED rung of the degrade ladder -- dispatches fall through
+        to the proven single-device jitted entries (bit-identical by
+        the same differential that gates the sharded ones)."""
+        self.mesh = mesh
+        if mesh is None:
+            self._rep = None
+            self._in_shardings = None
+            self._in_shardings_packed = None
+            self._s_shard = None
+            self._cat_k = None
+            self._multiproc = False
+            metrics.MESH_DEVICES.set(1.0)
+            return
         self._rep = NamedSharding(mesh, P())
         shardings = mesh_mod.catalog_sharding(mesh)
         if len(mesh.axis_names) > 1:
@@ -144,22 +171,153 @@ class MeshSolveEngine:
         self._multiproc = mesh_mod._is_multiprocess(mesh)
         metrics.MESH_DEVICES.set(float(self.mesh.devices.size))
 
+    # -- topology -------------------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        """The topology epoch staged catalogs are stamped with."""
+        return self.topology.epoch
+
+    def attach_watchdog(self, watchdog) -> None:
+        """Bracket every dispatch with the shard-straggler watchdog's
+        started/finished hooks (fleet/straggler.py)."""
+        self._watchdog = watchdog
+
+    def _sync_topology(self) -> None:
+        """Lazily re-point the engine at the topology's current mesh.
+        Double-checked: the unlocked epoch read keeps the healthy-path
+        dispatch free of the reshard lock."""
+        if self._applied_epoch == self.topology.epoch:
+            return
+        with self._topo_lock:
+            if self._applied_epoch != self.topology.epoch:
+                self._reshard()
+
+    def _reshard(self) -> None:
+        """Swap the sharding tables onto the topology's current mesh
+        (caller holds ``_topo_lock``). The restage seam of the degrade
+        ladder: a failure HERE (the ``mesh.restage`` failpoint, or a
+        mesh build raising on a half-dead runtime) descends one rung to
+        the unsharded single-device path instead of escaping -- the
+        engine must always come out of a reshard dispatchable."""
+        t0 = time.monotonic()
+        target = self.topology.epoch
+        try:
+            failpoints.eval("mesh.restage")
+            self._apply_mesh(self.topology.current_mesh())
+            reason = "unsharded" if self.mesh is None else self.topology.mode()
+        except RuntimeError:
+            metrics.HANDLED_ERRORS.inc(site="mesh.reshard")
+            self._apply_mesh(None)
+            reason = "restage-failed"
+        self._applied_epoch = target
+        metrics.MESH_RESHARDS.inc(reason=reason)
+        metrics.MESH_RESHARD_SECONDS.observe(time.monotonic() - t0)
+
+    def mark_device_lost(self, index: int, reason: str = "probe") -> bool:
+        """Health-probe/operator entry: declare device `index` lost. The
+        epoch bumps; the next dispatch reshards onto the survivors."""
+        return self.topology.mark_lost(index, reason)
+
+    def mark_device_returned(self, index: int) -> bool:
+        """Declare device `index` healthy again; the next dispatch
+        re-promotes (up to the full mesh, whose jit cache is kept warm
+        by reusing the original Mesh object)."""
+        return self.topology.mark_returned(index)
+
+    def quarantine_worst_device(self, reason: str = "straggler") -> Optional[int]:
+        """The straggler watchdog's quarantine rung: deterministically
+        pick the highest-index healthy device and mark it lost. Returns
+        the quarantined index, or None when already unsharded (nothing
+        left to shrink -- the watchdog escalates to its next rung)."""
+        healthy = self.topology.healthy_indices()
+        if self.mesh is None or len(healthy) == 0:
+            return None
+        idx = healthy[-1]
+        self.topology.mark_lost(idx, reason)
+        return idx
+
+    def _dispatch(self, entry: str, epoch: Optional[int], fn, *args):
+        """Every solve entry funnels through here: sync the topology,
+        fence stale epochs, bracket the straggler watchdog, and convert
+        a device-loss RuntimeError into the typed ladder rung.
+
+        LADDER_SEAM (analysis/checkers/errflow.py): the only exceptions
+        crossing this frame are ``StaleTopologyError`` (typed: staged
+        epoch no longer current, or a device died mid-dispatch -- the
+        caller's StaleSeqnumError rung restages and retries), plain
+        ``RuntimeError`` (a real program error, NOT a device loss --
+        re-raised unchanged), and ``OperatorCrashed`` (never absorbed).
+        """
+        from karpenter_tpu.solver import rpc as rpc_mod
+
+        self._sync_topology()
+        if epoch is not None and epoch != self._applied_epoch:
+            metrics.MESH_STALE_SOLVES.inc(site=entry)
+            raise rpc_mod.StaleTopologyError(
+                f"{entry}: staged under topology epoch {epoch}, "
+                f"mesh is now at epoch {self._applied_epoch}"
+            )
+        metrics.MESH_DISPATCHES.inc(entry=entry)
+        wd = self._watchdog
+        if wd is not None:
+            wd.dispatch_started(entry)
+        try:
+            failpoints.eval("mesh.device.lost")
+            failpoints.eval("mesh.shard.stall")
+            return fn(*args)
+        except RuntimeError as e:
+            if isinstance(e, rpc_mod.StaleSeqnumError):
+                raise
+            reason = topo_mod.classify_device_error(e)
+            if reason is None or self.mesh is None:
+                raise
+            healthy = self.topology.healthy_indices()
+            hint = topo_mod.device_index_hint(e)
+            idx = hint if hint in healthy else (healthy[-1] if healthy else 0)
+            self.topology.mark_lost(idx, reason)
+            metrics.MESH_STALE_SOLVES.inc(site=entry)
+            raise rpc_mod.StaleTopologyError(
+                f"{entry}: device {idx} lost mid-dispatch ({reason}); "
+                f"topology epoch now {self.topology.epoch}"
+            ) from e
+        finally:
+            if wd is not None:
+                wd.dispatch_finished()
+
     # -- catalog staging ------------------------------------------------------
     def stage_catalog(self, catalog) -> Tuple[ffd.StagedCatalog, Tuple[int, ...], Tuple[int, ...]]:
         """Sharded analogue of ffd.stage_catalog: the catalog uploads ONCE
         per seqnum, K-sharded over the types axis, and every later solve
         reuses the resident shards (per-solve traffic stays the ~100 KB of
         pod-class tensors, now split across devices by GSPMD)."""
-        words = tuple(catalog.words)
-        offsets = tuple(int(x) for x in np.cumsum((0,) + words[:-1]))
-        sh = self._in_shardings
-        staged = ffd.StagedCatalog(
-            **{
-                name: self._put(getattr(catalog, name), getattr(sh, name))
-                for name in ffd.StagedCatalog._fields
-            }
-        )
+        staged, offsets, words, _ = self.stage_catalog_versioned(catalog)
         return staged, offsets, words
+
+    def stage_catalog_versioned(
+        self, catalog
+    ) -> Tuple[ffd.StagedCatalog, Tuple[int, ...], Tuple[int, ...], int]:
+        """stage_catalog plus the topology epoch the shards were staged
+        under -- read under the reshard lock, so the stamp can never
+        name a NEWER mesh than the one holding the arrays. Callers keep
+        the stamp beside the staged handle and pass it back at dispatch
+        (`epoch=`); a membership change in between surfaces as
+        StaleTopologyError and one restage."""
+        with self._topo_lock:
+            self._sync_topology()
+            epoch = self._applied_epoch
+            if self.mesh is None:
+                staged, offsets, words = ffd.stage_catalog(catalog)
+                return staged, offsets, words, epoch
+            words = tuple(catalog.words)
+            offsets = tuple(int(x) for x in np.cumsum((0,) + words[:-1]))
+            sh = self._in_shardings
+            staged = ffd.StagedCatalog(
+                **{
+                    name: self._put(getattr(catalog, name), getattr(sh, name))
+                    for name in ffd.StagedCatalog._fields
+                }
+            )
+            return staged, offsets, words, epoch
 
     def _put(self, x, sharding):
         if self._multiproc:
@@ -271,104 +429,169 @@ class MeshSolveEngine:
     def solve_fused(
         self, inp: ffd.SolveInputs, *, g_max: int, nnz_max: int,
         word_offsets: Tuple[int, ...], words: Tuple[int, ...],
-        objective: str = "price",
+        objective: str = "price", epoch: Optional[int] = None,
     ) -> jax.Array:
         """The production tick's sharded dispatch: async, one replicated
         u32 buffer out (the in-jit all-gather), same fused layout as
         ffd.ffd_solve_fused -- the caller's copy_to_host_async +
-        expand_fused path is unchanged."""
-        fn = self._entry(
-            "fused",
-            (g_max, nnz_max, word_offsets, words, objective, self._mask_form(inp)),
-        )
-        metrics.MESH_DISPATCHES.inc(entry="fused")
-        return fn(self._put_inputs(inp))
+        expand_fused path is unchanged. `epoch` is the topology stamp
+        the inputs were staged under (stage_catalog_versioned)."""
+        def run():
+            if self.mesh is None:
+                return ffd.ffd_solve_fused(
+                    inp, g_max=g_max, nnz_max=nnz_max,
+                    word_offsets=word_offsets, words=words, objective=objective,
+                )
+            fn = self._entry(
+                "fused",
+                (g_max, nnz_max, word_offsets, words, objective, self._mask_form(inp)),
+            )
+            return fn(self._put_inputs(inp))
+
+        return self._dispatch("fused", epoch, run)
 
     def solve_compact(
         self, inp: ffd.SolveInputs, *, g_max: int, nnz_max: int,
         word_offsets: Tuple[int, ...], words: Tuple[int, ...],
-        objective: str = "price",
+        objective: str = "price", epoch: Optional[int] = None,
     ) -> ffd.CompactDecision:
-        fn = self._entry(
-            "compact",
-            (g_max, nnz_max, word_offsets, words, objective, self._mask_form(inp)),
-        )
-        metrics.MESH_DISPATCHES.inc(entry="compact")
-        return fn(self._put_inputs(inp))
+        def run():
+            if self.mesh is None:
+                return ffd.ffd_solve_compact(
+                    inp, g_max=g_max, nnz_max=nnz_max,
+                    word_offsets=word_offsets, words=words, objective=objective,
+                )
+            fn = self._entry(
+                "compact",
+                (g_max, nnz_max, word_offsets, words, objective, self._mask_form(inp)),
+            )
+            return fn(self._put_inputs(inp))
+
+        return self._dispatch("compact", epoch, run)
 
     def solve_dense(
         self, inp: ffd.SolveInputs, *, g_max: int,
         word_offsets: Tuple[int, ...], words: Tuple[int, ...],
-        objective: str = "price",
+        objective: str = "price", epoch: Optional[int] = None,
     ) -> ffd.SolveOutputs:
-        fn = self._entry(
-            "dense",
-            (g_max, word_offsets, words, objective, self._mask_form(inp)),
-        )
-        metrics.MESH_DISPATCHES.inc(entry="dense")
-        return fn(self._put_inputs(inp))
+        def run():
+            if self.mesh is None:
+                return ffd.ffd_solve(
+                    inp, g_max=g_max, word_offsets=word_offsets, words=words,
+                    objective=objective,
+                )
+            fn = self._entry(
+                "dense",
+                (g_max, word_offsets, words, objective, self._mask_form(inp)),
+            )
+            return fn(self._put_inputs(inp))
+
+        return self._dispatch("dense", epoch, run)
 
     def price_bound(
         self, inp: ffd.SolveInputs, placed, *,
         word_offsets: Tuple[int, ...], words: Tuple[int, ...],
+        epoch: Optional[int] = None,
     ) -> jax.Array:
         """The optimality-gap bound's sharded dispatch (solver/bound.py):
         async, [R] replicated totals out -- the caller's
         copy_to_host_async + fetch_bound barrier is unchanged."""
-        fn = self._entry(
-            "bound", (word_offsets, words, self._mask_form(inp)))
-        metrics.MESH_DISPATCHES.inc(entry="bound")
-        args = (self._put_inputs(inp), placed)
-        if self._multiproc:
-            args = (args[0], mesh_mod._put_multiprocess(placed, self._rep))
-        return fn(*args)
+        def run():
+            if self.mesh is None:
+                from karpenter_tpu.solver import bound as bound_mod
 
-    def repack(self, headroom, feas, req, member, excl):
+                return bound_mod.fractional_price_bound(
+                    inp, placed, word_offsets=word_offsets, words=words,
+                )
+            fn = self._entry(
+                "bound", (word_offsets, words, self._mask_form(inp)))
+            args = (self._put_inputs(inp), placed)
+            if self._multiproc:
+                args = (args[0], mesh_mod._put_multiprocess(placed, self._rep))
+            return fn(*args)
+
+        return self._dispatch("bound", epoch, run)
+
+    def repack(self, headroom, feas, req, member, excl, *, epoch: Optional[int] = None):
         """Disrupt candidate-pool repack, set axis sharded over every mesh
         axis (embarrassingly parallel; winners all-gather in-jit)."""
-        fn = self._entry("repack", ())
-        metrics.MESH_DISPATCHES.inc(entry="repack")
-        args = (headroom, feas, req, member, excl)
-        if self._multiproc:
-            shs = (self._rep, self._rep, self._rep, self._s_shard, self._s_shard)
-            args = tuple(
-                mesh_mod._put_multiprocess(a, s) for a, s in zip(args, shs)
-            )
-        return fn(*args)
+        def run():
+            if self.mesh is None:
+                from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
 
-    def replace(self, leftover, creq, compat, azone, acap, cap, ovh, price, *, od_col: int):
+                return disrupt_kernel.disrupt_repack(headroom, feas, req, member, excl)
+            fn = self._entry("repack", ())
+            args = (headroom, feas, req, member, excl)
+            if self._multiproc:
+                shs = (self._rep, self._rep, self._rep, self._s_shard, self._s_shard)
+                args = tuple(
+                    mesh_mod._put_multiprocess(a, s) for a, s in zip(args, shs)
+                )
+            return fn(*args)
+
+        return self._dispatch("repack", epoch, run)
+
+    def replace(self, leftover, creq, compat, azone, acap, cap, ovh, price, *,
+                od_col: int, epoch: Optional[int] = None):
         """Disrupt replacement search: leftover sharded on the set axis,
         catalog cap/price on their staged K-sharding."""
-        fn = self._entry("replace", (od_col,))
-        metrics.MESH_DISPATCHES.inc(entry="replace")
-        args = (leftover, creq, compat, azone, acap, cap, ovh, price)
-        if self._multiproc:
-            r, k, s = self._rep, self._cat_k, self._s_shard
-            shs = (s, r, r, r, r, k, r, k)
-            args = tuple(
-                mesh_mod._put_multiprocess(a, sh) for a, sh in zip(args, shs)
-            )
-        return fn(*args)
+        def run():
+            if self.mesh is None:
+                from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
 
-    def fetch(self, out):
+                return disrupt_kernel.disrupt_replace(
+                    leftover, creq, compat, azone, acap, cap, ovh, price,
+                    od_col=od_col,
+                )
+            fn = self._entry("replace", (od_col,))
+            args = (leftover, creq, compat, azone, acap, cap, ovh, price)
+            if self._multiproc:
+                r, k, s = self._rep, self._cat_k, self._s_shard
+                shs = (s, r, r, r, r, k, r, k)
+                args = tuple(
+                    mesh_mod._put_multiprocess(a, sh) for a, sh in zip(args, shs)
+                )
+            return fn(*args)
+
+        return self._dispatch("replace", epoch, run)
+
+    def fetch(self, out, *, epoch: Optional[int] = None):
         """SANCTIONED_FETCH site (analysis/checkers/jax_discipline.py):
         the mesh engine's designed host barrier. Outputs are already
         replicated ON DEVICE (the in-jit all-gather via out_shardings),
         so this is a local read on every process -- no per-fetch
-        re-shard, even on non-addressable meshes."""
+        re-shard, even on non-addressable meshes. With an `epoch`, the
+        barrier is fenced: reading a buffer computed on a mesh that has
+        since lost a device would block on a dead chip, so a stale stamp
+        raises StaleTopologyError BEFORE the read and the caller's
+        staging-gap rung re-solves on the current topology."""
+        if epoch is not None and epoch != self.topology.epoch:
+            from karpenter_tpu.solver import rpc as rpc_mod
+
+            metrics.MESH_STALE_SOLVES.inc(site="fetch")
+            raise rpc_mod.StaleTopologyError(
+                f"fetch: buffer computed at topology epoch {epoch}, "
+                f"mesh is now at epoch {self.topology.epoch}"
+            )
         return jax.tree_util.tree_map(np.asarray, out)
 
     def describe(self) -> dict:
         """Mesh shape + jit-cache occupancy for /debug and the bench's
         fleet stage."""
-        return {
-            "devices": int(self.mesh.devices.size),
-            "axes": {
-                name: int(size)
-                for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
-            },
+        doc = {
+            "devices": int(self.mesh.devices.size) if self.mesh is not None else 1,
+            "axes": (
+                {
+                    name: int(size)
+                    for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
+                }
+                if self.mesh is not None else {}
+            ),
             "multiprocess": bool(self._multiproc),
             "jit_entries": sorted(
                 str(k[1:]) for k in _JIT_CACHE if k[0] is self.mesh
             ),
+            "topology": self.topology.describe(),
+            "mode": self.topology.mode(),
         }
+        return doc

@@ -242,6 +242,15 @@ def build_tick_record(root_sp, t0: float, *, solver=None, brownout=None,
                           "fragmentation_index")
                 if k in q
             }
+        # mesh fault tolerance: stamp the tick with the live topology
+        # document (epoch, healthy/quarantined devices, ladder mode) so a
+        # post-incident trace shows which device set each decision ran
+        # under -- plain dict reads, same <1% overhead discipline
+        engine = getattr(solver, "mesh_engine", None)
+        topo = getattr(engine, "topology", None)
+        if topo is not None:
+            rec["topology"] = topo.describe()
+            rec["topology"]["mode"] = topo.mode()
         if breaker is None:
             breaker = getattr(solver, "breaker", None)
     if breaker is not None:

@@ -283,6 +283,36 @@ def _cmd_corpus(args) -> int:
             rc = 1
             pentry = {"ok": False, "note": f"packed-path invariant violation: {e}"}
         report[f"packed:{name}"] = pentry
+    # device-loss mesh gate (fleet fault tolerance): the one scenario
+    # that actually loses and regains devices is replayed through the
+    # mesh backend, where the events BITE (topology epoch bump ->
+    # reshard onto survivors -> shrunk-mesh solves -> re-promotion);
+    # its digest must equal the committed host golden bit-for-bit --
+    # the whole degrade ladder is decision-invisible, asserted the way
+    # sharded == unsharded is for the healthy mesh
+    loss = [p for p in traces
+            if os.path.splitext(os.path.basename(p))[0] == "mesh-device-loss"]
+    if loss and rc == 0:
+        from karpenter_tpu.sim.replay import InvariantViolation, replay
+
+        events = read_trace(loss[0])
+        seed = _trace_seed(events, None)
+        want = (new_digests.get("mesh-device-loss")
+                or golden.get("mesh-device-loss"))
+        try:
+            lres = replay(events, backend="mesh", seed=seed)
+            lentry = {"ok": lres.digest == want, "digest": lres.digest}
+            if not lentry["ok"]:
+                rc = 1
+                lentry["golden_digest"] = want
+                lentry["note"] = ("device-loss mesh digest diverged from "
+                                  "golden: the degrade ladder changed a "
+                                  "decision")
+        except InvariantViolation as e:
+            rc = 1
+            lentry = {"ok": False,
+                      "note": f"device-loss mesh invariant violation: {e}"}
+        report["mesh:mesh-device-loss"] = lentry
     if quality_violations:
         # the regression diff is a ready-made artifact: the sim-corpus CI
         # job uploads args.artifacts on failure, so the observed-vs-bound

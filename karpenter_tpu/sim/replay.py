@@ -550,6 +550,20 @@ class _Engine:
             elif kind == "operator_restart":
                 # clean restart between ticks (kill -9 while idle)
                 self._restart_operator()
+            elif kind in ("device_lost", "device_returned"):
+                # mesh fault tolerance: a device leaves/rejoins the
+                # solver's device mesh. Only the `mesh` backend carries
+                # an engine; every other backend takes the event as a
+                # decision-log entry alone -- which is exactly the
+                # differential contract: decisions (and so digests) must
+                # be bit-identical whether the solve resharded or never
+                # had a mesh at all.
+                engine = getattr(self.op.solver, "mesh_engine", None)
+                if engine is not None:
+                    if kind == "device_lost":
+                        engine.mark_device_lost(int(ev["device"]), reason="sim")
+                    else:
+                        engine.mark_device_returned(int(ev["device"]))
 
         for ev in events:
             apply(validate_event(ev))

@@ -19,6 +19,10 @@ Generators (composable; each returns self for chaining):
     ice_storm          -- exhaust capacity pools, then restore them
     price_shock        -- multiplicative price moves on named types
     pod_churn          -- delete a fraction of previously generated pods
+    device_lost/
+      device_returned  -- mesh device leaves/rejoins (topology epoch bump;
+                          only the mesh backend reshards -- the degrade
+                          ladder is decision-invisible by contract)
 
 Chaos events (interruptions, kills) are scheduled into QUIET windows --
 the generators leave a settle gap after arrivals -- because the pipelined
@@ -196,6 +200,24 @@ class ScenarioBuilder:
         nothing mid-flight, but caches are cold, the lease must be
         re-won, and the recovery sweep runs on the win."""
         self.at(t, {"ev": "operator_restart"})
+        return self
+
+    def device_lost(self, t: float, device: int) -> "ScenarioBuilder":
+        """Declare mesh device `device` lost at `t`: on the mesh backend
+        the topology epoch bumps and the next solve reshards onto the
+        survivors (2D layouts collapse a row first); every other backend
+        takes the event as a decision-log line alone. The degrade ladder
+        is decision-invisible by contract, so digests stay
+        backend-identical -- which is exactly what the corpus pins.
+        Schedule into QUIET windows like every other chaos verb."""
+        self.at(t, {"ev": "device_lost", "device": int(device)})
+        return self
+
+    def device_returned(self, t: float, device: int) -> "ScenarioBuilder":
+        """Device `device` comes back at `t`: the mesh backend
+        re-promotes up the ladder (back to the full mesh -- and its warm
+        jit cache -- once every device is healthy again)."""
+        self.at(t, {"ev": "device_returned", "device": int(device)})
         return self
 
     def ice_storm(self, t: float, pools: List[Tuple[str, str, str]],
@@ -414,6 +436,31 @@ def _scenario_multi_cluster_storm(seed: int) -> ScenarioBuilder:
     return b
 
 
+def _scenario_mesh_device_loss(seed: int) -> ScenarioBuilder:
+    """Mesh fault-tolerance family: the fleet serves a burst, loses the
+    highest-index mesh device in a quiet window (reshard onto seven
+    survivors; 2D layouts collapse a row), serves on the shrunk mesh,
+    loses a SECOND device (deeper down the ladder), then both return and
+    the full mesh is re-promoted for the final burst. The differential
+    corpus pins host == wire == pipelined THROUGH the loss events (every
+    backend logs them; only the mesh backend reshards), and the corpus's
+    device-loss mesh gate replays this trace through the mesh backend --
+    its digest must equal the committed host golden bit-for-bit, i.e.
+    the whole degrade ladder is decision-invisible."""
+    b = ScenarioBuilder("mesh-device-loss", seed)
+    b.poisson_arrivals(start=0.0, duration=12.0, rate_per_s=0.8)
+    # quiet window (fleet settled, pipeline drained) before each
+    # topology transition -- chaos-in-quiet-windows discipline
+    b.device_lost(t=30.0, device=7)
+    b.poisson_arrivals(start=36.0, duration=9.0, rate_per_s=0.6)
+    b.device_lost(t=60.0, device=3)
+    b.poisson_arrivals(start=66.0, duration=6.0, rate_per_s=0.5)
+    b.device_returned(t=90.0, device=3)
+    b.device_returned(t=90.0, device=7)
+    b.poisson_arrivals(start=96.0, duration=9.0, rate_per_s=0.6)
+    return b
+
+
 STANDARD_SCENARIOS = {
     "diurnal-small": _scenario_diurnal_small,
     "diurnal-medium": _scenario_diurnal_medium,
@@ -425,6 +472,7 @@ STANDARD_SCENARIOS = {
     "crash-restart": _scenario_crash_restart,
     "overload-storm": _scenario_overload_storm,
     "multi-cluster-storm": _scenario_multi_cluster_storm,
+    "mesh-device-loss": _scenario_mesh_device_loss,
 }
 
 # the committed corpus (tests/golden/scenarios/): small, fast, and one per
@@ -432,6 +480,7 @@ STANDARD_SCENARIOS = {
 CORPUS_SCENARIOS = (
     "diurnal-small", "diurnal-consolidation", "ice-storm",
     "interruption-wave", "overload-storm", "multi-cluster-storm",
+    "mesh-device-loss",
 )
 DEFAULT_SEED = 20260803
 

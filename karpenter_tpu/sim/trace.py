@@ -19,6 +19,11 @@ Event vocabulary (version 1):
     {"ev": "ice", "instance_type": t, "zone": z,
      "capacity_type": "spot", "count": 0}      # (ex|re)haust a capacity pool
     {"ev": "price", "instance_type": t, "factor": 1.5}  # pricing update
+    {"ev": "device_lost", "device": 7}         # mesh device dies (the
+                                               # topology epoch bumps; the
+                                               # mesh backend reshards)
+    {"ev": "device_returned", "device": 7}     # mesh device comes back
+                                               # (re-promotion to full)
     {"ev": "crash", "site": "crash.launch"}    # arm a one-shot crash
                                                # failpoint; the next tick
                                                # that reaches the site dies
@@ -72,7 +77,7 @@ TRACE_VERSION = 1
 EVENT_KINDS = (
     "header", "advance", "pod_add", "pod_delete", "kill_node",
     "interruption", "ice", "price", "crash", "operator_restart",
-    "failpoint",
+    "failpoint", "device_lost", "device_returned",
 )
 
 
@@ -92,6 +97,10 @@ def validate_event(ev: dict, lineno: int = 0) -> dict:
         raise TraceFormatError(f"line {lineno}: pod_add needs a pod object")
     if kind == "crash" and not (isinstance(ev.get("site"), str) and ev["site"]):
         raise TraceFormatError(f"line {lineno}: crash needs a failpoint site")
+    if kind in ("device_lost", "device_returned") and not isinstance(
+            ev.get("device"), int):
+        raise TraceFormatError(
+            f"line {lineno}: {kind} needs an integer device index")
     if kind == "failpoint" and not (isinstance(ev.get("spec"), str) and ev["spec"]):
         raise TraceFormatError(f"line {lineno}: failpoint needs a spec string")
     if kind == "header" and ev.get("version") != TRACE_VERSION:

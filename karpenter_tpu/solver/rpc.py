@@ -401,10 +401,16 @@ class _ReplyBuffer:
 
 
 class _StagedEntry:
-    def __init__(self, staged, offsets, words):
+    def __init__(self, staged, offsets, words, tepoch=None, catalog=None):
         self.staged = staged
         self.offsets = offsets
         self.words = words
+        # mesh fleet path only: the topology epoch the shards were staged
+        # under, and the HOST catalog tensors so a topology change can be
+        # healed server-side (one transparent restage at lookup -- the
+        # client keeps its seqnum, no wire round-trip, no restage loop)
+        self.tepoch = tepoch
+        self.catalog = catalog
 
 
 class SolverServer:
@@ -630,7 +636,7 @@ class SolverServer:
                 # without the join_allowed gate
                 features = [
                     "join_allowed", "trace_echo", "solve_delta", "reply_v2",
-                    "solve_disrupt", "packed_masks",
+                    "solve_disrupt", "packed_masks", "topology_epoch",
                 ]
                 if self._shm_enabled:
                     features.append("shm")
@@ -735,10 +741,15 @@ class SolverServer:
             tcap=t["tcap"], price=t["price"], vocabs=[], zones=list(header["zones"]),
             words=list(words),
         )
+        tepoch = None
         if self._mesh is not None:
             # fleet: catalog tensors stage K-sharded across the mesh once
-            # per seqnum; every tenant's later solves reuse the shards
-            staged, offsets, words = self._mesh.stage_catalog(catalog)
+            # per seqnum; every tenant's later solves reuse the shards.
+            # The entry keeps the HOST tensors so a topology-epoch change
+            # restages transparently at the next lookup.
+            staged, offsets, words, tepoch = (
+                self._mesh.stage_catalog_versioned(catalog)
+            )
         else:
             staged, offsets, words = ffd.stage_catalog(catalog)
         with self._lock:
@@ -746,10 +757,16 @@ class SolverServer:
                 self._staged.pop(next(iter(self._staged)))
                 self._evictions["catalog"] += 1
                 metrics.SOLVER_STAGED_EVICTIONS.inc(kind="catalog")
-            self._staged[seqnum] = _StagedEntry(staged, offsets, words)
+            self._staged[seqnum] = _StagedEntry(
+                staged, offsets, words, tepoch=tepoch,
+                catalog=catalog if self._mesh is not None else None,
+            )
             self._evict_for_pressure_locked()
             self._staged_bytes_locked()
-        _send_frame(sock, {"ok": True, "seqnum": seqnum})
+        reply = {"ok": True, "seqnum": seqnum}
+        if tepoch is not None:
+            reply["tepoch"] = int(tepoch)
+        _send_frame(sock, reply)
 
     def _staged_bytes_locked(self) -> Dict[str, int]:
         """Staged bytes by owner (HBM attribution, obs/hbm.py): sums
@@ -922,6 +939,27 @@ class SolverServer:
                 # USED catalog, not the oldest staged
                 self._staged.pop(seqnum)
                 self._staged[seqnum] = entry
+            if (
+                entry is not None
+                and self._mesh is not None
+                and entry.tepoch is not None
+                and entry.tepoch != self._mesh.epoch
+                and entry.catalog is not None
+            ):
+                # topology changed since this seqnum staged: the shards
+                # live on a mesh that no longer exists. The server holds
+                # the host tensors, so heal HERE -- one transparent
+                # restage onto the current mesh, in place, under the
+                # lock (exactly once per epoch change; the client keeps
+                # its seqnum and never sees a staging gap). A device
+                # loss DURING the solve itself still surfaces as
+                # StaleTopologyError through the dispatch guard.
+                metrics.MESH_STALE_SOLVES.inc(site="server-restage")
+                staged, offsets, words, tepoch = (
+                    self._mesh.stage_catalog_versioned(entry.catalog)
+                )
+                entry.staged, entry.offsets, entry.words = staged, offsets, words
+                entry.tepoch = tepoch
         if entry is None:
             _send_frame(sock, {"ok": False, "error": "unknown-seqnum"})
         return entry
@@ -972,6 +1010,7 @@ class SolverServer:
                     inp, g_max=int(header["g_max"]),
                     word_offsets=entry.offsets, words=entry.words,
                     objective=str(header.get("objective", "price")),
+                    epoch=entry.tepoch,
                 )
             else:
                 out = ffd.ffd_solve(
@@ -1014,6 +1053,7 @@ class SolverServer:
                     inp, g_max=int(header["g_max"]), nnz_max=int(header["nnz_max"]),
                     word_offsets=entry.offsets, words=entry.words,
                     objective=str(header.get("objective", "price")),
+                    epoch=entry.tepoch,
                 )
             else:
                 dec = ffd.ffd_solve_compact(
@@ -1112,7 +1152,7 @@ class SolverServer:
                     out = self._mesh.replace(
                         leftover, t["creq"], t["compat"], t["azone"], t["acap"],
                         entry.staged.cap, t["ovh"], entry.staged.price,
-                        od_col=od_col,
+                        od_col=od_col, epoch=entry.tepoch,
                     )
                 else:
                     out = disrupt_kernel.disrupt_replace(
@@ -1150,6 +1190,20 @@ class StaleEpochError(StaleSeqnumError):
     existing ladder that handles a mid-flight staging gap handles this one
     identically: the synchronous retry full-restages the class tensors
     (the client dropped its base on this error)."""
+
+
+class StaleTopologyError(StaleSeqnumError):
+    """The MESH-topology analogue of StaleSeqnumError: the device mesh a
+    sharded solve was staged under changed mid-flight (a device was lost,
+    quarantined, or returned -- fleet/topology.py bumps the topology
+    epoch on any membership change). Staged shards from the old epoch
+    live on a mesh that no longer exists, so the solve cannot be
+    completed as issued. Subclasses StaleSeqnumError so every existing
+    recovery rung -- the synchronous restage-and-retry ladder, the
+    pipelined barrier fallback, the breaker, the delta-epoch drop --
+    handles a topology change exactly like any other staging gap: the
+    retry restages onto the CURRENT mesh (fleet/shard.py reshards
+    lazily at the next dispatch) and re-solves bit-identically."""
 
 
 class _PendingReply:
@@ -1239,6 +1293,13 @@ class SolverClient:
         self._server_hostname = server_hostname or (host if host else None)
         self._sock: Optional[socket.socket] = None
         self._staged_seqnums: set = set()
+        # mesh topology epoch each seqnum was staged under, as reported in
+        # the stage reply (feature-negotiated "topology_epoch"; an older or
+        # unsharded server omits the field). Informational: the SERVER
+        # owns restaging across topology changes -- this is the observable
+        # half, so operators and tests can see which device set a staged
+        # catalog targeted.
+        self._staged_tepochs: Dict[str, int] = {}
         self._features: Optional[frozenset] = None  # per-connection, lazy
         # delta class shipping (the incremental-tick wire layer): when the
         # server advertises solve_delta, compact solves stage the class
@@ -1481,6 +1542,7 @@ class SolverClient:
             # needs (the breaker's promotion hook relies on this to gate
             # re-promotion on a catalog re-stage)
             self._staged_seqnums.clear()
+            self._staged_tepochs.clear()
             # delta bases die with the connection for the same reason: the
             # replacement sidecar holds no epochs, and a stale base would
             # cost one unknown-epoch roundtrip per seqnum before recovering
@@ -1594,6 +1656,16 @@ class SolverClient:
         header, out = rest
         if not header.get("ok"):
             err = str(header.get("error", ""))
+            if err.startswith("StaleTopologyError"):
+                # the sidecar's device mesh changed membership while this
+                # solve was in flight (server errors cross the wire as
+                # "ClassName: message"). The server transparently restages
+                # the seqnum onto the surviving devices at its next touch,
+                # so the typed re-raise rides the existing StaleSeqnumError
+                # barrier-fallback rung -- one synchronous retry against
+                # the SAME seqnum lands on the new topology epoch.
+                metrics.MESH_STALE_SOLVES.inc(site="client-wire")
+                raise StaleTopologyError(err)
             if err == "unknown-epoch":
                 # the sidecar lost the base epoch mid-flight: drop the
                 # client base so the synchronous retry ships full, and
@@ -1716,6 +1788,8 @@ class SolverClient:
             raise RuntimeError(f"stage failed: {resp.get('error')}")
         with self._lock:
             self._staged_seqnums.add(seqnum)
+            if resp.get("tepoch") is not None:
+                self._staged_tepochs[seqnum] = int(resp["tepoch"])
 
     @staticmethod
     def _class_tensors(class_set: encode.PodClassSet, packed: bool = False):
@@ -1937,6 +2011,22 @@ class SolverClient:
                 tensors = self._delta_request(seqnum, class_set, header)
                 self._maybe_reply_v2(header)
                 resp, out = self._roundtrip(header, tensors)
+            if (
+                not resp.get("ok")
+                and str(resp.get("error", "")).startswith("StaleTopologyError")
+            ):
+                # the sidecar's device mesh changed membership mid-solve
+                # (device lost, quarantine, or return). Its staging layer
+                # restages the seqnum onto the current device set on the
+                # next touch, so one retry -- same seqnum, same tensors --
+                # lands on the new topology epoch. At most once: a second
+                # stale answer surfaces as the failure it is and rides the
+                # breaker ladder like any other wire fault.
+                metrics.MESH_STALE_SOLVES.inc(site="client-sync")
+                header = dict(op_header)
+                tensors = self._delta_request(seqnum, class_set, header)
+                self._maybe_reply_v2(header)
+                resp, out = self._roundtrip(header, tensors)
             if not resp.get("ok"):
                 raise RuntimeError(f"solve failed: {resp.get('error')}")
             tracing.TRACER.graft(resp)
@@ -1984,6 +2074,14 @@ class SolverClient:
             ):
                 # sidecar restarted / evicted: re-stage once and retry
                 self.stage_catalog(seqnum, catalog)
+                resp, out = self._roundtrip(header, tensors)
+            if (
+                not resp.get("ok")
+                and str(resp.get("error", "")).startswith("StaleTopologyError")
+            ):
+                # mesh membership changed mid-dispatch: server-side
+                # restage is transparent on the next touch, retry once
+                metrics.MESH_STALE_SOLVES.inc(site="client-disrupt")
                 resp, out = self._roundtrip(header, tensors)
             if not resp.get("ok"):
                 raise RuntimeError(f"solve_disrupt failed: {resp.get('error')}")

@@ -77,6 +77,12 @@ class _CatalogEntry(NamedTuple):
     # catalog's vocabularies, so a warm steady-state tick re-encodes only
     # the classes that changed
     row_cache: Optional[dict] = None
+    # mesh mode only (fleet/topology.py): the topology epoch the staged
+    # shards were uploaded under. _catalog revalidates it -- a device
+    # loss/return between ticks restages the SAME encoding onto the new
+    # mesh under a fresh seqnum (in-flight barriers fall back), and a
+    # mid-dispatch change surfaces as StaleTopologyError
+    mesh_epoch: Optional[int] = None
 
 
 class _MergedVirtualPool(NodePool):
@@ -265,16 +271,41 @@ class TPUSolver:
         with self._lock:
             entry = self._catalog_cache.get(key)
             if entry is not None and entry.catalog_list is instance_types:
-                # LRU touch
-                self._catalog_cache[key] = self._catalog_cache.pop(key)
+                if (
+                    self.mesh_engine is not None
+                    and entry.mesh_epoch != self.mesh_engine.epoch
+                ):
+                    # topology changed since this catalog was staged: the
+                    # shards live on a mesh that no longer exists. Restage
+                    # the SAME encoding (tensors/row_cache survive) onto
+                    # the current mesh under a FRESH seqnum, so in-flight
+                    # pipelined barriers legally fall back -- exactly one
+                    # restage per epoch change, never a loop (the stamp
+                    # is read under the engine's reshard lock)
+                    staged, offsets, words, tepoch = (
+                        self.mesh_engine.stage_catalog_versioned(entry.tensors)
+                    )
+                    self._seq_counter += 1
+                    entry = entry._replace(
+                        staged=staged, offsets=offsets, words=words,
+                        seqnum=f"{self._seq_prefix}-{self._seq_counter}",
+                        mesh_epoch=tepoch,
+                    )
+                # LRU touch (and publish the restaged entry)
+                self._catalog_cache.pop(key, None)
+                self._catalog_cache[key] = entry
                 return entry
             tensors = encode.encode_catalog(instance_types)
+            tepoch = None
             # remote mode: the sidecar stages on ITS device; no local copy
             if self.client is not None:
                 staged, offsets, words = None, (), ()
             elif self.mesh_engine is not None:
-                # fleet: the catalog stages K-sharded across the mesh
-                staged, offsets, words = self.mesh_engine.stage_catalog(tensors)
+                # fleet: the catalog stages K-sharded across the mesh,
+                # stamped with the topology epoch it was staged under
+                staged, offsets, words, tepoch = (
+                    self.mesh_engine.stage_catalog_versioned(tensors)
+                )
             else:
                 staged, offsets, words = ffd.stage_catalog(tensors)
             # decode acceleration: type objects pre-sorted by cheapest
@@ -288,7 +319,7 @@ class TPUSolver:
                 seqnum=f"{self._seq_prefix}-{self._seq_counter}",
                 types_by_price=np.array(list(instance_types), dtype=object)[order],
                 order=order, catalog_list=instance_types,
-                row_cache={},
+                row_cache={}, mesh_epoch=tepoch,
             )
             self._catalog_cache[key] = entry
             while len(self._catalog_cache) > self._catalog_cache_cap:
@@ -458,7 +489,7 @@ class TPUSolver:
         jax.block_until_ready(outs)
 
     # -- kernel selection ---------------------------------------------------
-    def _dispatch_fused(self, inp, nnz_max: int, offsets, words):
+    def _dispatch_fused(self, inp, nnz_max: int, offsets, words, epoch=None):
         """One fused-solve dispatch through the configured kernel rung:
         mesh engine when sharded, the hand-written Pallas kernel when
         kernels='pallas' (solver/kernels/ffd_pallas.py -- same jit
@@ -472,7 +503,7 @@ class TPUSolver:
             words=words, objective=self.objective,
         )
         if self.mesh_engine is not None:
-            return self.mesh_engine.solve_fused(inp, **common)
+            return self.mesh_engine.solve_fused(inp, epoch=epoch, **common)
         if self.kernels == "pallas" and "ffd_solve_fused" not in self._pallas_failed:
             from karpenter_tpu.solver.kernels import ffd_pallas
 
@@ -492,14 +523,14 @@ class TPUSolver:
         metrics.SOLVER_KERNEL_DISPATCHES.inc(entry="ffd_solve_fused", impl="xla")
         return ffd.ffd_solve_fused(inp, **common)
 
-    def _dispatch_bound(self, inp, placed: np.ndarray, offsets, words):
+    def _dispatch_bound(self, inp, placed: np.ndarray, offsets, words, epoch=None):
         """One fractional-price-bound dispatch (solver/bound.py) through
         the same routing as the solve it shadows: the mesh engine's
         sharded entry when configured, the plain jit entry otherwise.
         Returns the in-flight [R] per-resource totals."""
         if self.mesh_engine is not None:
             return self.mesh_engine.price_bound(
-                inp, placed, word_offsets=offsets, words=words)
+                inp, placed, word_offsets=offsets, words=words, epoch=epoch)
         return price_bound.fractional_price_bound(
             inp, placed, word_offsets=offsets, words=words)
 
@@ -521,6 +552,7 @@ class TPUSolver:
             totals = self._dispatch_bound(
                 pending.inp, placed,
                 offsets=pending.entry.offsets, words=pending.entry.words,
+                epoch=pending.entry.mesh_epoch,
             )
             totals.copy_to_host_async()
             return totals
@@ -1760,6 +1792,59 @@ class TPUSolver:
                     # CPU fallback) owns degradation
                     wd_sp.set(dispatch_error=f"{type(e).__name__}: {e}"[:200])
                     pending.rpc_handle = None
+        elif self.mesh_engine is not None:
+            # the sharded dispatch is epoch-fenced: a device lost between
+            # staging and dispatch (or killed BY this dispatch -- the
+            # engine classifies the XLA error, quarantines the device,
+            # and bumps the epoch) surfaces as StaleTopologyError. One
+            # recovery rung here: re-enter solve_begin, whose _catalog
+            # restages the same encoding onto the surviving mesh. Each
+            # retry requires the epoch to have ADVANCED past the stamp it
+            # dispatched with, so a non-topology RuntimeError can never
+            # loop; repeated losses walk the ladder down to the
+            # unsharded rung, where the engine stops classifying.
+            from karpenter_tpu.solver import rpc as rpc_mod
+
+            try:
+                with tracing.span("dispatch_device"):
+                    inp = ffd.make_inputs_staged(
+                        staged, class_set, packed_masks=self.packed_masks)
+                    nnz_max = ffd.nnz_budget(class_set.c_pad, self.g_max)
+                    self._last_solve_bytes = obs_hbm.sum_nbytes(inp)
+                    self._last_mask_bytes = (
+                        packing.mask_nbytes(inp.open_allowed)
+                        + packing.mask_nbytes(inp.join_allowed)
+                    )
+                    self._last_mask_full_bytes = 2 * packing.full_mask_nbytes(
+                        class_set.c_pad, entry.tensors.k_pad
+                    )
+                    buf = self._dispatch_fused(
+                        inp, nnz_max=nnz_max, offsets=offsets, words=words,
+                        epoch=entry.mesh_epoch,
+                    )
+                    buf.copy_to_host_async()
+            except rpc_mod.StaleSeqnumError as e:
+                if (
+                    entry.mesh_epoch is not None
+                    and self.mesh_engine.epoch == entry.mesh_epoch
+                ):
+                    raise  # no topology progress: a retry would loop
+                metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="stale-topology")
+                tracing.annotate(fallback="stale-topology")
+                if self._route_monitor.has_changed(
+                        "mesh_topology", self.mesh_engine.epoch):
+                    self.log.warning(
+                        "mesh topology changed mid-dispatch; restaging onto "
+                        "the current device set",
+                        error=f"{type(e).__name__}: {e}"[:200],
+                        epoch=self.mesh_engine.epoch,
+                    )
+                return self.solve_begin(
+                    *call_args, _barrier=_barrier, **call_kwargs)
+            pending.buf = buf
+            pending.inp = inp
+            pending.nnz_max = nnz_max
+            return pending
         else:
             with tracing.span("dispatch_device"):
                 inp = ffd.make_inputs_staged(
@@ -1800,11 +1885,19 @@ class TPUSolver:
         falls back to a fresh synchronous solve."""
         with self._lock:
             cur = self._catalog_cache.get(id(entry.catalog_list))
-            return (
-                cur is not None
-                and cur.catalog_list is entry.catalog_list
-                and cur.seqnum == entry.seqnum
-            )
+            if (
+                cur is None
+                or cur.catalog_list is not entry.catalog_list
+                or cur.seqnum != entry.seqnum
+            ):
+                return False
+        # mesh mode: an epoch bump the cache has not SEEN yet (no
+        # _catalog call since the loss) still supersedes this staging --
+        # the barrier must fall back rather than fetch from a dead mesh
+        return (
+            self.mesh_engine is None
+            or entry.mesh_epoch == self.mesh_engine.epoch
+        )
 
     def solve_finish(self, pending: "_PendingSolve") -> SchedulingResult:
         """The pipeline barrier: fetch the dispatched decision, expand,
@@ -1843,6 +1936,19 @@ class TPUSolver:
                 # under this span when the reply carries them (rpc.py)
                 dense = self._finish_remote(pending)
         else:
+            if (
+                self.mesh_engine is not None
+                and pending.entry.mesh_epoch is not None
+                and pending.entry.mesh_epoch != self.mesh_engine.epoch
+            ):
+                # topology changed between dispatch and this barrier: the
+                # fused buffer lives on a mesh that lost a device, and
+                # reading it would block on a dead chip. Same fallback
+                # rung as a mid-flight catalog change: restage + re-solve
+                # (bit-identical -- the ladder only moves computation)
+                metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="stale-topology")
+                tracing.annotate(fallback="stale-topology")
+                return self.solve(*pending.call_args, **pending.call_kwargs)
             with tracing.span("device"):
                 # SANCTIONED_FETCH (jax_discipline): THE host barrier of
                 # the in-process tick -- drains the copy_to_host_async
@@ -1858,12 +1964,26 @@ class TPUSolver:
                 # refetch the dense decision -- correctness over latency
                 with tracing.span("device", refetch="dense"):
                     if self.mesh_engine is not None:
-                        out = self.mesh_engine.solve_dense(
-                            pending.inp, g_max=self.g_max,
-                            word_offsets=entry.offsets, words=entry.words,
-                            objective=self.objective,
-                        )
-                        f = self.mesh_engine.fetch(out)
+                        from karpenter_tpu.solver import rpc as rpc_mod
+
+                        try:
+                            out = self.mesh_engine.solve_dense(
+                                pending.inp, g_max=self.g_max,
+                                word_offsets=entry.offsets, words=entry.words,
+                                objective=self.objective,
+                                epoch=entry.mesh_epoch,
+                            )
+                            f = self.mesh_engine.fetch(
+                                out, epoch=entry.mesh_epoch)
+                        except rpc_mod.StaleSeqnumError:
+                            # topology changed under the refetch: restage
+                            # and re-solve -- same rung as a mid-flight
+                            # catalog change, bit-identical result
+                            metrics.SOLVER_PIPELINE_FALLBACKS.inc(
+                                reason="stale-topology")
+                            tracing.annotate(fallback="stale-topology")
+                            return self.solve(
+                                *pending.call_args, **pending.call_kwargs)
                         dense = (
                             f.take, f.unplaced, int(f.n_open),
                             f.gmask, f.gzone, f.gcap,

@@ -1,0 +1,491 @@
+"""Mesh fault-tolerance chaos soak: the device-loss degrade ladder.
+
+The fleet solve path's failure ladder (fleet/topology.py) is:
+
+    full mesh -> shrunk mesh -> unsharded single-device
+              -> wire breaker -> host CPU
+
+and EVERY rung must be bit-identical on decisions -- GSPMD only changes
+placement, never semantics, and the unsharded rung is the proven
+single-device entry set. This suite drills that contract three ways:
+
+1. the tier-1 ladder differential: full == shrunk == unsharded == host
+   decision signatures on BOTH mesh layouts (flat 8 and 2x4), plus
+   re-promotion handing back the ORIGINAL mesh object (warm jit cache);
+2. the seeded chaos soak: the production kwok rig (pipelined tick, mesh
+   sidecar, breaker) under seeded schedules of device losses, returns,
+   straggler quarantines, restage faults and mid-dispatch device-death
+   failpoints -- zero pods lost, no double-launch, convergence after
+   every transition, re-promotion at the end (`make mesh-chaos` runs
+   the 20-seed acceptance floor);
+3. the staging races: pressure-evicted sharded entries restage under
+   the NEW topology epoch, and a mid-flight StaleTopologyError resolves
+   through ONE restage -- never a loop.
+
+`KARPENTER_TPU_CHAOS_SEEDS` bounds the soak seed count exactly like
+tests/test_chaos.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from karpenter_tpu import metrics
+from karpenter_tpu.apis import NodeClaim, NodePool, Pod, TPUNodeClass
+from karpenter_tpu.cache.ttl import FakeClock
+from karpenter_tpu.failpoints import FAILPOINTS
+from karpenter_tpu.fleet.shard import MeshSolveEngine
+from karpenter_tpu.fleet.straggler import ShardStragglerWatchdog
+from karpenter_tpu.operator import Operator
+from karpenter_tpu.parallel.mesh import make_mesh, make_mesh_2d
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver import encode
+from karpenter_tpu.solver.rpc import (
+    SolverClient, SolverServer, StaleSeqnumError, StaleTopologyError,
+)
+from karpenter_tpu.solver.service import TPUSolver
+from tests.test_fleet import catalog_items, decision_sig, mixed_pods  # noqa: F401
+from tests.test_soak import check_invariants
+
+N_SEEDS = int(os.environ.get("KARPENTER_TPU_CHAOS_SEEDS", "20"))
+
+
+def _need_mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh (tests/conftest.py)")
+
+
+@pytest.fixture(params=["1d", "2x4"])
+def fresh_engine(request):
+    """Function-scoped: these tests MUTATE topology, so each gets its
+    own ledger (the jitted programs still share the module cache --
+    Mesh hashes by devices+axes)."""
+    _need_mesh()
+    mesh = make_mesh(8) if request.param == "1d" else make_mesh_2d(2, 4)
+    return MeshSolveEngine(mesh)
+
+
+class TestDegradeLadderBitIdentity:
+    """The acceptance differential: shrunk == unsharded == host, both
+    layouts, in tier-1."""
+
+    def test_every_rung_matches_host(self, fresh_engine, catalog_items):  # noqa: F811
+        pool = NodePool("default")
+        host = TPUSolver(g_max=64)
+        meshy = TPUSolver(g_max=64, mesh=fresh_engine)
+        rng = np.random.default_rng(41)
+        pods = mixed_pods(rng, 60, salt=600)
+        want = decision_sig(host.solve(pool, catalog_items, list(pods)))
+        full_mesh = fresh_engine.mesh
+
+        # rung 0: full mesh
+        assert fresh_engine.topology.mode() == "full"
+        assert decision_sig(meshy.solve(pool, catalog_items, list(pods))) == want
+
+        # rung 1: shrunk -- lose the highest-index device. On the flat
+        # layout that shrinks to the pow2 prefix (4 devices); on 2x4 the
+        # row containing device 7 leaves whole, and one surviving row
+        # cannot stand alone, so 2D collapses to the flat fallback.
+        assert fresh_engine.mark_device_lost(7, reason="test")
+        assert decision_sig(meshy.solve(pool, catalog_items, list(pods))) == want
+        assert fresh_engine.topology.mode() in ("shrunk", "unsharded")
+
+        # rung 2: unsharded -- lose all but one device
+        for idx in range(1, 7):
+            fresh_engine.mark_device_lost(idx, reason="test")
+        assert decision_sig(meshy.solve(pool, catalog_items, list(pods))) == want
+        assert fresh_engine.topology.mode() == "unsharded"
+        assert fresh_engine.mesh is None
+
+        # re-promotion: every device returns; the ORIGINAL mesh object
+        # comes back (warm jit cache), decisions still bit-identical
+        for idx in (7, *range(1, 7)):
+            assert fresh_engine.mark_device_returned(idx)
+        assert decision_sig(meshy.solve(pool, catalog_items, list(pods))) == want
+        assert fresh_engine.topology.mode() == "full"
+        assert fresh_engine.mesh is full_mesh
+
+    def test_epoch_monotonic_and_stamped(self, fresh_engine, catalog_items):  # noqa: F811
+        """Every membership change bumps the epoch exactly once; staged
+        catalogs are stamped with the epoch they were staged under."""
+        e0 = fresh_engine.epoch
+        assert fresh_engine.mark_device_lost(6, reason="test")
+        assert fresh_engine.epoch == e0 + 1
+        assert not fresh_engine.mark_device_lost(6, reason="test")  # idempotent
+        assert fresh_engine.epoch == e0 + 1
+        catalog = encode.encode_catalog(catalog_items, k_pad=640)
+        _, _, _, tepoch = fresh_engine.stage_catalog_versioned(catalog)
+        assert tepoch == fresh_engine.epoch
+        assert fresh_engine.mark_device_returned(6)
+        assert fresh_engine.epoch == e0 + 2
+
+    def test_stale_epoch_dispatch_fences(self, fresh_engine, catalog_items):  # noqa: F811
+        """A dispatch stamped with an old epoch raises the typed rung
+        (StaleTopologyError IS a StaleSeqnumError, so every existing
+        recovery rung handles it unchanged) instead of touching a mesh
+        the stamp no longer describes."""
+        from karpenter_tpu.solver import ffd
+
+        catalog = encode.encode_catalog(catalog_items, k_pad=640)
+        staged, offsets, words, tepoch = (
+            fresh_engine.stage_catalog_versioned(catalog)
+        )
+        classes = encode.group_pods(
+            mixed_pods(np.random.default_rng(43), 20, salt=610))
+        cs = encode.encode_classes(classes, catalog)
+        inp = ffd.make_inputs_staged(staged, cs)
+        nnz = ffd.nnz_budget(cs.c_pad, 32)
+        fresh_engine.mark_device_lost(5, reason="test")
+        with pytest.raises(StaleTopologyError):
+            fresh_engine.solve_fused(
+                inp, g_max=32, nnz_max=nnz, word_offsets=offsets,
+                words=words, epoch=tepoch,
+            )
+        assert isinstance(StaleTopologyError("x"), StaleSeqnumError)
+
+
+# -- the seeded chaos soak ----------------------------------------------------
+
+SIZES = [("250m", "512Mi"), ("500m", "1Gi"), ("1", "2Gi"), ("2", "4Gi")]
+
+# mesh fault vocabulary: topology mutations plus the two failpoint
+# sites on the dispatch/restage path. Failpoint budgets are finite so
+# every fault self-clears; the ladder must absorb all of them.
+MESH_FAULTS = (
+    "device_lost", "device_returned", "quarantine",
+    "restage_fault", "dispatch_device_death",
+)
+
+
+def _mesh_rig(tmp_path):
+    path = str(tmp_path / "solver.sock")
+    srv = SolverServer(path=path, mesh=make_mesh(8)).start()
+    client = SolverClient(path=path, timeout=10.0, connect_timeout=0.25, delta=True)
+    from karpenter_tpu.solver.breaker import CircuitBreaker
+
+    breaker = CircuitBreaker(failure_threshold=2, backoff_base=1000.0)
+    solver = TPUSolver(g_max=64, client=client, breaker=breaker)
+    op = Operator(clock=FakeClock(50_000.0), solver=solver)
+    op.cluster.create(TPUNodeClass("default"))
+    op.cluster.create(NodePool("default"))
+    return srv, client, breaker, op
+
+
+def _burst(op, rng, seed, start, n):
+    for i in range(n):
+        cpu, mem = SIZES[int(rng.integers(0, len(SIZES)))]
+        op.cluster.create(Pod(
+            f"meshchaos-{seed}-{start + i}",
+            requests=Resources({"cpu": cpu, "memory": mem}),
+        ))
+    return start + n
+
+
+def _settle(op, max_ticks=40):
+    for _ in range(max_ticks):
+        op.tick()
+        check_invariants(op)
+        if not op.cluster.pending_pods():
+            return True
+        op.clock.step(3.0)
+    return False
+
+
+def _drive_mesh_chaos_schedule(tmp_path, seed, rounds=3):
+    rng = np.random.default_rng(7000 + seed)
+    srv, client, breaker, op = _mesh_rig(tmp_path)
+    engine = srv._mesh
+    pod_seq = 0
+    epochs_seen = [engine.epoch]
+    try:
+        for round_i in range(rounds):
+            fault = MESH_FAULTS[int(rng.integers(0, len(MESH_FAULTS)))]
+            if fault == "device_lost":
+                # victims among the upper indices: the pow2-prefix
+                # shrink rule then reuses a small set of survivor
+                # layouts, so the soak exercises transitions without
+                # compiling a fresh program per seed
+                engine.mark_device_lost(int(rng.integers(4, 8)), reason="chaos")
+            elif fault == "device_returned":
+                lost = sorted(engine.topology.quarantined())
+                if lost:
+                    engine.mark_device_returned(
+                        lost[int(rng.integers(0, len(lost)))])
+            elif fault == "quarantine":
+                engine.quarantine_worst_device(reason="chaos")
+            elif fault == "restage_fault":
+                # the next reshard fails mid-swap: the ladder must land
+                # on the unsharded rung, not escape
+                FAILPOINTS.arm("mesh.restage", "error", "RuntimeError", times=1)
+                # victim must be CURRENTLY healthy: marking an
+                # already-lost device is idempotent (no epoch bump), and
+                # without a bump no reshard ever reaches the armed seam
+                healthy = engine.topology.healthy_indices()
+                pool = [i for i in healthy if i >= 4] or list(healthy)
+                engine.mark_device_lost(
+                    pool[int(rng.integers(0, len(pool)))], reason="chaos")
+            elif fault == "dispatch_device_death":
+                # a dispatch dies mid-flight with a device-loss-shaped
+                # RuntimeError: classified, quarantined, retried as the
+                # typed StaleTopologyError rung
+                FAILPOINTS.arm(
+                    "mesh.device.lost", "error", "RuntimeError", times=1)
+            epochs_seen.append(engine.epoch)
+            pod_seq = _burst(op, rng, seed, pod_seq, int(rng.integers(3, 8)))
+            assert _settle(op), (
+                f"seed {seed} round {round_i}: never converged after {fault}"
+            )
+            if fault in ("restage_fault", "dispatch_device_death"):
+                site = ("mesh.restage" if fault == "restage_fault"
+                        else "mesh.device.lost")
+                if FAILPOINTS.fires(site) == 0:
+                    # the burst never reached the armed seam: every pod
+                    # bound to existing capacity, or the breaker had
+                    # already sent the client to the host rung. Poke the
+                    # dispatch path directly so the drill is consumed
+                    # and the ladder still absorbs this round's fault.
+                    try:
+                        engine._dispatch("fused", None, lambda: None)
+                    except RuntimeError:
+                        pass
+                assert FAILPOINTS.fires(site) >= 1, (
+                    f"seed {seed} round {round_i}: {site} never fired"
+                )
+            FAILPOINTS.reset()
+        # the epoch ledger is monotonic: every transition moved it forward
+        assert epochs_seen == sorted(epochs_seen)
+        # device return: everything comes back, the engine re-promotes
+        # to the FULL mesh, and one more burst converges on it
+        for idx in sorted(engine.topology.quarantined()):
+            engine.mark_device_returned(idx)
+        assert engine.topology.mode() == "full"
+        pod_seq = _burst(op, rng, seed, pod_seq, 4)
+        assert _settle(op), f"seed {seed}: no convergence after re-promotion"
+        # end-state: zero pods lost, no double-launch, no orphans
+        for _ in range(10):
+            op.tick()
+            op.clock.step(10.0)
+        check_invariants(op)
+        for p in op.cluster.list(Pod):
+            assert p.node_name, f"pod {p.metadata.name} lost (never bound)"
+        claimed = {c.provider_id for c in op.cluster.list(NodeClaim) if c.provider_id}
+        assert len(claimed) == len(
+            [c for c in op.cluster.list(NodeClaim) if c.provider_id]
+        ), "duplicate provider id: double-launch"
+        for inst in op.cloud.describe_instances():
+            if inst.state == "running":
+                assert inst.provider_id in claimed, f"orphan instance {inst.id}"
+    finally:
+        FAILPOINTS.reset()
+        breaker.stop()
+        client.close()
+        srv.stop()
+
+
+@pytest.mark.parametrize("seed", range(N_SEEDS))
+def test_mesh_chaos_schedule(seed, failpoints, tmp_path):
+    _need_mesh()
+    _drive_mesh_chaos_schedule(tmp_path, seed, rounds=3)
+
+
+# -- straggler watchdog: per-shard stuck-dispatch ladder ----------------------
+
+
+class TestShardStragglerWatchdog:
+    def test_escalation_ladder(self):
+        _need_mesh()
+        engine = MeshSolveEngine(make_mesh(8))
+
+        class _Breaker:
+            opened = None
+
+            def force_open(self, reason):
+                self.opened = reason
+
+        cancelled = []
+        clock = [0.0]
+        breaker = _Breaker()
+        wd = ShardStragglerWatchdog(
+            budget=1.0, engine=engine, cancel=lambda: cancelled.append(1),
+            breaker=breaker, clock=lambda: clock[0],
+        )
+        e0 = engine.epoch
+        wd.dispatch_started("fused")
+        assert wd.check_now() is None           # inside budget
+        clock[0] = 4.5
+        assert wd.check_now() == "cancel"
+        assert cancelled == [1]
+        clock[0] = 8.5
+        assert wd.check_now() == "quarantine"   # epoch bump = typed rung
+        assert engine.epoch == e0 + 1
+        assert engine.topology.mode() in ("shrunk", "unsharded")
+        clock[0] = 12.5
+        assert wd.check_now() == "breaker-open"
+        assert breaker.opened == "shard-straggler watchdog"
+        assert wd.escalations["cancel"] == 1
+        assert wd.escalations["quarantine"] == 1
+        assert wd.escalations["breaker-open"] == 1
+        assert metrics.MESH_SHARD_WATCHDOG.value(stage="quarantine") >= 1
+        wd.dispatch_finished()
+        clock[0] = 100.0
+        assert wd.check_now() is None           # nothing in flight
+
+    def test_finished_dispatch_never_escalates(self):
+        _need_mesh()
+        clock = [0.0]
+        wd = ShardStragglerWatchdog(budget=0.5, clock=lambda: clock[0])
+        wd.dispatch_started("compact")
+        wd.dispatch_finished()
+        clock[0] = 1_000.0
+        assert wd.check_now() is None
+        d = wd.describe()
+        assert d["dispatch_active_for_s"] is None
+        assert d["budget_s"] == 0.5
+
+    def test_quarantined_solve_stays_bit_identical(self, catalog_items):  # noqa: F811
+        """The quarantine rung's whole point: after the watchdog shrinks
+        the mesh, decisions are STILL bit-identical to host."""
+        _need_mesh()
+        engine = MeshSolveEngine(make_mesh(8))
+        clock = [0.0]
+        wd = ShardStragglerWatchdog(
+            budget=1.0, engine=engine, clock=lambda: clock[0],
+            multiples=(1.0, 2.0, 90.0, 99.0),
+        )
+        engine.attach_watchdog(wd)
+        wd.dispatch_started("fused")
+        clock[0] = 2.5
+        wd.check_now()                     # cancel (no hook)
+        assert wd.check_now() == "quarantine"
+        wd.dispatch_finished()
+        pool = NodePool("default")
+        pods = mixed_pods(np.random.default_rng(47), 40, salt=700)
+        assert decision_sig(
+            TPUSolver(g_max=64, mesh=engine).solve(pool, catalog_items, list(pods))
+        ) == decision_sig(
+            TPUSolver(g_max=64).solve(pool, catalog_items, list(pods))
+        )
+
+
+# -- staging races: eviction vs reshard ---------------------------------------
+
+
+@pytest.fixture()
+def mesh_server():
+    _need_mesh()
+    srv = SolverServer(insecure_tcp=True, mesh=make_mesh(8)).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def mesh_client(mesh_server):
+    c = SolverClient(
+        mesh_server.address[0], mesh_server.address[1], delta=True,
+        track_transport=False,
+    )
+    yield c
+    c.close()
+
+
+class TestStagingReshardRaces:
+    def test_evicted_entry_restages_under_new_epoch(
+        self, mesh_server, mesh_client, catalog_items  # noqa: F811
+    ):
+        """Pressure eviction RACING a reshard: the evicted-then-restaged
+        entry must land under the NEW topology epoch, never the one it
+        was first staged under."""
+        from karpenter_tpu.obs import hbm as obs_hbm
+
+        pool = NodePool("default")
+        sd = TPUSolver(g_max=64, client=mesh_client, breaker=False)
+        host = TPUSolver(g_max=64)
+        rng = np.random.default_rng(53)
+        pods = mixed_pods(rng, 40, salt=800)
+        sd.solve(pool, catalog_items, list(pods))
+        (seqnum,) = list(mesh_server._staged)
+        old_epoch = mesh_server._staged[seqnum].tepoch
+        # the race: membership changes WHILE pressure empties the LRUs
+        engine = mesh_server._mesh
+        assert engine.mark_device_lost(6, reason="test")
+        try:
+            obs_hbm.set_stats_provider(lambda: {
+                "dev:0": {"bytes_in_use": 950, "bytes_limit": 1000,
+                          "peak_bytes_in_use": 950},
+            })
+            with mesh_server._lock:
+                mesh_server._evict_for_pressure_locked()
+        finally:
+            obs_hbm.set_stats_provider(None)
+        pods2 = pods[:-4] + mixed_pods(rng, 4, salt=801)
+        res = sd.solve(pool, catalog_items, list(pods2))
+        assert decision_sig(res) == decision_sig(
+            host.solve(pool, catalog_items, list(pods2)))
+        entry = mesh_server._staged[seqnum]
+        assert entry.tepoch == engine.epoch
+        assert entry.tepoch != old_epoch
+
+    def test_midflight_topology_change_resolves_in_one_restage(
+        self, mesh_server, mesh_client, catalog_items  # noqa: F811
+    ):
+        """A topology epoch bump mid-flight surfaces as the typed
+        StaleTopologyError and resolves through ONE server-side restage
+        -- not a restage loop. The loop guard: no topology progress =>
+        re-raise, one epoch step => one restage."""
+        solver = TPUSolver(g_max=64, client=mesh_client, breaker=False)
+        entry = solver._catalog(catalog_items)
+        engine = mesh_server._mesh
+        classes = encode.group_pods(
+            mixed_pods(np.random.default_rng(59), 30, salt=900))
+        cs = encode.encode_classes(classes, entry.tensors, c_pad=32)
+        h = mesh_client.begin_solve_compact(
+            entry.seqnum, entry.tensors, cs, g_max=64)
+        mesh_client.finish_solve_compact(h)
+        # the mesh loses a device between pipelined begin and finish
+        before = metrics.MESH_STALE_SOLVES.value(site="server-restage")
+        assert engine.mark_device_lost(5, reason="test")
+        cs2 = encode.encode_classes(classes, entry.tensors, c_pad=32)
+        cs2.count[0] += 1
+        h2 = mesh_client.begin_solve_compact(
+            entry.seqnum, entry.tensors, cs2, g_max=64)
+        try:
+            dec = mesh_client.finish_solve_compact(h2)
+        except StaleSeqnumError:
+            # the typed rung surfaced to the claim; the synchronous
+            # retry restages ONCE and lands on the new epoch
+            dec = mesh_client.solve_classes_compact(
+                entry.seqnum, entry.tensors, cs2, g_max=64)
+        assert int(dec.n_open) >= 0
+        restages = (
+            metrics.MESH_STALE_SOLVES.value(site="server-restage") - before
+        )
+        assert restages <= 1, f"restage loop: {restages} restages for one bump"
+        assert mesh_server._staged[entry.seqnum].tepoch == engine.epoch
+        # and the NEXT solve is clean: no further stale surfaces
+        before2 = metrics.MESH_STALE_SOLVES.value(site="server-restage")
+        dec2 = mesh_client.solve_classes_compact(
+            entry.seqnum, entry.tensors, cs2, g_max=64)
+        assert int(dec2.n_open) >= 0
+        assert metrics.MESH_STALE_SOLVES.value(site="server-restage") == before2
+
+
+# -- the committed corpus scenario --------------------------------------------
+
+
+def test_mesh_device_loss_corpus_scenario():
+    """The mesh-device-loss golden: the committed trace replayed through
+    the mesh backend -- where the device events actually reshard -- must
+    reproduce the pinned host digest bit-for-bit."""
+    import json
+
+    from karpenter_tpu.sim.replay import replay
+    from karpenter_tpu.sim.trace import read_trace
+
+    root = os.path.join(os.path.dirname(__file__), "golden", "scenarios")
+    with open(os.path.join(root, "digests.json")) as f:
+        golden = json.load(f)
+    events = read_trace(os.path.join(root, "mesh-device-loss.jsonl"))
+    res = replay(events, backend="mesh", seed=20260803)
+    assert res.digest == golden["mesh-device-loss"]
