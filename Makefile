@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate bench-fleet bench-mpod bench-quality bench-mesh-degrade bench-trend benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos mesh-chaos overload sim-corpus sim-fleet multichip lint typecheck
+.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate bench-fleet bench-mpod bench-quality bench-mesh-degrade bench-convex bench-trend benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos mesh-chaos overload sim-corpus sim-fleet multichip lint typecheck
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -66,6 +66,9 @@ bench-quality:  ## solution-quality stage only (quality observatory: optimality 
 
 bench-mesh-degrade:  ## mesh degrade stage only (fault-tolerance ladder: reshard p50/p99, shrunk power-of-two layout warm-tick delta vs full mesh, quarantine-tick cost, rig caveats in the JSON); one JSON line
 	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --mesh-degrade-only > bench_mesh_degrade_last.json; rc=$$?; cat bench_mesh_degrade_last.json; exit $$rc
+
+bench-convex:  ## convex global-solve tier stage only (solver/convex: convex_tick_p50/p99 vs ffd_tick_p50 at the 10k/50k tiers, gap_after_convex vs gap_after_ffd, iterations to convergence, end-to-end never-worse assertion, rig caveats in the JSON); one JSON line
+	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --convex-only > bench_convex_last.json; rc=$$?; cat bench_convex_last.json; exit $$rc
 
 bench-trend:  ## round-over-round trend table consolidating the BENCH_rNN.json artifacts (one row per driver round: cold/warm/wire/consolidation/fleet/mpod/quality headline fields; crashed rounds render as dashes)
 	$(PY) hack/bench_trend.py

@@ -1572,6 +1572,81 @@ def _quality_stage(pool, items, zones, rng, warm_tick_p50_ms=None,
     return out
 
 
+def _convex_stage(pool, items, zones, rng, iters: int = 10,
+                  platform: str = "cpu") -> dict:
+    """Convex global-solve tier stage (solver/convex tentpole): ALWAYS
+    runs. The tier's cost-and-quality card at the 10k and 50k tiers,
+    measured through the production TPUSolver path:
+
+    - convex_tick_p50/p99 vs ffd_tick_p50: the same warm workload
+      solved by a pure-FFD solver and a tier="convex" solver (relax
+      dispatch + fetch + rounding + the never-worse differential), so
+      the overhead ratio is exactly what opting in costs a tick;
+    - gap_after_convex vs gap_after_ffd: the optimality gap the quality
+      observatory reports under each tier -- the convex lower bound
+      tightens the gap denominator even when FFD's placement wins;
+    - convex_iterations: subgradient iterations to convergence out of
+      the fixed DEFAULT_ITERS budget (solver/convex/relax.py);
+    - never-worse acceptance: the realized fleet price under the convex
+      tier must not exceed the pure-FFD tier's on the same workload
+      (solver/convex/tier.py's choose() differential, asserted here
+      end to end).
+    """
+    from karpenter_tpu.solver.service import TPUSolver
+
+    out: dict = {}
+    for tier_n in sorted({min(N_PODS, 10_000), min(N_PODS, 50_000)}):
+        tag = f"{tier_n // 1000}k"
+        pods = synth_pods(rng, zones, tier_n, salt=97_000 + tier_n)
+        ffd = TPUSolver(g_max=G_MAX)
+        cx = TPUSolver(g_max=G_MAX, tier="convex")
+        ffd.solve(pool, items, pods)  # compile + stage
+        cx.solve(pool, items, pods)
+        ffd_ms, cx_ms = [], []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            ffd.solve(pool, items, pods)
+            ffd_ms.append((time.perf_counter() - t0) * 1e3)
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            cx.solve(pool, items, pods)
+            cx_ms.append((time.perf_counter() - t0) * 1e3)
+        ffd_p50 = float(np.percentile(ffd_ms, 50))
+        cx_p50 = float(np.percentile(cx_ms, 50))
+        out[f"ffd_tick_p50_{tag}_ms"] = round(ffd_p50, 2)
+        out[f"convex_tick_p50_{tag}_ms"] = round(cx_p50, 2)
+        out[f"convex_tick_p99_{tag}_ms"] = round(
+            float(np.percentile(cx_ms, 99)), 2)
+        if ffd_p50 > 0:
+            out[f"convex_tick_overhead_{tag}"] = round(cx_p50 / ffd_p50, 3)
+        q_ffd = dict(ffd.last_quality or {})
+        q_cx = dict(cx.last_quality or {})
+        out[f"gap_after_ffd_{tag}"] = round(
+            float(q_ffd.get("optimality_gap", 0.0)), 4)
+        out[f"gap_after_convex_{tag}"] = round(
+            float(q_cx.get("optimality_gap", 0.0)), 4)
+        lc = dict(cx.last_convex or {})
+        out[f"convex_winner_{tag}"] = lc.get("winner")
+        out[f"convex_iterations_{tag}"] = lc.get("iterations")
+        # never-worse acceptance on choose()'s OWN metric (cheapest
+        # surviving offering per group under the candidate's masks):
+        # the chosen candidate must not price above the FFD candidate.
+        # realized_per_h is NOT comparable across tiers -- it prices
+        # instance_types[0] unconstrained by the group's zone/captype
+        # masks, an estimator that can flip by a fraction of a percent
+        p_ffd_m = lc.get("price_ffd")
+        p_cx_m = lc.get("price_convex")
+        if p_ffd_m is not None:
+            chosen = (p_cx_m if lc.get("winner") == "convex" else p_ffd_m)
+            out[f"convex_price_ffd_{tag}"] = round(float(p_ffd_m), 4)
+            out[f"convex_price_chosen_{tag}"] = round(float(chosen), 4)
+            assert float(chosen) <= float(p_ffd_m) * (1.0 + 1e-9), (
+                f"convex tier chose a candidate pricing ${chosen}/h over "
+                f"FFD's ${p_ffd_m}/h at the {tag} tier: the never-worse "
+                f"differential is broken")
+    return out
+
+
 def _mesh_degrade_stage(pool, items, zones, rng, iters: int = 6,
                         platform: str = "cpu") -> dict:
     """Mesh degrade stage (mesh fault-tolerance tentpole): ALWAYS runs.
@@ -2120,7 +2195,8 @@ def _gen2_collections() -> int:
 def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
         wire_only: bool = False, consolidate_only: bool = False,
         fleet_only: bool = False, mpod_only: bool = False,
-        quality_only: bool = False, mesh_degrade_only: bool = False):
+        quality_only: bool = False, mesh_degrade_only: bool = False,
+        convex_only: bool = False):
     import jax
 
     from karpenter_tpu.apis import NodePool
@@ -2248,6 +2324,24 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
             iters=30 if backend != "cpu" else 12, platform=backend))
         out["value"] = out.get(
             f"quality_gap_{min(N_PODS, 50_000) // 1000}k", 0.0)
+        stage_fields(out)
+        return out
+    if convex_only:
+        # `make bench-convex`: only the convex-tier stage (plus setup)
+        # -- the fast iteration loop for the global-solve tier: tick
+        # cost vs FFD, gap after each tier, iterations to convergence
+        out = {
+            "metric": f"convex_tick_p50_{min(N_PODS, 50_000) // 1000}k_pods",
+            "unit": "ms",
+            "mode": "convex_only",
+            "platform": backend,
+            "rig_caveats": _rig_caveats(backend, G_MAX, 1_024),
+        }
+        out.update(_convex_stage(
+            pool, items, zones, np.random.default_rng(42),
+            iters=10 if backend != "cpu" else 5, platform=backend))
+        out["value"] = out.get(
+            f"convex_tick_p50_{min(N_PODS, 50_000) // 1000}k_ms", 0.0)
         stage_fields(out)
         return out
     if mesh_degrade_only:
@@ -2495,6 +2589,20 @@ def run(profile: bool, progress=lambda ev: None, warm_only: bool = False,
     progress({"ev": "phase", "name": "quality"})
     stage_fields(production)
 
+    # convex-tier stage (global-solve tentpole): ALWAYS runs -- the
+    # convex tick's cost vs FFD at the 10k/50k tiers, the gap under
+    # each tier, iterations to convergence, and the end-to-end
+    # never-worse assertion are headline acceptance data, persisted
+    # via the incremental side-file like every other stage
+    try:
+        production.update(_convex_stage(
+            pool, items, zones, rng,
+            iters=10 if backend != "cpu" else 5, platform=backend))
+    except Exception as e:  # noqa: BLE001
+        production["convex_stage_error"] = f"{type(e).__name__}: {e}"[:200]
+    progress({"ev": "phase", "name": "convex"})
+    stage_fields(production)
+
     # mesh degrade stage (mesh fault-tolerance tentpole): ALWAYS runs --
     # reshard p50/p99, the shrunk-layout warm-tick delta vs the full
     # mesh, and the quarantine-tick cost are headline acceptance data,
@@ -2663,7 +2771,8 @@ def _child_main() -> None:
                   fleet_only="--fleet-only" in sys.argv,
                   mpod_only="--mpod-only" in sys.argv,
                   quality_only="--quality-only" in sys.argv,
-                  mesh_degrade_only="--mesh-degrade-only" in sys.argv)
+                  mesh_degrade_only="--mesh-degrade-only" in sys.argv,
+                  convex_only="--convex-only" in sys.argv)
         progress({"ev": "result", "out": out})
         print(json.dumps(out))
     except Exception as e:  # noqa: BLE001 - parent assembles a partial
@@ -2815,6 +2924,8 @@ def _run_child(force_cpu: bool, profile: bool, budget_s: float, stall_s: float):
         args.append("--quality-only")
     if "--mesh-degrade-only" in sys.argv:
         args.append("--mesh-degrade-only")
+    if "--convex-only" in sys.argv:
+        args.append("--convex-only")
     proc = subprocess.Popen(
         args, stdout=subprocess.DEVNULL, stderr=None, text=True, env=env
     )

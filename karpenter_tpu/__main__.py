@@ -154,6 +154,7 @@ def build_operator(args):
             mesh = parse_mesh_spec(spec) if spec else mesh_from_env()
         solver = TPUSolver(
             auto_warm=client is None, client=client, breaker=breaker, mesh=mesh,
+            tier=getattr(args, "solve_tier", "ffd"),
         )
         # the consolidation engine rides the SAME wire as the scheduling
         # solve: with a sidecar configured, candidate-set sweeps dispatch
@@ -214,6 +215,15 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--tpu-solver", action=argparse.BooleanOptionalAction, default=True,
         help="route scheduling + consolidation decisions through the accelerator",
+    )
+    parser.add_argument(
+        "--solve-tier", choices=("ffd", "convex"), default="ffd",
+        help="solver decision tier: 'convex' runs the device-resident LP "
+        "relaxation + deterministic rounding beside every FFD solve and "
+        "ships whichever decision prices lower (never worse than FFD by "
+        "construction), tightens the optimality-gap bound, and arms the "
+        "global repack oracle in the disruption sweep; 'ffd' (default) "
+        "is the plain fused first-fit-decreasing solve",
     )
     parser.add_argument(
         "--mesh-devices", default=None, metavar="SPEC",

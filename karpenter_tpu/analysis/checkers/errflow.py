@@ -242,6 +242,31 @@ LADDER_SEAMS: Tuple[Seam, ...] = (
              "quarantine worst device, force breaker open) are best-effort "
              "-- a hook failure is counted and the ladder continues; only "
              "the crash rung's async raise leaves this frame"),
+    # -- convex tier: every fault lands on the FFD rung ----------------------
+    # the convex candidate is strictly optional: a dispatch or rounding
+    # fault costs the tick only that candidate, and the decision shipped
+    # is the pure-FFD one, bit-identical to tier="ffd". Both seams catch
+    # broad Exception ON PURPOSE (counted into
+    # karpenter_convex_fallbacks_total + logged); OperatorCrashed is a
+    # BaseException and still propagates through them.
+    Seam("karpenter_tpu/solver/service.py", "TPUSolver", "_dispatch_convex",
+         must_handle=("ConnectionError", "OSError", "TimeoutError",
+                      "RuntimeError", "ValueError"),
+         failpoint="rpc.convex.dispatch",
+         why="convex relax dispatch rides behind the fused FFD solve: a "
+             "dispatch fault (device OOM, trace error, injected transport "
+             "fault) nulls pending.cx and the finish barrier never sees a "
+             "convex candidate -- counted as "
+             "karpenter_convex_fallbacks_total{reason=dispatch}"),
+    Seam("karpenter_tpu/solver/service.py", "TPUSolver", "_finish_convex",
+         must_handle=("ConnectionError", "OSError", "TimeoutError",
+                      "RuntimeError", "ValueError"),
+         failpoint="convex.rounding",
+         why="the rounding rung: a fetch or deterministic-rounding fault "
+             "(incl. the convex.rounding failpoint) yields dense_cx=None "
+             "and choose() returns the FFD decision unchanged -- counted "
+             "as karpenter_convex_fallbacks_total{reason=rounding}; no "
+             "pod placement is ever lost to a convex-tier fault"),
 )
 
 # Handler sites sanctioned to absorb a crash (``OperatorCrashed``) or a
@@ -409,6 +434,9 @@ FAILPOINT_INJECTS: Dict[str, Tuple[str, ...]] = {
                  "OperatorCrashed"),
     "crash.": ("OperatorCrashed",),
     "stall.": ("OperatorCrashed",),
+    # convex-tier sites inject generic compute faults (a poisoned rounding
+    # pass surfaces as RuntimeError/ValueError) plus the crash rung
+    "convex.": ("RuntimeError", "ValueError", "OperatorCrashed"),
     # mesh sites inject bare RuntimeError: the device-loss classifier
     # (fleet/topology.py) matches the site name in the message and the
     # dispatch seam converts it to StaleTopologyError; the stall action

@@ -88,6 +88,9 @@ STATIC_ARG_BUCKETS: Dict[str, str] = {
     "words": "packed-bitset geometry: per-dimension word counts, fixed by "
              "the catalog encoding alongside word_offsets",
     "objective": "closed enum {'price', 'fit'}: two programs total",
+    "iters": "convex-tier iteration budget: fixed per process "
+             "(relax.DEFAULT_ITERS; the repack oracle's budget runs "
+             "host-side) -- one program per budget actually used",
     "od_col": "on-demand column of the closed capacity-type vocabulary "
               "(encode.CAPTYPE_INDEX): one value per process",
 }
@@ -116,6 +119,9 @@ JIT_ENTRY_FUNCTIONS: Dict[str, Tuple[str, ...]] = {
     # solution-quality observatory: the fractional price bound runs on
     # every warm tick right behind the solve (observe-only)
     "karpenter_tpu.solver.bound": ("fractional_price_bound",),
+    # convex global-solve tier: the LP relaxation dispatches behind the
+    # fused FFD solve on every convex-tier tick
+    "karpenter_tpu.solver.convex.relax": ("convex_relax",),
 }
 
 # every Pallas kernel entry must keep a registered XLA twin: the
@@ -168,12 +174,21 @@ DEVICE_HOT_PATH: Dict[str, Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]] =
          "fetch_bound"),
         {},
     ),
+    # convex tier: relax dispatch + fetch run per convex-tier tick right
+    # behind the fused solve; its one designed barrier is fetch_relax
+    # (SANCTIONED below) -- rounding/tier/repack are host-side numpy and
+    # touch no device values
+    "karpenter_tpu/solver/convex/relax.py": (
+        ("convex_relax", "convex_relax_impl", "fetch_relax"),
+        {},
+    ),
     "karpenter_tpu/solver/service.py": (
         (),
         {"TPUSolver": ("solve_begin", "solve_finish", "_finish_remote",
                        "_solve_local_dense", "_pack_existing",
                        "_dispatch_fused", "_dispatch_disrupt_repack",
-                       "_dispatch_bound", "_begin_quality")},
+                       "_dispatch_bound", "_begin_quality",
+                       "_dispatch_convex", "_finish_convex")},
     ),
     # Pallas kernel entries: the wrappers run per tick when selected
     # (TPUSolver(kernels="pallas")), so their prologue/epilogue code is
@@ -191,7 +206,7 @@ DEVICE_HOT_PATH: Dict[str, Tuple[Tuple[str, ...], Dict[str, Tuple[str, ...]]]] =
         {
             "SolverServer": ("_op_solve_delta", "_staged_inputs",
                              "_op_solve", "_op_solve_compact",
-                             "_op_solve_disrupt"),
+                             "_op_solve_disrupt", "_op_solve_convex"),
             "SolverClient": ("begin_solve_compact", "finish_solve_compact"),
         },
     ),
@@ -255,6 +270,10 @@ SANCTIONED_FETCH: Set[Tuple[str, str]] = {
     # the optimality-gap bound's designed barrier: drains the
     # copy_to_host_async issued when solve_finish dispatched the bound
     ("karpenter_tpu/solver/bound.py", "fetch_bound"),
+    # the convex tier's designed barrier: drains the relaxation's async
+    # copies at the finish barrier (in-process) / fetch stage (sidecar)
+    ("karpenter_tpu/solver/convex/relax.py", "fetch_relax"),
+    ("karpenter_tpu/solver/rpc.py", "_op_solve_convex"),
     # observatory introspection seams: memory_stats() reads the
     # allocator ledger (metadata, no transfer) and the profiler bracket
     # drives the runtime's own trace collection -- both are designed

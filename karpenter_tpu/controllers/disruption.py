@@ -92,6 +92,7 @@ class DisruptionController:
         evaluator=None,
         recorder=None,
         brownout=None,
+        repack=None,
     ):
         self.cluster = cluster
         self.cloud_provider = cloud_provider
@@ -107,6 +108,11 @@ class DisruptionController:
         # sets are judged in one dispatch; candidates with stateful
         # constraints fall back to the per-candidate oracle simulation
         self.evaluator = evaluator
+        # optional convex.repack.RepackOracle: fleet-wide regret scoring
+        # proposes candidate sets the local prefix/pair enumerations miss;
+        # proposals only NOMINATE -- stage 6 judges each through the same
+        # simulate/price differential as the controller's own enumerations
+        self.repack = repack
         self.last_decisions: List[Tuple[str, str]] = []  # (claim name, reason)
         # per-sweep stats for the flight recorder (obs/flight.py): sweep
         # mode (full / bounded / shed), wall ms, candidate-set counts by
@@ -678,7 +684,75 @@ class DisruptionController:
                     self._pair_consolidation(
                         remaining, device_verdicts, disrupting, totals,
                         max_disruptions)
+
+        # 6) global repack oracle (convex tier, opt-in): fleet-wide regret
+        #    scoring over the survivors nominates the sets whose members
+        #    sit too far apart in disruption-cost order for the prefix/pair
+        #    enumerations to ever co-select; each nomination passes the
+        #    SAME simulate/price differential before anything is touched
+        if self.repack is not None and len(self.last_decisions) < max_disruptions:
+            survivors = [
+                c
+                for c in consolidatable
+                if c.claim.metadata.name not in [n for n, _ in self.last_decisions]
+                and not c.do_not_disrupt
+                and c.nodepool.disruption.consolidation_policy != CONSOLIDATION_WHEN_EMPTY
+                and self._all_pods_evictable(c.pods)
+            ]
+            self._repack_consolidation(
+                survivors, disrupting, totals, max_disruptions)
         return self.last_decisions
+
+    def _repack_consolidation(
+        self,
+        remaining: List[Candidate],
+        disrupting: Dict[str, int],
+        totals: Dict[str, int],
+        max_disruptions: int,
+    ) -> bool:
+        """Stage 6: judge the repack oracle's nominated candidate sets
+        with the controller's own machinery -- pure deletion when the
+        set's pods fold into the survivors, else ONE cheaper replacement
+        node. The oracle only nominates; the simulate/price differential
+        decides, so a bad proposal costs planning time, never capacity."""
+        if not remaining:
+            return False
+        try:
+            sets = self.repack.propose(
+                remaining, self._pass_pools or [], self._pass_catalogs)
+        except Exception:  # noqa: BLE001 -- an oracle fault costs this
+            # sweep its stage-6 nominations only; the local enumerations
+            # above already ran (OperatorCrashed is BaseException and
+            # still propagates)
+            self.log.warning("repack oracle failed; skipping stage 6")
+            return False
+        acted = False
+        for idx in sets:
+            if len(self.last_decisions) >= max_disruptions:
+                break
+            decided = {n for n, _ in self.last_decisions}
+            sel = [remaining[i] for i in idx]
+            if any(c.claim.metadata.name in decided for c in sel):
+                continue
+            if not self._budget_allows_set(sel, disrupting, totals):
+                continue
+            self._pass_set_counts["repack"] = (
+                self._pass_set_counts.get("repack", 0) + 1)
+            ok, _ = self._simulate(sel, allow_new_node=False)
+            if ok:
+                for c in sel:
+                    c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+                    self._disrupt(c, REASON_UNDERUTILIZED, disrupting)
+                acted = True
+                continue
+            ok, groups = self._simulate(sel, allow_new_node=True)
+            if ok and groups and self._replacement_cheaper(sel, groups):
+                for c in sel:
+                    c.claim.status_conditions.set_true(COND_CONSOLIDATABLE)
+                self._replace_then_disrupt(
+                    sel, groups, REASON_UNDERUTILIZED, disrupting)
+                acted = True
+        return acted
 
     def _multi_node_replacement(
         self,

@@ -674,3 +674,38 @@ TENANT_BREAKER_TRIPS = REGISTRY.counter(
     "Per-tenant breaker trips (K consecutive dispatch failures)",
     labels=("tenant",),
 )
+# convex global-solve tier (solver/convex/): LP relaxation + rounding
+CONVEX_SOLVES = REGISTRY.counter(
+    "karpenter_convex_solves_total",
+    "Scheduling ticks that ran the convex tier, by differential winner "
+    "(convex = the rounded LP placement strictly beat FFD on fleet "
+    "price without leaving more pods behind; ffd = the incumbent kept "
+    "the tick -- a loss, a tie, or a rounding fallback)",
+    labels=("winner",),  # convex | ffd
+)
+CONVEX_FALLBACKS = REGISTRY.counter(
+    "karpenter_convex_fallbacks_total",
+    "Convex-tier ticks that landed on the FFD rung before the "
+    "differential could judge a candidate, by reason (rounding = "
+    "deterministic rounding returned no valid placement; dispatch = "
+    "the relaxation dispatch/fetch failed; wire = the sidecar lacked "
+    "the convex feature or the solve_convex op errored). The tick's "
+    "DECISION is the pure-FFD one, bit-identical",
+    labels=("reason",),  # rounding | dispatch | wire
+)
+CONVEX_ITERATIONS = REGISTRY.gauge(
+    "karpenter_convex_iterations",
+    "Projected-subgradient iterations the last convex solve needed to "
+    "converge (first iteration within rtol of the final objective; the "
+    "schedule always RUNS the full static budget -- this reports how "
+    "much of it the objective needed)",
+)
+CONVEX_TIGHTEN = REGISTRY.gauge(
+    "karpenter_convex_bound_tighten_ratio",
+    "Convex lower bound over the per-class fractional bound "
+    "(solver/bound.py) for the last convex solve. > 1.0 means the "
+    "coupled relaxation tightened the optimality-gap denominator; "
+    "< 1.0 means the fixed-iteration certificate came out looser "
+    "than the closed-form bound on this instance (the gap always "
+    "uses the MAX of the two, so it never loosens either way)",
+)

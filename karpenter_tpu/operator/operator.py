@@ -302,10 +302,18 @@ class Operator:
             self.cluster, self.cloud_provider, recorder=self.recorder,
             journal=self.journal,
         )
+        # convex-tier solvers bring the global repack oracle along: the
+        # disruption sweep's stage 6 judges its fleet-wide nominations
+        # through the same simulate/price differential as stages 1-5
+        repack = None
+        if solver is not None and getattr(solver, "tier", "ffd") == "convex":
+            from karpenter_tpu.solver.convex.repack import RepackOracle
+
+            repack = RepackOracle()
         self.disruption = DisruptionController(
             self.cluster, self.cloud_provider, self.pricing, self.options.feature_gates,
             evaluator=consolidation_evaluator, recorder=self.recorder,
-            brownout=self.brownout,
+            brownout=self.brownout, repack=repack,
         )
         # instance-id field index for interruption lookups, registered
         # exactly when the interruption queue is configured (reference

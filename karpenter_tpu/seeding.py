@@ -17,6 +17,12 @@ from __future__ import annotations
 import random
 from typing import Optional
 
+# seed the convex rounding tie-break stream derives from; set by
+# `apply()`, read through `convex_rng()` so a process that never called
+# `apply()` (unit tests, ad-hoc scripts) still gets a deterministic
+# stream (seed 0) instead of ambient randomness
+_convex_seed: Optional[int] = None
+
 
 def seeded_rng(label: str, seed: int) -> random.Random:
     """A dedicated RNG stream for one consumer of the seed chain. The
@@ -24,6 +30,15 @@ def seeded_rng(label: str, seed: int) -> random.Random:
     must use the SAME label for the same consumer or a recorded run and
     its replay stop sharing one seed chain."""
     return random.Random(f"{label}:{seed}")
+
+
+def convex_rng() -> random.Random:
+    """A fresh RNG for the convex tier's rounding tie-breaks, derived
+    from the applied seed (0 when `apply()` never ran). Fresh per call
+    ON PURPOSE: every rounding pass starts from the stream's origin, so
+    tick N's tie-breaks do not depend on how many ticks preceded it --
+    replay can round any tick in isolation."""
+    return seeded_rng("convex", _convex_seed if _convex_seed is not None else 0)
 
 
 def apply(seed: Optional[int]) -> None:
@@ -36,9 +51,11 @@ def apply(seed: Optional[int]) -> None:
                                             seed_object_uids)
     from karpenter_tpu.failpoints import FAILPOINTS
 
+    global _convex_seed
     seed_object_names(seed)
     seed_intent_tokens(seed)
     seed_object_uids(seed)
+    _convex_seed = seed
     if seed is not None:
         FAILPOINTS.seed = seed
         tracing.TRACER.configure(rng=seeded_rng("tracing", seed).random)
@@ -55,6 +72,7 @@ def snapshot() -> tuple:
         objects._name_rng, objects._token_rng, objects._uid_rng,
         FAILPOINTS.seed,
         tracing.TRACER._rng, tracing.TRACER.enabled, tracing.TRACER.sample,
+        _convex_seed,
     )
 
 
@@ -63,9 +81,12 @@ def restore(token: tuple) -> None:
     from karpenter_tpu.apis import objects
     from karpenter_tpu.failpoints import FAILPOINTS
 
-    name_rng, token_rng, uid_rng, fp_seed, t_rng, t_enabled, t_sample = token
+    global _convex_seed
+    (name_rng, token_rng, uid_rng, fp_seed,
+     t_rng, t_enabled, t_sample, cx_seed) = token
     objects._name_rng = name_rng
     objects._token_rng = token_rng
     objects._uid_rng = uid_rng
     FAILPOINTS.seed = fp_seed
+    _convex_seed = cx_seed
     tracing.TRACER.configure(enabled=t_enabled, sample=t_sample, rng=t_rng)

@@ -175,11 +175,14 @@ def _cmd_corpus(args) -> int:
     new_quality = {}
     report = {}
     new_digests = {}
+    host_kpis_by_name = {}
+    backends_by_path = {}
     rc = 0
     for path in traces:
         name = os.path.splitext(os.path.basename(path))[0]
         events = read_trace(path)
         seed = _trace_seed(events, None)
+        backends_by_path[path] = _trace_backends(events)
         from karpenter_tpu.sim.replay import BACKENDS
 
         res = differential(events, seed=seed,
@@ -204,6 +207,7 @@ def _cmd_corpus(args) -> int:
             entry["golden_digest"] = golden.get(name)
             entry["note"] = "decision digest drifted from golden"
         host_kpis = res.results["host"].kpis if "host" in res.results else {}
+        host_kpis_by_name[name] = host_kpis
         gap_keys = ("optimality_gap_p50", "optimality_gap_final")
         entry["quality"] = {
             k: host_kpis.get(k, 0.0)
@@ -235,7 +239,13 @@ def _cmd_corpus(args) -> int:
     if traces and rc == 0:
         from karpenter_tpu.sim.replay import InvariantViolation, replay
 
-        path = traces[0]
+        # anchor on the first trace NOT restricted to the host backend:
+        # host-only scenarios (e.g. binpack-adversarial-convex) pin that
+        # restriction because their point is a quality comparison, not
+        # cross-backend bit-identity, and forcing the wire-shaped legs
+        # through one would gate on a digest the scenario never promised
+        path = next((p for p in traces
+                     if backends_by_path.get(p) != ("host",)), traces[0])
         name = os.path.splitext(os.path.basename(path))[0]
         events = read_trace(path)
         seed = _trace_seed(events, None)
@@ -313,6 +323,55 @@ def _cmd_corpus(args) -> int:
             lentry = {"ok": False,
                       "note": f"device-loss mesh invariant violation: {e}"}
         report["mesh:mesh-device-loss"] = lentry
+    # convex-tier gate (solver/convex): the adversarial bin-packing
+    # scenario is re-replayed with the convex global-solve tier forced
+    # on. Unlike the bit-identical legs above, convex is ALLOWED to
+    # change decisions -- the gate asserts DOMINANCE instead: fleet
+    # $/pod-hour strictly below the host replay's, final optimality gap
+    # no worse, and byte-determinism via its own digest pinned under
+    # "convex:binpack-adversarial-convex" in digests.json
+    adv = [p for p in traces
+           if os.path.splitext(os.path.basename(p))[0]
+           == "binpack-adversarial-convex"]
+    if adv and rc == 0:
+        from karpenter_tpu.sim.replay import InvariantViolation, replay
+
+        events = read_trace(adv[0])
+        seed = _trace_seed(events, None)
+        hk = host_kpis_by_name.get("binpack-adversarial-convex", {})
+        key = "convex:binpack-adversarial-convex"
+        try:
+            cres = replay(events, backend="convex", seed=seed)
+            centry = {
+                "digest": cres.digest,
+                "cost_per_pod_hour": cres.kpis.get("cost_per_pod_hour"),
+                "host_cost_per_pod_hour": hk.get("cost_per_pod_hour"),
+                "optimality_gap_final": cres.kpis.get("optimality_gap_final"),
+                "host_optimality_gap_final": hk.get("optimality_gap_final"),
+            }
+            wins = (
+                cres.kpis.get("cost_per_pod_hour", float("inf"))
+                < hk.get("cost_per_pod_hour", 0.0)
+                and cres.kpis.get("optimality_gap_final", float("inf"))
+                <= hk.get("optimality_gap_final", 0.0)
+            )
+            centry["ok"] = wins
+            if not wins:
+                rc = 1
+                centry["note"] = ("convex tier failed to dominate the host "
+                                  "replay on the adversarial corpus")
+            new_digests[key] = cres.digest
+            if (wins and not args.update_digests
+                    and golden.get(key) not in (None, cres.digest)):
+                rc = 1
+                centry["ok"] = False
+                centry["golden_digest"] = golden.get(key)
+                centry["note"] = "convex decision digest drifted from golden"
+        except InvariantViolation as e:
+            rc = 1
+            centry = {"ok": False,
+                      "note": f"convex-tier invariant violation: {e}"}
+        report[key] = centry
     if quality_violations:
         # the regression diff is a ready-made artifact: the sim-corpus CI
         # job uploads args.artifacts on failure, so the observed-vs-bound
@@ -417,7 +476,8 @@ def main(argv=None) -> int:
     rep = sub.add_parser("replay", help="replay a trace through the operator stack")
     rep.add_argument("trace")
     rep.add_argument("--backend",
-                     choices=("host", "wire", "pipelined", "delta", "tcp", "mesh"),
+                     choices=("host", "wire", "pipelined", "delta", "tcp",
+                              "mesh", "packed", "convex"),
                      default="host")
     rep.add_argument("--differential", action="store_true",
                      help="replay through host+wire+pipelined and compare")
@@ -432,7 +492,8 @@ def main(argv=None) -> int:
     shr.add_argument("--mode", choices=("differential", "invariant"),
                      default="differential")
     shr.add_argument("--backend",
-                     choices=("host", "wire", "pipelined", "delta", "tcp", "mesh"),
+                     choices=("host", "wire", "pipelined", "delta", "tcp",
+                              "mesh", "packed", "convex"),
                      default="host", help="backend for --mode invariant")
     shr.add_argument("--seed", type=int, default=None)
     shr.add_argument("--max-probes", type=int, default=2_000)

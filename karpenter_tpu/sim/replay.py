@@ -62,8 +62,14 @@ BACKENDS = ("host", "wire", "pipelined")
 #   (solver/packing.py, TPUSolver(packed_masks=True)) -- the corpus gate
 #   replays one scenario through it and fails on any digest divergence
 #   from the committed host golden (packed == full-width, asserted the
-#   way sharded == unsharded is).
-EXTRA_BACKENDS = ("delta", "tcp", "mesh", "packed")
+#   way sharded == unsharded is);
+# - "convex": TPUSolver in-process with the convex global-solve tier
+#   (solver/convex/: LP relaxation + deterministic rounding beside every
+#   FFD solve, never-worse differential at the finish barrier) -- the
+#   corpus gate replays the binpack-adversarial scenario through it and
+#   asserts the convex decisions beat the host golden on fleet $/pod-hour
+#   while staying byte-deterministic across replays.
+EXTRA_BACKENDS = ("delta", "tcp", "mesh", "packed", "convex")
 
 DEFAULT_TICK_SECONDS = 3.0
 MAX_SETTLE_TICKS = 80
@@ -186,6 +192,12 @@ class _Engine:
 
         if self.backend == "host":
             solver = TPUSolver(g_max=64)
+        elif self.backend == "convex":
+            # the convex global-solve tier through the whole in-process
+            # path (solver/convex/): the FFD rung keeps decisions
+            # never-worse, so the corpus gate asserts cost DOMINANCE on
+            # the adversarial scenario rather than digest equality
+            solver = TPUSolver(g_max=64, tier="convex")
         elif self.backend == "packed":
             # bit-packed open/join masks through the whole in-process
             # path (solver/packing.py): digest equality with the host

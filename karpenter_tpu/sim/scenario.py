@@ -345,6 +345,23 @@ def _scenario_binpack_adversarial(seed: int) -> ScenarioBuilder:
     return b
 
 
+def _scenario_binpack_adversarial_convex(seed: int) -> ScenarioBuilder:
+    """Convex-tier family: a pure adversarial bin-packing burst, the
+    input first-fit-decreasing handles WORST (pods just over 1/2 and 1/3
+    of the common node shapes strand near-half of every node). The
+    corpus gate replays this trace through the `convex` backend (LP
+    relaxation + deterministic rounding beside every FFD solve) and
+    asserts cost DOMINANCE over the committed host golden -- convex
+    fleet $/pod-hour strictly below FFD's, optimality gap never worse --
+    plus byte-determinism of the convex decision digest. Host-only in
+    the differential (the point is the two TIERS diverging, not the
+    transports agreeing; the standard trio rides the other scenarios)."""
+    b = ScenarioBuilder("binpack-adversarial-convex", seed)
+    b.binpack_adversarial(t=1.0, n=30)
+    b.backends("host")
+    return b
+
+
 def _scenario_crash_restart(seed: int) -> ScenarioBuilder:
     """Crash-consistency drill: a burst arrives, the operator dies
     mid-launch (open intents + uncommitted instances left behind), a
@@ -469,6 +486,7 @@ STANDARD_SCENARIOS = {
     "interruption-wave": _scenario_interruption_wave,
     "spread-burst": _scenario_spread_burst,
     "binpack-adversarial": _scenario_binpack_adversarial,
+    "binpack-adversarial-convex": _scenario_binpack_adversarial_convex,
     "crash-restart": _scenario_crash_restart,
     "overload-storm": _scenario_overload_storm,
     "multi-cluster-storm": _scenario_multi_cluster_storm,
@@ -480,7 +498,7 @@ STANDARD_SCENARIOS = {
 CORPUS_SCENARIOS = (
     "diurnal-small", "diurnal-consolidation", "ice-storm",
     "interruption-wave", "overload-storm", "multi-cluster-storm",
-    "mesh-device-loss",
+    "mesh-device-loss", "binpack-adversarial-convex",
 )
 DEFAULT_SEED = 20260803
 

@@ -1,0 +1,17 @@
+"""Convex global-solve tier: LP relaxation + deterministic rounding.
+
+The opt-in second solve tier (``TPUSolver(tier="convex")`` /
+``--solve-tier convex``). Four pieces:
+
+- ``relax``    -- the device-resident LP relaxation (in-jit projected
+                  subgradient over the staged tensors) plus its float64
+                  reference oracle and the anytime lower-bound
+                  certificate that tightens ``solver/bound.py``'s gap
+- ``rounding`` -- host-side bit-deterministic rounding to an integral
+                  placement (seeded tie-breaks, None -> FFD rung)
+- ``tier``     -- the never-worse differential selection against FFD
+- ``repack``   -- the background global repack oracle feeding the
+                  disruption controller candidate sets its local
+                  enumerations cannot see
+"""
+from karpenter_tpu.solver.convex import relax, rounding, tier  # noqa: F401

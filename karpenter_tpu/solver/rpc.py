@@ -637,6 +637,7 @@ class SolverServer:
                 features = [
                     "join_allowed", "trace_echo", "solve_delta", "reply_v2",
                     "solve_disrupt", "packed_masks", "topology_epoch",
+                    "convex",
                 ]
                 if self._shm_enabled:
                     features.append("shm")
@@ -645,7 +646,8 @@ class SolverServer:
                 _send_frame(sock, {"ok": True, "features": features})
             elif op == "stage":
                 self._op_stage(sock, header, tensors)
-            elif op in ("solve", "solve_compact", "solve_delta", "solve_disrupt"):
+            elif op in ("solve", "solve_compact", "solve_delta", "solve_disrupt",
+                        "solve_convex"):
                 if self._coalescer is not None:
                     # fleet topology: device dispatches from N tenants
                     # batch into shared windows with deterministic tenant
@@ -682,6 +684,8 @@ class SolverServer:
             self._op_solve_compact(sock, header, tensors, wt)
         elif op == "solve_delta":
             self._op_solve_delta(sock, header, tensors, wt)
+        elif op == "solve_convex":
+            self._op_solve_convex(sock, header, tensors, wt)
         else:
             self._op_solve_disrupt(sock, header, tensors, wt)
 
@@ -1079,6 +1083,110 @@ class SolverServer:
         _send_frame(
             sock, {"ok": True, **wt.echo()},
             [(n, np.atleast_1d(np.asarray(a))) for n, a in zip(names, arrays)],
+        )
+
+    def _op_solve_convex(self, sock, header: dict, t: Dict[str, np.ndarray],
+                         wt: Optional[tracing.WireTrace] = None) -> None:
+        """The convex global-solve op: the sidecar owns the staged tensors
+        both tiers need, so ONE roundtrip runs the dense FFD solve, the
+        LP relaxation (dispatched behind it -- the device overlaps both),
+        the deterministic rounding, and the never-worse differential, and
+        replies with the CHOSEN dense decision plus the certificate
+        (winner, lower bound, iterations) in the header. A rounding
+        failure server-side is the same FFD rung as in-process: the reply
+        is exactly what the solve op would have returned, flagged with
+        fallback=True so the client counts it."""
+        import jax
+
+        from karpenter_tpu.solver.convex import relax as convex_relax
+        from karpenter_tpu.solver.convex import rounding as convex_rounding
+        from karpenter_tpu.solver.convex import tier as convex_tier
+
+        wt = wt or tracing.WireTrace(None)
+        hit = self._staged_inputs(sock, header, t)
+        if hit is None:
+            return
+        entry, inp = hit
+        g_max = int(header["g_max"])
+        iters = int(header.get("iters", convex_relax.DEFAULT_ITERS))
+        objective = str(header.get("objective", "price"))
+        with wt.stage("device", op="solve_convex"):
+            if self._mesh is not None:
+                out = self._mesh.solve_dense(
+                    inp, g_max=g_max,
+                    word_offsets=entry.offsets, words=entry.words,
+                    objective=objective, epoch=entry.tepoch,
+                )
+            else:
+                out = ffd.ffd_solve(
+                    inp, g_max=g_max,
+                    word_offsets=entry.offsets, words=entry.words,
+                    objective=objective,
+                )
+            cx = convex_relax.convex_relax(
+                inp, iters=iters,
+                word_offsets=entry.offsets, words=entry.words,
+            )
+            if wt.ctx is not None:
+                # see _op_solve: sync traced requests so XLA compute lands
+                # in "device", not "fetch"
+                jax.block_until_ready((tuple(out), tuple(cx)))
+        with wt.stage("fetch"):
+            # SANCTIONED_FETCH (jax_discipline): the convex op's host
+            # barrier -- the FFD decision, the relaxation, and the small
+            # catalog tensors rounding needs (the server keeps no host
+            # catalog outside mesh mode)
+            arrays = jax.device_get(tuple(out))
+            x, lower, trace = convex_relax.fetch_relax(cx)
+            feas = np.asarray(cx.feas)
+            cap = np.asarray(inp.cap)
+            price = np.asarray(inp.price)
+            tzone = np.asarray(inp.tzone)
+            tcap = np.asarray(inp.tcap)
+            overhead = np.asarray(inp.node_overhead)
+        names = ffd.SolveOutputs._fields
+        ffd_out = dict(zip(names, (np.asarray(a) for a in arrays)))
+        dense_ffd = (
+            ffd_out["take"], ffd_out["unplaced"], int(ffd_out["n_open"]),
+            ffd_out["gmask"], ffd_out["gzone"], ffd_out["gcap"],
+        )
+        cap_eff = np.maximum(
+            cap.astype(np.float64) - overhead[None, :], 0.0)
+        fallback = False
+        try:
+            dense_cx = convex_rounding.round_arrays(
+                x, feas=feas, cap_eff=cap_eff, price=price,
+                req=t["req"], count=t["count"],
+                azone=t["azone"], acap=t["acap"],
+                tzone=tzone, tcap=tcap, g_max=g_max,
+            )
+        except Exception:  # noqa: BLE001 -- the FFD rung owns the reply;
+            # the error-frame path would cost the client a whole re-solve
+            # for a candidate it is allowed to simply not have
+            # (OperatorCrashed is BaseException and still flies)
+            dense_cx = None
+        fallback = dense_cx is None
+        winner, dense, p_ffd, p_cx = convex_tier.choose(
+            dense_ffd, dense_cx, price)
+        take, unplaced, n_open, gmask, gzone, gcap = dense
+        _send_frame(
+            sock,
+            {
+                "ok": True, "winner": winner, "n_open": int(n_open),
+                "lower": float(lower),
+                "iterations": int(convex_relax.iterations_to_convergence(trace)),
+                "fallback": bool(fallback),
+                "price_ffd": float(p_ffd),
+                "price_convex": (None if not np.isfinite(p_cx) else float(p_cx)),
+                **wt.echo(),
+            },
+            [
+                ("take", np.asarray(take, dtype=np.int32)),
+                ("unplaced", np.asarray(unplaced, dtype=np.int32)),
+                ("gmask", np.asarray(gmask)),
+                ("gzone", np.asarray(gzone)),
+                ("gcap", np.asarray(gcap)),
+            ],
         )
 
     def _op_solve_disrupt(self, sock, header: dict, t: Dict[str, np.ndarray],
@@ -2057,6 +2165,40 @@ class SolverClient:
         )
         resp, out = self._solve_op(header, seqnum, catalog, class_set)
         return self._compact_from_reply(resp, out, g_max)
+
+    def solve_convex(
+        self, seqnum: str, catalog: encode.CatalogTensors, class_set: encode.PodClassSet,
+        g_max: int = 1024, iters: Optional[int] = None, objective: str = "price",
+    ):
+        """The convex tier's wire solve: one synchronous roundtrip through
+        the same stage-if-needed + staging-gap retry ladder as every solve
+        op. Returns (dense decode tuple, info dict) where the dense tuple
+        is the differential WINNER the sidecar chose and info carries the
+        certificate: winner, lower (the LP bound, $/h), iterations,
+        fallback (rounding produced no candidate), price_ffd /
+        price_convex. Callers gate on `\"convex\" in features()` first --
+        an old sidecar answers unknown-op and this raises RuntimeError."""
+        fields = dict(
+            op="solve_convex", seqnum=seqnum, g_max=g_max, objective=objective,
+        )
+        if iters is not None:
+            fields["iters"] = int(iters)
+        header = self._op_header(**fields)
+        resp, out = self._solve_op(header, seqnum, catalog, class_set)
+        dense = (
+            np.asarray(out["take"]), np.asarray(out["unplaced"]),
+            int(resp["n_open"]), np.asarray(out["gmask"]),
+            np.asarray(out["gzone"]), np.asarray(out["gcap"]),
+        )
+        info = {
+            "winner": str(resp.get("winner", "ffd")),
+            "lower": resp.get("lower"),
+            "iterations": int(resp.get("iterations", 0)),
+            "fallback": bool(resp.get("fallback", False)),
+            "price_ffd": resp.get("price_ffd"),
+            "price_convex": resp.get("price_convex"),
+        }
+        return dense, info
 
     # -- batched consolidation (solver/disrupt, the solve_disrupt op) ---------
     def _disrupt_roundtrip(self, header: dict, tensors, seqnum, catalog):

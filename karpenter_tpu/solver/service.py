@@ -35,6 +35,9 @@ from karpenter_tpu.scheduling import Operator, Requirement, Requirements, Resour
 from karpenter_tpu.scheduling import resources as res
 from karpenter_tpu.solver import bound as price_bound
 from karpenter_tpu.solver import encode, ffd, packing
+from karpenter_tpu.solver.convex import relax as convex_relax
+from karpenter_tpu.solver.convex import rounding as convex_rounding
+from karpenter_tpu.solver.convex import tier as convex_tier
 from karpenter_tpu.solver.encode import CatalogTensors
 from karpenter_tpu.solver.oracle import NewNodeGroup, Scheduler, SchedulingResult
 from karpenter_tpu.utils import gc_paused
@@ -113,7 +116,7 @@ class _PendingSolve:
     __slots__ = (
         "done", "pool", "entry", "class_set", "result", "placed_existing",
         "nodepool_usage", "buf", "inp", "nnz_max", "rpc_handle", "barrier",
-        "call_args", "call_kwargs",
+        "call_args", "call_kwargs", "cx",
     )
 
     def __init__(self, done: Optional[SchedulingResult] = None):
@@ -121,6 +124,10 @@ class _PendingSolve:
         self.rpc_handle = None
         self.buf = None
         self.inp = None
+        # convex tier: the in-flight RelaxOutputs (None on the FFD tier,
+        # on dispatch fallback, and on the wire path -- the sidecar runs
+        # the relaxation next to its FFD solve)
+        self.cx = None
 
     @property
     def completed(self) -> bool:
@@ -134,7 +141,7 @@ class TPUSolver:
         self, g_max: int = 1024, c_pad_min: int = 16, client=None,
         objective: str = "price", auto_warm: bool = False, breaker=None,
         incremental: bool = True, mesh=None, kernels: str = "xla",
-        packed_masks: bool = False,
+        packed_masks: bool = False, tier: str = "ffd",
     ):
         # mesh-sharded production solve (karpenter_tpu/fleet/shard.py):
         # with a mesh configured (and no wire client -- the sidecar owns
@@ -244,6 +251,21 @@ class TPUSolver:
             raise ValueError(f"kernels must be 'xla' or 'pallas', got {kernels!r}")
         self.kernels = kernels
         self._pallas_failed: set = set()   # entry names that fell back
+        # convex global-solve tier (solver/convex/): "convex" dispatches
+        # the in-jit LP relaxation NEXT TO the fused FFD solve, rounds it
+        # deterministically at the finish barrier, and takes the rounded
+        # placement only when it strictly beats FFD without leaving more
+        # pods behind (the never-worse differential, solver/convex/tier.py).
+        # Every failure rung -- dispatch error, rounding infeasibility,
+        # sidecar without the feature -- IS the FFD tick, bit-identical.
+        # The relaxation's lower bound tightens the optimality-gap
+        # denominator regardless of who wins the differential.
+        if tier not in ("ffd", "convex"):
+            raise ValueError(f"tier must be 'ffd' or 'convex', got {tier!r}")
+        self.tier = tier
+        # the last convex differential, for the flight recorder / tests:
+        # {"winner", "price_ffd", "price_convex", "lower", "iterations"}
+        self.last_convex: Optional[dict] = None
         # solution-quality observatory (obs/quality.py): the last solve's
         # quality document -- optimality gap (realized fleet price /
         # solver/bound.py fractional bound), waste attribution, price
@@ -534,6 +556,85 @@ class TPUSolver:
         return price_bound.fractional_price_bound(
             inp, placed, word_offsets=offsets, words=words)
 
+    def _dispatch_convex(self, inp, offsets, words):
+        """One LP-relaxation dispatch for the convex tier, issued right
+        behind the fused FFD solve so both stream back together. Returns
+        the in-flight RelaxOutputs (leaves prefetching async), or None on
+        the FFD tier and on any dispatch failure -- the tick then IS the
+        pure-FFD one, bit-identical (the dispatch rung of the convex
+        degrade ladder). Mesh mode dispatches the same jit entry over the
+        sharded staged tensors (GSPMD shards the einsum); a device lost
+        under it surfaces here and takes the same rung."""
+        if self.tier != "convex":
+            return None
+        try:
+            # chaos site: a dispatch fault must cost the tick ONLY the
+            # convex candidate (LADDER_SEAMS in analysis/checkers/errflow.py)
+            failpoints.eval("rpc.convex.dispatch")
+            with tracing.span("dispatch_convex"):
+                out = convex_relax.convex_relax(
+                    inp, iters=convex_relax.DEFAULT_ITERS,
+                    word_offsets=offsets, words=words,
+                )
+                for leaf in (out.x, out.lower, out.trace):
+                    leaf.copy_to_host_async()
+            return out
+        except Exception as e:  # noqa: BLE001 -- counted; the FFD rung
+            # owns the tick (OperatorCrashed is BaseException and flies)
+            metrics.CONVEX_FALLBACKS.inc(reason="dispatch")
+            if self._route_monitor.has_changed("convex_dispatch", type(e).__name__):
+                self.log.warning(
+                    "convex relaxation dispatch failed; tick stays on FFD",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+            return None
+
+    def _finish_convex(self, pending: "_PendingSolve", dense_ffd):
+        """The convex half of the finish barrier: fetch the relaxation
+        (fetch_relax, its SANCTIONED host sync), round deterministically,
+        and judge the never-worse differential against the FFD decision.
+        Returns (chosen dense tuple, convex lower bound or None). Every
+        failure -- fetch, rounding exception, rounding infeasibility --
+        keeps the FFD tuple unchanged; the lower bound still tightens the
+        gap whenever the fetch succeeded."""
+        entry, class_set = pending.entry, pending.class_set
+        try:
+            with tracing.span("convex_fetch"):
+                x, lower, trace = convex_relax.fetch_relax(pending.cx)
+        except Exception as e:  # noqa: BLE001 -- counted; FFD rung
+            metrics.CONVEX_FALLBACKS.inc(reason="dispatch")
+            if self._route_monitor.has_changed("convex_fetch", type(e).__name__):
+                self.log.warning(
+                    "convex relaxation fetch failed; tick stays on FFD",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+            return dense_ffd, None
+        try:
+            with tracing.span("convex_round"):
+                dense_cx = convex_rounding.round_solution(
+                    x, entry.tensors, class_set, g_max=self.g_max)
+        except Exception as e:  # noqa: BLE001 -- counted; FFD rung
+            # (the convex.rounding chaos site raises through here)
+            dense_cx = None
+            if self._route_monitor.has_changed("convex_round", type(e).__name__):
+                self.log.warning(
+                    "convex rounding failed; tick stays on FFD",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                )
+        if dense_cx is None:
+            metrics.CONVEX_FALLBACKS.inc(reason="rounding")
+        winner, dense, p_ffd, p_cx = convex_tier.choose(
+            dense_ffd, dense_cx, entry.tensors.price)
+        iters = convex_relax.iterations_to_convergence(trace)
+        metrics.CONVEX_SOLVES.inc(winner=winner)
+        metrics.CONVEX_ITERATIONS.set(iters)
+        tracing.annotate(convex_winner=winner)
+        self.last_convex = {
+            "winner": winner, "price_ffd": p_ffd, "price_convex": p_cx,
+            "lower": float(lower), "iterations": iters,
+        }
+        return dense, float(lower)
+
     def _begin_quality(self, pending: "_PendingSolve", dense):
         """Dispatch the optimality-gap bound for the decision just
         expanded -- async, so the device computes while the host decodes;
@@ -560,16 +661,28 @@ class TPUSolver:
             metrics.HANDLED_ERRORS.inc(site="solver.quality_dispatch")
             return None
 
-    def _finish_quality(self, result: SchedulingResult, totals) -> None:
+    def _finish_quality(self, result: SchedulingResult, totals,
+                        lb_convex: Optional[float] = None) -> None:
         """The observe-only epilogue of solve_finish: drain the bound's
         async copy (fetch_bound, the SANCTIONED barrier), attribute waste
         from the decode outputs, publish gauges + last_quality for the
-        flight recorder and /debug/quality. Never raises into the tick."""
+        flight recorder and /debug/quality. Never raises into the tick.
+        The convex tier's certified lower bound couples classes through
+        shared capacity so it often tightens the per-class fractional
+        bound, but the fixed-iteration certificate is not pointwise
+        dominant -- the gap denominator takes the MAX of the two (never
+        loosens) and the tighten ratio is published either way."""
         try:
             if totals is not None:
                 bound_h, r_star = price_bound.fetch_bound(totals)
             else:
                 bound_h, r_star = None, None
+            if lb_convex is not None and lb_convex > 0.0:
+                if bound_h is not None and bound_h > 0.0:
+                    metrics.CONVEX_TIGHTEN.set(lb_convex / bound_h)
+                    bound_h = max(bound_h, lb_convex)
+                else:
+                    bound_h, r_star = lb_convex, r_star
             self.last_quality = obs_quality.solve_quality(
                 result, bound_h, r_star)
         except Exception:  # noqa: BLE001 -- quality must never fail a tick
@@ -1768,6 +1881,15 @@ class TPUSolver:
         pending.call_args = call_args
         pending.call_kwargs = call_kwargs
         if wire:
+            # convex tier over the wire: the sidecar runs the relaxation
+            # NEXT TO its FFD solve inside one synchronous solve_convex op
+            # (it owns the staged tensors both need), so the pipelined
+            # compact dispatch is skipped -- rpc_handle stays None and the
+            # barrier issues the op through the same retry ladder. A
+            # sidecar without the feature degrades to the plain wire
+            # ladder there (FFD tick, bit-identical).
+            if self.tier == "convex":
+                return pending
             # async wire dispatch: the solve frame streams to the sidecar
             # now and the reply is claimed at the barrier -- the ~RTT
             # overlaps whatever the caller does between begin and finish
@@ -1823,6 +1945,8 @@ class TPUSolver:
                         epoch=entry.mesh_epoch,
                     )
                     buf.copy_to_host_async()
+                    pending.cx = self._dispatch_convex(
+                        inp, offsets=offsets, words=words)
             except rpc_mod.StaleSeqnumError as e:
                 if (
                     entry.mesh_epoch is not None
@@ -1872,6 +1996,11 @@ class TPUSolver:
                 buf = self._dispatch_fused(
                     inp, nnz_max=nnz_max, offsets=offsets, words=words)
                 buf.copy_to_host_async()
+                # convex tier: the relaxation dispatches right behind the
+                # fused solve -- both results stream back async and the
+                # finish barrier judges the differential host-side
+                pending.cx = self._dispatch_convex(
+                    inp, offsets=offsets, words=words)
             pending.buf = buf
             pending.inp = inp
             pending.nnz_max = nnz_max
@@ -1927,6 +2056,7 @@ class TPUSolver:
             # stay in the SAME tree instead of orphaning a half-trace
             tracing.annotate(fallback="catalog-changed")
             return self.solve(*pending.call_args, **pending.call_kwargs)
+        cx_lower = None
         if self.client is not None and pending.buf is None:
             # the wire path: either a pipelined reply to claim or the
             # synchronous ladder. A breaker-open dispatch set pending.buf
@@ -1934,7 +2064,10 @@ class TPUSolver:
             with tracing.span("wire"):
                 # the echoed server-side stages ("device", "fetch") graft
                 # under this span when the reply carries them (rpc.py)
-                dense = self._finish_remote(pending)
+                if self.tier == "convex":
+                    dense, cx_lower = self._finish_remote_convex(pending)
+                else:
+                    dense = self._finish_remote(pending)
         else:
             if (
                 self.mesh_engine is not None
@@ -1993,6 +2126,11 @@ class TPUSolver:
                             pending.inp, g_max=self.g_max, word_offsets=entry.offsets,
                             words=entry.words, objective=self.objective,
                         )
+        # convex tier: round + judge the differential before decode, so
+        # the decoded groups ARE the chosen placement (the quality bound
+        # below also bills the winner's take rows, keeping gap >= 1)
+        if pending.cx is not None:
+            dense, cx_lower = self._finish_convex(pending, dense)
         # quality observatory: dispatch the bound BEFORE decode so the
         # device computes it while the host decodes; fetch after
         qtotals = self._begin_quality(pending, dense)
@@ -2001,7 +2139,7 @@ class TPUSolver:
                 pending.pool, entry, class_set, dense, pending.nodepool_usage,
                 result=pending.result, class_offset=pending.placed_existing,
             )
-        self._finish_quality(out, qtotals)
+        self._finish_quality(out, qtotals, lb_convex=cx_lower)
         return out
 
     def _finish_remote(self, pending: "_PendingSolve"):
@@ -2032,6 +2170,56 @@ class TPUSolver:
             if self.breaker is not None:
                 self.breaker.record_success()
         return dense
+
+    def _finish_remote_convex(self, pending: "_PendingSolve"):
+        """The convex tier's wire barrier: one synchronous solve_convex
+        op -- the sidecar runs its FFD solve, the relaxation, the
+        deterministic rounding, and the never-worse differential next to
+        its own staged tensors, and replies with the CHOSEN dense
+        decision plus the certificate (winner, lower bound, iterations).
+        Returns (dense, convex lower bound or None). A sidecar without
+        the feature or any wire failure degrades with the fallback
+        counted: feature-missing takes the plain wire ladder, a dead wire
+        takes the in-process dense solve -- an FFD tick either way,
+        bit-identical to the plain path's."""
+        entry, class_set = pending.entry, pending.class_set
+        try:
+            if "convex" not in self.client.features():
+                metrics.CONVEX_FALLBACKS.inc(reason="wire")
+                if self._route_monitor.has_changed("convex_feature", entry.seqnum):
+                    self.log.info(
+                        "sidecar lacks the convex feature; ticks stay on FFD")
+                return self._finish_remote(pending), None
+            with tracing.span("wire_convex"):
+                dense, info = self.client.solve_convex(
+                    entry.seqnum, entry.tensors, class_set,
+                    g_max=self.g_max, objective=self.objective,
+                )
+        except (ConnectionError, OSError, RuntimeError) as e:
+            if self.breaker is not None:
+                self.breaker.record_failure()
+            metrics.CONVEX_FALLBACKS.inc(reason="wire")
+            metrics.SOLVER_PIPELINE_FALLBACKS.inc(reason="rpc-down")
+            tracing.annotate(fallback="rpc-down")
+            if self._route_monitor.has_changed("convex_wire", type(e).__name__):
+                self.log.warning(
+                    "solve_convex wire op failed; solving on in-process "
+                    "host backend",
+                    error=f"{type(e).__name__}: {e}"[:200],
+                    breaker=self.breaker.state if self.breaker is not None else "none",
+                )
+            with tracing.span("device", fallback="rpc-down"):
+                return self._solve_local_dense(pending), None
+        if self.breaker is not None:
+            self.breaker.record_success()
+        metrics.CONVEX_SOLVES.inc(winner=info["winner"])
+        metrics.CONVEX_ITERATIONS.set(int(info["iterations"]))
+        if info.get("fallback"):
+            metrics.CONVEX_FALLBACKS.inc(reason="rounding")
+        tracing.annotate(convex_winner=info["winner"])
+        self.last_convex = dict(info)
+        lower = float(info.get("lower") or 0.0)
+        return dense, (lower if lower > 0.0 else None)
 
     def _solve_local_dense(self, pending: "_PendingSolve"):
         """The CPU fallback's compute: the dense solve on locally staged
