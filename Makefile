@@ -5,7 +5,7 @@
 PY ?= python
 PYTEST ?= $(PY) -m pytest
 
-.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate bench-fleet bench-mpod bench-quality bench-mesh-degrade bench-convex bench-trend benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos mesh-chaos overload sim-corpus sim-fleet multichip lint typecheck
+.PHONY: test deflake benchmark bench-warm bench-wire bench-consolidate bench-fleet bench-mpod bench-quality bench-mesh-degrade bench-convex bench-coldstart bench-trend benchmark-interruption benchmark-consolidation fuzz-extended e2e run docs-check docs verify-entry ci chaos crash-chaos mesh-chaos overload sim-corpus sim-fleet multichip lint typecheck
 
 test:  ## unit + component + differential suites
 	$(PYTEST) tests/ -q
@@ -69,6 +69,9 @@ bench-mesh-degrade:  ## mesh degrade stage only (fault-tolerance ladder: reshard
 
 bench-convex:  ## convex global-solve tier stage only (solver/convex: convex_tick_p50/p99 vs ffd_tick_p50 at the 10k/50k tiers, gap_after_convex vs gap_after_ffd, iterations to convergence, end-to-end never-worse assertion, rig caveats in the JSON); one JSON line
 	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --convex-only > bench_convex_last.json; rc=$$?; cat bench_convex_last.json; exit $$rc
+
+bench-coldstart:  ## coldstart stage only (compile-cache subsystem: first-tick latency in fresh processes cold vs warm persistent-cache vs AOT-serialized executables, restart-to-first-decision, reshard first tick on a precompiled shrunk layout, ladder dispatch overhead vs pure JIT); one JSON line
+	KARPENTER_TPU_JAX_WITNESS=1 $(PY) bench.py --coldstart-only > bench_coldstart_last.json; rc=$$?; cat bench_coldstart_last.json; exit $$rc
 
 bench-trend:  ## round-over-round trend table consolidating the BENCH_rNN.json artifacts (one row per driver round: cold/warm/wire/consolidation/fleet/mpod/quality headline fields; crashed rounds render as dashes)
 	$(PY) hack/bench_trend.py

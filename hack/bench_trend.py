@@ -6,7 +6,8 @@ one-JSON-line output (or null when the round crashed -- r01's rc=1 and
 r05's rc=124 are real rows, not noise, and the table must show them).
 Reading five of those side by side by hand is exactly the drift this
 script removes: it consolidates the headline field of every stage family
-(warm, wire, consolidation, fleet, mpod, quality) into ONE table, one
+(warm, wire, consolidation, fleet, mpod, quality, convex, mesh
+degrade, coldstart) into ONE table, one
 row per round, so a regression reads as a column going the wrong way.
 
 Usage:
@@ -42,6 +43,14 @@ COLUMNS = (
     ("quality_gap", "quality_gap_50k"),
     ("bound_cost_ms", "quality_bound_cost_ms"),
     ("fleet_price_per_h", "fleet_price_per_hour"),
+    ("convex_p50_ms", "convex_tick_p50_50k_ms"),
+    ("gap_ffd", "gap_after_ffd_50k"),
+    ("gap_convex", "gap_after_convex_50k"),
+    ("reshard_p50_ms", "mesh_reshard_p50_ms"),
+    ("quar_tick_ms", "mesh_quarantine_first_tick_ms"),
+    ("cold_tick_ms", "coldstart_cold_first_tick_ms"),
+    ("aot_tick_ms", "coldstart_aot_first_tick_ms"),
+    ("aot_speedup", "coldstart_aot_speedup_vs_cold"),
 )
 
 

@@ -1,0 +1,92 @@
+"""Two-process persistent-compile-cache drill (CI cache-persistence job).
+
+The compile-cache tentpole's restart contract, asserted end to end:
+
+    python hack/cache_drill.py --phase warm   --dir /tmp/cache  # process 1
+    python hack/cache_drill.py --phase verify --dir /tmp/cache  # process 2
+
+Process 1 enables the persistent XLA compilation cache rooted at --dir,
+runs a small production solve (TPUSolver through the real dispatch
+path), and exits 0 once the versioned cache home holds artifacts.
+Process 2 is a FRESH interpreter restarting onto the same root: it runs
+the identical solve and asserts ``karpenter_compile_cache_misses == 0``
+-- every XLA compile in the second process must be served from disk.
+Any miss means the cache key regressed (jaxlib/backend fingerprint, the
+min-entry thresholds, or a nondeterministic lowering) and the operator
+restart story is broken, so the drill exits 1 and CI uploads the cache
+directory for inspection.
+
+Both phases print one JSON line: ``{phase, ok, hits, misses, bytes,
+home, first_solve_ms}``. Workload size is fixed and deterministic
+(same rng seed + salt both phases) so the two processes lower exactly
+the same programs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+N_PODS = int(os.environ.get("CACHE_DRILL_PODS", "800"))
+
+
+def run_phase(phase: str, root: str) -> int:
+    import numpy as np
+
+    from karpenter_tpu.obs import jitstats
+    from karpenter_tpu.utils import enable_jax_compilation_cache
+
+    home = enable_jax_compilation_cache(root)
+    out = {"phase": phase, "ok": True, "home": home}
+    if not home:
+        out.update(ok=False, error="compilation cache did not enable")
+        print(json.dumps(out))
+        return 1
+
+    from bench import build_catalog_items, synth_pods
+    from karpenter_tpu.apis import NodePool
+    from karpenter_tpu.solver.service import TPUSolver
+
+    items, cloud = build_catalog_items()
+    zones = [z.name for z in cloud.describe_zones()]
+    pods = synth_pods(np.random.default_rng(7), zones, N_PODS,
+                      salt=7, templates=12)
+    solver = TPUSolver(g_max=64)
+    t0 = time.perf_counter()
+    solver.solve(NodePool("default"), items, pods)
+    out["first_solve_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+
+    cs = jitstats.cache_stats()
+    out.update(hits=int(cs["hits"]), misses=int(cs["misses"]),
+               bytes=int(jitstats.update_cache_bytes(home)))
+    if phase == "warm":
+        # the warm pass must have WRITTEN something for verify to read
+        if out["bytes"] <= 0:
+            out.update(ok=False, error="warm pass left an empty cache")
+    else:
+        # the restart contract: zero compiles reach XLA's backend
+        if out["misses"] != 0:
+            out.update(ok=False,
+                       error=f"{out['misses']} cache miss(es) on restart")
+        elif out["hits"] <= 0:
+            out.update(ok=False, error="no cache hits recorded on restart")
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--phase", choices=("warm", "verify"), required=True)
+    p.add_argument("--dir", required=True,
+                   help="compile-cache root shared by both phases")
+    args = p.parse_args(argv)
+    return run_phase(args.phase, args.dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,7 +84,7 @@ def build_operator(args):
         from karpenter_tpu.solver.consolidate import ConsolidationEvaluator
         from karpenter_tpu.solver.service import TPUSolver
 
-        enable_jax_compilation_cache()
+        cache_home = enable_jax_compilation_cache()
         # sidecar topology (deploy/controller.yaml): the solver process
         # owns the chip; this process ships tensors over its UNIX socket
         import os as _os
@@ -156,6 +156,14 @@ def build_operator(args):
             auto_warm=client is None, client=client, breaker=breaker, mesh=mesh,
             tier=getattr(args, "solve_tier", "ffd"),
         )
+        # AOT compile-cache subsystem (solver/aot.py): load serialized
+        # executables now (the restart path's compile-free first tick)
+        # and arm the background warmup ladder for every staged catalog.
+        # In-process backends only (a sidecar owns its own AOT);
+        # KARPENTER_TPU_AOT=0 opts out.
+        if client is None and _os.environ.get("KARPENTER_TPU_AOT", "1") != "0":
+            solver.enable_aot(
+                _os.path.join(cache_home, "exec") if cache_home else None)
         # the consolidation engine rides the SAME wire as the scheduling
         # solve: with a sidecar configured, candidate-set sweeps dispatch
         # as the solve_disrupt op against the catalogs already staged per
@@ -386,6 +394,9 @@ def main(argv=None) -> int:
         if hasattr(op.solver, "describe_wire"):
             # /debug/solver: incremental-tick engine + staging LRU state
             health.solver_info = op.solver.describe_wire
+        if hasattr(op.solver, "describe_aot"):
+            # /debug/aot: AOT armed-executable coverage + warmup ladder
+            health.aot_info = op.solver.describe_aot
         # /debug/journal: the crash-consistency intent journal (open
         # write-ahead records + the recently-resolved ring)
         health.journal_info = op.journal.describe

@@ -158,6 +158,11 @@ class _State:
     # probe can read them lock-free.
     traces_total: int = 0
     trace_secs_total: float = 0.0
+    # AOT warmup-ladder compiles (solver/aot.py): accounted separately so
+    # background precompilation never inflates the hot-path totals the
+    # bench persists and the tests assert against
+    aot_compiles_total: int = 0
+    aot_compile_secs_total: float = 0.0
     compile_breakdown: Dict[str, List[float]] = field(default_factory=dict)
     originals: Dict[str, Any] = field(default_factory=dict)
     array_type: Any = None
@@ -212,15 +217,29 @@ def _on_compile_duration(name: str, secs: float, **kw: Any) -> None:
     if not name.startswith(_COMPILE_PREFIX) or not _state.installed:
         return
     phase = name[len(_COMPILE_PREFIX):]
-    if phase == _TRACE_PHASE:
+    # AOT warmup-ladder exemption (solver/aot.py): the ladder compiles
+    # CONCURRENTLY with production hot sections by design, so a compile
+    # on an aot_phase()-marked thread is attributed to the "aot:" phase
+    # bucket and the aot totals -- never the hot-path trace counters
+    # (obs/jitstats reads _tls deltas for per-dispatch attribution) and
+    # never the retrace witness. Thread-local: a retrace on any OTHER
+    # thread during the same window is still a recorded violation.
+    in_aot = getattr(_tls, "aot_depth", 0) > 0
+    if phase == _TRACE_PHASE and not in_aot:
         # outside the guard: thread-local, no contention by definition
         _tls.traces = getattr(_tls, "traces", 0) + 1
         _tls.trace_secs = getattr(_tls, "trace_secs", 0.0) + secs
     hit: Optional[Retrace] = None
     with _state.guard:
-        cell = _state.compile_breakdown.setdefault(phase, [0, 0.0])
+        cell = _state.compile_breakdown.setdefault(
+            ("aot:" + phase) if in_aot else phase, [0, 0.0])
         cell[0] += 1
         cell[1] += secs
+        if in_aot:
+            if phase == _TRACE_PHASE:
+                _state.aot_compiles_total += 1
+                _state.aot_compile_secs_total += secs
+            return
         if phase == _BACKEND_PHASE:
             _state.compiles_total += 1
             _state.compile_secs_total += secs
@@ -358,6 +377,28 @@ def hot(label: str = "hot") -> _HotSection:
     return _HotSection(label)
 
 
+class _AotPhase:
+    """Thread-scoped AOT-compile marker (see _on_compile_duration): the
+    warmup ladder wraps each precompile so its traces account under the
+    "aot:" breakdown and never trip a concurrent hot section's retrace
+    witness. Deliberately NOT process-wide -- only the marked thread is
+    exempt, so a real retrace on the tick thread still records."""
+
+    def __enter__(self) -> "_AotPhase":
+        _tls.aot_depth = getattr(_tls, "aot_depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        _tls.aot_depth = getattr(_tls, "aot_depth", 1) - 1
+        return False
+
+
+def aot_phase() -> _AotPhase:
+    """Mark the CALLING THREAD as running AOT precompilation until exit
+    (re-entrant). Used by the solver/aot.py warmup ladder."""
+    return _AotPhase()
+
+
 def reset() -> None:
     """Drop accumulated events (a fresh witness epoch; patches stay)."""
     with _state.guard:
@@ -368,6 +409,8 @@ def reset() -> None:
         _state.compile_secs_total = 0.0
         _state.traces_total = 0
         _state.trace_secs_total = 0.0
+        _state.aot_compiles_total = 0
+        _state.aot_compile_secs_total = 0.0
         _state.sanctioned_fetches = 0
         _state.cold_unsanctioned = 0
 
@@ -404,6 +447,8 @@ def stats() -> Dict[str, Any]:
             "compile_secs_total": round(_state.compile_secs_total, 4),
             "traces_total": _state.traces_total,
             "trace_secs_total": round(_state.trace_secs_total, 4),
+            "aot_compiles_total": _state.aot_compiles_total,
+            "aot_compile_secs_total": round(_state.aot_compile_secs_total, 4),
             "compile_breakdown": {
                 phase: {"count": int(c), "secs": round(s, 4)}
                 for phase, (c, s) in sorted(_state.compile_breakdown.items())

@@ -250,6 +250,42 @@ class TopologyTracker:
             )
         return None
 
+    def shrunk_meshes(self) -> Tuple[Mesh, ...]:
+        """Every DETERMINISTIC shrunk layout the degrade ladder can build
+        (largest first), independent of which devices are currently
+        healthy: pow2 row prefixes for a 2D mesh, then pow2 flat device
+        prefixes down to 2. These are exactly the meshes
+        _build_mesh_locked produces when the HIGHEST-indexed devices go
+        (the quarantine rung removes highest-index first), so the AOT
+        warmup ladder (solver/aot.py) can precompile their sharded
+        programs BEFORE any device is lost -- a reshard then lands on a
+        warm module jit cache (Mesh equality is by devices+axis names)."""
+        with self._lock:
+            devices, shape, names = self._devices, self._shape, self._axis_names
+        out = []
+        if len(shape) == 2:
+            n_hosts, per_host = shape
+            n_rows = _pow2_floor(n_hosts - 1) if n_hosts > 1 else 0
+            while n_rows >= 2:
+                grid = np.array(
+                    [
+                        [devices[r * per_host + c] for c in range(per_host)]
+                        for r in range(n_rows)
+                    ]
+                )
+                out.append(Mesh(grid, axis_names=names))
+                n_rows //= 2
+        n_flat = _pow2_floor(len(devices) - 1) if len(devices) > 1 else 0
+        while n_flat >= 2:
+            out.append(
+                Mesh(
+                    np.array(devices[:n_flat]),
+                    axis_names=(mesh_mod.TYPES_AXIS,),
+                )
+            )
+            n_flat //= 2
+        return tuple(out)
+
     def mode(self) -> str:
         """Which ladder rung the current layout is: "full" | "shrunk" |
         "unsharded"."""

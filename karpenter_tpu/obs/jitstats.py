@@ -77,12 +77,49 @@ JIT_COMPILE_SECS = metrics.REGISTRY.counter(
     "persistent compilation cache misses)",
     labels=("entry",),
 )
+# AOT precompiles (solver/aot.py) attribute to their own counters --
+# phase="aot" in spirit: warmup-ladder compiles must never pollute the
+# hot-path per-entry compile counters above, whose zeros the bench and
+# the zero-retrace tests assert
+JIT_AOT_COMPILES = metrics.REGISTRY.counter(
+    "karpenter_jit_entry_aot_compiles_total",
+    "Warmup-ladder AOT precompiles per jit entry family (solver/aot.py; "
+    "kept apart from karpenter_jit_entry_compiles_total so background "
+    "precompilation never reads as hot-path compile cost)",
+    labels=("entry",),
+)
+JIT_AOT_COMPILE_SECS = metrics.REGISTRY.counter(
+    "karpenter_jit_entry_aot_compile_seconds_total",
+    "Cumulative wall seconds the AOT warmup ladder spent precompiling "
+    "each entry family (lower+compile, off the tick thread)",
+    labels=("entry",),
+)
+COMPILE_CACHE_HITS = metrics.REGISTRY.counter(
+    "karpenter_compile_cache_hits_total",
+    "Persistent XLA compilation-cache hits (the backend binary came "
+    "from disk; only the trace/lower phases ran)",
+)
+COMPILE_CACHE_MISSES = metrics.REGISTRY.counter(
+    "karpenter_compile_cache_misses_total",
+    "Persistent XLA compilation-cache misses (a full backend compile "
+    "ran and its artifact was written). The CI cache-persistence drill "
+    "asserts this stays 0 in a second process over a warm cache",
+)
+COMPILE_CACHE_BYTES = metrics.REGISTRY.gauge(
+    "karpenter_compile_cache_bytes",
+    "On-disk size of the persistent compile cache's versioned directory "
+    "(XLA entries + serialized AOT executables), for the cache-sizing "
+    "runbook in docs/operations.md",
+)
 
 _lock = threading.Lock()
 # entry -> [dispatches, dispatch_secs, compiles, compile_secs]
 _table: Dict[str, list] = {}
+# entry family -> [aot compiles, aot compile secs] (the warmup ladder)
+_aot_table: Dict[str, list] = {}
 # modname -> {fn_name: original}; non-empty = installed
 _originals: Dict[str, Dict[str, Any]] = {}
+_cache_listener_installed = False
 
 
 def _probe(entry: str, fn):
@@ -154,6 +191,78 @@ def install() -> int:
     return installed
 
 
+def original(modname: str, fn_name: str):
+    """The pre-probe jitted function for an installed entry, or None --
+    the AOT plan builder (solver/aot.py) lowers through THIS (the probe
+    wrapper has no .lower()); transparent when probes are absent."""
+    return _originals.get(modname, {}).get(fn_name)
+
+
+def note_aot(entry: str, secs: float) -> None:
+    """Attribute one warmup-ladder precompile to `entry`'s AOT row --
+    the phase=\"aot\" seam: solver/aot.py calls this per ladder task so
+    precompiles show up in table() without touching the hot-path
+    compile counters."""
+    with _lock:
+        row = _aot_table.setdefault(entry, [0, 0.0])
+        row[0] += 1
+        row[1] += secs
+    JIT_AOT_COMPILES.inc(entry=entry)
+    JIT_AOT_COMPILE_SECS.inc(secs, entry=entry)
+
+
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+
+def _on_cache_event(event: str, **kw: Any) -> None:
+    if event == _CACHE_HIT_EVENT:
+        COMPILE_CACHE_HITS.inc()
+    elif event == _CACHE_MISS_EVENT:
+        COMPILE_CACHE_MISSES.inc()
+
+
+def install_cache_listener() -> None:
+    """Register the persistent-compilation-cache hit/miss listener
+    (plain jax.monitoring events, fired by jax's cache layer on every
+    backend-compile lookup). Idempotent; jax.monitoring has no
+    unregister, so reinstall is a no-op rather than a double-count."""
+    global _cache_listener_installed
+    if _cache_listener_installed:
+        return
+    import jax
+
+    jax.monitoring.register_event_listener(_on_cache_event)
+    _cache_listener_installed = True
+
+
+def update_cache_bytes(path: str) -> int:
+    """Walk the versioned cache directory and publish its size (jax
+    emits no bytes event, so the gauge is a dir scan -- called at
+    startup and by /debug/aot scrapes, never per tick)."""
+    import os
+
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(path):
+        for name in filenames:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, name))
+            except OSError:
+                continue
+    COMPILE_CACHE_BYTES.set(float(total))
+    return total
+
+
+def cache_stats() -> Dict[str, float]:
+    """{hits, misses, bytes} snapshot of the persistent-cache counters
+    (bench coldstart stage + the CI cache-persistence drill)."""
+    return {
+        "hits": COMPILE_CACHE_HITS.value(),
+        "misses": COMPILE_CACHE_MISSES.value(),
+        "bytes": COMPILE_CACHE_BYTES.value(),
+    }
+
+
 def uninstall() -> None:
     import sys
 
@@ -173,6 +282,7 @@ def installed() -> bool:
 def reset() -> None:
     with _lock:
         _table.clear()
+        _aot_table.clear()
 
 
 def table() -> Dict[str, Dict[str, Any]]:
@@ -182,7 +292,8 @@ def table() -> Dict[str, Dict[str, Any]]:
     dispatch cost ({} while probes are not installed)."""
     with _lock:
         rows = {k: list(v) for k, v in _table.items()}
-    if not rows and not _originals:
+        aot_rows = {k: list(v) for k, v in _aot_table.items()}
+    if not rows and not aot_rows and not _originals:
         return {}
     sizes = jax_witness.entry_cache_sizes()
     out: Dict[str, Dict[str, Any]] = {}
@@ -195,6 +306,13 @@ def table() -> Dict[str, Dict[str, Any]]:
         }
         if entry in sizes:
             out[entry]["cache_size"] = sizes[entry]
+    # the warmup ladder's precompiles ride along under their own columns
+    # (phase="aot"): visible per family, never mixed into "compiles"
+    for entry, (n, secs) in sorted(aot_rows.items()):
+        row = out.setdefault(entry, {"dispatches": 0, "dispatch_ms": 0.0,
+                                     "compiles": 0, "compile_ms": 0.0})
+        row["aot_compiles"] = n
+        row["aot_compile_ms"] = round(secs * 1e3, 3)
     # entries registered but never dispatched still show their cache
     # size: "this program exists and is resident" is attribution too
     for entry, size in sorted(sizes.items()):

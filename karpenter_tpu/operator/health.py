@@ -83,6 +83,11 @@ DEBUG_ENDPOINTS = {
         "(realized fleet price / fractional bound), waste attribution "
         "(stranded CPU/mem, fragmentation index), price by pool and "
         "capacity type (karpenter_tpu/obs/quality.py)"),
+    "/debug/aot": (
+        "compile-cache subsystem: cache fingerprint + exec store, "
+        "armed-executable coverage per jit entry, warmup-ladder "
+        "progress and duty cycle, deserialize/dispatch fallback "
+        "counts (karpenter_tpu/solver/aot.py)"),
 }
 
 
@@ -117,6 +122,12 @@ class HealthServer:
         # /debug/overload, loopback-only -- the overload runbook's first
         # stop during a storm (docs/operations.md).
         self.overload_info = None
+        # optional () -> dict with the AOT compile-cache state (TPUSolver
+        # .describe_aot: fingerprint, armed coverage per entry, ladder
+        # progress, fallback counts). Served by /debug/aot,
+        # loopback-only -- the cold-start runbook's first stop when a
+        # restart recompiles (docs/operations.md).
+        self.aot_info = None
         # whether the run loop actually brackets ticks with the profiler
         # (Options.observatory): with the observatory off, an armed
         # capture would wait forever, so /debug/profile must report
@@ -322,6 +333,11 @@ class HealthServer:
                     # deadline/admission bounds, brownout ladder state,
                     # watchdog escalation counts
                     self._debug_json(outer.overload_info)
+                elif self.path == "/debug/aot":
+                    # compile-cache subsystem (solver/aot.py): armed
+                    # executable coverage per entry, exec store stats,
+                    # warmup-ladder state, fallback counts
+                    self._debug_json(outer.aot_info)
                 elif self.path == "/debug/journal":
                     # crash-consistency intent journal (karpenter_tpu/
                     # journal.py): open write-ahead intents + the

@@ -1,0 +1,907 @@
+"""Zero-compile cold starts: the AOT compile-cache subsystem.
+
+Every prior round optimized the WARM tick; this module attacks the cold
+one -- operator restart, breaker re-promotion, a shrunk-mesh reshard, a
+fresh sidecar -- where a full trace+compile storm lands at exactly the
+moment latency matters most. Three layers, each differential-gated
+bit-identical to the JIT path it shadows (AOT never changes a decision,
+only who compiles it and when):
+
+1. **Persistent compilation cache** (``prepare_cache``): JAX's on-disk
+   cache, rooted at ``$KARPENTER_TPU_COMPILE_CACHE`` (default under the
+   home state dir), VERSIONED by a jaxlib/backend/topology fingerprint
+   and swept of stale sibling versions at server start -- the same
+   discipline as the shm segment sweep (solver/shm.cleanup_stale).
+   Hit/miss accounting threads through the jit cost table
+   (obs/jitstats.install_cache_listener).
+
+2. **Exhaustive AOT precompilation** (``AotManager`` + the warmup
+   ladder): the compile space is FINITE -- ``JIT_ENTRY_FUNCTIONS`` x
+   ``STATIC_ARG_BUCKETS`` is a machine-checked manifest, catalog
+   geometry pins the shapes, and the round-22 degrade ladder's shrunk
+   layouts are deterministic pow2 prefixes -- so a background ladder
+   ``.lower().compile()``s all of it, ordered by criticality (the
+   production hot shapes first, degrade-ladder mesh layouts before any
+   device is lost, rare buckets last) and duty-cycle rate-limited so
+   warmup never steals the tick (the observatory's <1% overhead
+   contract, measured by the bench coldstart stage). Compiles run under
+   ``jax_witness.aot_phase()`` so a concurrent hot section never
+   records them as retraces, and are attributed to the per-entry AOT
+   counters in obs/jitstats (never the hot-path compile counters).
+   Coverage is published per entry (``karpenter_aot_precompiled_fraction``)
+   and the whole armed state serves on ``/debug/aot``.
+
+3. **Executable serialization** (``ExecStore``): single-device compiled
+   executables serialize (jax.experimental.serialize_executable) into
+   ``<cache>/<fingerprint>/exec/<key>.aotx`` artifacts that a restarted
+   operator or recovering sidecar LOADS instead of recompiling -- the
+   PR-6 recovery sweep's first tick dispatches a deserialized
+   executable, compile-free. Any deserialize or dispatch failure is a
+   counted, typed rung (``karpenter_aot_fallbacks_total``) that falls
+   back to the ordinary JIT path -- the repo's ladder discipline:
+   decisions never change, only who computes them.
+
+Sharded (mesh) programs are NOT serialized: a deserialized executable
+is pinned to a device assembly, and the persistent compilation cache
+already covers their backend compiles across processes. Instead the
+ladder warm-calls the engine's entries -- for the CURRENT layout and
+for every deterministic shrunk layout (topology.shrunk_meshes) -- into
+the module-level jit caches, so a reshard lands on a warm program.
+
+Import stays jax-free (metrics generation imports this module); all
+jax work happens inside functions.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import re
+import shutil
+import threading
+import time
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+from karpenter_tpu import metrics
+from karpenter_tpu.logging import get_logger
+
+log = get_logger("aot")
+
+# operator-facing knobs
+CACHE_ENV = "KARPENTER_TPU_COMPILE_CACHE"   # cache root (versioned under it)
+AOT_ENV = "KARPENTER_TPU_AOT"               # "0" disables the AOT layers
+DUTY_ENV = "KARPENTER_TPU_AOT_DUTY"         # ladder duty cycle (0..1]
+
+ARTIFACT_SUFFIX = ".aotx"
+_ARTIFACT_VERSION = 1
+# ladder sleeps are capped so one pathological compile cannot park the
+# ladder for minutes between tasks
+_MAX_THROTTLE_SLEEP_S = 30.0
+
+AOT_PRECOMPILED_FRACTION = metrics.REGISTRY.gauge(
+    "karpenter_aot_precompiled_fraction",
+    "Fraction of the enumerated AOT plan compiled and armed, per jit "
+    "entry family (1.0 = every planned static/shape bucket of this "
+    "entry is compile-free); /debug/aot carries the full breakdown",
+    labels=("entry",),
+)
+AOT_DISPATCHES = metrics.REGISTRY.counter(
+    "karpenter_aot_dispatches_total",
+    "Solve dispatches served by an armed AOT executable instead of the "
+    "jit path (bit-identical by the AOT differential; the cold-start "
+    "latency win is measured by the bench coldstart stage)",
+    labels=("entry",),
+)
+AOT_FALLBACKS = metrics.REGISTRY.counter(
+    "karpenter_aot_fallbacks_total",
+    "AOT degrade-ladder rungs taken, by reason: deserialize (corrupt/"
+    "stale artifact -> JIT), dispatch (armed executable rejected the "
+    "call -> disarmed + JIT), compile (a ladder task failed -> skipped), "
+    "serialize (artifact write failed -> in-memory only). Every rung "
+    "leaves the tick on the proven jit path",
+    labels=("reason",),
+)
+AOT_SERIALIZED = metrics.REGISTRY.counter(
+    "karpenter_aot_serialized_total",
+    "Compiled executables serialized into the exec store, per entry -- "
+    "what a restarted operator can load instead of recompiling",
+    labels=("entry",),
+)
+AOT_LOADED = metrics.REGISTRY.counter(
+    "karpenter_aot_loaded_total",
+    "Serialized executables deserialized and armed at startup, per "
+    "entry (the restart path's compile-free budget)",
+    labels=("entry",),
+)
+AOT_SWEPT_DIRS = metrics.REGISTRY.counter(
+    "karpenter_aot_swept_dirs_total",
+    "Stale fingerprint-versioned cache directories removed at server "
+    "start (a jaxlib/backend/topology change invalidates executables "
+    "wholesale -- the shm stale-segment sweep, for compile artifacts)",
+)
+
+
+class AotDeserializeError(RuntimeError):
+    """A cache artifact failed validation or deserialization; the
+    caller's counted rung falls back to JIT. ``corrupt=True`` marks
+    format-level damage (truncated pickle, bad version/fingerprint)
+    that would re-fail every restart -- the loader unlinks those;
+    backend deserialize errors can be process-state-dependent (the CPU
+    runtime refuses to re-load an executable already loaded in this
+    process), so the artifact is kept for the next fresh process."""
+
+    def __init__(self, msg: str, corrupt: bool = True):
+        super().__init__(msg)
+        self.corrupt = corrupt
+
+
+# -- cache layout ----------------------------------------------------------
+
+def fingerprint() -> str:
+    """The cache version key: executables (and XLA cache entries) are
+    valid only for one (jax, jaxlib, backend, device topology) tuple --
+    any element changing invalidates them wholesale."""
+    import jax
+    import jaxlib
+
+    devs = jax.devices()
+    kind = devs[0].device_kind if devs else "none"
+    raw = (
+        f"jax{jax.__version__}-jaxlib{jaxlib.__version__}"
+        f"-{jax.default_backend()}-{len(devs)}x{kind}"
+    )
+    return re.sub(r"[^A-Za-z0-9._-]", "_", raw)
+
+
+def default_root() -> str:
+    return os.path.join(os.path.expanduser("~"), ".cache", "karpenter-tpu", "jax")
+
+
+def resolve_root(cache_dir: str = "") -> str:
+    """Cache-root resolution: explicit arg > $KARPENTER_TPU_COMPILE_CACHE
+    > $JAX_COMPILATION_CACHE_DIR (the standard jax mechanism) > the home
+    state-dir default."""
+    return (
+        cache_dir
+        or os.environ.get(CACHE_ENV)
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+        or default_root()
+    )
+
+
+def sweep_stale(root: str, keep: str) -> int:
+    """Remove every versioned sibling directory except `keep` -- run at
+    server start like the shm segment sweep. Only directories go (a
+    pre-versioning flat cache left loose files at the root; they are
+    inert and harmless). Returns the number of directories removed."""
+    removed = 0
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return 0
+    for name in names:
+        path = os.path.join(root, name)
+        if name != keep and os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+            AOT_SWEPT_DIRS.inc()
+            removed += 1
+    if removed:
+        log.info("swept stale compile-cache versions", removed=removed, keep=keep)
+    return removed
+
+
+def prepare_cache(cache_dir: str = "") -> Optional[str]:
+    """Build the versioned cache layout and return its directory:
+
+        <root>/<fingerprint>/xla    -- jax's persistent compilation cache
+        <root>/<fingerprint>/exec   -- serialized executables (ExecStore)
+
+    Stale fingerprint siblings are swept. Returns None when the root is
+    unwritable -- a cache optimization must never abort startup."""
+    root = resolve_root(cache_dir)
+    fp = fingerprint()
+    home = os.path.join(root, fp)
+    try:
+        os.makedirs(os.path.join(home, "xla"), exist_ok=True)
+        os.makedirs(os.path.join(home, "exec"), exist_ok=True)
+    except OSError as e:
+        log.warning("compile cache disabled", path=home, error=str(e))
+        return None
+    sweep_stale(root, fp)
+    return home
+
+
+# -- keys ------------------------------------------------------------------
+
+def _aval_sig(tree: Any) -> str:
+    """Shape/dtype signature of an argument tree -- with the entry name
+    and statics, this pins exactly one compiled program (jit's own cache
+    key is statics + input avals)."""
+    import jax
+
+    parts = []
+    for x in jax.tree_util.tree_leaves(tree):
+        shape = tuple(getattr(x, "shape", ()))
+        dtype = str(getattr(x, "dtype", type(x).__name__))
+        parts.append(f"{shape}:{dtype}")
+    return ";".join(parts)
+
+
+def exec_key(entry: str, statics: Dict[str, Any], args: Tuple, fp: str) -> str:
+    """The armed-table / artifact key: one per (entry, static bucket,
+    input aval signature, cache fingerprint). Computed identically at
+    plan-build time and at the dispatch seam, so a lookup hit implies
+    the armed executable accepts exactly these inputs."""
+    statics_repr = repr(sorted(statics.items()))
+    raw = f"{_ARTIFACT_VERSION}|{fp}|{entry}|{statics_repr}|{_aval_sig(args)}"
+    return hashlib.sha256(raw.encode()).hexdigest()[:32]
+
+
+# -- executable store ------------------------------------------------------
+
+class ExecStore:
+    """Serialized-executable artifacts under <cache>/<fp>/exec.
+
+    One pickle per key, written atomically (tmp + rename, the artifact
+    discipline every bench side-file uses), validated on load: version,
+    fingerprint, and payload deserialization all gate -- any failure is
+    an AotDeserializeError the manager counts and survives."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def artifact(self, key: str) -> str:
+        return os.path.join(self.path, key + ARTIFACT_SUFFIX)
+
+    def save(self, key: str, entry: str, fp: str, compiled: Any) -> bool:
+        from jax.experimental import serialize_executable as sx
+
+        try:
+            payload, in_tree, out_tree = sx.serialize(compiled)
+            blob = pickle.dumps(
+                {
+                    "v": _ARTIFACT_VERSION,
+                    "fingerprint": fp,
+                    "entry": entry,
+                    "payload": payload,
+                    "in_tree": in_tree,
+                    "out_tree": out_tree,
+                }
+            )
+            tmp = self.artifact(key) + f".tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, self.artifact(key))
+        except Exception as e:  # noqa: BLE001 -- counted rung: the
+            # executable stays armed in memory, only persistence is lost
+            AOT_FALLBACKS.inc(reason="serialize")
+            log.warning("aot serialize failed", entry=entry,
+                        error=f"{type(e).__name__}: {e}"[:200])
+            return False
+        AOT_SERIALIZED.inc(entry=entry)
+        return True
+
+    def load_one(self, path: str, fp: str) -> Tuple[str, Any]:
+        """(entry name, loaded executable) or AotDeserializeError."""
+        from jax.experimental import serialize_executable as sx
+
+        try:
+            with open(path, "rb") as f:
+                doc = pickle.load(f)
+        except Exception as e:  # noqa: BLE001 -- every corruption mode
+            # (truncated pickle, bad bytes) lands on the same typed rung
+            raise AotDeserializeError(f"unreadable artifact: {e}") from e
+        if not isinstance(doc, dict) or doc.get("v") != _ARTIFACT_VERSION:
+            raise AotDeserializeError(
+                f"artifact version {doc.get('v') if isinstance(doc, dict) else '?'}"
+                f" != {_ARTIFACT_VERSION}"
+            )
+        if doc.get("fingerprint") != fp:
+            raise AotDeserializeError(
+                f"fingerprint {doc.get('fingerprint')!r} != {fp!r}"
+            )
+        try:
+            compiled = sx.deserialize_and_load(
+                doc["payload"], doc["in_tree"], doc["out_tree"]
+            )
+        except Exception as e:  # noqa: BLE001 -- backend refusal, NOT
+            # format corruption: keep the artifact for a fresh process
+            raise AotDeserializeError(
+                f"deserialize failed: {e}", corrupt=False) from e
+        return str(doc.get("entry", "?")), compiled
+
+    def load_all(self, fp: str) -> Tuple[Dict[str, Tuple[str, Any]], int]:
+        """Arm everything on disk: {key: (entry, executable)} plus the
+        failure count. A format-corrupt artifact is counted, logged,
+        and REMOVED (it would re-fail every restart; CI uploads the
+        cache dir on failure for forensics); backend-refused ones are
+        counted and kept."""
+        armed: Dict[str, Tuple[str, Any]] = {}
+        failures = 0
+        try:
+            names = sorted(os.listdir(self.path))
+        except OSError:
+            return armed, 0
+        for name in names:
+            if not name.endswith(ARTIFACT_SUFFIX):
+                continue
+            key = name[: -len(ARTIFACT_SUFFIX)]
+            path = os.path.join(self.path, name)
+            try:
+                entry, compiled = self.load_one(path, fp)
+            except AotDeserializeError as e:
+                AOT_FALLBACKS.inc(reason="deserialize")
+                failures += 1
+                log.warning("aot artifact rejected; JIT covers this entry",
+                            artifact=name, error=str(e)[:200])
+                if e.corrupt:
+                    # format damage re-fails every restart; a backend
+                    # refusal may be this process only -- keep those
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                continue
+            armed[key] = (entry, compiled)
+            AOT_LOADED.inc(entry=entry)
+        return armed, failures
+
+    def stats(self) -> Dict[str, int]:
+        artifacts = 0
+        total = 0
+        try:
+            for name in sorted(os.listdir(self.path)):
+                if name.endswith(ARTIFACT_SUFFIX):
+                    artifacts += 1
+                    try:
+                        total += os.path.getsize(os.path.join(self.path, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+        return {"artifacts": artifacts, "bytes": total}
+
+
+# -- plan / ladder ---------------------------------------------------------
+
+class _Task(NamedTuple):
+    tier: int            # 0 hot shapes, 1 degrade-ladder meshes, 2 side
+    #                      entries (convex/disrupt), 3 rare buckets
+    entry: str           # jit entry family (coverage gauge label)
+    label: str           # human-readable, for /debug/aot
+    key: Optional[str]   # armed-table key (None for warm-call tasks)
+    run: Callable[[], Optional[Any]]   # -> compiled executable or None
+
+
+def _jit_entry(modname: str, fn_name: str):
+    """The UNDERLYING jitted function for an entry -- when the jitstats
+    probe is installed the module attribute is a plain wrapper without
+    .lower(), so the probe's originals map is the authority."""
+    import importlib
+
+    from karpenter_tpu.obs import jitstats
+
+    saved = jitstats.original(modname, fn_name)
+    if saved is not None:
+        return saved
+    return getattr(importlib.import_module(modname), fn_name)
+
+
+class AotManager:
+    """The armed-executable table, the exec store, and the warmup ladder
+    for one TPUSolver. ``try_call`` is the dispatch seam: an armed key
+    serves the solve from a precompiled executable; any miss or failure
+    is the ordinary jit path, bit-identical."""
+
+    def __init__(self, solver, exec_dir: Optional[str] = None,
+                 serialize: bool = True, duty: float = 0.05,
+                 pads: Optional[Sequence[int]] = None):
+        self.solver = solver
+        self.serialize = serialize
+        env_duty = os.environ.get(DUTY_ENV)
+        if env_duty:
+            try:
+                duty = float(env_duty)
+            except ValueError:
+                pass
+        # duty in (0, 1]: fraction of ladder wall time spent compiling;
+        # >= 1 disables throttling (bench's synchronous prep pass)
+        self.duty = min(max(duty, 0.005), 1.0)
+        self.pads = tuple(pads) if pads is not None else None
+        self.fingerprint = ""       # set lazily (jax import)
+        self.store = ExecStore(exec_dir) if exec_dir else None
+        self._armed: Dict[str, Any] = {}          # key -> executable
+        self._armed_entry: Dict[str, str] = {}    # key -> entry family
+        self._loaded_keys: set = set()
+        self._planned: Dict[str, int] = {}        # entry -> planned tasks
+        self._done: Dict[str, int] = {}           # entry -> finished tasks
+        self._load_failures = 0
+        self._compile_failures = 0
+        self._ladder_runs = 0
+        self._ladder_busy = False
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._pending = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- fingerprint (lazy: jax) ------------------------------------------
+    def _fp(self) -> str:
+        if not self.fingerprint:
+            self.fingerprint = fingerprint()
+        return self.fingerprint
+
+    # -- restart path ------------------------------------------------------
+    def load_store(self) -> int:
+        """Arm every valid serialized executable BEFORE the first catalog
+        stages -- the recovering operator's first tick then dispatches
+        compile-free. Returns the number armed."""
+        if self.store is None:
+            return 0
+        armed, failures = self.store.load_all(self._fp())
+        with self._lock:
+            for key, (entry, compiled) in armed.items():
+                self._armed[key] = compiled
+                self._armed_entry[key] = entry
+                self._loaded_keys.add(key)
+            self._load_failures += failures
+        if armed or failures:
+            log.info("aot exec store loaded", armed=len(armed), failures=failures)
+        return len(armed)
+
+    # -- dispatch seam -----------------------------------------------------
+    def try_call(self, entry: str, args: Tuple, statics: Dict[str, Any]):
+        """(hit, output): dispatch through an armed executable when one
+        matches (entry, statics, input avals) exactly; (False, None)
+        otherwise. A rejected call disarms the key and takes the counted
+        dispatch rung -- the tick continues on JIT."""
+        with self._lock:
+            empty = not self._armed
+        if empty:
+            return False, None
+        key = exec_key(entry, statics, args, self._fp())
+        fn = self._armed.get(key)
+        if fn is None:
+            return False, None
+        try:
+            out = fn(*args)
+        except Exception as e:  # noqa: BLE001 -- any executable rejection
+            # (aval drift, device mismatch) disarms and falls back to JIT
+            AOT_FALLBACKS.inc(reason="dispatch")
+            with self._lock:
+                self._armed.pop(key, None)
+            log.warning("aot executable rejected dispatch; disarmed",
+                        entry=entry, error=f"{type(e).__name__}: {e}"[:200])
+            return False, None
+        AOT_DISPATCHES.inc(entry=entry)
+        return True, out
+
+    # -- plan building -----------------------------------------------------
+    def _arm(self, task: "_Task", compiled: Any) -> None:
+        with self._lock:
+            self._armed[task.key] = compiled
+            self._armed_entry[task.key] = task.entry
+        if self.serialize and self.store is not None:
+            self.store.save(task.key, task.entry, self._fp(), compiled)
+
+    def _lower_task(self, tier: int, entry: str, modname: str, fn_name: str,
+                    args: Tuple, statics: Dict[str, Any], label: str) -> "_Task":
+        key = exec_key(entry, statics, args, self._fp())
+        serializing = self.serialize and self.store is not None
+
+        def run():
+            fn = _jit_entry(modname, fn_name)
+            lowered = fn.lower(*args, **statics)
+            if not serializing:
+                return lowered.compile()
+            # An executable served FROM the persistent XLA cache
+            # serializes into a stub that references compiler symbols
+            # resident only in this process ("Symbols not found" on a
+            # fresh-process deserialize).  Serializable tasks must
+            # therefore compile with the persistent cache bypassed: the
+            # exec store, not the XLA cache, is their cross-process
+            # layer.  Window cost: a concurrent tick compile misses the
+            # cache for the duration of this one compile; correctness
+            # is unaffected.
+            import jax
+            prev = bool(jax.config.jax_enable_compilation_cache)
+            try:
+                jax.config.update("jax_enable_compilation_cache", False)
+                return lowered.compile()
+            finally:
+                jax.config.update("jax_enable_compilation_cache", prev)
+
+        return _Task(tier=tier, entry=entry, label=label, key=key, run=run)
+
+    def build_plan(self, entry) -> List["_Task"]:
+        """The exhaustive task list for one staged catalog: every jit
+        entry family x every static/shape bucket the running config can
+        dispatch, ordered by criticality. `entry` is the solver's
+        _CatalogEntry (real staged tensors -- lowering from the same
+        inputs the tick dispatches guarantees exact aval/key match)."""
+        import numpy as np
+
+        from karpenter_tpu.solver import encode, ffd
+
+        solver = self.solver
+        tensors = entry.tensors
+        offsets, words = entry.offsets, entry.words
+        pads = self.pads or solver.WARM_C_PADS
+        tasks: List[_Task] = []
+
+        def inputs_for(cp: int, staged=None):
+            cs = encode.encode_classes([], tensors, c_pad=cp)
+            return ffd.make_inputs_staged(
+                staged if staged is not None else entry.staged, cs,
+                packed_masks=solver.packed_masks,
+            )
+
+        if solver.mesh_engine is None:
+            # tier 0: the production solve + its shadowing bound, every
+            # class-count bucket -- these are the hot shapes a restart's
+            # first tick dispatches
+            for cp in pads:
+                inp = inputs_for(cp)
+                fstat = dict(
+                    g_max=solver.g_max, nnz_max=ffd.nnz_budget(cp, solver.g_max),
+                    word_offsets=offsets, words=words, objective=solver.objective,
+                )
+                tasks.append(self._lower_task(
+                    0, "ffd_solve_fused", "karpenter_tpu.solver.ffd",
+                    "ffd_solve_fused", (inp,), fstat, f"fused c{cp}"))
+                placed = np.zeros((cp,), np.float32)
+                tasks.append(self._lower_task(
+                    0, "fractional_price_bound", "karpenter_tpu.solver.bound",
+                    "fractional_price_bound", (inp, placed),
+                    dict(word_offsets=offsets, words=words), f"bound c{cp}"))
+            # tier 2: the convex tier's relaxation (only when the tier
+            # can dispatch it) -- behind the hot shapes, before rare work
+            if solver.tier == "convex":
+                from karpenter_tpu.solver.convex import relax as convex_relax
+
+                for cp in pads:
+                    inp = inputs_for(cp)
+                    cstat = dict(
+                        iters=convex_relax.DEFAULT_ITERS,
+                        word_offsets=offsets, words=words,
+                    )
+                    tasks.append(self._lower_task(
+                        2, "convex_relax", "karpenter_tpu.solver.convex.relax",
+                        "convex_relax", (inp,), cstat, f"convex c{cp}"))
+        else:
+            tasks.extend(self._mesh_tasks(entry, pads))
+        # tier 3 (rare buckets last): disrupt kernels at their smallest
+        # pow2 candidate buckets -- shapes come from counts, not the
+        # catalog, so this warms the common small-pool case and the
+        # persistent cache covers the rest
+        tasks.extend(self._disrupt_tasks(tensors))
+        tasks.sort(key=lambda t: t.tier)
+        return tasks
+
+    def _mesh_tasks(self, entry, pads) -> List["_Task"]:
+        """Warm-call tasks for the sharded engine: serialized executables
+        are device-assembly-pinned, so mesh coverage goes through the
+        module jit caches instead -- the CURRENT layout first (tier 0),
+        then every deterministic shrunk layout of the degrade ladder
+        (tier 1: armed BEFORE any device is lost, which is the point)."""
+        import numpy as np
+
+        from karpenter_tpu.fleet.shard import MeshSolveEngine
+        from karpenter_tpu.solver import encode, ffd
+
+        solver = self.solver
+        engine = solver.mesh_engine
+        tensors = entry.tensors
+        tasks: List[_Task] = []
+        # (tier, engine factory) -- throwaway engines over the shrunk
+        # layouts share the module-level _JIT_CACHE with the production
+        # engine (Mesh equality is by devices + axis names), so a real
+        # reshard lands on programs these warm calls compiled
+        layouts: List[Tuple[int, Callable[[], Any]]] = [(0, lambda: engine)]
+        try:
+            for mesh in engine.topology.shrunk_meshes():
+                layouts.append((1, (lambda m: (lambda: MeshSolveEngine(m)))(mesh)))
+        except Exception as e:  # noqa: BLE001 -- enumeration is advisory:
+            # losing the shrunk tiers costs coverage, never correctness
+            AOT_FALLBACKS.inc(reason="compile")
+            log.warning("shrunk-layout enumeration failed",
+                        error=f"{type(e).__name__}: {e}"[:200])
+
+        for tier, make_engine in layouts:
+            staged_cell: Dict[str, Any] = {}
+
+            def stage(make_engine=make_engine, staged_cell=staged_cell):
+                if "v" not in staged_cell:
+                    eng = make_engine()
+                    staged, offs, words, _ = eng.stage_catalog_versioned(tensors)
+                    staged_cell["v"] = (eng, staged, offs, words)
+                return staged_cell["v"]
+
+            for cp in pads:
+                def run_fused(cp=cp, stage=stage):
+                    import jax
+
+                    eng, staged, offs, words = stage()
+                    cs = encode.encode_classes([], tensors, c_pad=cp)
+                    inp = ffd.make_inputs_staged(
+                        staged, cs, packed_masks=solver.packed_masks)
+                    out = eng.solve_fused(
+                        inp, g_max=solver.g_max,
+                        nnz_max=ffd.nnz_budget(cp, solver.g_max),
+                        word_offsets=offs, words=words,
+                        objective=solver.objective,
+                    )
+                    jax.block_until_ready(out)
+                    return None
+
+                def run_bound(cp=cp, stage=stage):
+                    import jax
+
+                    eng, staged, offs, words = stage()
+                    cs = encode.encode_classes([], tensors, c_pad=cp)
+                    inp = ffd.make_inputs_staged(
+                        staged, cs, packed_masks=solver.packed_masks)
+                    out = eng.price_bound(
+                        inp, np.zeros((cp,), np.float32),
+                        word_offsets=offs, words=words,
+                    )
+                    jax.block_until_ready(out)
+                    return None
+
+                kind = "full" if tier == 0 else "shrunk"
+                tasks.append(_Task(tier, "mesh_fused", f"mesh {kind} fused c{cp}",
+                                   None, run_fused))
+                tasks.append(_Task(tier, "mesh_bound", f"mesh {kind} bound c{cp}",
+                                   None, run_bound))
+        return tasks
+
+    def _disrupt_tasks(self, tensors) -> List["_Task"]:
+        """Warm-call the consolidation kernels at their smallest pow2
+        candidate buckets (C=N=S=16, the encode.bucket floor): shapes
+        come from candidate counts, so these are warm-calls into the jit
+        caches, not armable store entries.  The pack-existing first-fit
+        (service._pack_existing) dispatches the SAME repack entry with a
+        single member row (S=1, C floored at c_pad_min) -- a distinct
+        compiled shape that fires on EVERY tick with live nodes, so it
+        gets its own warm-call or the restart first tick pays it."""
+        import numpy as np
+
+        solver = self.solver
+        R = int(tensors.cap.shape[1])
+        K = int(tensors.k_pad)
+        Z = int(tensors.tzone.shape[1])
+        CT = int(tensors.tcap.shape[1])
+        C = N = S = 16
+
+        def run_repack():
+            import jax
+
+            headroom = np.zeros((N, R), np.float32)
+            feas = np.zeros((C, N), bool)
+            req = np.zeros((C, R), np.float32)
+            member = np.zeros((S, C), np.int32)
+            excl = np.zeros((S, N), bool)
+            out = solver._dispatch_disrupt_repack(headroom, feas, req, member, excl)
+            jax.block_until_ready(out)
+            return None
+
+        def run_replace():
+            import jax
+
+            from karpenter_tpu.apis import labels as wk
+            from karpenter_tpu.solver import encode
+            from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
+
+            od_col = int(encode.CAPTYPE_INDEX[wk.CAPACITY_TYPE_ON_DEMAND])
+            args = (
+                np.zeros((S, C), np.int32), np.zeros((C, R), np.float32),
+                np.zeros((C, K), bool), np.zeros((C, Z), bool),
+                np.zeros((C, CT), bool), np.zeros((K, R), np.float32),
+                np.zeros((R,), np.float32),
+                np.full((K, Z, CT), np.inf, np.float32),
+            )
+            if solver.mesh_engine is not None:
+                out = solver.mesh_engine.replace(*args, od_col=od_col)
+            else:
+                out = disrupt_kernel.disrupt_replace(*args, od_col=od_col)
+            jax.block_until_ready(out)
+            return None
+
+        from karpenter_tpu.solver import encode
+
+        # the pack-existing first-fit shape is FIXED at its floors, so it
+        # is armable: precompile + serialize it like a tier-0 entry and
+        # _dispatch_disrupt_repack's AOT rung serves it trace-free
+        Cp = int(encode.bucket(1, solver.c_pad_min))
+        pack_args = (
+            np.zeros((N, R), np.float32), np.zeros((Cp, N), bool),
+            np.zeros((Cp, R), np.float32), np.zeros((1, Cp), np.int32),
+            np.zeros((1, N), bool),
+        )
+        return [
+            _Task(3, "disrupt_repack", f"repack C{C} N{N} S{S}", None, run_repack),
+            self._lower_task(3, "disrupt_repack",
+                             "karpenter_tpu.solver.disrupt.kernel",
+                             "disrupt_repack", pack_args, {},
+                             f"pack-existing C{Cp} N{N} S1"),
+            _Task(3, "disrupt_replace", f"replace C{C} S{S}", None, run_replace),
+        ]
+
+    # -- ladder ------------------------------------------------------------
+    def on_catalog(self, entry) -> None:
+        """A new catalog staged: (re)build the plan in the background
+        ladder. The latest catalog wins -- a mid-plan re-stage abandons
+        the stale remainder at the next task boundary."""
+        with self._lock:
+            self._pending = entry
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._ladder_loop, daemon=True, name="tpusolver-aot")
+                self._thread.start()
+        self._wake.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+    def run_plan(self, entry, throttle: bool = True) -> Dict[str, Any]:
+        """Build and execute the plan SYNCHRONOUSLY on the calling
+        thread (bench's coldstart prep, tests, the restart drill).
+        Returns a summary of what armed."""
+        plan = self.build_plan(entry)
+        with self._lock:
+            self._planned = {}
+            self._done = {}
+            for t in plan:
+                self._planned[t.entry] = self._planned.get(t.entry, 0) + 1
+        self._publish_coverage()
+        compiled = 0
+        for task in plan:
+            if self._run_task(task, throttle=throttle):
+                compiled += 1
+        with self._lock:
+            self._ladder_runs += 1
+        return {"tasks": len(plan), "compiled": compiled}
+
+    def _ladder_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait()
+            if self._stop.is_set():
+                return
+            self._wake.clear()
+            with self._lock:
+                entry = self._pending
+                self._pending = None
+            if entry is None:
+                continue
+            self._ladder_busy = True
+            try:
+                plan = self.build_plan(entry)
+                with self._lock:
+                    self._planned = {}
+                    self._done = {}
+                    for t in plan:
+                        self._planned[t.entry] = self._planned.get(t.entry, 0) + 1
+                self._publish_coverage()
+                for task in plan:
+                    if self._stop.is_set():
+                        return
+                    with self._lock:
+                        stale = self._pending is not None
+                    if stale:
+                        break   # newer catalog: abandon, re-plan
+                    self._run_task(task, throttle=True)
+                with self._lock:
+                    self._ladder_runs += 1
+            except Exception as e:  # noqa: BLE001 -- the ladder is
+                # best-effort: a plan failure costs coverage, never a tick
+                AOT_FALLBACKS.inc(reason="compile")
+                log.warning("aot ladder pass failed",
+                            error=f"{type(e).__name__}: {e}"[:200])
+            finally:
+                self._ladder_busy = False
+
+    def _run_task(self, task: "_Task", throttle: bool) -> bool:
+        """One ladder step: compile under the witness's aot phase (a
+        concurrent hot section must never see it as a retrace), attribute
+        to the per-entry AOT counters, arm/serialize, publish coverage,
+        then yield the duty-cycle sleep."""
+        from karpenter_tpu.analysis import jax_witness
+        from karpenter_tpu.obs import jitstats
+
+        if task.key is not None:
+            # already armed from the exec store: the whole point of the
+            # restart path is NOT paying this compile again. A later
+            # dispatch rejection disarms the key, and the next catalog's
+            # ladder pass recompiles it then.
+            with self._lock:
+                armed = task.key in self._armed and task.key in self._loaded_keys
+            if armed:
+                with self._lock:
+                    self._done[task.entry] = self._done.get(task.entry, 0) + 1
+                self._publish_coverage()
+                return True
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            with jax_witness.aot_phase():
+                compiled = task.run()
+            ok = True
+        except Exception as e:  # noqa: BLE001 -- one failed bucket is a
+            # counted skip; everything else still arms
+            compiled = None
+            AOT_FALLBACKS.inc(reason="compile")
+            with self._lock:
+                self._compile_failures += 1
+            log.warning("aot precompile failed", task=task.label,
+                        error=f"{type(e).__name__}: {e}"[:200])
+        secs = time.perf_counter() - t0
+        jitstats.note_aot(task.entry, secs)
+        if compiled is not None and task.key is not None:
+            self._arm(task, compiled)
+        if ok:
+            with self._lock:
+                self._done[task.entry] = self._done.get(task.entry, 0) + 1
+            self._publish_coverage()
+        if throttle and self.duty < 1.0:
+            time.sleep(min(_MAX_THROTTLE_SLEEP_S,
+                           secs * (1.0 - self.duty) / self.duty))
+        return ok and compiled is not None
+
+    def _publish_coverage(self) -> None:
+        with self._lock:
+            planned = dict(self._planned)
+            done = dict(self._done)
+        for entry, n in planned.items():
+            AOT_PRECOMPILED_FRACTION.set(
+                min(1.0, done.get(entry, 0) / n) if n else 0.0, entry=entry)
+
+    def drain(self, timeout_s: float = 300.0) -> bool:
+        """Wait for the background ladder to go idle (tests/bench)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                pending = self._pending is not None
+            if not pending and not self._ladder_busy:
+                return True
+            time.sleep(0.02)
+        return False
+
+    # -- observability -----------------------------------------------------
+    def describe(self) -> Dict[str, Any]:
+        """The /debug/aot document: what is armed, what the plan covers,
+        where the store lives, and every ladder counter."""
+        with self._lock:
+            armed_by_entry: Dict[str, int] = {}
+            for key, entry in self._armed_entry.items():
+                if key in self._armed:
+                    armed_by_entry[entry] = armed_by_entry.get(entry, 0) + 1
+            planned = dict(self._planned)
+            done = dict(self._done)
+            doc = {
+                "fingerprint": self._fp() if self.fingerprint else "",
+                "exec_dir": self.store.path if self.store else None,
+                "serialize": self.serialize,
+                "duty": self.duty,
+                "armed": len(self._armed),
+                "loaded": len(self._loaded_keys),
+                "load_failures": self._load_failures,
+                "compile_failures": self._compile_failures,
+                "ladder_runs": self._ladder_runs,
+                "ladder_busy": self._ladder_busy,
+            }
+        entries = sorted(set(planned) | set(armed_by_entry))
+        doc["entries"] = {
+            e: {
+                "planned": planned.get(e, 0),
+                "done": done.get(e, 0),
+                "armed": armed_by_entry.get(e, 0),
+                "fraction": round(done.get(e, 0) / planned[e], 4)
+                if planned.get(e) else None,
+            }
+            for e in entries
+        }
+        if self.store is not None:
+            doc["store"] = self.store.stats()
+        return doc

@@ -2332,6 +2332,17 @@ def serve_main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
+    # persistent XLA compilation cache (solver/aot.py layout), enabled
+    # BEFORE the first jit (mesh engine construction below may trace):
+    # a sidecar restart then reuses every backend compile from the
+    # previous incarnation, including the sharded mesh programs the
+    # serialized-executable store cannot cover (device-assembly-pinned).
+    # Failure returns None and the sidecar runs uncached -- a cache
+    # optimization must never abort startup.
+    from karpenter_tpu.utils import enable_jax_compilation_cache
+
+    enable_jax_compilation_cache()
+
     token = None
     if args.token_file:
         with open(args.token_file) as f:

@@ -273,7 +273,33 @@ class TPUSolver:
         # solve_finish, read by the flight recorder and /debug/quality;
         # nothing downstream of a decision reads it.
         self.last_quality: Optional[dict] = None
+        # AOT compile-cache subsystem (solver/aot.py): armed via
+        # enable_aot() -- None means every dispatch takes the ordinary
+        # jit path (bit-identical either way; AOT only changes who
+        # compiles and when)
+        self._aot = None
         self._lock = threading.Lock()
+
+    # -- AOT precompilation (solver/aot.py) ---------------------------------
+    def enable_aot(self, exec_dir: Optional[str] = None, serialize: bool = True,
+                   duty: float = 0.05, pads: Optional[Sequence[int]] = None):
+        """Arm the AOT subsystem: load any serialized executables NOW
+        (the restart path -- armed before the first catalog stages), and
+        run the warmup ladder over every staged catalog from here on.
+        In-process backends only; remote mode's sidecar owns its own AOT
+        (rpc.serve_main). Returns the manager, or None in wire mode."""
+        if self.client is not None:
+            return None
+        from karpenter_tpu.solver import aot as aot_mod
+
+        self._aot = aot_mod.AotManager(
+            self, exec_dir=exec_dir, serialize=serialize, duty=duty, pads=pads)
+        self._aot.load_store()
+        return self._aot
+
+    def describe_aot(self) -> dict:
+        """The /debug/aot document ({} while AOT is not enabled)."""
+        return self._aot.describe() if self._aot is not None else {}
 
     # -- catalog staging ----------------------------------------------------
     def _catalog(self, instance_types: Sequence) -> "_CatalogEntry":
@@ -361,6 +387,11 @@ class TPUSolver:
                 target=self._bg_warm, args=(staged_entry,), daemon=True,
                 name="tpusolver-warm",
             ).start()
+        # AOT warmup ladder (solver/aot.py): every freshly staged catalog
+        # (re)plans the exhaustive precompile pass in the background --
+        # rate-limited, witness-exempt, and serialized for the next restart
+        if staged_entry is not None and self._aot is not None and self.client is None:
+            self._aot.on_catalog(staged_entry)
         return entry
 
     def catalog_tensors(self, instance_types: Sequence) -> CatalogTensors:
@@ -542,6 +573,16 @@ class TPUSolver:
                     "pallas ffd kernel failed; pinned to XLA twin",
                     error=f"{type(e).__name__}: {e}"[:200],
                 )
+        # AOT rung (solver/aot.py): an armed precompiled executable for
+        # exactly these statics + input avals serves the solve without a
+        # trace -- the restart path's compile-free first tick. Any miss
+        # or rejection falls through to the proven jit entry.
+        if self._aot is not None:
+            hit, buf = self._aot.try_call("ffd_solve_fused", (inp,), common)
+            if hit:
+                metrics.SOLVER_KERNEL_DISPATCHES.inc(
+                    entry="ffd_solve_fused", impl="aot")
+                return buf
         metrics.SOLVER_KERNEL_DISPATCHES.inc(entry="ffd_solve_fused", impl="xla")
         return ffd.ffd_solve_fused(inp, **common)
 
@@ -553,6 +594,12 @@ class TPUSolver:
         if self.mesh_engine is not None:
             return self.mesh_engine.price_bound(
                 inp, placed, word_offsets=offsets, words=words, epoch=epoch)
+        if self._aot is not None:
+            hit, totals = self._aot.try_call(
+                "fractional_price_bound", (inp, placed),
+                dict(word_offsets=offsets, words=words))
+            if hit:
+                return totals
         return price_bound.fractional_price_bound(
             inp, placed, word_offsets=offsets, words=words)
 
@@ -572,10 +619,19 @@ class TPUSolver:
             # convex candidate (LADDER_SEAMS in analysis/checkers/errflow.py)
             failpoints.eval("rpc.convex.dispatch")
             with tracing.span("dispatch_convex"):
-                out = convex_relax.convex_relax(
-                    inp, iters=convex_relax.DEFAULT_ITERS,
-                    word_offsets=offsets, words=words,
-                )
+                out = None
+                if self._aot is not None:
+                    hit, out_aot = self._aot.try_call(
+                        "convex_relax", (inp,),
+                        dict(iters=convex_relax.DEFAULT_ITERS,
+                             word_offsets=offsets, words=words))
+                    if hit:
+                        out = out_aot
+                if out is None:
+                    out = convex_relax.convex_relax(
+                        inp, iters=convex_relax.DEFAULT_ITERS,
+                        word_offsets=offsets, words=words,
+                    )
                 for leaf in (out.x, out.lower, out.trace):
                     leaf.copy_to_host_async()
             return out
@@ -709,6 +765,18 @@ class TPUSolver:
                     "pallas disrupt kernel failed; pinned to XLA twin",
                     error=f"{type(e).__name__}: {e}"[:200],
                 )
+        # AOT rung: the pack-existing floor shape (S=1, C/N at their
+        # bucket floors) fires on every tick with live nodes, so the
+        # warmup ladder precompiles and serializes it (aot._disrupt_tasks)
+        # -- the restart first tick repacks without a trace. Any other
+        # candidate bucket misses and takes the jit entry below.
+        if self._aot is not None:
+            hit, out = self._aot.try_call(
+                "disrupt_repack", (headroom, feas, req, member, excl), {})
+            if hit:
+                metrics.SOLVER_KERNEL_DISPATCHES.inc(
+                    entry="disrupt_repack", impl="aot")
+                return out
         metrics.SOLVER_KERNEL_DISPATCHES.inc(entry="disrupt_repack", impl="xla")
         return disrupt_kernel.disrupt_repack(headroom, feas, req, member, excl)
 

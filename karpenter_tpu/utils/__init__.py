@@ -188,35 +188,37 @@ def configure_gc_for_latency() -> None:
     gc.set_threshold(1_000_000, 50, 50)
 
 
-def enable_jax_compilation_cache(cache_dir: str = "") -> None:
+def enable_jax_compilation_cache(cache_dir: str = "") -> "str | None":
     """Turn on JAX's persistent compilation cache so controller restarts /
     bench runs skip the first-solve XLA compile (~4s per scan program).
     Safe to call before or after jax import, but BEFORE the first jit.
 
-    Resolution order: explicit arg > JAX_COMPILATION_CACHE_DIR (the
-    standard mechanism, e.g. a mounted writable volume in a pod) > a
-    home-dir default. An unwritable location degrades to no persistent
-    cache -- a cache optimization must never abort operator startup
+    Resolution order: explicit arg > KARPENTER_TPU_COMPILE_CACHE >
+    JAX_COMPILATION_CACHE_DIR (the standard mechanism, e.g. a mounted
+    writable volume in a pod) > a home-dir default. The root is
+    VERSIONED by the jaxlib/backend/topology fingerprint
+    (solver/aot.py): <root>/<fp>/xla holds jax's cache, <root>/<fp>/exec
+    holds serialized AOT executables, and stale sibling versions are
+    swept at startup like shm segments. Hit/miss accounting registers
+    through obs/jitstats. Returns the versioned directory (callers hand
+    <dir>/exec to TPUSolver.enable_aot), or None when unwritable -- a
+    cache optimization must never abort operator startup
     (readOnlyRootFilesystem pods have no writable HOME)."""
     import os
 
     import jax
 
-    path = (
-        cache_dir
-        or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-        or os.path.join(os.path.expanduser("~"), ".cache", "karpenter-tpu", "jax")
-    )
-    try:
-        os.makedirs(path, exist_ok=True)
-    except OSError as e:
-        from karpenter_tpu.logging import get_logger
+    from karpenter_tpu.solver import aot
 
-        get_logger("utils").warning(
-            "compilation cache disabled", path=path, error=str(e)
-        )
-        return
-    jax.config.update("jax_compilation_cache_dir", path)
+    home = aot.prepare_cache(cache_dir)
+    if home is None:
+        return None
+    jax.config.update("jax_compilation_cache_dir", os.path.join(home, "xla"))
     # cache every program, however small/fast-to-compile
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    from karpenter_tpu.obs import jitstats
+
+    jitstats.install_cache_listener()
+    jitstats.update_cache_bytes(home)
+    return home

@@ -1,0 +1,134 @@
+"""Restart-drill child for tests/test_aot.py (TestRestartDrill): one
+process per phase over a shared cache root.
+
+    python tests/fixtures/aot_restart_child.py serialize <root>
+    python tests/fixtures/aot_restart_child.py restart   <root>
+
+``serialize`` solves cold with both cache layers enabled and runs the
+AOT plan synchronously so the exec store holds the tier-0 executables.
+``restart`` is a fresh interpreter arming from that store: its first
+production tick must record ZERO compiles and ZERO traces under the jax
+witness and decide identically. Prints one JSON line per phase."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def build_catalog():
+    from karpenter_tpu.apis import TPUNodeClass
+    from karpenter_tpu.apis.nodeclass import SubnetStatus
+    from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+    from karpenter_tpu.kwok.cloud import FakeCloud
+    from karpenter_tpu.providers.instancetype import gen_catalog
+    from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+    from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+    from karpenter_tpu.providers.instancetype.types import Resolver
+    from karpenter_tpu.providers.pricing import PricingProvider
+
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in gen_catalog.ZONES},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [
+        SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return prov.list(nc)
+
+
+def make_pods(n=60):
+    from karpenter_tpu.apis import Pod
+    from karpenter_tpu.scheduling import Resources
+
+    shapes = [("1", 2), ("2", 4), ("4", 8), ("500m", 1)]
+    return [
+        Pod(f"p{i}", requests=Resources(
+            {"cpu": shapes[i % 4][0], "memory": f"{shapes[i % 4][1]}Gi"}))
+        for i in range(n)
+    ]
+
+
+def decisions_sig(result):
+    return sorted(
+        (sorted(it.name for it in g.instance_types),
+         sorted(p.metadata.name for p in g.pods))
+        for g in result.new_groups
+    )
+
+
+def main() -> int:
+    phase, root = sys.argv[1], sys.argv[2]
+
+    from karpenter_tpu.analysis import jax_witness
+    from karpenter_tpu.apis import NodePool
+    from karpenter_tpu.solver.service import TPUSolver
+    from karpenter_tpu.utils import enable_jax_compilation_cache
+
+    jax_witness.install()
+    home = enable_jax_compilation_cache(root)
+    assert home, "cache must enable for the drill"
+    exec_dir = os.path.join(home, "exec")
+
+    items = build_catalog()
+    pods = make_pods()
+    pool = NodePool("default")
+    out = {"phase": phase}
+
+    if phase == "serialize":
+        solver = TPUSolver(g_max=64)
+        pad_cell = []
+        orig = solver._dispatch_bound
+
+        def cap(inp, placed, *a, **kw):
+            pad_cell.append(int(placed.shape[0]))
+            return orig(inp, placed, *a, **kw)
+
+        solver._dispatch_bound = cap
+        try:
+            result = solver.solve(pool, items, pods)
+        finally:
+            solver._dispatch_bound = orig
+        mgr = solver.enable_aot(exec_dir, serialize=True, duty=1.0,
+                                pads=(pad_cell[0],))
+        mgr.run_plan(solver._catalog(items), throttle=False)
+        out["serialized"] = mgr.store.stats()["artifacts"]
+        out["decisions"] = decisions_sig(result)
+    else:
+        solver = TPUSolver(g_max=64)
+        solver.enable_aot(exec_dir, serialize=False, duty=1.0)
+        out["loaded"] = solver.describe_aot()["loaded"]
+        st0 = jax_witness.stats()
+        t0 = time.perf_counter()
+        with jax_witness.hot("restart-drill-first-tick"):
+            result = solver.solve(pool, items, pods)
+        out["first_tick_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        st1 = jax_witness.stats()
+        out["first_tick_compiles"] = st1["compiles_total"] - st0["compiles_total"]
+        out["first_tick_traces"] = st1["traces_total"] - st0["traces_total"]
+        out["decisions"] = decisions_sig(result)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    # XLA's C++ teardown can abort ("terminate called without an active
+    # exception") after a deserialized executable has run; the result is
+    # already on stdout, so skip interpreter teardown entirely.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

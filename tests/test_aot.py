@@ -1,0 +1,417 @@
+"""AOT compile-cache subsystem tests (solver/aot.py): the armed
+executable path must be BIT-IDENTICAL to the jit path it shadows, every
+failure mode must land on a counted typed rung that falls back to JIT,
+and the versioned cache layout must survive restarts and sweep stale
+versions -- the zero-compile cold-start contract, asserted end to end
+(subprocess restart drill included)."""
+import json
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from karpenter_tpu.apis import NodePool, Pod, TPUNodeClass
+from karpenter_tpu.apis.nodeclass import SubnetStatus
+from karpenter_tpu.cache.unavailable_offerings import UnavailableOfferings
+from karpenter_tpu.kwok.cloud import FakeCloud
+from karpenter_tpu.providers.instancetype import gen_catalog
+from karpenter_tpu.providers.instancetype.offerings import OfferingsBuilder
+from karpenter_tpu.providers.instancetype.provider import InstanceTypeProvider
+from karpenter_tpu.providers.instancetype.types import Resolver
+from karpenter_tpu.providers.pricing import PricingProvider
+from karpenter_tpu.scheduling import Resources
+from karpenter_tpu.solver import aot
+from karpenter_tpu.solver.service import TPUSolver
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def catalog_items():
+    cloud = FakeCloud()
+    prov = InstanceTypeProvider(
+        cloud,
+        Resolver(gen_catalog.REGION),
+        OfferingsBuilder(
+            PricingProvider(cloud, cloud, gen_catalog.REGION),
+            UnavailableOfferings(),
+            {z.name: z.zone_id for z in gen_catalog.ZONES},
+        ),
+        UnavailableOfferings(),
+    )
+    nc = TPUNodeClass("default")
+    nc.status_subnets = [SubnetStatus(s.id, s.zone, s.zone_id) for s in cloud.describe_subnets()]
+    return prov.list(nc)
+
+
+def make_pods(n=60):
+    """A deterministic small workload with a handful of distinct specs
+    (few classes -> the smallest c_pad bucket -> cheap compiles)."""
+    pods = []
+    shapes = [("1", 2), ("2", 4), ("4", 8), ("500m", 1)]
+    for i in range(n):
+        cpu, mem = shapes[i % len(shapes)]
+        pods.append(Pod(f"p{i}", requests=Resources({"cpu": cpu, "memory": f"{mem}Gi"})))
+    return pods
+
+
+def decisions_sig(result):
+    """Order-insensitive digest of the placement decision (the quantity
+    the AOT differential pins)."""
+    return sorted(
+        (sorted(it.name for it in g.instance_types),
+         sorted(p.metadata.name for p in g.pods))
+        for g in result.new_groups
+    )
+
+
+@pytest.fixture(scope="module")
+def armed_world(catalog_items, tmp_path_factory):
+    """One shared armed-AOT world: a pure-JIT solve, then a second solver
+    whose plan compiled + serialized the same shapes, solved the same
+    pods. Module-scoped -- the compiles are the expensive part and every
+    assertion reads the same world."""
+    exec_dir = str(tmp_path_factory.mktemp("aot-exec"))
+    pool = NodePool("default")
+    pods = make_pods()
+
+    jit_solver = TPUSolver(g_max=64)
+    result_jit = jit_solver.solve(pool, catalog_items, pods)
+
+    solver = TPUSolver(g_max=64)
+    # capture the c_pad the production dispatch uses so the plan's pads
+    # cover exactly the hot bucket (what bench's coldstart cold child does)
+    pad_cell = []
+    orig = solver._dispatch_bound
+
+    def cap(inp, placed, *a, **kw):
+        pad_cell.append(int(placed.shape[0]))
+        return orig(inp, placed, *a, **kw)
+
+    solver._dispatch_bound = cap
+    try:
+        solver.solve(pool, catalog_items, pods)
+    finally:
+        solver._dispatch_bound = orig
+    pad = pad_cell[0]
+
+    mgr = solver.enable_aot(exec_dir, serialize=True, duty=1.0, pads=(pad,))
+    plan = mgr.run_plan(solver._catalog(catalog_items), throttle=False)
+    d0 = aot.AOT_DISPATCHES.value(entry="ffd_solve_fused") + aot.AOT_DISPATCHES.value(
+        entry="fractional_price_bound")
+    result_aot = solver.solve(pool, catalog_items, pods)
+    d1 = aot.AOT_DISPATCHES.value(entry="ffd_solve_fused") + aot.AOT_DISPATCHES.value(
+        entry="fractional_price_bound")
+    return {
+        "exec_dir": exec_dir, "pool": pool, "pods": pods, "pad": pad,
+        "solver": solver, "mgr": mgr, "plan": plan,
+        "result_jit": result_jit, "result_aot": result_aot,
+        "aot_dispatch_delta": d1 - d0,
+    }
+
+
+class TestKeysAndLayout:
+    def test_exec_key_stability(self):
+        args = (np.zeros((4, 8), np.float32), np.zeros((4,), np.int32))
+        statics = {"g_max": 64, "objective": "price"}
+        k1 = aot.exec_key("ffd_solve_fused", statics, args, "fp")
+        k2 = aot.exec_key("ffd_solve_fused", dict(statics), tuple(args), "fp")
+        assert k1 == k2
+        # every key component must move the key
+        assert k1 != aot.exec_key("other_entry", statics, args, "fp")
+        assert k1 != aot.exec_key("ffd_solve_fused", {**statics, "g_max": 128}, args, "fp")
+        assert k1 != aot.exec_key(
+            "ffd_solve_fused", statics, (np.zeros((8, 8), np.float32), args[1]), "fp")
+        assert k1 != aot.exec_key("ffd_solve_fused", statics, args, "fp2")
+
+    def test_fingerprint_pins_runtime(self):
+        import jaxlib
+
+        fp = aot.fingerprint()
+        assert jax.__version__ in fp
+        assert jaxlib.__version__ in fp
+        assert jax.default_backend() in fp
+        assert f"{len(jax.devices())}x" in fp
+        # filesystem-safe: used verbatim as a directory name
+        assert "/" not in fp and " " not in fp
+
+    def test_sweep_stale_keeps_current(self, tmp_path):
+        root = str(tmp_path / "cache")
+        fp = aot.fingerprint()
+        for name in (fp, "jax0.0.0-stale-a", "jax0.0.0-stale-b"):
+            os.makedirs(os.path.join(root, name, "xla"))
+        # loose files at the root are inert, never swept
+        open(os.path.join(root, "legacy.bin"), "wb").close()
+        before = aot.AOT_SWEPT_DIRS.value()
+        home = aot.prepare_cache(root)
+        assert home == os.path.join(root, fp)
+        assert sorted(os.listdir(root)) == [fp, "legacy.bin"]
+        assert aot.AOT_SWEPT_DIRS.value() - before == 2
+        assert os.path.isdir(os.path.join(home, "exec"))
+
+    def test_resolve_root_precedence(self, monkeypatch):
+        monkeypatch.setenv(aot.CACHE_ENV, "/env/root")
+        monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/jax/root")
+        assert aot.resolve_root("/explicit") == "/explicit"
+        assert aot.resolve_root() == "/env/root"
+        monkeypatch.delenv(aot.CACHE_ENV)
+        assert aot.resolve_root() == "/jax/root"
+
+    def test_duty_clamped(self):
+        solver = TPUSolver(g_max=16)
+        assert aot.AotManager(solver, duty=0.0).duty == 0.005
+        assert aot.AotManager(solver, duty=7.0).duty == 1.0
+
+
+class TestBitIdentity:
+    def test_aot_dispatch_hits(self, armed_world):
+        """The armed table serves the production solve for both tier-0
+        families -- the precompile actually lands on the dispatch seam."""
+        assert armed_world["plan"]["compiled"] >= 2
+        assert armed_world["aot_dispatch_delta"] >= 2
+
+    def test_aot_equals_jit_decisions(self, armed_world):
+        """The differential: AOT never changes a decision, only who
+        compiles it."""
+        assert decisions_sig(armed_world["result_aot"]) == decisions_sig(
+            armed_world["result_jit"])
+        assert (armed_world["result_aot"].unschedulable
+                == armed_world["result_jit"].unschedulable)
+
+    def test_coverage_gauge_full(self, armed_world):
+        for entry in ("ffd_solve_fused", "fractional_price_bound"):
+            assert aot.AOT_PRECOMPILED_FRACTION.value(entry=entry) == 1.0
+
+    def test_pack_existing_repack_armed(self, armed_world):
+        """The disruption stage's pack-existing floor shape (S=1, C/N at
+        their bucket floors) rides an armed executable bit-identically --
+        what makes a restarted OPERATOR settle, not just the bench solve
+        path, run zero traces."""
+        import numpy as np
+
+        from karpenter_tpu.solver import encode
+        from karpenter_tpu.solver.disrupt import kernel as disrupt_kernel
+
+        solver = armed_world["solver"]
+        # floor shapes exactly as service._pack_existing builds them
+        Cp = int(encode.bucket(1, solver.c_pad_min))
+        N = 16
+        R = encode.R
+        rng = np.random.default_rng(3)
+        headroom = rng.random((N, R)).astype(np.float32)
+        feas = rng.random((Cp, N)) > 0.5
+        req = rng.random((Cp, R)).astype(np.float32)
+        member = rng.integers(0, 3, (1, Cp)).astype(np.int32)
+        excl = np.zeros((1, N), dtype=bool)
+
+        d0 = aot.AOT_DISPATCHES.value(entry="disrupt_repack")
+        out_aot = solver._dispatch_disrupt_repack(
+            headroom, feas, req, member, excl)
+        d1 = aot.AOT_DISPATCHES.value(entry="disrupt_repack")
+        assert d1 - d0 == 1, "floor-shape repack must ride the armed exec"
+
+        out_jit = disrupt_kernel.disrupt_repack(
+            headroom, feas, req, member, excl)
+        for a, b in zip(out_aot, out_jit):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_describe_surface(self, armed_world):
+        doc = armed_world["solver"].describe_aot()
+        assert doc["fingerprint"] == aot.fingerprint()
+        assert doc["exec_dir"] == armed_world["exec_dir"]
+        assert doc["armed"] >= 2
+        for entry in ("ffd_solve_fused", "fractional_price_bound"):
+            assert doc["entries"][entry]["armed"] >= 1
+            assert doc["entries"][entry]["fraction"] == 1.0
+        assert doc["store"]["artifacts"] >= 2
+
+    def test_debug_endpoint_registered(self):
+        from karpenter_tpu.operator import health
+
+        assert "/debug/aot" in health.DEBUG_ENDPOINTS
+
+
+class TestExecStore:
+    def test_serialized_artifacts_on_disk(self, armed_world):
+        store = armed_world["mgr"].store
+        st = store.stats()
+        assert st["artifacts"] >= 2
+        assert st["bytes"] > 0
+
+    def test_restart_arm_from_store(self, armed_world, catalog_items):
+        """A NEW manager over the same exec dir arms from disk (the
+        in-process restart path) and its solve is bit-identical."""
+        before = aot.AOT_LOADED.value(entry="ffd_solve_fused")
+        solver = TPUSolver(g_max=64)
+        solver.enable_aot(armed_world["exec_dir"], serialize=False,
+                          duty=1.0, pads=(armed_world["pad"],))
+        doc = solver.describe_aot()
+        assert doc["loaded"] >= 2
+        assert aot.AOT_LOADED.value(entry="ffd_solve_fused") - before >= 1
+        result = solver.solve(armed_world["pool"], catalog_items,
+                              armed_world["pods"])
+        assert decisions_sig(result) == decisions_sig(armed_world["result_jit"])
+
+    def test_corrupt_artifact_counted_and_unlinked(self, tmp_path):
+        """Format corruption (garbage bytes, wrong version) is a counted
+        deserialize rung AND the artifact is removed -- it would re-fail
+        every restart."""
+        store = aot.ExecStore(str(tmp_path / "exec"))
+        fp = aot.fingerprint()
+        garbage = store.artifact("deadbeef")
+        with open(garbage, "wb") as f:
+            f.write(b"\x00not a pickle")
+        stale = store.artifact("cafecafe")
+        with open(stale, "wb") as f:
+            pickle.dump({"v": -1}, f)
+        before = aot.AOT_FALLBACKS.value(reason="deserialize")
+        armed, failures = store.load_all(fp)
+        assert armed == {} and failures == 2
+        assert aot.AOT_FALLBACKS.value(reason="deserialize") - before == 2
+        assert not os.path.exists(garbage) and not os.path.exists(stale)
+
+    def test_backend_refusal_keeps_artifact(self, tmp_path):
+        """A well-formed artifact whose PAYLOAD the backend refuses is
+        counted but KEPT: the refusal can be process-state-dependent and
+        a fresh process may load it fine."""
+        store = aot.ExecStore(str(tmp_path / "exec"))
+        fp = aot.fingerprint()
+        path = store.artifact("feedface")
+        with open(path, "wb") as f:
+            pickle.dump({"v": aot._ARTIFACT_VERSION, "fingerprint": fp,
+                         "entry": "ffd_solve_fused", "payload": b"bogus",
+                         "in_tree": None, "out_tree": None}, f)
+        armed, failures = store.load_all(fp)
+        assert armed == {} and failures == 1
+        assert os.path.exists(path)
+
+    def test_wrong_fingerprint_rejected(self, tmp_path):
+        store = aot.ExecStore(str(tmp_path / "exec"))
+        path = store.artifact("0123abcd")
+        with open(path, "wb") as f:
+            pickle.dump({"v": aot._ARTIFACT_VERSION, "fingerprint": "other",
+                         "entry": "e", "payload": b"", "in_tree": None,
+                         "out_tree": None}, f)
+        with pytest.raises(aot.AotDeserializeError) as ei:
+            store.load_one(path, aot.fingerprint())
+        assert ei.value.corrupt
+
+
+class TestCorruptionFallback:
+    def test_disarmed_on_dispatch_failure_decisions_identical(
+            self, armed_world, catalog_items):
+        """An armed executable that rejects a dispatch is disarmed on the
+        counted rung and the tick finishes on JIT with the identical
+        decision."""
+        solver = TPUSolver(g_max=64)
+        mgr = solver.enable_aot(None, serialize=False, duty=1.0,
+                                pads=(armed_world["pad"],))
+        mgr.run_plan(solver._catalog(catalog_items), throttle=False)
+
+        class Rejecting:
+            def __call__(self, *a, **k):
+                raise RuntimeError("injected dispatch failure")
+
+        with mgr._lock:
+            keys = list(mgr._armed)
+            for k in keys:
+                mgr._armed[k] = Rejecting()
+        before = aot.AOT_FALLBACKS.value(reason="dispatch")
+        result = solver.solve(armed_world["pool"], catalog_items,
+                              armed_world["pods"])
+        assert aot.AOT_FALLBACKS.value(reason="dispatch") - before >= 1
+        with mgr._lock:
+            assert len(mgr._armed) < len(keys)  # disarmed, not retried
+        assert decisions_sig(result) == decisions_sig(armed_world["result_jit"])
+
+
+class TestMeshCoverage:
+    def test_shrunk_layout_reshard_zero_compiles(self, catalog_items):
+        """The degrade-ladder chapter: warm-call tasks cover the CURRENT
+        mesh and every deterministic shrunk pow2 layout, so the first
+        tick after a quarantine recompiles NOTHING and decides the same."""
+        from karpenter_tpu.analysis import jax_witness
+
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the 8-device virtual mesh (tests/conftest.py)")
+        pool = NodePool("default")
+        pods = make_pods()
+        solver = TPUSolver(g_max=64, mesh=8)
+        mgr = solver.enable_aot(None, serialize=False, duty=1.0, pads=(16,))
+        r0 = solver.solve(pool, catalog_items, pods)
+        plan = mgr.run_plan(solver._catalog(catalog_items), throttle=False)
+        # full + shrunk(4) + shrunk(2), fused + bound each
+        assert plan["tasks"] >= 6
+        solver.mesh_engine.quarantine_worst_device("test-aot")
+        st0 = jax_witness.stats()
+        with jax_witness.hot("aot-reshard-tick"):
+            r1 = solver.solve(pool, catalog_items, pods)
+        st1 = jax_witness.stats()
+        assert st1["compiles_total"] == st0["compiles_total"]
+        assert st1["traces_total"] == st0["traces_total"]
+        assert decisions_sig(r1) == decisions_sig(r0)
+
+
+class TestAttribution:
+    def test_witness_aot_phase_exemption(self):
+        """Compiles under aot_phase() land on the AOT counters, never the
+        hot-path compile counters a hot section would flag."""
+        from karpenter_tpu.analysis import jax_witness
+
+        @jax.jit
+        def probe(x, salt):
+            return x * 2.0 + salt
+
+        st0 = jax_witness.stats()
+        with jax_witness.aot_phase():
+            probe(np.float32(3.0), 11.0).block_until_ready()
+        st1 = jax_witness.stats()
+        assert st1["aot_compiles_total"] > st0["aot_compiles_total"]
+        assert st1["compiles_total"] == st0["compiles_total"]
+
+    def test_jitstats_aot_columns(self):
+        from karpenter_tpu.obs import jitstats
+
+        jitstats.note_aot("test_entry_family", 0.25)
+        row = jitstats.table()["test_entry_family"]
+        assert row["aot_compiles"] >= 1
+        assert row["aot_compile_ms"] >= 250.0
+        # never mixed into the hot-path compile columns
+        assert row["compiles"] == 0
+
+    def test_cache_stats_keys(self):
+        from karpenter_tpu.obs import jitstats
+
+        cs = jitstats.cache_stats()
+        assert set(cs) == {"hits", "misses", "bytes"}
+
+
+class TestRestartDrill:
+    def test_restart_zero_compiles_subprocess(self, tmp_path):
+        """The headline contract end to end: process 1 solves cold with
+        both cache layers enabled and serializes; process 2 restarts onto
+        the same root and its first production tick must run ZERO
+        compiles and ZERO traces, deciding identically."""
+        script = os.path.join(ROOT, "tests", "fixtures", "aot_restart_child.py")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=1",
+                   KARPENTER_TPU_LOCK_WITNESS="0")
+        root = str(tmp_path / "cache")
+        outs = []
+        for phase in ("serialize", "restart"):
+            proc = subprocess.run(
+                [sys.executable, script, phase, root],
+                capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            outs.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+        first, second = outs
+        assert first["serialized"] >= 2
+        assert second["loaded"] >= 2
+        assert second["first_tick_compiles"] == 0
+        assert second["first_tick_traces"] == 0
+        assert second["decisions"] == first["decisions"]
